@@ -1,0 +1,18 @@
+"""production-stack-tpu: a TPU-native LLM serving-fleet framework.
+
+Re-implements the capabilities of the vLLM Production Stack
+(reference: /root/reference, an orchestration layer around vLLM) as a
+standalone TPU-first system:
+
+- ``production_stack_tpu.engine``  — a JAX/Pallas serving engine (paged KV
+  cache, continuous batching, tensor/sequence parallelism over a device
+  mesh) exposing an OpenAI-compatible HTTP surface.
+- ``production_stack_tpu.router``  — an L7 request router (service
+  discovery, routing policies, stats, metrics), the analogue of the
+  reference's ``src/vllm_router``.
+- ``production_stack_tpu.kvserver`` — remote KV block store + cache
+  controller (the analogue of the reference's LMCache server/controller).
+- ``helm/``, ``csrc/operator``     — deployment + control plane.
+"""
+
+__version__ = "0.1.0"

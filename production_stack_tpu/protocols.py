@@ -1,0 +1,238 @@
+"""OpenAI-compatible protocol models shared by engine server and router.
+
+Parity surface: the reference router's ``src/vllm_router/protocols.py:11-56``
+(ModelCard/ModelList/ErrorResponse) plus the request/response bodies the
+vLLM OpenAI server speaks (the engine here implements them natively).
+Unknown extra fields are accepted and logged, as in the reference.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from .logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def random_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+class _Permissive(BaseModel):
+    """Base model that tolerates (and logs) unknown fields."""
+
+    model_config = ConfigDict(extra="allow", protected_namespaces=())
+
+    @model_validator(mode="after")
+    def _log_extra(self):
+        if self.model_extra:
+            logger.debug(
+                "%s received extra fields: %s",
+                type(self).__name__,
+                sorted(self.model_extra),
+            )
+        return self
+
+
+# ----------------------------------------------------------------------------
+# Models listing
+# ----------------------------------------------------------------------------
+
+
+class ModelCard(_Permissive):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+
+class ModelList(_Permissive):
+    object: str = "list"
+    data: List[ModelCard] = Field(default_factory=list)
+
+
+class ErrorResponse(_Permissive):
+    object: str = "error"
+    message: str
+    type: str = "invalid_request_error"
+    code: int = 400
+    param: Optional[str] = None
+
+
+# ----------------------------------------------------------------------------
+# Chat / completion requests
+# ----------------------------------------------------------------------------
+
+
+class ChatMessage(_Permissive):
+    role: Literal["system", "user", "assistant", "tool"] = "user"
+    content: Union[str, List[Dict[str, Any]], None] = None
+    name: Optional[str] = None
+
+    def text(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if isinstance(self.content, list):
+            return "".join(
+                part.get("text", "")
+                for part in self.content
+                if isinstance(part, dict) and part.get("type", "text") == "text"
+            )
+        return ""
+
+
+class SamplingFields(_Permissive):
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1
+    min_p: float = 0.0
+    n: int = 1
+    stop: Union[str, List[str], None] = None
+    stop_token_ids: Optional[List[int]] = None
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    seed: Optional[int] = None
+    logprobs: Union[bool, int, None] = None
+    top_logprobs: Optional[int] = None
+    ignore_eos: bool = False
+    stream: bool = False
+    stream_options: Optional[Dict[str, Any]] = None
+    user: Optional[str] = None
+
+
+class CompletionRequest(SamplingFields):
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]] = ""
+    echo: bool = False
+    suffix: Optional[str] = None
+
+
+class ChatCompletionRequest(SamplingFields):
+    model: str
+    messages: List[ChatMessage] = Field(default_factory=list)
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Union[str, Dict[str, Any], None] = None
+    response_format: Optional[Dict[str, Any]] = None
+
+
+class EmbeddingRequest(_Permissive):
+    model: str
+    input: Union[str, List[str], List[int], List[List[int]]] = ""
+    encoding_format: str = "float"
+    dimensions: Optional[int] = None
+
+
+class TokenizeRequest(_Permissive):
+    model: Optional[str] = None
+    prompt: Optional[str] = None
+    messages: Optional[List[ChatMessage]] = None
+    add_special_tokens: bool = True
+
+
+class DetokenizeRequest(_Permissive):
+    model: Optional[str] = None
+    tokens: List[int] = Field(default_factory=list)
+
+
+class RerankRequest(_Permissive):
+    model: Optional[str] = None
+    query: str = ""
+    documents: List[str] = Field(default_factory=list)
+    top_n: Optional[int] = None
+
+
+class ScoreRequest(_Permissive):
+    model: Optional[str] = None
+    text_1: Union[str, List[str]] = ""
+    text_2: Union[str, List[str]] = ""
+
+
+# ----------------------------------------------------------------------------
+# Responses
+# ----------------------------------------------------------------------------
+
+
+class UsageInfo(_Permissive):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class CompletionChoice(_Permissive):
+    index: int = 0
+    text: str = ""
+    logprobs: Optional[Dict[str, Any]] = None
+    finish_reason: Optional[str] = None
+
+
+class CompletionResponse(_Permissive):
+    id: str = Field(default_factory=lambda: random_id("cmpl"))
+    object: str = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[CompletionChoice] = Field(default_factory=list)
+    usage: UsageInfo = Field(default_factory=UsageInfo)
+
+
+class ChatCompletionMessage(_Permissive):
+    role: str = "assistant"
+    content: Optional[str] = None
+
+
+class ChatChoice(_Permissive):
+    index: int = 0
+    message: ChatCompletionMessage = Field(default_factory=ChatCompletionMessage)
+    logprobs: Optional[Dict[str, Any]] = None
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(_Permissive):
+    id: str = Field(default_factory=lambda: random_id("chatcmpl"))
+    object: str = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatChoice] = Field(default_factory=list)
+    usage: UsageInfo = Field(default_factory=UsageInfo)
+
+
+class DeltaMessage(_Permissive):
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatStreamChoice(_Permissive):
+    index: int = 0
+    delta: DeltaMessage = Field(default_factory=DeltaMessage)
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(_Permissive):
+    id: str = ""
+    object: str = "chat.completion.chunk"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatStreamChoice] = Field(default_factory=list)
+    usage: Optional[UsageInfo] = None
+
+
+class EmbeddingData(_Permissive):
+    object: str = "embedding"
+    index: int = 0
+    embedding: List[float] = Field(default_factory=list)
+
+
+class EmbeddingResponse(_Permissive):
+    object: str = "list"
+    data: List[EmbeddingData] = Field(default_factory=list)
+    model: str = ""
+    usage: UsageInfo = Field(default_factory=UsageInfo)

@@ -1,0 +1,147 @@
+"""Shared small utilities (singletons, URL validation, parsing helpers).
+
+Capability parity with the reference router's ``src/vllm_router/utils.py``
+(SingletonMeta :17-30, ModelType :49-81, url validation :84-102, ulimit
+bump :106-121, alias/CSV parsing :124-147) — re-designed, not copied.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+import re
+import resource
+import threading
+from abc import ABCMeta
+from typing import Any, Dict, List, Optional
+
+
+class SingletonMeta(type):
+    """Thread-safe singleton metaclass (one instance per class)."""
+
+    _instances: Dict[type, Any] = {}
+    _lock = threading.Lock()
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            with cls._lock:
+                if cls not in cls._instances:
+                    cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+    def destroy(cls) -> None:
+        """Drop the cached instance (used by hot-reconfiguration)."""
+        with cls._lock:
+            cls._instances.pop(cls, None)
+
+
+class SingletonABCMeta(ABCMeta, SingletonMeta):
+    """Singleton metaclass usable with abc.ABC subclasses."""
+
+
+class ModelType(enum.Enum):
+    """Model capability classes, each with a minimal health-probe payload.
+
+    Mirrors the reference's ModelType (utils.py:49-81): the payload is a
+    cheap request that exercises the corresponding endpoint.
+    """
+
+    chat = "/v1/chat/completions"
+    completion = "/v1/completions"
+    embeddings = "/v1/embeddings"
+    rerank = "/v1/rerank"
+    score = "/v1/score"
+
+    @staticmethod
+    def get_test_payload(model_type: str) -> dict:
+        payloads = {
+            "chat": {
+                "messages": [{"role": "user", "content": "ping"}],
+                "max_tokens": 1,
+                "temperature": 0,
+            },
+            "completion": {"prompt": "ping", "max_tokens": 1, "temperature": 0},
+            "embeddings": {"input": "ping"},
+            "rerank": {"query": "ping", "documents": ["pong"]},
+            "score": {"text_1": "ping", "text_2": "pong"},
+        }
+        return payloads[model_type]
+
+    @staticmethod
+    def get_all_fields() -> List[str]:
+        return [m.name for m in ModelType]
+
+
+_HOSTNAME_RE = re.compile(
+    r"^(?=.{1,253}$)([a-zA-Z0-9](?:[a-zA-Z0-9-]{0,61}[a-zA-Z0-9])?\.)*"
+    r"[a-zA-Z0-9](?:[a-zA-Z0-9-]{0,61}[a-zA-Z0-9])?$"
+)
+
+
+def validate_url(url: str) -> bool:
+    """True iff url looks like http(s)://host[:port][/path]."""
+    m = re.match(r"^(https?)://([^/:]+)(:\d{1,5})?(/.*)?$", url)
+    if not m:
+        return False
+    host = m.group(2)
+    if m.group(3):
+        port = int(m.group(3)[1:])
+        if not (0 < port < 65536):
+            return False
+    try:
+        ipaddress.ip_address(host)
+        return True
+    except ValueError:
+        return bool(_HOSTNAME_RE.match(host))
+
+
+def validate_static_urls(csv: str) -> bool:
+    return all(validate_url(u) for u in parse_comma_separated(csv))
+
+
+def parse_comma_separated(value: Optional[str]) -> List[str]:
+    if not value:
+        return []
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def parse_static_urls(value: str) -> List[str]:
+    urls = parse_comma_separated(value)
+    bad = [u for u in urls if not validate_url(u)]
+    if bad:
+        raise ValueError(f"invalid static backend url(s): {bad}")
+    return urls
+
+
+def parse_static_model_names(value: str) -> List[str]:
+    return parse_comma_separated(value)
+
+
+def parse_static_aliases(value: Optional[str]) -> Dict[str, str]:
+    """Parse ``alias1:model1,alias2:model2`` into a dict."""
+    aliases: Dict[str, str] = {}
+    for pair in parse_comma_separated(value):
+        if ":" not in pair:
+            raise ValueError(f"bad alias spec {pair!r}, expected alias:model")
+        alias, model = pair.split(":", 1)
+        aliases[alias.strip()] = model.strip()
+    return aliases
+
+
+def set_ulimit(target_soft: int = 65535) -> None:
+    """Raise RLIMIT_NOFILE soft limit for high-fanout proxying."""
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < target_soft:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(target_soft, hard), hard)
+            )
+    except (ValueError, OSError):
+        pass
+
+
+def update_content_length(headers: Dict[str, str], body: bytes) -> Dict[str, str]:
+    """Return headers with Content-Length matching body (after rewrites)."""
+    headers = {k: v for k, v in headers.items() if k.lower() != "content-length"}
+    headers["Content-Length"] = str(len(body))
+    return headers

@@ -1,0 +1,56 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's "multi-node without a real cluster" testing strategy
+(SURVEY.md §4): all sharding/multi-chip tests run on virtual CPU devices.
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("PST_FORCE_PALLAS_INTERPRET", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if inspect.iscoroutinefunction(getattr(item, "function", None)):
+            item.add_marker(pytest.mark.asyncio)
+
+
+@pytest.fixture
+def event_loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio test support (pytest-asyncio may be absent)."""
+    func = pyfuncitem.function
+    if inspect.iscoroutinefunction(func):
+        sig = inspect.signature(func)
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in sig.parameters
+            if name in pyfuncitem.funcargs
+        }
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(func(**kwargs))
+        finally:
+            loop.close()
+        return True
+    return None

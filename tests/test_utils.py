@@ -1,0 +1,76 @@
+"""Unit tests for shared utils (parity with reference test_utils/test_singleton)."""
+
+import threading
+
+from production_stack_tpu.utils import (
+    ModelType,
+    SingletonMeta,
+    parse_static_aliases,
+    parse_static_urls,
+    validate_url,
+)
+
+
+class _Single(metaclass=SingletonMeta):
+    def __init__(self):
+        self.value = 0
+
+
+def test_singleton_identity():
+    a = _Single()
+    b = _Single()
+    assert a is b
+    a.value = 7
+    assert b.value == 7
+    _Single.destroy()
+    c = _Single()
+    assert c is not a
+
+
+def test_singleton_thread_safety():
+    _Single.destroy()
+    seen = []
+
+    def make():
+        seen.append(_Single())
+
+    threads = [threading.Thread(target=make) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(s) for s in seen}) == 1
+
+
+def test_validate_url():
+    assert validate_url("http://localhost:8000")
+    assert validate_url("https://engine-0.ns.svc.cluster.local/v1")
+    assert validate_url("http://10.0.0.3:9000/metrics")
+    assert not validate_url("ftp://host")
+    assert not validate_url("http://")
+    assert not validate_url("not-a-url")
+    assert not validate_url("http://host:99999")
+
+
+def test_parse_static_urls():
+    urls = parse_static_urls("http://a:1, http://b:2")
+    assert urls == ["http://a:1", "http://b:2"]
+    try:
+        parse_static_urls("http://a:1,bogus")
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
+
+
+def test_parse_aliases():
+    assert parse_static_aliases("gpt4:llama-3-8b,small:opt-125m") == {
+        "gpt4": "llama-3-8b",
+        "small": "opt-125m",
+    }
+
+
+def test_model_type_payloads():
+    for name in ModelType.get_all_fields():
+        payload = ModelType.get_test_payload(name)
+        assert isinstance(payload, dict) and payload
