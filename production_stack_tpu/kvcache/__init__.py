@@ -1,0 +1,1 @@
+"""KV-cache block hashing, tiering, and transfer shared by engine/router/kvserver."""

@@ -1,0 +1,60 @@
+"""Content hashing of token chunks — the contract that makes prefix reuse,
+KV-aware routing, and remote KV lookup agree with each other.
+
+The reference delegates this to LMCache (engines report chunk hashes to the
+LMCache controller; the router tokenizes and asks the controller for the
+longest match — ``routing_logic.py:287-299``). Here the scheme is explicit
+and shared: a rolling xxhash over fixed-size token chunks, where each chunk
+hash commits to the full prefix before it (so equal hash ⇒ equal prefix,
+modulo 64-bit collisions).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import xxhash
+
+# One hash per CHUNK_TOKENS tokens. Must divide/align with the engine KV
+# block size (engine blocks per chunk = CHUNK_TOKENS // block_size).
+CHUNK_TOKENS = 256
+
+
+def chunk_hashes(token_ids: Sequence[int], chunk_tokens: int = CHUNK_TOKENS) -> List[int]:
+    """Prefix-committing hashes of each full chunk of ``token_ids``.
+
+    Only complete chunks are hashed: a 700-token prompt with 256-token
+    chunks yields 2 hashes. Returns unsigned 63-bit ints (JSON-safe).
+    """
+    out: List[int] = []
+    prev = 0
+    n_full = len(token_ids) // chunk_tokens
+    arr = np.asarray(token_ids[: n_full * chunk_tokens], dtype=np.int64)
+    for i in range(n_full):
+        h = xxhash.xxh64(arr[i * chunk_tokens : (i + 1) * chunk_tokens].tobytes())
+        h.update(prev.to_bytes(8, "little"))
+        prev = h.intdigest()
+        out.append(prev & 0x7FFF_FFFF_FFFF_FFFF)
+    return out
+
+
+def block_hashes(
+    token_ids: Sequence[int], block_size: int, parent: int = 0
+) -> List[int]:
+    """Per-KV-block prefix-committing hashes (engine-side prefix caching).
+
+    Same construction as :func:`chunk_hashes` but at engine block
+    granularity, with an optional parent hash to chain from (used when
+    extending an existing sequence).
+    """
+    out: List[int] = []
+    prev = parent
+    n_full = len(token_ids) // block_size
+    arr = np.asarray(token_ids[: n_full * block_size], dtype=np.int64)
+    for i in range(n_full):
+        h = xxhash.xxh64(arr[i * block_size : (i + 1) * block_size].tobytes())
+        h.update(prev.to_bytes(8, "little", signed=False))
+        prev = h.intdigest()
+        out.append(prev & 0x7FFF_FFFF_FFFF_FFFF)
+    return out

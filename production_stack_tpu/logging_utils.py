@@ -25,10 +25,14 @@ _DATEFMT = "%H:%M:%S"
 
 
 class _ColorFormatter(logging.Formatter):
+    def __init__(self, fmt: str, datefmt: str, stream) -> None:
+        super().__init__(fmt, datefmt)
+        self._stream = stream
+
     def format(self, record: logging.LogRecord) -> str:
         base = super().format(record)
         color = _COLORS.get(record.levelno, "")
-        if color and sys.stderr.isatty():
+        if color and self._stream.isatty():
             return f"{color}{base}{_RESET}"
         return base
 
@@ -42,16 +46,17 @@ def init_logger(name: str, level: int = logging.INFO) -> logging.Logger:
     """Return a logger with colored stdout/stderr split handlers."""
     logger = logging.getLogger(name)
     if getattr(logger, "_pst_configured", False):
+        logger.setLevel(level)
         return logger
     logger.setLevel(level)
     logger.propagate = False
 
     out = logging.StreamHandler(sys.stdout)
     out.addFilter(_BelowWarning())
-    out.setFormatter(_ColorFormatter(_FMT, _DATEFMT))
+    out.setFormatter(_ColorFormatter(_FMT, _DATEFMT, sys.stdout))
     err = logging.StreamHandler(sys.stderr)
     err.setLevel(logging.WARNING)
-    err.setFormatter(_ColorFormatter(_FMT, _DATEFMT))
+    err.setFormatter(_ColorFormatter(_FMT, _DATEFMT, sys.stderr))
 
     logger.addHandler(out)
     logger.addHandler(err)

@@ -1,0 +1,7 @@
+"""L7 request router for the TPU serving fleet.
+
+Capability parity with the reference's ``src/vllm_router`` (an
+OpenAI-compatible FastAPI router over vLLM pods); this implementation is
+asyncio-native on aiohttp.web and fronts ``production_stack_tpu.engine``
+pods (or anything speaking the same OpenAI + /metrics surface).
+"""

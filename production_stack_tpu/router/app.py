@@ -1,0 +1,214 @@
+"""Router app bootstrap: wire discovery, stats, routing, services; serve.
+
+Capability parity with the reference's ``src/vllm_router/app.py``
+(initialize_all :112-271, lifespan :83-109, main :283-299). aiohttp.web
+replaces FastAPI/uvicorn; background workers are asyncio tasks started in
+``on_startup``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..logging_utils import init_logger
+from ..utils import parse_comma_separated, set_ulimit
+from .parser import parse_args
+from .routes import routes
+from .routing.logic import (
+    RoutingLogic,
+    initialize_routing_logic,
+    teardown_routing_logic,
+)
+from .service_discovery import (
+    ServiceDiscoveryType,
+    get_service_discovery,
+    initialize_service_discovery,
+    teardown_service_discovery,
+)
+from .stats.engine_stats import get_engine_stats_scraper, initialize_engine_stats_scraper
+from .stats.request_stats import (
+    get_request_stats_monitor,
+    initialize_request_stats_monitor,
+)
+from .services.callbacks import configure_custom_callbacks
+from .services.rewriter import initialize_request_rewriter
+from .experimental.feature_gates import (
+    PII_DETECTION,
+    SEMANTIC_CACHE,
+    get_feature_gates,
+    initialize_feature_gates,
+)
+
+logger = init_logger(__name__)
+
+
+async def _log_stats_loop(app: web.Application, interval: float) -> None:
+    """Periodic human-readable fleet snapshot (reference log_stats.py:37-115)."""
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            lines = ["", "=" * 60]
+            engine_stats = get_engine_stats_scraper().get_engine_stats()
+            request_stats = get_request_stats_monitor().get_request_stats(time.time())
+            for ep in get_service_discovery().get_endpoint_info():
+                lines.append(f"Server: {ep.url} models={ep.model_names}")
+                es = engine_stats.get(ep.url)
+                if es:
+                    lines.append(
+                        f"  engine: running={es.num_running_requests} "
+                        f"waiting={es.num_queuing_requests} "
+                        f"kv_hit_rate={es.gpu_prefix_cache_hit_rate:.2f} "
+                        f"kv_usage={es.gpu_cache_usage_perc:.2f}"
+                    )
+                rs = request_stats.get(ep.url)
+                if rs:
+                    lines.append(
+                        f"  requests: qps={rs.qps:.2f} ttft={rs.ttft:.3f}s "
+                        f"latency={rs.avg_latency:.3f}s itl={rs.avg_itl:.4f}s "
+                        f"prefill={rs.in_prefill_requests} "
+                        f"decode={rs.in_decoding_requests} "
+                        f"finished={rs.finished_requests}"
+                    )
+            lines.append("=" * 60)
+            logger.info("\n".join(lines))
+        except Exception as e:  # noqa: BLE001
+            logger.error("log_stats loop error: %s", e)
+
+
+@web.middleware
+async def api_key_middleware(request: web.Request, handler):
+    required = request.app.get("api_key")
+    if required and request.path.startswith("/v1"):
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {required}":
+            return web.json_response(
+                {"error": {"message": "invalid API key", "type": "authentication_error"}},
+                status=401,
+            )
+    return await handler(request)
+
+
+def initialize_all(app: web.Application, args) -> None:
+    """Create all router singletons from parsed args (pre-event-loop)."""
+    if args.service_discovery == "static":
+        initialize_service_discovery(
+            ServiceDiscoveryType.STATIC,
+            app=app,
+            urls=parse_comma_separated(args.static_backends),
+            models=parse_comma_separated(args.static_models),
+            aliases=args.static_aliases_parsed,
+            model_labels=parse_comma_separated(args.static_model_labels) or None,
+            model_types=parse_comma_separated(args.static_model_types) or None,
+            static_backend_health_checks=args.static_backend_health_checks,
+            prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
+            decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
+        )
+    else:
+        initialize_service_discovery(
+            ServiceDiscoveryType.K8S,
+            app=app,
+            namespace=args.k8s_namespace,
+            port=args.k8s_port,
+            label_selector=args.k8s_label_selector,
+            k8s_service_discovery_type=args.k8s_service_discovery_type,
+            prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
+            decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
+        )
+
+    initialize_engine_stats_scraper(args.engine_stats_interval)
+    initialize_request_stats_monitor(args.request_stats_window)
+    initialize_routing_logic(
+        RoutingLogic(args.routing_logic),
+        session_key=args.session_key,
+        kv_aware_threshold=args.kv_aware_threshold,
+        controller_url=args.cache_controller_url,
+        tokenizer_name=args.tokenizer_name,
+        prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
+        decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
+    )
+    initialize_request_rewriter(args.request_rewriter)
+    configure_custom_callbacks(args.callbacks)
+    initialize_feature_gates(args.feature_gates)
+    app["api_key"] = args.api_key
+    app["args"] = args
+
+    gates = get_feature_gates()
+    if gates.enabled(SEMANTIC_CACHE):
+        from .experimental.semantic_cache import install_semantic_cache
+
+        install_semantic_cache(app, args)
+    if gates.enabled(PII_DETECTION):
+        from .experimental.pii import install_pii_check
+
+        install_pii_check(app, args)
+    if args.enable_batch_api:
+        from .services.files_service import install_files_api
+        from .services.batch_service import install_batch_api
+
+        install_files_api(app, args)
+        install_batch_api(app, args)
+
+
+def create_app(args) -> web.Application:
+    app = web.Application(middlewares=[api_key_middleware], client_max_size=64 * 2**20)
+    initialize_all(app, args)
+    app.add_routes(routes)
+
+    async def on_startup(app: web.Application) -> None:
+        app["client_session"] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None),
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+        await get_service_discovery().start()
+        await get_engine_stats_scraper().start()
+        if args.log_stats:
+            app["log_stats_task"] = asyncio.create_task(
+                _log_stats_loop(app, args.log_stats_interval)
+            )
+        if args.dynamic_config_json:
+            from .dynamic_config import initialize_dynamic_config_watcher
+
+            app["dynamic_config_watcher"] = initialize_dynamic_config_watcher(
+                args.dynamic_config_json, 10.0, args, app
+            )
+        for key in ("batch_processor",):
+            proc = app.get(key)
+            if proc is not None:
+                await proc.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        for key in ("log_stats_task",):
+            task = app.get(key)
+            if task is not None:
+                task.cancel()
+        watcher = app.get("dynamic_config_watcher")
+        if watcher is not None:
+            watcher.close()
+        get_engine_stats_scraper().close()
+        teardown_service_discovery()
+        teardown_routing_logic()
+        for key in ("client_session", "prefill_client", "decode_client"):
+            session = app.get(key)
+            if session is not None:
+                await session.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = parse_args(argv)
+    set_ulimit()
+    app = create_app(args)
+    logger.info("starting pst-router on %s:%d", args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Hot-reload of discovery/routing config from a watched YAML/JSON file.
+
+Capability parity with the reference's ``src/vllm_router/dynamic_config.py``
+(DynamicRouterConfig :43-117, DynamicConfigWatcher._watch_worker :256-280,
+reconfigure_all :236-244): the file is polled on an interval and, when its
+content hash changes, discovery and routing singletons are torn down and
+rebuilt from the new values.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import yaml
+
+from ..logging_utils import init_logger
+from ..utils import parse_comma_separated, parse_static_aliases
+from .routing.logic import RoutingLogic, reconfigure_routing_logic
+from .service_discovery import (
+    ServiceDiscoveryType,
+    get_service_discovery,
+    reconfigure_service_discovery,
+)
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class DynamicRouterConfig:
+    """The subset of router config that may change at runtime."""
+
+    service_discovery: Optional[str] = None
+    static_backends: Optional[str] = None
+    static_models: Optional[str] = None
+    static_aliases: Optional[str] = None
+    static_model_labels: Optional[str] = None
+    routing_logic: Optional[str] = None
+    session_key: Optional[str] = None
+    kv_aware_threshold: Optional[int] = None
+    cache_controller_url: Optional[str] = None
+    prefill_model_labels: Optional[str] = None
+    decode_model_labels: Optional[str] = None
+
+    @classmethod
+    def from_file(cls, path: str) -> "DynamicRouterConfig":
+        with open(path) as f:
+            raw = yaml.safe_load(f) if path.endswith((".yaml", ".yml")) else json.load(f)
+        fields = {k.replace("-", "_"): v for k, v in (raw or {}).items()}
+        known = {f_ for f_ in cls.__dataclass_fields__}
+        unknown = set(fields) - known
+        if unknown:
+            logger.warning("ignoring unknown dynamic config keys: %s", sorted(unknown))
+        return cls(**{k: v for k, v in fields.items() if k in known})
+
+
+def reconfigure_all(config: DynamicRouterConfig, args, app) -> None:
+    """Apply a new dynamic config by rebuilding the affected singletons."""
+    merged: Dict[str, Any] = {**vars(args)}
+    for k, v in vars(config).items():
+        if v is not None:
+            merged[k] = v
+    sd_type = merged.get("service_discovery", "static")
+    if sd_type == "static":
+        reconfigure_service_discovery(
+            ServiceDiscoveryType.STATIC,
+            app=app,
+            urls=parse_comma_separated(merged.get("static_backends")),
+            models=parse_comma_separated(merged.get("static_models")),
+            aliases=parse_static_aliases(merged.get("static_aliases")),
+            model_labels=parse_comma_separated(merged.get("static_model_labels")) or None,
+        )
+    else:
+        reconfigure_service_discovery(
+            ServiceDiscoveryType.K8S,
+            app=app,
+            namespace=merged.get("k8s_namespace", "default"),
+            port=merged.get("k8s_port", 8000),
+            label_selector=merged.get("k8s_label_selector"),
+            k8s_service_discovery_type=merged.get("k8s_service_discovery_type", "pod-ip"),
+        )
+    reconfigure_routing_logic(
+        RoutingLogic(merged.get("routing_logic", "roundrobin")),
+        session_key=merged.get("session_key"),
+        kv_aware_threshold=merged.get("kv_aware_threshold"),
+        controller_url=merged.get("cache_controller_url"),
+        prefill_model_labels=parse_comma_separated(merged.get("prefill_model_labels")) or None,
+        decode_model_labels=parse_comma_separated(merged.get("decode_model_labels")) or None,
+    )
+    logger.info("dynamic config applied: %s", config)
+
+
+class DynamicConfigWatcher:
+    """Polls the config file; re-applies on content change."""
+
+    def __init__(self, path: str, interval: float, args, app):
+        self.path = path
+        self.interval = interval
+        self.args = args
+        self.app = app
+        self._last_hash: Optional[str] = None
+        self._task = asyncio.get_event_loop().create_task(self._watch())
+        self.current_config: Optional[DynamicRouterConfig] = None
+
+    async def _watch(self) -> None:
+        while True:
+            try:
+                with open(self.path, "rb") as f:
+                    content = f.read()
+                digest = hashlib.sha256(content).hexdigest()
+                if digest != self._last_hash:
+                    if self._last_hash is not None:
+                        logger.info("dynamic config change detected at %s", self.path)
+                        config = DynamicRouterConfig.from_file(self.path)
+                        reconfigure_all(config, self.args, self.app)
+                        await get_service_discovery().start()
+                        self.current_config = config
+                    self._last_hash = digest
+            except FileNotFoundError:
+                logger.debug("dynamic config file %s missing", self.path)
+            except Exception as e:  # noqa: BLE001
+                logger.error("dynamic config reload failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    def get_current_config(self) -> Optional[DynamicRouterConfig]:
+        return self.current_config
+
+    def close(self) -> None:
+        self._task.cancel()
+
+
+_watcher: Optional[DynamicConfigWatcher] = None
+
+
+def initialize_dynamic_config_watcher(
+    path: str, interval: float, args, app
+) -> DynamicConfigWatcher:
+    global _watcher
+    _watcher = DynamicConfigWatcher(path, interval, args, app)
+    return _watcher
+
+
+def get_dynamic_config_watcher() -> Optional[DynamicConfigWatcher]:
+    return _watcher

@@ -1,0 +1,84 @@
+"""Feature gates for experimental router subsystems.
+
+Capability parity with the reference's
+``src/vllm_router/experimental/feature_gates.py:46-104``:
+``--feature-gates SemanticCache=true,PIIDetection=true`` with
+Alpha/Beta/GA stages and a singleton registry.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class FeatureStage(enum.Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclass(frozen=True)
+class Feature:
+    name: str
+    stage: FeatureStage
+    default: bool
+
+
+SEMANTIC_CACHE = "SemanticCache"
+PII_DETECTION = "PIIDetection"
+
+KNOWN_FEATURES: Dict[str, Feature] = {
+    SEMANTIC_CACHE: Feature(SEMANTIC_CACHE, FeatureStage.ALPHA, False),
+    PII_DETECTION: Feature(PII_DETECTION, FeatureStage.ALPHA, False),
+}
+
+
+class FeatureGates:
+    def __init__(self, spec: Optional[str] = None):
+        self._enabled: Dict[str, bool] = {
+            name: f.default for name, f in KNOWN_FEATURES.items()
+        }
+        for pair in (spec or "").split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(f"bad feature gate {pair!r}, expected Name=true|false")
+            name, value = pair.split("=", 1)
+            name = name.strip()
+            if name not in KNOWN_FEATURES:
+                raise ValueError(
+                    f"unknown feature gate {name!r}; known: {sorted(KNOWN_FEATURES)}"
+                )
+            self._enabled[name] = value.strip().lower() in ("true", "1", "yes")
+            logger.info(
+                "feature gate %s=%s (stage %s)",
+                name,
+                self._enabled[name],
+                KNOWN_FEATURES[name].stage.value,
+            )
+
+    def enabled(self, name: str) -> bool:
+        return self._enabled.get(name, False)
+
+
+_gates: Optional[FeatureGates] = None
+
+
+def initialize_feature_gates(spec: Optional[str] = None) -> FeatureGates:
+    global _gates
+    _gates = FeatureGates(spec)
+    return _gates
+
+
+def get_feature_gates() -> FeatureGates:
+    global _gates
+    if _gates is None:
+        _gates = FeatureGates()
+    return _gates

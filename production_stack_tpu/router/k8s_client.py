@@ -1,0 +1,109 @@
+"""Minimal in-cluster Kubernetes API client (no external dependency).
+
+The reference router depends on the official ``kubernetes`` Python client
+for pod/service watches (``service_discovery.py:571-617``). Here the same
+capability is provided natively: service-account credentials from the
+standard in-cluster mount, aiohttp for the HTTP layer, and the K8s
+``watch=true`` chunked-JSON stream protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+from typing import AsyncIterator, Optional
+
+import aiohttp
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sClient:
+    """Talks to the API server from inside a pod (or via env overrides).
+
+    Env overrides for out-of-cluster testing:
+      PST_K8S_API_SERVER  (e.g. http://127.0.0.1:8001 — a kubectl proxy)
+      PST_K8S_TOKEN / PST_K8S_CA_CERT
+    """
+
+    def __init__(self) -> None:
+        self.api_server = os.environ.get("PST_K8S_API_SERVER")
+        if not self.api_server:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if host:
+                self.api_server = f"https://{host}:{port}"
+        self.token = os.environ.get("PST_K8S_TOKEN")
+        if not self.token and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                self.token = f.read().strip()
+        ca = os.environ.get("PST_K8S_CA_CERT", f"{SA_DIR}/ca.crt")
+        self.ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.api_server and self.api_server.startswith("https") and os.path.exists(ca):
+            self.ssl_ctx = ssl.create_default_context(cafile=ca)
+
+    def _headers(self) -> dict:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    async def _watch(
+        self, resource: str, namespace: str, label_selector: Optional[str]
+    ) -> AsyncIterator[dict]:
+        """Yield watch events for a namespaced resource, forever-per-call.
+
+        First lists the resource (synthesizing ADDED events) so callers
+        converge even if they start after the pods, then opens the watch
+        stream from the list's resourceVersion.
+        """
+        if not self.api_server:
+            raise RuntimeError(
+                "no Kubernetes API server configured (not in-cluster and "
+                "PST_K8S_API_SERVER unset)"
+            )
+        base = f"{self.api_server}/api/v1/namespaces/{namespace}/{resource}"
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=None)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(
+                base, params=params, headers=self._headers(), ssl=self.ssl_ctx
+            ) as resp:
+                resp.raise_for_status()
+                listing = await resp.json()
+            for item in listing.get("items", []):
+                yield {"type": "ADDED", "object": item}
+            rv = listing.get("metadata", {}).get("resourceVersion", "0")
+            wparams = dict(params, watch="true", resourceVersion=rv)
+            async with session.get(
+                base, params=wparams, headers=self._headers(), ssl=self.ssl_ctx
+            ) as resp:
+                resp.raise_for_status()
+                buf = b""
+                async for chunk in resp.content.iter_any():
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            yield json.loads(line)
+                        except json.JSONDecodeError:
+                            logger.debug("skipping malformed watch line")
+
+    def watch_pods(
+        self, namespace: str, label_selector: Optional[str] = None
+    ) -> AsyncIterator[dict]:
+        return self._watch("pods", namespace, label_selector)
+
+    def watch_services(
+        self, namespace: str, label_selector: Optional[str] = None
+    ) -> AsyncIterator[dict]:
+        return self._watch("services", namespace, label_selector)

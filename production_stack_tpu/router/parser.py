@@ -1,0 +1,150 @@
+"""Router CLI: argparse flags, YAML/JSON bootstrap defaults, validation.
+
+Capability parity with the reference's ``src/vllm_router/parsers/parser.py``
+(parse_args :120-382, validate_args :85-117, YAML/JSON defaults merge
+:47-68) and ``parsers/yaml_utils.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, Optional
+
+import yaml
+
+from ..logging_utils import init_logger
+from ..utils import (
+    parse_comma_separated,
+    parse_static_aliases,
+    parse_static_urls,
+)
+
+logger = init_logger(__name__)
+
+
+def load_bootstrap_config(path: Optional[str]) -> Dict[str, Any]:
+    """Load a YAML/JSON file whose keys are CLI flag names (dashes or
+    underscores) used as argparse defaults."""
+    if not path:
+        return {}
+    with open(path) as f:
+        data = yaml.safe_load(f) if path.endswith((".yaml", ".yml")) else json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"bootstrap config {path} must be a mapping")
+    return {k.replace("-", "_"): v for k, v in data.items()}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pst-router", description="TPU serving-fleet L7 router"
+    )
+    p.add_argument("--config", help="YAML/JSON file with default flag values")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8001)
+
+    # Service discovery
+    p.add_argument(
+        "--service-discovery", choices=["static", "k8s"], default="static"
+    )
+    p.add_argument(
+        "--k8s-service-discovery-type",
+        choices=["pod-ip", "service-name"],
+        default="pod-ip",
+    )
+    p.add_argument("--static-backends", help="comma-separated engine URLs")
+    p.add_argument("--static-models", help="comma-separated model names (one per backend)")
+    p.add_argument("--static-aliases", help="alias1:model1,alias2:model2")
+    p.add_argument("--static-model-labels", help="comma-separated labels (one per backend)")
+    p.add_argument("--static-model-types", help="comma-separated model types (chat|completion|embeddings|rerank|score)")
+    p.add_argument("--static-backend-health-checks", action="store_true")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-port", type=int, default=8000)
+    p.add_argument("--k8s-label-selector", default=None)
+
+    # Routing
+    p.add_argument(
+        "--routing-logic",
+        choices=["roundrobin", "session", "kvaware", "prefixaware", "disaggregated_prefill"],
+        default="roundrobin",
+    )
+    p.add_argument("--session-key", default=None)
+    p.add_argument("--kv-aware-threshold", type=int, default=2000)
+    p.add_argument("--cache-controller-url", default=None, help="KV cache controller base URL (kvaware routing)")
+    p.add_argument("--tokenizer-name", default=None, help="tokenizer for kvaware prefix hashing (defaults to request model)")
+    p.add_argument("--prefill-model-labels", default=None)
+    p.add_argument("--decode-model-labels", default=None)
+
+    # Stats / metrics
+    p.add_argument("--engine-stats-interval", type=float, default=15.0)
+    p.add_argument("--request-stats-window", type=float, default=60.0)
+    p.add_argument("--log-stats", action="store_true")
+    p.add_argument("--log-stats-interval", type=float, default=10.0)
+
+    # Files / batches
+    p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument("--file-storage-class", default="local_file")
+    p.add_argument("--file-storage-path", default="/tmp/pst_files")
+    p.add_argument("--batch-processor", default="local")
+
+    # Dynamic config & callbacks & experimental
+    p.add_argument("--dynamic-config-json", help="path to a hot-reloaded config file")
+    p.add_argument("--callbacks", help="python file or module with pre/post request hooks")
+    p.add_argument("--request-rewriter", default="noop")
+    p.add_argument("--feature-gates", default="")
+    p.add_argument("--semantic-cache-model", default="all-MiniLM-L6-v2")
+    p.add_argument("--semantic-cache-dir", default=None)
+    p.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+
+    # Misc
+    p.add_argument("--api-key", default=None, help="require this bearer token from clients")
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def validate_args(args: argparse.Namespace) -> None:
+    if args.service_discovery == "static":
+        if not args.static_backends:
+            raise ValueError("static discovery requires --static-backends")
+        if not args.static_models:
+            raise ValueError("static discovery requires --static-models")
+        urls = parse_static_urls(args.static_backends)
+        models = parse_comma_separated(args.static_models)
+        if len(urls) != len(models):
+            raise ValueError(
+                f"--static-backends ({len(urls)}) and --static-models "
+                f"({len(models)}) must have the same length"
+            )
+        if args.static_model_labels:
+            labels = parse_comma_separated(args.static_model_labels)
+            if len(labels) != len(urls):
+                raise ValueError("--static-model-labels length mismatch")
+        if args.static_backend_health_checks and not args.static_model_types:
+            raise ValueError(
+                "--static-backend-health-checks requires --static-model-types"
+            )
+    if args.routing_logic == "session" and not args.session_key:
+        raise ValueError("session routing requires --session-key")
+    if args.routing_logic == "disaggregated_prefill":
+        if not (args.prefill_model_labels and args.decode_model_labels):
+            raise ValueError(
+                "disaggregated_prefill routing requires --prefill-model-labels "
+                "and --decode-model-labels"
+            )
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = build_parser()
+    # Two-pass: read --config first, re-parse with file values as defaults.
+    pre, _ = parser.parse_known_args(argv)
+    if pre.config:
+        defaults = load_bootstrap_config(pre.config)
+        known = {a.dest for a in parser._actions}
+        unknown = set(defaults) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        parser.set_defaults(**defaults)
+    args = parser.parse_args(argv)
+    validate_args(args)
+    args.static_aliases_parsed = parse_static_aliases(args.static_aliases)
+    return args

@@ -1,0 +1,227 @@
+"""HTTP route table for the router (OpenAI surface + admin + metrics).
+
+Capability parity with the reference's
+``src/vllm_router/routers/main_router.py:40-231`` (route list in
+SURVEY.md §1) and ``routers/metrics_router.py:57-123``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import psutil
+from aiohttp import web
+from prometheus_client import generate_latest
+
+from .. import __version__
+from ..logging_utils import init_logger
+from .service_discovery import get_service_discovery
+from .services import metrics_service as gauges
+from .services.request_service import (
+    route_general_request,
+    route_sleep_wakeup_request,
+)
+from .stats.engine_stats import get_engine_stats_scraper
+from .stats.request_stats import get_request_stats_monitor
+
+logger = init_logger(__name__)
+
+routes = web.RouteTableDef()
+
+
+# ---------------------------------------------------------------------------
+# OpenAI-compatible endpoints (proxied to engines)
+# ---------------------------------------------------------------------------
+
+
+@routes.post("/v1/chat/completions")
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    check = request.app.get("semantic_cache_check")
+    if check is not None:
+        cached = await check(request)
+        if cached is not None:
+            return cached
+    return await route_general_request(request, "/v1/chat/completions")
+
+
+@routes.post("/v1/completions")
+async def completions(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/completions")
+
+
+@routes.post("/v1/embeddings")
+async def embeddings(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/embeddings")
+
+
+@routes.post("/v1/rerank")
+@routes.post("/rerank")
+async def rerank(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/rerank")
+
+
+@routes.post("/v1/score")
+@routes.post("/score")
+async def score(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/score")
+
+
+@routes.post("/tokenize")
+async def tokenize(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/tokenize")
+
+
+@routes.post("/detokenize")
+async def detokenize(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/detokenize")
+
+
+@routes.get("/v1/models")
+async def list_models(request: web.Request) -> web.Response:
+    """Aggregate model cards across all live engines (dedup by id)."""
+    seen = {}
+    for ep in get_service_discovery().get_endpoint_info():
+        for model_id, info in ep.model_info.items():
+            if model_id not in seen:
+                seen[model_id] = {
+                    "id": model_id,
+                    "object": "model",
+                    "created": info.created,
+                    "owned_by": info.owned_by,
+                    "parent": info.parent,
+                    "root": info.root,
+                }
+        for model_id in ep.model_names:
+            seen.setdefault(
+                model_id,
+                {
+                    "id": model_id,
+                    "object": "model",
+                    "created": int(ep.added_timestamp),
+                    "owned_by": "production-stack-tpu",
+                    "parent": None,
+                    "root": None,
+                },
+            )
+    # Aliases appear as models so clients can discover them.
+    aliases = getattr(get_service_discovery(), "aliases", None) or {}
+    for alias, target in aliases.items():
+        if alias not in seen and target in seen:
+            card = dict(seen[target])
+            card["id"] = alias
+            seen[alias] = card
+    return web.json_response({"object": "list", "data": list(seen.values())})
+
+
+# ---------------------------------------------------------------------------
+# Admin / observability
+# ---------------------------------------------------------------------------
+
+
+@routes.get("/version")
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+@routes.get("/health")
+async def health(request: web.Request) -> web.Response:
+    """Composite health: discovery watcher + stats scraper must be live."""
+    discovery = get_service_discovery()
+    if not discovery.get_health():
+        return web.json_response(
+            {"status": "unhealthy", "reason": "service discovery watcher died"},
+            status=503,
+        )
+    scraper = get_engine_stats_scraper()
+    if not scraper.get_health():
+        return web.json_response(
+            {"status": "unhealthy", "reason": "engine stats scraper died"}, status=503
+        )
+    return web.json_response({"status": "healthy"})
+
+
+@routes.get("/engines")
+async def engines(request: web.Request) -> web.Response:
+    """Current engine pool with live engine- and request-level stats."""
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    out = []
+    for ep in get_service_discovery().get_endpoint_info():
+        es = engine_stats.get(ep.url)
+        rs = request_stats.get(ep.url)
+        out.append(
+            {
+                "url": ep.url,
+                "id": ep.Id,
+                "models": ep.model_names,
+                "model_label": ep.model_label,
+                "sleep": ep.sleep,
+                "pod_name": ep.pod_name,
+                "namespace": ep.namespace,
+                "engine_stats": es.__dict__ if es else None,
+                "request_stats": rs.__dict__ if rs else None,
+            }
+        )
+    return web.json_response(out)
+
+
+@routes.get("/metrics")
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus exposition: refresh gauges from live stats, then render.
+
+    Parity: reference metrics_router.py:57-123 (also samples router-process
+    CPU/mem/disk via psutil).
+    """
+    endpoints = get_service_discovery().get_endpoint_info()
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    for ep in endpoints:
+        url = ep.url
+        es = engine_stats.get(url)
+        if es is not None:
+            gauges.gpu_prefix_cache_hit_rate.labels(server=url).set(
+                es.gpu_prefix_cache_hit_rate
+            )
+            gauges.gpu_prefix_cache_hits_total.labels(server=url).set(
+                es.gpu_prefix_cache_hits_total
+            )
+            gauges.gpu_prefix_cache_queries_total.labels(server=url).set(
+                es.gpu_prefix_cache_queries_total
+            )
+            gauges.gpu_cache_usage_perc.labels(server=url).set(es.gpu_cache_usage_perc)
+            gauges.num_requests_waiting.labels(server=url).set(es.num_queuing_requests)
+        rs = request_stats.get(url)
+        if rs is not None:
+            gauges.current_qps.labels(server=url).set(rs.qps)
+            gauges.avg_decoding_length.labels(server=url).set(rs.avg_decoding_length)
+            gauges.num_prefill_requests.labels(server=url).set(rs.in_prefill_requests)
+            gauges.num_decoding_requests.labels(server=url).set(rs.in_decoding_requests)
+            gauges.num_requests_running.labels(server=url).set(
+                rs.in_prefill_requests + rs.in_decoding_requests
+            )
+            gauges.avg_latency.labels(server=url).set(rs.avg_latency)
+            gauges.avg_itl.labels(server=url).set(rs.avg_itl)
+            gauges.num_requests_swapped.labels(server=url).set(rs.num_swapped_requests)
+        gauges.healthy_pods_total.labels(server=url).set(1)
+    # Router-process resource usage.
+    proc = psutil.Process()
+    gauges.router_cpu_percent.set(proc.cpu_percent())
+    gauges.router_memory_mb.set(proc.memory_info().rss / 1e6)
+    gauges.router_disk_percent.set(psutil.disk_usage("/").percent)
+    return web.Response(body=generate_latest(), content_type="text/plain")
+
+
+@routes.post("/sleep")
+async def sleep(request: web.Request) -> web.Response:
+    return await route_sleep_wakeup_request(request, "sleep")
+
+
+@routes.post("/wake_up")
+async def wake_up(request: web.Request) -> web.Response:
+    return await route_sleep_wakeup_request(request, "wake_up")
+
+
+@routes.get("/is_sleeping")
+async def is_sleeping(request: web.Request) -> web.Response:
+    return await route_sleep_wakeup_request(request, "is_sleeping")

@@ -1,0 +1,104 @@
+"""Chunked hash trie for prefix-aware routing.
+
+Capability parity with the reference's ``src/vllm_router/prefix/hashtrie.py``
+(chunked 128-char xxhash trie, per-node asyncio locks, insert :58-74,
+longest_prefix_match :76-103). Additions over the reference: a node budget
+with LRU pruning so a long-running router cannot grow without bound, and
+endpoint eviction when discovery removes a backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional, Set, Tuple
+
+import xxhash
+
+
+class _Node:
+    __slots__ = ("children", "endpoints", "lock", "last_access")
+
+    def __init__(self) -> None:
+        self.children: Dict[int, "_Node"] = {}
+        self.endpoints: Set[str] = set()
+        self.lock = asyncio.Lock()
+        self.last_access = time.monotonic()
+
+
+class HashTrie:
+    def __init__(self, chunk_size: int = 128, max_nodes: int = 262144) -> None:
+        self.chunk_size = chunk_size
+        self.max_nodes = max_nodes
+        self.root = _Node()
+        self._node_count = 1
+
+    def _chunks(self, text: str):
+        for i in range(0, len(text), self.chunk_size):
+            yield xxhash.xxh64_intdigest(text[i : i + self.chunk_size])
+
+    async def insert(self, text: str, endpoint: str) -> None:
+        """Record that ``endpoint`` has served (and likely cached) ``text``."""
+        node = self.root
+        for h in self._chunks(text):
+            async with node.lock:
+                node.endpoints.add(endpoint)
+                child = node.children.get(h)
+                if child is None:
+                    if self._node_count >= self.max_nodes:
+                        self._prune()
+                    child = _Node()
+                    node.children[h] = child
+                    self._node_count += 1
+            node = child
+            node.last_access = time.monotonic()
+        async with node.lock:
+            node.endpoints.add(endpoint)
+
+    async def longest_prefix_match(
+        self, text: str, available: Optional[Set[str]] = None
+    ) -> Tuple[int, Set[str]]:
+        """Return (matched chars, endpoints at the deepest matched node).
+
+        Only endpoints in ``available`` (if given) count as matches; the
+        walk stops where no available endpoint remains on the path.
+        """
+        node = self.root
+        matched_chars = 0
+        best: Set[str] = set()
+        text_len = len(text)
+        for i, h in enumerate(self._chunks(text)):
+            child = node.children.get(h)
+            if child is None:
+                break
+            eps = child.endpoints if available is None else child.endpoints & available
+            if not eps:
+                break
+            node = child
+            node.last_access = time.monotonic()
+            matched_chars = min((i + 1) * self.chunk_size, text_len)
+            best = set(eps)
+        return matched_chars, best
+
+    async def remove_endpoint(self, endpoint: str) -> None:
+        """Drop a disappeared endpoint from the whole trie."""
+
+        def walk(node: _Node) -> None:
+            node.endpoints.discard(endpoint)
+            for child in node.children.values():
+                walk(child)
+
+        walk(self.root)
+
+    def _prune(self) -> None:
+        """Drop the least-recently-accessed top-level subtree (approx. LRU)."""
+        if not self.root.children:
+            return
+        oldest = min(self.root.children, key=lambda h: self.root.children[h].last_access)
+
+        def count(node: _Node) -> int:
+            return 1 + sum(count(c) for c in node.children.values())
+
+        removed = count(self.root.children[oldest])
+        del self.root.children[oldest]
+        self._node_count -= removed

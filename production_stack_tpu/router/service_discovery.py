@@ -1,0 +1,477 @@
+"""Engine endpoint discovery: static lists and Kubernetes watchers.
+
+Capability parity with the reference's ``src/vllm_router/service_discovery.py``
+(EndpointInfo :80-175, StaticServiceDiscovery :206-341, K8sPodIPServiceDiscovery
+:344-746, K8sServiceNameServiceDiscovery :749-1150, factory :1153-1229).
+
+Redesign notes (not a translation):
+- asyncio-native: watchers are asyncio tasks on the app loop, not daemon
+  threads with their own event loops.
+- No ``kubernetes`` client dependency: a minimal in-cluster K8s API client
+  (service-account token + CA, aiohttp watch streams) lives in
+  :mod:`production_stack_tpu.router.k8s_client`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import hashlib
+import time
+import uuid
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from ..logging_utils import init_logger
+from ..utils import ModelType
+
+logger = init_logger(__name__)
+
+
+class ServiceDiscoveryType(enum.Enum):
+    STATIC = "static"
+    K8S = "k8s"
+
+
+@dataclass
+class ModelInfo:
+    """A model (base or LoRA adapter) served by an endpoint."""
+
+    id: str
+    object: str = "model"
+    created: int = field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    parent: Optional[str] = None
+    root: Optional[str] = None
+    is_adapter: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelInfo":
+        return cls(
+            id=d.get("id", ""),
+            object=d.get("object", "model"),
+            created=d.get("created", int(time.time())),
+            owned_by=d.get("owned_by", "unknown"),
+            parent=d.get("parent"),
+            root=d.get("root"),
+            is_adapter=d.get("parent") is not None,
+        )
+
+
+@dataclass
+class EndpointInfo:
+    """One serving-engine endpoint, as seen by the router.
+
+    Field parity with the reference's EndpointInfo
+    (``service_discovery.py:80-175``).
+    """
+
+    url: str
+    model_names: List[str]
+    Id: str
+    added_timestamp: float
+    model_label: str
+    sleep: bool = False
+    pod_name: Optional[str] = None
+    service_name: Optional[str] = None
+    namespace: Optional[str] = None
+    model_info: Dict[str, ModelInfo] = field(default_factory=dict)
+
+    def get_base_models(self) -> List[str]:
+        return [mid for mid, mi in self.model_info.items() if not mi.parent]
+
+    def get_adapters(self) -> List[str]:
+        return [mid for mid, mi in self.model_info.items() if mi.parent]
+
+    def get_adapters_for_model(self, base_model: str) -> List[str]:
+        return [mid for mid, mi in self.model_info.items() if mi.parent == base_model]
+
+    def has_model(self, model_id: str) -> bool:
+        return model_id in self.model_names
+
+    def get_model_info(self, model_id: str) -> Optional[ModelInfo]:
+        return self.model_info.get(model_id)
+
+
+class ServiceDiscovery(ABC):
+    """Source of truth for which engine endpoints exist right now."""
+
+    app = None  # set by factory; used for prefill/decode client sessions
+
+    @abstractmethod
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        ...
+
+    def get_health(self) -> bool:
+        return True
+
+    async def start(self) -> None:
+        """Begin background watch/health tasks (called from app startup)."""
+
+    def close(self) -> None:
+        """Stop background tasks."""
+
+    def get_model_labels(self) -> List[str]:
+        return sorted({e.model_label for e in self.get_endpoint_info() if e.model_label})
+
+    async def initialize_client_sessions(
+        self,
+        prefill_model_labels: Optional[List[str]],
+        decode_model_labels: Optional[List[str]],
+    ) -> None:
+        """Open long-lived sessions to the prefill/decode endpoints (disagg P/D)."""
+        if not prefill_model_labels or not decode_model_labels or self.app is None:
+            return
+        for info in self.get_endpoint_info():
+            if info.model_label in prefill_model_labels:
+                self.app["prefill_client"] = aiohttp.ClientSession(
+                    base_url=info.url, timeout=aiohttp.ClientTimeout(total=None)
+                )
+            elif info.model_label in decode_model_labels:
+                self.app["decode_client"] = aiohttp.ClientSession(
+                    base_url=info.url, timeout=aiohttp.ClientTimeout(total=None)
+                )
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed backend list given on the CLI, with optional active health checks.
+
+    Parity: reference ``service_discovery.py:206-341``. Health checking is
+    an asyncio task issuing real test payloads per model type
+    (cf. reference ``utils.py:162-174``).
+    """
+
+    def __init__(
+        self,
+        app=None,
+        urls: Optional[List[str]] = None,
+        models: Optional[List[str]] = None,
+        aliases: Optional[Dict[str, str]] = None,
+        model_labels: Optional[List[str]] = None,
+        model_types: Optional[List[str]] = None,
+        static_backend_health_checks: bool = False,
+        prefill_model_labels: Optional[List[str]] = None,
+        decode_model_labels: Optional[List[str]] = None,
+        health_check_interval: float = 60.0,
+    ):
+        urls = urls or []
+        models = models or []
+        if len(urls) != len(models):
+            raise ValueError("static urls and models must have the same length")
+        self.app = app
+        self.urls = urls
+        self.models = models
+        self.aliases = aliases or {}
+        self.model_labels = model_labels
+        self.model_types = model_types
+        self.engine_ids = [str(uuid.uuid4()) for _ in urls]
+        self.added_timestamp = time.time()
+        self.enable_health_checks = static_backend_health_checks
+        self.health_check_interval = health_check_interval
+        self.prefill_model_labels = prefill_model_labels
+        self.decode_model_labels = decode_model_labels
+        self._unhealthy: set = set()
+        self._task: Optional[asyncio.Task] = None
+
+    @staticmethod
+    def _endpoint_hash(url: str, model: str) -> str:
+        return hashlib.md5(f"{url}{model}".encode()).hexdigest()
+
+    async def _probe(self, session: aiohttp.ClientSession, url: str, model: str, model_type: str) -> bool:
+        try:
+            mt = ModelType[model_type]
+            payload = dict(ModelType.get_test_payload(model_type))
+            payload["model"] = model
+            async with session.post(
+                url + mt.value, json=payload, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                return resp.status == 200
+        except Exception as e:  # noqa: BLE001 — any failure means unhealthy
+            logger.debug("health probe failed for %s (%s): %s", url, model, e)
+            return False
+
+    async def _health_loop(self) -> None:
+        if not self.model_types or len(self.model_types) != len(self.urls):
+            logger.error(
+                "static health checks need one --static-model-types entry per "
+                "backend; skipping health checking"
+            )
+            return
+        async with aiohttp.ClientSession() as session:
+            while True:
+                unhealthy = set()
+                for url, model, mtype in zip(self.urls, self.models, self.model_types):
+                    ok = await self._probe(session, url, model, mtype)
+                    if not ok:
+                        logger.warning("%s at %s failed health check", model, url)
+                        unhealthy.add(self._endpoint_hash(url, model))
+                self._unhealthy = unhealthy
+                await asyncio.sleep(self.health_check_interval)
+
+    async def start(self) -> None:
+        if self.enable_health_checks and self._task is None:
+            self._task = asyncio.create_task(self._health_loop())
+        await self.initialize_client_sessions(
+            self.prefill_model_labels, self.decode_model_labels
+        )
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        infos = []
+        for i, (url, model) in enumerate(zip(self.urls, self.models)):
+            if self._endpoint_hash(url, model) in self._unhealthy:
+                continue
+            label = self.model_labels[i] if self.model_labels else "default"
+            infos.append(
+                EndpointInfo(
+                    url=url,
+                    model_names=[model],
+                    Id=self.engine_ids[i],
+                    added_timestamp=self.added_timestamp,
+                    model_label=label,
+                    sleep=False,
+                    model_info={model: ModelInfo(id=model)},
+                )
+            )
+        return infos
+
+
+class _K8sWatcherBase(ServiceDiscovery):
+    """Shared machinery for the two Kubernetes discovery modes."""
+
+    def __init__(
+        self,
+        app=None,
+        namespace: str = "default",
+        port: int = 8000,
+        label_selector: Optional[str] = None,
+        prefill_model_labels: Optional[List[str]] = None,
+        decode_model_labels: Optional[List[str]] = None,
+    ):
+        from .k8s_client import K8sClient  # local import: optional subsystem
+
+        self.app = app
+        self.namespace = namespace
+        self.port = port
+        self.label_selector = label_selector
+        self.prefill_model_labels = prefill_model_labels
+        self.decode_model_labels = decode_model_labels
+        self.k8s = K8sClient()
+        self.available_engines: Dict[str, EndpointInfo] = {}
+        self._lock = asyncio.Lock()
+        self._task: Optional[asyncio.Task] = None
+        self._healthy = True
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        return list(self.available_engines.values())
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._watch_loop())
+        await self.initialize_client_sessions(
+            self.prefill_model_labels, self.decode_model_labels
+        )
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _fetch_models(self, base_url: str) -> Dict[str, ModelInfo]:
+        """Ask an engine which models (incl. LoRA adapters) it serves."""
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{base_url}/v1/models", timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                resp.raise_for_status()
+                data = await resp.json()
+        return {m["id"]: ModelInfo.from_dict(m) for m in data.get("data", [])}
+
+    async def _fetch_sleep_status(self, base_url: str) -> bool:
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"{base_url}/is_sleeping", timeout=aiohttp.ClientTimeout(total=5)
+                ) as resp:
+                    if resp.status == 200:
+                        return bool((await resp.json()).get("is_sleeping", False))
+        except Exception:  # noqa: BLE001
+            pass
+        return False
+
+    async def _watch_loop(self) -> None:
+        raise NotImplementedError
+
+
+class K8sPodIPServiceDiscovery(_K8sWatcherBase):
+    """Watch engine pods and address them by pod IP.
+
+    Parity: reference ``service_discovery.py:344-746`` (_watch_engines
+    :571-617, _on_engine_update :657-696). Pods are eligible once Ready;
+    terminating/not-ready pods are removed; each added pod is queried for
+    its model list and sleep state.
+    """
+
+    async def _watch_loop(self) -> None:
+        while True:
+            try:
+                async for event in self.k8s.watch_pods(
+                    self.namespace, self.label_selector
+                ):
+                    await self._on_pod_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep watching
+                logger.error("pod watch error (retrying in 0.5s): %s", e)
+                await asyncio.sleep(0.5)
+
+    @staticmethod
+    def _pod_ready(pod: dict) -> bool:
+        status = pod.get("status", {})
+        if status.get("phase") != "Running":
+            return False
+        for cond in status.get("conditions", []) or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    async def _on_pod_event(self, event: dict) -> None:
+        etype = event.get("type")
+        pod = event.get("object", {})
+        meta = pod.get("metadata", {})
+        name = meta.get("name", "")
+        ip = pod.get("status", {}).get("podIP")
+        deleting = meta.get("deletionTimestamp") is not None
+        if etype == "DELETED" or deleting or not self._pod_ready(pod) or not ip:
+            async with self._lock:
+                if self.available_engines.pop(name, None) is not None:
+                    logger.info("engine %s removed from pool", name)
+            return
+        url = f"http://{ip}:{self.port}"
+        try:
+            model_info = await self._fetch_models(url)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("engine %s not serving /v1/models yet: %s", name, e)
+            return
+        sleep = await self._fetch_sleep_status(url)
+        labels = meta.get("labels", {}) or {}
+        info = EndpointInfo(
+            url=url,
+            model_names=list(model_info),
+            Id=meta.get("uid", name),
+            added_timestamp=time.time(),
+            model_label=labels.get("model", labels.get("app", "default")),
+            sleep=sleep,
+            pod_name=name,
+            namespace=self.namespace,
+            model_info=model_info,
+        )
+        async with self._lock:
+            self.available_engines[name] = info
+        logger.info("engine %s added: %s models=%s", name, url, info.model_names)
+
+
+class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
+    """Watch Services and address engines by cluster-DNS service name.
+
+    Parity: reference ``service_discovery.py:749-1150``.
+    """
+
+    async def _watch_loop(self) -> None:
+        while True:
+            try:
+                async for event in self.k8s.watch_services(
+                    self.namespace, self.label_selector
+                ):
+                    await self._on_service_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                logger.error("service watch error (retrying in 0.5s): %s", e)
+                await asyncio.sleep(0.5)
+
+    async def _on_service_event(self, event: dict) -> None:
+        etype = event.get("type")
+        svc = event.get("object", {})
+        meta = svc.get("metadata", {})
+        name = meta.get("name", "")
+        if etype == "DELETED":
+            async with self._lock:
+                self.available_engines.pop(name, None)
+            return
+        url = f"http://{name}.{self.namespace}.svc.cluster.local:{self.port}"
+        try:
+            model_info = await self._fetch_models(url)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("service %s not ready: %s", name, e)
+            return
+        sleep = await self._fetch_sleep_status(url)
+        labels = meta.get("labels", {}) or {}
+        info = EndpointInfo(
+            url=url,
+            model_names=list(model_info),
+            Id=meta.get("uid", name),
+            added_timestamp=time.time(),
+            model_label=labels.get("model", labels.get("app", "default")),
+            sleep=sleep,
+            service_name=name,
+            namespace=self.namespace,
+            model_info=model_info,
+        )
+        async with self._lock:
+            self.available_engines[name] = info
+
+
+_global_service_discovery: Optional[ServiceDiscovery] = None
+
+
+def _create(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
+    if sd_type == ServiceDiscoveryType.STATIC:
+        return StaticServiceDiscovery(*args, **kwargs)
+    if sd_type == ServiceDiscoveryType.K8S:
+        mode = (kwargs.pop("k8s_service_discovery_type", None) or "pod-ip").strip().lower()
+        if mode == "service-name":
+            return K8sServiceNameServiceDiscovery(*args, **kwargs)
+        return K8sPodIPServiceDiscovery(*args, **kwargs)
+    raise ValueError(f"invalid service discovery type {sd_type}")
+
+
+def initialize_service_discovery(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
+    global _global_service_discovery
+    if _global_service_discovery is not None:
+        raise ValueError("service discovery already initialized")
+    _global_service_discovery = _create(sd_type, *args, **kwargs)
+    return _global_service_discovery
+
+
+def reconfigure_service_discovery(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
+    global _global_service_discovery
+    if _global_service_discovery is None:
+        raise ValueError("service discovery not initialized")
+    new = _create(sd_type, *args, **kwargs)
+    _global_service_discovery.close()
+    _global_service_discovery = new
+    return new
+
+
+def get_service_discovery() -> ServiceDiscovery:
+    if _global_service_discovery is None:
+        raise ValueError("service discovery not initialized")
+    return _global_service_discovery
+
+
+def teardown_service_discovery() -> None:
+    global _global_service_discovery
+    if _global_service_discovery is not None:
+        _global_service_discovery.close()
+    _global_service_discovery = None

@@ -1,0 +1,66 @@
+"""User-supplied request lifecycle hooks loaded from a Python file/module.
+
+Capability parity with the reference's callbacks service
+(``services/callbacks_service/callbacks.py:23-31``,
+``custom_callbacks.py:20-55``): a module exposing ``pre_request`` (may
+short-circuit with a response) and ``post_request`` (fire-and-forget).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import sys
+from typing import Any, Optional
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class CustomCallbackHandler:
+    def __init__(self, module: Any):
+        self.module = module
+        self.pre_request = getattr(module, "pre_request", None)
+        self.post_request = getattr(module, "post_request", None)
+
+    async def call_pre_request(self, request, request_body: bytes, request_json: dict):
+        """Returns a response-like object to short-circuit, or None."""
+        if self.pre_request is None:
+            return None
+        result = self.pre_request(request, request_body, request_json)
+        if hasattr(result, "__await__"):
+            result = await result
+        return result
+
+    async def call_post_request(self, request, response_content: bytes):
+        if self.post_request is None:
+            return
+        result = self.post_request(request, response_content)
+        if hasattr(result, "__await__"):
+            await result
+
+
+_handler: Optional[CustomCallbackHandler] = None
+
+
+def configure_custom_callbacks(spec: Optional[str]) -> Optional[CustomCallbackHandler]:
+    """Load callbacks from ``path/to/file.py`` or ``dotted.module.name``."""
+    global _handler
+    if not spec:
+        _handler = None
+        return None
+    if spec.endswith(".py"):
+        modspec = importlib.util.spec_from_file_location("pst_custom_callbacks", spec)
+        module = importlib.util.module_from_spec(modspec)
+        sys.modules["pst_custom_callbacks"] = module
+        modspec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(spec)
+    _handler = CustomCallbackHandler(module)
+    logger.info("loaded custom callbacks from %s", spec)
+    return _handler
+
+
+def get_custom_callback_handler() -> Optional[CustomCallbackHandler]:
+    return _handler

@@ -1,0 +1,53 @@
+"""Router-side Prometheus gauges, labeled per engine ``server``.
+
+Capability parity with the reference's
+``src/vllm_router/services/metrics_service/__init__.py:1-47``. Gauge names
+keep the ``vllm:`` prefix so the reference Grafana dashboards
+(observability/) work against this stack unchanged.
+"""
+
+from prometheus_client import Gauge
+
+num_requests_running = Gauge(
+    "vllm:num_requests_running", "Number of running requests", ["server"]
+)
+num_requests_waiting = Gauge(
+    "vllm:num_requests_waiting", "Number of waiting requests", ["server"]
+)
+gpu_prefix_cache_hit_rate = Gauge(
+    "vllm:gpu_prefix_cache_hit_rate", "KV prefix cache hit rate", ["server"]
+)
+gpu_prefix_cache_hits_total = Gauge(
+    "vllm:gpu_prefix_cache_hits_total", "Total KV prefix cache hits", ["server"]
+)
+gpu_prefix_cache_queries_total = Gauge(
+    "vllm:gpu_prefix_cache_queries_total", "Total KV prefix cache queries", ["server"]
+)
+gpu_cache_usage_perc = Gauge(
+    "vllm:gpu_cache_usage_perc", "HBM KV cache usage fraction", ["server"]
+)
+current_qps = Gauge("vllm:current_qps", "Current queries per second", ["server"])
+avg_decoding_length = Gauge(
+    "vllm:avg_decoding_length", "Average decoding length (s)", ["server"]
+)
+num_prefill_requests = Gauge(
+    "vllm:num_prefill_requests", "Requests in prefill", ["server"]
+)
+num_decoding_requests = Gauge(
+    "vllm:num_decoding_requests", "Requests in decode", ["server"]
+)
+healthy_pods_total = Gauge(
+    "vllm:healthy_pods_total", "Number of healthy engine pods", ["server"]
+)
+avg_latency = Gauge(
+    "vllm:avg_latency", "Average end-to-end request latency (s)", ["server"]
+)
+avg_itl = Gauge("vllm:avg_itl", "Average inter-token latency (s)", ["server"])
+num_requests_swapped = Gauge(
+    "vllm:num_requests_swapped", "Number of swapped requests", ["server"]
+)
+
+# Router-process resource usage (Grafana "router CPU/mem/disk" panels).
+router_cpu_percent = Gauge("pst_router:cpu_percent", "Router process CPU percent")
+router_memory_mb = Gauge("pst_router:memory_mb", "Router process RSS (MB)")
+router_disk_percent = Gauge("pst_router:disk_percent", "Router disk usage percent")

@@ -1,0 +1,297 @@
+"""The proxy hot path: parse → route → stream, plus disagg P/D and sleep/wake.
+
+Capability parity with the reference's
+``src/vllm_router/services/request_service/request.py``
+(route_general_request :139-301, process_request :54-136,
+send_request_to_prefiller :304-322, send_request_to_decode :325-339,
+route_disaggregated_prefill_request :342-434, route_sleep_wakeup_request
+:437-513). aiohttp.web-native redesign: responses are
+``web.StreamResponse`` generators; the shared upstream ClientSession
+lives on the app.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ...logging_utils import init_logger
+from ..routing.logic import (
+    DisaggregatedPrefillRouter,
+    get_routing_logic,
+)
+from ..service_discovery import get_service_discovery
+from ..stats.engine_stats import get_engine_stats_scraper
+from ..stats.request_stats import get_request_stats_monitor
+from .callbacks import get_custom_callback_handler
+from .rewriter import get_request_rewriter
+
+logger = init_logger(__name__)
+
+# Hop-by-hop headers that must not be forwarded either direction.
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host", "content-length",
+}
+
+
+def _forwardable(headers) -> dict:
+    return {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
+
+
+def _error_response(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": etype, "code": status}}, status=status
+    )
+
+
+async def proxy_and_stream(
+    request: web.Request,
+    backend_url: str,
+    endpoint: str,
+    body: bytes,
+    request_id: str,
+    debug_headers: Optional[dict] = None,
+) -> web.StreamResponse:
+    """Forward the request to ``backend_url``/``endpoint`` and stream back.
+
+    The first upstream chunk marks TTFT (on_request_response); completion
+    marks on_request_complete. Response content is accumulated only when a
+    post-request hook (callbacks / semantic cache) needs it.
+    """
+    monitor = get_request_stats_monitor()
+    callback = get_custom_callback_handler()
+    session: aiohttp.ClientSession = request.app["client_session"]
+    monitor.on_new_request(backend_url, request_id, time.time())
+
+    collect = callback is not None and callback.post_request is not None
+    semantic_store = request.app.get("semantic_cache_store")
+    collect = collect or semantic_store is not None
+    collected = bytearray()
+
+    try:
+        async with session.request(
+            request.method,
+            backend_url + endpoint,
+            data=body,
+            headers=_forwardable(request.headers),
+        ) as upstream:
+            response = web.StreamResponse(status=upstream.status)
+            for k, v in upstream.headers.items():
+                if k.lower() not in _HOP_HEADERS:
+                    response.headers[k] = v
+            response.headers["X-Request-Id"] = request_id
+            if debug_headers:
+                for k, v in debug_headers.items():
+                    response.headers[k] = v
+            await response.prepare(request)
+            async for chunk in upstream.content.iter_any():
+                # First call records TTFT; subsequent calls record ITL.
+                monitor.on_request_response(backend_url, request_id, time.time())
+                if collect:
+                    collected.extend(chunk)
+                await response.write(chunk)
+            monitor.on_request_complete(backend_url, request_id, time.time())
+            await response.write_eof()
+    except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
+        monitor.on_request_complete(backend_url, request_id, time.time())
+        logger.error("backend %s failed for %s: %s", backend_url, request_id, e)
+        return _error_response(502, f"backend error: {e}", "bad_gateway")
+
+    if collect:
+        content = bytes(collected)
+        if semantic_store is not None:
+            try:
+                await semantic_store(request, content)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("semantic cache store failed: %s", e)
+        if callback is not None:
+            try:
+                await callback.call_post_request(request, content)
+            except Exception as e:  # noqa: BLE001
+                logger.error("post_request callback failed: %s", e)
+    return response
+
+
+async def route_general_request(request: web.Request, endpoint: str) -> web.StreamResponse:
+    """Route an OpenAI-API request to an engine and stream the response."""
+    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    body = await request.read()
+    try:
+        request_json = json.loads(body) if body else {}
+    except json.JSONDecodeError:
+        return _error_response(400, "invalid JSON in request body")
+
+    callback = get_custom_callback_handler()
+    if callback is not None:
+        short = await callback.call_pre_request(request, body, request_json)
+        if short is not None:
+            return short
+
+    # PII gate (experimental, feature-gated).
+    pii_check = request.app.get("pii_check")
+    if pii_check is not None:
+        blocked = await pii_check(request_json)
+        if blocked is not None:
+            return blocked
+
+    discovery = get_service_discovery()
+    endpoints = discovery.get_endpoint_info()
+
+    requested_model = request_json.get("model", "")
+    aliases = getattr(discovery, "aliases", None) or {}
+    if requested_model in aliases:
+        requested_model = aliases[requested_model]
+        request_json["model"] = requested_model
+        body = json.dumps(request_json).encode()
+
+    # Rewriter hook (after alias resolution, before routing).
+    rewriter = get_request_rewriter()
+    rewritten = rewriter.rewrite_request(body.decode(), requested_model, endpoint)
+    if rewritten != body.decode():
+        body = rewritten.encode()
+        request_json = json.loads(rewritten)
+
+    router = get_routing_logic()
+    is_disagg = isinstance(router, DisaggregatedPrefillRouter)
+
+    # Debug escape hatch: pin a specific engine by id with ?id=...
+    pinned_id = request.query.get("id")
+    if pinned_id:
+        candidates = [e for e in endpoints if e.Id == pinned_id]
+    elif is_disagg:
+        # P/D pools serve under distinct labels; model filter happens per-pool.
+        candidates = [e for e in endpoints if not e.sleep]
+    else:
+        candidates = [
+            e for e in endpoints if (e.has_model(requested_model) and not e.sleep)
+        ]
+    if not candidates:
+        return _error_response(
+            404,
+            f"model {requested_model!r} not found on any live engine",
+            "not_found_error",
+        )
+
+    if is_disagg:
+        return await route_disaggregated_prefill_request(
+            request, endpoint, request_json, candidates, request_id
+        )
+
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    try:
+        backend_url = await router.route_request(
+            candidates, engine_stats, request_stats, dict(request.headers), request_json
+        )
+    except ValueError as e:
+        return _error_response(503, f"no backend available: {e}", "service_unavailable")
+    logger.debug("routing %s for model %s to %s", request_id, requested_model, backend_url)
+    return await proxy_and_stream(request, backend_url, endpoint, body, request_id)
+
+
+async def route_disaggregated_prefill_request(
+    request: web.Request,
+    endpoint: str,
+    request_json: dict,
+    endpoints: list,
+    request_id: str,
+) -> web.StreamResponse:
+    """Two-phase flow: prefill with max_tokens=1 (KV produced and shipped),
+    then decode streams from the decode pool with the KV pulled in.
+    """
+    router = get_routing_logic()
+    monitor = get_request_stats_monitor()
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    headers = dict(request.headers)
+
+    original_max_tokens = request_json.get("max_tokens")
+    original_stream = request_json.get("stream", False)
+    prefill_json = dict(request_json)
+    prefill_json["max_tokens"] = 1
+    prefill_json["stream"] = False
+    # Ask the engine to retain/publish KV for this request id so the decode
+    # engine can fetch it (kv_transfer_params mirrors the reference's
+    # connector config surface, deployment-vllm-multi.yaml:180-189).
+    prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
+
+    try:
+        prefill_url = await router.route_request(
+            endpoints, engine_stats, request_stats, headers, prefill_json
+        )
+    except ValueError as e:
+        return _error_response(503, f"no prefill backend: {e}", "service_unavailable")
+
+    session: aiohttp.ClientSession = request.app["client_session"]
+    t_prefill_start = time.time()
+    monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
+    try:
+        async with session.post(
+            prefill_url + endpoint, json=prefill_json, headers=_forwardable(headers)
+        ) as resp:
+            resp.raise_for_status()
+            await resp.json()
+    except (aiohttp.ClientError, OSError) as e:
+        monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
+        return _error_response(502, f"prefill failed: {e}", "bad_gateway")
+    monitor.on_request_response(prefill_url, f"{request_id}-prefill", time.time())
+    monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
+    logger.debug(
+        "disagg prefill for %s done in %.3fs", request_id, time.time() - t_prefill_start
+    )
+
+    decode_json = dict(request_json)
+    if original_max_tokens is not None:
+        decode_json["max_tokens"] = original_max_tokens
+    decode_json["stream"] = original_stream
+    decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
+    decode_json["kv_transfer_params"]["prefill_url"] = prefill_url
+    try:
+        decode_url = await router.route_request(
+            endpoints, engine_stats, request_stats, headers, decode_json
+        )
+    except ValueError as e:
+        return _error_response(503, f"no decode backend: {e}", "service_unavailable")
+    return await proxy_and_stream(
+        request,
+        decode_url,
+        endpoint,
+        json.dumps(decode_json).encode(),
+        request_id,
+        debug_headers={"X-Prefill-Url": prefill_url, "X-Decode-Url": decode_url},
+    )
+
+
+async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.Response:
+    """Admin proxy for /sleep, /wake_up, /is_sleeping across engines.
+
+    Targets engines by ``model`` query-param label (or all engines when
+    omitted), mirroring reference ``request.py:437-513``.
+    """
+    discovery = get_service_discovery()
+    endpoints = discovery.get_endpoint_info()
+    label = request.query.get("model")
+    targets = [e for e in endpoints if label is None or e.model_label == label or label in e.model_names]
+    if not targets:
+        return _error_response(404, f"no engines matching {label!r}", "not_found_error")
+    session: aiohttp.ClientSession = request.app["client_session"]
+    results = {}
+    for ep in targets:
+        try:
+            if action == "is_sleeping":
+                async with session.get(f"{ep.url}/is_sleeping") as resp:
+                    results[ep.url] = await resp.json()
+            else:
+                level = request.query.get("level")
+                params = {"level": level} if level else None
+                async with session.post(f"{ep.url}/{action}", params=params) as resp:
+                    results[ep.url] = {"status": resp.status}
+        except (aiohttp.ClientError, OSError) as e:
+            results[ep.url] = {"error": str(e)}
+    return web.json_response(results)

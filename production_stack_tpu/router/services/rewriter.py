@@ -1,0 +1,45 @@
+"""Pluggable request-body rewriting hook.
+
+Capability parity with the reference's
+``src/vllm_router/services/request_service/rewriter.py:30-119`` (ABC +
+noop implementation + factory).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class RequestRewriter(ABC):
+    @abstractmethod
+    def rewrite_request(self, request_body: str, model_name: str, endpoint: str) -> str:
+        """Return the (possibly modified) request body."""
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite_request(self, request_body: str, model_name: str, endpoint: str) -> str:
+        return request_body
+
+
+_rewriter: Optional[RequestRewriter] = None
+
+
+def initialize_request_rewriter(rewriter_type: Optional[str] = None) -> RequestRewriter:
+    global _rewriter
+    if rewriter_type in (None, "", "noop"):
+        _rewriter = NoopRequestRewriter()
+    else:
+        raise ValueError(f"unknown request rewriter type {rewriter_type!r}")
+    return _rewriter
+
+
+def get_request_rewriter() -> RequestRewriter:
+    global _rewriter
+    if _rewriter is None:
+        _rewriter = NoopRequestRewriter()
+    return _rewriter

@@ -1,0 +1,121 @@
+"""Scrape engine /metrics endpoints and keep a live per-engine snapshot.
+
+Capability parity with the reference's ``src/vllm_router/stats/engine_stats.py``
+(EngineStats.from_vllm_scrape :42-85, EngineStatsScraper :88-209). The
+scraper is an asyncio task (not a daemon thread) and parses the same
+``vllm:``-prefixed gauge names our TPU engine exports, so reference
+dashboards keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import aiohttp
+from prometheus_client.parser import text_string_to_metric_families
+
+from ...logging_utils import init_logger
+from ...utils import SingletonMeta
+from ..service_discovery import get_service_discovery
+
+logger = init_logger(__name__)
+
+_METRIC_FIELDS = {
+    "vllm:num_requests_running": "num_running_requests",
+    "vllm:num_requests_waiting": "num_queuing_requests",
+    "vllm:gpu_prefix_cache_hit_rate": "gpu_prefix_cache_hit_rate",
+    "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
+    "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
+    "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+}
+
+
+@dataclass
+class EngineStats:
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    gpu_prefix_cache_hits_total: int = 0
+    gpu_prefix_cache_queries_total: int = 0
+    gpu_cache_usage_perc: float = 0.0
+
+    @staticmethod
+    def from_scrape(text: str) -> "EngineStats":
+        values: Dict[str, float] = {}
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                field = _METRIC_FIELDS.get(sample.name)
+                if field is not None:
+                    values[field] = sample.value
+        stats = EngineStats()
+        for field, value in values.items():
+            if field.startswith("num_") or field.endswith("_total"):
+                setattr(stats, field, int(value))
+            else:
+                setattr(stats, field, float(value))
+        return stats
+
+    # Back-compat alias with the reference's classmethod name.
+    from_vllm_scrape = from_scrape
+
+
+class EngineStatsScraper(metaclass=SingletonMeta):
+    def __init__(self, scrape_interval: Optional[float] = None):
+        if getattr(self, "_initialized", False):
+            return
+        if scrape_interval is None:
+            raise ValueError("EngineStatsScraper needs a scrape_interval")
+        self.scrape_interval = scrape_interval
+        self.engine_stats: Dict[str, EngineStats] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._initialized = True
+
+    async def _scrape_one(self, session: aiohttp.ClientSession, url: str) -> None:
+        try:
+            async with session.get(
+                f"{url}/metrics", timeout=aiohttp.ClientTimeout(total=self.scrape_interval)
+            ) as resp:
+                resp.raise_for_status()
+                text = await resp.text()
+            self.engine_stats[url] = EngineStats.from_scrape(text)
+        except Exception as e:  # noqa: BLE001 — engine may be booting
+            logger.debug("failed scraping %s: %s", url, e)
+
+    async def _loop(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                try:
+                    urls = [e.url for e in get_service_discovery().get_endpoint_info()]
+                    await asyncio.gather(*(self._scrape_one(session, u) for u in urls))
+                    for stale in set(self.engine_stats) - set(urls):
+                        del self.engine_stats[stale]
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    logger.error("engine stats scrape sweep failed: %s", e)
+                await asyncio.sleep(self.scrape_interval)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    def get_engine_stats(self) -> Dict[str, EngineStats]:
+        return dict(self.engine_stats)
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+def initialize_engine_stats_scraper(scrape_interval: float) -> EngineStatsScraper:
+    return EngineStatsScraper(scrape_interval)
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    return EngineStatsScraper()

@@ -1,0 +1,187 @@
+"""Per-engine request-level statistics (QPS, TTFT, latency, state counts).
+
+Capability parity with the reference's ``src/vllm_router/stats/request_stats.py``
+(RequestStats :34-55, MovingAverageMonitor :58-103, RequestStatsMonitor
+:106-306): requests move prefill → decode → finished, with sliding-window
+averages per engine.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+from ...utils import SingletonMeta
+
+
+@dataclass
+class RequestStats:
+    qps: float = 0.0
+    ttft: float = -1.0
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    finished_requests: int = 0
+    uptime: float = 0.0
+    avg_decoding_length: float = -1.0
+    avg_latency: float = -1.0
+    avg_itl: float = -1.0
+    num_swapped_requests: int = 0
+
+
+class MovingAverageMonitor:
+    """Average of timestamped values over a sliding time window."""
+
+    def __init__(self, window: float):
+        self.window = window
+        self._items: Deque[Tuple[float, float]] = deque()
+        self._sum = 0.0
+
+    def update(self, timestamp: float, value: float) -> None:
+        self._items.append((timestamp, value))
+        self._sum += value
+        self._evict(timestamp)
+
+    def update_no_value(self, timestamp: float) -> None:
+        self.update(timestamp, 0.0)
+
+    def _evict(self, now: float) -> None:
+        while self._items and self._items[0][0] < now - self.window:
+            _, v = self._items.popleft()
+            self._sum -= v
+
+    def poll(self, now: Optional[float] = None) -> None:
+        self._evict(now if now is not None else time.time())
+
+    def get_average(self) -> float:
+        if not self._items:
+            return -1.0
+        return self._sum / len(self._items)
+
+    def get_sum(self) -> float:
+        return self._sum
+
+    def get_count(self) -> int:
+        return len(self._items)
+
+
+class RequestStatsMonitor(metaclass=SingletonMeta):
+    """Tracks request lifecycle events reported by the proxy layer."""
+
+    def __init__(self, sliding_window_size: Optional[float] = None):
+        if getattr(self, "_initialized", False):
+            return
+        if sliding_window_size is None:
+            raise ValueError("RequestStatsMonitor needs sliding_window_size")
+        self.window = sliding_window_size
+        self.qps_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.ttft_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.latency_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.decoding_length_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.itl_monitors: Dict[str, MovingAverageMonitor] = {}
+        # (engine_url, request_id) -> timestamps
+        self.request_start: Dict[Tuple[str, str], float] = {}
+        self.first_token_time: Dict[Tuple[str, str], float] = {}
+        self.last_token_time: Dict[Tuple[str, str], float] = {}
+        self.token_counts: Dict[Tuple[str, str], int] = {}
+        self.in_prefill: Dict[str, int] = {}
+        self.in_decoding: Dict[str, int] = {}
+        self.finished: Dict[str, int] = {}
+        self.swapped: Dict[str, int] = {}
+        self.first_query_time: Optional[float] = None
+        self._initialized = True
+
+    def _mon(self, table: Dict[str, MovingAverageMonitor], url: str) -> MovingAverageMonitor:
+        if url not in table:
+            table[url] = MovingAverageMonitor(self.window)
+        return table[url]
+
+    def on_new_request(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        self.request_start[(engine_url, request_id)] = timestamp
+        self.in_prefill[engine_url] = self.in_prefill.get(engine_url, 0) + 1
+        self._mon(self.qps_monitors, engine_url).update_no_value(timestamp)
+        if self.first_query_time is None:
+            self.first_query_time = timestamp
+
+    def on_request_response(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """First streamed token observed → TTFT sample; request enters decode."""
+        key = (engine_url, request_id)
+        start = self.request_start.get(key)
+        if start is None:
+            return
+        if key in self.first_token_time:
+            # Subsequent tokens: inter-token latency sample.
+            prev = self.last_token_time.get(key, timestamp)
+            self._mon(self.itl_monitors, engine_url).update(timestamp, timestamp - prev)
+            self.last_token_time[key] = timestamp
+            self.token_counts[key] = self.token_counts.get(key, 1) + 1
+            return
+        self.first_token_time[key] = timestamp
+        self.last_token_time[key] = timestamp
+        self.token_counts[key] = 1
+        self._mon(self.ttft_monitors, engine_url).update(timestamp, timestamp - start)
+        self.in_prefill[engine_url] = max(0, self.in_prefill.get(engine_url, 1) - 1)
+        self.in_decoding[engine_url] = self.in_decoding.get(engine_url, 0) + 1
+
+    def on_request_complete(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        key = (engine_url, request_id)
+        start = self.request_start.pop(key, None)
+        first = self.first_token_time.pop(key, None)
+        self.last_token_time.pop(key, None)
+        self.token_counts.pop(key, None)
+        if first is not None:
+            self.in_decoding[engine_url] = max(0, self.in_decoding.get(engine_url, 1) - 1)
+            self._mon(self.decoding_length_monitors, engine_url).update(
+                timestamp, timestamp - first
+            )
+        else:
+            self.in_prefill[engine_url] = max(0, self.in_prefill.get(engine_url, 1) - 1)
+        if start is not None:
+            self._mon(self.latency_monitors, engine_url).update(timestamp, timestamp - start)
+        self.finished[engine_url] = self.finished.get(engine_url, 0) + 1
+
+    def on_request_swapped(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        self.swapped[engine_url] = self.swapped.get(engine_url, 0) + 1
+
+    def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
+        now = current_time if current_time is not None else time.time()
+        urls = (
+            set(self.qps_monitors)
+            | set(self.in_prefill)
+            | set(self.in_decoding)
+            | set(self.finished)
+        )
+        out: Dict[str, RequestStats] = {}
+        uptime = now - self.first_query_time if self.first_query_time else 0.0
+        for url in urls:
+            qps_mon = self.qps_monitors.get(url)
+            qps = 0.0
+            if qps_mon is not None:
+                qps_mon.poll(now)
+                qps = qps_mon.get_count() / self.window
+            def avg(table: Dict[str, MovingAverageMonitor]) -> float:
+                mon = table.get(url)
+                return mon.get_average() if mon is not None else -1.0
+
+            out[url] = RequestStats(
+                qps=qps,
+                ttft=avg(self.ttft_monitors),
+                in_prefill_requests=self.in_prefill.get(url, 0),
+                in_decoding_requests=self.in_decoding.get(url, 0),
+                finished_requests=self.finished.get(url, 0),
+                uptime=uptime,
+                avg_decoding_length=avg(self.decoding_length_monitors),
+                avg_latency=avg(self.latency_monitors),
+                avg_itl=avg(self.itl_monitors),
+                num_swapped_requests=self.swapped.get(url, 0),
+            )
+        return out
+
+
+def initialize_request_stats_monitor(sliding_window_size: float) -> RequestStatsMonitor:
+    return RequestStatsMonitor(sliding_window_size)
+
+
+def get_request_stats_monitor() -> RequestStatsMonitor:
+    return RequestStatsMonitor()

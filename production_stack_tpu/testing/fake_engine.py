@@ -1,0 +1,253 @@
+"""Fake serving engine: the backend for router tests without TPUs.
+
+Capability parity with the reference's
+``src/tests/perftest/fake-openai-server.py`` (streams tokens at a
+configurable rate, tracks running-request count) extended to the full
+surface the router depends on (SURVEY.md §4 "pattern to replicate"):
+``/v1/models``, ``/v1/chat/completions``, ``/v1/completions`` (streaming
+and non-streaming), ``/metrics`` with ``vllm:``-style gauges,
+``/is_sleeping`` + ``/sleep`` + ``/wake_up``, ``/health``, LoRA
+load/unload endpoints, and ``/tokenize``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import List, Optional
+
+from aiohttp import web
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class FakeEngineState:
+    def __init__(self, model: str, speed: float, max_tokens_default: int = 32):
+        self.model = model
+        self.speed = speed  # tokens per second
+        self.max_tokens_default = max_tokens_default
+        self.num_running = 0
+        self.num_waiting = 0
+        self.prefix_hits = 0
+        self.prefix_queries = 0
+        self.sleeping = False
+        self.lora_adapters: List[str] = []
+        self.requests_seen: List[dict] = []
+
+
+def _models_payload(state: FakeEngineState) -> dict:
+    data = [
+        {
+            "id": state.model,
+            "object": "model",
+            "created": int(time.time()),
+            "owned_by": "fake",
+            "parent": None,
+            "root": None,
+        }
+    ]
+    for adapter in state.lora_adapters:
+        data.append(
+            {
+                "id": adapter,
+                "object": "model",
+                "created": int(time.time()),
+                "owned_by": "fake",
+                "parent": state.model,
+                "root": None,
+            }
+        )
+    return {"object": "list", "data": data}
+
+
+def create_fake_engine_app(
+    model: str = "fake/model",
+    speed: float = 500.0,
+    ttft: float = 0.0,
+) -> web.Application:
+    state = FakeEngineState(model, speed)
+    app = web.Application()
+    app["state"] = state
+
+    async def list_models(request: web.Request) -> web.Response:
+        return web.json_response(_models_payload(state))
+
+    async def _generate(request: web.Request, is_chat: bool) -> web.StreamResponse:
+        body = await request.json()
+        state.requests_seen.append(body)
+        n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
+        stream = bool(body.get("stream", False))
+        state.num_running += 1
+        state.prefix_queries += 1
+        req_id = f"fake-{uuid.uuid4().hex[:12]}"
+        token_interval = 1.0 / state.speed if state.speed > 0 else 0.0
+        try:
+            if ttft:
+                await asyncio.sleep(ttft)
+            if stream:
+                resp = web.StreamResponse(status=200)
+                resp.headers["Content-Type"] = "text/event-stream"
+                await resp.prepare(request)
+                for i in range(n_tokens):
+                    if is_chat:
+                        chunk = {
+                            "id": req_id,
+                            "object": "chat.completion.chunk",
+                            "model": state.model,
+                            "choices": [
+                                {
+                                    "index": 0,
+                                    "delta": {"content": f"tok{i} "},
+                                    "finish_reason": None,
+                                }
+                            ],
+                        }
+                    else:
+                        chunk = {
+                            "id": req_id,
+                            "object": "text_completion",
+                            "model": state.model,
+                            "choices": [
+                                {"index": 0, "text": f"tok{i} ", "finish_reason": None}
+                            ],
+                        }
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    if token_interval:
+                        await asyncio.sleep(token_interval)
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            else:
+                if token_interval:
+                    await asyncio.sleep(token_interval * n_tokens)
+                text = " ".join(f"tok{i}" for i in range(n_tokens))
+                if is_chat:
+                    payload = {
+                        "id": req_id,
+                        "object": "chat.completion",
+                        "model": state.model,
+                        "choices": [
+                            {
+                                "index": 0,
+                                "message": {"role": "assistant", "content": text},
+                                "finish_reason": "length",
+                            }
+                        ],
+                        "usage": {
+                            "prompt_tokens": 10,
+                            "completion_tokens": n_tokens,
+                            "total_tokens": 10 + n_tokens,
+                        },
+                    }
+                else:
+                    payload = {
+                        "id": req_id,
+                        "object": "text_completion",
+                        "model": state.model,
+                        "choices": [
+                            {"index": 0, "text": text, "finish_reason": "length"}
+                        ],
+                        "usage": {
+                            "prompt_tokens": 10,
+                            "completion_tokens": n_tokens,
+                            "total_tokens": 10 + n_tokens,
+                        },
+                    }
+                return web.json_response(payload)
+        finally:
+            state.num_running -= 1
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        return await _generate(request, is_chat=True)
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        return await _generate(request, is_chat=False)
+
+    async def metrics(request: web.Request) -> web.Response:
+        hit_rate = state.prefix_hits / state.prefix_queries if state.prefix_queries else 0.0
+        text = "\n".join(
+            [
+                "# TYPE vllm:num_requests_running gauge",
+                f"vllm:num_requests_running {state.num_running}",
+                "# TYPE vllm:num_requests_waiting gauge",
+                f"vllm:num_requests_waiting {state.num_waiting}",
+                "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
+                f"vllm:gpu_prefix_cache_hit_rate {hit_rate}",
+                "# TYPE vllm:gpu_prefix_cache_hits_total counter",
+                f"vllm:gpu_prefix_cache_hits_total {state.prefix_hits}",
+                "# TYPE vllm:gpu_prefix_cache_queries_total counter",
+                f"vllm:gpu_prefix_cache_queries_total {state.prefix_queries}",
+                "# TYPE vllm:gpu_cache_usage_perc gauge",
+                f"vllm:gpu_cache_usage_perc {min(1.0, state.num_running * 0.1)}",
+                "",
+            ]
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def is_sleeping(request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": state.sleeping})
+
+    async def sleep(request: web.Request) -> web.Response:
+        state.sleeping = True
+        return web.json_response({"status": "sleeping"})
+
+    async def wake_up(request: web.Request) -> web.Response:
+        state.sleeping = False
+        return web.json_response({"status": "awake"})
+
+    async def load_lora(request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name and name not in state.lora_adapters:
+            state.lora_adapters.append(name)
+        return web.json_response({"status": "ok"})
+
+    async def unload_lora(request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name in state.lora_adapters:
+            state.lora_adapters.remove(name)
+        return web.json_response({"status": "ok"})
+
+    async def tokenize(request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body.get("prompt") or ""
+        tokens = list(text.encode())
+        return web.json_response({"tokens": tokens, "count": len(tokens)})
+
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/health", health)
+    app.router.add_get("/is_sleeping", is_sleeping)
+    app.router.add_post("/sleep", sleep)
+    app.router.add_post("/wake_up", wake_up)
+    app.router.add_post("/v1/load_lora_adapter", load_lora)
+    app.router.add_post("/v1/unload_lora_adapter", unload_lora)
+    app.router.add_post("/tokenize", tokenize)
+    return app
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(description="fake TPU serving engine")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9101)
+    p.add_argument("--model", default="fake/model")
+    p.add_argument("--speed", type=float, default=500.0, help="tokens/sec")
+    p.add_argument("--ttft", type=float, default=0.0, help="artificial TTFT (s)")
+    args = p.parse_args(argv)
+    app = create_fake_engine_app(args.model, args.speed, args.ttft)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,11 +79,17 @@ _HOSTNAME_RE = re.compile(
 
 
 def validate_url(url: str) -> bool:
-    """True iff url looks like http(s)://host[:port][/path]."""
-    m = re.match(r"^(https?)://([^/:]+)(:\d{1,5})?(/.*)?$", url)
+    """True iff url looks like http(s)://host[:port][/path] (IPv6 in brackets)."""
+    m = re.match(r"^(https?)://(\[[0-9a-fA-F:]+\]|[^/:?#]+)(:\d{1,5})?([/?#].*)?$", url)
     if not m:
         return False
     host = m.group(2)
+    if host.startswith("[") and host.endswith("]"):
+        try:
+            ipaddress.IPv6Address(host[1:-1])
+            return True
+        except ValueError:
+            return False
     if m.group(3):
         port = int(m.group(3)[1:])
         if not (0 < port < 65536):

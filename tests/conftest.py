@@ -24,12 +24,6 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
-def pytest_collection_modifyitems(items):
-    for item in items:
-        if inspect.iscoroutinefunction(getattr(item, "function", None)):
-            item.add_marker(pytest.mark.asyncio)
-
-
 @pytest.fixture
 def event_loop():
     loop = asyncio.new_event_loop()
@@ -38,7 +32,11 @@ def event_loop():
 
 
 def pytest_pyfunc_call(pyfuncitem):
-    """Minimal asyncio test support (pytest-asyncio may be absent)."""
+    """Minimal asyncio test support (pytest-asyncio may be absent).
+
+    If the test requested the ``event_loop`` fixture, the coroutine runs on
+    that same loop so callbacks scheduled through the fixture fire correctly.
+    """
     func = pyfuncitem.function
     if inspect.iscoroutinefunction(func):
         sig = inspect.signature(func)
@@ -47,10 +45,14 @@ def pytest_pyfunc_call(pyfuncitem):
             for name in sig.parameters
             if name in pyfuncitem.funcargs
         }
-        loop = asyncio.new_event_loop()
+        loop = pyfuncitem.funcargs.get("event_loop")
+        own_loop = loop is None
+        if own_loop:
+            loop = asyncio.new_event_loop()
         try:
             loop.run_until_complete(func(**kwargs))
         finally:
-            loop.close()
+            if own_loop:
+                loop.close()
         return True
     return None

@@ -1,0 +1,35 @@
+"""Shared helpers for router tests: singleton reset, endpoint builders."""
+
+import time
+import uuid
+
+from production_stack_tpu.router.routing.logic import teardown_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    EndpointInfo,
+    ModelInfo,
+    teardown_service_discovery,
+)
+from production_stack_tpu.router.stats.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+
+
+def reset_router_singletons():
+    teardown_routing_logic()
+    try:
+        teardown_service_discovery()
+    except Exception:
+        pass
+    EngineStatsScraper.destroy()
+    RequestStatsMonitor.destroy()
+
+
+def make_endpoint(url: str, model: str = "m", label: str = "default") -> EndpointInfo:
+    return EndpointInfo(
+        url=url,
+        model_names=[model],
+        Id=str(uuid.uuid4()),
+        added_timestamp=time.time(),
+        model_label=label,
+        sleep=False,
+        model_info={model: ModelInfo(id=model)},
+    )

@@ -1,0 +1,179 @@
+"""Ring-2 e2e: real router app proxying to in-process fake engines.
+
+Mirrors the reference's perftest/e2e strategy (SURVEY.md §4): fake engines
+with the full surface (models/metrics/sleep/streaming), real router app,
+requests driven through the public HTTP interface.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+
+
+class Cluster:
+    """Two fake engines + a router, all on ephemeral localhost ports."""
+
+    def __init__(self, routing_logic="roundrobin", extra_args=None):
+        self.routing_logic = routing_logic
+        self.extra_args = extra_args or []
+        self.runners = []
+        self.engine_urls = []
+        self.router_url = None
+
+    async def __aenter__(self):
+        for name in ("fake/model", "fake/model"):
+            app = create_fake_engine_app(model=name, speed=5000.0)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            self.runners.append(runner)
+            self.engine_urls.append(f"http://127.0.0.1:{port}")
+        argv = [
+            "--service-discovery", "static",
+            "--static-backends", ",".join(self.engine_urls),
+            "--static-models", "fake/model,fake/model",
+            "--routing-logic", self.routing_logic,
+            "--engine-stats-interval", "0.2",
+            *self.extra_args,
+        ]
+        args = parse_args(argv)
+        router_app = create_app(args)
+        runner = web.AppRunner(router_app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.runners.append(runner)
+        self.router_url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def __aexit__(self, *exc):
+        for runner in reversed(self.runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+async def test_models_aggregation_and_health():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{c.router_url}/v1/models") as resp:
+                assert resp.status == 200
+                data = await resp.json()
+                assert {m["id"] for m in data["data"]} == {"fake/model"}
+            async with s.get(f"{c.router_url}/health") as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/version") as resp:
+                assert "version" in await resp.json()
+            async with s.get(f"{c.router_url}/engines") as resp:
+                engines = await resp.json()
+                assert len(engines) == 2
+
+
+async def test_roundrobin_proxy_and_stats():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(4):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": "fake/model", "prompt": "hi", "max_tokens": 4},
+                ) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["choices"][0]["text"].startswith("tok0")
+                    assert "X-Request-Id" in resp.headers
+            # Requests spread evenly over both engines.
+            counts = []
+            for url in c.engine_urls:
+                async with s.get(f"{url}/metrics") as resp:
+                    text = await resp.text()
+                for line in text.splitlines():
+                    if line.startswith("vllm:gpu_prefix_cache_queries_total"):
+                        counts.append(float(line.split()[-1]))
+            assert counts == [2.0, 2.0]
+            # Router /metrics exposes per-server gauges after scrape.
+            await asyncio.sleep(0.5)
+            async with s.get(f"{c.router_url}/metrics") as resp:
+                text = await resp.text()
+                assert "vllm:num_requests_running" in text
+                assert "pst_router:cpu_percent" in text
+
+
+async def test_streaming_chat_through_router():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/chat/completions",
+                json={
+                    "model": "fake/model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 5,
+                    "stream": True,
+                },
+            ) as resp:
+                assert resp.status == 200
+                chunks = []
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                assert len(chunks) == 5
+                assert chunks[0]["choices"][0]["delta"]["content"].startswith("tok0")
+
+
+async def test_unknown_model_404():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "nope", "prompt": "hi"},
+            ) as resp:
+                assert resp.status == 404
+
+
+async def test_sleep_wakeup_admin_flow():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{c.router_url}/sleep") as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/is_sleeping") as resp:
+                data = await resp.json()
+                assert all(v.get("is_sleeping") for v in data.values())
+            async with s.post(f"{c.router_url}/wake_up") as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/is_sleeping") as resp:
+                data = await resp.json()
+                assert not any(v.get("is_sleeping") for v in data.values())
+
+
+async def test_api_key_auth():
+    async with Cluster(extra_args=["--api-key", "sekrit"]) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "hi"},
+            ) as resp:
+                assert resp.status == 401
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "hi", "max_tokens": 2},
+                headers={"Authorization": "Bearer sekrit"},
+            ) as resp:
+                assert resp.status == 200

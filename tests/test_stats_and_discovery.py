@@ -1,0 +1,159 @@
+"""Unit tests: request-stats lifecycle, engine-stats scrape parsing, static
+discovery, hashtrie, parser validation."""
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.routing.hashtrie import HashTrie
+from production_stack_tpu.router.service_discovery import (
+    ServiceDiscoveryType,
+    StaticServiceDiscovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+
+from .router_utils import reset_router_singletons
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+def test_engine_stats_from_scrape():
+    text = "\n".join(
+        [
+            "# TYPE vllm:num_requests_running gauge",
+            "vllm:num_requests_running 3",
+            "# TYPE vllm:num_requests_waiting gauge",
+            "vllm:num_requests_waiting 7",
+            "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
+            "vllm:gpu_prefix_cache_hit_rate 0.61",
+            "# TYPE vllm:gpu_prefix_cache_hits_total counter",
+            "vllm:gpu_prefix_cache_hits_total 100",
+            "# TYPE vllm:gpu_prefix_cache_queries_total counter",
+            "vllm:gpu_prefix_cache_queries_total 164",
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            "vllm:gpu_cache_usage_perc 0.42",
+            "",
+        ]
+    )
+    stats = EngineStats.from_scrape(text)
+    assert stats.num_running_requests == 3
+    assert stats.num_queuing_requests == 7
+    assert abs(stats.gpu_prefix_cache_hit_rate - 0.61) < 1e-9
+    assert stats.gpu_prefix_cache_hits_total == 100
+    assert stats.gpu_prefix_cache_queries_total == 164
+    assert abs(stats.gpu_cache_usage_perc - 0.42) < 1e-9
+
+
+def test_request_stats_lifecycle():
+    mon = RequestStatsMonitor(sliding_window_size=60.0)
+    url = "http://e0"
+    mon.on_new_request(url, "r1", 100.0)
+    stats = mon.get_request_stats(current_time=100.5)
+    assert stats[url].in_prefill_requests == 1
+    mon.on_request_response(url, "r1", 100.25)  # first token → TTFT 0.25
+    mon.on_request_response(url, "r1", 100.35)  # second token → ITL 0.10
+    mon.on_request_complete(url, "r1", 101.0)
+    stats = mon.get_request_stats(current_time=101.0)
+    s = stats[url]
+    assert s.in_prefill_requests == 0
+    assert s.in_decoding_requests == 0
+    assert s.finished_requests == 1
+    assert abs(s.ttft - 0.25) < 1e-9
+    assert abs(s.avg_itl - 0.10) < 1e-9
+    assert abs(s.avg_latency - 1.0) < 1e-9
+    assert s.qps > 0
+
+
+def test_static_discovery():
+    sd = initialize_service_discovery(
+        ServiceDiscoveryType.STATIC,
+        urls=["http://e0", "http://e1"],
+        models=["llama", "mistral"],
+        aliases={"big": "llama"},
+        model_labels=["a", "b"],
+    )
+    assert isinstance(sd, StaticServiceDiscovery)
+    infos = sd.get_endpoint_info()
+    assert len(infos) == 2
+    assert infos[0].model_names == ["llama"]
+    assert infos[1].model_label == "b"
+    assert sd.aliases == {"big": "llama"}
+    assert infos[0].has_model("llama") and not infos[0].has_model("mistral")
+
+
+def test_static_discovery_length_mismatch():
+    with pytest.raises(ValueError):
+        StaticServiceDiscovery(urls=["http://a"], models=["m1", "m2"])
+
+
+def test_hashtrie(event_loop):
+    trie = HashTrie(chunk_size=4)
+    event_loop.run_until_complete(trie.insert("abcdefgh", "e1"))
+    event_loop.run_until_complete(trie.insert("abcdxxxx", "e2"))
+    matched, eps = event_loop.run_until_complete(trie.longest_prefix_match("abcdefgh"))
+    assert matched == 8 and eps == {"e1"}
+    matched, eps = event_loop.run_until_complete(trie.longest_prefix_match("abcdzzzz"))
+    assert matched == 4 and eps == {"e1", "e2"}
+    matched, eps = event_loop.run_until_complete(trie.longest_prefix_match("zzzz"))
+    assert matched == 0 and eps == set()
+    # availability filter
+    matched, eps = event_loop.run_until_complete(
+        trie.longest_prefix_match("abcdefgh", {"e2"})
+    )
+    assert matched == 4 and eps == {"e2"}
+    # endpoint removal
+    event_loop.run_until_complete(trie.remove_endpoint("e1"))
+    matched, eps = event_loop.run_until_complete(trie.longest_prefix_match("abcdefgh"))
+    assert "e1" not in eps
+
+
+def test_parser_static_ok(tmp_path):
+    args = parse_args(
+        [
+            "--service-discovery", "static",
+            "--static-backends", "http://localhost:9101",
+            "--static-models", "m",
+        ]
+    )
+    assert args.port == 8001
+    assert args.static_aliases_parsed == {}
+
+
+def test_parser_validation_errors():
+    with pytest.raises(ValueError):
+        parse_args(["--service-discovery", "static"])  # missing backends
+    with pytest.raises(ValueError):
+        parse_args(
+            [
+                "--service-discovery", "static",
+                "--static-backends", "http://a:1,http://b:2",
+                "--static-models", "only-one",
+            ]
+        )
+    with pytest.raises(ValueError):
+        parse_args(
+            [
+                "--service-discovery", "static",
+                "--static-backends", "http://a:1",
+                "--static-models", "m",
+                "--routing-logic", "session",
+            ]
+        )
+
+
+def test_parser_config_file(tmp_path):
+    cfg = tmp_path / "router.yaml"
+    cfg.write_text(
+        "port: 9999\nstatic-backends: http://localhost:9101\nstatic-models: m\n"
+    )
+    args = parse_args(["--config", str(cfg)])
+    assert args.port == 9999
+    assert args.static_backends == "http://localhost:9101"
