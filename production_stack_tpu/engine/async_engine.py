@@ -1,0 +1,221 @@
+"""Async façade over :class:`LLMEngine` for the aiohttp server.
+
+The device step loop runs on a dedicated thread (a jitted TPU step blocks);
+request submission and streaming consumption happen on the asyncio loop.
+Outputs cross threads via ``loop.call_soon_threadsafe`` into per-request
+queues — the same engine-loop/frontend split vLLM's AsyncLLMEngine gives the
+reference stack, minus multiprocessing.
+
+Sleep/wake (reference `/sleep`, `/wake_up`, tutorial 19): sleeping pauses the
+step loop; level 2 additionally drops the KV cache pages to free HBM (they
+are re-zeroed on wake).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from typing import AsyncIterator, Dict, List, Optional, Sequence as Seq
+
+from ..logging_utils import init_logger
+from .config import EngineConfig
+from .engine import LLMEngine, RequestOutput
+from .sequence import SamplingParams
+
+logger = init_logger(__name__)
+
+_SENTINEL = object()
+
+
+class AsyncLLMEngine:
+    def __init__(self, cfg: EngineConfig, mesh=None):
+        self.engine = LLMEngine(cfg, mesh)
+        self._lock = threading.Lock()  # guards scheduler/engine mutation
+        self._work = threading.Event()
+        self._stop = False
+        self._sleeping = False
+        self._sleep_level = 0
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        # Submission/abort mailboxes drained by the step thread, so the
+        # asyncio loop never contends for the engine lock (a jitted step can
+        # hold it for hundreds of ms — taking it on the loop would stall
+        # every connection, including /health).
+        self._submit_lock = threading.Lock()
+        self._pending_adds: list = []
+        self._pending_aborts: list = []
+        # Step-loop health for the composite /health check.
+        self.last_step_time = time.time()
+        self.step_error: Optional[str] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name="engine-step-loop", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def is_healthy(self) -> bool:
+        return (
+            self.step_error is None
+            and self._thread is not None
+            and self._thread.is_alive()
+        )
+
+    # -- sleep / wake -----------------------------------------------------
+
+    @property
+    def sleeping(self) -> bool:
+        return self._sleeping
+
+    def sleep(self, level: int = 1) -> None:
+        self._sleeping = True
+        self._sleep_level = level
+        if level >= 2:
+            with self._lock:
+                # Dropping HBM pages invalidates every block the prefix maps
+                # point at — clear them (and abort in-flight work) or later
+                # prompts would adopt zeroed pages as cache hits.
+                self.engine.clear_kv_state()
+                self.engine.runner.drop_kv_cache()
+            self._sentinel_all()
+        logger.info("engine sleeping (level %d)", level)
+
+    def wake_up(self) -> None:
+        if self._sleep_level >= 2:
+            with self._lock:
+                self.engine.runner.restore_kv_cache()
+        self._sleeping = False
+        self._sleep_level = 0
+        self._work.set()
+        logger.info("engine awake")
+
+    # -- submission -------------------------------------------------------
+
+    async def generate(
+        self,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[Seq[int]] = None,
+        sampling: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[RequestOutput]:
+        if self.step_error is not None:
+            raise RuntimeError(f"engine is failed: {self.step_error}")
+        rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = queue
+        finished = False
+        try:
+            with self._submit_lock:
+                self._pending_adds.append(
+                    (
+                        rid,
+                        dict(
+                            prompt=prompt,
+                            prompt_token_ids=prompt_token_ids,
+                            sampling=sampling,
+                            arrival_time=time.time(),
+                        ),
+                    )
+                )
+            self._work.set()
+            while True:
+                item = await queue.get()
+                if item is _SENTINEL:
+                    break
+                yield item
+                if item.finished:
+                    finished = True
+                    break
+        finally:
+            self._queues.pop(rid, None)
+            if not finished:  # client went away mid-stream: reclaim pages
+                with self._submit_lock:
+                    self._pending_aborts.append(rid)
+                self._work.set()
+
+    async def abort(self, request_id: str) -> bool:
+        with self._submit_lock:
+            self._pending_aborts.append(request_id)
+        self._work.set()
+        q = self._queues.get(request_id)
+        if q is not None:
+            q.put_nowait(_SENTINEL)
+        return True
+
+    # -- engine thread ----------------------------------------------------
+
+    def _drain_mailboxes(self) -> None:
+        with self._submit_lock:
+            adds, self._pending_adds = self._pending_adds, []
+            aborts, self._pending_aborts = self._pending_aborts, []
+        with self._lock:
+            for rid in aborts:
+                self.engine.abort_request(rid)
+            for rid, kwargs in adds:
+                if rid in self._queues:  # skip if the client already left
+                    try:
+                        self.engine.add_request(rid, **kwargs)
+                    except Exception as e:  # noqa: BLE001 — per-request error
+                        logger.warning("add_request %s failed: %s", rid, e)
+                        self._sentinel_one(rid)
+
+    def _sentinel_one(self, rid: str) -> None:
+        if self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(
+            lambda: self._queues.get(rid) and self._queues[rid].put_nowait(_SENTINEL)
+        )
+
+    def _run(self) -> None:
+        logger.info("engine step loop started")
+        while not self._stop:
+            self._drain_mailboxes()
+            if self._sleeping or not self.engine.has_work():
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+                self.last_step_time = time.time()
+                continue
+            try:
+                with self._lock:
+                    outputs = self.engine.step()
+                self.last_step_time = time.time()
+            except Exception as e:  # noqa: BLE001 — surface via /health
+                logger.exception("engine step failed")
+                self.step_error = str(e)
+                with self._lock:
+                    # Drain the scheduler so the loop doesn't spin hot on the
+                    # same failure; queued requests get sentinels (callers see
+                    # truncated streams) and new submissions are refused.
+                    self.engine.abort_all_requests()
+                self._sentinel_all()
+                continue
+            if outputs and self._loop is not None:
+                self._loop.call_soon_threadsafe(self._dispatch, outputs)
+
+    def _dispatch(self, outputs: List[RequestOutput]) -> None:
+        for out in outputs:
+            q = self._queues.get(out.request_id)
+            if q is not None:
+                q.put_nowait(out)
+
+    def _sentinel_all(self) -> None:
+        if self._loop is None:
+            return
+
+        def _do():
+            for q in self._queues.values():
+                q.put_nowait(_SENTINEL)
+
+        self._loop.call_soon_threadsafe(_do)

@@ -1,0 +1,251 @@
+"""KV tiering: HBM → host DRAM → remote store (the LMCache-analogue layer).
+
+Reference mechanism (SURVEY.md §2.4 "KV-cache tiering"): LMCache hooks vLLM's
+paged allocator and spills KV to CPU RAM (`cpuOffloadingBufferSize` →
+`LMCACHE_LOCAL_CPU`, `deployment-vllm-multi.yaml:301-308`), local disk, and a
+remote TCP server (`LMCACHE_REMOTE_URL`, `:313-318`). TPU-native version:
+
+- :class:`HostKVPool` — pinned host-DRAM page pool keyed by the same
+  prefix-committing block hashes the HBM allocator uses (one hashing scheme
+  across tiers, router, and controller — ``kvcache/hashing.py``).
+- :class:`RemoteKVClient` — HTTP client for the remote block store
+  (:mod:`production_stack_tpu.kvserver.server`); device→host DMA then DCN,
+  the TPU replacement for NIXL/GPUDirect.
+- :class:`TieredAllocator` — a :class:`BlockAllocator` whose evictions spill
+  down-tier and whose ``match_prefix`` faults pages back *up*-tier (host or
+  remote hit → allocate HBM page → upload → extend the match). The scheduler
+  is tier-oblivious.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..kvcache.hashing import block_hashes
+from ..logging_utils import init_logger
+from .kv_manager import BlockAllocator, NoFreeBlocksError
+
+logger = init_logger(__name__)
+
+
+class HostKVPool:
+    """LRU pool of KV pages in host DRAM, keyed by block hash."""
+
+    def __init__(self, max_blocks: int):
+        self.max_blocks = max_blocks
+        self._pages: "collections.OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.bytes_used = 0
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> None:
+        with self._lock:
+            if h in self._pages:
+                self._pages.move_to_end(h)
+                return
+            while len(self._pages) >= self.max_blocks:
+                _, (ek, ev) = self._pages.popitem(last=False)
+                self.bytes_used -= ek.nbytes + ev.nbytes
+            self._pages[h] = (k, v)
+            self.bytes_used += k.nbytes + v.nbytes
+
+    def get(self, h: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            item = self._pages.get(h)
+            if item is not None:
+                self._pages.move_to_end(h)
+            return item
+
+    def contains(self, h: int) -> bool:
+        with self._lock:
+            return h in self._pages
+
+
+class RemoteKVClient:
+    """Blocking HTTP client for the remote KV block server (engine thread)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        import requests
+
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> bool:
+        try:
+            payload = _serialize_page(k, v)
+            r = self._session.put(
+                f"{self.base_url}/blocks/{h}",
+                data=payload,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=self.timeout,
+            )
+            return r.status_code == 200
+        except Exception as e:  # noqa: BLE001 — remote tier is best-effort
+            logger.debug("remote KV put failed: %s", e)
+            return False
+
+    def get(self, h: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        try:
+            r = self._session.get(
+                f"{self.base_url}/blocks/{h}", timeout=self.timeout
+            )
+            if r.status_code != 200:
+                return None
+            return _deserialize_page(r.content)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("remote KV get failed: %s", e)
+            return None
+
+
+_MAGIC = b"PSTKV1\x00\x00"
+
+
+def _serialize_page(k: np.ndarray, v: np.ndarray) -> bytes:
+    """Self-describing page serde (the LMCache 'serde' role): header carries
+    dtype + shape; body is raw K then V bytes."""
+    import json as _json
+
+    header = _json.dumps(
+        {"dtype": str(k.dtype), "shape": list(k.shape)}
+    ).encode()
+    return (
+        _MAGIC
+        + len(header).to_bytes(4, "little")
+        + header
+        + np.ascontiguousarray(k).tobytes()
+        + np.ascontiguousarray(v).tobytes()
+    )
+
+
+def _deserialize_page(buf: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    import json as _json
+
+    assert buf[:8] == _MAGIC, "bad KV page magic"
+    hlen = int.from_bytes(buf[8:12], "little")
+    header = _json.loads(buf[12 : 12 + hlen].decode())
+    dtype = np.dtype(header["dtype"])
+    shape = tuple(header["shape"])
+    body = buf[12 + hlen :]
+    n = dtype.itemsize * int(np.prod(shape))
+    k = np.frombuffer(body[:n], dtype=dtype).reshape(shape)
+    v = np.frombuffer(body[n : 2 * n], dtype=dtype).reshape(shape)
+    return k, v
+
+
+class TieredAllocator(BlockAllocator):
+    """HBM allocator with spill-down / fault-up across host and remote tiers.
+
+    ``page_io`` is the runner adapter exposing ``download_page(blk)`` and
+    ``upload_page(blk, k, v)`` (device DMA endpoints).
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        page_io,
+        host_blocks: int = 0,
+        remote: Optional[RemoteKVClient] = None,
+        enable_prefix_caching: bool = True,
+    ):
+        super().__init__(
+            num_blocks,
+            block_size,
+            enable_prefix_caching=enable_prefix_caching,
+            on_evict=self._spill,
+        )
+        self.page_io = page_io
+        self.host_pool = HostKVPool(host_blocks) if host_blocks > 0 else None
+        self.remote = remote
+        # Tier KPIs (exported as lmcache-dashboard-style metrics).
+        self.host_hit_blocks = 0
+        self.remote_hit_blocks = 0
+        self.spilled_blocks = 0
+        self.remote_push_drops = 0
+        # Remote pushes ride a bounded queue + worker thread: eviction sits
+        # on the decode critical path and must never wait on DCN/HTTP.
+        self._push_queue: "collections.deque[Tuple[int, np.ndarray, np.ndarray]]" = (
+            collections.deque(maxlen=256)
+        )
+        self._push_event = threading.Event()
+        self._push_thread: Optional[threading.Thread] = None
+        if remote is not None:
+            self._push_thread = threading.Thread(
+                target=self._push_worker, name="kv-remote-push", daemon=True
+            )
+            self._push_thread.start()
+
+    # -- spill down -------------------------------------------------------
+
+    def _spill(self, blk: int, h: int) -> None:
+        if self.host_pool is None and self.remote is None:
+            return
+        k, v = self.page_io.download_page(blk)
+        if self.host_pool is not None:
+            self.host_pool.put(h, k, v)
+        if self.remote is not None:
+            if len(self._push_queue) == self._push_queue.maxlen:
+                self.remote_push_drops += 1  # deque evicts the oldest entry
+            self._push_queue.append((h, k, v))
+            self._push_event.set()
+        self.spilled_blocks += 1
+
+    def _push_worker(self) -> None:
+        while True:
+            try:
+                h, k, v = self._push_queue.popleft()
+            except IndexError:
+                self._push_event.wait(timeout=1.0)
+                self._push_event.clear()
+                continue
+            self.remote.put(h, k, v)  # best-effort; client logs failures
+
+    # -- fault up ---------------------------------------------------------
+
+    def _fetch_lower_tier(self, h: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        if self.host_pool is not None:
+            page = self.host_pool.get(h)
+            if page is not None:
+                self.host_hit_blocks += 1
+                return page
+        if self.remote is not None:
+            page = self.remote.get(h)
+            if page is not None:
+                self.remote_hit_blocks += 1
+                if self.host_pool is not None:  # promote to the warmer tier
+                    self.host_pool.put(h, *page)
+                return page
+        return None
+
+    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], List[int]]:
+        self.query_tokens += len(token_ids)
+        if not self.enable_prefix_caching:
+            return [], []
+        hashes = block_hashes(token_ids, self.block_size)
+        matched: List[int] = []
+        matched_hashes: List[int] = []
+        for h in hashes:
+            blk = self.acquire_cached(h)
+            if blk is None:
+                page = self._fetch_lower_tier(h)
+                if page is None:
+                    break
+                try:
+                    blk = self.allocate()
+                except NoFreeBlocksError:
+                    break
+                self.page_io.upload_page(blk, *page)
+                blk = self.commit(blk, h)
+            matched.append(blk)
+            matched_hashes.append(h)
+        self.hit_tokens += len(matched) * self.block_size
+        return matched, matched_hashes

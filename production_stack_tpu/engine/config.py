@@ -1,0 +1,104 @@
+"""Engine configuration — the TPU analogue of the reference's vLLM flag set.
+
+Field ↔ reference mapping (`helm/values.yaml:71-81`, CRD
+`operator/api/v1alpha1/vllmruntime_types.go:67-95`):
+``tensor_parallel_size`` ↔ ``--tensor-parallel-size``; ``max_model_len`` ↔
+``--max-model-len``; ``max_num_seqs`` ↔ ``--max-num-seqs``;
+``enable_prefix_caching`` ↔ ``--enable-prefix-caching``;
+``max_prefill_tokens`` ↔ chunked-prefill token budget;
+``hbm_utilization`` ↔ ``--gpu-memory-utilization``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from ..logging_utils import init_logger
+from ..models.llama import LlamaConfig
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny-llama-debug"
+    tokenizer: Optional[str] = None  # default: model dir, or byte tokenizer
+    served_model_name: Optional[str] = None
+    max_model_len: int = 4096
+    block_size: int = 32
+    num_kv_blocks: Optional[int] = None  # None: size from HBM budget
+    hbm_utilization: float = 0.9
+    max_num_seqs: int = 64
+    max_prefill_tokens: int = 2048
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    kv_cache_dtype: Optional[str] = None  # default: model dtype
+    attn_impl: str = "auto"  # auto | gather | pallas
+    enable_prefix_caching: bool = True
+    # Decode tokens generated per device call (lax.scan over steps inside one
+    # jit). Amortizes host⇄device dispatch — the dominant cost for small
+    # models and remote-attached chips. Stop conditions are applied host-side
+    # after the burst; at most n-1 speculatively-decoded tokens are discarded
+    # per finished request. 1 = classic per-token stepping.
+    num_decode_steps: int = 1
+    enforce_eager: bool = False  # reserved; XLA always compiles
+    seed: int = 0
+    # KV tiering (LMCache-analogue knobs; SURVEY.md §2.4).
+    cpu_offload_blocks: int = 0
+    remote_kv_url: Optional[str] = None
+    # Cache-controller registration (KV-aware routing; LMCACHE_CONTROLLER_URL
+    # analogue). engine_url is what this pod reports itself as.
+    cache_controller_url: Optional[str] = None
+    engine_url: Optional[str] = None
+    # Disaggregated prefill role (reference: --kv-transfer-config
+    # kv_producer/kv_consumer, `deployment-vllm-multi.yaml:180-189`).
+    # producer: push each completed prefill's KV pages to the remote store
+    # (device→host DMA then DCN — the NIXL-sender analogue).
+    # consumer: fault pages up from the remote store at admission
+    # (TieredAllocator.match_prefix — the NIXL-receiver analogue).
+    kv_role: str = "none"  # none | producer | consumer | both
+
+
+def resolve_num_kv_blocks(
+    cfg: EngineConfig, model_cfg: LlamaConfig, param_bytes_per_device: int
+) -> int:
+    """Page count from the HBM budget (``--gpu-memory-utilization`` analogue).
+
+    bytes/page = 2 (K+V) * L * bs * KH * hd * itemsize, divided by tp because
+    kv heads are sharded over the tensor axis.
+    """
+    if cfg.num_kv_blocks is not None:
+        return cfg.num_kv_blocks
+    dtype_size = jax.numpy.dtype(cfg.kv_cache_dtype or model_cfg.dtype).itemsize
+    tp = max(cfg.tensor_parallel_size, 1)
+    page_bytes = (
+        2
+        * model_cfg.num_layers
+        * cfg.block_size
+        * max(model_cfg.num_kv_heads // tp, 1)
+        * model_cfg.head_dim
+        * dtype_size
+    )
+    dev = jax.devices()[0]
+    stats = {}
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        pass
+    hbm = stats.get("bytes_limit")
+    if not hbm:
+        # Virtual CPU devices: keep the cache modest (tests override anyway).
+        budget = 512 * 1024 * 1024
+    else:
+        budget = int(hbm * cfg.hbm_utilization) - param_bytes_per_device
+    n = max(budget // page_bytes, cfg.max_num_seqs * 2)
+    # Never fewer pages than one full-length sequence needs.
+    n = max(n, -(-cfg.max_model_len // cfg.block_size) + 1)
+    logger.info(
+        "KV cache: %d pages x %d tokens (%.1f MiB/device)",
+        n, cfg.block_size, n * page_bytes / 2**20,
+    )
+    return int(n)

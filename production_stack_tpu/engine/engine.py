@@ -1,0 +1,366 @@
+"""LLMEngine: the synchronous serving core (add_request / step / outputs).
+
+Equivalent role to the vLLM engine the reference stack drives over HTTP
+(SURVEY.md §1 "Serving engine" row). One `step()` = one scheduler decision +
+one (or a few) jitted device steps + host-side bookkeeping: detokenization,
+stop handling, prefix-block commitment, and the counters the `/metrics`
+endpoint exports under the `vllm:`-compatible names the router's stats
+scraper parses (`stats/engine_stats.py:42-85` contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional, Sequence as Seq, Union
+
+from ..kvcache.hashing import CHUNK_TOKENS
+from ..logging_utils import init_logger
+from ..models.registry import get_model_config
+from .config import EngineConfig
+from .kv_manager import BlockAllocator
+from .runner import ModelRunner
+from .scheduler import Scheduler, SchedulerConfig
+from .sequence import SamplingParams, Sequence
+from .tokenizer import get_tokenizer
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    text_delta: str = ""
+    new_token_ids: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+    num_cached_prompt_tokens: int = 0
+    ttft: Optional[float] = None
+
+
+class LLMEngine:
+    def __init__(self, cfg: EngineConfig, mesh=None):
+        self.cfg = cfg
+        self.model_cfg = get_model_config(cfg.model)
+        tok_spec = cfg.tokenizer or (cfg.model if os.path.isdir(cfg.model) else None)
+        self.tokenizer = get_tokenizer(tok_spec, self.model_cfg.vocab_size)
+        self.runner = ModelRunner(cfg, self.model_cfg, mesh)
+        if cfg.cpu_offload_blocks > 0 or cfg.remote_kv_url:
+            from .cache_tiering import RemoteKVClient, TieredAllocator
+
+            self.allocator: BlockAllocator = TieredAllocator(
+                self.runner.num_blocks,
+                cfg.block_size,
+                page_io=self.runner,
+                host_blocks=cfg.cpu_offload_blocks,
+                remote=RemoteKVClient(cfg.remote_kv_url)
+                if cfg.remote_kv_url
+                else None,
+                enable_prefix_caching=cfg.enable_prefix_caching,
+            )
+        else:
+            self.allocator = BlockAllocator(
+                self.runner.num_blocks, cfg.block_size, cfg.enable_prefix_caching
+            )
+        self.scheduler = Scheduler(
+            SchedulerConfig(
+                max_num_seqs=cfg.max_num_seqs,
+                max_prefill_tokens=cfg.max_prefill_tokens,
+                max_model_len=cfg.max_model_len,
+                num_decode_steps=cfg.num_decode_steps,
+            ),
+            self.allocator,
+        )
+        self._seqs: Dict[str, Sequence] = {}
+        # Incremental detokenizer state per request:
+        # emitted text + [prefix_offset, read_offset) decode window.
+        self._detok: Dict[str, Dict[str, object]] = {}
+        # Chunk hashes resident in this engine's tiers (controller
+        # registration: hash -> last-commit time).
+        self.resident_chunk_hashes: Dict[int, float] = {}
+        # Cumulative counters for /metrics.
+        self.num_preempted_total = 0
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+
+    @property
+    def model_name(self) -> str:
+        return self.cfg.served_model_name or self.model_cfg.name
+
+    # ------------------------------------------------------------------
+    # Requests
+    # ------------------------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[Seq[int]] = None,
+        sampling: Optional[SamplingParams] = None,
+        arrival_time: Optional[float] = None,
+    ) -> Sequence:
+        if prompt_token_ids is None:
+            prompt_token_ids = self.tokenizer.encode(prompt or "")
+        if not prompt_token_ids:
+            prompt_token_ids = [0]
+        seq = Sequence(
+            request_id,
+            prompt_token_ids,
+            sampling or SamplingParams(),
+            arrival_time=arrival_time,
+        )
+        self.scheduler.add(seq)
+        self._seqs[request_id] = seq
+        self._detok[request_id] = {"emitted": "", "prefix": 0, "read": 0}
+        self.prompt_tokens_total += len(prompt_token_ids)
+        return seq
+
+    def abort_request(self, request_id: str) -> bool:
+        seq = self.scheduler.abort(request_id)
+        self._seqs.pop(request_id, None)
+        self._detok.pop(request_id, None)
+        return seq is not None
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def abort_all_requests(self) -> int:
+        """Abort everything queued or running (sleep / fatal-error paths)."""
+        rids = list(self._seqs.keys())
+        for rid in rids:
+            self.abort_request(rid)
+        return len(rids)
+
+    def clear_kv_state(self) -> None:
+        """Invalidate all HBM-resident KV bookkeeping. Must accompany any
+        operation that discards cache contents (sleep level 2): otherwise the
+        hash→page maps would serve zero-filled pages as prefix hits. Lower
+        tiers (host pool / remote) keep their pages — their copies were
+        written before the drop and stay valid, LMCache-style."""
+        self.abort_all_requests()
+        host_pool = getattr(self.allocator, "host_pool", None)
+        remote = getattr(self.allocator, "remote", None)
+        if host_pool is not None or remote is not None:
+            from .cache_tiering import TieredAllocator
+
+            new = TieredAllocator(
+                self.runner.num_blocks,
+                self.cfg.block_size,
+                page_io=self.runner,
+                host_blocks=0,
+                remote=remote,
+                enable_prefix_caching=self.cfg.enable_prefix_caching,
+            )
+            new.host_pool = host_pool  # preserve the warm host tier
+            self.allocator = new
+        else:
+            self.allocator = BlockAllocator(
+                self.runner.num_blocks,
+                self.cfg.block_size,
+                self.cfg.enable_prefix_caching,
+            )
+        self.scheduler.allocator = self.allocator
+        self.resident_chunk_hashes.clear()
+
+    # ------------------------------------------------------------------
+    # Stepping
+    # ------------------------------------------------------------------
+
+    def step(self) -> List[RequestOutput]:
+        sched = self.scheduler.schedule()
+        self.num_preempted_total += len(sched.preempted)
+        if sched.is_empty:
+            return []
+        outputs: List[RequestOutput] = []
+        if sched.prefills:
+            tokens = self.runner.execute_prefill_batch(sched.prefills)
+            for item, token in zip(sched.prefills, tokens):
+                seq = item.seq
+                seq.num_computed_tokens = item.end
+                self._commit(seq)
+                # Sample only when this chunk completes a *fresh* prompt;
+                # recompute chunks (post-preemption) must not re-emit tokens.
+                if item.end == seq.num_prompt_tokens and not seq.output_token_ids:
+                    out = self._append_token(seq, int(token))
+                    if out is not None:
+                        outputs.append(out)
+        else:
+            bursts = self.runner.execute_decode_multi(
+                sched.decodes, sched.n_decode_steps
+            )
+            for seq, row in zip(sched.decodes, bursts):
+                for token in row:
+                    seq.num_computed_tokens += 1
+                    self._commit(seq)
+                    out = self._append_token(seq, int(token))
+                    if out is not None:
+                        outputs.append(out)
+                    if seq.is_finished:
+                        break  # trim speculative tail of the burst
+        return outputs
+
+    # Controller-registration hygiene: chunk claims older than the TTL (or
+    # beyond the cap) are dropped so KV-aware routing doesn't chase KV that
+    # LRU eviction already reclaimed, and the dict can't grow unboundedly.
+    CHUNK_CLAIM_TTL = 20 * 60.0
+    CHUNK_CLAIM_CAP = 200_000
+
+    def _commit(self, seq: Sequence) -> None:
+        seq.commit_full_blocks(self.allocator)
+        now = time.time()
+        for h in seq.commit_full_chunks(CHUNK_TOKENS):
+            self.resident_chunk_hashes.pop(h, None)  # refresh insertion order
+            self.resident_chunk_hashes[h] = now
+        if len(self.resident_chunk_hashes) > self.CHUNK_CLAIM_CAP:
+            self._prune_chunk_claims(now)
+
+    def _prune_chunk_claims(self, now: float) -> None:
+        cutoff = now - self.CHUNK_CLAIM_TTL
+        fresh = {h: t for h, t in self.resident_chunk_hashes.items() if t >= cutoff}
+        if len(fresh) > self.CHUNK_CLAIM_CAP:
+            # insertion order == recency (refreshed on re-commit): keep newest
+            fresh = dict(list(fresh.items())[-self.CHUNK_CLAIM_CAP :])
+        self.resident_chunk_hashes = fresh
+
+    def _push_kv_to_remote(self, seq: Sequence) -> int:
+        """Producer-side disagg-prefill transfer: ship this request's
+        committed KV pages to the remote store before the prefill response
+        returns, so the decode engine's pull is guaranteed to hit (the
+        ordering the router's two-phase flow relies on). Returns pages sent."""
+        remote = getattr(self.allocator, "remote", None)
+        if remote is None:
+            return 0
+        sent = 0
+        for blk, h in zip(seq.block_ids, seq.block_hashes):
+            k, v = self.runner.download_page(blk)
+            if remote.put(h, k, v):
+                sent += 1
+        return sent
+
+    # ------------------------------------------------------------------
+    # Token bookkeeping
+    # ------------------------------------------------------------------
+
+    def _append_token(self, seq: Sequence, token: int) -> Optional[RequestOutput]:
+        sp = seq.sampling
+        seq.output_token_ids.append(token)
+        self.generation_tokens_total += 1
+        now = time.time()
+        if seq.first_token_time is None:
+            seq.first_token_time = now
+
+        finish_reason: Optional[str] = None
+        is_stop_token = False
+        if not sp.ignore_eos and token in self.model_cfg.eos_token_ids:
+            finish_reason = "stop"
+            is_stop_token = True
+        elif token in sp.stop_token_ids:
+            finish_reason = "stop"
+            is_stop_token = True
+        elif len(seq.output_token_ids) >= sp.max_tokens:
+            finish_reason = "length"
+        elif seq.num_tokens >= self.cfg.max_model_len:
+            finish_reason = "length"
+
+        # Incremental detokenization: decode only a sliding window of recent
+        # tokens (O(window) per step, not O(total)); hold back text while the
+        # window ends in a partial multi-byte/multi-token character.
+        delta = "" if is_stop_token else self._detok_delta(seq)
+        st = self._detok[seq.request_id]
+        if delta and sp.stop_strings():
+            emitted = st["emitted"]
+            full = emitted + delta
+            for stop_s in sp.stop_strings():
+                idx = full.find(stop_s, max(len(emitted) - len(stop_s), 0))
+                if idx >= 0:
+                    delta = full[:idx][len(emitted):]
+                    finish_reason = "stop"
+                    break
+        st["emitted"] += delta
+
+        out = RequestOutput(
+            request_id=seq.request_id,
+            text_delta=delta,
+            new_token_ids=[token],
+            num_prompt_tokens=seq.num_prompt_tokens,
+            num_output_tokens=len(seq.output_token_ids),
+            num_cached_prompt_tokens=seq.num_cached_prompt_tokens,
+            ttft=(seq.first_token_time - seq.arrival_time),
+        )
+        if finish_reason is not None:
+            if self.cfg.kv_role in ("producer", "both"):
+                sent = self._push_kv_to_remote(seq)
+                if sent:
+                    logger.debug(
+                        "disagg: pushed %d KV pages for %s", sent, seq.request_id
+                    )
+            self.scheduler.finish(seq, finish_reason)
+            out.finished = True
+            out.finish_reason = finish_reason
+            self._seqs.pop(seq.request_id, None)
+            self._detok.pop(seq.request_id, None)
+        return out
+
+    def _detok_delta(self, seq: Sequence) -> str:
+        """vLLM-style incremental detokenization over a bounded window."""
+        st = self._detok[seq.request_id]
+        ids = seq.output_token_ids
+        prefix, read = int(st["prefix"]), int(st["read"])  # type: ignore[arg-type]
+        prefix_text = self.tokenizer.decode(ids[prefix:read])
+        new_text = self.tokenizer.decode(ids[prefix:])
+        if new_text.endswith("�") and len(ids) - read < 16:
+            return ""  # partial character: hold until it completes (bounded —
+            # genuinely invalid byte runs are force-emitted after 16 tokens)
+        delta = new_text[len(prefix_text):]
+        st["prefix"], st["read"] = read, len(ids)
+        return delta
+
+    # ------------------------------------------------------------------
+    # Convenience (tests / bench)
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Union[List[str], List[List[int]]],
+        sampling: Optional[SamplingParams] = None,
+    ) -> List[Dict[str, object]]:
+        """Run prompts to completion; returns list of dicts with text/ids."""
+        results: Dict[str, Dict[str, object]] = {}
+        for i, p in enumerate(prompts):
+            rid = f"gen-{i}"
+            kwargs = {"prompt_token_ids": p} if isinstance(p, list) else {"prompt": p}
+            self.add_request(rid, sampling=sampling, **kwargs)
+            results[rid] = {"text": "", "token_ids": [], "finish_reason": None}
+        while self.has_work():
+            for out in self.step():
+                r = results[out.request_id]
+                r["text"] = str(r["text"]) + out.text_delta
+                r["token_ids"].extend(out.new_token_ids)  # type: ignore[union-attr]
+                if out.finished:
+                    r["finish_reason"] = out.finish_reason
+        return [results[f"gen-{i}"] for i in range(len(prompts))]
+
+    # ------------------------------------------------------------------
+    # Metrics snapshot for the server layer
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        out = {
+            "num_requests_running": float(self.scheduler.num_running),
+            "num_requests_waiting": float(self.scheduler.num_waiting),
+            "num_preemptions_total": float(self.num_preempted_total),
+            "prompt_tokens_total": float(self.prompt_tokens_total),
+            "generation_tokens_total": float(self.generation_tokens_total),
+            "kv_cache_usage_perc": self.allocator.usage,
+            "prefix_cache_hit_rate": self.allocator.hit_rate,
+            "prefix_cache_hits_total": float(self.allocator.hit_tokens),
+            "prefix_cache_queries_total": float(self.allocator.query_tokens),
+        }
+        # Tiering KPIs (present when the LMCache-analogue layer is on).
+        for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):
+            if hasattr(self.allocator, attr):
+                out[f"kv_offload_{attr}"] = float(getattr(self.allocator, attr))
+        return out

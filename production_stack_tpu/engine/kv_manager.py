@@ -1,0 +1,161 @@
+"""Paged KV-cache block manager with content-hash prefix caching.
+
+The reference gets this from vLLM's PagedAttention block manager plus
+LMCache's chunk-hash dedup (`SURVEY.md` §2.4 "KV-cache tiering"). Here the
+manager is host-side bookkeeping only — device pages live in the stacked
+``[L, nb, bs, KH, hd]`` cache arrays owned by the runner; this class decides
+*which page index* each sequence writes/reads, and which full pages are
+shareable across requests via the prefix-committing block hashes of
+:mod:`production_stack_tpu.kvcache.hashing` (the same scheme the router's
+KV-aware policy and the remote cache tier speak, so routing and reuse agree).
+
+Eviction is LRU over reusable pages (refcount 0 but content intact). An
+``on_evict`` hook lets the tiering layer capture pages on their way out
+(HBM → host DRAM → remote, LMCache-style).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..kvcache.hashing import block_hashes
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class NoFreeBlocksError(RuntimeError):
+    pass
+
+
+class BlockAllocator:
+    """Reference-counted page allocator with hash-addressed reuse."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+        on_evict: Optional[Callable[[int, int], None]] = None,
+    ):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.on_evict = on_evict
+        self._refcount = [0] * num_blocks
+        self._hash_of_block: Dict[int, int] = {}
+        self._block_of_hash: Dict[int, int] = {}
+        # refcount-0 blocks with intact, hash-addressed content (LRU order).
+        self._reusable: "collections.OrderedDict[int, int]" = collections.OrderedDict()
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        # Prefix-cache KPIs exported as vllm:gpu_prefix_cache_* gauges.
+        self.hit_tokens = 0
+        self.query_tokens = 0
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._reusable)
+
+    @property
+    def usage(self) -> float:
+        return 1.0 - self.num_free / max(self.num_blocks, 1)
+
+    # -- allocation -------------------------------------------------------
+
+    def allocate(self) -> int:
+        """Take one writable page (evicting the LRU reusable page if needed)."""
+        if self._free:
+            blk = self._free.pop()
+            self._refcount[blk] = 1
+            return blk
+        if self._reusable:
+            blk, h = self._reusable.popitem(last=False)
+            del self._block_of_hash[h]
+            del self._hash_of_block[blk]
+            if self.on_evict is not None:
+                self.on_evict(blk, h)
+            self._refcount[blk] = 1
+            return blk
+        raise NoFreeBlocksError("out of KV blocks")
+
+    def acquire_cached(self, h: int) -> Optional[int]:
+        """Reuse the page holding hash ``h``, if resident. Increfs."""
+        if not self.enable_prefix_caching:
+            return None
+        blk = self._block_of_hash.get(h)
+        if blk is None:
+            return None
+        if blk in self._reusable:
+            del self._reusable[blk]
+        self._refcount[blk] += 1
+        return blk
+
+    def incref(self, blk: int) -> None:
+        self._refcount[blk] += 1
+
+    def commit(self, blk: int, h: int) -> int:
+        """Mark a freshly-written full page as content-addressed by ``h``.
+
+        If another request concurrently committed the same content, dedup to
+        the existing page: the caller must swap to the returned id.
+        """
+        if not self.enable_prefix_caching:
+            return blk
+        existing = self._block_of_hash.get(h)
+        if existing is not None and existing != blk:
+            self.release(blk)
+            self.incref(existing)
+            if existing in self._reusable:
+                del self._reusable[existing]
+            return existing
+        self._hash_of_block[blk] = h
+        self._block_of_hash[h] = blk
+        return blk
+
+    def release(self, blk: int) -> None:
+        self._refcount[blk] -= 1
+        assert self._refcount[blk] >= 0, f"double free of block {blk}"
+        if self._refcount[blk] == 0:
+            h = self._hash_of_block.get(blk)
+            if h is not None:
+                self._reusable[blk] = h  # keep content for future hits
+            else:
+                self._free.append(blk)
+
+    def release_all(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            self.release(b)
+
+    # -- prefix lookup ----------------------------------------------------
+
+    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], List[int]]:
+        """Longest resident prefix of ``token_ids`` at block granularity.
+
+        Returns (matched block ids — increfed, their hashes). Callers start
+        computing at ``len(matched) * block_size``.
+        """
+        self.query_tokens += len(token_ids)
+        if not self.enable_prefix_caching:
+            return [], []
+        hashes = block_hashes(token_ids, self.block_size)
+        matched: List[int] = []
+        matched_hashes: List[int] = []
+        for h in hashes:
+            blk = self.acquire_cached(h)
+            if blk is None:
+                break
+            matched.append(blk)
+            matched_hashes.append(h)
+        self.hit_tokens += len(matched) * self.block_size
+        return matched, matched_hashes
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0
+
+    def reset_metrics(self) -> None:
+        self.hit_tokens = 0
+        self.query_tokens = 0

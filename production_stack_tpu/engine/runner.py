@@ -1,0 +1,476 @@
+"""Model runner: marshals scheduler output into jitted device steps.
+
+Owns the device state (sharded params + KV page arrays) and the compiled
+step functions. XLA's static-shape world meets continuous batching here:
+every step is padded into power-of-two buckets — decode batch width, prefill
+chunk length, block-table width — so the number of distinct compilations is
+O(log² shapes), all cached by ``jax.jit``. Padding rows write to a
+guaranteed-dropped slot (flat index ``nb*bs``) and are masked in attention by
+``kv_len = 0``.
+
+Sampling runs inside the same jit (logits never leave the device); only the
+``[B]`` sampled token ids are transferred back.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import xxhash
+from jax.numpy import asarray as jnp_asarray
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..logging_utils import init_logger
+from ..models.llama import Llama, LlamaConfig, load_hf_params
+from ..models.registry import get_model_config
+from ..ops.sampling import apply_penalties, sample_tokens
+from ..parallel.mesh import MeshConfig, build_mesh
+from .config import EngineConfig, resolve_num_kv_blocks
+from .scheduler import PrefillItem
+from .sequence import Sequence
+
+logger = init_logger(__name__)
+
+
+def _pow2(n: int, cap: Optional[int] = None) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap) if cap else b
+
+
+# Block tables below this width share one bucket: sequences crossing small
+# power-of-two boundaries would otherwise retrace mid-serving, and the pallas
+# kernel skips out-of-range pages anyway (only the gather fallback pays for
+# the extra width).
+_MIN_TABLE_BUCKET = 64
+
+
+def _seed_for(seq: Sequence) -> int:
+    base = (
+        seq.sampling.seed
+        if seq.sampling.seed is not None
+        else xxhash.xxh32(seq.request_id.encode()).intdigest()
+    )
+    return (base + len(seq.output_token_ids)) & 0x7FFF_FFFF
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        model_cfg: Optional[LlamaConfig] = None,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        self.model_cfg = model_cfg or get_model_config(cfg.model)
+        self.model = Llama(self.model_cfg)
+        tp = cfg.tensor_parallel_size
+        if self.model_cfg.num_kv_heads % max(tp, 1):
+            raise ValueError(
+                f"num_kv_heads={self.model_cfg.num_kv_heads} not divisible by "
+                f"tensor_parallel_size={tp}"
+            )
+        self.mesh = mesh or build_mesh(
+            MeshConfig(
+                tensor_parallel_size=tp, data_parallel_size=cfg.data_parallel_size
+            )
+        )
+
+        t0 = time.time()
+        if os.path.isdir(cfg.model):
+            params = load_hf_params(self.model_cfg, cfg.model)
+        else:
+            params = self.model.init_params(jax.random.PRNGKey(cfg.seed))
+        pspecs = self.model.param_pspecs()
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            params,
+            pspecs,
+        )
+        param_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
+        )
+        logger.info(
+            "params ready: %.2f GiB total, %.1fs", param_bytes / 2**30, time.time() - t0
+        )
+
+        self.num_blocks = resolve_num_kv_blocks(
+            cfg, self.model_cfg, param_bytes // max(tp, 1)
+        )
+        self.max_table_width = -(-cfg.max_model_len // cfg.block_size)
+        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec())
+        k, v = self.model.make_kv_cache(
+            self.num_blocks, cfg.block_size, cfg.kv_cache_dtype
+        )
+        self.k_cache = jax.device_put(k, cache_sh)
+        self.v_cache = jax.device_put(v, cache_sh)
+        self._repl = NamedSharding(self.mesh, P())
+        # Decode batches shard rows over dp (independent sequences — the
+        # in-engine data-parallel axis); prefill chunks stay replicated.
+        self._dp = cfg.data_parallel_size
+        self._row = NamedSharding(self.mesh, P("dp"))
+        self._drop_slot = self.num_blocks * cfg.block_size
+
+        model = self.model
+        attn_impl = cfg.attn_impl
+
+        def step(params, k_cache, v_cache, batch: Dict[str, Any]):
+            logits, (k_cache, v_cache) = model.forward(
+                params,
+                batch["tokens"],
+                batch["positions"],
+                batch["write_idx"],
+                batch["block_tables"],
+                batch["kv_lens"],
+                batch["last_idx"],
+                k_cache,
+                v_cache,
+                attn_impl=attn_impl,
+            )
+            if "penalty_prompt" in batch:
+                logits = apply_penalties(
+                    logits,
+                    batch["penalty_prompt"],
+                    batch["penalty_output"],
+                    batch["presence"],
+                    batch["frequency"],
+                    batch["repetition"],
+                )
+            toks = sample_tokens(
+                logits,
+                batch["temps"],
+                batch["top_ps"],
+                batch["top_ks"],
+                batch["min_ps"],
+                batch["seeds"],
+            )
+            return toks, k_cache, v_cache
+
+        self._step = jax.jit(step, donate_argnums=(1, 2))
+
+        bs = cfg.block_size
+        drop_slot = self.num_blocks * bs
+
+        def multi_step(params, k_cache, v_cache, batch, n_steps: int):
+            """Decode ``n_steps`` tokens per sequence in one compiled call.
+
+            The inter-token dependency (sampled token feeds the next forward)
+            lives inside a ``lax.scan``: positions, page write slots, and
+            per-step PRNG seeds are all derived on-device, so the host pays
+            one dispatch per burst instead of per token.
+            """
+            tables = batch["block_tables"]
+            active = batch["kv_lens"] > 0  # padding rows never write
+
+            def body(carry, i):
+                k_cache, v_cache, tokens, positions = carry
+                blk = jnp.take_along_axis(
+                    tables, (positions // bs)[:, None], axis=1
+                )[:, 0]
+                flat = jnp.where(
+                    active, blk * bs + positions % bs, drop_slot
+                ).astype(jnp.int32)
+                logits, (k_cache, v_cache) = model.forward(
+                    params,
+                    tokens[:, None],
+                    positions[:, None],
+                    flat[:, None],
+                    tables,
+                    positions + 1,  # kv valid through the just-written slot
+                    jnp.zeros_like(positions),
+                    k_cache,
+                    v_cache,
+                    attn_impl=attn_impl,
+                )
+                nxt = sample_tokens(
+                    logits,
+                    batch["temps"],
+                    batch["top_ps"],
+                    batch["top_ks"],
+                    batch["min_ps"],
+                    batch["seeds"] + i.astype(jnp.uint32),
+                )
+                return (k_cache, v_cache, nxt, positions + 1), nxt
+
+            carry = (k_cache, v_cache, batch["tokens"], batch["positions"])
+            (k_cache, v_cache, _, _), toks = jax.lax.scan(
+                body, carry, jnp.arange(n_steps), length=n_steps
+            )
+            return toks.T, k_cache, v_cache  # [B, n_steps]
+
+        self._multi_step = jax.jit(
+            multi_step, static_argnums=(4,), donate_argnums=(1, 2)
+        )
+
+    # ------------------------------------------------------------------
+    # Page I/O for KV tiering (HBM ↔ host DRAM, the LMCache-offload hook).
+    # blk is a traced scalar so each direction compiles exactly once.
+    # ------------------------------------------------------------------
+
+    def download_page(self, blk: int):
+        """Fetch one page's K/V across all layers → host numpy [L, KH, bs, hd]."""
+        if not hasattr(self, "_page_get"):
+            self._page_get = jax.jit(lambda c, i: c[:, :, i])
+        k = np.asarray(jax.device_get(self._page_get(self.k_cache, blk)))
+        v = np.asarray(jax.device_get(self._page_get(self.v_cache, blk)))
+        return k, v
+
+    def upload_page(self, blk: int, k_np, v_np) -> None:
+        """Install host page data into HBM page ``blk`` (donated, in-place)."""
+        if not hasattr(self, "_page_set"):
+            self._page_set = jax.jit(
+                lambda c, i, x: c.at[:, :, i].set(x), donate_argnums=(0,)
+            )
+        cache_dtype = self.k_cache.dtype
+        self.k_cache = self._page_set(
+            self.k_cache, blk, jnp_asarray(k_np, cache_dtype)
+        )
+        self.v_cache = self._page_set(
+            self.v_cache, blk, jnp_asarray(v_np, cache_dtype)
+        )
+
+    # ------------------------------------------------------------------
+    # Sleep / wake (reference tutorial 19: free accelerator memory without
+    # restarting the pod; KV contents are discarded, shapes restored on wake)
+    # ------------------------------------------------------------------
+
+    def drop_kv_cache(self) -> None:
+        self.k_cache.delete()
+        self.v_cache.delete()
+        self.k_cache = None
+        self.v_cache = None
+
+    def restore_kv_cache(self) -> None:
+        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec())
+        k, v = self.model.make_kv_cache(
+            self.num_blocks, self.cfg.block_size, self.cfg.kv_cache_dtype
+        )
+        self.k_cache = jax.device_put(k, cache_sh)
+        self.v_cache = jax.device_put(v, cache_sh)
+
+    # ------------------------------------------------------------------
+    # Embeddings (/v1/embeddings): full-attention encode, mean-pooled
+    # ------------------------------------------------------------------
+
+    def encode(self, token_ids: Seq[int]) -> np.ndarray:
+        T = _pow2(max(len(token_ids), 1), cap=_pow2(self.cfg.max_model_len))
+        toks = np.zeros((1, T), np.int32)
+        toks[0, : len(token_ids)] = token_ids
+        length = np.array([len(token_ids)], np.int32)
+        if not hasattr(self, "_encode_fn"):
+            model = self.model
+
+            def enc(params, toks, length):
+                return model.encode(params, toks, length)
+
+            self._encode_fn = jax.jit(enc)
+        out = self._encode_fn(
+            self.params,
+            jax.device_put(toks, self._repl),
+            jax.device_put(length, self._repl),
+        )
+        return np.asarray(jax.device_get(out))[0]
+
+    # ------------------------------------------------------------------
+    # Public entry points
+    # ------------------------------------------------------------------
+
+    def execute_decode(self, seqs: List[Sequence]) -> np.ndarray:
+        """One decode token for each sequence. Returns [len(seqs)] ids."""
+        batch = self._decode_batch(seqs)
+        return self._run(batch)[: len(seqs)]
+
+    def execute_decode_multi(self, seqs: List[Sequence], n_steps: int) -> np.ndarray:
+        """Decode burst: ``n_steps`` tokens per sequence in one device call.
+        Returns [len(seqs), n_steps] token ids (host trims at stops)."""
+        if n_steps == 1:
+            return self.execute_decode(seqs)[:, None]
+        batch = self._decode_batch(seqs, multi=True)
+        B = batch["kv_lens"].shape[0]
+        row_shard = self._dp > 1 and B % self._dp == 0
+        dev_batch = {
+            k: jax.device_put(v, self._row if row_shard else self._repl)
+            for k, v in batch.items()
+        }
+        toks, self.k_cache, self.v_cache = self._multi_step(
+            self.params, self.k_cache, self.v_cache, dev_batch, n_steps
+        )
+        return np.asarray(jax.device_get(toks))[: len(seqs)]
+
+    def execute_prefill(self, item: PrefillItem) -> int:
+        """Process one prefill chunk; returns the sampled token id (only
+        meaningful when the chunk completes the prompt)."""
+        batch = self._prefill_batch([item])
+        return int(self._run(batch)[0])
+
+    def execute_prefill_batch(self, items: List[PrefillItem]) -> np.ndarray:
+        """Prefill several chunks in one device call (rows padded to a
+        common chunk bucket). Returns [len(items)] sampled token ids."""
+        batch = self._prefill_batch(items)
+        return self._run(batch)[: len(items)]
+
+    def _run(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        B = batch["kv_lens"].shape[0]
+        row_shard = self._dp > 1 and B % self._dp == 0
+        dev_batch = {
+            k: jax.device_put(v, self._row if row_shard else self._repl)
+            for k, v in batch.items()
+        }
+        toks, self.k_cache, self.v_cache = self._step(
+            self.params, self.k_cache, self.v_cache, dev_batch
+        )
+        return np.asarray(jax.device_get(toks))
+
+    # ------------------------------------------------------------------
+    # Batch construction (host side, numpy)
+    # ------------------------------------------------------------------
+
+    def _table_row(self, seq: Sequence, width: int) -> np.ndarray:
+        row = np.zeros(width, np.int32)
+        n = min(len(seq.block_ids), width)
+        row[:n] = seq.block_ids[:n]
+        return row
+
+    def _decode_batch(
+        self, seqs: List[Sequence], multi: bool = False
+    ) -> Dict[str, np.ndarray]:
+        B = len(seqs)
+        Bb = _pow2(B, cap=_pow2(self.cfg.max_num_seqs))
+        Bb = max(Bb, B, self._dp)
+        W = max(len(s.block_ids) for s in seqs)
+        Wb = max(
+            _pow2(W, cap=_pow2(self.max_table_width)),
+            min(_MIN_TABLE_BUCKET, _pow2(self.max_table_width)),
+        )
+        bs = self.cfg.block_size
+
+        shape = (Bb,) if multi else (Bb, 1)
+        tokens = np.zeros(shape, np.int32)
+        positions = np.zeros(shape, np.int32)
+        tables = np.zeros((Bb, Wb), np.int32)
+        kv_lens = np.zeros(Bb, np.int32)
+        if not multi:
+            write_idx = np.full((Bb, 1), self._drop_slot, np.int32)
+            last_idx = np.zeros(Bb, np.int32)
+        for i, s in enumerate(seqs):
+            pos = s.num_tokens - 1
+            tokens[i, ...] = s.all_token_ids[-1]
+            positions[i, ...] = pos
+            tables[i] = self._table_row(s, Wb)
+            kv_lens[i] = s.num_tokens
+            if not multi:
+                write_idx[i, 0] = s.block_ids[pos // bs] * bs + pos % bs
+        batch = {
+            "tokens": tokens,
+            "positions": positions,
+            "block_tables": tables,
+            "kv_lens": kv_lens,
+        }
+        if not multi:
+            batch["write_idx"] = write_idx
+            batch["last_idx"] = last_idx
+        batch.update(self._sampling_arrays(seqs, Bb))
+        return batch
+
+    def _prefill_batch(self, items: List[PrefillItem]) -> Dict[str, np.ndarray]:
+        B = len(items)
+        Bb = _pow2(B)
+        chunk_max = max(it.end - it.start for it in items)
+        Tb = _pow2(chunk_max, cap=_pow2(self.cfg.max_prefill_tokens))
+        Tb = max(Tb, chunk_max)
+        Wb = max(
+            _pow2(
+                max(max(len(it.seq.block_ids) for it in items), 1),
+                cap=_pow2(self.max_table_width),
+            ),
+            min(_MIN_TABLE_BUCKET, _pow2(self.max_table_width)),
+        )
+        bs = self.cfg.block_size
+
+        tokens = np.zeros((Bb, Tb), np.int32)
+        positions = np.zeros((Bb, Tb), np.int32)
+        write_idx = np.full((Bb, Tb), self._drop_slot, np.int32)
+        tables = np.zeros((Bb, Wb), np.int32)
+        kv_lens = np.zeros(Bb, np.int32)
+        last_idx = np.zeros(Bb, np.int32)
+        for i, it in enumerate(items):
+            s, start, end = it.seq, it.start, it.end
+            chunk = end - start
+            ids = s.all_token_ids
+            for j in range(chunk):
+                pos = start + j
+                tokens[i, j] = ids[pos]
+                positions[i, j] = pos
+                write_idx[i, j] = s.block_ids[pos // bs] * bs + pos % bs
+            positions[i, chunk:] = max(end - 1, 0)
+            tables[i] = self._table_row(s, Wb)
+            kv_lens[i] = end
+            last_idx[i] = chunk - 1
+        batch = {
+            "tokens": tokens,
+            "positions": positions,
+            "write_idx": write_idx,
+            "block_tables": tables,
+            "kv_lens": kv_lens,
+            "last_idx": last_idx,
+        }
+        batch.update(self._sampling_arrays([it.seq for it in items], Bb))
+        return batch
+
+    def _sampling_arrays(
+        self, seqs: List[Sequence], B: int
+    ) -> Dict[str, np.ndarray]:
+        temps = np.zeros(B, np.float32)
+        top_ps = np.ones(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        min_ps = np.zeros(B, np.float32)
+        seeds = np.zeros(B, np.uint32)
+        for i, s in enumerate(seqs):
+            sp = s.sampling
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_ks[i] = sp.top_k
+            min_ps[i] = sp.min_p
+            seeds[i] = _seed_for(s)
+        out = {
+            "temps": temps,
+            "top_ps": top_ps,
+            "top_ks": top_ks,
+            "min_ps": min_ps,
+            "seeds": seeds,
+        }
+        if any(s.sampling.has_penalties for s in seqs):
+            out.update(self._penalty_arrays(seqs, B))
+        return out
+
+    def _penalty_arrays(
+        self, seqs: List[Sequence], B: int
+    ) -> Dict[str, np.ndarray]:
+        V = self.model_cfg.vocab_size  # pad value: dropped by scatter
+        Pp = _pow2(max(max(s.num_prompt_tokens for s in seqs), 1))
+        Po = _pow2(max(max(len(s.output_token_ids) for s in seqs), 1))
+        prompt = np.full((B, Pp), V, np.int32)
+        output = np.full((B, Po), V, np.int32)
+        presence = np.zeros(B, np.float32)
+        frequency = np.zeros(B, np.float32)
+        repetition = np.ones(B, np.float32)
+        for i, s in enumerate(seqs):
+            sp = s.sampling
+            prompt[i, : s.num_prompt_tokens] = s.prompt_token_ids
+            output[i, : len(s.output_token_ids)] = s.output_token_ids
+            presence[i] = sp.presence_penalty
+            frequency[i] = sp.frequency_penalty
+            repetition[i] = sp.repetition_penalty
+        return {
+            "penalty_prompt": prompt,
+            "penalty_output": output,
+            "presence": presence,
+            "frequency": frequency,
+            "repetition": repetition,
+        }

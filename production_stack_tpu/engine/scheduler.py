@@ -1,0 +1,225 @@
+"""Continuous-batching scheduler: admission, chunked prefill, preemption.
+
+The reference's engines get this behavior from vLLM (`--enable-chunked-prefill`,
+`--max-num-seqs` pass-throughs in `helm/values.yaml:71-81`); here it is native.
+Each call to :meth:`Scheduler.schedule` emits one device step: either a set of
+prefill chunks (token-budget bounded) or one decode batch over all running
+sequences. Out-of-pages decode preempts the youngest sequence (free its pages,
+recompute later) — same policy family as vLLM's recompute preemption.
+
+Static-shape discipline: the scheduler emits *logical* work; the runner pads
+each step into a small set of compiled bucket shapes, so nothing here needs to
+care about XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional
+
+from ..logging_utils import init_logger
+from .kv_manager import BlockAllocator, NoFreeBlocksError
+from .sequence import Sequence, SequenceStatus
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_num_seqs: int = 64
+    max_prefill_tokens: int = 2048  # per-step chunked-prefill token budget
+    max_model_len: int = 4096
+    num_decode_steps: int = 1  # decode burst length per device call
+
+
+@dataclasses.dataclass
+class PrefillItem:
+    seq: Sequence
+    start: int  # first token index processed this step
+    end: int  # one past the last token index
+
+
+@dataclasses.dataclass
+class SchedulerOutput:
+    prefills: List[PrefillItem] = dataclasses.field(default_factory=list)
+    decodes: List[Sequence] = dataclasses.field(default_factory=list)
+    preempted: List[Sequence] = dataclasses.field(default_factory=list)
+    n_decode_steps: int = 1
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.prefills and not self.decodes
+
+
+class Scheduler:
+    def __init__(self, config: SchedulerConfig, allocator: BlockAllocator):
+        self.config = config
+        self.allocator = allocator
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+
+    # -- queue ops --------------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        if seq.num_prompt_tokens >= self.config.max_model_len:
+            raise ValueError(
+                f"prompt of {seq.num_prompt_tokens} tokens exceeds "
+                f"max_model_len={self.config.max_model_len}"
+            )
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        for q in (self.waiting, self.running):
+            for seq in list(q):
+                if seq.request_id == request_id:
+                    q.remove(seq)
+                    self._finish(seq, "abort")
+                    return seq
+        return None
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        self._finish(seq, reason)
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = reason
+        self.allocator.release_all(seq.block_ids)
+        seq.block_ids = []
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- the step ---------------------------------------------------------
+
+    def schedule(self) -> SchedulerOutput:
+        out = SchedulerOutput()
+        self._admit(out)
+
+        # Phase 1: sequences needing prompt (or post-preemption recompute)
+        # work get chunks, oldest first, bounded by the step token budget.
+        # A preempted sequence that already has outputs recomputes KV up to
+        # its last token exclusive — that token is re-processed by decode.
+        budget = self.config.max_prefill_tokens
+        for seq in list(self.running):
+            if budget <= 0:
+                break
+            if seq not in self.running:  # evicted by an earlier _ensure_blocks
+                continue
+            target = (
+                seq.num_prompt_tokens
+                if not seq.output_token_ids
+                else seq.num_tokens - 1
+            )
+            remaining = target - seq.num_computed_tokens
+            if remaining <= 0:
+                continue
+            chunk = min(remaining, budget)
+            start = seq.num_computed_tokens
+            end = start + chunk
+            if not self._ensure_blocks(seq, end, out):
+                continue
+            out.prefills.append(PrefillItem(seq=seq, start=start, end=end))
+            budget -= chunk
+        if out.prefills:
+            return out
+
+        # Phase 2: a decode burst for every running sequence. Burst length is
+        # bounded so no sequence writes KV past max_model_len; early stops
+        # are trimmed host-side (≤ n-1 wasted tokens per finishing request).
+        n = max(self.config.num_decode_steps, 1)
+        for seq in self.running:
+            n = min(n, max(self.config.max_model_len - seq.num_tokens, 1))
+            if seq.sampling.has_penalties:
+                n = 1  # penalties need per-token count updates host-side
+        for seq in list(self.running):
+            if seq not in self.running:  # lost pages to an earlier preemption
+                continue
+            if not self._ensure_blocks(
+                seq, seq.num_tokens + n - 1, out, protect=seq
+            ):
+                continue
+            out.decodes.append(seq)
+        out.n_decode_steps = n
+        return out
+
+    # -- internals --------------------------------------------------------
+
+    def _admit(self, out: SchedulerOutput) -> None:
+        while self.waiting and len(self.running) < self.config.max_num_seqs:
+            seq = self.waiting[0]
+            # Prefix-cache lookup at admission; never match the full token
+            # list — at least one token must be computed to produce logits.
+            # (all_token_ids, not just the prompt: a preempted-with-outputs
+            # sequence can re-match KV for its own generated tokens too.)
+            if not seq.block_ids:
+                toks = seq.all_token_ids
+                matchable = toks[: len(toks) - 1]
+                blocks, hashes = self.allocator.match_prefix(matchable)
+                if blocks:
+                    seq.adopt_cached_prefix(blocks, hashes)
+                    seq.num_computed_tokens = len(blocks) * self.allocator.block_size
+                    seq.num_cached_prompt_tokens = seq.num_computed_tokens
+            first_chunk = min(
+                seq.num_prompt_tokens - seq.num_computed_tokens,
+                self.config.max_prefill_tokens,
+            )
+            need = seq.blocks_needed(
+                seq.num_computed_tokens + first_chunk, self.allocator.block_size
+            )
+            if need > self.allocator.num_free:
+                break  # engine full; stays queued (vllm:num_requests_waiting)
+            self.waiting.popleft()
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+
+    def _ensure_blocks(
+        self,
+        seq: Sequence,
+        up_to_tokens: int,
+        out: SchedulerOutput,
+        protect: Optional[Sequence] = None,
+    ) -> bool:
+        """Allocate pages for ``seq`` up to ``up_to_tokens``, preempting the
+        youngest other sequence on exhaustion. False if ``seq`` itself lost."""
+        while True:
+            try:
+                for _ in range(seq.blocks_needed(up_to_tokens, self.allocator.block_size)):
+                    seq.block_ids.append(self.allocator.allocate())
+                return True
+            except NoFreeBlocksError:
+                victim = self._pick_victim(exclude=protect or seq)
+                if victim is None:
+                    # Nothing left to evict but this sequence itself.
+                    self._preempt(seq, out)
+                    return False
+                self._preempt(victim, out)
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        for seq in reversed(self.running):  # youngest first (vLLM policy)
+            if seq is not exclude:
+                return seq
+        return None
+
+    def _preempt(self, seq: Sequence, out: SchedulerOutput) -> None:
+        logger.warning("preempting request %s (out of KV pages)", seq.request_id)
+        if seq in self.running:
+            self.running.remove(seq)
+        # The victim may already have been granted work this step — revoke it
+        # (its pages are about to be surrendered).
+        out.decodes[:] = [s for s in out.decodes if s is not seq]
+        out.prefills[:] = [it for it in out.prefills if it.seq is not seq]
+        self.allocator.release_all(seq.block_ids)
+        seq.reset_for_recompute()
+        self.waiting.appendleft(seq)
+        out.preempted.append(seq)

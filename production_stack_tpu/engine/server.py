@@ -1,0 +1,712 @@
+"""OpenAI-compatible HTTP server for the TPU engine (`pst-engine`).
+
+This is the pod the stack deploys where the reference deploys the
+`vllm/vllm-openai` image (`helm/templates/deployment-vllm-multi.yaml:101-118`).
+Surface contract (everything the router, stats scraper, operator, and
+dashboards depend on — SURVEY.md §1 "Serving engine" row):
+
+- `/v1/models`, `/v1/chat/completions`, `/v1/completions` (SSE streaming),
+  `/v1/embeddings`, `/tokenize`, `/detokenize`, `/rerank`, `/v1/rerank`,
+  `/score`, `/v1/score`
+- `/metrics` with `vllm:`-prefixed gauge names the router's
+  `EngineStats.from_vllm_scrape` parses (reference `stats/engine_stats.py:63-76`)
+- `/health`, `/is_sleeping`, `/sleep`, `/wake_up` (tutorial 19 drain flow)
+- `/v1/load_lora_adapter`, `/v1/unload_lora_adapter` (operator LoRA flow,
+  `loraadapter_controller.go:582-611`)
+- `/version`
+
+Auth: optional `--api-key` (Bearer) mirroring the chart's vllmApiKey secret.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+import numpy as np
+from aiohttp import web
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from .. import __version__
+from ..logging_utils import init_logger
+from ..protocols import (
+    ChatCompletionRequest,
+    ChatMessage,
+    CompletionRequest,
+    EmbeddingRequest,
+    ErrorResponse,
+    random_id,
+)
+from .async_engine import AsyncLLMEngine
+from .config import EngineConfig
+from .sequence import SamplingParams
+
+logger = init_logger(__name__)
+
+
+def _error(message: str, status: int = 400, etype: str = "invalid_request_error"):
+    return web.json_response(
+        ErrorResponse(message=message, type=etype, code=status).model_dump(),
+        status=status,
+    )
+
+
+class EngineMetrics:
+    """Prometheus surface, `vllm:`-named for scraper/dashboard compatibility."""
+
+    def __init__(self, model: str):
+        self.registry = CollectorRegistry()
+        label = {"model_name": model}
+
+        def gauge(name, doc):
+            g = Gauge(name, doc, ["model_name"], registry=self.registry)
+            return g.labels(**label)
+
+        def counter(name, doc):
+            c = Counter(name, doc, ["model_name"], registry=self.registry)
+            return c.labels(**label)
+
+        def hist(name, doc, buckets):
+            h = Histogram(
+                name, doc, ["model_name"], registry=self.registry, buckets=buckets
+            )
+            return h.labels(**label)
+
+        self.running = gauge("vllm:num_requests_running", "running requests")
+        self.waiting = gauge("vllm:num_requests_waiting", "waiting requests")
+        self.swapped = gauge("vllm:num_requests_swapped", "preempted requests")
+        self.cache_usage = gauge(
+            "vllm:gpu_cache_usage_perc", "KV page usage (HBM)"
+        )
+        self.hit_rate = gauge(
+            "vllm:gpu_prefix_cache_hit_rate", "prefix cache hit rate"
+        )
+        self.hits = gauge(
+            "vllm:gpu_prefix_cache_hits_total", "prefix cache hit tokens"
+        )
+        self.queries = gauge(
+            "vllm:gpu_prefix_cache_queries_total", "prefix cache query tokens"
+        )
+        self.prompt_tokens = counter(
+            "vllm:prompt_tokens_total", "prompt tokens processed"
+        )
+        self.generation_tokens = counter(
+            "vllm:generation_tokens_total", "tokens generated"
+        )
+        self.ttft = hist(
+            "vllm:time_to_first_token_seconds",
+            "TTFT",
+            (0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4),
+        )
+        self.e2e = hist(
+            "vllm:e2e_request_latency_seconds",
+            "request latency",
+            (0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64),
+        )
+        self.success = counter("vllm:request_success_total", "finished requests")
+
+    def refresh(self, stats: dict) -> None:
+        self.running.set(stats["num_requests_running"])
+        self.waiting.set(stats["num_requests_waiting"])
+        self.swapped.set(stats["num_preemptions_total"])
+        self.cache_usage.set(stats["kv_cache_usage_perc"])
+        self.hit_rate.set(stats["prefix_cache_hit_rate"])
+        self.hits.set(stats["prefix_cache_hits_total"])
+        self.queries.set(stats["prefix_cache_queries_total"])
+
+
+def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
+    limit = max(max_model_len - prompt_len - 1, 1)
+    want = req.max_completion_tokens or req.max_tokens
+    return SamplingParams(
+        max_tokens=min(want, limit) if want else limit,
+        temperature=req.temperature,
+        top_p=req.top_p,
+        top_k=req.top_k,
+        min_p=req.min_p,
+        stop=req.stop,
+        stop_token_ids=tuple(req.stop_token_ids or ()),
+        ignore_eos=req.ignore_eos,
+        seed=req.seed,
+        presence_penalty=req.presence_penalty,
+        frequency_penalty=req.frequency_penalty,
+        repetition_penalty=req.repetition_penalty,
+    )
+
+
+def create_engine_app(
+    engine: AsyncLLMEngine, api_key: Optional[str] = None
+) -> web.Application:
+    app = web.Application(middlewares=[])
+    model_name = engine.engine.model_name
+    metrics = EngineMetrics(model_name)
+    lora_adapters: List[str] = []
+    app["engine"] = engine
+    app["metrics"] = metrics
+
+    # -- middleware-ish auth check ------------------------------------
+
+    # Everything except unauthenticated probe/scrape endpoints is guarded
+    # when --api-key is set (/sleep in particular is destructive).
+    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping"}
+
+    def check_auth(request: web.Request) -> Optional[web.Response]:
+        if api_key is None or request.path in _OPEN_PATHS:
+            return None
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {api_key}":
+            return _error("invalid API key", 401, "authentication_error")
+        return None
+
+    # -- model listing -------------------------------------------------
+
+    async def list_models(request: web.Request) -> web.Response:
+        if resp := check_auth(request):
+            return resp
+        now = int(time.time())
+        data = [
+            {"id": model_name, "object": "model", "created": now,
+             "owned_by": "production-stack-tpu", "root": None, "parent": None}
+        ] + [
+            {"id": a, "object": "model", "created": now,
+             "owned_by": "production-stack-tpu", "root": None, "parent": model_name}
+            for a in lora_adapters
+        ]
+        return web.json_response({"object": "list", "data": data})
+
+    # -- generation ----------------------------------------------------
+
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        if resp := check_auth(request):
+            return resp
+        try:
+            req = ChatCompletionRequest(**await request.json())
+        except Exception as e:  # noqa: BLE001
+            return _error(f"invalid request body: {e}")
+        if engine.sleeping:
+            return _error("engine is sleeping", 503, "service_unavailable")
+        prompt = engine.engine.tokenizer.apply_chat_template(req.messages)
+        return await _serve_generation(request, req, prompt, is_chat=True)
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        if resp := check_auth(request):
+            return resp
+        try:
+            req = CompletionRequest(**await request.json())
+        except Exception as e:  # noqa: BLE001
+            return _error(f"invalid request body: {e}")
+        if engine.sleeping:
+            return _error("engine is sleeping", 503, "service_unavailable")
+        prompt = req.prompt
+        # Normalize the four OpenAI prompt forms: str, [str, ...],
+        # [int, ...] (one tokenized prompt), [[int, ...], ...] (a batch).
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            prompt = [prompt]
+        prompts = prompt if isinstance(prompt, list) else [prompt]
+        if not prompts:
+            return _error("prompt must not be empty")
+        if len(prompts) == 1:
+            p = prompts[0]
+            if isinstance(p, list):
+                return await _serve_generation(
+                    request, req, None, is_chat=False, prompt_ids=p
+                )
+            return await _serve_generation(request, req, str(p), is_chat=False)
+        if req.stream:
+            return _error("streaming is not supported for batched prompts")
+        return await _serve_completion_batch(request, req, prompts)
+
+    async def _serve_completion_batch(
+        request: web.Request, req, prompts: List
+    ) -> web.Response:
+        """OpenAI batched completions: one choice per prompt, index-aligned."""
+        tok = engine.engine.tokenizer
+        max_len = engine.engine.cfg.max_model_len
+        created = int(time.time())
+        rid = random_id("cmpl")
+        start = time.time()
+
+        async def one(prompt) -> dict:
+            if isinstance(prompt, list):
+                try:
+                    ids = [int(x) for x in prompt]
+                except (TypeError, ValueError):
+                    return {"error": "prompt token ids must be integers", "ids": []}
+            else:
+                ids = tok.encode(str(prompt))
+            if len(ids) >= max_len:
+                return {"error": f"prompt has {len(ids)} tokens (max {max_len})",
+                        "ids": ids}
+            sampling = build_sampling(req, max_len, len(ids))
+            parts, n_out, finish = [], 0, None
+            async for out in engine.generate(prompt_token_ids=ids, sampling=sampling):
+                parts.append(out.text_delta)
+                n_out = out.num_output_tokens
+                finish = out.finish_reason or finish
+                if out.num_output_tokens == 1 and out.ttft is not None:
+                    metrics.ttft.observe(out.ttft)
+            return {"text": "".join(parts), "n_in": len(ids), "n_out": n_out,
+                    "finish": finish}
+
+        results = await asyncio.gather(*(one(p) for p in prompts))
+        if any("error" in r for r in results):
+            return _error(next(r["error"] for r in results if "error" in r))
+        usage = {
+            "prompt_tokens": sum(r["n_in"] for r in results),
+            "completion_tokens": sum(r["n_out"] for r in results),
+            "total_tokens": sum(r["n_in"] + r["n_out"] for r in results),
+        }
+        metrics.e2e.observe(time.time() - start)
+        metrics.success.inc()
+        metrics.prompt_tokens.inc(usage["prompt_tokens"])
+        metrics.generation_tokens.inc(usage["completion_tokens"])
+        return web.json_response(
+            {
+                "id": rid, "object": "text_completion", "created": created,
+                "model": req.model,
+                "choices": [
+                    {"index": i, "text": r["text"], "logprobs": None,
+                     "finish_reason": r["finish"]}
+                    for i, r in enumerate(results)
+                ],
+                "usage": usage,
+            },
+            headers={"X-Request-Id": rid},
+        )
+
+    async def _serve_generation(
+        request: web.Request,
+        req,
+        prompt: Optional[str],
+        is_chat: bool,
+        prompt_ids: Optional[List[int]] = None,
+    ) -> web.StreamResponse:
+        tok = engine.engine.tokenizer
+        if prompt_ids is not None:
+            try:  # malformed ids must 400 here, not poison the step thread
+                ids = [int(x) for x in prompt_ids]
+            except (TypeError, ValueError):
+                return _error("prompt token ids must be integers")
+        else:
+            ids = tok.encode(prompt or "")
+        max_len = engine.engine.cfg.max_model_len
+        if len(ids) >= max_len:
+            return _error(
+                f"prompt has {len(ids)} tokens, exceeds max_model_len={max_len}"
+            )
+        sampling = build_sampling(req, max_len, len(ids))
+        rid = random_id("chatcmpl" if is_chat else "cmpl")
+        created = int(time.time())
+        start = time.time()
+        obj = "chat.completion.chunk" if is_chat else "text_completion"
+
+        gen = engine.generate(
+            prompt_token_ids=ids, sampling=sampling, request_id=rid
+        )
+
+        if req.stream:
+            resp = web.StreamResponse(status=200)
+            resp.headers["Content-Type"] = "text/event-stream"
+            resp.headers["Cache-Control"] = "no-cache"
+            resp.headers["X-Request-Id"] = rid
+            await resp.prepare(request)
+            n_out = 0
+            try:
+                if is_chat:
+                    first = {
+                        "id": rid, "object": obj, "created": created,
+                        "model": req.model,
+                        "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                     "finish_reason": None}],
+                    }
+                    await resp.write(f"data: {json.dumps(first)}\n\n".encode())
+                async for out in gen:
+                    n_out = out.num_output_tokens
+                    if out.num_output_tokens == 1 and out.ttft is not None:
+                        metrics.ttft.observe(out.ttft)
+                    if is_chat:
+                        delta = {"content": out.text_delta} if out.text_delta else {}
+                        choice = {"index": 0, "delta": delta,
+                                  "finish_reason": out.finish_reason}
+                    else:
+                        choice = {"index": 0, "text": out.text_delta,
+                                  "logprobs": None,
+                                  "finish_reason": out.finish_reason}
+                    chunk = {"id": rid, "object": obj, "created": created,
+                             "model": req.model, "choices": [choice]}
+                    if out.finished and getattr(req, "stream_options", None) and (
+                        req.stream_options or {}
+                    ).get("include_usage"):
+                        chunk["usage"] = {
+                            "prompt_tokens": len(ids),
+                            "completion_tokens": n_out,
+                            "total_tokens": len(ids) + n_out,
+                        }
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionResetError, asyncio.CancelledError):
+                await engine.abort(rid)
+                raise
+            metrics.e2e.observe(time.time() - start)
+            metrics.success.inc()
+            metrics.prompt_tokens.inc(len(ids))
+            metrics.generation_tokens.inc(n_out)
+            await resp.write_eof()
+            return resp
+
+        # Non-streaming: accumulate.
+        text_parts: List[str] = []
+        token_ids: List[int] = []
+        finish_reason = None
+        try:
+            async for out in gen:
+                if out.num_output_tokens == 1 and out.ttft is not None:
+                    metrics.ttft.observe(out.ttft)
+                text_parts.append(out.text_delta)
+                token_ids.extend(out.new_token_ids)
+                finish_reason = out.finish_reason or finish_reason
+        except asyncio.CancelledError:
+            await engine.abort(rid)
+            raise
+        text = "".join(text_parts)
+        usage = {
+            "prompt_tokens": len(ids),
+            "completion_tokens": len(token_ids),
+            "total_tokens": len(ids) + len(token_ids),
+        }
+        metrics.e2e.observe(time.time() - start)
+        metrics.success.inc()
+        metrics.prompt_tokens.inc(len(ids))
+        metrics.generation_tokens.inc(len(token_ids))
+        if is_chat:
+            payload = {
+                "id": rid, "object": "chat.completion", "created": created,
+                "model": req.model,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant", "content": text},
+                             "logprobs": None, "finish_reason": finish_reason}],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid, "object": "text_completion", "created": created,
+                "model": req.model,
+                "choices": [{"index": 0, "text": text, "logprobs": None,
+                             "finish_reason": finish_reason}],
+                "usage": usage,
+            }
+        return web.json_response(payload, headers={"X-Request-Id": rid})
+
+    # -- embeddings / rerank / score ----------------------------------
+
+    async def embeddings(request: web.Request) -> web.Response:
+        if resp := check_auth(request):
+            return resp
+        try:
+            req = EmbeddingRequest(**await request.json())
+        except Exception as e:  # noqa: BLE001
+            return _error(f"invalid request body: {e}")
+        tok = engine.engine.tokenizer
+        inputs = req.input if isinstance(req.input, list) else [req.input]
+        if inputs and isinstance(inputs[0], int):
+            inputs = [inputs]  # single token-id list
+        data = []
+        total_tokens = 0
+        for i, item in enumerate(inputs):
+            ids = item if isinstance(item, list) else tok.encode(str(item))
+            total_tokens += len(ids)
+            vec = await asyncio.get_event_loop().run_in_executor(
+                None, engine.engine.runner.encode, ids
+            )
+            data.append(
+                {"object": "embedding", "index": i, "embedding": vec.tolist()}
+            )
+        return web.json_response(
+            {
+                "object": "list", "data": data, "model": req.model,
+                "usage": {"prompt_tokens": total_tokens,
+                          "total_tokens": total_tokens},
+            }
+        )
+
+    async def _similarity(texts_a: List[str], texts_b: List[str]) -> List[float]:
+        loop = asyncio.get_event_loop()
+        tok = engine.engine.tokenizer
+
+        async def emb(t: str):
+            return await loop.run_in_executor(
+                None, engine.engine.runner.encode, tok.encode(t)
+            )
+
+        scores = []
+        for a, b in zip(texts_a, texts_b):
+            va, vb = await emb(a), await emb(b)
+            scores.append(float(np.dot(va, vb)))
+        return scores
+
+    async def rerank(request: web.Request) -> web.Response:
+        body = await request.json()
+        query = body.get("query", "")
+        docs = body.get("documents", [])
+        top_n = body.get("top_n") or len(docs)
+        scores = await _similarity([query] * len(docs), docs)
+        order = sorted(range(len(docs)), key=lambda i: -scores[i])[:top_n]
+        return web.json_response(
+            {
+                "id": random_id("rerank"),
+                "model": body.get("model", model_name),
+                "results": [
+                    {"index": i, "document": {"text": docs[i]},
+                     "relevance_score": scores[i]}
+                    for i in order
+                ],
+            }
+        )
+
+    async def score(request: web.Request) -> web.Response:
+        body = await request.json()
+        t1 = body.get("text_1", "")
+        t2 = body.get("text_2", "")
+        l1 = t1 if isinstance(t1, list) else [t1]
+        l2 = t2 if isinstance(t2, list) else [t2]
+        if len(l1) == 1 and len(l2) > 1:
+            l1 = l1 * len(l2)
+        scores = await _similarity(l1, l2)
+        return web.json_response(
+            {
+                "id": random_id("score"),
+                "object": "list",
+                "model": body.get("model", model_name),
+                "data": [
+                    {"index": i, "object": "score", "score": s}
+                    for i, s in enumerate(scores)
+                ],
+                "usage": {},
+            }
+        )
+
+    # -- tokenize ------------------------------------------------------
+
+    async def tokenize(request: web.Request) -> web.Response:
+        body = await request.json()
+        tok = engine.engine.tokenizer
+        if body.get("messages"):
+            msgs = [ChatMessage(**m) for m in body["messages"]]
+            text = tok.apply_chat_template(msgs)
+        else:
+            text = body.get("prompt") or ""
+        ids = tok.encode(text, add_special_tokens=body.get("add_special_tokens", True))
+        return web.json_response(
+            {"tokens": ids, "count": len(ids),
+             "max_model_len": engine.engine.cfg.max_model_len}
+        )
+
+    async def detokenize(request: web.Request) -> web.Response:
+        body = await request.json()
+        text = engine.engine.tokenizer.decode(body.get("tokens", []))
+        return web.json_response({"prompt": text})
+
+    # -- admin / health ------------------------------------------------
+
+    async def health(request: web.Request) -> web.Response:
+        if engine.is_healthy():
+            return web.json_response({"status": "ok"})
+        return web.json_response(
+            {"status": "unhealthy", "error": engine.step_error}, status=503
+        )
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        metrics.refresh(engine.engine.stats())
+        return web.Response(
+            body=generate_latest(metrics.registry),
+            content_type="text/plain",
+        )
+
+    async def is_sleeping(request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": engine.sleeping})
+
+    async def sleep(request: web.Request) -> web.Response:
+        level = int(request.query.get("level", "1"))
+        engine.sleep(level)
+        return web.json_response({"status": "sleeping", "level": level})
+
+    async def wake_up(request: web.Request) -> web.Response:
+        engine.wake_up()
+        return web.json_response({"status": "awake"})
+
+    async def load_lora(request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if not name:
+            return _error("lora_name required")
+        if name not in lora_adapters:
+            lora_adapters.append(name)
+        return web.json_response({"status": "ok"})
+
+    async def unload_lora(request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name in lora_adapters:
+            lora_adapters.remove(name)
+        return web.json_response({"status": "ok"})
+
+    async def version(request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/rerank", rerank)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/v2/rerank", rerank)
+    app.router.add_post("/score", score)
+    app.router.add_post("/v1/score", score)
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/is_sleeping", is_sleeping)
+    app.router.add_post("/sleep", sleep)
+    app.router.add_post("/wake_up", wake_up)
+    app.router.add_post("/v1/load_lora_adapter", load_lora)
+    app.router.add_post("/v1/unload_lora_adapter", unload_lora)
+    app.router.add_get("/version", version)
+    return app
+
+
+def parse_engine_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="production-stack-tpu serving engine (vllm-serve analogue)"
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--model", default="tiny-llama-debug")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--block-size", type=int, default=32)
+    p.add_argument("--num-kv-blocks", type=int, default=None)
+    p.add_argument(
+        "--gpu-memory-utilization", "--hbm-utilization",
+        dest="hbm_utilization", type=float, default=0.9,
+    )
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument(
+        "--max-num-batched-tokens", dest="max_prefill_tokens", type=int, default=2048
+    )
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--kv-cache-dtype", default=None)
+    p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
+    p.add_argument("--enable-prefix-caching", action="store_true", default=True)
+    p.add_argument(
+        "--no-enable-prefix-caching", dest="enable_prefix_caching",
+        action="store_false",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--api-key", default=None)
+    # KV tiering / controller (LMCache env-var analogues).
+    p.add_argument("--cpu-offload-blocks", type=int, default=0)
+    p.add_argument("--remote-kv-url", default=None)
+    p.add_argument("--cache-controller-url", default=None)
+    p.add_argument("--engine-url", default=None)
+    p.add_argument(
+        "--kv-role", default="none",
+        choices=["none", "producer", "consumer", "both"],
+    )
+    return p.parse_args(argv)
+
+
+def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
+    return EngineConfig(
+        model=args.model,
+        tokenizer=args.tokenizer,
+        served_model_name=args.served_model_name,
+        max_model_len=args.max_model_len,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        hbm_utilization=args.hbm_utilization,
+        max_num_seqs=args.max_num_seqs,
+        max_prefill_tokens=args.max_prefill_tokens,
+        tensor_parallel_size=args.tensor_parallel_size,
+        kv_cache_dtype=args.kv_cache_dtype,
+        attn_impl=args.attn_impl,
+        enable_prefix_caching=args.enable_prefix_caching,
+        seed=args.seed,
+        cpu_offload_blocks=args.cpu_offload_blocks,
+        remote_kv_url=args.remote_kv_url,
+        cache_controller_url=args.cache_controller_url,
+        engine_url=args.engine_url,
+        kv_role=args.kv_role,
+    )
+
+
+async def controller_report_loop(
+    engine: AsyncLLMEngine, controller_url: str, engine_url: str, interval: float
+) -> None:
+    """Snapshot-register resident chunk hashes with the cache controller
+    (LMCACHE controller heartbeat analogue; feeds KV-aware routing)."""
+    import aiohttp
+
+    model = engine.engine.model_name
+    while True:
+        try:
+            eng = engine.engine
+            cutoff = time.time() - eng.CHUNK_CLAIM_TTL
+            hashes = [
+                h for h, t in list(eng.resident_chunk_hashes.items()) if t >= cutoff
+            ]
+            async with aiohttp.ClientSession() as sess:
+                await sess.post(
+                    f"{controller_url.rstrip('/')}/register",
+                    json={
+                        "url": engine_url,
+                        "model": model,
+                        "hashes": hashes,
+                        "replace": True,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=5),
+                )
+        except Exception as e:  # noqa: BLE001 — registration is best-effort
+            logger.debug("controller registration failed: %s", e)
+        await asyncio.sleep(interval)
+
+
+def main(argv=None) -> None:
+    args = parse_engine_args(argv)
+    cfg = engine_config_from_args(args)
+    engine = AsyncLLMEngine(cfg)
+    app = create_engine_app(engine, api_key=args.api_key)
+
+    async def on_startup(app):
+        engine.start(asyncio.get_event_loop())
+        if cfg.cache_controller_url:
+            engine_url = cfg.engine_url or f"http://{args.host}:{args.port}"
+            app["controller_task"] = asyncio.create_task(
+                controller_report_loop(
+                    engine, cfg.cache_controller_url, engine_url, 10.0
+                )
+            )
+
+    async def on_cleanup(app):
+        task = app.get("controller_task")
+        if task:
+            task.cancel()
+        engine.shutdown()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,8 +34,10 @@ def chunk_hashes(token_ids: Sequence[int], chunk_tokens: int = CHUNK_TOKENS) -> 
     for i in range(n_full):
         h = xxhash.xxh64(arr[i * chunk_tokens : (i + 1) * chunk_tokens].tobytes())
         h.update(prev.to_bytes(8, "little"))
-        prev = h.intdigest()
-        out.append(prev & 0x7FFF_FFFF_FFFF_FFFF)
+        # Chain on the *returned* (masked) value so incremental callers can
+        # resume from any emitted hash and land on the identical chain.
+        prev = h.intdigest() & 0x7FFF_FFFF_FFFF_FFFF
+        out.append(prev)
     return out
 
 
@@ -55,6 +57,6 @@ def block_hashes(
     for i in range(n_full):
         h = xxhash.xxh64(arr[i * block_size : (i + 1) * block_size].tobytes())
         h.update(prev.to_bytes(8, "little", signed=False))
-        prev = h.intdigest()
-        out.append(prev & 0x7FFF_FFFF_FFFF_FFFF)
+        prev = h.intdigest() & 0x7FFF_FFFF_FFFF_FFFF  # chain == emitted value
+        out.append(prev)
     return out

@@ -1,0 +1,135 @@
+"""Cache controller (`pst-kv-controller`): fleet-wide KV location index.
+
+The role LMCache's controller plays for the reference's KV-aware routing
+(`routing_logic.py:287-299` sends a `LookupMsg`; the Go picker hits `/lookup`
+HTTP — `kv_aware_picker.go:92-133`). Engines periodically report the chunk
+hashes their caches hold; the router asks which engine holds the longest
+prefix of a prompt's chunk hashes.
+
+Endpoints:
+  POST /register    {"url", "model", "hashes": [...], "replace": bool}
+  POST /deregister  {"url"}
+  POST /lookup      {"model", "hashes": [...]} →
+                    {"matches": {url: matched_token_count}}
+  GET  /instances   debug listing
+  GET  /health
+
+Matching walks the prompt's chunk-hash chain in order and counts consecutive
+chunks present per engine — chunk hashes commit to their full prefix
+(kvcache/hashing.py), so presence of chunk i implies content-equality of
+everything before it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict, Set
+
+from aiohttp import web
+
+from ..kvcache.hashing import CHUNK_TOKENS
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class ControllerState:
+    def __init__(self, instance_ttl: float = 120.0):
+        # model -> url -> set(chunk hashes)
+        self.instances: Dict[str, Dict[str, Set[int]]] = {}
+        self.last_seen: Dict[str, float] = {}
+        self.instance_ttl = instance_ttl
+
+    def register(self, url: str, model: str, hashes, replace: bool) -> None:
+        per_model = self.instances.setdefault(model, {})
+        if replace or url not in per_model:
+            per_model[url] = set()
+        per_model[url].update(int(h) for h in hashes)
+        self.last_seen[url] = time.time()
+
+    def deregister(self, url: str) -> None:
+        for per_model in self.instances.values():
+            per_model.pop(url, None)
+        self.last_seen.pop(url, None)
+
+    def expire(self) -> None:
+        cutoff = time.time() - self.instance_ttl
+        stale = [u for u, t in self.last_seen.items() if t < cutoff]
+        for u in stale:
+            self.deregister(u)
+
+    def lookup(self, model: str, hashes) -> Dict[str, int]:
+        self.expire()
+        per_model = self.instances.get(model) or {}
+        matches: Dict[str, int] = {}
+        for url, have in per_model.items():
+            n = 0
+            for h in hashes:
+                if int(h) in have:
+                    n += 1
+                else:
+                    break
+            if n:
+                matches[url] = n * CHUNK_TOKENS
+        return matches
+
+
+def create_controller_app(instance_ttl: float = 120.0) -> web.Application:
+    state = ControllerState(instance_ttl)
+    app = web.Application()
+    app["state"] = state
+
+    async def register(request: web.Request) -> web.Response:
+        body = await request.json()
+        state.register(
+            body["url"],
+            body.get("model", ""),
+            body.get("hashes", []),
+            bool(body.get("replace", False)),
+        )
+        return web.json_response({"status": "ok"})
+
+    async def deregister(request: web.Request) -> web.Response:
+        body = await request.json()
+        state.deregister(body["url"])
+        return web.json_response({"status": "ok"})
+
+    async def lookup(request: web.Request) -> web.Response:
+        body = await request.json()
+        matches = state.lookup(body.get("model", ""), body.get("hashes", []))
+        return web.json_response({"matches": matches})
+
+    async def instances(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                model: {url: len(hashes) for url, hashes in per_model.items()}
+                for model, per_model in state.instances.items()
+            }
+        )
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/register", register)
+    app.router.add_post("/deregister", deregister)
+    app.router.add_post("/lookup", lookup)
+    app.router.add_get("/instances", instances)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="production-stack-tpu KV cache controller")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--instance-ttl", type=float, default=120.0)
+    args = p.parse_args(argv)
+    web.run_app(
+        create_controller_app(args.instance_ttl),
+        host=args.host, port=args.port, access_log=None,
+    )
+
+
+if __name__ == "__main__":
+    main()

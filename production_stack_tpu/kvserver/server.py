@@ -1,0 +1,122 @@
+"""Remote KV block store (`pst-kv-server`) — the LMCache-server analogue.
+
+Reference: the cache-server Deployment running `lmcache_experimental_server`
+(`helm/templates/deployment-cache-server.yaml:31-43`), which engines reach
+over TCP with a serde format. Here: an aiohttp server speaking the page serde
+of :mod:`production_stack_tpu.engine.cache_tiering` over HTTP (TCP/DCN), with
+a byte-capacity LRU.
+
+Endpoints:
+  PUT  /blocks/{hash}     store one page (raw serde body)
+  GET  /blocks/{hash}     fetch one page (404 if absent)
+  POST /contains          {"hashes": [...]} → {"present": [bool, ...]}
+  GET  /stats             occupancy/bytes/hit counters
+  GET  /health
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+from typing import Optional
+
+from aiohttp import web
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlockStore:
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._blocks: "collections.OrderedDict[int, bytes]" = collections.OrderedDict()
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def put(self, h: int, data: bytes) -> None:
+        if len(data) > self.max_bytes:
+            return  # unstorable; never evict the fleet's cache trying
+        if h in self._blocks:
+            self.bytes_used -= len(self._blocks.pop(h))
+        while self._blocks and self.bytes_used + len(data) > self.max_bytes:
+            _, old = self._blocks.popitem(last=False)
+            self.bytes_used -= len(old)
+            self.evictions += 1
+        self._blocks[h] = data
+        self.bytes_used += len(data)
+
+    def get(self, h: int) -> Optional[bytes]:
+        data = self._blocks.get(h)
+        if data is None:
+            self.misses += 1
+            return None
+        self._blocks.move_to_end(h)
+        self.hits += 1
+        return data
+
+    def contains(self, h: int) -> bool:
+        return h in self._blocks
+
+
+def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
+    store = BlockStore(max_bytes)
+    app = web.Application(client_max_size=256 << 20)
+    app["store"] = store
+
+    async def put_block(request: web.Request) -> web.Response:
+        h = int(request.match_info["hash"])
+        store.put(h, await request.read())
+        return web.json_response({"status": "ok"})
+
+    async def get_block(request: web.Request) -> web.Response:
+        data = store.get(int(request.match_info["hash"]))
+        if data is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    async def contains(request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response(
+            {"present": [store.contains(int(h)) for h in body.get("hashes", [])]}
+        )
+
+    async def stats(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "num_blocks": len(store._blocks),
+                "bytes_used": store.bytes_used,
+                "max_bytes": store.max_bytes,
+                "hits": store.hits,
+                "misses": store.misses,
+                "evictions": store.evictions,
+            }
+        )
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_put("/blocks/{hash}", put_block)
+    app.router.add_get("/blocks/{hash}", get_block)
+    app.router.add_post("/contains", contains)
+    app.router.add_get("/stats", stats)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="production-stack-tpu remote KV store")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--max-bytes", type=int, default=8 << 30)
+    args = p.parse_args(argv)
+    web.run_app(
+        create_kv_server_app(args.max_bytes),
+        host=args.host, port=args.port, access_log=None,
+    )
+
+
+if __name__ == "__main__":
+    main()

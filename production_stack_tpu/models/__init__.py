@@ -1,0 +1,2 @@
+from .llama import LlamaConfig, Llama  # noqa: F401
+from .registry import get_model_config, PRESETS  # noqa: F401

@@ -1,0 +1,118 @@
+"""Model registry: presets for known architectures + HF-dir resolution.
+
+The reference selects models by HF id passed to ``vllm serve``
+(`deployment-vllm-multi.yaml:101-118`). Here a model is either a local HF
+directory (config.json + safetensors, loaded zero-egress) or a named preset
+(random-init — used by tests, benchmarks, and the fake fleet).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+from .llama import LlamaConfig, config_from_hf_json
+
+# Architecture presets. Shapes match the public configs of each family so
+# perf numbers are honest; weights are random-init unless an HF dir is given.
+PRESETS: Dict[str, LlamaConfig] = {
+    # Tiny debug model for unit tests / CPU-mesh e2e (heads divisible by 8
+    # so every tp degree the test mesh uses divides cleanly).
+    "tiny-llama-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=8,
+        head_dim=16,
+        max_position_embeddings=2048,
+        name="tiny-llama-debug",
+        eos_token_ids=(0,),
+        bos_token_id=None,
+        dtype="float32",
+    ),
+    # ~1B-class model: single-chip bench workhorse.
+    "llama-1b": LlamaConfig(
+        vocab_size=32768,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        name="llama-1b",
+        eos_token_ids=(2,),
+    ),
+    # Llama-3-8B shapes (the BASELINE.md flagship target).
+    "llama-3-8b": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=131072,
+        name="llama-3-8b",
+        eos_token_ids=(128001, 128009),
+        bos_token_id=128000,
+    ),
+    # Llama-3-70B shapes (pipeline-parallel multi-host config ladder rung 5).
+    "llama-3-70b": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=131072,
+        name="llama-3-70b",
+        eos_token_ids=(128001, 128009),
+        bos_token_id=128000,
+    ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        name="mistral-7b",
+        eos_token_ids=(2,),
+    ),
+    "qwen2-7b": LlamaConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        attention_bias=True,
+        name="qwen2-7b",
+        eos_token_ids=(151645, 151643),
+        bos_token_id=None,
+    ),
+}
+
+
+def get_model_config(model: str) -> LlamaConfig:
+    """Resolve ``model`` to a config: preset name or local HF directory."""
+    if model in PRESETS:
+        return PRESETS[model]
+    cfg_path = os.path.join(model, "config.json")
+    if os.path.isfile(cfg_path):
+        return config_from_hf_json(cfg_path, name=model)
+    raise ValueError(
+        f"unknown model {model!r}: not a preset "
+        f"({', '.join(sorted(PRESETS))}) and no local HF dir found"
+    )

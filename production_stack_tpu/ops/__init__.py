@@ -1,0 +1,1 @@
+from .attention import paged_attention  # noqa: F401

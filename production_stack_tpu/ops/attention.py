@@ -1,0 +1,107 @@
+"""Paged attention over a block-table KV cache.
+
+This is the op the reference delegates to vLLM's CUDA PagedAttention; here it
+is TPU-native with two interchangeable implementations:
+
+- ``gather``: pure-XLA. Gathers the sequence's KV pages into a contiguous
+  ``[B, S, KH, hd]`` view and runs masked attention. Compiles everywhere
+  (including the 8-device virtual CPU mesh used in tests) and XLA fuses the
+  mask/softmax chain; the gather materialization costs HBM bandwidth.
+- ``pallas``: a TPU kernel that streams pages HBM→VMEM per (batch, kv-head)
+  grid cell without materializing the gathered KV
+  (:mod:`production_stack_tpu.ops.paged_attention_pallas`).
+
+Shapes (one layer):
+  q                [B, T, H, hd]   T=1 for decode rows, T=chunk for prefill
+  k_pages/v_pages  [KH, nb, bs, hd] (pages contiguous per kv head)
+  block_tables     [B, W] int32    page ids per sequence (W*bs >= kv_len)
+  kv_lens          [B]   int32     valid KV length per sequence
+  q_positions      [B, T] int32    absolute position of each query token
+                                   (padding rows may hold any value; they are
+                                   masked out downstream via last_idx/sampling)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("PST_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    q_positions: jax.Array,
+    *,
+    scale: float,
+    impl: str = "auto",
+) -> jax.Array:
+    """Causal attention of ``q`` against paged KV. Returns [B, T, H, hd]."""
+    if impl == "auto":
+        impl = "pallas" if _use_pallas() else "gather"
+    if impl == "pallas":
+        from .paged_attention_pallas import pallas_paged_attention
+
+        return pallas_paged_attention(
+            q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+        )
+    return gather_paged_attention(
+        q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+    )
+
+
+def gather_paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    q_positions: jax.Array,
+    *,
+    scale: float,
+) -> jax.Array:
+    B, T, H, hd = q.shape
+    KH, nb, bs, _ = k_pages.shape
+    W = block_tables.shape[1]
+    S = W * bs
+    G = H // KH
+
+    # [KH, B, W, bs, hd] -> [KH, B, S, hd]. Out-of-range table entries are
+    # clipped by XLA gather semantics; they are masked below anyway.
+    k = k_pages[:, block_tables].reshape(KH, B, S, hd)
+    v = v_pages[:, block_tables].reshape(KH, B, S, hd)
+
+    qg = q.reshape(B, T, KH, G, hd)
+    # scores [B, KH, G, T, S]
+    scores = jnp.einsum(
+        "btkgd,kbsd->bkgts", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+
+    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    valid = kv_pos < kv_lens[:, None]  # [B, S]
+    causal = kv_pos[:, None, :] <= q_positions[..., None]  # [B, T, S]
+    mask = (valid[:, None, :] & causal)[:, None, None]  # [B, 1, 1, T, S]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,kbsd->btkgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, T, H, hd).astype(q.dtype)

@@ -1,0 +1,156 @@
+"""Pallas TPU flash-decode kernel over paged KV.
+
+The hot op of the serving loop (the role vLLM's CUDA PagedAttention kernel
+plays behind the reference stack). Decode attention is HBM-bandwidth-bound:
+the win over the gather fallback is that pages stream HBM→VMEM per grid cell
+and are reduced online (flash accumulation) — the gathered KV never
+materializes in HBM.
+
+Layout: KV pages are ``[KH, nb, bs, hd]`` (contiguous ``[bs, hd]`` tiles, the
+TPU-tiling-legal arrangement). Grid ``(B, KH, W)``; each cell loads one page
+for one kv-head and folds it into fp32 flash accumulators held in VMEM
+scratch. Page indices come from the block table via scalar prefetch
+(``PrefetchScalarGridSpec``) so the pipeline can address HBM pages ahead of
+the body. The last grid step normalizes and writes ``[G, hd]``.
+
+Used for decode (``T == 1``); prefill chunks take the gather path where the
+big matmuls already keep the MXU busy.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret() -> bool:
+    return bool(os.environ.get("PST_FORCE_PALLAS_INTERPRET"))
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # [B, W] int32 (SMEM)
+    lens_ref,  # [B] int32 (SMEM)
+    # blocked operands
+    q_ref,  # [1, 1, G, hd]
+    k_ref,  # [1, 1, bs, hd]
+    v_ref,  # [1, 1, bs, hd]
+    o_ref,  # [1, 1, G, hd]
+    # scratch
+    m_ref,  # [G, 128] fp32 (col 0 live)
+    l_ref,  # [G, 128] fp32 (col 0 live)
+    acc_ref,  # [G, hd] fp32
+    *,
+    scale: float,
+    block_size: int,
+):
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+    n_w = pl.num_programs(2)
+
+    @pl.when(w == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = lens_ref[b]
+
+    @pl.when(w * block_size < kv_len)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, bs]
+        kv_pos = w * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        s = jnp.where(kv_pos < kv_len, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)  # [G, 1]
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:, :1] = m_new
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(w == n_w - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-20)
+        ).astype(o_ref.dtype)
+
+
+def _decode_call(q4, k_pages, v_pages, block_tables, kv_lens, *, scale):
+    B, KH, G, hd = q4.shape
+    _, nb, bs, _ = k_pages.shape
+    W = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KH, W),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, w, t, l: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, hd), lambda b, h, w, t, l: (h, t[b, w], 0, 0)),
+            pl.BlockSpec((1, 1, bs, hd), lambda b, h, w, t, l: (h, t[b, w], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, w, t, l: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, hd), q4.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(block_tables, kv_lens, q4, k_pages, v_pages)
+
+
+def pallas_paged_attention(
+    q: jax.Array,  # [B, T, H, hd] — T must be 1 (decode)
+    k_pages: jax.Array,  # [KH, nb, bs, hd]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, W]
+    kv_lens: jax.Array,  # [B]
+    q_positions: jax.Array,  # unused for decode (kv_lens carries causality)
+    *,
+    scale: float,
+) -> jax.Array:
+    B, T, H, hd = q.shape
+    if T != 1:
+        from .attention import gather_paged_attention
+
+        return gather_paged_attention(
+            q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+        )
+    KH = k_pages.shape[0]
+    G = H // KH
+    q4 = q[:, 0].reshape(B, KH, G, hd)
+    out = _decode_call(
+        q4,
+        k_pages,
+        v_pages,
+        block_tables.astype(jnp.int32),
+        kv_lens.astype(jnp.int32),
+        scale=scale,
+    )
+    return out.reshape(B, 1, H, hd)

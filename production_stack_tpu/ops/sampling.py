@@ -1,0 +1,84 @@
+"""On-device token sampling: temperature / top-k / top-p / min-p + penalties.
+
+Runs inside the engine's jitted step so logits never leave the device (only
+the sampled token ids — ``[B]`` int32 — cross to host). Truncated to the top
+``SAMPLE_K_CAP`` logits before filtering: exact for any vocab when the cap
+covers it, and the standard serving approximation for 100k+ vocabs (mass
+outside the top-256 is negligible post-temperature).
+
+Greedy rows (temperature ≈ 0) take a pure argmax of the raw logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+SAMPLE_K_CAP = 256
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    temps: jax.Array,  # [B]
+    top_ps: jax.Array,  # [B]
+    top_ks: jax.Array,  # [B] int32 (<=0: disabled)
+    min_ps: jax.Array,  # [B]
+    seeds: jax.Array,  # [B] uint32 (per-seq, per-step)
+) -> jax.Array:
+    B, V = logits.shape
+    K = min(V, SAMPLE_K_CAP)
+    greedy = temps <= 1e-5
+    t = jnp.maximum(temps, 1e-5)[:, None]
+
+    vals, idxs = jax.lax.top_k(logits, K)  # [B, K] descending
+    scaled = vals / t
+    probs = jax.nn.softmax(scaled, axis=-1)
+
+    col = jnp.arange(K, dtype=jnp.int32)[None, :]
+    kk = jnp.where(top_ks <= 0, K, jnp.minimum(top_ks, K))[:, None]
+    keep = col < kk
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_ps[:, None]  # keep first token crossing top_p
+    keep &= probs >= min_ps[:, None] * probs[:, :1]
+    keep = keep.at[:, 0].set(True)
+
+    def one(seed, row, mask):
+        g = jax.random.gumbel(jax.random.PRNGKey(seed), (K,), jnp.float32)
+        return jnp.argmax(jnp.where(mask, row + g, _NEG))
+
+    choice = jax.vmap(one)(seeds, scaled, keep)  # [B]
+    sampled = jnp.take_along_axis(idxs, choice[:, None], axis=1)[:, 0]
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] float32
+    prompt_tokens: jax.Array,  # [B, Pp] int32, pad = V (dropped)
+    output_tokens: jax.Array,  # [B, Po] int32, pad = V (dropped)
+    presence: jax.Array,  # [B]
+    frequency: jax.Array,  # [B]
+    repetition: jax.Array,  # [B]
+) -> jax.Array:
+    """vLLM-convention penalties: repetition over prompt+output occurrence;
+    presence/frequency over output counts."""
+    B, V = logits.shape
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    out_counts = (
+        jnp.zeros((B, V), jnp.float32)
+        .at[rows, output_tokens]
+        .add(1.0, mode="drop")
+    )
+    prompt_seen = (
+        jnp.zeros((B, V), jnp.bool_)
+        .at[rows, prompt_tokens]
+        .set(True, mode="drop")
+    )
+    seen = prompt_seen | (out_counts > 0)
+    rep = repetition[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    )
+    logits = logits - frequency[:, None] * out_counts
+    logits = logits - presence[:, None] * (out_counts > 0).astype(jnp.float32)
+    return logits

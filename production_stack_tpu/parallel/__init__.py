@@ -1,0 +1,10 @@
+from .mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_PIPELINE,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+)

@@ -1,0 +1,115 @@
+"""Device-mesh construction for the TPU serving engine.
+
+The reference stack expresses parallelism as vLLM/Ray/NCCL configuration
+(``helm/templates/deployment-vllm-multi.yaml:155-158`` tensor parallel,
+``helm/templates/ray-cluster.yaml:560-566`` pipeline parallel). TPU-native,
+every strategy is a named axis of one ``jax.sharding.Mesh``; XLA inserts the
+ICI/DCN collectives implied by sharding annotations — there is no NCCL/Ray
+equivalent to manage.
+
+Axes (any may be size 1):
+
+- ``dp``  — data parallel: independent decode batches / cache shards.
+- ``pp``  — pipeline parallel: layer stages (DCN-friendly, crosses slices).
+- ``tp``  — tensor parallel: attention heads / MLP hidden (innermost: rides
+  ICI, where all-reduce bandwidth is highest).
+- ``sp``  — sequence/context parallel for long-context ring attention.
+- ``ep``  — expert parallel (MoE models).
+
+Convention: ``tp`` is the fastest-varying (innermost) axis so tensor-parallel
+collectives stay on ICI neighbors; ``dp``/``pp`` are outermost and may span
+DCN. This mirrors the scaling-book recipe: pick the mesh, annotate shardings,
+let XLA place collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "dp"
+AXIS_PIPELINE = "pp"
+AXIS_TENSOR = "tp"
+AXIS_SEQUENCE = "sp"
+AXIS_EXPERT = "ep"
+
+# Outer→inner order used for every mesh this package builds.
+MESH_AXIS_ORDER = (AXIS_DATA, AXIS_PIPELINE, AXIS_SEQUENCE, AXIS_EXPERT, AXIS_TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Parallelism degrees. ``total() `` must divide the device count."""
+
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+
+    def total(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.pipeline_parallel_size
+            * self.sequence_parallel_size
+            * self.expert_parallel_size
+            * self.tensor_parallel_size
+        )
+
+    def sizes(self) -> List[int]:
+        return [
+            self.data_parallel_size,
+            self.pipeline_parallel_size,
+            self.sequence_parallel_size,
+            self.expert_parallel_size,
+            self.tensor_parallel_size,
+        ]
+
+
+def build_mesh(
+    config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the engine mesh over ``devices`` (default: all JAX devices).
+
+    Devices are arranged so ``tp`` groups are contiguous in device order —
+    on real TPU slices, contiguous device order tracks physical ICI
+    adjacency, keeping the hot all-reduces off DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = config.total()
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices ({config}), only {len(devices)} available"
+        )
+    grid = np.array(devices[:n], dtype=object).reshape(config.sizes())
+    return Mesh(grid, MESH_AXIS_ORDER)
+
+
+def local_mesh(tensor_parallel_size: Optional[int] = None) -> Mesh:
+    """Single-axis-of-interest mesh over local devices (tp only).
+
+    The common single-slice serving case: all chips in one tensor-parallel
+    group (``--tensor-parallel-size`` analogue of
+    ``deployment-vllm-multi.yaml:155-158``).
+    """
+    n = tensor_parallel_size or len(jax.devices())
+    return build_mesh(MeshConfig(tensor_parallel_size=n))
+
+
+def largest_pow2_leq(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 1
+
+
+def auto_mesh_config(n_devices: int, max_tp: int = 8) -> MeshConfig:
+    """Heuristic mesh for ``n_devices``: fill tp up to ``max_tp``, rest dp."""
+    tp = math.gcd(largest_pow2_leq(n_devices), max_tp)
+    while n_devices % tp:
+        tp //= 2
+    return MeshConfig(tensor_parallel_size=tp, data_parallel_size=n_devices // tp)

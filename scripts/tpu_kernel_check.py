@@ -1,0 +1,53 @@
+"""Standalone real-TPU check for the pallas decode kernel vs gather.
+
+Run directly on the tunneled chip (ambient JAX_PLATFORMS=axon):
+    python scripts/tpu_kernel_check.py
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.ops.attention import gather_paged_attention
+from production_stack_tpu.ops.paged_attention_pallas import pallas_paged_attention
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices())
+    B, H, KH, hd = 8, 16, 8, 128
+    nb, bs, W = 512, 32, 16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((KH, nb, bs, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((KH, nb, bs, hd)), jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.permutation(nb)[: B * W].reshape(B, W).astype(np.int32)
+    )
+    kv_lens = jnp.asarray(
+        rng.integers(1, bs * W, size=B).astype(np.int32)
+    )
+    q_pos = (kv_lens - 1)[:, None]
+    scale = 1.0 / np.sqrt(hd)
+
+    ref_fn = jax.jit(lambda *a: gather_paged_attention(*a, scale=scale))
+    pal_fn = jax.jit(lambda *a: pallas_paged_attention(*a, scale=scale))
+
+    ref = np.asarray(ref_fn(q, k, v, tables, kv_lens, q_pos), np.float32)
+    print("gather ok")
+    got = np.asarray(pal_fn(q, k, v, tables, kv_lens, q_pos), np.float32)
+    print("pallas ok; max abs diff:", np.abs(ref - got).max())
+
+    for name, fn in [("gather", ref_fn), ("pallas", pal_fn)]:
+        fn(q, k, v, tables, kv_lens, q_pos)[0].block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = fn(q, k, v, tables, kv_lens, q_pos)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / 20
+        print(f"{name}: {dt*1e3:.3f} ms/call")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,14 +7,22 @@ Mirrors the reference's "multi-node without a real cluster" testing strategy
 import os
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests must be hermetic and fast on the virtual 8-device CPU mesh. The
+# ambient environment points JAX_PLATFORMS at the tunneled TPU (axon) and a
+# sitecustomize.py imports jax at interpreter startup — before this conftest —
+# so the env var alone is too late; jax.config.update still works because the
+# backend itself initializes lazily. XLA_FLAGS is read at backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("PST_FORCE_PALLAS_INTERPRET", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

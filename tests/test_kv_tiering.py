@@ -1,0 +1,124 @@
+"""KV tiering: HBM→host spill, fault-up on reuse, remote store, controller.
+
+Mirrors the LMCache behavior the reference configures (SURVEY.md §2.4):
+evicted pages must survive in a lower tier and come back as prefix hits —
+that is the entire mechanism behind the multi-round-QA hit-rate target.
+"""
+
+import numpy as np
+
+from production_stack_tpu.engine.cache_tiering import (
+    HostKVPool,
+    _deserialize_page,
+    _serialize_page,
+)
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+from production_stack_tpu.kvserver.controller import ControllerState
+from production_stack_tpu.kvserver.server import BlockStore
+from production_stack_tpu.kvcache.hashing import CHUNK_TOKENS, chunk_hashes
+
+
+def make_engine(**over) -> LLMEngine:
+    kw = dict(
+        model="tiny-llama-debug",
+        max_model_len=128,
+        block_size=8,
+        num_kv_blocks=24,  # deliberately small: forces spills
+        max_num_seqs=4,
+        max_prefill_tokens=64,
+        cpu_offload_blocks=64,
+    )
+    kw.update(over)
+    return LLMEngine(EngineConfig(**kw))
+
+
+def test_hash_chain_incremental_equals_full():
+    """Regression: incremental chaining (engine commit path) must land on the
+    exact chain of a one-shot hash (router/lookup path) — a mismatch silently
+    zeroes the prefix-cache hit rate."""
+    toks = list(range(100, 612))
+    full = chunk_hashes(toks, 8)
+    from production_stack_tpu.kvcache.hashing import block_hashes as bh
+
+    prev, inc = 0, []
+    for i in range(len(toks) // 8):
+        h = bh(toks[i * 8 : (i + 1) * 8], 8, parent=prev)[0]
+        inc.append(h)
+        prev = h
+    assert full == inc
+
+
+def test_page_serde_roundtrip():
+    k = np.random.default_rng(0).standard_normal((2, 4, 8, 16)).astype(np.float32)
+    v = k * 2
+    k2, v2 = _deserialize_page(_serialize_page(k, v))
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_host_pool_lru():
+    pool = HostKVPool(max_blocks=2)
+    a = np.ones((1, 2, 2, 2), np.float32)
+    pool.put(1, a, a)
+    pool.put(2, a, a)
+    pool.put(3, a, a)  # evicts 1
+    assert pool.get(1) is None
+    assert pool.get(2) is not None
+    assert pool.get(3) is not None
+
+
+def test_spill_and_fault_up_preserves_output():
+    eng = make_engine()
+    sp = SamplingParams(max_tokens=4, temperature=0.0)
+    rng = np.random.default_rng(1)
+    prompt_a = rng.integers(1, 500, size=64).tolist()  # 8 full blocks
+    prompt_b = rng.integers(1, 500, size=64).tolist()
+    prompt_c = rng.integers(1, 500, size=64).tolist()
+
+    first = eng.generate([prompt_a], sp)[0]
+    # Fill the 24-block HBM pool with other work → A's pages spill to host.
+    eng.generate([prompt_b, prompt_c], sp)
+    alloc = eng.allocator
+    assert alloc.spilled_blocks > 0, "small pool must have spilled pages"
+
+    again = eng.generate([prompt_a], sp)[0]
+    assert alloc.host_hit_blocks > 0, "replay should fault pages up from host"
+    assert again["token_ids"] == first["token_ids"]
+
+
+def test_remote_block_store_lru_and_stats():
+    store = BlockStore(max_bytes=100)
+    store.put(1, b"x" * 40)
+    store.put(2, b"y" * 40)
+    store.put(3, b"z" * 40)  # evicts 1
+    assert store.get(1) is None
+    assert store.get(2) == b"y" * 40
+    assert store.evictions == 1
+    assert store.bytes_used == 80
+
+
+def test_controller_longest_prefix_lookup():
+    state = ControllerState()
+    toks = list(range(CHUNK_TOKENS * 3))
+    hashes = chunk_hashes(toks)
+    assert len(hashes) == 3
+    state.register("http://e1:8000", "m", hashes[:2], replace=True)
+    state.register("http://e2:8000", "m", hashes, replace=True)
+    # e3 holds chunk 2 and 3 but NOT chunk 1 → zero consecutive prefix.
+    state.register("http://e3:8000", "m", hashes[1:], replace=True)
+    matches = state.lookup("m", hashes)
+    assert matches["http://e1:8000"] == 2 * CHUNK_TOKENS
+    assert matches["http://e2:8000"] == 3 * CHUNK_TOKENS
+    assert "http://e3:8000" not in matches
+
+
+def test_engine_registers_chunk_hashes():
+    eng = make_engine(max_model_len=CHUNK_TOKENS * 2, num_kv_blocks=80,
+                      max_prefill_tokens=CHUNK_TOKENS)
+    prompt = list(np.random.default_rng(2).integers(1, 500, size=CHUNK_TOKENS + 8))
+    eng.generate([[int(x) for x in prompt]], SamplingParams(max_tokens=2))
+    # One full chunk computed → one resident chunk hash, and it equals the
+    # router-side chunk hash of the same tokens (shared hashing contract).
+    assert chunk_hashes(prompt[:CHUNK_TOKENS])[0] in eng.resident_chunk_hashes
