@@ -1,0 +1,237 @@
+#!/usr/bin/env python3
+"""Multi-round QA benchmark: N users × M rounds over the router's HTTP API.
+
+Protocol parity with the reference harness
+(`benchmarks/multi-round-qa/multi-round-qa.py`: WorkloadConfig :17-43,
+UserSessionManager round loop, per-request CSV + ProcessSummary :436-516):
+concurrent simulated users share a system prompt, each keeps a growing chat
+history, sends one question per round, Poisson-arrival pacing at a target
+QPS, and the run reports QPS served, prompt/generation throughput, and
+TTFT/latency percentiles, plus a per-request CSV.
+
+Usage:
+  python benchmarks/multi_round_qa.py \
+      --base-url http://localhost:8000 --model tiny-llama-debug \
+      --num-users 8 --num-rounds 4 --qps 2 \
+      --system-prompt-len 512 --chat-history-len 2048 --answer-len 64 \
+      --output summary.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import json
+import random
+import string
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+import numpy as np
+
+
+@dataclass
+class WorkloadConfig:
+    num_users: int
+    num_rounds: int
+    qps: float
+    system_prompt_len: int
+    chat_history_len: int
+    answer_len: int
+    model: str
+    base_url: str
+    api_key: Optional[str] = None
+    stream: bool = True
+    seed: int = 0
+
+
+@dataclass
+class RequestRecord:
+    user: int
+    round: int
+    launch_time: float = 0.0
+    ttft: float = -1.0
+    latency: float = -1.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    status: int = 0
+
+
+def synth_words(rng: random.Random, approx_tokens: int) -> str:
+    """~1.3 tokens/word of plausible text (reference uses ShareGPT or
+    random text; synthetic keeps the benchmark hermetic)."""
+    n_words = max(approx_tokens * 3 // 4, 1)
+    return " ".join(
+        "".join(rng.choices(string.ascii_lowercase, k=rng.randint(2, 9)))
+        for _ in range(n_words)
+    )
+
+
+class UserSession:
+    def __init__(self, cfg: WorkloadConfig, user_id: int, system_prompt: str):
+        self.cfg = cfg
+        self.user_id = user_id
+        rng = random.Random(cfg.seed * 1000 + user_id)
+        self.messages: List[dict] = [
+            {"role": "system", "content": system_prompt},
+            {"role": "user",
+             "content": synth_words(rng, cfg.chat_history_len)},
+        ]
+        self.rng = rng
+        self.round = 0
+
+    async def run_round(self, session: aiohttp.ClientSession) -> RequestRecord:
+        rec = RequestRecord(user=self.user_id, round=self.round)
+        if self.round > 0:
+            self.messages.append(
+                {"role": "user", "content": synth_words(self.rng, 32)}
+            )
+        payload = {
+            "model": self.cfg.model,
+            "messages": self.messages,
+            "max_tokens": self.cfg.answer_len,
+            "temperature": 0.0,
+            "ignore_eos": True,
+            "stream": self.cfg.stream,
+        }
+        headers = {}
+        if self.cfg.api_key:
+            headers["Authorization"] = f"Bearer {self.cfg.api_key}"
+        rec.launch_time = time.time()
+        answer_parts: List[str] = []
+        try:
+            async with session.post(
+                f"{self.cfg.base_url}/v1/chat/completions",
+                json=payload, headers=headers,
+            ) as resp:
+                rec.status = resp.status
+                if resp.status != 200:
+                    await resp.read()
+                    return rec
+                if self.cfg.stream:
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[6:]
+                        if data == "[DONE]":
+                            break
+                        if rec.ttft < 0:
+                            rec.ttft = time.time() - rec.launch_time
+                        chunk = json.loads(data)
+                        delta = chunk["choices"][0].get("delta", {})
+                        if delta.get("content"):
+                            answer_parts.append(delta["content"])
+                            rec.completion_tokens += 1
+                else:
+                    body = await resp.json()
+                    rec.ttft = time.time() - rec.launch_time
+                    answer_parts.append(
+                        body["choices"][0]["message"].get("content") or ""
+                    )
+                    rec.completion_tokens = body.get("usage", {}).get(
+                        "completion_tokens", 0
+                    )
+                    rec.prompt_tokens = body.get("usage", {}).get(
+                        "prompt_tokens", 0
+                    )
+        except aiohttp.ClientError:
+            rec.status = -1
+            return rec
+        rec.latency = time.time() - rec.launch_time
+        self.messages.append(
+            {"role": "assistant", "content": "".join(answer_parts)}
+        )
+        self.round += 1
+        return rec
+
+
+async def run_benchmark(cfg: WorkloadConfig) -> List[RequestRecord]:
+    rng = random.Random(cfg.seed)
+    system_prompt = synth_words(rng, cfg.system_prompt_len)
+    users = [UserSession(cfg, u, system_prompt) for u in range(cfg.num_users)]
+    records: List[RequestRecord] = []
+    sem_done: List[asyncio.Task] = []
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=600),
+        connector=aiohttp.TCPConnector(limit=0),
+    ) as session:
+
+        async def user_loop(user: UserSession):
+            for _ in range(cfg.num_rounds):
+                records.append(await user.run_round(session))
+
+        # Poisson arrivals: stagger user starts at the target QPS.
+        for user in users:
+            sem_done.append(asyncio.create_task(user_loop(user)))
+            await asyncio.sleep(rng.expovariate(cfg.qps) if cfg.qps > 0 else 0)
+        await asyncio.gather(*sem_done)
+    return records
+
+
+def summarize(records: List[RequestRecord], wall: float) -> dict:
+    ok = [r for r in records if r.status == 200 and r.ttft >= 0]
+    ttfts = np.array([r.ttft for r in ok]) if ok else np.array([0.0])
+    lats = np.array([r.latency for r in ok]) if ok else np.array([0.0])
+    gen_tokens = sum(r.completion_tokens for r in ok)
+    return {
+        "requests": len(records),
+        "successful": len(ok),
+        "qps_served": round(len(ok) / wall, 3),
+        "generation_tok_per_s": round(gen_tokens / wall, 1),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1000, 1),
+        "ttft_p90_ms": round(float(np.percentile(ttfts, 90)) * 1000, 1),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1000, 1),
+        "latency_p50_s": round(float(np.percentile(lats, 50)), 3),
+        "latency_p99_s": round(float(np.percentile(lats, 99)), 3),
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--base-url", default="http://localhost:8000")
+    p.add_argument("--model", default="tiny-llama-debug")
+    p.add_argument("--num-users", type=int, default=8)
+    p.add_argument("--num-rounds", type=int, default=4)
+    p.add_argument("--qps", type=float, default=2.0)
+    p.add_argument("--system-prompt-len", type=int, default=512)
+    p.add_argument("--chat-history-len", type=int, default=2048)
+    p.add_argument("--answer-len", type=int, default=64)
+    p.add_argument("--api-key", default=None)
+    p.add_argument("--no-stream", dest="stream", action="store_false")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", default=None, help="per-request CSV path")
+    args = p.parse_args(argv)
+
+    cfg = WorkloadConfig(
+        num_users=args.num_users, num_rounds=args.num_rounds, qps=args.qps,
+        system_prompt_len=args.system_prompt_len,
+        chat_history_len=args.chat_history_len, answer_len=args.answer_len,
+        model=args.model, base_url=args.base_url.rstrip("/"),
+        api_key=args.api_key, stream=args.stream, seed=args.seed,
+    )
+    t0 = time.time()
+    records = asyncio.run(run_benchmark(cfg))
+    wall = time.time() - t0
+
+    if args.output:
+        with open(args.output, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["user", "round", "launch_time", "ttft_s", "latency_s",
+                        "completion_tokens", "status"])
+            for r in records:
+                w.writerow([r.user, r.round, f"{r.launch_time:.3f}",
+                            f"{r.ttft:.4f}", f"{r.latency:.4f}",
+                            r.completion_tokens, r.status])
+
+    summary = summarize(records, wall)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

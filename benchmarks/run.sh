@@ -1,0 +1,21 @@
+#!/bin/bash
+# Multi-engine fleet sweep (reference: benchmarks/multi-round-qa/run.sh —
+# 320 users, 10 rounds, warmup pre-population, QPS 0.1–4.1).
+set -e
+BASE_URL="${1:-http://localhost:8000}"
+MODEL="${2:-llama-3-8b}"
+
+echo "=== warmup (pre-populate KV offload tiers) ==="
+python "$(dirname "$0")/multi_round_qa.py" \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users 400 --num-rounds 1 --qps 8 \
+  --system-prompt-len 1000 --chat-history-len 20000 --answer-len 10
+
+for QPS in 0.1 0.5 1.1 1.7 2.3 2.9 3.5 4.1; do
+  echo "=== QPS $QPS ==="
+  python "$(dirname "$0")/multi_round_qa.py" \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    --num-users 320 --num-rounds 10 --qps "$QPS" \
+    --system-prompt-len 1000 --chat-history-len 20000 --answer-len 100 \
+    --output "summary_qps${QPS}.csv"
+done

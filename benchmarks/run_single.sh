@@ -1,0 +1,15 @@
+#!/bin/bash
+# Single-engine QPS sweep (reference: benchmarks/multi-round-qa/run_single.sh
+# — 15 users, 1000-token system prompt, 20000-token history, QPS 0.1–1.1).
+set -e
+BASE_URL="${1:-http://localhost:8000}"
+MODEL="${2:-llama-3-8b}"
+
+for QPS in 0.1 0.3 0.5 0.7 0.9 1.1; do
+  echo "=== QPS $QPS ==="
+  python "$(dirname "$0")/multi_round_qa.py" \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    --num-users 15 --num-rounds 10 --qps "$QPS" \
+    --system-prompt-len 1000 --chat-history-len 20000 --answer-len 100 \
+    --output "summary_qps${QPS}.csv"
+done

@@ -1,0 +1,18 @@
+{{- define "pst.fullname" -}}
+{{- .Release.Name | trunc 40 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "pst.labels" -}}
+app.kubernetes.io/part-of: production-stack-tpu
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+environment: production-stack-tpu
+{{- end -}}
+
+{{- define "pst.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create -}}
+{{ default (printf "%s-sa" (include "pst.fullname" .)) .Values.serviceAccount.name }}
+{{- else -}}
+{{ default "default" .Values.serviceAccount.name }}
+{{- end -}}
+{{- end -}}

@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+"""Generate the Grafana dashboards (run: python gen_dashboards.py).
+
+Reference role: observability/vllm-dashboard.json (20 fleet panels) and the
+LMCache dashboard configmap. Panels are generated so metric names stay in
+sync with the code in one place.
+"""
+
+import json
+import os
+
+DS = {"type": "prometheus", "uid": "${datasource}"}
+
+
+def panel(title, exprs, x, y, w=8, h=7, unit="short", kind="timeseries"):
+    targets = [
+        {"expr": expr, "legendFormat": legend, "refId": chr(65 + i),
+         "datasource": DS}
+        for i, (expr, legend) in enumerate(exprs)
+    ]
+    return {
+        "title": title,
+        "type": kind,
+        "datasource": DS,
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": targets,
+        "options": {"legend": {"displayMode": "list", "placement": "bottom"}},
+    }
+
+
+def stat(title, expr, x, y, w=4, h=4, unit="short"):
+    p = panel(title, [(expr, "")], x, y, w, h, unit, kind="stat")
+    p["options"] = {"reduceOptions": {"calcs": ["lastNotNull"]}}
+    return p
+
+
+def dashboard(uid, title, panels):
+    return {
+        "uid": uid,
+        "title": title,
+        "tags": ["production-stack-tpu"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "15s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                    "current": {},
+                }
+            ]
+        },
+        "panels": panels,
+    }
+
+
+def fleet_dashboard():
+    """Reference vllm-dashboard.json parity: fleet + router health."""
+    p = []
+    # Row 1 — fleet stats.
+    p.append(stat("Available Engines",
+                  'count(vllm:num_requests_running)', 0, 0))
+    p.append(stat("Running Requests",
+                  'sum(vllm:num_requests_running)', 4, 0))
+    p.append(stat("Pending Requests",
+                  'sum(vllm:num_requests_waiting)', 8, 0))
+    p.append(stat("KV Hit Rate",
+                  'avg(vllm:gpu_prefix_cache_hit_rate)', 12, 0,
+                  unit="percentunit"))
+    p.append(stat("KV Usage",
+                  'max(vllm:gpu_cache_usage_perc)', 16, 0,
+                  unit="percentunit"))
+    p.append(stat("Preempted (swapped)",
+                  'sum(vllm:num_requests_swapped)', 20, 0))
+    # Row 2 — latency distributions.
+    p.append(panel("Request TTFT distribution (p50/p90/p99)", [
+        ('histogram_quantile(0.5, sum(rate(vllm:time_to_first_token_seconds_bucket[2m])) by (le))', "p50"),
+        ('histogram_quantile(0.9, sum(rate(vllm:time_to_first_token_seconds_bucket[2m])) by (le))', "p90"),
+        ('histogram_quantile(0.99, sum(rate(vllm:time_to_first_token_seconds_bucket[2m])) by (le))', "p99"),
+    ], 0, 4, unit="s"))
+    p.append(panel("Request latency distribution (p50/p90/p99)", [
+        ('histogram_quantile(0.5, sum(rate(vllm:e2e_request_latency_seconds_bucket[2m])) by (le))', "p50"),
+        ('histogram_quantile(0.9, sum(rate(vllm:e2e_request_latency_seconds_bucket[2m])) by (le))', "p90"),
+        ('histogram_quantile(0.99, sum(rate(vllm:e2e_request_latency_seconds_bucket[2m])) by (le))', "p99"),
+    ], 8, 4, unit="s"))
+    p.append(panel("QPS (successful requests/s)", [
+        ('sum(rate(vllm:request_success_total[2m]))', "qps"),
+    ], 16, 4))
+    # Row 3 — throughput + per-engine load.
+    p.append(panel("Token throughput", [
+        ('sum(rate(vllm:generation_tokens_total[2m]))', "generation tok/s"),
+        ('sum(rate(vllm:prompt_tokens_total[2m]))', "prompt tok/s"),
+    ], 0, 11))
+    p.append(panel("Running requests per engine", [
+        ('vllm:num_requests_running', "{{model_name}}"),
+    ], 8, 11))
+    p.append(panel("KV cache usage per engine", [
+        ('vllm:gpu_cache_usage_perc', "{{model_name}}"),
+    ], 16, 11, unit="percentunit"))
+    # Row 4 — prefix cache + router process.
+    p.append(panel("Prefix cache hit rate per engine", [
+        ('vllm:gpu_prefix_cache_hit_rate', "{{model_name}}"),
+    ], 0, 18, unit="percentunit"))
+    p.append(panel("Router process", [
+        ('pst_router:cpu_percent', "cpu %"),
+        ('pst_router:memory_mb', "memory MB"),
+        ('pst_router:disk_percent', "disk %"),
+    ], 8, 18))
+    p.append(panel("Router request stats (QPS per backend)", [
+        ('vllm:current_qps', "{{server}}"),
+    ], 16, 18))
+    return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
+
+
+def tiering_dashboard():
+    """LMCache-dashboard parity: offload tier behavior."""
+    p = []
+    p.append(stat("Host-tier hit blocks",
+                  'sum(vllm:kv_offload_host_hit_blocks)', 0, 0))
+    p.append(stat("Remote-tier hit blocks",
+                  'sum(vllm:kv_offload_remote_hit_blocks)', 4, 0))
+    p.append(stat("Spilled blocks",
+                  'sum(vllm:kv_offload_spilled_blocks)', 8, 0))
+    p.append(panel("TTFT (warm vs target)", [
+        ('histogram_quantile(0.5, sum(rate(vllm:time_to_first_token_seconds_bucket[2m])) by (le))', "p50"),
+    ], 0, 4, unit="s"))
+    p.append(panel("Offload activity", [
+        ('rate(vllm:kv_offload_spilled_blocks[2m])', "spills/s"),
+        ('rate(vllm:kv_offload_host_hit_blocks[2m])', "host hits/s"),
+        ('rate(vllm:kv_offload_remote_hit_blocks[2m])', "remote hits/s"),
+    ], 8, 4))
+    p.append(panel("Prefix cache hits vs queries", [
+        ('sum(rate(vllm:gpu_prefix_cache_hits_total[2m]))', "hit tokens/s"),
+        ('sum(rate(vllm:gpu_prefix_cache_queries_total[2m]))', "query tokens/s"),
+    ], 16, 4))
+    return dashboard("pst-kv-tiering", "production-stack-tpu / KV Tiering", p)
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name, dash in [
+        ("pst-dashboard.json", fleet_dashboard()),
+        ("kv-tiering-dashboard.json", tiering_dashboard()),
+    ]:
+        with open(os.path.join(here, name), "w") as f:
+            json.dump(dash, f, indent=2)
+        print("wrote", name)

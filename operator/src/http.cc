@@ -1,0 +1,140 @@
+#include "http.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+#include <stdexcept>
+
+namespace pst {
+
+Url Url::parse(const std::string& url) {
+  Url out;
+  std::string rest = url;
+  const std::string scheme = "http://";
+  if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+  if (rest.rfind("https://", 0) == 0)
+    throw std::runtime_error("https unsupported: route via a TLS proxy sidecar");
+  auto slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  auto colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    out.host = hostport.substr(0, colon);
+    out.port = std::stoi(hostport.substr(colon + 1));
+  } else {
+    out.host = hostport;
+    out.port = 80;
+  }
+  return out;
+}
+
+namespace {
+
+int connect_to(const std::string& host, int port, int timeout_sec) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
+    throw std::runtime_error("DNS resolution failed for " + host);
+  int fd = -1;
+  for (auto* p = res; p; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv{timeout_sec, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect failed to " + host);
+  return fd;
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+HttpResponse http_request(const std::string& method, const std::string& url,
+                          const std::string& body,
+                          const std::string& content_type, int timeout_sec) {
+  Url u = Url::parse(url);
+  int fd = connect_to(u.host, u.port, timeout_sec);
+
+  std::ostringstream req;
+  req << method << " " << u.path << " HTTP/1.1\r\n"
+      << "Host: " << u.host << ":" << u.port << "\r\n"
+      << "Connection: close\r\n"
+      << "Accept: application/json\r\n";
+  if (!body.empty() || method == "POST" || method == "PUT" || method == "PATCH") {
+    req << "Content-Type: " << content_type << "\r\n"
+        << "Content-Length: " << body.size() << "\r\n";
+  }
+  req << "\r\n" << body;
+
+  HttpResponse resp;
+  if (!send_all(fd, req.str())) {
+    close(fd);
+    throw std::runtime_error("send failed to " + u.host);
+  }
+
+  std::string raw;
+  char buf[16384];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, static_cast<size_t>(n));
+  close(fd);
+  if (raw.empty()) throw std::runtime_error("empty response from " + u.host);
+
+  auto header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos)
+    throw std::runtime_error("malformed HTTP response");
+  std::string headers = raw.substr(0, header_end);
+  std::string payload = raw.substr(header_end + 4);
+
+  // Status line: HTTP/1.1 200 OK
+  auto sp = headers.find(' ');
+  resp.status = sp == std::string::npos ? 0 : std::stoi(headers.substr(sp + 1));
+
+  // De-chunk if needed (Connection: close means we already have every byte).
+  std::string lower_headers;
+  lower_headers.reserve(headers.size());
+  for (char c : headers) lower_headers += static_cast<char>(tolower(c));
+  if (lower_headers.find("transfer-encoding: chunked") != std::string::npos) {
+    std::string out;
+    size_t pos = 0;
+    while (pos < payload.size()) {
+      auto line_end = payload.find("\r\n", pos);
+      if (line_end == std::string::npos) break;
+      size_t chunk_len = std::stoul(payload.substr(pos, line_end - pos), nullptr, 16);
+      if (chunk_len == 0) break;
+      out.append(payload, line_end + 2, chunk_len);
+      pos = line_end + 2 + chunk_len + 2;  // skip chunk + trailing CRLF
+    }
+    resp.body = std::move(out);
+  } else {
+    resp.body = std::move(payload);
+  }
+  return resp;
+}
+
+}  // namespace pst

@@ -1,0 +1,33 @@
+// Blocking HTTP/1.1 client over POSIX sockets (no libcurl/TLS in the image).
+//
+// In-cluster, the controller reaches the API server through a TLS-terminating
+// localhost proxy (`kubectl proxy` sidecar — see operator/README.md), so the
+// client itself speaks plain HTTP. The same client drives engine-pod HTTP
+// (LoRA load/unload, /v1/models), mirroring the reference reconciler's calls
+// (loraadapter_controller.go:582-611).
+#pragma once
+
+#include <string>
+
+namespace pst {
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+struct Url {
+  std::string host;
+  int port = 80;
+  std::string path;  // includes query
+  static Url parse(const std::string& url);
+};
+
+// method: GET/POST/PUT/PATCH/DELETE. content_type applies when body nonempty.
+HttpResponse http_request(const std::string& method, const std::string& url,
+                          const std::string& body = "",
+                          const std::string& content_type = "application/json",
+                          int timeout_sec = 10);
+
+}  // namespace pst

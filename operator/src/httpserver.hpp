@@ -1,0 +1,128 @@
+// Minimal threaded HTTP/1.1 server (POSIX sockets) for the picker service.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <thread>
+
+namespace pst {
+
+struct HttpServerRequest {
+  std::string method;
+  std::string path;
+  std::string body;
+};
+
+struct HttpServerResponse {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+};
+
+using Handler = std::function<HttpServerResponse(const HttpServerRequest&)>;
+
+class HttpServer {
+ public:
+  explicit HttpServer(Handler handler) : handler_(std::move(handler)) {}
+
+  // Binds and returns the actual port (0 = ephemeral).
+  int listen(int port) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return -1;
+    ::listen(fd_, 128);
+    socklen_t len = sizeof(addr);
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    return ntohs(addr.sin_port);
+  }
+
+  void serve_forever() {
+    while (!stop_.load()) {
+      int client = accept(fd_, nullptr, nullptr);
+      if (client < 0) continue;
+      std::thread([this, client] { handle(client); }).detach();
+    }
+  }
+
+  void stop() {
+    stop_.store(true);
+    if (fd_ >= 0) {
+      shutdown(fd_, SHUT_RDWR);
+      close(fd_);
+    }
+  }
+
+ private:
+  void handle(int client) {
+    struct timeval tv{10, 0};
+    setsockopt(client, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    std::string raw;
+    char buf[8192];
+    size_t content_length = 0;
+    size_t header_end = std::string::npos;
+    while (true) {
+      ssize_t n = recv(client, buf, sizeof(buf), 0);
+      if (n <= 0) break;
+      raw.append(buf, static_cast<size_t>(n));
+      if (header_end == std::string::npos) {
+        header_end = raw.find("\r\n\r\n");
+        if (header_end != std::string::npos) {
+          auto cl = raw.find("Content-Length:");
+          if (cl == std::string::npos) cl = raw.find("content-length:");
+          if (cl != std::string::npos && cl < header_end)
+            content_length = std::stoul(raw.substr(cl + 15));
+        }
+      }
+      if (header_end != std::string::npos &&
+          raw.size() >= header_end + 4 + content_length)
+        break;
+    }
+    if (header_end == std::string::npos) {
+      close(client);
+      return;
+    }
+    HttpServerRequest req;
+    {
+      std::istringstream line(raw.substr(0, raw.find("\r\n")));
+      line >> req.method >> req.path;
+    }
+    req.body = raw.substr(header_end + 4);
+
+    HttpServerResponse resp = handler_(req);
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << " OK\r\n"
+        << "Content-Type: " << resp.content_type << "\r\n"
+        << "Content-Length: " << resp.body.size() << "\r\n"
+        << "Connection: close\r\n\r\n"
+        << resp.body;
+    const std::string data = out.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = send(client, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+      if (n <= 0) break;
+      sent += static_cast<size_t>(n);
+    }
+    close(client);
+  }
+
+  Handler handler_;
+  int fd_ = -1;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace pst

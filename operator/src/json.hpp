@@ -1,0 +1,377 @@
+// Minimal JSON value type + parser/serializer for the operator.
+//
+// The reference operator leans on controller-runtime's typed Go structs
+// (operator/api/v1alpha1/*_types.go); this C++ controller works with dynamic
+// JSON the way the K8s API actually speaks it — no codegen, no deepcopy
+// (zz_generated.deepcopy.go has no analogue here by design).
+//
+// Self-contained (no external deps: the image has no libcurl/openssl dev).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace pst {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(long v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  long as_int(long dflt = 0) const {
+    return type_ == Type::Number ? static_cast<long>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  std::string as_string_or(const std::string& dflt) const {
+    return type_ == Type::String ? str_ : dflt;
+  }
+
+  JsonArray& items() {
+    ensure(Type::Array);
+    return arr_;
+  }
+  const JsonArray& items() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  JsonObject& fields() {
+    ensure(Type::Object);
+    return obj_;
+  }
+  const JsonObject& fields() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  // Object access. operator[] creates (for building); at() is const lookup
+  // returning a Null sentinel for missing keys (for safe deep reads).
+  Json& operator[](const std::string& key) {
+    ensure(Type::Object);
+    return obj_[key];
+  }
+  const Json& at(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  // Deep path lookup: at({"spec", "replicas"}).
+  const Json& at(std::initializer_list<std::string> path) const {
+    const Json* cur = this;
+    for (const auto& key : path) cur = &cur->at(key);
+    return *cur;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+
+  void push_back(Json v) {
+    ensure(Type::Array);
+    arr_.push_back(std::move(v));
+  }
+
+  bool operator==(const Json& o) const {
+    if (type_ != o.type_) return false;
+    switch (type_) {
+      case Type::Null: return true;
+      case Type::Bool: return bool_ == o.bool_;
+      case Type::Number: return num_ == o.num_;
+      case Type::String: return str_ == o.str_;
+      case Type::Array: return arr_ == o.arr_;
+      case Type::Object: return obj_ == o.obj_;
+    }
+    return false;
+  }
+  bool operator!=(const Json& o) const { return !(*this == o); }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON content");
+    return v;
+  }
+
+ private:
+  void ensure(Type t) {
+    if (type_ == Type::Null) {
+      type_ = t;  // building convenience: null -> container on first use
+      return;
+    }
+    if (type_ != t) throw std::runtime_error("JSON type mismatch");
+  }
+
+  void write(std::ostringstream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == static_cast<long long>(num_)) {
+          out << static_cast<long long>(num_);
+        } else {
+          out << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(out, str_); break;
+      case Type::Array: {
+        out << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out << ',';
+          arr_[i].write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Object: {
+        out << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_string(out, k);
+          out << ':';
+          v.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& out, const std::string& s) {
+    out << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  static void skip_ws(const std::string& s, size_t& pos) {
+    while (pos < s.size() &&
+           (s[pos] == ' ' || s[pos] == '\t' || s[pos] == '\n' || s[pos] == '\r'))
+      ++pos;
+  }
+
+  static Json parse_value(const std::string& s, size_t& pos) {
+    skip_ws(s, pos);
+    if (pos >= s.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = s[pos];
+    if (c == '{') return parse_object(s, pos);
+    if (c == '[') return parse_array(s, pos);
+    if (c == '"') return Json(parse_string(s, pos));
+    if (c == 't' || c == 'f') return parse_bool(s, pos);
+    if (c == 'n') {
+      expect(s, pos, "null");
+      return Json();
+    }
+    return parse_number(s, pos);
+  }
+
+  static void expect(const std::string& s, size_t& pos, const char* lit) {
+    for (const char* p = lit; *p; ++p, ++pos) {
+      if (pos >= s.size() || s[pos] != *p)
+        throw std::runtime_error(std::string("expected ") + lit);
+    }
+  }
+
+  static Json parse_bool(const std::string& s, size_t& pos) {
+    if (s[pos] == 't') {
+      expect(s, pos, "true");
+      return Json(true);
+    }
+    expect(s, pos, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& s, size_t& pos) {
+    size_t start = pos;
+    if (pos < s.size() && (s[pos] == '-' || s[pos] == '+')) ++pos;
+    while (pos < s.size() &&
+           (isdigit(static_cast<unsigned char>(s[pos])) || s[pos] == '.' ||
+            s[pos] == 'e' || s[pos] == 'E' || s[pos] == '-' || s[pos] == '+'))
+      ++pos;
+    if (pos == start) throw std::runtime_error("invalid JSON number");
+    return Json(std::stod(s.substr(start, pos - start)));
+  }
+
+  static std::string parse_string(const std::string& s, size_t& pos) {
+    if (s[pos] != '"') throw std::runtime_error("expected string");
+    ++pos;
+    std::string out;
+    while (pos < s.size() && s[pos] != '"') {
+      char c = s[pos++];
+      if (c == '\\') {
+        if (pos >= s.size()) throw std::runtime_error("bad escape");
+        char e = s[pos++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos + 4 > s.size()) throw std::runtime_error("bad \\u escape");
+            unsigned code = std::stoul(s.substr(pos, 4), nullptr, 16);
+            pos += 4;
+            // UTF-8 encode (surrogate pairs for completeness).
+            if (code >= 0xD800 && code <= 0xDBFF && pos + 6 <= s.size() &&
+                s[pos] == '\\' && s[pos + 1] == 'u') {
+              unsigned low = std::stoul(s.substr(pos + 2, 4), nullptr, 16);
+              pos += 6;
+              code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+            }
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else if (code < 0x10000) {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (code >> 18));
+              out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape char");
+        }
+      } else {
+        out += c;
+      }
+    }
+    if (pos >= s.size()) throw std::runtime_error("unterminated string");
+    ++pos;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& s, size_t& pos) {
+    ++pos;  // [
+    Json arr = Json::array();
+    skip_ws(s, pos);
+    if (pos < s.size() && s[pos] == ']') {
+      ++pos;
+      return arr;
+    }
+    while (true) {
+      arr.push_back(parse_value(s, pos));
+      skip_ws(s, pos);
+      if (pos >= s.size()) throw std::runtime_error("unterminated array");
+      if (s[pos] == ',') {
+        ++pos;
+        continue;
+      }
+      if (s[pos] == ']') {
+        ++pos;
+        return arr;
+      }
+      throw std::runtime_error("expected , or ] in array");
+    }
+  }
+
+  static Json parse_object(const std::string& s, size_t& pos) {
+    ++pos;  // {
+    Json obj = Json::object();
+    skip_ws(s, pos);
+    if (pos < s.size() && s[pos] == '}') {
+      ++pos;
+      return obj;
+    }
+    while (true) {
+      skip_ws(s, pos);
+      std::string key = parse_string(s, pos);
+      skip_ws(s, pos);
+      if (pos >= s.size() || s[pos] != ':')
+        throw std::runtime_error("expected : in object");
+      ++pos;
+      obj[key] = parse_value(s, pos);
+      skip_ws(s, pos);
+      if (pos >= s.size()) throw std::runtime_error("unterminated object");
+      if (s[pos] == ',') {
+        ++pos;
+        continue;
+      }
+      if (s[pos] == '}') {
+        ++pos;
+        return obj;
+      }
+      throw std::runtime_error("expected , or } in object");
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace pst

@@ -1,0 +1,78 @@
+#include "k8s.hpp"
+
+#include <stdexcept>
+
+namespace pst {
+
+std::string K8sClient::url(const std::string& api_prefix,
+                           const std::string& plural, const std::string& name,
+                           const std::string& query) const {
+  std::string out = base_ + api_prefix + "/namespaces/" + ns_ + "/" + plural;
+  if (!name.empty()) out += "/" + name;
+  if (!query.empty()) out += "?" + query;
+  return out;
+}
+
+Json K8sClient::list(const std::string& api_prefix, const std::string& plural,
+                     const std::string& label_selector) const {
+  std::string query;
+  if (!label_selector.empty()) query = "labelSelector=" + label_selector;
+  auto resp = http_request("GET", url(api_prefix, plural, "", query));
+  if (!resp.ok())
+    throw std::runtime_error("list " + plural + " failed: " +
+                             std::to_string(resp.status));
+  return Json::parse(resp.body);
+}
+
+std::optional<Json> K8sClient::get(const std::string& api_prefix,
+                                   const std::string& plural,
+                                   const std::string& name) const {
+  auto resp = http_request("GET", url(api_prefix, plural, name));
+  if (resp.status == 404) return std::nullopt;
+  if (!resp.ok())
+    throw std::runtime_error("get " + plural + "/" + name + " failed: " +
+                             std::to_string(resp.status));
+  return Json::parse(resp.body);
+}
+
+Json K8sClient::create(const std::string& api_prefix, const std::string& plural,
+                       const Json& obj) const {
+  auto resp = http_request("POST", url(api_prefix, plural), obj.dump());
+  if (!resp.ok())
+    throw std::runtime_error("create " + plural + " failed: " +
+                             std::to_string(resp.status) + " " + resp.body);
+  return Json::parse(resp.body);
+}
+
+Json K8sClient::replace(const std::string& api_prefix,
+                        const std::string& plural, const std::string& name,
+                        const Json& obj) const {
+  auto resp = http_request("PUT", url(api_prefix, plural, name), obj.dump());
+  if (!resp.ok())
+    throw std::runtime_error("replace " + plural + "/" + name + " failed: " +
+                             std::to_string(resp.status) + " " + resp.body);
+  return Json::parse(resp.body);
+}
+
+bool K8sClient::destroy(const std::string& api_prefix,
+                        const std::string& plural,
+                        const std::string& name) const {
+  auto resp = http_request("DELETE", url(api_prefix, plural, name));
+  return resp.ok() || resp.status == 404;
+}
+
+bool K8sClient::patch_status(const std::string& api_prefix,
+                             const std::string& plural, const std::string& name,
+                             const Json& status) const {
+  Json patch = Json::object();
+  patch["status"] = status;
+  auto resp = http_request("PATCH", url(api_prefix, plural, name + "/status"),
+                           patch.dump(), "application/merge-patch+json");
+  if (resp.status == 404) {  // API server without the status subresource
+    resp = http_request("PATCH", url(api_prefix, plural, name), patch.dump(),
+                        "application/merge-patch+json");
+  }
+  return resp.ok();
+}
+
+}  // namespace pst

@@ -1,0 +1,50 @@
+// Thin dynamic Kubernetes REST client (list/get/create/replace/delete +
+// status patch) — the role controller-runtime's Client plays for the
+// reference operator (operator/cmd/main.go:58-231), minus caches/codegen.
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "http.hpp"
+#include "json.hpp"
+
+namespace pst {
+
+class K8sClient {
+ public:
+  K8sClient(std::string base_url, std::string ns)
+      : base_(std::move(base_url)), ns_(std::move(ns)) {}
+
+  const std::string& ns() const { return ns_; }
+
+  // api_prefix: "/api/v1" (core) or "/apis/<group>/<version>".
+  Json list(const std::string& api_prefix, const std::string& plural,
+            const std::string& label_selector = "") const;
+  std::optional<Json> get(const std::string& api_prefix,
+                          const std::string& plural,
+                          const std::string& name) const;
+  Json create(const std::string& api_prefix, const std::string& plural,
+              const Json& obj) const;
+  Json replace(const std::string& api_prefix, const std::string& plural,
+               const std::string& name, const Json& obj) const;
+  bool destroy(const std::string& api_prefix, const std::string& plural,
+               const std::string& name) const;
+  // Merge-patch against the /status subresource.
+  bool patch_status(const std::string& api_prefix, const std::string& plural,
+                    const std::string& name, const Json& status) const;
+
+ private:
+  std::string url(const std::string& api_prefix, const std::string& plural,
+                  const std::string& name = "",
+                  const std::string& query = "") const;
+  std::string base_;
+  std::string ns_;
+};
+
+// API path constants.
+inline const char* kCoreV1 = "/api/v1";
+inline const char* kAppsV1 = "/apis/apps/v1";
+inline const char* kPstV1 = "/apis/pst.production-stack.io/v1alpha1";
+
+}  // namespace pst

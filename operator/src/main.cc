@@ -1,0 +1,170 @@
+// pst-operator: controller manager for the production-stack-tpu CRDs.
+//
+// Reference equivalent: operator/cmd/main.go:58-231 (controller-runtime
+// manager with leader election + 4 reconcilers). This manager is a C++
+// poll-reconcile loop: every --interval it lists each CRD and drives the
+// cluster to the declared state; leader election uses a coordination.k8s.io
+// Lease so only one replica reconciles.
+//
+// The API server is reached over plain HTTP (--api-server); in-cluster this
+// is a kubectl-proxy/TLS-terminating sidecar on localhost (no TLS libs in
+// the runtime image — see operator/README.md).
+
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <thread>
+#include <unistd.h>
+
+#include "k8s.hpp"
+#include "reconcilers.hpp"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+
+struct Options {
+  std::string api_server = "http://127.0.0.1:8001";
+  std::string ns = "default";
+  int interval_sec = 10;
+  bool once = false;  // single pass (tests / CI)
+  bool leader_election = false;
+  std::string identity;
+};
+
+Options parse_options(int argc, char** argv) {
+  Options o;
+  char host[256] = {0};
+  gethostname(host, sizeof(host) - 1);
+  o.identity = host;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--api-server") o.api_server = next();
+    else if (a == "--namespace") o.ns = next();
+    else if (a == "--interval") o.interval_sec = std::stoi(next());
+    else if (a == "--once") o.once = true;
+    else if (a == "--leader-elect") o.leader_election = true;
+    else if (a == "--identity") o.identity = next();
+    else if (a == "--help") {
+      printf("pst-operator --api-server URL --namespace NS [--interval S]"
+             " [--once] [--leader-elect] [--identity ID]\n");
+      exit(0);
+    }
+  }
+  return o;
+}
+
+// Lease-based leader election (coordination.k8s.io/v1), reference
+// main.go LeaderElection analogue. Returns true if we hold the lease.
+bool try_acquire_lease(const pst::K8sClient& k8s, const Options& o) {
+  const char* api = "/apis/coordination.k8s.io/v1";
+  const std::string name = "pst-operator-leader";
+  const int lease_seconds = o.interval_sec * 3;
+  time_t now = time(nullptr);
+  char now_buf[40];
+  struct tm tm_utc;
+  gmtime_r(&now, &tm_utc);
+  strftime(now_buf, sizeof(now_buf), "%Y-%m-%dT%H:%M:%S.000000Z", &tm_utc);
+
+  auto existing = k8s.get(api, "leases", name);
+  pst::Json lease = pst::Json::object();
+  lease["apiVersion"] = "coordination.k8s.io/v1";
+  lease["kind"] = "Lease";
+  lease["metadata"]["name"] = name;
+  lease["metadata"]["namespace"] = k8s.ns();
+  lease["spec"]["holderIdentity"] = o.identity;
+  lease["spec"]["leaseDurationSeconds"] = lease_seconds;
+  lease["spec"]["renewTime"] = std::string(now_buf);
+
+  try {
+    if (!existing) {
+      k8s.create(api, "leases", lease);
+      return true;
+    }
+    const std::string holder =
+        existing->at({"spec", "holderIdentity"}).as_string();
+    const std::string renew = existing->at({"spec", "renewTime"}).as_string();
+    bool expired = true;
+    if (!renew.empty()) {
+      struct tm tm_renew {};
+      if (strptime(renew.c_str(), "%Y-%m-%dT%H:%M:%S", &tm_renew)) {
+        expired = difftime(now, timegm(&tm_renew)) > lease_seconds;
+      }
+    }
+    if (holder == o.identity || holder.empty() || expired) {
+      lease["metadata"]["resourceVersion"] =
+          existing->at({"metadata", "resourceVersion"}).as_string();
+      k8s.replace(api, "leases", name, lease);
+      return true;
+    }
+    return false;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "[operator] lease error (reconciling anyway): %s\n",
+            e.what());
+    return true;  // fail open: a stuck lease must not halt the fleet
+  }
+}
+
+void reconcile_all(const pst::K8sClient& k8s) {
+  struct Kind {
+    const char* plural;
+    pst::ReconcileResult (*fn)(const pst::K8sClient&, const pst::Json&);
+  };
+  static const Kind kinds[] = {
+      {"tpuruntimes", pst::reconcile_tpu_runtime},
+      {"tpurouters", pst::reconcile_tpu_router},
+      {"cacheservers", pst::reconcile_cache_server},
+      {"loraadapters", pst::reconcile_lora_adapter},
+  };
+  for (const auto& kind : kinds) {
+    pst::Json list;
+    try {
+      list = k8s.list(pst::kPstV1, kind.plural);
+    } catch (const std::exception& e) {
+      // CRD may not be installed; that's fine (reference skips likewise).
+      continue;
+    }
+    for (const auto& cr : list.at("items").items()) {
+      const std::string name = cr.at({"metadata", "name"}).as_string();
+      try {
+        auto result = kind.fn(k8s, cr);
+        if (result.changed)
+          printf("[operator] %s/%s reconciled -> %s\n", kind.plural,
+                 name.c_str(), result.phase.c_str());
+      } catch (const std::exception& e) {
+        fprintf(stderr, "[operator] %s/%s reconcile failed: %s\n", kind.plural,
+                name.c_str(), e.what());
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options o = parse_options(argc, argv);
+  signal(SIGINT, handle_signal);
+  signal(SIGTERM, handle_signal);
+  pst::K8sClient k8s(o.api_server, o.ns);
+  printf("[operator] managing namespace %s via %s (interval %ds)\n",
+         o.ns.c_str(), o.api_server.c_str(), o.interval_sec);
+  fflush(stdout);
+
+  do {
+    if (!o.leader_election || try_acquire_lease(k8s, o)) {
+      reconcile_all(k8s);
+    }
+    fflush(stdout);
+    if (o.once) break;
+    for (int i = 0; i < o.interval_sec * 10 && !g_stop; ++i)
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  } while (!g_stop);
+  printf("[operator] shutting down\n");
+  return 0;
+}

@@ -1,0 +1,280 @@
+// pst-picker: C++ endpoint-picker service for gateway integration.
+//
+// Reference parity: the Go Gateway-API inference-extension pickers
+// (src/gateway_inference_extension/{roundrobin,prefix_aware,kv_aware}_picker.go).
+// Instead of linking into a Go plugin framework, the same picking policies
+// run behind a tiny HTTP API any gateway/ext-proc hook can call:
+//
+//   POST /pick {"policy"?: "...", "model": "...", "prompt": "...",
+//               "pods": [{"name": "...", "address": "..."}]}
+//     -> {"pod": "<name>", "matched_tokens": N}
+//   GET /healthz
+//
+// Policies:
+//   roundrobin  — atomic counter over name-sorted pods
+//                 (roundrobin_picker.go:40-57)
+//   prefixaware — 128-char-chunk xxh64 trie, longest prefix match with
+//                 random tie-break, insert-on-pick
+//                 (prefix_aware_picker.go:52-129; same chunking as the
+//                 router's hashtrie so both layers agree)
+//   kvaware     — cache-controller /lookup with threshold + roundrobin
+//                 fallback (kv_aware_picker.go:48-88)
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "http.hpp"
+#include "httpserver.hpp"
+#include "json.hpp"
+#include "xxhash64.hpp"
+
+namespace {
+
+using pst::Json;
+
+constexpr size_t kChunkChars = 128;
+
+struct TrieNode {
+  std::map<uint64_t, std::unique_ptr<TrieNode>> children;
+  std::set<std::string> endpoints;
+};
+
+class PrefixTrie {
+ public:
+  // Node budget mirrors the router's HashTrie (max_nodes with pruning) so a
+  // long-running picker can't grow without bound; on overflow the oldest
+  // root subtree is dropped (approximate LRU via insertion order).
+  static constexpr size_t kMaxNodes = 262144;
+
+  void insert(const std::string& text, const std::string& endpoint) {
+    std::lock_guard<std::mutex> guard(mu_);
+    TrieNode* node = &root_;
+    for (size_t i = 0; i < text.size(); i += kChunkChars) {
+      uint64_t h = pst::xxh64(text.substr(i, kChunkChars));
+      node->endpoints.insert(endpoint);
+      auto& child = node->children[h];
+      if (!child) {
+        if (node_count_ >= kMaxNodes) prune_locked();
+        child = std::make_unique<TrieNode>();
+        ++node_count_;
+        if (node == &root_) root_order_.push_back(h);
+      }
+      node = child.get();
+    }
+    node->endpoints.insert(endpoint);
+  }
+
+  // Returns (matched chars, endpoints at deepest matched node ∩ available).
+  std::pair<size_t, std::set<std::string>> match(
+      const std::string& text, const std::set<std::string>& available) {
+    std::lock_guard<std::mutex> guard(mu_);
+    TrieNode* node = &root_;
+    size_t matched = 0;
+    std::set<std::string> best;
+    for (size_t i = 0; i < text.size(); i += kChunkChars) {
+      uint64_t h = pst::xxh64(text.substr(i, kChunkChars));
+      auto it = node->children.find(h);
+      if (it == node->children.end()) break;
+      std::set<std::string> eps;
+      for (const auto& e : it->second->endpoints)
+        if (available.count(e)) eps.insert(e);
+      if (eps.empty()) break;
+      node = it->second.get();
+      matched = std::min(i + kChunkChars, text.size());
+      best = std::move(eps);
+    }
+    return {matched, best};
+  }
+
+ private:
+  static size_t count_nodes(const TrieNode& node) {
+    size_t n = 1;
+    for (const auto& [_, child] : node.children) n += count_nodes(*child);
+    return n;
+  }
+
+  void prune_locked() {
+    while (!root_order_.empty()) {
+      uint64_t h = root_order_.front();
+      root_order_.erase(root_order_.begin());
+      auto it = root_.children.find(h);
+      if (it == root_.children.end()) continue;
+      node_count_ -= count_nodes(*it->second);
+      root_.children.erase(it);
+      return;
+    }
+    root_.children.clear();  // degenerate single-subtree case
+    node_count_ = 0;
+  }
+
+  std::mutex mu_;
+  TrieNode root_;
+  size_t node_count_ = 0;
+  std::vector<uint64_t> root_order_;
+};
+
+struct Pod {
+  std::string name;
+  std::string address;
+};
+
+std::vector<Pod> parse_pods(const Json& req) {
+  std::vector<Pod> pods;
+  for (const auto& p : req.at("pods").items())
+    pods.push_back({p.at("name").as_string(), p.at("address").as_string()});
+  std::sort(pods.begin(), pods.end(),
+            [](const Pod& a, const Pod& b) { return a.name < b.name; });
+  return pods;
+}
+
+class PickerService {
+ public:
+  PickerService(std::string default_policy, std::string controller_url,
+                long threshold)
+      : default_policy_(std::move(default_policy)),
+        controller_url_(std::move(controller_url)),
+        threshold_(threshold) {}
+
+  pst::HttpServerResponse handle(const pst::HttpServerRequest& req) {
+    if (req.path == "/healthz")
+      return {200, "application/json", "{\"status\":\"ok\"}"};
+    if (req.method != "POST" || req.path != "/pick")
+      return {404, "application/json", "{\"error\":\"not found\"}"};
+    try {
+      Json body = Json::parse(req.body);
+      auto pods = parse_pods(body);
+      if (pods.empty())
+        return {400, "application/json", "{\"error\":\"no pods\"}"};
+      const std::string policy =
+          body.at("policy").as_string_or(default_policy_);
+      const std::string prompt = body.at("prompt").as_string();
+      long matched = 0;
+      std::string chosen;
+      if (policy == "prefixaware") {
+        chosen = pick_prefix(prompt, pods, &matched);
+      } else if (policy == "kvaware") {
+        chosen = pick_kvaware(body.at("model").as_string(), prompt, pods,
+                              &matched);
+      } else {
+        chosen = pick_roundrobin(pods);
+      }
+      Json resp = Json::object();
+      resp["pod"] = chosen;
+      resp["matched_tokens"] = matched;
+      return {200, "application/json", resp.dump()};
+    } catch (const std::exception& e) {
+      Json err = Json::object();
+      err["error"] = e.what();
+      return {500, "application/json", err.dump()};
+    }
+  }
+
+ private:
+  std::string pick_roundrobin(const std::vector<Pod>& pods) {
+    return pods[counter_.fetch_add(1) % pods.size()].name;
+  }
+
+  std::string pick_prefix(const std::string& prompt,
+                          const std::vector<Pod>& pods, long* matched) {
+    std::set<std::string> available;
+    for (const auto& p : pods) available.insert(p.name);
+    auto [chars, eps] = trie_.match(prompt, available);
+    *matched = static_cast<long>(chars);
+    std::string chosen;
+    if (!eps.empty()) {
+      // Random tie-break among deepest-match holders (Go picker behavior).
+      std::vector<std::string> v(eps.begin(), eps.end());
+      std::uniform_int_distribution<size_t> dist(0, v.size() - 1);
+      std::lock_guard<std::mutex> guard(rng_mu_);
+      chosen = v[dist(rng_)];
+    } else {
+      chosen = pick_roundrobin(pods);
+    }
+    trie_.insert(prompt, chosen);
+    return chosen;
+  }
+
+  std::string pick_kvaware(const std::string& model, const std::string& prompt,
+                           const std::vector<Pod>& pods, long* matched) {
+    // Chunk-hash the prompt the way the engines register chunks (byte-level
+    // token ids == utf-8 bytes+1 for the byte tokenizer; for HF-tokenized
+    // fleets the router path is authoritative — this picker queries with
+    // the same /lookup contract: kv_aware_picker.go:92-115).
+    try {
+      Json lookup = Json::object();
+      lookup["model"] = model;
+      Json hashes = Json::array();
+      // Controller speaks token-chunk hashes; gateway has text only, so ask
+      // the controller's text-lookup convenience if present.
+      lookup["text"] = prompt;
+      auto resp = pst::http_request("POST", controller_url_ + "/lookup",
+                                    lookup.dump(), "application/json", 2);
+      if (resp.ok()) {
+        Json result = Json::parse(resp.body);
+        std::string best;
+        long best_tokens = 0;
+        for (const auto& [url, tokens] : result.at("matches").fields()) {
+          if (tokens.as_int() > best_tokens) {
+            best_tokens = tokens.as_int();
+            best = url;
+          }
+        }
+        for (const auto& p : pods) {
+          if (p.address == best || p.name == best) {
+            if (best_tokens >= threshold_) {
+              *matched = best_tokens;
+              return p.name;
+            }
+          }
+        }
+      }
+    } catch (...) {
+    }
+    return pick_roundrobin(pods);
+  }
+
+  std::string default_policy_;
+  std::string controller_url_;
+  long threshold_;
+  std::atomic<uint64_t> counter_{0};
+  PrefixTrie trie_;
+  std::mutex rng_mu_;
+  std::mt19937 rng_{std::random_device{}()};
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 9002;
+  std::string policy = "prefixaware";
+  std::string controller_url = "http://127.0.0.1:9000";
+  long threshold = 2000;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--port") port = std::stoi(next());
+    else if (a == "--policy") policy = next();
+    else if (a == "--controller-url") controller_url = next();
+    else if (a == "--threshold") threshold = std::stol(next());
+  }
+  PickerService service(policy, controller_url, threshold);
+  pst::HttpServer server(
+      [&](const pst::HttpServerRequest& r) { return service.handle(r); });
+  int bound = server.listen(port);
+  if (bound < 0) {
+    fprintf(stderr, "[picker] bind failed on port %d\n", port);
+    return 1;
+  }
+  printf("[picker] policy=%s listening on :%d\n", policy.c_str(), bound);
+  fflush(stdout);
+  server.serve_forever();
+  return 0;
+}

@@ -1,0 +1,668 @@
+#include "reconcilers.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <ctime>
+
+namespace pst {
+
+namespace {
+
+constexpr const char* kHashAnnotation = "pst.production-stack.io/spec-hash";
+
+Json owner_ref(const Json& cr) {
+  Json ref = Json::object();
+  ref["apiVersion"] = cr.at("apiVersion").as_string_or(
+      "pst.production-stack.io/v1alpha1");
+  ref["kind"] = cr.at("kind").as_string();
+  ref["name"] = cr.at({"metadata", "name"}).as_string();
+  ref["uid"] = cr.at({"metadata", "uid"}).as_string_or("");
+  ref["controller"] = true;
+  ref["blockOwnerDeletion"] = true;
+  Json arr = Json::array();
+  arr.push_back(ref);
+  return arr;
+}
+
+Json meta_for(const Json& cr, const std::string& name, const std::string& ns,
+              const std::string& component) {
+  Json m = Json::object();
+  m["name"] = name;
+  m["namespace"] = ns;
+  Json labels = Json::object();
+  labels["app.kubernetes.io/part-of"] = "production-stack-tpu";
+  labels["app.kubernetes.io/component"] = component;
+  labels["app"] = name;
+  labels["environment"] = "production-stack-tpu";
+  if (component == "engine")
+    labels["model"] = cr.at({"metadata", "name"}).as_string();
+  m["labels"] = labels;
+  Json ann = Json::object();
+  ann[kHashAnnotation] = spec_hash(cr.at("spec"));
+  m["annotations"] = ann;
+  m["ownerReferences"] = owner_ref(cr);
+  return m;
+}
+
+void push_arg(Json& args, const std::string& flag, const std::string& value) {
+  args.push_back(flag);
+  args.push_back(value);
+}
+
+void push_arg_num(Json& args, const std::string& flag, long value) {
+  push_arg(args, flag, std::to_string(value));
+}
+
+std::string now_rfc3339() {
+  char buf[32];
+  time_t t = time(nullptr);
+  struct tm tm_utc;
+  gmtime_r(&t, &tm_utc);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tm_utc);
+  return buf;
+}
+
+// Generic "ensure object matches CR spec" upsert keyed on the spec-hash
+// annotation (drift detection without semantic diffing).
+bool upsert(const K8sClient& k8s, const std::string& api,
+            const std::string& plural, const Json& desired) {
+  const std::string name = desired.at({"metadata", "name"}).as_string();
+  auto existing = k8s.get(api, plural, name);
+  if (!existing) {
+    k8s.create(api, plural, desired);
+    return true;
+  }
+  const std::string want =
+      desired.at({"metadata", "annotations"}).at(kHashAnnotation).as_string();
+  const std::string have = existing->at({"metadata", "annotations"})
+                               .at(kHashAnnotation)
+                               .as_string();
+  if (want != have) {
+    Json replacement = desired;
+    // Carry resourceVersion for optimistic concurrency on PUT.
+    const std::string rv =
+        existing->at({"metadata", "resourceVersion"}).as_string();
+    if (!rv.empty()) replacement["metadata"]["resourceVersion"] = rv;
+    k8s.replace(api, plural, name, replacement);
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+std::string spec_hash(const Json& spec) {
+  // FNV-1a over the canonical dump (std::map keys are sorted → stable).
+  const std::string s = spec.dump();
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  char buf[20];
+  snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(h));
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// TPURuntime
+// ---------------------------------------------------------------------------
+
+Json build_engine_deployment(const Json& cr, const std::string& ns) {
+  const Json& spec = cr.at("spec");
+  const std::string cr_name = cr.at({"metadata", "name"}).as_string();
+  const std::string name = cr_name + "-engine";
+
+  Json args = Json::array();
+  push_arg(args, "--model", spec.at("model").as_string_or("tiny-llama-debug"));
+  if (spec.has("servedModelName"))
+    push_arg(args, "--served-model-name", spec.at("servedModelName").as_string());
+  push_arg(args, "--host", "0.0.0.0");
+  push_arg_num(args, "--port", 8000);
+  const Json& ec = spec.at("engineConfig");
+  push_arg_num(args, "--max-model-len", ec.at("maxModelLen").as_int(4096));
+  push_arg_num(args, "--max-num-seqs", ec.at("maxNumSeqs").as_int(64));
+  push_arg_num(args, "--max-num-batched-tokens",
+               ec.at("maxNumBatchedTokens").as_int(2048));
+  push_arg_num(args, "--tensor-parallel-size",
+               ec.at("tensorParallelSize").as_int(1));
+  push_arg_num(args, "--block-size", ec.at("blockSize").as_int(32));
+  push_arg(args, "--attn-impl", ec.at("attnImpl").as_string_or("auto"));
+  if (ec.has("hbmUtilization")) {
+    char buf[16];
+    snprintf(buf, sizeof(buf), "%.3f", ec.at("hbmUtilization").as_number(0.9));
+    push_arg(args, "--gpu-memory-utilization", buf);
+  }
+  if (ec.has("enablePrefixCaching") && !ec.at("enablePrefixCaching").as_bool(true))
+    args.push_back("--no-enable-prefix-caching");
+  const Json& kv = spec.at("kvCache");
+  if (kv.at("cpuOffloadBlocks").as_int(0) > 0)
+    push_arg_num(args, "--cpu-offload-blocks", kv.at("cpuOffloadBlocks").as_int());
+  if (kv.has("remoteKvUrl") && !kv.at("remoteKvUrl").as_string().empty())
+    push_arg(args, "--remote-kv-url", kv.at("remoteKvUrl").as_string());
+  if (kv.has("kvRole") && kv.at("kvRole").as_string_or("none") != "none")
+    push_arg(args, "--kv-role", kv.at("kvRole").as_string());
+  if (spec.has("cacheControllerUrl"))
+    push_arg(args, "--cache-controller-url",
+             spec.at("cacheControllerUrl").as_string());
+  for (const auto& extra : ec.at("extraArgs").items()) args.push_back(extra);
+
+  Json container = Json::object();
+  container["name"] = "engine";
+  container["image"] = spec.at("image").as_string_or(
+      "ghcr.io/production-stack-tpu/engine:0.1.0");
+  Json cmd = Json::array();
+  cmd.push_back("pst-engine");
+  container["command"] = cmd;
+  container["args"] = args;
+  Json port = Json::object();
+  port["containerPort"] = 8000;
+  port["name"] = "http";
+  Json ports = Json::array();
+  ports.push_back(port);
+  container["ports"] = ports;
+
+  Json resources = Json::object();
+  Json requests = Json::object();
+  requests["cpu"] = spec.at({"resources", "cpu"}).as_string_or("4");
+  requests["memory"] = spec.at({"resources", "memory"}).as_string_or("16Gi");
+  Json limits = Json::object();
+  const long chips = spec.at({"tpu", "chips"}).as_int(0);
+  if (chips > 0) {
+    requests["google.com/tpu"] = std::to_string(chips);
+    limits["google.com/tpu"] = std::to_string(chips);
+  }
+  resources["requests"] = requests;
+  if (chips > 0) resources["limits"] = limits;
+  container["resources"] = resources;
+
+  Json probe = Json::object();
+  Json http_get = Json::object();
+  http_get["path"] = "/health";
+  http_get["port"] = 8000;
+  probe["httpGet"] = http_get;
+  probe["periodSeconds"] = 10;
+  probe["failureThreshold"] = 120;
+  container["startupProbe"] = probe;
+  Json live = probe;
+  live["failureThreshold"] = 6;
+  container["livenessProbe"] = live;
+
+  Json pod_spec = Json::object();
+  if (chips > 0) {
+    Json node_selector = Json::object();
+    node_selector["cloud.google.com/gke-tpu-accelerator"] =
+        spec.at({"tpu", "accelerator"}).as_string_or("tpu-v5-lite-podslice");
+    node_selector["cloud.google.com/gke-tpu-topology"] =
+        spec.at({"tpu", "topology"}).as_string_or("2x4");
+    pod_spec["nodeSelector"] = node_selector;
+    Json tol = Json::object();
+    tol["key"] = "google.com/tpu";
+    tol["operator"] = "Exists";
+    tol["effect"] = "NoSchedule";
+    Json tols = Json::array();
+    tols.push_back(tol);
+    pod_spec["tolerations"] = tols;
+  }
+  if (spec.at({"storage", "enabled"}).as_bool(false)) {
+    Json vm = Json::object();
+    vm["name"] = "model-storage";
+    vm["mountPath"] = "/data";
+    Json vms = Json::array();
+    vms.push_back(vm);
+    container["volumeMounts"] = vms;
+    Json vol = Json::object();
+    vol["name"] = "model-storage";
+    Json pvc_src = Json::object();
+    pvc_src["claimName"] = cr_name + "-pvc";
+    vol["persistentVolumeClaim"] = pvc_src;
+    Json vols = Json::array();
+    vols.push_back(vol);
+    pod_spec["volumes"] = vols;
+    Json env = Json::object();
+    env["name"] = "HF_HOME";
+    env["value"] = "/data";
+    Json envs = Json::array();
+    envs.push_back(env);
+    container["env"] = envs;
+  }
+  Json containers = Json::array();
+  containers.push_back(container);
+  pod_spec["containers"] = containers;
+
+  Json pod_meta = Json::object();
+  Json pod_labels = Json::object();
+  pod_labels["app"] = name;
+  pod_labels["model"] = cr_name;
+  pod_labels["environment"] = "production-stack-tpu";
+  pod_meta["labels"] = pod_labels;
+
+  Json tmpl = Json::object();
+  tmpl["metadata"] = pod_meta;
+  tmpl["spec"] = pod_spec;
+
+  Json selector = Json::object();
+  Json match = Json::object();
+  match["app"] = name;
+  selector["matchLabels"] = match;
+
+  Json dspec = Json::object();
+  dspec["replicas"] = spec.at("replicas").as_int(1);
+  dspec["selector"] = selector;
+  dspec["template"] = tmpl;
+
+  Json dep = Json::object();
+  dep["apiVersion"] = "apps/v1";
+  dep["kind"] = "Deployment";
+  dep["metadata"] = meta_for(cr, name, ns, "engine");
+  dep["spec"] = dspec;
+  return dep;
+}
+
+Json build_engine_service(const Json& cr, const std::string& ns) {
+  const std::string cr_name = cr.at({"metadata", "name"}).as_string();
+  const std::string name = cr_name + "-engine";
+  Json svc = Json::object();
+  svc["apiVersion"] = "v1";
+  svc["kind"] = "Service";
+  svc["metadata"] = meta_for(cr, name, ns, "engine");
+  Json sel = Json::object();
+  sel["app"] = name;
+  Json port = Json::object();
+  port["port"] = 8000;
+  port["targetPort"] = 8000;
+  port["name"] = "http";
+  Json ports = Json::array();
+  ports.push_back(port);
+  Json sspec = Json::object();
+  sspec["selector"] = sel;
+  sspec["ports"] = ports;
+  svc["spec"] = sspec;
+  return svc;
+}
+
+Json build_engine_pvc(const Json& cr, const std::string& ns) {
+  const Json& st = cr.at({"spec", "storage"});
+  Json pvc = Json::object();
+  pvc["apiVersion"] = "v1";
+  pvc["kind"] = "PersistentVolumeClaim";
+  pvc["metadata"] =
+      meta_for(cr, cr.at({"metadata", "name"}).as_string() + "-pvc", ns, "engine");
+  Json pspec = Json::object();
+  Json modes = Json::array();
+  modes.push_back(st.at("accessMode").as_string_or("ReadWriteOnce"));
+  pspec["accessModes"] = modes;
+  if (st.has("storageClass") && !st.at("storageClass").as_string().empty())
+    pspec["storageClassName"] = st.at("storageClass").as_string();
+  Json req = Json::object();
+  Json storage = Json::object();
+  storage["storage"] = st.at("size").as_string_or("100Gi");
+  req["requests"] = storage;
+  pspec["resources"] = req;
+  pvc["spec"] = pspec;
+  return pvc;
+}
+
+ReconcileResult reconcile_tpu_runtime(const K8sClient& k8s, const Json& cr) {
+  ReconcileResult result;
+  const std::string ns = k8s.ns();
+  bool changed = false;
+  changed |= upsert(k8s, kCoreV1, "services", build_engine_service(cr, ns));
+  if (cr.at({"spec", "storage", "enabled"}).as_bool(false)) {
+    const std::string pvc_name =
+        cr.at({"metadata", "name"}).as_string() + "-pvc";
+    if (!k8s.get(kCoreV1, "persistentvolumeclaims", pvc_name))
+      k8s.create(kCoreV1, "persistentvolumeclaims", build_engine_pvc(cr, ns));
+  }
+  changed |= upsert(k8s, kAppsV1, "deployments", build_engine_deployment(cr, ns));
+
+  // Status: ready replicas from the owned Deployment.
+  const std::string dep_name =
+      cr.at({"metadata", "name"}).as_string() + "-engine";
+  long ready = 0;
+  if (auto dep = k8s.get(kAppsV1, "deployments", dep_name))
+    ready = dep->at({"status", "readyReplicas"}).as_int(0);
+  Json status = Json::object();
+  status["readyReplicas"] = ready;
+  status["phase"] = ready > 0 ? "Ready" : "Pending";
+  status["lastReconciled"] = now_rfc3339();
+  k8s.patch_status(kPstV1, "tpuruntimes",
+                   cr.at({"metadata", "name"}).as_string(), status);
+  result.changed = changed;
+  result.phase = status.at("phase").as_string();
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// TPURouter
+// ---------------------------------------------------------------------------
+
+Json build_router_deployment(const Json& cr, const std::string& ns) {
+  const Json& spec = cr.at("spec");
+  const std::string name = cr.at({"metadata", "name"}).as_string() + "-router";
+
+  Json args = Json::array();
+  push_arg(args, "--host", "0.0.0.0");
+  push_arg_num(args, "--port", spec.at("port").as_int(8000));
+  push_arg(args, "--service-discovery",
+           spec.at("serviceDiscovery").as_string_or("k8s"));
+  if (spec.at("serviceDiscovery").as_string_or("k8s") == "k8s") {
+    push_arg(args, "--k8s-namespace", ns);
+    push_arg(args, "--k8s-label-selector",
+             spec.at("k8sLabelSelector")
+                 .as_string_or("environment=production-stack-tpu"));
+  }
+  push_arg(args, "--routing-logic",
+           spec.at("routingLogic").as_string_or("roundrobin"));
+  if (spec.has("sessionKey"))
+    push_arg(args, "--session-key", spec.at("sessionKey").as_string());
+  if (spec.has("cacheControllerUrl"))
+    push_arg(args, "--cache-controller-url",
+             spec.at("cacheControllerUrl").as_string());
+  for (const auto& extra : spec.at("extraArgs").items()) args.push_back(extra);
+
+  Json container = Json::object();
+  container["name"] = "router";
+  container["image"] = spec.at("image").as_string_or(
+      "ghcr.io/production-stack-tpu/router:0.1.0");
+  Json cmd = Json::array();
+  cmd.push_back("pst-router");
+  container["command"] = cmd;
+  container["args"] = args;
+  Json port = Json::object();
+  port["containerPort"] = spec.at("port").as_int(8000);
+  Json ports = Json::array();
+  ports.push_back(port);
+  container["ports"] = ports;
+
+  Json containers = Json::array();
+  containers.push_back(container);
+  Json pod_spec = Json::object();
+  pod_spec["containers"] = containers;
+  if (spec.has("serviceAccountName"))
+    pod_spec["serviceAccountName"] = spec.at("serviceAccountName").as_string();
+
+  Json pod_labels = Json::object();
+  pod_labels["app"] = name;
+  Json pod_meta = Json::object();
+  pod_meta["labels"] = pod_labels;
+  Json tmpl = Json::object();
+  tmpl["metadata"] = pod_meta;
+  tmpl["spec"] = pod_spec;
+
+  Json match = Json::object();
+  match["app"] = name;
+  Json selector = Json::object();
+  selector["matchLabels"] = match;
+
+  Json dspec = Json::object();
+  dspec["replicas"] = spec.at("replicas").as_int(1);
+  dspec["selector"] = selector;
+  dspec["template"] = tmpl;
+
+  Json dep = Json::object();
+  dep["apiVersion"] = "apps/v1";
+  dep["kind"] = "Deployment";
+  dep["metadata"] = meta_for(cr, name, ns, "router");
+  dep["spec"] = dspec;
+  return dep;
+}
+
+Json build_router_service(const Json& cr, const std::string& ns) {
+  const std::string name = cr.at({"metadata", "name"}).as_string() + "-router";
+  Json svc = Json::object();
+  svc["apiVersion"] = "v1";
+  svc["kind"] = "Service";
+  svc["metadata"] = meta_for(cr, name, ns, "router");
+  Json sel = Json::object();
+  sel["app"] = name;
+  Json port = Json::object();
+  port["port"] = cr.at({"spec", "servicePort"}).as_int(80);
+  port["targetPort"] = cr.at({"spec", "port"}).as_int(8000);
+  Json ports = Json::array();
+  ports.push_back(port);
+  Json sspec = Json::object();
+  sspec["selector"] = sel;
+  sspec["ports"] = ports;
+  sspec["type"] = cr.at({"spec", "serviceType"}).as_string_or("ClusterIP");
+  svc["spec"] = sspec;
+  return svc;
+}
+
+ReconcileResult reconcile_tpu_router(const K8sClient& k8s, const Json& cr) {
+  ReconcileResult result;
+  const std::string ns = k8s.ns();
+  bool changed = false;
+  changed |= upsert(k8s, kCoreV1, "services", build_router_service(cr, ns));
+  changed |= upsert(k8s, kAppsV1, "deployments", build_router_deployment(cr, ns));
+
+  const std::string dep_name =
+      cr.at({"metadata", "name"}).as_string() + "-router";
+  long ready = 0;
+  if (auto dep = k8s.get(kAppsV1, "deployments", dep_name))
+    ready = dep->at({"status", "readyReplicas"}).as_int(0);
+  // activeRuntimes: reference counts VLLMRuntimes (vllmrouter_controller.go:390).
+  long runtimes = 0;
+  try {
+    runtimes = static_cast<long>(
+        k8s.list(kPstV1, "tpuruntimes").at("items").items().size());
+  } catch (...) {
+  }
+  Json status = Json::object();
+  status["readyReplicas"] = ready;
+  status["activeRuntimes"] = runtimes;
+  status["phase"] = ready > 0 ? "Ready" : "Pending";
+  status["lastReconciled"] = now_rfc3339();
+  k8s.patch_status(kPstV1, "tpurouters",
+                   cr.at({"metadata", "name"}).as_string(), status);
+  result.changed = changed;
+  result.phase = status.at("phase").as_string();
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// CacheServer
+// ---------------------------------------------------------------------------
+
+Json build_cache_server_deployment(const Json& cr, const std::string& ns) {
+  const Json& spec = cr.at("spec");
+  const std::string name =
+      cr.at({"metadata", "name"}).as_string() + "-cache-server";
+  Json args = Json::array();
+  push_arg(args, "--host", "0.0.0.0");
+  push_arg_num(args, "--port", spec.at("port").as_int(8100));
+  push_arg_num(args, "--max-bytes",
+               spec.at("maxBytes").as_int(8l << 30));
+  Json container = Json::object();
+  container["name"] = "cache-server";
+  container["image"] = spec.at("image").as_string_or(
+      "ghcr.io/production-stack-tpu/engine:0.1.0");
+  Json cmd = Json::array();
+  cmd.push_back("pst-kv-server");
+  container["command"] = cmd;
+  container["args"] = args;
+  Json containers = Json::array();
+  containers.push_back(container);
+  Json pod_spec = Json::object();
+  pod_spec["containers"] = containers;
+  Json pod_labels = Json::object();
+  pod_labels["app"] = name;
+  Json pod_meta = Json::object();
+  pod_meta["labels"] = pod_labels;
+  Json tmpl = Json::object();
+  tmpl["metadata"] = pod_meta;
+  tmpl["spec"] = pod_spec;
+  Json match = Json::object();
+  match["app"] = name;
+  Json selector = Json::object();
+  selector["matchLabels"] = match;
+  Json dspec = Json::object();
+  dspec["replicas"] = spec.at("replicas").as_int(1);
+  dspec["selector"] = selector;
+  dspec["template"] = tmpl;
+  Json dep = Json::object();
+  dep["apiVersion"] = "apps/v1";
+  dep["kind"] = "Deployment";
+  dep["metadata"] = meta_for(cr, name, ns, "cache-server");
+  dep["spec"] = dspec;
+  return dep;
+}
+
+Json build_cache_server_service(const Json& cr, const std::string& ns) {
+  const std::string name =
+      cr.at({"metadata", "name"}).as_string() + "-cache-server";
+  Json svc = Json::object();
+  svc["apiVersion"] = "v1";
+  svc["kind"] = "Service";
+  svc["metadata"] = meta_for(cr, name, ns, "cache-server");
+  Json sel = Json::object();
+  sel["app"] = name;
+  Json port = Json::object();
+  port["port"] = cr.at({"spec", "port"}).as_int(8100);
+  port["targetPort"] = cr.at({"spec", "port"}).as_int(8100);
+  Json ports = Json::array();
+  ports.push_back(port);
+  Json sspec = Json::object();
+  sspec["selector"] = sel;
+  sspec["ports"] = ports;
+  svc["spec"] = sspec;
+  return svc;
+}
+
+ReconcileResult reconcile_cache_server(const K8sClient& k8s, const Json& cr) {
+  ReconcileResult result;
+  const std::string ns = k8s.ns();
+  bool changed = false;
+  changed |= upsert(k8s, kCoreV1, "services", build_cache_server_service(cr, ns));
+  changed |=
+      upsert(k8s, kAppsV1, "deployments", build_cache_server_deployment(cr, ns));
+  Json status = Json::object();
+  status["phase"] = "Ready";
+  status["lastReconciled"] = now_rfc3339();
+  k8s.patch_status(kPstV1, "cacheservers",
+                   cr.at({"metadata", "name"}).as_string(), status);
+  result.changed = changed;
+  result.phase = "Ready";
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// LoraAdapter
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct EnginePod {
+  std::string name;
+  std::string base;  // http://ip:port
+};
+
+std::vector<EnginePod> ready_engine_pods(const K8sClient& k8s,
+                                         const std::string& base_model) {
+  std::vector<EnginePod> pods;
+  Json list = k8s.list(kCoreV1, "pods", "model%3D" + base_model);
+  for (const auto& pod : list.at("items").items()) {
+    const std::string ip = pod.at({"status", "podIP"}).as_string();
+    const std::string phase = pod.at({"status", "phase"}).as_string();
+    if (ip.empty() || phase != "Running") continue;
+    // Engine port from the pod's declared containerPort (default 8000).
+    long port = 8000;
+    const auto& containers = pod.at({"spec", "containers"}).items();
+    if (!containers.empty()) {
+      const auto& ports = containers[0].at("ports").items();
+      if (!ports.empty()) port = ports[0].at("containerPort").as_int(8000);
+    }
+    pods.push_back({pod.at({"metadata", "name"}).as_string(),
+                    "http://" + ip + ":" + std::to_string(port)});
+  }
+  std::sort(pods.begin(), pods.end(),
+            [](const EnginePod& a, const EnginePod& b) { return a.name < b.name; });
+  return pods;
+}
+
+bool adapter_loaded(const std::string& base, const std::string& adapter) {
+  try {
+    auto resp = http_request("GET", base + "/v1/models", "", "", 5);
+    if (!resp.ok()) return false;
+    Json models = Json::parse(resp.body);
+    for (const auto& m : models.at("data").items())
+      if (m.at("id").as_string() == adapter) return true;
+  } catch (...) {
+  }
+  return false;
+}
+
+bool post_adapter(const std::string& base, const std::string& endpoint,
+                  const std::string& adapter, const std::string& path) {
+  Json body = Json::object();
+  body["lora_name"] = adapter;
+  if (!path.empty()) body["lora_path"] = path;
+  try {
+    auto resp = http_request("POST", base + endpoint, body.dump(),
+                             "application/json", 10);
+    return resp.ok();
+  } catch (...) {
+    return false;
+  }
+}
+
+}  // namespace
+
+ReconcileResult reconcile_lora_adapter(const K8sClient& k8s, const Json& cr) {
+  // Placement algorithms follow the reference semantics
+  // (loraadapter_controller.go:394 getOptimalPlacement):
+  //   default   — load on every ready pod
+  //   ordered   — first N pods by name
+  //   equalized — N pods chosen round-robin by a stable hash offset, so
+  //               multiple adapters spread across the fleet
+  ReconcileResult result;
+  const Json& spec = cr.at("spec");
+  const std::string adapter = spec.at("adapterName").as_string_or(
+      cr.at({"metadata", "name"}).as_string());
+  const std::string path = spec.at("adapterPath").as_string_or("");
+  const std::string base_model = spec.at("baseModel").as_string();
+  const std::string algo =
+      spec.at({"placement", "algorithm"}).as_string_or("default");
+  long want = spec.at({"placement", "replicas"}).as_int(0);
+
+  auto pods = ready_engine_pods(k8s, base_model);
+  std::vector<EnginePod> desired;
+  if (algo == "default" || want <= 0 ||
+      want >= static_cast<long>(pods.size())) {
+    desired = pods;
+  } else if (algo == "ordered") {
+    desired.assign(pods.begin(), pods.begin() + want);
+  } else {  // equalized
+    size_t offset = 0;
+    for (unsigned char c : adapter) offset = offset * 31 + c;
+    for (long i = 0; i < want; ++i)
+      desired.push_back(pods[(offset + static_cast<size_t>(i)) % pods.size()]);
+  }
+
+  Json loaded = Json::array();
+  bool changed = false;
+  for (const auto& pod : pods) {
+    const bool should_have =
+        std::any_of(desired.begin(), desired.end(),
+                    [&](const EnginePod& p) { return p.name == pod.name; });
+    const bool has = adapter_loaded(pod.base, adapter);
+    if (should_have && !has) {
+      changed |= post_adapter(pod.base, "/v1/load_lora_adapter", adapter, path);
+    } else if (!should_have && has) {
+      changed |= post_adapter(pod.base, "/v1/unload_lora_adapter", adapter, "");
+    }
+    if (should_have) loaded.push_back(pod.name);
+  }
+
+  Json status = Json::object();
+  status["loadedPods"] = loaded;
+  status["phase"] = loaded.items().empty() ? "Pending" : "Ready";
+  status["lastReconciled"] = now_rfc3339();
+  k8s.patch_status(kPstV1, "loraadapters",
+                   cr.at({"metadata", "name"}).as_string(), status);
+  result.changed = changed;
+  result.phase = status.at("phase").as_string();
+  return result;
+}
+
+}  // namespace pst

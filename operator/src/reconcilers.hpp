@@ -1,0 +1,44 @@
+// Reconcilers for the four CRDs (reference: operator/internal/controller/*).
+//
+// TPURuntime   — engine fleet:   Service + PVC + Deployment from CR spec
+//                (vllmruntime_controller.go:57-186 analogue, TPU resources)
+// TPURouter    — router:         Deployment + Service
+//                (vllmrouter_controller.go:61-195 analogue)
+// CacheServer  — remote KV store Deployment + Service
+//                (cacheserver_controller.go:54-289 analogue)
+// LoraAdapter  — dynamic LoRA:   placement over ready engine pods + engine
+//                HTTP load/unload (loraadapter_controller.go:73-232 analogue)
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "k8s.hpp"
+#include "json.hpp"
+
+namespace pst {
+
+struct ReconcileResult {
+  bool changed = false;
+  std::string phase;
+  std::string message;
+};
+
+// Stable content hash of a CR spec; stored as an annotation on owned objects
+// so drift detection is a string compare (deploymentNeedsUpdate analogue).
+std::string spec_hash(const Json& spec);
+
+Json build_engine_deployment(const Json& cr, const std::string& ns);
+Json build_engine_service(const Json& cr, const std::string& ns);
+Json build_engine_pvc(const Json& cr, const std::string& ns);
+Json build_router_deployment(const Json& cr, const std::string& ns);
+Json build_router_service(const Json& cr, const std::string& ns);
+Json build_cache_server_deployment(const Json& cr, const std::string& ns);
+Json build_cache_server_service(const Json& cr, const std::string& ns);
+
+ReconcileResult reconcile_tpu_runtime(const K8sClient& k8s, const Json& cr);
+ReconcileResult reconcile_tpu_router(const K8sClient& k8s, const Json& cr);
+ReconcileResult reconcile_cache_server(const K8sClient& k8s, const Json& cr);
+ReconcileResult reconcile_lora_adapter(const K8sClient& k8s, const Json& cr);
+
+}  // namespace pst

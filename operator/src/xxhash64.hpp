@@ -1,0 +1,87 @@
+// XXH64 (public algorithm, from its specification) — the same hash the
+// router's prefix trie and the reference's Go picker use
+// (prefix_aware_picker.go / prefix/hashtrie.py), so a C++ picker and the
+// Python router agree on chunk identity.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace pst {
+
+inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+inline uint64_t xxh64(const void* data, size_t len, uint64_t seed = 0) {
+  constexpr uint64_t P1 = 11400714785074694791ull;
+  constexpr uint64_t P2 = 14029467366897019727ull;
+  constexpr uint64_t P3 = 1609587929392839161ull;
+  constexpr uint64_t P4 = 9650029242287828579ull;
+  constexpr uint64_t P5 = 2870177450012600261ull;
+
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  uint64_t h;
+
+  auto read64 = [](const uint8_t* q) {
+    uint64_t v;
+    memcpy(&v, q, 8);
+    return v;  // little-endian host assumed (x86/ARM)
+  };
+  auto read32 = [](const uint8_t* q) {
+    uint32_t v;
+    memcpy(&v, q, 4);
+    return static_cast<uint64_t>(v);
+  };
+  auto round = [&](uint64_t acc, uint64_t input) {
+    acc += input * P2;
+    acc = rotl64(acc, 31);
+    return acc * P1;
+  };
+
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    do {
+      v1 = round(v1, read64(p)); p += 8;
+      v2 = round(v2, read64(p)); p += 8;
+      v3 = round(v3, read64(p)); p += 8;
+      v4 = round(v4, read64(p)); p += 8;
+    } while (p + 32 <= end);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    auto merge = [&](uint64_t acc, uint64_t v) {
+      acc ^= round(0, v);
+      return acc * P1 + P4;
+    };
+    h = merge(h, v1); h = merge(h, v2); h = merge(h, v3); h = merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += static_cast<uint64_t>(len);
+  while (p + 8 <= end) {
+    h ^= round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+inline uint64_t xxh64(const std::string& s, uint64_t seed = 0) {
+  return xxh64(s.data(), s.size(), seed);
+}
+
+}  // namespace pst

@@ -97,7 +97,17 @@ def create_controller_app(instance_ttl: float = 120.0) -> web.Application:
 
     async def lookup(request: web.Request) -> web.Response:
         body = await request.json()
-        matches = state.lookup(body.get("model", ""), body.get("hashes", []))
+        hashes = body.get("hashes")
+        if not hashes and body.get("text"):
+            # Gateway pickers hold raw text, not token ids: byte-tokenize
+            # (the fleet-wide fallback tokenizer) and chunk-hash here so the
+            # C++ picker needs no tokenizer of its own.
+            from ..engine.tokenizer import ByteTokenizer
+            from ..kvcache.hashing import chunk_hashes
+
+            ids = ByteTokenizer().encode(body["text"])
+            hashes = chunk_hashes(ids)
+        matches = state.lookup(body.get("model", ""), hashes or [])
         return web.json_response({"matches": matches})
 
     async def instances(request: web.Request) -> web.Response:

@@ -186,6 +186,9 @@ def create_app(args) -> web.Application:
             task = app.get(key)
             if task is not None:
                 task.cancel()
+        proc = app.get("batch_processor")
+        if proc is not None:
+            await proc.close()
         watcher = app.get("dynamic_config_watcher")
         if watcher is not None:
             watcher.close()

@@ -1,0 +1,118 @@
+"""PII detection gate: scan request content, block on detection.
+
+Capability parity with the reference's experimental PII middleware
+(``experimental/pii/``: regex + Presidio analyzers, block-on-detect with
+Prometheus counters). Presidio is unavailable in this image, so the analyzer
+surface is pluggable with the regex analyzer as the shipped implementation
+(the reference's regex pattern classes, re-derived: email / phone / SSN /
+credit card / IP / API-key shapes).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Pattern
+
+from aiohttp import web
+from prometheus_client import Counter, REGISTRY
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def _metric(name: str, doc: str, labels: List[str]) -> Counter:
+    try:
+        return Counter(name, doc, labels)
+    except ValueError:
+        return REGISTRY._names_to_collectors[name]  # type: ignore[return-value]
+
+
+pii_detected_total = _metric(
+    "pst_router_pii_detected_total", "requests blocked for PII", ["pii_type"]
+)
+
+PII_PATTERNS: Dict[str, Pattern[str]] = {
+    "email": re.compile(r"\b[\w.+-]+@[\w-]+\.[\w.-]{2,}\b"),
+    "phone": re.compile(r"\b(?:\+?\d{1,3}[ .-]?)?(?:\(\d{2,4}\)[ .-]?)?\d{3}[ .-]\d{3,4}[ .-]?\d{0,4}\b"),
+    "ssn": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    "credit_card": re.compile(r"\b(?:\d[ -]?){13,19}\b"),
+    "ipv4": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    "api_key": re.compile(r"\b(?:sk|pk|rk)[-_][A-Za-z0-9]{16,}\b"),
+}
+
+
+def _luhn_ok(digits: str) -> bool:
+    ds = [int(c) for c in digits if c.isdigit()]
+    if not 13 <= len(ds) <= 19:
+        return False
+    total = 0
+    for i, d in enumerate(reversed(ds)):
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+class RegexPIIAnalyzer:
+    """Pattern scan; credit-card candidates additionally Luhn-validated."""
+
+    def __init__(self, types: Optional[List[str]] = None):
+        self.patterns = {
+            k: v for k, v in PII_PATTERNS.items() if types is None or k in types
+        }
+
+    def analyze(self, text: str) -> List[str]:
+        found = []
+        for name, pattern in self.patterns.items():
+            for match in pattern.finditer(text):
+                if name == "credit_card" and not _luhn_ok(match.group()):
+                    continue
+                found.append(name)
+                break
+        return found
+
+
+def extract_text(request_json: dict) -> str:
+    parts: List[str] = []
+    prompt = request_json.get("prompt")
+    if isinstance(prompt, str):
+        parts.append(prompt)
+    elif isinstance(prompt, list):
+        parts.extend(p for p in prompt if isinstance(p, str))
+    for m in request_json.get("messages", []):
+        content = m.get("content") if isinstance(m, dict) else None
+        if isinstance(content, str):
+            parts.append(content)
+    return "\n".join(parts)
+
+
+def install_pii_check(app: web.Application, args) -> None:
+    analyzer = RegexPIIAnalyzer()
+    app["pii_analyzer"] = analyzer
+
+    async def check(request_json: dict) -> Optional[web.Response]:
+        text = extract_text(request_json)
+        if not text:
+            return None
+        found = analyzer.analyze(text)
+        if not found:
+            return None
+        for t in found:
+            pii_detected_total.labels(pii_type=t).inc()
+        logger.warning("request blocked: PII detected (%s)", ", ".join(found))
+        return web.json_response(
+            {
+                "error": {
+                    "message": f"request blocked: detected PII ({', '.join(sorted(found))})",
+                    "type": "pii_detected",
+                    "code": 400,
+                }
+            },
+            status=400,
+        )
+
+    app["pii_check"] = check
+    logger.info("PII detection enabled (regex analyzer)")

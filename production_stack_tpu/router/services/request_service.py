@@ -71,7 +71,15 @@ async def proxy_and_stream(
 
     collect = callback is not None and callback.post_request is not None
     semantic_store = request.app.get("semantic_cache_store")
-    collect = collect or semantic_store is not None
+    # Only buffer bodies the cache can actually use (non-streamed chat
+    # completions) — otherwise long streams would pile up in router memory.
+    parsed = request.get("parsed_json") or {}
+    cacheable = (
+        semantic_store is not None
+        and endpoint == "/v1/chat/completions"
+        and not parsed.get("stream")
+    )
+    collect = collect or cacheable
     collected = bytearray()
 
     try:
@@ -126,6 +134,7 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         request_json = json.loads(body) if body else {}
     except json.JSONDecodeError:
         return _error_response(400, "invalid JSON in request body")
+    request["parsed_json"] = request_json  # for post-response hooks
 
     callback = get_custom_callback_handler()
     if callback is not None:
@@ -139,6 +148,14 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         blocked = await pii_check(request_json)
         if blocked is not None:
             return blocked
+
+    # Semantic cache probe (experimental): a hit short-circuits routing
+    # entirely (reference main_router.py:47-54 check_semantic_cache).
+    cache_check = request.app.get("semantic_cache_check")
+    if cache_check is not None and endpoint == "/v1/chat/completions":
+        cached = await cache_check(request_json)
+        if cached is not None:
+            return cached
 
     discovery = get_service_discovery()
     endpoints = discovery.get_endpoint_info()

@@ -1,0 +1,72 @@
+"""Benchmark harness ring-2 test: drive the real router + fake engines.
+
+Reference parity: CI runs the perftest/benchmark harness against fake
+engines (`router-e2e-test.yml:49-81`).
+"""
+
+import asyncio
+
+from aiohttp import web
+
+from benchmarks.multi_round_qa import (
+    UserSession,
+    WorkloadConfig,
+    run_benchmark,
+    summarize,
+)
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+
+
+async def test_multi_round_qa_against_fake_fleet():
+    reset_router_singletons()
+    runners = []
+    try:
+        engine_urls = []
+        for _ in range(2):
+            app = create_fake_engine_app(model="fake/model", speed=5000.0)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            engine_urls.append(
+                f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+            )
+        router_app = create_app(parse_args([
+            "--service-discovery", "static",
+            "--static-backends", ",".join(engine_urls),
+            "--static-models", "fake/model,fake/model",
+            "--routing-logic", "roundrobin",
+            "--engine-stats-interval", "0.2",
+        ]))
+        runner = web.AppRunner(router_app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        router_url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+
+        cfg = WorkloadConfig(
+            num_users=4, num_rounds=2, qps=50.0,
+            system_prompt_len=64, chat_history_len=128, answer_len=8,
+            model="fake/model", base_url=router_url,
+        )
+        import time
+
+        t0 = time.time()
+        records = await run_benchmark(cfg)
+        summary = summarize(records, time.time() - t0)
+        assert summary["requests"] == 8
+        assert summary["successful"] == 8
+        assert summary["ttft_p50_ms"] > 0
+        assert summary["generation_tok_per_s"] > 0
+        # Sessions really are multi-round: histories grew.
+        assert all(r.status == 200 for r in records)
+    finally:
+        for runner in reversed(runners):
+            await runner.cleanup()
+        reset_router_singletons()

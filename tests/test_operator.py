@@ -1,0 +1,340 @@
+"""envtest-style harness for the C++ operator.
+
+Reference strategy (SURVEY.md §4 "Operator" row): the Go operator tests run
+against envtest — a real API server without kubelet. Here a Python fake API
+server implements the REST surface the controller uses (list/get/create/
+replace/merge-patch, label selectors), the real `pst-operator` binary runs
+`--once` against it, and the tests assert the objects it creates.
+"""
+
+import asyncio
+import json
+import subprocess
+import threading
+from pathlib import Path
+
+import pytest
+from aiohttp import web
+
+OPERATOR_DIR = Path(__file__).resolve().parent.parent / "operator"
+BINARY = OPERATOR_DIR / "build" / "pst-operator"
+
+
+@pytest.fixture(scope="module")
+def operator_binary():
+    subprocess.run(["make"], cwd=OPERATOR_DIR, check=True, capture_output=True)
+    assert BINARY.exists()
+    return str(BINARY)
+
+
+class FakeK8s:
+    """Minimal namespaced K8s API: enough semantics for the controller."""
+
+    def __init__(self):
+        # (api_prefix, plural) -> {name: obj}
+        self.store = {}
+        self.rv = 0
+        self.url = None
+        self._ready = threading.Event()
+        self._loop = None
+
+    # -- storage helpers --------------------------------------------------
+
+    def bucket(self, prefix, plural):
+        return self.store.setdefault((prefix, plural), {})
+
+    def seed(self, prefix, plural, obj):
+        name = obj["metadata"]["name"]
+        obj["metadata"].setdefault("uid", f"uid-{name}")
+        self.bucket(prefix, plural)[name] = obj
+
+    # -- aiohttp app ------------------------------------------------------
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_route("*", "/{api:apis?}/{rest:.*}", self.handle)
+        return app
+
+    async def handle(self, request: web.Request):
+        # Paths: /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+        #        /apis/{group}/{ver}/namespaces/{ns}/{plural}[/{name}[/status]]
+        parts = request.path.strip("/").split("/")
+        if parts[0] == "api":
+            prefix = "/api/" + parts[1]
+            rest = parts[2:]
+        else:
+            prefix = "/apis/" + parts[1] + "/" + parts[2]
+            rest = parts[3:]
+        if len(rest) < 2 or rest[0] != "namespaces":
+            return web.json_response({"error": "bad path"}, status=400)
+        plural = rest[2]
+        name = rest[3] if len(rest) > 3 else None
+        subresource = rest[4] if len(rest) > 4 else None
+        bucket = self.bucket(prefix, plural)
+
+        if request.method == "GET" and name is None:
+            items = list(bucket.values())
+            selector = request.query.get("labelSelector")
+            if selector:
+                k, _, v = selector.partition("=")
+                items = [
+                    o for o in items
+                    if o.get("metadata", {}).get("labels", {}).get(k) == v
+                ]
+            return web.json_response({"kind": "List", "items": items})
+        if request.method == "GET":
+            if name not in bucket:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(bucket[name])
+        if request.method == "POST":
+            obj = await request.json()
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            obj["metadata"].setdefault("uid", f"uid-{obj['metadata']['name']}")
+            bucket[obj["metadata"]["name"]] = obj
+            return web.json_response(obj, status=201)
+        if request.method == "PUT":
+            obj = await request.json()
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            bucket[name] = obj
+            return web.json_response(obj)
+        if request.method == "PATCH":
+            if name not in bucket:
+                return web.json_response({"error": "not found"}, status=404)
+            patch = await request.json()
+            target = bucket[name]
+            if subresource == "status" or "status" in patch:
+                target.setdefault("status", {}).update(patch.get("status", {}))
+            return web.json_response(target)
+        if request.method == "DELETE":
+            bucket.pop(name, None)
+            return web.json_response({"status": "ok"})
+        return web.json_response({"error": "unsupported"}, status=405)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            runner = web.AppRunner(self.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+            self._ready.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def stop(self):
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+PST = "/apis/pst.production-stack.io/v1alpha1"
+APPS = "/apis/apps/v1"
+CORE = "/api/v1"
+
+
+def run_operator(binary, url, ns="default"):
+    proc = subprocess.run(
+        [binary, "--api-server", url, "--namespace", ns, "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def test_tpuruntime_creates_engine_deployment(operator_binary):
+    k8s = FakeK8s().start()
+    try:
+        k8s.seed(PST, "tpuruntimes", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "TPURuntime",
+            "metadata": {"name": "llama8b", "namespace": "default"},
+            "spec": {
+                "model": "llama-3-8b",
+                "replicas": 2,
+                "image": "example/engine:1",
+                "tpu": {"accelerator": "tpu-v5-lite-podslice",
+                        "topology": "2x4", "chips": 8},
+                "engineConfig": {"maxModelLen": 8192,
+                                 "tensorParallelSize": 8,
+                                 "attnImpl": "pallas"},
+                "kvCache": {"cpuOffloadBlocks": 128},
+            },
+        })
+        run_operator(operator_binary, k8s.url)
+
+        deps = k8s.bucket(APPS, "deployments")
+        assert "llama8b-engine" in deps
+        dep = deps["llama8b-engine"]
+        assert dep["spec"]["replicas"] == 2
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        assert container["command"] == ["pst-engine"]
+        args = container["args"]
+        assert "--tensor-parallel-size" in args
+        assert args[args.index("--tensor-parallel-size") + 1] == "8"
+        assert "--cpu-offload-blocks" in args
+        assert container["resources"]["requests"]["google.com/tpu"] == "8"
+        sel = dep["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+        # Owner reference → K8s GC ties the Deployment to the CR.
+        assert dep["metadata"]["ownerReferences"][0]["kind"] == "TPURuntime"
+        assert "llama8b-engine" in k8s.bucket(CORE, "services")
+        # Status written back.
+        cr = k8s.bucket(PST, "tpuruntimes")["llama8b"]
+        assert cr["status"]["phase"] in ("Pending", "Ready")
+
+        # Idempotence: second pass must not rewrite anything.
+        rv_before = dep["metadata"]["resourceVersion"]
+        run_operator(operator_binary, k8s.url)
+        assert (k8s.bucket(APPS, "deployments")["llama8b-engine"]["metadata"]
+                ["resourceVersion"] == rv_before)
+    finally:
+        k8s.stop()
+
+
+def test_tpuruntime_spec_change_triggers_update(operator_binary):
+    k8s = FakeK8s().start()
+    try:
+        cr = {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "TPURuntime",
+            "metadata": {"name": "m", "namespace": "default"},
+            "spec": {"model": "tiny-llama-debug", "replicas": 1,
+                     "engineConfig": {}, "kvCache": {}},
+        }
+        k8s.seed(PST, "tpuruntimes", cr)
+        run_operator(operator_binary, k8s.url)
+        assert k8s.bucket(APPS, "deployments")["m-engine"]["spec"]["replicas"] == 1
+
+        cr["spec"]["replicas"] = 3
+        run_operator(operator_binary, k8s.url)
+        assert k8s.bucket(APPS, "deployments")["m-engine"]["spec"]["replicas"] == 3
+    finally:
+        k8s.stop()
+
+
+def test_router_and_cacheserver_reconcile(operator_binary):
+    k8s = FakeK8s().start()
+    try:
+        k8s.seed(PST, "tpurouters", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "TPURouter",
+            "metadata": {"name": "r", "namespace": "default"},
+            "spec": {"replicas": 2, "routingLogic": "prefixaware",
+                     "serviceDiscovery": "k8s"},
+        })
+        k8s.seed(PST, "cacheservers", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "CacheServer",
+            "metadata": {"name": "kv", "namespace": "default"},
+            "spec": {"port": 8100, "maxBytes": 1000000},
+        })
+        run_operator(operator_binary, k8s.url)
+        router_dep = k8s.bucket(APPS, "deployments")["r-router"]
+        args = router_dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--routing-logic" in args
+        assert args[args.index("--routing-logic") + 1] == "prefixaware"
+        assert "r-router" in k8s.bucket(CORE, "services")
+        cache_dep = k8s.bucket(APPS, "deployments")["kv-cache-server"]
+        assert cache_dep["spec"]["template"]["spec"]["containers"][0][
+            "command"] == ["pst-kv-server"]
+    finally:
+        k8s.stop()
+
+
+def test_lora_adapter_load_unload_flow(operator_binary):
+    """LoRA reconcile against real fake-engine HTTP servers: 'ordered'
+    placement on 1 of 2 ready pods loads on pod-a; a stale copy pre-loaded on
+    pod-b gets unloaded (reference loadAdapter/unloadAdapter flow)."""
+    from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+    k8s = FakeK8s().start()
+    engines = {}
+    ready = threading.Event()
+    loop_holder = {}
+
+    def engines_thread():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_holder["loop"] = loop
+
+        async def boot():
+            for pod in ("pod-a", "pod-b"):
+                app = create_fake_engine_app(model="base")
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                engines[pod] = {
+                    "port": site._server.sockets[0].getsockname()[1],
+                    "state": app["state"],
+                }
+            ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=engines_thread, daemon=True).start()
+    assert ready.wait(10)
+
+    try:
+        engines["pod-b"]["state"].lora_adapters.append("ad")  # stale copy
+        for pod, info in engines.items():
+            k8s.seed(CORE, "pods", {
+                "metadata": {"name": pod, "namespace": "default",
+                             "labels": {"model": "base"}},
+                "spec": {"containers": [{
+                    "name": "engine",
+                    "ports": [{"containerPort": info["port"]}],
+                }]},
+                "status": {"podIP": "127.0.0.1", "phase": "Running"},
+            })
+        k8s.seed(PST, "loraadapters", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "LoraAdapter",
+            "metadata": {"name": "ad", "namespace": "default"},
+            "spec": {"baseModel": "base", "adapterName": "ad",
+                     "adapterPath": "/adapters/ad",
+                     "placement": {"algorithm": "ordered", "replicas": 1}},
+        })
+        run_operator(operator_binary, k8s.url)
+
+        assert "ad" in engines["pod-a"]["state"].lora_adapters
+        assert "ad" not in engines["pod-b"]["state"].lora_adapters
+        cr = k8s.bucket(PST, "loraadapters")["ad"]
+        assert cr["status"]["phase"] == "Ready"
+        assert cr["status"]["loadedPods"] == ["pod-a"]
+    finally:
+        if loop_holder.get("loop"):
+            loop_holder["loop"].call_soon_threadsafe(loop_holder["loop"].stop)
+        k8s.stop()
+
+
+def test_lora_status_pending_without_pods(operator_binary):
+    k8s = FakeK8s().start()
+    try:
+        k8s.seed(PST, "loraadapters", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "LoraAdapter",
+            "metadata": {"name": "ad", "namespace": "default"},
+            "spec": {"baseModel": "base", "adapterName": "ad",
+                     "placement": {"algorithm": "ordered", "replicas": 1}},
+        })
+        run_operator(operator_binary, k8s.url)
+        cr = k8s.bucket(PST, "loraadapters")["ad"]
+        assert cr["status"]["phase"] == "Pending"
+        assert cr["status"]["loadedPods"] == []
+    finally:
+        k8s.stop()

@@ -1,0 +1,180 @@
+"""Router extras e2e: files API, batches API, semantic cache, PII gate.
+
+Ring-2 strategy: real router app + fake engines (SURVEY.md §4), driving the
+OpenAI files/batches surface and the feature-gated experimental paths.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+
+
+class Cluster:
+    def __init__(self, extra_args=None):
+        self.extra_args = extra_args or []
+        self.runners = []
+        self.router_url = None
+
+    async def __aenter__(self):
+        reset_router_singletons()
+        app = create_fake_engine_app(model="fake/model", speed=5000.0)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.runners.append(runner)
+        self.engine_url = f"http://127.0.0.1:{port}"
+        argv = [
+            "--service-discovery", "static",
+            "--static-backends", self.engine_url,
+            "--static-models", "fake/model",
+            "--routing-logic", "roundrobin",
+            "--engine-stats-interval", "0.2",
+            *self.extra_args,
+        ]
+        router_app = create_app(parse_args(argv))
+        r = web.AppRunner(router_app)
+        await r.setup()
+        site = web.TCPSite(r, "127.0.0.1", 0)
+        await site.start()
+        self.runners.append(r)
+        self.router_url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+        return self
+
+    async def __aexit__(self, *exc):
+        for runner in reversed(self.runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+async def test_files_api_roundtrip(tmp_path):
+    async with Cluster(
+        ["--enable-batch-api", "--file-storage-path", str(tmp_path)]
+    ) as c, aiohttp.ClientSession() as sess:
+        form = aiohttp.FormData()
+        form.add_field("purpose", "batch")
+        form.add_field("file", b"hello world", filename="test.txt")
+        async with sess.post(f"{c.router_url}/v1/files", data=form) as r:
+            assert r.status == 200
+            info = await r.json()
+            assert info["object"] == "file"
+            assert info["bytes"] == 11
+        fid = info["id"]
+        async with sess.get(f"{c.router_url}/v1/files") as r:
+            ids = [f["id"] for f in (await r.json())["data"]]
+            assert fid in ids
+        async with sess.get(f"{c.router_url}/v1/files/{fid}/content") as r:
+            assert await r.read() == b"hello world"
+        async with sess.delete(f"{c.router_url}/v1/files/{fid}") as r:
+            assert (await r.json())["deleted"] is True
+        async with sess.get(f"{c.router_url}/v1/files/{fid}") as r:
+            assert r.status == 404
+
+
+async def test_batch_api_executes_against_backend(tmp_path):
+    async with Cluster(
+        ["--enable-batch-api", "--file-storage-path", str(tmp_path)]
+    ) as c, aiohttp.ClientSession() as sess:
+        lines = [
+            {"custom_id": "a", "method": "POST", "url": "/v1/completions",
+             "body": {"model": "fake/model", "prompt": "x", "max_tokens": 3}},
+            {"custom_id": "b", "method": "POST", "url": "/v1/chat/completions",
+             "body": {"model": "fake/model",
+                      "messages": [{"role": "user", "content": "y"}],
+                      "max_tokens": 3}},
+        ]
+        form = aiohttp.FormData()
+        form.add_field("purpose", "batch")
+        form.add_field(
+            "file", "\n".join(json.dumps(l) for l in lines).encode(),
+            filename="input.jsonl",
+        )
+        async with sess.post(f"{c.router_url}/v1/files", data=form) as r:
+            input_file = (await r.json())["id"]
+        async with sess.post(
+            f"{c.router_url}/v1/batches",
+            json={"input_file_id": input_file, "endpoint": "/v1/completions"},
+        ) as r:
+            batch = await r.json()
+            assert batch["status"] in ("validating", "in_progress")
+
+        for _ in range(80):
+            async with sess.get(f"{c.router_url}/v1/batches/{batch['id']}") as r:
+                batch = await r.json()
+            if batch["status"] in ("completed", "failed"):
+                break
+            await asyncio.sleep(0.25)
+        assert batch["status"] == "completed", batch
+        assert batch["request_counts"]["completed"] == 2
+
+        async with sess.get(
+            f"{c.router_url}/v1/files/{batch['output_file_id']}/content"
+        ) as r:
+            out_lines = (await r.read()).decode().splitlines()
+        assert len(out_lines) == 2
+        by_id = {json.loads(l)["custom_id"]: json.loads(l) for l in out_lines}
+        assert by_id["a"]["response"]["status_code"] == 200
+        assert "choices" in by_id["b"]["response"]["body"]
+
+        # Listing works.
+        async with sess.get(f"{c.router_url}/v1/batches") as r:
+            assert any(b["id"] == batch["id"] for b in (await r.json())["data"])
+
+
+async def test_semantic_cache_serves_repeat(tmp_path):
+    async with Cluster(
+        ["--feature-gates", "SemanticCache=true",
+         "--semantic-cache-dir", str(tmp_path / "cache"),
+         "--semantic-cache-threshold", "0.99"]
+    ) as c, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "fake/model",
+            "messages": [{"role": "user", "content": "what is the capital of peru"}],
+            "max_tokens": 4,
+        }
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+            first = await r.json()
+            assert r.headers.get("X-Semantic-Cache") != "hit"
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Semantic-Cache") == "hit"
+            second = await r.json()
+        assert second["choices"] == first["choices"]
+
+
+async def test_pii_gate_blocks(tmp_path):
+    async with Cluster(
+        ["--feature-gates", "PIIDetection=true"]
+    ) as c, aiohttp.ClientSession() as sess:
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions",
+            json={"model": "fake/model",
+                  "messages": [{"role": "user",
+                                "content": "my ssn is 123-45-6789 please help"}]},
+        ) as r:
+            assert r.status == 400
+            body = await r.json()
+            assert body["error"]["type"] == "pii_detected"
+        # Clean requests pass.
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions",
+            json={"model": "fake/model",
+                  "messages": [{"role": "user", "content": "hello there"}],
+                  "max_tokens": 2},
+        ) as r:
+            assert r.status == 200
