@@ -177,6 +177,7 @@ class TieredAllocator(BlockAllocator):
             collections.deque(maxlen=256)
         )
         self._push_event = threading.Event()
+        self._push_stop = threading.Event()
         self._push_thread: Optional[threading.Thread] = None
         if remote is not None:
             self._push_thread = threading.Thread(
@@ -200,7 +201,7 @@ class TieredAllocator(BlockAllocator):
         self.spilled_blocks += 1
 
     def _push_worker(self) -> None:
-        while True:
+        while not self._push_stop.is_set():
             try:
                 h, k, v = self._push_queue.popleft()
             except IndexError:
@@ -208,6 +209,15 @@ class TieredAllocator(BlockAllocator):
                 self._push_event.clear()
                 continue
             self.remote.put(h, k, v)  # best-effort; client logs failures
+
+    def shutdown(self) -> None:
+        """Stop the push worker (sleep level 2 rebuilds the allocator; without
+        this, every sleep/wake cycle would leak one kv-remote-push thread)."""
+        self._push_stop.set()
+        self._push_event.set()
+        if self._push_thread is not None:
+            self._push_thread.join(timeout=2.0)
+            self._push_thread = None
 
     # -- fault up ---------------------------------------------------------
 

@@ -35,6 +35,10 @@ class EngineConfig:
     max_prefill_tokens: int = 2048
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    # Layer-stage parallelism over the pp mesh axis (the reference's
+    # Ray-cluster `--pipeline-parallel-size`, `ray-cluster.yaml:560-566`).
+    # Stages hold L/pp layers + their KV pages; activations hop via ppermute.
+    pipeline_parallel_size: int = 1
     kv_cache_dtype: Optional[str] = None  # default: model dtype
     attn_impl: str = "auto"  # auto | gather | pallas
     enable_prefix_caching: bool = True
@@ -67,16 +71,17 @@ def resolve_num_kv_blocks(
 ) -> int:
     """Page count from the HBM budget (``--gpu-memory-utilization`` analogue).
 
-    bytes/page = 2 (K+V) * L * bs * KH * hd * itemsize, divided by tp because
-    kv heads are sharded over the tensor axis.
+    bytes/page = 2 (K+V) * L * bs * KH * hd * itemsize, divided by tp (kv
+    heads sharded over the tensor axis) and pp (layers sharded over stages).
     """
     if cfg.num_kv_blocks is not None:
         return cfg.num_kv_blocks
     dtype_size = jax.numpy.dtype(cfg.kv_cache_dtype or model_cfg.dtype).itemsize
     tp = max(cfg.tensor_parallel_size, 1)
+    pp = max(cfg.pipeline_parallel_size, 1)
     page_bytes = (
         2
-        * model_cfg.num_layers
+        * max(model_cfg.num_layers // pp, 1)
         * cfg.block_size
         * max(model_cfg.num_kv_heads // tp, 1)
         * model_cfg.head_dim

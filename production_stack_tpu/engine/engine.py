@@ -146,6 +146,9 @@ class LLMEngine:
         if host_pool is not None or remote is not None:
             from .cache_tiering import TieredAllocator
 
+            old_shutdown = getattr(self.allocator, "shutdown", None)
+            if old_shutdown is not None:
+                old_shutdown()  # stop the old kv-remote-push worker thread
             new = TieredAllocator(
                 self.runner.num_blocks,
                 self.cfg.block_size,

@@ -71,14 +71,23 @@ class ModelRunner:
         self.model_cfg = model_cfg or get_model_config(cfg.model)
         self.model = Llama(self.model_cfg)
         tp = cfg.tensor_parallel_size
+        pp = max(cfg.pipeline_parallel_size, 1)
+        self._pp = pp
         if self.model_cfg.num_kv_heads % max(tp, 1):
             raise ValueError(
                 f"num_kv_heads={self.model_cfg.num_kv_heads} not divisible by "
                 f"tensor_parallel_size={tp}"
             )
+        if self.model_cfg.num_layers % pp:
+            raise ValueError(
+                f"num_layers={self.model_cfg.num_layers} not divisible by "
+                f"pipeline_parallel_size={pp}"
+            )
         self.mesh = mesh or build_mesh(
             MeshConfig(
-                tensor_parallel_size=tp, data_parallel_size=cfg.data_parallel_size
+                tensor_parallel_size=tp,
+                data_parallel_size=cfg.data_parallel_size,
+                pipeline_parallel_size=pp,
             )
         )
 
@@ -87,7 +96,7 @@ class ModelRunner:
             params = load_hf_params(self.model_cfg, cfg.model)
         else:
             params = self.model.init_params(jax.random.PRNGKey(cfg.seed))
-        pspecs = self.model.param_pspecs()
+        pspecs = self.model.param_pspecs(pipeline=pp > 1)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             params,
@@ -104,7 +113,7 @@ class ModelRunner:
             cfg, self.model_cfg, param_bytes // max(tp, 1)
         )
         self.max_table_width = -(-cfg.max_model_len // cfg.block_size)
-        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec())
+        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=pp > 1))
         k, v = self.model.make_kv_cache(
             self.num_blocks, cfg.block_size, cfg.kv_cache_dtype
         )
@@ -119,6 +128,7 @@ class ModelRunner:
 
         model = self.model
         attn_impl = cfg.attn_impl
+        mesh_for_pp = self.mesh if pp > 1 else None
 
         def step(params, k_cache, v_cache, batch: Dict[str, Any]):
             logits, (k_cache, v_cache) = model.forward(
@@ -132,6 +142,8 @@ class ModelRunner:
                 k_cache,
                 v_cache,
                 attn_impl=attn_impl,
+                pp_size=pp,
+                mesh=mesh_for_pp,
             )
             if "penalty_prompt" in batch:
                 logits = apply_penalties(
@@ -187,6 +199,8 @@ class ModelRunner:
                     k_cache,
                     v_cache,
                     attn_impl=attn_impl,
+                    pp_size=pp,
+                    mesh=mesh_for_pp,
                 )
                 nxt = sample_tokens(
                     logits,
@@ -247,7 +261,7 @@ class ModelRunner:
         self.v_cache = None
 
     def restore_kv_cache(self) -> None:
-        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec())
+        cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=self._pp > 1))
         k, v = self.model.make_kv_cache(
             self.num_blocks, self.cfg.block_size, self.cfg.kv_cache_dtype
         )
@@ -265,9 +279,13 @@ class ModelRunner:
         length = np.array([len(token_ids)], np.int32)
         if not hasattr(self, "_encode_fn"):
             model = self.model
+            pp = self._pp
+            mesh_for_pp = self.mesh if pp > 1 else None
 
             def enc(params, toks, length):
-                return model.encode(params, toks, length)
+                return model.encode(
+                    params, toks, length, pp_size=pp, mesh=mesh_for_pp
+                )
 
             self._encode_fn = jax.jit(enc)
         out = self._encode_fn(

@@ -58,6 +58,11 @@ class Scheduler:
         self.allocator = allocator
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
+        # (request_id, num_free) of the last head-of-line admission failure:
+        # until the free-page count changes there is no point re-running the
+        # prefix match every step (it is O(prompt) hashing and would skew the
+        # prefix-cache hit metrics with repeated counted hits).
+        self._admit_blocked: Optional[tuple] = None
 
     # -- queue ops --------------------------------------------------------
 
@@ -158,6 +163,12 @@ class Scheduler:
     def _admit(self, out: SchedulerOutput) -> None:
         while self.waiting and len(self.running) < self.config.max_num_seqs:
             seq = self.waiting[0]
+            if self._admit_blocked == (
+                seq.request_id,
+                self.allocator.num_free,
+                self.config.max_prefill_tokens,
+            ):
+                break  # nothing changed since the last failed attempt
             # Prefix-cache lookup at admission; never match the full token
             # list — at least one token must be computed to produce logits.
             # (all_token_ids, not just the prompt: a preempted-with-outputs
@@ -178,8 +189,23 @@ class Scheduler:
                 seq.num_computed_tokens + first_chunk, self.allocator.block_size
             )
             if need > self.allocator.num_free:
-                break  # engine full; stays queued (vllm:num_requests_waiting)
+                # Engine full; stays queued (vllm:num_requests_waiting). The
+                # prefix blocks adopted above must be released: they are
+                # refcounted and nothing in the preemption path reclaims
+                # pages pinned by *waiting* sequences, so holding them here
+                # could wedge admission permanently. Re-matched next attempt.
+                if seq.block_ids:
+                    self.allocator.release_all(seq.block_ids)
+                    seq.reset_for_recompute()
+                    seq.status = SequenceStatus.WAITING
+                self._admit_blocked = (
+                    seq.request_id,
+                    self.allocator.num_free,
+                    self.config.max_prefill_tokens,
+                )
+                break
             self.waiting.popleft()
+            self._admit_blocked = None
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
 

@@ -146,32 +146,29 @@ def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
 def create_engine_app(
     engine: AsyncLLMEngine, api_key: Optional[str] = None
 ) -> web.Application:
-    app = web.Application(middlewares=[])
+    # Everything except unauthenticated probe/scrape endpoints is guarded
+    # when --api-key is set (/sleep in particular is destructive). Enforced
+    # as a middleware so no handler can be forgotten.
+    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping"}
+
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        if api_key is not None and request.path not in _OPEN_PATHS:
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {api_key}":
+                return _error("invalid API key", 401, "authentication_error")
+        return await handler(request)
+
+    app = web.Application(middlewares=[auth_middleware])
     model_name = engine.engine.model_name
     metrics = EngineMetrics(model_name)
     lora_adapters: List[str] = []
     app["engine"] = engine
     app["metrics"] = metrics
 
-    # -- middleware-ish auth check ------------------------------------
-
-    # Everything except unauthenticated probe/scrape endpoints is guarded
-    # when --api-key is set (/sleep in particular is destructive).
-    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping"}
-
-    def check_auth(request: web.Request) -> Optional[web.Response]:
-        if api_key is None or request.path in _OPEN_PATHS:
-            return None
-        auth = request.headers.get("Authorization", "")
-        if auth != f"Bearer {api_key}":
-            return _error("invalid API key", 401, "authentication_error")
-        return None
-
     # -- model listing -------------------------------------------------
 
     async def list_models(request: web.Request) -> web.Response:
-        if resp := check_auth(request):
-            return resp
         now = int(time.time())
         data = [
             {"id": model_name, "object": "model", "created": now,
@@ -186,8 +183,6 @@ def create_engine_app(
     # -- generation ----------------------------------------------------
 
     async def chat_completions(request: web.Request) -> web.StreamResponse:
-        if resp := check_auth(request):
-            return resp
         try:
             req = ChatCompletionRequest(**await request.json())
         except Exception as e:  # noqa: BLE001
@@ -198,8 +193,6 @@ def create_engine_app(
         return await _serve_generation(request, req, prompt, is_chat=True)
 
     async def completions(request: web.Request) -> web.StreamResponse:
-        if resp := check_auth(request):
-            return resp
         try:
             req = CompletionRequest(**await request.json())
         except Exception as e:  # noqa: BLE001
@@ -409,8 +402,6 @@ def create_engine_app(
     # -- embeddings / rerank / score ----------------------------------
 
     async def embeddings(request: web.Request) -> web.Response:
-        if resp := check_auth(request):
-            return resp
         try:
             req = EmbeddingRequest(**await request.json())
         except Exception as e:  # noqa: BLE001
@@ -605,6 +596,8 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
         "--max-num-batched-tokens", dest="max_prefill_tokens", type=int, default=2048
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1)
+    p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--kv-cache-dtype", default=None)
     p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
@@ -638,6 +631,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_num_seqs=args.max_num_seqs,
         max_prefill_tokens=args.max_prefill_tokens,
         tensor_parallel_size=args.tensor_parallel_size,
+        pipeline_parallel_size=args.pipeline_parallel_size,
+        data_parallel_size=args.data_parallel_size,
         kv_cache_dtype=args.kv_cache_dtype,
         attn_impl=args.attn_impl,
         enable_prefix_caching=args.enable_prefix_caching,
@@ -682,6 +677,16 @@ async def controller_report_loop(
 
 
 def main(argv=None) -> None:
+    # Honor JAX_PLATFORMS even when a sitecustomize already registered a
+    # device plugin before this process's env was consulted (jax.config wins
+    # over plugin registration as long as no backend has initialized yet).
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     args = parse_engine_args(argv)
     cfg = engine_config_from_args(args)
     engine = AsyncLLMEngine(cfg)

@@ -38,11 +38,61 @@ from jax.sharding import PartitionSpec as P
 
 from ..logging_utils import init_logger
 from ..ops.attention import paged_attention
-from ..parallel.mesh import AXIS_TENSOR
+from ..parallel.mesh import AXIS_PIPELINE, AXIS_TENSOR
 
 logger = init_logger(__name__)
 
 Params = Dict[str, Any]
+
+
+def pp_compose(run_stage, x, replicated, scanned, pp_size: int, mesh):
+    """Compose layer-stages across the ``pp`` mesh axis by rotating
+    activations (TPU-native pipeline parallel; replaces the reference's
+    Ray-cluster PP, ``helm/templates/ray-cluster.yaml:560-566``).
+
+    Each pp rank holds ``L/pp`` layers (the ``scanned`` pytrees are sharded on
+    their leading layer axis). The activation makes ``pp`` hops: at hop ``i``
+    rank ``i`` holds the correctly-composed prefix, applies its local layers,
+    and ``ppermute``s the result to rank ``i+1``; other ranks compute on
+    rotated (discarded) lanes, so wall-clock equals the sequential depth while
+    HBM per device drops by ``pp``. Rank 0 ends with the full composition,
+    which a masked ``psum`` broadcasts. Collectives are point-to-point
+    ``ppermute``s — DCN-friendly, exactly the inter-host traffic pattern PP
+    wants (the tp all-reduces stay inside each stage on ICI, handled by GSPMD
+    auto mode since only ``pp`` is manual here).
+
+    ``run_stage(x, scanned_local, gate)`` applies the local layer stack;
+    ``gate`` is a bool scalar — True only on the hop where this rank's input
+    is the real composition, letting the stage suppress side effects (KV
+    cache writes) on garbage lanes. Returns ``(x, scanned_local_out)``.
+
+    ``replicated`` arrays (rope tables, block tables, …) are passed through
+    explicitly — closed-over traced values would carry auto-mesh shardings
+    that clash with the manual-``pp`` context.
+    """
+    perm = [(j, (j + 1) % pp_size) for j in range(pp_size)]
+
+    def body(x, repl, *scanned_local):
+        rank = jax.lax.axis_index(AXIS_PIPELINE)
+        out_scanned = scanned_local
+        for i in range(pp_size):
+            x_out, out_scanned = run_stage(x, repl, out_scanned, rank == i)
+            x = jax.lax.ppermute(x_out, AXIS_PIPELINE, perm)
+        x = jax.lax.psum(
+            jnp.where(rank == 0, x, jnp.zeros_like(x)), AXIS_PIPELINE
+        )
+        return (x, *out_scanned)
+
+    pp_spec = P(AXIS_PIPELINE)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), *([pp_spec] * len(scanned))),
+        out_specs=(P(), *([pp_spec] * len(scanned))),
+        axis_names={AXIS_PIPELINE},
+        check_vma=False,
+    )(x, replicated, *scanned)
+    return out[0], out[1:]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,9 +222,11 @@ class Llama:
         return jnp.zeros(shape, d), jnp.zeros(shape, d)
 
     @staticmethod
-    def cache_pspec() -> P:
-        # [L, KH, nb, bs, hd] — kv heads over tp.
-        return P(None, AXIS_TENSOR, None, None, None)
+    def cache_pspec(pipeline: bool = False) -> P:
+        # [L, KH, nb, bs, hd] — kv heads over tp; layers over pp when the
+        # engine runs pipeline-parallel (each stage holds its layers' pages).
+        pp = AXIS_PIPELINE if pipeline else None
+        return P(pp, AXIS_TENSOR, None, None, None)
 
     # ------------------------------------------------------------------
     # Forward
@@ -193,8 +245,15 @@ class Llama:
         v_cache: jax.Array,
         *,
         attn_impl: str = "auto",
+        pp_size: int = 1,
+        mesh=None,
     ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-        """One engine step. Returns (last-token logits [B, V], new caches)."""
+        """One engine step. Returns (last-token logits [B, V], new caches).
+
+        With ``pp_size > 1`` the stacked layer axis (params and caches) is
+        sharded over the ``pp`` mesh axis and composed via
+        :func:`pp_compose`; ``mesh`` must be the engine mesh.
+        """
         cfg = self.cfg
         B, T = tokens.shape
         nb, bs = k_cache.shape[2], k_cache.shape[3]
@@ -202,9 +261,12 @@ class Llama:
 
         x = params["embed"][tokens]  # [B, T, D]
         rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
-        flat_write = write_idx.reshape(-1)  # [B*T]
+        flat_write_real = write_idx.reshape(-1)  # [B*T]
 
-        def layer(x, scanned):
+        def layer_fn(ctx, x, scanned):
+            # ctx: traced arrays shared by every layer. Threaded explicitly
+            # (not closed over) so the pp shard_map can pass them through.
+            flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
             lp, k_pages, v_pages = scanned  # caches: [KH, nb, bs, hd]
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q = _proj(h, lp["wq"], lp.get("bq"))
@@ -263,9 +325,33 @@ class Llama:
             ).astype(x.dtype)
             return x, (k_pages, v_pages)
 
-        x, (k_cache, v_cache) = jax.lax.scan(
-            layer, x, (params["layers"], k_cache, v_cache)
-        )
+        ctx = (flat_write_real, rope_cos, rope_sin, block_tables, kv_lens,
+               positions)
+        if pp_size > 1:
+            def run_stage(x, repl, scanned_local, gate):
+                fw, *rest = repl
+                # Suppress cache writes on garbage (rotated) lanes: only the
+                # hop where this rank's input is the true composition may
+                # write KV; others write to the dropped slot (nb*bs).
+                fw = jnp.where(gate, fw, nb * bs)
+                layers_local, k_local, v_local = scanned_local
+                x, (k_local, v_local) = jax.lax.scan(
+                    lambda c, s: layer_fn((fw, *rest), c, s),
+                    x,
+                    (layers_local, k_local, v_local),
+                )
+                return x, (layers_local, k_local, v_local)
+
+            x, (_, k_cache, v_cache) = pp_compose(
+                run_stage, x, ctx, (params["layers"], k_cache, v_cache),
+                pp_size, mesh,
+            )
+        else:
+            x, (k_cache, v_cache) = jax.lax.scan(
+                lambda c, s: layer_fn(ctx, c, s),
+                x,
+                (params["layers"], k_cache, v_cache),
+            )
 
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -276,7 +362,13 @@ class Llama:
         return logits, (k_cache, v_cache)
 
     def encode(
-        self, params: Params, tokens: jax.Array, lengths: jax.Array
+        self,
+        params: Params,
+        tokens: jax.Array,
+        lengths: jax.Array,
+        *,
+        pp_size: int = 1,
+        mesh=None,
     ) -> jax.Array:
         """Embedding path (/v1/embeddings): full causal attention, no cache;
         returns L2-normalized mean-pooled final hidden states [B, D]."""
@@ -291,7 +383,8 @@ class Llama:
         ) & valid[:, None, :]  # [B, T, S]
         G = cfg.num_heads // cfg.num_kv_heads
 
-        def layer(x, lp):
+        def layer(ctx, x, lp):
+            rope_cos, rope_sin, causal = ctx
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q = _proj(h, lp["wq"], lp.get("bq")).reshape(
                 B, T, cfg.num_kv_heads, G, cfg.head_dim
@@ -328,7 +421,20 @@ class Llama:
             ).astype(x.dtype)
             return x, None
 
-        x, _ = jax.lax.scan(layer, x, params["layers"])
+        ctx = (rope_cos, rope_sin, causal)
+        if pp_size > 1:
+            def run_stage(x, repl, scanned_local, gate):
+                (layers_local,) = scanned_local
+                x, _ = jax.lax.scan(
+                    lambda c, s: layer(repl, c, s), x, layers_local
+                )
+                return x, (layers_local,)
+
+            x, _ = pp_compose(
+                run_stage, x, ctx, (params["layers"],), pp_size, mesh
+            )
+        else:
+            x, _ = jax.lax.scan(lambda c, s: layer(ctx, c, s), x, params["layers"])
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         mask = valid[..., None].astype(jnp.float32)
         pooled = (x.astype(jnp.float32) * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)

@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     # Files / batches
     p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument(
+        "--batch-db-path", default=None,
+        help="SQLite path for the batch queue (default: <file-storage-path>/batches.sqlite)",
+    )
     p.add_argument("--file-storage-class", default="local_file")
     p.add_argument("--file-storage-path", default="/tmp/pst_files")
     p.add_argument("--batch-processor", default="local")

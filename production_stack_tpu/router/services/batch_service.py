@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sqlite3
 import time
 import uuid
@@ -251,9 +252,15 @@ class LocalBatchProcessor:
 
 
 def install_batch_api(app: web.Application, args) -> None:
-    processor = LocalBatchProcessor(
-        getattr(args, "batch_db_path", None) or "/tmp/pst_batches.sqlite", app
-    )
+    # Default the queue DB under this instance's file-storage root: a shared
+    # host-global path would let two routers on one host steal each other's
+    # queued batches (each marking the other's inputs missing → failed).
+    db_path = getattr(args, "batch_db_path", None)
+    if not db_path:
+        root = getattr(args, "file_storage_path", None) or "/tmp/pst_files"
+        os.makedirs(root, exist_ok=True)
+        db_path = os.path.join(root, "batches.sqlite")
+    processor = LocalBatchProcessor(db_path, app)
     app["batch_processor"] = processor
 
     async def create(request: web.Request) -> web.Response:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -23,6 +24,21 @@ from ...logging_utils import init_logger
 logger = init_logger(__name__)
 
 _CHUNK = 1 << 20
+
+# aiohttp percent-decodes match_info, so a file_id of ``..%2F..%2Fetc/passwd``
+# reaches the storage layer as a relative path. Path components must match a
+# strict allowlist — no separators, no '..' — before any filesystem use.
+_SAFE_COMPONENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+def _check_component(name: str, what: str) -> str:
+    if not _SAFE_COMPONENT.match(name) or ".." in name:
+        raise ValueError(f"invalid {what}: {name!r}")
+    if name.endswith(".json"):
+        # A file id of '<fid>.json' would alias file <fid>'s metadata
+        # sidecar, exposing or deleting another file's metadata.
+        raise ValueError(f"invalid {what}: {name!r} (reserved suffix)")
+    return name
 
 
 @dataclasses.dataclass
@@ -53,15 +69,23 @@ class FileStorage:
         os.makedirs(base_path, exist_ok=True)
 
     def _dir(self, user: str) -> str:
-        d = os.path.join(self.base_path, user)
+        d = os.path.join(self.base_path, _check_component(user, "user"))
         os.makedirs(d, exist_ok=True)
         return d
 
+    def _resolve(self, user: str, name: str) -> str:
+        """Join + belt-and-braces realpath containment check."""
+        path = os.path.join(self._dir(user), name)
+        base = os.path.realpath(self.base_path)
+        if os.path.commonpath([os.path.realpath(path), base]) != base:
+            raise ValueError(f"path escapes storage root: {name!r}")
+        return path
+
     def _meta_path(self, user: str, file_id: str) -> str:
-        return os.path.join(self._dir(user), file_id + ".json")
+        return self._resolve(user, _check_component(file_id, "file id") + ".json")
 
     def _data_path(self, user: str, file_id: str) -> str:
-        return os.path.join(self._dir(user), file_id)
+        return self._resolve(user, _check_component(file_id, "file id"))
 
     async def save_file(
         self,
@@ -163,8 +187,16 @@ def install_files_api(app: web.Application, args) -> None:
             {"object": "list", "data": [f.to_dict() for f in files]}
         )
 
+    def _bad_id(e: ValueError) -> web.Response:
+        return web.json_response(
+            {"error": {"message": str(e), "code": 400}}, status=400
+        )
+
     async def get(request: web.Request) -> web.Response:
-        info = await storage.get_file(request.match_info["file_id"])
+        try:
+            info = await storage.get_file(request.match_info["file_id"])
+        except ValueError as e:
+            return _bad_id(e)
         if info is None:
             return web.json_response(
                 {"error": {"message": "file not found", "code": 404}}, status=404
@@ -172,7 +204,10 @@ def install_files_api(app: web.Application, args) -> None:
         return web.json_response(info.to_dict())
 
     async def content(request: web.Request) -> web.Response:
-        data = await storage.get_file_content(request.match_info["file_id"])
+        try:
+            data = await storage.get_file_content(request.match_info["file_id"])
+        except ValueError as e:
+            return _bad_id(e)
         if data is None:
             return web.json_response(
                 {"error": {"message": "file not found", "code": 404}}, status=404
@@ -180,7 +215,10 @@ def install_files_api(app: web.Application, args) -> None:
         return web.Response(body=data, content_type="application/octet-stream")
 
     async def delete(request: web.Request) -> web.Response:
-        ok = await storage.delete_file(request.match_info["file_id"])
+        try:
+            ok = await storage.delete_file(request.match_info["file_id"])
+        except ValueError as e:
+            return _bad_id(e)
         return web.json_response(
             {"id": request.match_info["file_id"], "object": "file", "deleted": ok}
         )

@@ -149,14 +149,6 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         if blocked is not None:
             return blocked
 
-    # Semantic cache probe (experimental): a hit short-circuits routing
-    # entirely (reference main_router.py:47-54 check_semantic_cache).
-    cache_check = request.app.get("semantic_cache_check")
-    if cache_check is not None and endpoint == "/v1/chat/completions":
-        cached = await cache_check(request_json)
-        if cached is not None:
-            return cached
-
     discovery = get_service_discovery()
     endpoints = discovery.get_endpoint_info()
 
@@ -173,6 +165,19 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     if rewritten != body.decode():
         body = rewritten.encode()
         request_json = json.loads(rewritten)
+    # The store hook (proxy_and_stream) keys off parsed_json — keep it the
+    # same dict the cache probe below sees, or check/store keys diverge.
+    request["parsed_json"] = request_json
+
+    # Semantic cache probe (experimental): a hit short-circuits routing
+    # entirely (reference main_router.py:47-54 check_semantic_cache). Runs
+    # after alias resolution + rewriting so cache lookups and stores key on
+    # the same (resolved) model string and final message content.
+    cache_check = request.app.get("semantic_cache_check")
+    if cache_check is not None and endpoint == "/v1/chat/completions":
+        cached = await cache_check(request_json)
+        if cached is not None:
+            return cached
 
     router = get_routing_logic()
     is_disagg = isinstance(router, DisaggregatedPrefillRouter)

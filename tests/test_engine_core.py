@@ -228,6 +228,36 @@ def test_tensor_parallel_matches_single_chip(ref):
     assert got["token_ids"] == expected
 
 
+def test_pipeline_parallel_matches_single_chip(ref):
+    cfg, params = ref
+    eng_pp = make_engine(pipeline_parallel_size=2, tensor_parallel_size=2)
+    expected = naive_greedy(cfg, params, PROMPT, 8, eos_ids=cfg.eos_token_ids)
+    got = eng_pp.generate([list(PROMPT)], SamplingParams(max_tokens=8, temperature=0.0))[0]
+    assert got["token_ids"] == expected
+
+
+def test_dp_pp_tp_full_mesh_matches(ref):
+    """dp×pp×tp over all 8 virtual devices — the v5e-16-pool layout class."""
+    cfg, params = ref
+    eng = make_engine(
+        data_parallel_size=2, pipeline_parallel_size=2, tensor_parallel_size=2
+    )
+    prompts = [list(PROMPT), list(reversed(PROMPT))]
+    outs = eng.generate(prompts, SamplingParams(max_tokens=6, temperature=0.0))
+    for p, out in zip(prompts, outs):
+        assert out["token_ids"] == naive_greedy(
+            cfg, params, p, 6, eos_ids=cfg.eos_token_ids
+        )
+
+
+def test_pipeline_parallel_multi_step_decode(ref):
+    cfg, params = ref
+    eng = make_engine(pipeline_parallel_size=2, num_decode_steps=4)
+    expected = naive_greedy(cfg, params, PROMPT, 8, eos_ids=cfg.eos_token_ids)
+    got = eng.generate([list(PROMPT)], SamplingParams(max_tokens=8, temperature=0.0))[0]
+    assert got["token_ids"] == expected
+
+
 def test_multi_step_decode_matches_single_step(ref):
     cfg, params = ref
     eng = make_engine(num_decode_steps=8)

@@ -192,5 +192,15 @@ async def test_api_key_auth():
                 # Non-/v1 endpoints (health/metrics probes) stay open.
                 async with sess.get(f"{url}/health") as r:
                     assert r.status == 200
+                # Destructive/admin endpoints must also be guarded: /sleep
+                # level 2 aborts all requests and drops the KV cache.
+                for path in ("/sleep?level=2", "/wake_up",
+                             "/v1/load_lora_adapter"):
+                    async with sess.post(f"{url}{path}") as r:
+                        assert r.status == 401, path
+                for path in ("/rerank", "/score", "/tokenize", "/detokenize"):
+                    async with sess.post(f"{url}{path}", json={}) as r:
+                        assert r.status == 401, path
+                assert not server.engine.sleeping
         finally:
             await runner.cleanup()

@@ -81,6 +81,25 @@ async def test_files_api_roundtrip(tmp_path):
             assert r.status == 404
 
 
+async def test_files_api_rejects_path_traversal(tmp_path):
+    secret = tmp_path.parent / "secret.txt"
+    secret.write_text("topsecret")
+    async with Cluster(
+        ["--enable-batch-api", "--file-storage-path", str(tmp_path)]
+    ) as c, aiohttp.ClientSession() as sess:
+        # aiohttp percent-decodes match_info: ..%2F.. becomes ../.. inside
+        # the handler. Reads and deletes outside the root must be refused.
+        evil = "..%2Fsecret.txt"
+        async with sess.get(f"{c.router_url}/v1/files/{evil}/content") as r:
+            assert r.status == 400
+            assert b"topsecret" not in await r.read()
+        async with sess.delete(f"{c.router_url}/v1/files/{evil}") as r:
+            assert r.status == 400
+        assert secret.exists()
+        async with sess.get(f"{c.router_url}/v1/files/{evil}") as r:
+            assert r.status == 400
+
+
 async def test_batch_api_executes_against_backend(tmp_path):
     async with Cluster(
         ["--enable-batch-api", "--file-storage-path", str(tmp_path)]

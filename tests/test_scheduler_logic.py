@@ -1,0 +1,79 @@
+"""Pure-logic scheduler/allocator regression tests (no device work).
+
+Ring-1 strategy (SURVEY.md §4): stub-free unit tests over the admission and
+preemption state machine alone.
+"""
+
+from production_stack_tpu.engine.kv_manager import BlockAllocator
+from production_stack_tpu.engine.scheduler import Scheduler, SchedulerConfig
+from production_stack_tpu.engine.sequence import (
+    SamplingParams,
+    Sequence,
+    SequenceStatus,
+)
+
+
+def _sched(num_blocks=8, bs=4, **over):
+    alloc = BlockAllocator(num_blocks, bs, enable_prefix_caching=True)
+    kw = dict(max_num_seqs=4, max_prefill_tokens=64, max_model_len=256)
+    kw.update(over)
+    return Scheduler(SchedulerConfig(**kw), alloc), alloc
+
+
+def test_admission_releases_pinned_prefix_on_capacity_shortfall():
+    """A waiting seq whose prefix-cache hit pins pages must surrender them
+    when the capacity check fails — otherwise admission can deadlock with
+    nothing running and most pages pinned by un-admittable waiters."""
+    sched, alloc = _sched(num_blocks=8, bs=4)
+
+    # Request A computes 24 prompt tokens (6 pages) and finishes, leaving
+    # those pages cached (refcount 0, reusable).
+    a = Sequence("a", list(range(1, 25)), SamplingParams(max_tokens=1))
+    sched.add(a)
+    out = sched.schedule()
+    assert out.prefills and out.prefills[0].seq is a
+    a.num_computed_tokens = out.prefills[0].end
+    a.commit_full_blocks(alloc)
+    sched.finish(a, "stop")
+    assert alloc.num_free == 8
+
+    # Request B shares A's 24-token prefix but needs 10 pages total — the
+    # prefix match pins 6, the remaining need (4) exceeds the 2 untouched
+    # pages, so B cannot be admitted this round.
+    b = Sequence("b", list(range(1, 25)) + list(range(100, 116)),
+                 SamplingParams(max_tokens=1))
+    sched.add(b)
+    out = sched.schedule()
+    assert not out.prefills and b.status == SequenceStatus.WAITING
+    # The regression: B must not keep the 6 matched pages pinned while
+    # waiting — every page must be back in the reusable pool, and repeated
+    # scheduling attempts must not leak pins either.
+    assert b.block_ids == []
+    assert alloc.num_free == 8
+    for _ in range(3):
+        sched.schedule()
+        assert b.block_ids == [] and alloc.num_free == 8
+
+
+def test_admission_rematches_prefix_once_space_frees():
+    sched, alloc = _sched(num_blocks=8, bs=4)
+    a = Sequence("a", list(range(1, 25)), SamplingParams(max_tokens=1))
+    sched.add(a)
+    out = sched.schedule()
+    a.num_computed_tokens = out.prefills[0].end
+    a.commit_full_blocks(alloc)
+    sched.finish(a, "stop")
+
+    b = Sequence("b", list(range(1, 25)) + list(range(100, 116)),
+                 SamplingParams(max_tokens=1))
+    sched.add(b)
+    sched.schedule()  # rejected: needs 10 pages, only 8 exist... with chunking
+    # With a smaller first chunk the same request fits: shrink the budget so
+    # the first chunk needs fewer new pages than are free.
+    sched.config = SchedulerConfig(
+        max_num_seqs=4, max_prefill_tokens=8, max_model_len=256
+    )
+    out = sched.schedule()
+    assert any(item.seq is b for item in out.prefills)
+    # Prefix hit was re-established on the second attempt.
+    assert b.num_cached_prompt_tokens == 24
