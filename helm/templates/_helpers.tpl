@@ -16,3 +16,138 @@ environment: production-stack-tpu
 {{ default "default" .Values.serviceAccount.name }}
 {{- end -}}
 {{- end -}}
+
+{{/*
+Pod spec shared by the multi-host leader and worker templates.
+dict args: root (chart root), ms (modelSpec entry), leader (bool).
+Leader and workers run the same binary: process id / coordinator env decide
+whether a pod serves HTTP (host 0) or runs the follower loop.
+*/}}
+{{- define "pst.multihostPodSpec" -}}
+{{- $root := .root -}}
+{{- $ms := .ms -}}
+{{- if $ms.tpu }}
+nodeSelector:
+  cloud.google.com/gke-tpu-accelerator: "{{ $ms.tpu.accelerator }}"
+  cloud.google.com/gke-tpu-topology: "{{ $ms.tpu.topology }}"
+  {{- with $ms.nodeSelectorExtra }}{{ toYaml . | nindent 2 }}{{- end }}
+{{- end }}
+{{- with $ms.tolerations }}
+tolerations: {{- toYaml . | nindent 2 }}
+{{- end }}
+containers:
+  - name: engine
+    image: "{{ $root.Values.image.repository }}:{{ $root.Values.image.tag }}"
+    imagePullPolicy: {{ $root.Values.image.pullPolicy }}
+    command: ["pst-engine"]
+    args:
+      - "--model"
+      - "{{ $ms.model }}"
+      {{- if $ms.servedModelName }}
+      - "--served-model-name"
+      - "{{ $ms.servedModelName }}"
+      {{- end }}
+      - "--host"
+      - "0.0.0.0"
+      - "--port"
+      - "8000"
+      {{- with $ms.engineConfig }}
+      - "--max-model-len"
+      - "{{ .maxModelLen | default 4096 }}"
+      - "--max-num-seqs"
+      - "{{ .maxNumSeqs | default 64 }}"
+      - "--max-num-batched-tokens"
+      - "{{ .maxNumBatchedTokens | default 2048 }}"
+      - "--tensor-parallel-size"
+      - "{{ .tensorParallelSize | default 1 }}"
+      - "--pipeline-parallel-size"
+      - "{{ .pipelineParallelSize | default 1 }}"
+      - "--data-parallel-size"
+      - "{{ .dataParallelSize | default 1 }}"
+      - "--block-size"
+      - "{{ .blockSize | default 32 }}"
+      - "--gpu-memory-utilization"
+      - "{{ .hbmUtilization | default 0.9 }}"
+      - "--attn-impl"
+      - "{{ .attnImpl | default "auto" }}"
+      {{- if .kvCacheDtype }}
+      - "--kv-cache-dtype"
+      - "{{ .kvCacheDtype }}"
+      {{- end }}
+      {{- if eq (toString .enablePrefixCaching) "false" }}
+      - "--no-enable-prefix-caching"
+      {{- end }}
+      {{- range .extraArgs }}
+      - {{ . | quote }}
+      {{- end }}
+      {{- end }}
+      {{- with $ms.kvCache }}
+      {{- if .cpuOffloadBlocks }}
+      - "--cpu-offload-blocks"
+      - "{{ .cpuOffloadBlocks }}"
+      {{- end }}
+      {{- if .useRemoteStore }}
+      - "--remote-kv-url"
+      - "http://{{ include "pst.fullname" $root }}-cache-server:{{ $root.Values.cacheServerSpec.port }}"
+      {{- end }}
+      {{- if and .kvRole (ne .kvRole "none") }}
+      - "--kv-role"
+      - "{{ .kvRole }}"
+      {{- end }}
+      {{- end }}
+      {{- if $root.Values.kvControllerSpec.enableController }}
+      - "--cache-controller-url"
+      - "http://{{ include "pst.fullname" $root }}-kv-controller:{{ $root.Values.kvControllerSpec.port }}"
+      {{- end }}
+      {{- if $root.Values.servingEngineSpec.apiKeySecret }}
+      - "--api-key"
+      - "$(PST_API_KEY)"
+      {{- end }}
+    env:
+      {{- if $root.Values.servingEngineSpec.apiKeySecret }}
+      - name: PST_API_KEY
+        valueFrom:
+          secretKeyRef:
+            name: {{ $root.Values.servingEngineSpec.apiKeySecret }}
+            key: api-key
+      {{- end }}
+      # jax.distributed boot (production_stack_tpu/parallel/distributed.py).
+      # LWS injects LWS_LEADER_ADDRESS on every pod in the group and
+      # the group size; worker index 0 is the leader pod itself.
+      - name: PST_COORDINATOR_ADDRESS
+        value: "$(LWS_LEADER_ADDRESS):8476"
+      - name: PST_NUM_PROCESSES
+        value: "{{ $ms.multiHost.size }}"
+      - name: PST_PROCESS_ID
+        valueFrom:
+          fieldRef:
+            fieldPath: metadata.labels['leaderworkerset.sigs.k8s.io/worker-index']
+      {{- range $ms.env }}
+      - name: {{ .name }}
+        value: {{ .value | quote }}
+      {{- end }}
+    ports:
+      - containerPort: 8000
+      - containerPort: 8476
+    resources:
+      requests:
+        cpu: {{ $ms.requestCPU | default 8 | quote }}
+        memory: {{ $ms.requestMemory | default "32Gi" | quote }}
+        {{- if $ms.tpu }}
+        google.com/tpu: {{ $ms.tpu.chips | quote }}
+        {{- end }}
+      {{- if $ms.tpu }}
+      limits:
+        google.com/tpu: {{ $ms.tpu.chips | quote }}
+      {{- end }}
+    {{- if .leader }}
+    startupProbe:
+      httpGet: { path: /health, port: 8000 }
+      failureThreshold: 120
+      periodSeconds: 10
+    livenessProbe:
+      httpGet: { path: /health, port: 8000 }
+      periodSeconds: 15
+      failureThreshold: 4
+    {{- end }}
+{{- end -}}

@@ -87,7 +87,10 @@ def resolve_num_kv_blocks(
         * model_cfg.head_dim
         * dtype_size
     )
-    dev = jax.devices()[0]
+    # local_devices, not devices: on a multi-host mesh devices()[0] may be
+    # non-addressable here, and a swallowed memory_stats failure would give
+    # followers a different page count than the primary (shape divergence).
+    dev = jax.local_devices()[0]
     stats = {}
     try:
         stats = dev.memory_stats() or {}

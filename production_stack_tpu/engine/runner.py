@@ -15,6 +15,7 @@ Sampling runs inside the same jit (logits never leave the device); only the
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence as Seq
 
@@ -110,7 +111,7 @@ class ModelRunner:
         )
 
         self.num_blocks = resolve_num_kv_blocks(
-            cfg, self.model_cfg, param_bytes // max(tp, 1)
+            cfg, self.model_cfg, param_bytes // (max(tp, 1) * pp)
         )
         self.max_table_width = -(-cfg.max_model_len // cfg.block_size)
         cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=pp > 1))
@@ -164,7 +165,14 @@ class ModelRunner:
             )
             return toks, k_cache, v_cache
 
-        self._step = jax.jit(step, donate_argnums=(1, 2))
+        # Sampled tokens come back replicated: on a multi-host mesh the
+        # primary must be able to device_get them (only addressable shards
+        # are fetchable), and an all-gather of [B] int32 is free.
+        self._step = jax.jit(
+            step,
+            donate_argnums=(1, 2),
+            out_shardings=(self._repl, cache_sh, cache_sh),
+        )
 
         bs = cfg.block_size
         drop_slot = self.num_blocks * bs
@@ -219,8 +227,20 @@ class ModelRunner:
             return toks.T, k_cache, v_cache  # [B, n_steps]
 
         self._multi_step = jax.jit(
-            multi_step, static_argnums=(4,), donate_argnums=(1, 2)
+            multi_step,
+            static_argnums=(4,),
+            donate_argnums=(1, 2),
+            out_shardings=(self._repl, cache_sh, cache_sh),
         )
+        # Multi-host control plane (None on single-host): installed by the
+        # server when jax.process_count() > 1; every device dispatch below
+        # announces first so followers issue the identical XLA call.
+        self.publisher = None
+        # Serializes announce+dispatch pairs: the engine step thread and the
+        # executor threads serving /v1/embeddings//rerank//score would
+        # otherwise interleave broadcasts, diverging the followers' XLA
+        # program order from the primary's (collective deadlock).
+        self._device_lock = threading.RLock()
 
     # ------------------------------------------------------------------
     # Page I/O for KV tiering (HBM ↔ host DRAM, the LMCache-offload hook).
@@ -229,14 +249,28 @@ class ModelRunner:
 
     def download_page(self, blk: int):
         """Fetch one page's K/V across all layers → host numpy [L, KH, bs, hd]."""
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("download_page", int(blk))
+            return self._dispatch_download_page(blk)
+
+    def _dispatch_download_page(self, blk: int):
         if not hasattr(self, "_page_get"):
-            self._page_get = jax.jit(lambda c, i: c[:, :, i])
+            self._page_get = jax.jit(
+                lambda c, i: c[:, :, i], out_shardings=self._repl
+            )
         k = np.asarray(jax.device_get(self._page_get(self.k_cache, blk)))
         v = np.asarray(jax.device_get(self._page_get(self.v_cache, blk)))
         return k, v
 
     def upload_page(self, blk: int, k_np, v_np) -> None:
         """Install host page data into HBM page ``blk`` (donated, in-place)."""
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("upload_page", (int(blk), k_np, v_np))
+            self._dispatch_upload_page(blk, k_np, v_np)
+
+    def _dispatch_upload_page(self, blk: int, k_np, v_np) -> None:
         if not hasattr(self, "_page_set"):
             self._page_set = jax.jit(
                 lambda c, i, x: c.at[:, :, i].set(x), donate_argnums=(0,)
@@ -255,12 +289,24 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     def drop_kv_cache(self) -> None:
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("drop_kv", None)
+            self._dispatch_drop_kv()
+
+    def _dispatch_drop_kv(self) -> None:
         self.k_cache.delete()
         self.v_cache.delete()
         self.k_cache = None
         self.v_cache = None
 
     def restore_kv_cache(self) -> None:
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("restore_kv", None)
+            self._dispatch_restore_kv()
+
+    def _dispatch_restore_kv(self) -> None:
         cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=self._pp > 1))
         k, v = self.model.make_kv_cache(
             self.num_blocks, self.cfg.block_size, self.cfg.kv_cache_dtype
@@ -277,6 +323,12 @@ class ModelRunner:
         toks = np.zeros((1, T), np.int32)
         toks[0, : len(token_ids)] = token_ids
         length = np.array([len(token_ids)], np.int32)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("encode", (toks, length))
+            return self._dispatch_encode(toks, length)
+
+    def _dispatch_encode(self, toks: np.ndarray, length: np.ndarray) -> np.ndarray:
         if not hasattr(self, "_encode_fn"):
             model = self.model
             pp = self._pp
@@ -287,7 +339,7 @@ class ModelRunner:
                     params, toks, length, pp_size=pp, mesh=mesh_for_pp
                 )
 
-            self._encode_fn = jax.jit(enc)
+            self._encode_fn = jax.jit(enc, out_shardings=self._repl)
         out = self._encode_fn(
             self.params,
             jax.device_put(toks, self._repl),
@@ -310,6 +362,12 @@ class ModelRunner:
         if n_steps == 1:
             return self.execute_decode(seqs)[:, None]
         batch = self._decode_batch(seqs, multi=True)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("multi_step", (batch, n_steps))
+            return self._dispatch_multi_step(batch, n_steps)[: len(seqs)]
+
+    def _dispatch_multi_step(self, batch: Dict[str, np.ndarray], n_steps: int) -> np.ndarray:
         B = batch["kv_lens"].shape[0]
         row_shard = self._dp > 1 and B % self._dp == 0
         dev_batch = {
@@ -319,7 +377,7 @@ class ModelRunner:
         toks, self.k_cache, self.v_cache = self._multi_step(
             self.params, self.k_cache, self.v_cache, dev_batch, n_steps
         )
-        return np.asarray(jax.device_get(toks))[: len(seqs)]
+        return np.asarray(jax.device_get(toks))
 
     def execute_prefill(self, item: PrefillItem) -> int:
         """Process one prefill chunk; returns the sampled token id (only
@@ -334,6 +392,12 @@ class ModelRunner:
         return self._run(batch)[: len(items)]
 
     def _run(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("step", batch)
+            return self._dispatch_step(batch)
+
+    def _dispatch_step(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         B = batch["kv_lens"].shape[0]
         row_shard = self._dp > 1 and B % self._dp == 0
         dev_batch = {

@@ -689,7 +689,24 @@ def main(argv=None) -> None:
 
     args = parse_engine_args(argv)
     cfg = engine_config_from_args(args)
+
+    # Multi-host boot (the ray-cluster head/worker analogue): every process
+    # joins the jax.distributed runtime; host 0 serves HTTP, the rest mirror
+    # device steps (SURVEY.md §7 hard part 3 — single-program serving).
+    from ..parallel.distributed import is_primary, maybe_init_distributed
+
+    multihost = maybe_init_distributed()
+    if multihost and not is_primary():
+        from .multihost import make_follower_runner, run_follower
+
+        run_follower(make_follower_runner(cfg))
+        return
+
     engine = AsyncLLMEngine(cfg)
+    if multihost:
+        from .multihost import StepPublisher
+
+        engine.engine.runner.publisher = StepPublisher()
     app = create_engine_app(engine, api_key=args.api_key)
 
     async def on_startup(app):
@@ -706,6 +723,9 @@ def main(argv=None) -> None:
         task = app.get("controller_task")
         if task:
             task.cancel()
+        publisher = engine.engine.runner.publisher
+        if publisher is not None:
+            publisher.shutdown()  # release follower loops before exiting
         engine.shutdown()
 
     app.on_startup.append(on_startup)

@@ -1,0 +1,129 @@
+"""Multi-host runtime: process boot, host-0 serving, control-plane broadcast.
+
+The reference serves multi-host models by wrapping vLLM in a Ray cluster
+(`helm/templates/ray-cluster.yaml:3-15,520,560-566`): the head pod runs the
+HTTP server, workers join via Ray, NCCL carries tensors. TPU-native there is
+no Ray: every host runs the *same* SPMD program under ``jax.distributed``,
+XLA moves tensors over ICI/DCN, and the only extra machinery needed is a
+small control plane:
+
+- :func:`maybe_init_distributed` — ``jax.distributed.initialize`` from env
+  (K8s JobSet/LeaderWorkerSet downward-API env vars; see
+  ``helm/templates/multihost-engine.yaml``).
+- :func:`is_primary` — host 0 binds the OpenAI HTTP server; other hosts run
+  the follower loop (`run_follower` in ``engine.multihost``), mirroring the
+  "vllm serve on head" split of ``ray-cluster.yaml:520``.
+- :class:`HostBridge` — broadcasts per-step batch descriptions from host 0 to
+  all hosts so every process enters the same jitted computation. Payloads are
+  pickled and length-prefixed over ``multihost_utils.broadcast_one_to_all``
+  (a DCN all-reduce under the hood) — the TPU replacement for Ray RPC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# Env surface (set by the Helm multi-host template / JobSet downward API).
+ENV_COORDINATOR = "PST_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "PST_NUM_PROCESSES"
+ENV_PROCESS_ID = "PST_PROCESS_ID"
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        return cls(
+            coordinator_address=os.environ.get(ENV_COORDINATOR),
+            num_processes=int(os.environ.get(ENV_NUM_PROCESSES, "1")),
+            process_id=int(os.environ.get(ENV_PROCESS_ID, "0")),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_processes > 1
+
+
+def maybe_init_distributed(cfg: Optional[DistributedConfig] = None) -> bool:
+    """Boot the JAX distributed runtime when configured. Idempotent.
+
+    Returns True when running multi-process. On TPU pod slices with no
+    explicit env, ``jax.distributed.initialize()`` auto-detects via the TPU
+    metadata server — so bare ``initialize()`` is attempted when the backend
+    is TPU even without PST_* env.
+    """
+    global _initialized
+    cfg = cfg or DistributedConfig.from_env()
+    if _initialized:
+        return jax.process_count() > 1
+    if cfg.enabled:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+        _initialized = True
+        logger.info(
+            "distributed runtime up: process %d/%d, %d global devices",
+            jax.process_index(), jax.process_count(), len(jax.devices()),
+        )
+        return True
+    return False
+
+
+def is_primary() -> bool:
+    """True on the host that should bind the HTTP server (ray head analogue)."""
+    return jax.process_index() == 0
+
+
+class HostBridge:
+    """Host-0 → all-hosts control broadcast for per-step batch metadata.
+
+    Every SPMD process must issue identical XLA computations; the scheduler
+    runs on host 0 only, so each step's logical batch is shipped to the
+    followers before the jitted call. Two-phase fixed-shape broadcast (length
+    then padded payload) because ``broadcast_one_to_all`` needs matching
+    pytree structure on every host.
+    """
+
+    def __init__(self, chunk: int = 1 << 20):
+        from jax.experimental import multihost_utils
+
+        self._mh = multihost_utils
+        self.chunk = chunk
+
+    def publish(self, obj: Any) -> Any:
+        """On host 0: broadcast ``obj``; on followers: receive it."""
+        if is_primary():
+            payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            n = len(payload)
+        else:
+            payload, n = b"", 0
+        n = int(self._mh.broadcast_one_to_all(np.int64(n)))
+        nchunks = -(-n // self.chunk) or 1
+        buf = np.zeros(nchunks * self.chunk, np.uint8)
+        if is_primary():
+            buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        buf = np.asarray(self._mh.broadcast_one_to_all(buf))
+        if is_primary():
+            return obj
+        return pickle.loads(buf[:n].tobytes())
+
+    def barrier(self, name: str = "pst") -> None:
+        self._mh.sync_global_devices(name)

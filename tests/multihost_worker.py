@@ -1,0 +1,78 @@
+"""Worker script for the 2-process multi-host engine test.
+
+Each process gets 4 virtual CPU devices (8 global), joins jax.distributed,
+and builds the identical engine over a tp=2 dp=2 pp=2... — actually a
+dp=2 × tp=4-style mesh is overkill for 2 layers; we use pp=2 × tp=4 to span
+both hosts' devices. Process 0 runs real generation through the scheduler and
+prints the token ids; process 1 runs the follower loop. The parent test
+asserts process 0's output matches the single-host oracle.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["PST_FORCE_PALLAS_INTERPRET"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from production_stack_tpu.parallel.distributed import (  # noqa: E402
+    DistributedConfig,
+    maybe_init_distributed,
+)
+
+maybe_init_distributed(
+    DistributedConfig(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+from production_stack_tpu.engine.config import EngineConfig  # noqa: E402
+
+cfg = EngineConfig(
+    model="tiny-llama-debug",
+    max_model_len=128,
+    block_size=8,
+    num_kv_blocks=64,
+    max_num_seqs=4,
+    max_prefill_tokens=32,
+    tensor_parallel_size=4,
+    pipeline_parallel_size=2,
+    attn_impl="gather",
+)
+
+PROMPT = [3, 17, 98, 255, 42, 7, 11, 200, 150, 31, 8, 77, 123]
+
+if pid == 0:
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.multihost import StepPublisher
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    engine = LLMEngine(cfg)
+    engine.runner.publisher = StepPublisher()
+    out = engine.generate(
+        [list(PROMPT)], SamplingParams(max_tokens=8, temperature=0.0)
+    )[0]
+    engine.runner.publisher.shutdown()
+    print("TOKENS:" + ",".join(str(t) for t in out["token_ids"]))
+else:
+    from production_stack_tpu.engine.multihost import (
+        make_follower_runner,
+        run_follower,
+    )
+
+    run_follower(make_follower_runner(cfg))
+    print("FOLLOWER-DONE")

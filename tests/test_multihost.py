@@ -1,0 +1,78 @@
+"""Multi-host serving test: 2 jax.distributed processes, one engine.
+
+The reference validates multi-node behavior with envtest/kind instead of real
+clusters (SURVEY.md §4 "multi-node without real cluster"); the analogue here
+is two real OS processes joined via ``jax.distributed`` over loopback, each
+holding 4 virtual CPU devices of one pp2×tp4 mesh. Host 0 drives the real
+scheduler; host 1 mirrors device steps through the follower loop. Output must
+match the single-host oracle exactly.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_engine_matches_oracle():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "multihost_worker.py"),
+             str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    tokens_line = next(
+        (ln for ln in outs[0].splitlines() if ln.startswith("TOKENS:")), None
+    )
+    assert tokens_line, outs[0][-2000:]
+    got = [int(t) for t in tokens_line[len("TOKENS:"):].split(",") if t]
+    assert "FOLLOWER-DONE" in outs[1], outs[1][-2000:]
+
+    # Single-host oracle on the in-process 8-device mesh (same config modulo
+    # the distributed split).
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    eng = LLMEngine(EngineConfig(
+        model="tiny-llama-debug",
+        max_model_len=128,
+        block_size=8,
+        num_kv_blocks=64,
+        max_num_seqs=4,
+        max_prefill_tokens=32,
+        attn_impl="gather",
+    ))
+    prompt = [3, 17, 98, 255, 42, 7, 11, 200, 150, 31, 8, 77, 123]
+    expected = eng.generate(
+        [prompt], SamplingParams(max_tokens=8, temperature=0.0)
+    )[0]["token_ids"]
+    assert got == expected
