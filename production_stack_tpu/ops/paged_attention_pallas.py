@@ -1,20 +1,28 @@
-"""Pallas TPU flash-decode kernel over paged KV.
+"""Pallas TPU flash kernels over paged KV: decode and chunked prefill.
 
-The hot op of the serving loop (the role vLLM's CUDA PagedAttention kernel
-plays behind the reference stack). Decode attention is HBM-bandwidth-bound:
-the win over the gather fallback is that pages stream HBM→VMEM per grid cell
-and are reduced online (flash accumulation) — the gathered KV never
-materializes in HBM.
+The hot ops of the serving loop (the role vLLM's CUDA PagedAttention +
+flash-attn kernels play behind the reference stack). Both are
+HBM-bandwidth-bound: the win over the gather fallback is that pages stream
+HBM→VMEM per grid cell and are reduced online (flash accumulation) — neither
+the gathered ``[B, S, ...]`` KV nor the full ``[T, S]`` score matrix ever
+materializes in HBM. At the reference's long-context protocol (20k-token
+histories, 32k max_model_len — ``BASELINE.md``) the gather path's
+materializations are the difference between fitting and OOM.
 
 Layout: KV pages are ``[KH, nb, bs, hd]`` (contiguous ``[bs, hd]`` tiles, the
-TPU-tiling-legal arrangement). Grid ``(B, KH, W)``; each cell loads one page
-for one kv-head and folds it into fp32 flash accumulators held in VMEM
-scratch. Page indices come from the block table via scalar prefetch
-(``PrefetchScalarGridSpec``) so the pipeline can address HBM pages ahead of
-the body. The last grid step normalizes and writes ``[G, hd]``.
+TPU-tiling-legal arrangement). Page indices come from the block table via
+scalar prefetch (``PrefetchScalarGridSpec``) so the pipeline can address HBM
+pages ahead of the body.
 
-Used for decode (``T == 1``); prefill chunks take the gather path where the
-big matmuls already keep the MXU busy.
+- **Decode** (``T == 1``): grid ``(B, KH, W)``; each cell folds one page into
+  fp32 flash accumulators ``[G, hd]``; the last step normalizes.
+- **Chunked prefill** (``T > 1``): grid ``(B, Tt, KH, W)``. Queries are
+  pre-folded to ``[B, KH, T*G, hd]`` rows (grouped-query heads share a page
+  read); each cell folds one page into ``[Tq*G, hd]`` accumulators under the
+  causal mask derived from the chunk's start position. Pages entirely above
+  the tile's last query position are skipped — the causal triangle halves the
+  page traffic, exactly the chunked-prefill capability the reference enables
+  with ``--enable-chunked-prefill`` (`deployment-vllm-multi.yaml:135-141`).
 """
 
 from __future__ import annotations
@@ -125,32 +133,192 @@ def _decode_call(q4, k_pages, v_pages, block_tables, kv_lens, *, scale):
     )(block_tables, kv_lens, q4, k_pages, v_pages)
 
 
+def _prefill_kernel(
+    # scalar prefetch
+    tables_ref,  # [B, W] int32 (SMEM)
+    lens_ref,  # [B] int32 (SMEM)
+    starts_ref,  # [B] int32 (SMEM) — absolute position of chunk row 0
+    # blocked operands
+    q_ref,  # [1, 1, TqG, hd]
+    k_ref,  # [1, 1, bs, hd]
+    v_ref,  # [1, 1, bs, hd]
+    o_ref,  # [1, 1, TqG, hd]
+    # scratch
+    m_ref,  # [TqG, 128] fp32 (col 0 live)
+    l_ref,  # [TqG, 128] fp32 (col 0 live)
+    acc_ref,  # [TqG, hd] fp32
+    *,
+    scale: float,
+    block_size: int,
+    q_tile: int,  # Tq (query tokens per tile)
+    group: int,  # G (q heads per kv head; rows are t*G+g)
+):
+    b = pl.program_id(0)
+    tq = pl.program_id(1)
+    w = pl.program_id(3)
+    n_w = pl.num_programs(3)
+
+    @pl.when(w == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = lens_ref[b]
+    start = starts_ref[b]
+    # Query rows in this tile cover absolute positions
+    # [start + tq*Tq, start + tq*Tq + Tq - 1]; pages past the last one are
+    # entirely masked — skip them (causal triangle ≈ halves page traffic).
+    tile_last_pos = start + (tq + 1) * q_tile - 1
+
+    @pl.when((w * block_size <= tile_last_pos) & (w * block_size < kv_len))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [TqG, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [TqG, bs]
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)  # row = t*G+g
+        q_pos = start + tq * q_tile + rows // group  # [TqG, bs]
+        kv_pos = w * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where((kv_pos <= q_pos) & (kv_pos < kv_len), s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [TqG, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:, :1] = m_new
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(w == n_w - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-20)
+        ).astype(o_ref.dtype)
+
+
+def _prefill_call(qf, k_pages, v_pages, block_tables, kv_lens, starts,
+                  *, scale, q_tile, group):
+    B, KH, M, hd = qf.shape  # M = T*G rows
+    _, nb, bs, _ = k_pages.shape
+    W = block_tables.shape[1]
+    tile_rows = q_tile * group
+    n_tiles = M // tile_rows
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, n_tiles, KH, W),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, tile_rows, hd), lambda b, tq, h, w, t, l, s: (b, h, tq, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, hd), lambda b, tq, h, w, t, l, s: (h, t[b, w], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, hd), lambda b, tq, h, w, t, l, s: (h, t[b, w], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tile_rows, hd), lambda b, tq, h, w, t, l, s: (b, h, tq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile_rows, 128), jnp.float32),
+            pltpu.VMEM((tile_rows, 128), jnp.float32),
+            pltpu.VMEM((tile_rows, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        scale=scale,
+        block_size=bs,
+        q_tile=q_tile,
+        group=group,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, M, hd), qf.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(block_tables, kv_lens, starts, qf, k_pages, v_pages)
+
+
+def _pick_q_tile(T: int, G: int) -> int:
+    """Largest power-of-two tile with tile_rows = Tq*G in [8, 512]."""
+    tq = 1
+    while tq * 2 <= T and (tq * 2) * G <= 512:
+        tq *= 2
+    while tq * G < 8 and tq < T:  # too few sublanes: widen if possible
+        tq *= 2
+    return tq
+
+
 def pallas_paged_attention(
-    q: jax.Array,  # [B, T, H, hd] — T must be 1 (decode)
+    q: jax.Array,  # [B, T, H, hd]
     k_pages: jax.Array,  # [KH, nb, bs, hd]
     v_pages: jax.Array,
     block_tables: jax.Array,  # [B, W]
     kv_lens: jax.Array,  # [B]
-    q_positions: jax.Array,  # unused for decode (kv_lens carries causality)
+    q_positions: jax.Array,  # [B, T] absolute positions (row 0 = chunk start)
     *,
     scale: float,
 ) -> jax.Array:
     B, T, H, hd = q.shape
-    if T != 1:
-        from .attention import gather_paged_attention
+    KH = k_pages.shape[0]
+    G = H // KH
+    if T == 1:
+        q4 = q[:, 0].reshape(B, KH, G, hd)
+        out = _decode_call(
+            q4,
+            k_pages,
+            v_pages,
+            block_tables.astype(jnp.int32),
+            kv_lens.astype(jnp.int32),
+            scale=scale,
+        )
+        return out.reshape(B, 1, H, hd)
+
+    q_tile = _pick_q_tile(T, G)
+    if T % q_tile:
+        from .attention import gather_paged_attention  # odd shapes: fallback
 
         return gather_paged_attention(
             q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
         )
-    KH = k_pages.shape[0]
-    G = H // KH
-    q4 = q[:, 0].reshape(B, KH, G, hd)
-    out = _decode_call(
-        q4,
+    # Fold grouped heads into query rows: [B, T, KH, G, hd] -> [B, KH, T*G, hd]
+    # (row t*G + g). Chunk positions are consecutive from row 0's position —
+    # the runner builds prefill batches that way — so the kernel derives the
+    # causal mask from starts alone. Padding rows attend past their chunk;
+    # their outputs are discarded downstream (last_idx / dropped writes).
+    qf = (
+        q.reshape(B, T, KH, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, KH, T * G, hd)
+    )
+    starts = q_positions[:, 0].astype(jnp.int32)
+    out = _prefill_call(
+        qf,
         k_pages,
         v_pages,
         block_tables.astype(jnp.int32),
         kv_lens.astype(jnp.int32),
+        starts,
         scale=scale,
+        q_tile=q_tile,
+        group=G,
     )
-    return out.reshape(B, 1, H, hd)
+    return (
+        out.reshape(B, KH, T, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, T, H, hd)
+    )

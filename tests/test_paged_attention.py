@@ -37,10 +37,56 @@ def test_pallas_handles_empty_rows():
     assert np.allclose(np.asarray(got)[1], 0.0)
 
 
-def test_prefill_shapes_fall_back_to_gather():
-    q, k, v, tables, kv_lens, q_pos = _setup()
-    qT = jnp.tile(q, (1, 4, 1, 1))  # T=4 → gather path
+def _prefill_setup(B, T, start_offsets, H=8, KH=4, hd=32, nb=64, bs=8, W=8,
+                   seed=1):
+    """Chunked-prefill batch: row b's chunk starts at start_offsets[b] and
+    covers T consecutive positions; KV for [0, start+T) is resident."""
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((B, T, H, hd), dtype=np.float32)
+    k = rng.standard_normal((KH, nb, bs, hd), dtype=np.float32)
+    v = rng.standard_normal((KH, nb, bs, hd), dtype=np.float32)
+    tables = rng.permutation(nb)[: B * W].reshape(B, W).astype(np.int32)
+    starts = np.asarray(start_offsets, np.int32)
+    kv_lens = starts + T  # chunk KV already written (cache = source of truth)
+    q_pos = starts[:, None] + np.arange(T, dtype=np.int32)[None]
+    return map(jnp.asarray, (q, k, v, tables, kv_lens, q_pos))
+
+
+def test_pallas_prefill_matches_gather_fresh_prompt():
+    q, k, v, tables, kv_lens, q_pos = _prefill_setup(B=2, T=16, start_offsets=[0, 0])
     scale = 1.0 / np.sqrt(q.shape[-1])
-    posT = jnp.tile(q_pos, (1, 4))
-    out = pallas_paged_attention(qT, k, v, tables, kv_lens, posT, scale=scale)
-    assert out.shape == qT.shape
+    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_prefill_matches_gather_chunk_continuation():
+    # Later chunks (prefix-cache hit or chunked prefill continuation): the
+    # chunk starts mid-sequence and attends to all earlier KV.
+    q, k, v, tables, kv_lens, q_pos = _prefill_setup(
+        B=3, T=8, start_offsets=[0, 13, 40]
+    )
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_prefill_long_context():
+    # Long-history shape: 1 row, 64-token chunk at the end of ~1.5k-token
+    # context (interpret mode keeps this CPU-feasible; real sizes on TPU).
+    q, k, v, tables, kv_lens, q_pos = _prefill_setup(
+        B=1, T=64, start_offsets=[1472], nb=256, W=192
+    )
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_prefill_odd_tile_falls_back():
+    q, k, v, tables, kv_lens, q_pos = _prefill_setup(B=1, T=12, start_offsets=[0])
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
