@@ -106,7 +106,10 @@ class RemoteKVClient:
             return None
 
 
-_MAGIC = b"PSTKV1\x00\x00"
+# v2: per-page host layout changed to [L, bs, KH, hd] (head-folded combined
+# device pages); v1 pages ([L, KH, bs, hd]) are layout-incompatible and must
+# not be faulted in across an upgrade.
+_MAGIC = b"PSTKV2\x00\x00"
 
 
 def _serialize_page(k: np.ndarray, v: np.ndarray) -> bytes:

@@ -115,11 +115,7 @@ class ModelRunner:
         )
         self.max_table_width = -(-cfg.max_model_len // cfg.block_size)
         cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=pp > 1))
-        k, v = self.model.make_kv_cache(
-            self.num_blocks, cfg.block_size, cfg.kv_cache_dtype
-        )
-        self.k_cache = jax.device_put(k, cache_sh)
-        self.v_cache = jax.device_put(v, cache_sh)
+        self._dispatch_restore_kv()  # single source of truth for allocation
         self._repl = NamedSharding(self.mesh, P())
         # Decode batches shard rows over dp (independent sequences — the
         # in-engine data-parallel axis); prefill chunks stay replicated.
@@ -131,8 +127,8 @@ class ModelRunner:
         attn_impl = cfg.attn_impl
         mesh_for_pp = self.mesh if pp > 1 else None
 
-        def step(params, k_cache, v_cache, batch: Dict[str, Any]):
-            logits, (k_cache, v_cache) = model.forward(
+        def step(params, kv_cache, batch: Dict[str, Any]):
+            logits, kv_cache = model.forward(
                 params,
                 batch["tokens"],
                 batch["positions"],
@@ -140,8 +136,7 @@ class ModelRunner:
                 batch["block_tables"],
                 batch["kv_lens"],
                 batch["last_idx"],
-                k_cache,
-                v_cache,
+                kv_cache,
                 attn_impl=attn_impl,
                 pp_size=pp,
                 mesh=mesh_for_pp,
@@ -163,21 +158,21 @@ class ModelRunner:
                 batch["min_ps"],
                 batch["seeds"],
             )
-            return toks, k_cache, v_cache
+            return toks, kv_cache
 
         # Sampled tokens come back replicated: on a multi-host mesh the
         # primary must be able to device_get them (only addressable shards
         # are fetchable), and an all-gather of [B] int32 is free.
         self._step = jax.jit(
             step,
-            donate_argnums=(1, 2),
-            out_shardings=(self._repl, cache_sh, cache_sh),
+            donate_argnums=(1,),
+            out_shardings=(self._repl, cache_sh),
         )
 
         bs = cfg.block_size
         drop_slot = self.num_blocks * bs
 
-        def multi_step(params, k_cache, v_cache, batch, n_steps: int):
+        def multi_step(params, kv_cache, batch, n_steps: int):
             """Decode ``n_steps`` tokens per sequence in one compiled call.
 
             The inter-token dependency (sampled token feeds the next forward)
@@ -189,14 +184,14 @@ class ModelRunner:
             active = batch["kv_lens"] > 0  # padding rows never write
 
             def body(carry, i):
-                k_cache, v_cache, tokens, positions = carry
+                kv_cache, tokens, positions = carry
                 blk = jnp.take_along_axis(
                     tables, (positions // bs)[:, None], axis=1
                 )[:, 0]
                 flat = jnp.where(
                     active, blk * bs + positions % bs, drop_slot
                 ).astype(jnp.int32)
-                logits, (k_cache, v_cache) = model.forward(
+                logits, kv_cache = model.forward(
                     params,
                     tokens[:, None],
                     positions[:, None],
@@ -204,8 +199,7 @@ class ModelRunner:
                     tables,
                     positions + 1,  # kv valid through the just-written slot
                     jnp.zeros_like(positions),
-                    k_cache,
-                    v_cache,
+                    kv_cache,
                     attn_impl=attn_impl,
                     pp_size=pp,
                     mesh=mesh_for_pp,
@@ -218,19 +212,19 @@ class ModelRunner:
                     batch["min_ps"],
                     batch["seeds"] + i.astype(jnp.uint32),
                 )
-                return (k_cache, v_cache, nxt, positions + 1), nxt
+                return (kv_cache, nxt, positions + 1), nxt
 
-            carry = (k_cache, v_cache, batch["tokens"], batch["positions"])
-            (k_cache, v_cache, _, _), toks = jax.lax.scan(
+            carry = (kv_cache, batch["tokens"], batch["positions"])
+            (kv_cache, _, _), toks = jax.lax.scan(
                 body, carry, jnp.arange(n_steps), length=n_steps
             )
-            return toks.T, k_cache, v_cache  # [B, n_steps]
+            return toks.T, kv_cache  # [B, n_steps]
 
         self._multi_step = jax.jit(
             multi_step,
-            static_argnums=(4,),
-            donate_argnums=(1, 2),
-            out_shardings=(self._repl, cache_sh, cache_sh),
+            static_argnums=(3,),
+            donate_argnums=(1,),
+            out_shardings=(self._repl, cache_sh),
         )
         # Multi-host control plane (None on single-host): installed by the
         # server when jax.process_count() > 1; every device dispatch below
@@ -248,7 +242,7 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     def download_page(self, blk: int):
-        """Fetch one page's K/V across all layers → host numpy [L, KH, bs, hd]."""
+        """Fetch one page's K/V across all layers → host numpy [L, bs, KH, hd]."""
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("download_page", int(blk))
@@ -257,10 +251,13 @@ class ModelRunner:
     def _dispatch_download_page(self, blk: int):
         if not hasattr(self, "_page_get"):
             self._page_get = jax.jit(
-                lambda c, i: c[:, :, i], out_shardings=self._repl
+                lambda c, i: c[:, i], out_shardings=self._repl
             )
-        k = np.asarray(jax.device_get(self._page_get(self.k_cache, blk)))
-        v = np.asarray(jax.device_get(self._page_get(self.v_cache, blk)))
+        page = np.asarray(jax.device_get(self._page_get(self.kv_cache, blk)))
+        L, _, bs, _ = page.shape
+        KH, hd = self.model_cfg.num_kv_heads, self.model_cfg.head_dim
+        k = page[:, 0].reshape(L, bs, KH, hd)
+        v = page[:, 1].reshape(L, bs, KH, hd)
         return k, v
 
     def upload_page(self, blk: int, k_np, v_np) -> None:
@@ -273,14 +270,15 @@ class ModelRunner:
     def _dispatch_upload_page(self, blk: int, k_np, v_np) -> None:
         if not hasattr(self, "_page_set"):
             self._page_set = jax.jit(
-                lambda c, i, x: c.at[:, :, i].set(x), donate_argnums=(0,)
+                lambda c, i, x: c.at[:, i].set(x), donate_argnums=(0,)
             )
-        cache_dtype = self.k_cache.dtype
-        self.k_cache = self._page_set(
-            self.k_cache, blk, jnp_asarray(k_np, cache_dtype)
-        )
-        self.v_cache = self._page_set(
-            self.v_cache, blk, jnp_asarray(v_np, cache_dtype)
+        k_np, v_np = np.asarray(k_np), np.asarray(v_np)
+        L, bs = k_np.shape[0], k_np.shape[1]
+        page = np.stack(
+            [k_np.reshape(L, bs, -1), v_np.reshape(L, bs, -1)], axis=1
+        )  # [L, 2, bs, KH*hd]
+        self.kv_cache = self._page_set(
+            self.kv_cache, blk, jnp_asarray(page, self.kv_cache.dtype)
         )
 
     # ------------------------------------------------------------------
@@ -295,10 +293,8 @@ class ModelRunner:
             self._dispatch_drop_kv()
 
     def _dispatch_drop_kv(self) -> None:
-        self.k_cache.delete()
-        self.v_cache.delete()
-        self.k_cache = None
-        self.v_cache = None
+        self.kv_cache.delete()
+        self.kv_cache = None
 
     def restore_kv_cache(self) -> None:
         with self._device_lock:
@@ -308,11 +304,12 @@ class ModelRunner:
 
     def _dispatch_restore_kv(self) -> None:
         cache_sh = NamedSharding(self.mesh, Llama.cache_pspec(pipeline=self._pp > 1))
-        k, v = self.model.make_kv_cache(
-            self.num_blocks, self.cfg.block_size, self.cfg.kv_cache_dtype
+        self.kv_cache = jax.device_put(
+            self.model.make_kv_cache(
+                self.num_blocks, self.cfg.block_size, self.cfg.kv_cache_dtype
+            ),
+            cache_sh,
         )
-        self.k_cache = jax.device_put(k, cache_sh)
-        self.v_cache = jax.device_put(v, cache_sh)
 
     # ------------------------------------------------------------------
     # Embeddings (/v1/embeddings): full-attention encode, mean-pooled
@@ -374,8 +371,8 @@ class ModelRunner:
             k: jax.device_put(v, self._row if row_shard else self._repl)
             for k, v in batch.items()
         }
-        toks, self.k_cache, self.v_cache = self._multi_step(
-            self.params, self.k_cache, self.v_cache, dev_batch, n_steps
+        toks, self.kv_cache = self._multi_step(
+            self.params, self.kv_cache, dev_batch, n_steps
         )
         return np.asarray(jax.device_get(toks))
 
@@ -404,8 +401,8 @@ class ModelRunner:
             k: jax.device_put(v, self._row if row_shard else self._repl)
             for k, v in batch.items()
         }
-        toks, self.k_cache, self.v_cache = self._step(
-            self.params, self.k_cache, self.v_cache, dev_batch
+        toks, self.kv_cache = self._step(
+            self.params, self.kv_cache, dev_batch
         )
         return np.asarray(jax.device_get(toks))
 

@@ -212,21 +212,30 @@ class Llama:
 
     def make_kv_cache(
         self, num_blocks: int, block_size: int, dtype: Optional[str] = None
-    ) -> Tuple[jax.Array, jax.Array]:
-        # [L, KH, nb, bs, hd]: pages are contiguous [bs, hd] tiles per head —
-        # the layout the pallas kernel DMAs whole, and TPU-tiling-legal
-        # (last two dims are sublane×lane aligned).
+    ) -> jax.Array:
+        # One combined array [L, nb, 2, bs, KH*hd]: a page holds its K rows
+        # (index 0 of dim 2) then V rows (index 1), each token row spanning
+        # all kv heads in the lane dimension. One DMA moves a whole page in
+        # the pallas kernel, the write path is a single scatter, and the
+        # minor dims (bs, KH*hd) are sublane/lane tiling-exact — a
+        # [..., KH, hd] tail would pad KH=8 up to the 16-sublane tile and
+        # physically double the cache.
         cfg = self.cfg
-        shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim)
+        shape = (
+            cfg.num_layers, num_blocks, 2, block_size,
+            cfg.num_kv_heads * cfg.head_dim,
+        )
         d = jnp.dtype(dtype) if dtype else cfg.jdtype
-        return jnp.zeros(shape, d), jnp.zeros(shape, d)
+        return jnp.zeros(shape, d)
 
     @staticmethod
     def cache_pspec(pipeline: bool = False) -> P:
-        # [L, KH, nb, bs, hd] — kv heads over tp; layers over pp when the
-        # engine runs pipeline-parallel (each stage holds its layers' pages).
+        # [L, nb, 2, bs, KH*hd] — the head-folded lane dim shards over tp
+        # (shard boundaries align with head boundaries when tp | KH); layers
+        # over pp when the engine runs pipeline-parallel (each stage holds
+        # its layers' pages).
         pp = AXIS_PIPELINE if pipeline else None
-        return P(pp, AXIS_TENSOR, None, None, None)
+        return P(pp, None, None, None, AXIS_TENSOR)
 
     # ------------------------------------------------------------------
     # Forward
@@ -241,22 +250,21 @@ class Llama:
         block_tables: jax.Array,  # [B, W] int32
         kv_lens: jax.Array,  # [B] int32 valid kv len AFTER this step's writes
         last_idx: jax.Array,  # [B] int32 index in T of each row's last token
-        k_cache: jax.Array,  # [L, nb, bs, KH, hd] (donated by caller's jit)
-        v_cache: jax.Array,
+        kv_cache: jax.Array,  # [L, nb, 2, bs, KH*hd] (donated by caller's jit)
         *,
         attn_impl: str = "auto",
         pp_size: int = 1,
         mesh=None,
-    ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-        """One engine step. Returns (last-token logits [B, V], new caches).
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One engine step. Returns (last-token logits [B, V], new cache).
 
-        With ``pp_size > 1`` the stacked layer axis (params and caches) is
+        With ``pp_size > 1`` the stacked layer axis (params and cache) is
         sharded over the ``pp`` mesh axis and composed via
         :func:`pp_compose`; ``mesh`` must be the engine mesh.
         """
         cfg = self.cfg
         B, T = tokens.shape
-        nb, bs = k_cache.shape[2], k_cache.shape[3]
+        nb, bs = kv_cache.shape[1], kv_cache.shape[3]
         scale = 1.0 / math.sqrt(cfg.head_dim)
 
         x = params["embed"][tokens]  # [B, T, D]
@@ -267,7 +275,7 @@ class Llama:
             # ctx: traced arrays shared by every layer. Threaded explicitly
             # (not closed over) so the pp shard_map can pass them through.
             flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
-            lp, k_pages, v_pages = scanned  # caches: [KH, nb, bs, hd]
+            lp, kv_pages = scanned  # cache: [nb, 2, bs, KH*hd]
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q = _proj(h, lp["wq"], lp.get("bq"))
             k = _proj(h, lp["wk"], lp.get("bk"))
@@ -278,34 +286,32 @@ class Llama:
             q = _apply_rope(q, rope_cos, rope_sin)
             k = _apply_rope(k, rope_cos, rope_sin)
 
-            # Write this step's K/V into the pages, then attend through the
-            # block table — prefix hits and chunked prefill need no special
-            # casing because the cache is always the source of truth.
-            kd = (
-                k.astype(k_pages.dtype)
-                .reshape(B * T, cfg.num_kv_heads, cfg.head_dim)
-                .transpose(1, 0, 2)  # [KH, B*T, hd]
-            )
-            vd = (
-                v.astype(v_pages.dtype)
-                .reshape(B * T, cfg.num_kv_heads, cfg.head_dim)
-                .transpose(1, 0, 2)
-            )
-            k_pages = (
-                k_pages.reshape(cfg.num_kv_heads, nb * bs, cfg.head_dim)
-                .at[:, flat_write]
-                .set(kd, mode="drop")
-                .reshape(cfg.num_kv_heads, nb, bs, cfg.head_dim)
-            )
-            v_pages = (
-                v_pages.reshape(cfg.num_kv_heads, nb * bs, cfg.head_dim)
-                .at[:, flat_write]
-                .set(vd, mode="drop")
-                .reshape(cfg.num_kv_heads, nb, bs, cfg.head_dim)
+            # Write this step's K/V into the pages (one scatter over the
+            # flattened [nb*2*bs, KH*hd] row view: slot (blk, pos) holds its
+            # K row at blk*2*bs + pos and its V row bs rows later), then
+            # attend through the block table — prefix hits and chunked
+            # prefill need no special casing because the cache is always the
+            # source of truth.
+            blk = flat_write // bs
+            pos = flat_write % bs
+            idx_k = blk * (2 * bs) + pos  # drop slot nb*bs maps OOB → dropped
+            kvd = jnp.concatenate(
+                [
+                    k.reshape(B * T, cfg.kv_size),
+                    v.reshape(B * T, cfg.kv_size),
+                ],
+                axis=0,
+            ).astype(kv_pages.dtype)  # [2*B*T, KH*hd]
+            idx = jnp.concatenate([idx_k, idx_k + bs])
+            kv_pages = (
+                kv_pages.reshape(nb * 2 * bs, cfg.kv_size)
+                .at[idx]
+                .set(kvd, mode="drop")
+                .reshape(nb, 2, bs, cfg.kv_size)
             )
 
             attn = paged_attention(
-                q, k_pages, v_pages, block_tables, kv_lens, positions,
+                q, kv_pages, block_tables, kv_lens, positions,
                 scale=scale, impl=attn_impl,
             )
             attn = attn.reshape(B, T, cfg.q_size)
@@ -323,7 +329,7 @@ class Llama:
             x = x + jnp.einsum(
                 "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
             ).astype(x.dtype)
-            return x, (k_pages, v_pages)
+            return x, kv_pages
 
         ctx = (flat_write_real, rope_cos, rope_sin, block_tables, kv_lens,
                positions)
@@ -334,23 +340,23 @@ class Llama:
                 # hop where this rank's input is the true composition may
                 # write KV; others write to the dropped slot (nb*bs).
                 fw = jnp.where(gate, fw, nb * bs)
-                layers_local, k_local, v_local = scanned_local
-                x, (k_local, v_local) = jax.lax.scan(
+                layers_local, kv_local = scanned_local
+                x, kv_local = jax.lax.scan(
                     lambda c, s: layer_fn((fw, *rest), c, s),
                     x,
-                    (layers_local, k_local, v_local),
+                    (layers_local, kv_local),
                 )
-                return x, (layers_local, k_local, v_local)
+                return x, (layers_local, kv_local)
 
-            x, (_, k_cache, v_cache) = pp_compose(
-                run_stage, x, ctx, (params["layers"], k_cache, v_cache),
+            x, (_, kv_cache) = pp_compose(
+                run_stage, x, ctx, (params["layers"], kv_cache),
                 pp_size, mesh,
             )
         else:
-            x, (k_cache, v_cache) = jax.lax.scan(
+            x, kv_cache = jax.lax.scan(
                 lambda c, s: layer_fn(ctx, c, s),
                 x,
-                (params["layers"], k_cache, v_cache),
+                (params["layers"], kv_cache),
             )
 
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -359,7 +365,7 @@ class Llama:
         logits = jnp.einsum(
             "bd,vd->bv", last, unembed, preferred_element_type=jnp.float32
         )
-        return logits, (k_cache, v_cache)
+        return logits, kv_cache
 
     def encode(
         self,

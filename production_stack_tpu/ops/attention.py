@@ -4,19 +4,24 @@ This is the op the reference delegates to vLLM's CUDA PagedAttention; here it
 is TPU-native with two interchangeable implementations:
 
 - ``gather``: pure-XLA. Gathers the sequence's KV pages into a contiguous
-  ``[B, S, KH, hd]`` view and runs masked attention. Compiles everywhere
+  ``[B, S, ...]`` view and runs masked attention. Compiles everywhere
   (including the 8-device virtual CPU mesh used in tests) and XLA fuses the
-  mask/softmax chain; the gather materialization costs HBM bandwidth.
-- ``pallas``: a TPU kernel that streams pages HBM→VMEM per (batch, kv-head)
-  grid cell without materializing the gathered KV
+  mask/softmax chain; the gather materialization costs HBM bandwidth, which
+  rules it out at long context (a 32k-table gather materializes the whole
+  window per layer).
+- ``pallas``: TPU flash kernels that stream only the live pages HBM→VMEM
+  with double-buffered DMA
   (:mod:`production_stack_tpu.ops.paged_attention_pallas`).
 
 Shapes (one layer):
-  q                [B, T, H, hd]   T=1 for decode rows, T=chunk for prefill
-  k_pages/v_pages  [KH, nb, bs, hd] (pages contiguous per kv head)
-  block_tables     [B, W] int32    page ids per sequence (W*bs >= kv_len)
-  kv_lens          [B]   int32     valid KV length per sequence
-  q_positions      [B, T] int32    absolute position of each query token
+  q            [B, T, H, hd]       T=1 for decode rows, T=chunk for prefill
+  kv_pages     [nb, 2, bs, KH*hd]  combined pages: row 0 = K, row 1 = V;
+                                   each token row spans all kv heads in the
+                                   lane dim (one DMA per page in the kernel;
+                                   minor dims stay tiling-exact)
+  block_tables [B, W] int32        page ids per sequence (W*bs >= kv_len)
+  kv_lens      [B]   int32         valid KV length per sequence
+  q_positions  [B, T] int32        absolute position of each query token
                                    (padding rows may hold any value; they are
                                    masked out downstream via last_idx/sampling)
 """
@@ -42,8 +47,7 @@ def _use_pallas() -> bool:
 
 def paged_attention(
     q: jax.Array,
-    k_pages: jax.Array,
-    v_pages: jax.Array,
+    kv_pages: jax.Array,
     block_tables: jax.Array,
     kv_lens: jax.Array,
     q_positions: jax.Array,
@@ -58,17 +62,16 @@ def paged_attention(
         from .paged_attention_pallas import pallas_paged_attention
 
         return pallas_paged_attention(
-            q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+            q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
         )
     return gather_paged_attention(
-        q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+        q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
     )
 
 
 def gather_paged_attention(
     q: jax.Array,
-    k_pages: jax.Array,
-    v_pages: jax.Array,
+    kv_pages: jax.Array,
     block_tables: jax.Array,
     kv_lens: jax.Array,
     q_positions: jax.Array,
@@ -76,20 +79,22 @@ def gather_paged_attention(
     scale: float,
 ) -> jax.Array:
     B, T, H, hd = q.shape
-    KH, nb, bs, _ = k_pages.shape
+    nb, _, bs, lanes = kv_pages.shape
+    KH = lanes // hd
     W = block_tables.shape[1]
     S = W * bs
     G = H // KH
 
-    # [KH, B, W, bs, hd] -> [KH, B, S, hd]. Out-of-range table entries are
-    # clipped by XLA gather semantics; they are masked below anyway.
-    k = k_pages[:, block_tables].reshape(KH, B, S, hd)
-    v = v_pages[:, block_tables].reshape(KH, B, S, hd)
+    # [B, W, 2, bs, KH*hd] -> [B, S, KH, hd] per half. Out-of-range table
+    # entries are clipped by XLA gather semantics; masked below anyway.
+    kv = kv_pages[block_tables]
+    k = kv[:, :, 0].reshape(B, S, KH, hd)
+    v = kv[:, :, 1].reshape(B, S, KH, hd)
 
     qg = q.reshape(B, T, KH, G, hd)
     # scores [B, KH, G, T, S]
     scores = jnp.einsum(
-        "btkgd,kbsd->bkgts", qg, k, preferred_element_type=jnp.float32
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores * scale
 
@@ -101,7 +106,7 @@ def gather_paged_attention(
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bkgts,kbsd->btkgd", probs.astype(v.dtype), v,
+        "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, T, H, hd).astype(q.dtype)

@@ -1,28 +1,40 @@
 """Pallas TPU flash kernels over paged KV: decode and chunked prefill.
 
 The hot ops of the serving loop (the role vLLM's CUDA PagedAttention +
-flash-attn kernels play behind the reference stack). Both are
-HBM-bandwidth-bound: the win over the gather fallback is that pages stream
-HBM→VMEM per grid cell and are reduced online (flash accumulation) — neither
-the gathered ``[B, S, ...]`` KV nor the full ``[T, S]`` score matrix ever
-materializes in HBM. At the reference's long-context protocol (20k-token
-histories, 32k max_model_len — ``BASELINE.md``) the gather path's
-materializations are the difference between fitting and OOM.
+flash-attn kernels play behind the reference stack). Both are HBM-bandwidth
+bound at the reference's long-context protocol (20k-token histories, 32k
+max_model_len — ``BASELINE.md``), so the kernel is organized around DMA
+efficiency, not grid geometry:
 
-Layout: KV pages are ``[KH, nb, bs, hd]`` (contiguous ``[bs, hd]`` tiles, the
-TPU-tiling-legal arrangement). Page indices come from the block table via
-scalar prefetch (``PrefetchScalarGridSpec``) so the pipeline can address HBM
-pages ahead of the body.
+- KV lives in one combined page array ``[nb, 2, bs, KH*hd]`` (a page holds
+  its K rows then V rows, each token row spanning **all** kv heads in the
+  lane dimension), so one async copy moves an entire page — 100s of KB per
+  DMA instead of the 8 KB per-head fragments a ``[KH, nb, bs, hd]`` layout
+  forces. The head fold keeps the minor dims at ``(bs, KH*hd)``: both
+  tiling-exact, no sublane padding (a ``[..., KH, hd]`` tail would pad
+  KH=8 → 16 sublanes and physically double the cache).
+- The grid is tiny — ``(B,)`` for decode, ``(B, T/Tq)`` for prefill — and
+  each cell walks its sequence's **live** pages with a double-buffered
+  ``fori_loop`` (chunks of ``C`` pages), overlapping the next chunk's DMAs
+  with the current chunk's flash accumulation. Pages past ``kv_len`` — and,
+  for prefill, pages entirely above the tile's causal horizon — are never
+  fetched at all (the round-2 kernel's ``pl.when`` skipped the *compute* but
+  the BlockSpec pipeline still paid the *DMA*; that was the round-2 TTFT
+  regression).
+- Flash state (m/l/acc) is head-major in VMEM scratch so per-head slices are
+  contiguous; grouped-query heads share each page read.
 
-- **Decode** (``T == 1``): grid ``(B, KH, W)``; each cell folds one page into
-  fp32 flash accumulators ``[G, hd]``; the last step normalizes.
-- **Chunked prefill** (``T > 1``): grid ``(B, Tt, KH, W)``. Queries are
-  pre-folded to ``[B, KH, T*G, hd]`` rows (grouped-query heads share a page
-  read); each cell folds one page into ``[Tq*G, hd]`` accumulators under the
-  causal mask derived from the chunk's start position. Pages entirely above
-  the tile's last query position are skipped — the causal triangle halves the
-  page traffic, exactly the chunked-prefill capability the reference enables
-  with ``--enable-chunked-prefill`` (`deployment-vllm-multi.yaml:135-141`).
+Scalar-prefetched block tables address the pages (``PrefetchScalarGridSpec``)
+so page ids are in SMEM before the body runs.
+
+Shapes (one layer):
+  q           [B, T, H, hd]        T=1 decode, T=chunk prefill
+  kv_pages    [nb, 2, bs, KH*hd]   combined K(row 0)/V(row 1) pages
+  tables      [B, W] int32         page ids (W*bs >= kv_len)
+  kv_lens     [B] int32            valid KV length per sequence (0 = padding)
+  q_positions [B, T] int32         absolute position per query token; the
+                                   prefill kernel uses row 0 (chunks are
+                                   consecutive positions — runner contract)
 """
 
 from __future__ import annotations
@@ -42,231 +54,296 @@ def _interpret() -> bool:
     return bool(os.environ.get("PST_FORCE_PALLAS_INTERPRET"))
 
 
+def _chunk_pages(bs: int) -> int:
+    """Pages per DMA buffer slot: target ~512 tokens per chunk."""
+    return max(512 // bs, 1)
+
+
+def _chunked_flash(
+    *,
+    b,  # batch index (program id)
+    n_chunks,  # traced: chunks of C pages to stream
+    tables_ref,  # [B, W] SMEM
+    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    buf,  # [2, C, 2, bs, KH*hd] VMEM scratch
+    sems,  # [2, C] DMA semaphores
+    q_heads,  # list of KH fp32 arrays [R, hd]
+    bounds,  # [R, 1] exclusive per-row attention bound (causality + kv_len)
+    m_ref,  # [KH, R, 128] fp32 scratch (col 0 live)
+    l_ref,  # [KH, R, 128]
+    acc_ref,  # [KH, R, hd]
+    scale: float,
+    block_size: int,
+    chunk: int,
+    table_width: int,
+    head_dim: int,
+):
+    """Stream ``n_chunks`` KV chunks with double-buffered DMA and fold each
+    into the per-head flash accumulators. Shared by decode and prefill —
+    decode is the R=G, bounds=kv_len special case."""
+    C, W, hd = chunk, table_width, head_dim
+    KH = acc_ref.shape[0]
+
+    def dma(c, j, slot):
+        # Page ids past the live range clamp to the table's last entry;
+        # their columns are masked below (only the ragged final chunk
+        # fetches any).
+        page = tables_ref[b, jnp.minimum(c * C + j, W - 1)]
+        return pltpu.make_async_copy(
+            kv_hbm.at[page], buf.at[slot, j], sems.at[slot, j]
+        )
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(n_chunks > 0)
+    def _warmup():
+        for j in range(C):
+            dma(0, j, 0).start()
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+        nslot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _next():
+            for j in range(C):
+                dma(c + 1, j, nslot).start()
+
+        for j in range(C):
+            dma(c, j, slot).wait()
+
+        page = buf[slot]  # [C, 2, bs, KH*hd]
+        S = C * block_size
+        col = c * S + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+        for h in range(KH):
+            kh = page[:, 0, :, h * hd : (h + 1) * hd].reshape(S, hd)
+            vh = page[:, 1, :, h * hd : (h + 1) * hd].reshape(S, hd)
+            s = jax.lax.dot_general(
+                q_heads[h], kh.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [R, S]
+            s = jnp.where(col < bounds, s, _NEG_INF)
+            m_prev = m_ref[h, :, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[h, :, :1] = alpha * l_ref[h, :, :1] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            m_ref[h, :, :1] = m_new
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                p, vh.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+
+
 def _decode_kernel(
-    # scalar prefetch
-    tables_ref,  # [B, W] int32 (SMEM)
-    lens_ref,  # [B] int32 (SMEM)
-    # blocked operands
-    q_ref,  # [1, 1, G, hd]
-    k_ref,  # [1, 1, bs, hd]
-    v_ref,  # [1, 1, bs, hd]
-    o_ref,  # [1, 1, G, hd]
-    # scratch
-    m_ref,  # [G, 128] fp32 (col 0 live)
-    l_ref,  # [G, 128] fp32 (col 0 live)
-    acc_ref,  # [G, hd] fp32
+    tables_ref, lens_ref,  # scalar prefetch (SMEM)
+    q_ref,  # [1, H, hd] VMEM
+    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    o_ref,  # [1, H, hd] VMEM
+    buf, sems, m_ref, l_ref, acc_ref,  # scratch
     *,
     scale: float,
     block_size: int,
+    chunk: int,
+    table_width: int,
+    group: int,
+    head_dim: int,
 ):
     b = pl.program_id(0)
-    w = pl.program_id(2)
-    n_w = pl.num_programs(2)
-
-    @pl.when(w == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
+    G, KH = group, acc_ref.shape[0]
     kv_len = lens_ref[b]
+    n_chunks = (kv_len + chunk * block_size - 1) // (chunk * block_size)
 
-    @pl.when(w * block_size < kv_len)
-    def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bs, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [G, bs]
-        kv_pos = w * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1
-        )
-        s = jnp.where(kv_pos < kv_len, s, _NEG_INF)
-
-        m_prev = m_ref[:, :1]  # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # [G, bs]
-        alpha = jnp.exp(m_prev - m_new)  # [G, 1]
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        m_ref[:, :1] = m_new
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    @pl.when(w == n_w - 1)
-    def _finalize():
-        o_ref[0, 0] = (
-            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-20)
-        ).astype(o_ref.dtype)
-
-
-def _decode_call(q4, k_pages, v_pages, block_tables, kv_lens, *, scale):
-    B, KH, G, hd = q4.shape
-    _, nb, bs, _ = k_pages.shape
-    W = block_tables.shape[1]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KH, W),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, hd), lambda b, h, w, t, l: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bs, hd), lambda b, h, w, t, l: (h, t[b, w], 0, 0)),
-            pl.BlockSpec((1, 1, bs, hd), lambda b, h, w, t, l: (h, t[b, w], 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, w, t, l: (b, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.VMEM((G, hd), jnp.float32),
-        ],
+    q = q_ref[0].astype(jnp.float32)  # [H, hd]
+    _chunked_flash(
+        b=b,
+        n_chunks=n_chunks,
+        tables_ref=tables_ref,
+        kv_hbm=kv_hbm,
+        buf=buf,
+        sems=sems,
+        q_heads=[q[h * G : (h + 1) * G] for h in range(KH)],
+        bounds=jnp.full((G, 1), kv_len, jnp.int32),
+        m_ref=m_ref,
+        l_ref=l_ref,
+        acc_ref=acc_ref,
+        scale=scale,
+        block_size=block_size,
+        chunk=chunk,
+        table_width=table_width,
+        head_dim=head_dim,
     )
-    kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KH, G, hd), q4.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
-    )(block_tables, kv_lens, q4, k_pages, v_pages)
+    out = acc_ref[...] / jnp.maximum(l_ref[:, :, :1], 1e-20)  # [KH, G, hd]
+    o_ref[0] = out.reshape(KH * G, head_dim).astype(o_ref.dtype)
 
 
 def _prefill_kernel(
-    # scalar prefetch
-    tables_ref,  # [B, W] int32 (SMEM)
-    lens_ref,  # [B] int32 (SMEM)
-    starts_ref,  # [B] int32 (SMEM) — absolute position of chunk row 0
-    # blocked operands
-    q_ref,  # [1, 1, TqG, hd]
-    k_ref,  # [1, 1, bs, hd]
-    v_ref,  # [1, 1, bs, hd]
-    o_ref,  # [1, 1, TqG, hd]
-    # scratch
-    m_ref,  # [TqG, 128] fp32 (col 0 live)
-    l_ref,  # [TqG, 128] fp32 (col 0 live)
-    acc_ref,  # [TqG, hd] fp32
+    tables_ref, lens_ref, starts_ref,  # scalar prefetch (SMEM)
+    q_ref,  # [1, Tq, H, hd] VMEM
+    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    o_ref,  # [1, Tq, H, hd] VMEM
+    buf, sems, m_ref, l_ref, acc_ref,  # scratch
     *,
     scale: float,
     block_size: int,
-    q_tile: int,  # Tq (query tokens per tile)
-    group: int,  # G (q heads per kv head; rows are t*G+g)
+    chunk: int,
+    table_width: int,
+    group: int,
+    head_dim: int,
+    q_tile: int,
 ):
     b = pl.program_id(0)
     tq = pl.program_id(1)
-    w = pl.program_id(3)
-    n_w = pl.num_programs(3)
-
-    @pl.when(w == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
+    G, Tq, KH = group, q_tile, acc_ref.shape[0]
     kv_len = lens_ref[b]
     start = starts_ref[b]
-    # Query rows in this tile cover absolute positions
-    # [start + tq*Tq, start + tq*Tq + Tq - 1]; pages past the last one are
-    # entirely masked — skip them (causal triangle ≈ halves page traffic).
-    tile_last_pos = start + (tq + 1) * q_tile - 1
 
-    @pl.when((w * block_size <= tile_last_pos) & (w * block_size < kv_len))
-    def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32)  # [TqG, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bs, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [TqG, bs]
+    # Rows t*G+g of each head cover absolute positions start + tq*Tq + t.
+    # The tile's causal horizon is its last row's position; pages past
+    # min(horizon+1, kv_len) are never fetched (≈ halves page traffic over a
+    # full prefill, while warm tiles near the sequence end still stream every
+    # live page — exactly the data they need).
+    limit = jnp.minimum(kv_len, start + (tq + 1) * Tq)
+    n_chunks = (limit + chunk * block_size - 1) // (chunk * block_size)
 
-        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)  # row = t*G+g
-        q_pos = start + tq * q_tile + rows // group  # [TqG, bs]
-        kv_pos = w * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
-        )
-        s = jnp.where((kv_pos <= q_pos) & (kv_pos < kv_len), s, _NEG_INF)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Tq * G, 1), 0)
+    q_pos = start + tq * Tq + rows // G  # [Tq*G, 1]
+    bounds = jnp.minimum(q_pos + 1, kv_len)
 
-        m_prev = m_ref[:, :1]  # [TqG, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        m_ref[:, :1] = m_new
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    @pl.when(w == n_w - 1)
-    def _finalize():
-        o_ref[0, 0] = (
-            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-20)
+    qh = [
+        q_ref[0, :, h * G : (h + 1) * G, :]
+        .reshape(Tq * G, head_dim)
+        .astype(jnp.float32)
+        for h in range(KH)
+    ]
+    _chunked_flash(
+        b=b,
+        n_chunks=n_chunks,
+        tables_ref=tables_ref,
+        kv_hbm=kv_hbm,
+        buf=buf,
+        sems=sems,
+        q_heads=qh,
+        bounds=bounds,
+        m_ref=m_ref,
+        l_ref=l_ref,
+        acc_ref=acc_ref,
+        scale=scale,
+        block_size=block_size,
+        chunk=chunk,
+        table_width=table_width,
+        head_dim=head_dim,
+    )
+    # Padding rows (kv_len == 0) accumulated nothing: l stays 0 and the
+    # output is 0, matching the drop-slot contract.
+    for h in range(KH):
+        out = acc_ref[h] / jnp.maximum(l_ref[h, :, :1], 1e-20)  # [Tq*G, hd]
+        o_ref[0, :, h * G : (h + 1) * G, :] = out.reshape(
+            Tq, G, head_dim
         ).astype(o_ref.dtype)
 
 
-def _prefill_call(qf, k_pages, v_pages, block_tables, kv_lens, starts,
-                  *, scale, q_tile, group):
-    B, KH, M, hd = qf.shape  # M = T*G rows
-    _, nb, bs, _ = k_pages.shape
+def _scratch(C, bs, lanes, R, KH, hd, kv_dtype):
+    return [
+        pltpu.VMEM((2, C, 2, bs, lanes), kv_dtype),
+        pltpu.SemaphoreType.DMA((2, C)),
+        pltpu.VMEM((KH, R, 128), jnp.float32),
+        pltpu.VMEM((KH, R, 128), jnp.float32),
+        pltpu.VMEM((KH, R, hd), jnp.float32),
+    ]
+
+
+def _decode_call(q3, kv_pages, block_tables, kv_lens, *, scale):
+    B, H, hd = q3.shape
+    nb, _, bs, lanes = kv_pages.shape
+    KH = lanes // hd
     W = block_tables.shape[1]
-    tile_rows = q_tile * group
-    n_tiles = M // tile_rows
+    G = H // KH
+    C = _chunk_pages(bs)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, t, l: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l: (b, 0, 0)),
+        scratch_shapes=_scratch(C, bs, lanes, G, KH, hd, kv_pages.dtype),
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=scale,
+        block_size=bs,
+        chunk=C,
+        table_width=W,
+        group=G,
+        head_dim=hd,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q3.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=_interpret(),
+    )(block_tables, kv_lens, q3, kv_pages)
+
+
+def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, *, scale, q_tile):
+    B, T, H, hd = q.shape
+    nb, _, bs, lanes = kv_pages.shape
+    KH = lanes // hd
+    W = block_tables.shape[1]
+    G = H // KH
+    C = _chunk_pages(bs)
+    n_tiles = T // q_tile
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, n_tiles, KH, W),
+        grid=(B, n_tiles),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, tile_rows, hd), lambda b, tq, h, w, t, l, s: (b, h, tq, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bs, hd), lambda b, tq, h, w, t, l, s: (h, t[b, w], 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bs, hd), lambda b, tq, h, w, t, l, s: (h, t[b, w], 0, 0)
-            ),
+            pl.BlockSpec((1, q_tile, H, hd), lambda b, t, tt, l, s: (b, t, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, tile_rows, hd), lambda b, tq, h, w, t, l, s: (b, h, tq, 0)
+            (1, q_tile, H, hd), lambda b, t, tt, l, s: (b, t, 0, 0)
         ),
-        scratch_shapes=[
-            pltpu.VMEM((tile_rows, 128), jnp.float32),
-            pltpu.VMEM((tile_rows, 128), jnp.float32),
-            pltpu.VMEM((tile_rows, hd), jnp.float32),
-        ],
+        scratch_shapes=_scratch(C, bs, lanes, q_tile * G, KH, hd, kv_pages.dtype),
     )
     kernel = functools.partial(
         _prefill_kernel,
         scale=scale,
         block_size=bs,
+        chunk=C,
+        table_width=W,
+        group=G,
+        head_dim=hd,
         q_tile=q_tile,
-        group=group,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KH, M, hd), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel"),
         ),
         interpret=_interpret(),
-    )(block_tables, kv_lens, starts, qf, k_pages, v_pages)
-
-
-def _pick_q_tile(T: int, G: int) -> int:
-    """Largest power-of-two tile with tile_rows = Tq*G in [8, 512]."""
-    tq = 1
-    while tq * 2 <= T and (tq * 2) * G <= 512:
-        tq *= 2
-    while tq * G < 8 and tq < T:  # too few sublanes: widen if possible
-        tq *= 2
-    return tq
+    )(block_tables, kv_lens, starts, q, kv_pages)
 
 
 def pallas_paged_attention(
     q: jax.Array,  # [B, T, H, hd]
-    k_pages: jax.Array,  # [KH, nb, bs, hd]
-    v_pages: jax.Array,
+    kv_pages: jax.Array,  # [nb, 2, bs, KH*hd]
     block_tables: jax.Array,  # [B, W]
     kv_lens: jax.Array,  # [B]
     q_positions: jax.Array,  # [B, T] absolute positions (row 0 = chunk start)
@@ -274,51 +351,24 @@ def pallas_paged_attention(
     scale: float,
 ) -> jax.Array:
     B, T, H, hd = q.shape
-    KH = k_pages.shape[0]
-    G = H // KH
+    tables = block_tables.astype(jnp.int32)
+    lens = kv_lens.astype(jnp.int32)
     if T == 1:
-        q4 = q[:, 0].reshape(B, KH, G, hd)
-        out = _decode_call(
-            q4,
-            k_pages,
-            v_pages,
-            block_tables.astype(jnp.int32),
-            kv_lens.astype(jnp.int32),
-            scale=scale,
-        )
-        return out.reshape(B, 1, H, hd)
+        out = _decode_call(q[:, 0], kv_pages, tables, lens, scale=scale)
+        return out[:, None]
 
-    q_tile = _pick_q_tile(T, G)
-    if T % q_tile:
-        from .attention import gather_paged_attention  # odd shapes: fallback
+    # Chunk positions are consecutive from row 0's position (the runner
+    # builds prefill batches that way), so the kernel derives causality from
+    # starts alone. Padding rows attend past their chunk; their outputs are
+    # discarded downstream (last_idx / dropped writes).
+    q_tile = min(T, 128)
+    if T % q_tile:  # odd shapes: runner buckets are powers of two
+        from .attention import gather_paged_attention
 
         return gather_paged_attention(
-            q, k_pages, v_pages, block_tables, kv_lens, q_positions, scale=scale
+            q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
         )
-    # Fold grouped heads into query rows: [B, T, KH, G, hd] -> [B, KH, T*G, hd]
-    # (row t*G + g). Chunk positions are consecutive from row 0's position —
-    # the runner builds prefill batches that way — so the kernel derives the
-    # causal mask from starts alone. Padding rows attend past their chunk;
-    # their outputs are discarded downstream (last_idx / dropped writes).
-    qf = (
-        q.reshape(B, T, KH, G, hd)
-        .transpose(0, 2, 1, 3, 4)
-        .reshape(B, KH, T * G, hd)
-    )
     starts = q_positions[:, 0].astype(jnp.int32)
-    out = _prefill_call(
-        qf,
-        k_pages,
-        v_pages,
-        block_tables.astype(jnp.int32),
-        kv_lens.astype(jnp.int32),
-        starts,
-        scale=scale,
-        q_tile=q_tile,
-        group=G,
-    )
-    return (
-        out.reshape(B, KH, T, G, hd)
-        .transpose(0, 2, 1, 3, 4)
-        .reshape(B, T, H, hd)
+    return _prefill_call(
+        q, kv_pages, tables, lens, starts, scale=scale, q_tile=q_tile
     )

@@ -1,4 +1,9 @@
-"""Pallas decode kernel (interpret mode on CPU) vs the gather reference."""
+"""Pallas paged-attention kernels (interpret mode on CPU) vs the gather oracle.
+
+KV layout: one combined page array [nb, 2, bs, KH*hd] (K rows at index 0 of
+the pair dim, V rows at index 1; heads folded into the lane dim) — the
+layout the kernels DMA whole pages of.
+"""
 
 import numpy as np
 import jax
@@ -6,6 +11,13 @@ import jax.numpy as jnp
 
 from production_stack_tpu.ops.attention import gather_paged_attention
 from production_stack_tpu.ops.paged_attention_pallas import pallas_paged_attention
+
+
+def _pack(k, v):
+    # [KH, nb, bs, hd] pair -> combined [nb, 2, bs, KH*hd]
+    KH, nb, bs, hd = k.shape
+    fold = lambda x: x.transpose(1, 2, 0, 3).reshape(nb, bs, KH * hd)
+    return np.stack([fold(k), fold(v)], axis=1)
 
 
 def _setup(B=3, H=8, KH=4, hd=32, nb=32, bs=8, W=4, seed=0):
@@ -17,22 +29,22 @@ def _setup(B=3, H=8, KH=4, hd=32, nb=32, bs=8, W=4, seed=0):
     tables = rng.permutation(nb)[: B * W].reshape(B, W).astype(np.int32)
     kv_lens = np.array([5, bs * W, bs * 2 + 3], np.int32)[:B]
     q_pos = (kv_lens - 1).reshape(B, 1).astype(np.int32)
-    return map(jnp.asarray, (q, k, v, tables, kv_lens, q_pos))
+    return map(jnp.asarray, (q, _pack(k, v), tables, kv_lens, q_pos))
 
 
 def test_pallas_decode_matches_gather():
-    q, k, v, tables, kv_lens, q_pos = _setup()
+    q, kv, tables, kv_lens, q_pos = _setup()
     scale = 1.0 / np.sqrt(q.shape[-1])
-    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
-    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_pallas_handles_empty_rows():
-    q, k, v, tables, kv_lens, q_pos = _setup()
+    q, kv, tables, kv_lens, q_pos = _setup()
     kv_lens = kv_lens.at[1].set(0)  # padding row
     scale = 1.0 / np.sqrt(q.shape[-1])
-    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     assert np.all(np.isfinite(np.asarray(got)))
     assert np.allclose(np.asarray(got)[1], 0.0)
 
@@ -49,44 +61,61 @@ def _prefill_setup(B, T, start_offsets, H=8, KH=4, hd=32, nb=64, bs=8, W=8,
     starts = np.asarray(start_offsets, np.int32)
     kv_lens = starts + T  # chunk KV already written (cache = source of truth)
     q_pos = starts[:, None] + np.arange(T, dtype=np.int32)[None]
-    return map(jnp.asarray, (q, k, v, tables, kv_lens, q_pos))
+    return map(jnp.asarray, (q, _pack(k, v), tables, kv_lens, q_pos))
 
 
 def test_pallas_prefill_matches_gather_fresh_prompt():
-    q, k, v, tables, kv_lens, q_pos = _prefill_setup(B=2, T=16, start_offsets=[0, 0])
+    q, kv, tables, kv_lens, q_pos = _prefill_setup(B=2, T=16, start_offsets=[0, 0])
     scale = 1.0 / np.sqrt(q.shape[-1])
-    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
-    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_pallas_prefill_matches_gather_chunk_continuation():
     # Later chunks (prefix-cache hit or chunked prefill continuation): the
     # chunk starts mid-sequence and attends to all earlier KV.
-    q, k, v, tables, kv_lens, q_pos = _prefill_setup(
+    q, kv, tables, kv_lens, q_pos = _prefill_setup(
         B=3, T=8, start_offsets=[0, 13, 40]
     )
     scale = 1.0 / np.sqrt(q.shape[-1])
-    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
-    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_pallas_prefill_long_context():
     # Long-history shape: 1 row, 64-token chunk at the end of ~1.5k-token
     # context (interpret mode keeps this CPU-feasible; real sizes on TPU).
-    q, k, v, tables, kv_lens, q_pos = _prefill_setup(
+    q, kv, tables, kv_lens, q_pos = _prefill_setup(
         B=1, T=64, start_offsets=[1472], nb=256, W=192
     )
     scale = 1.0 / np.sqrt(q.shape[-1])
-    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
-    got = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_prefill_multi_tile():
+    # T > q_tile (128): multiple query tiles per row; later tiles must apply
+    # the causal horizon so early-page traffic is skipped without changing
+    # the math.
+    q, kv, tables, kv_lens, q_pos = _prefill_setup(
+        B=1, T=256, start_offsets=[64], nb=128, W=64
+    )
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    got = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_pallas_prefill_odd_tile_falls_back():
-    q, k, v, tables, kv_lens, q_pos = _prefill_setup(B=1, T=12, start_offsets=[0])
+    # T not divisible by the 128-row tile: falls back to gather (runner
+    # buckets are powers of two, so this only happens for exotic callers).
+    q, kv, tables, kv_lens, q_pos = _prefill_setup(
+        B=1, T=192, start_offsets=[0], nb=128, W=32
+    )
     scale = 1.0 / np.sqrt(q.shape[-1])
-    out = pallas_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
-    ref = gather_paged_attention(q, k, v, tables, kv_lens, q_pos, scale=scale)
+    out = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
+    ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
