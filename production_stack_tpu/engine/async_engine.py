@@ -109,6 +109,7 @@ class AsyncLLMEngine:
         prompt_token_ids: Optional[Seq[int]] = None,
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        lora_name: Optional[str] = None,
     ) -> AsyncIterator[RequestOutput]:
         if self.step_error is not None:
             raise RuntimeError(f"engine is failed: {self.step_error}")
@@ -126,6 +127,7 @@ class AsyncLLMEngine:
                             prompt_token_ids=prompt_token_ids,
                             sampling=sampling,
                             arrival_time=time.time(),
+                            lora_name=lora_name,
                         ),
                     )
                 )

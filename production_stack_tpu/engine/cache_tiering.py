@@ -239,11 +239,13 @@ class TieredAllocator(BlockAllocator):
                 return page
         return None
 
-    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], List[int]]:
+    def match_prefix(
+        self, token_ids: Sequence[int], salt: int = 0
+    ) -> Tuple[List[int], List[int]]:
         self.query_tokens += len(token_ids)
         if not self.enable_prefix_caching:
             return [], []
-        hashes = block_hashes(token_ids, self.block_size)
+        hashes = block_hashes(token_ids, self.block_size, parent=salt)
         matched: List[int] = []
         matched_hashes: List[int] = []
         for h in hashes:

@@ -48,6 +48,12 @@ class EngineConfig:
     # after the burst; at most n-1 speculatively-decoded tokens are discarded
     # per finished request. 1 = classic per-token stepping.
     num_decode_steps: int = 1
+    # Floor for the decode-batch row bucket. Serving workloads whose active
+    # set fluctuates otherwise walk through every power-of-two width,
+    # compiling each one the first time it appears (an XLA compile mid-burst
+    # is a multi-second TTFT outlier). Padding rows carry kv_len=0 and cost
+    # ~nothing — the pallas kernel streams zero pages for them.
+    min_decode_bucket: int = 1
     enforce_eager: bool = False  # reserved; XLA always compiles
     seed: int = 0
     # KV tiering (LMCache-analogue knobs; SURVEY.md §2.4).
@@ -57,6 +63,13 @@ class EngineConfig:
     # analogue). engine_url is what this pod reports itself as.
     cache_controller_url: Optional[str] = None
     engine_url: Optional[str] = None
+    # LoRA serving (reference: vLLM --enable-lora + the operator's
+    # load/unload HTTP flow, `loraadapter_controller.go:582-611`). Adapters
+    # live in a stacked device bank; any mix serves in one compiled step.
+    enable_lora: bool = False
+    max_loras: int = 8
+    max_lora_rank: int = 16
+    lora_dir: str = "/adapters"
     # Disaggregated prefill role (reference: --kv-transfer-config
     # kv_producer/kv_consumer, `deployment-vllm-multi.yaml:180-189`).
     # producer: push each completed prefill's KV pages to the remote store

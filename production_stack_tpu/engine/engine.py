@@ -74,6 +74,16 @@ class LLMEngine:
             ),
             self.allocator,
         )
+        if cfg.enable_lora:
+            from .lora import LoraManager
+
+            self.lora_manager: Optional["LoraManager"] = LoraManager(
+                self.model_cfg, cfg.max_loras, cfg.max_lora_rank, cfg.lora_dir
+            )
+        else:
+            self.lora_manager = None
+        # Unloaded-adapter slots awaiting their last in-flight sequence.
+        self._retiring_slots: set = set()
         self._seqs: Dict[str, Sequence] = {}
         # Incremental detokenizer state per request:
         # emitted text + [prefix_offset, read_offset) decode window.
@@ -101,22 +111,73 @@ class LLMEngine:
         prompt_token_ids: Optional[Seq[int]] = None,
         sampling: Optional[SamplingParams] = None,
         arrival_time: Optional[float] = None,
+        lora_name: Optional[str] = None,
     ) -> Sequence:
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
         if not prompt_token_ids:
             prompt_token_ids = [0]
+        lora_idx, lora_scale, salt = 0, 0.0, 0
+        if lora_name:
+            if self.lora_manager is None:
+                raise ValueError("LoRA not enabled on this engine")
+            ad = self.lora_manager.get(lora_name)
+            if ad is None:
+                raise ValueError(f"LoRA adapter {lora_name!r} not loaded")
+            lora_idx, lora_scale = ad.slot, ad.scaling
+            # KV under an adapter differs from base KV: salt the prefix
+            # hash chain so cache hits never cross adapters.
+            import xxhash
+
+            salt = xxhash.xxh64(lora_name.encode()).intdigest() & 0x7FFF_FFFF_FFFF_FFFF
         seq = Sequence(
             request_id,
             prompt_token_ids,
             sampling or SamplingParams(),
             arrival_time=arrival_time,
+            lora_idx=lora_idx,
+            lora_scale=lora_scale,
+            cache_salt=salt,
         )
         self.scheduler.add(seq)
         self._seqs[request_id] = seq
         self._detok[request_id] = {"emitted": "", "prefix": 0, "read": 0}
         self.prompt_tokens_total += len(prompt_token_ids)
         return seq
+
+    def load_lora(self, name: str, path: Optional[str] = None):
+        """Load a PEFT adapter into a device bank slot (operator flow:
+        POST /v1/load_lora_adapter → here)."""
+        if self.lora_manager is None:
+            raise ValueError("LoRA not enabled on this engine (--enable-lora)")
+        ad, arrays = self.lora_manager.load(name, path)
+        if arrays is not None:  # freshly parsed (not already resident)
+            self.runner.install_adapter(ad.slot, arrays)
+        return ad
+
+    def unload_lora(self, name: str) -> bool:
+        """Unregister the adapter. New requests for it fail immediately;
+        in-flight sequences finish under its weights — the device slot is
+        zeroed and recycled only after the last one drains (step() sweeps
+        ``_retiring_slots``). Matches the reference engines' drain-then-free
+        semantics for /v1/unload_lora_adapter."""
+        if self.lora_manager is None:
+            return False
+        ad = self.lora_manager.unload(name)
+        if ad is None:
+            return False
+        self._retiring_slots.add(ad.slot)
+        self._sweep_retiring_slots()
+        return True
+
+    def _sweep_retiring_slots(self) -> None:
+        if not self._retiring_slots:
+            return
+        live = {s.lora_idx for s in self._seqs.values() if s.lora_idx}
+        for slot in [s for s in self._retiring_slots if s not in live]:
+            self._retiring_slots.discard(slot)
+            self.runner.uninstall_adapter(slot)
+            self.lora_manager.release_slot(slot)
 
     def abort_request(self, request_id: str) -> bool:
         seq = self.scheduler.abort(request_id)
@@ -203,6 +264,7 @@ class LLMEngine:
                         outputs.append(out)
                     if seq.is_finished:
                         break  # trim speculative tail of the burst
+        self._sweep_retiring_slots()
         return outputs
 
     # Controller-registration hygiene: chunk claims older than the TTL (or

@@ -131,16 +131,20 @@ class BlockAllocator:
 
     # -- prefix lookup ----------------------------------------------------
 
-    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], List[int]]:
+    def match_prefix(
+        self, token_ids: Sequence[int], salt: int = 0
+    ) -> Tuple[List[int], List[int]]:
         """Longest resident prefix of ``token_ids`` at block granularity.
 
+        ``salt`` seeds the hash chain (LoRA adapters salt by adapter name so
+        base-model KV never serves adapter requests and vice versa).
         Returns (matched block ids — increfed, their hashes). Callers start
         computing at ``len(matched) * block_size``.
         """
         self.query_tokens += len(token_ids)
         if not self.enable_prefix_caching:
             return [], []
-        hashes = block_hashes(token_ids, self.block_size)
+        hashes = block_hashes(token_ids, self.block_size, parent=salt)
         matched: List[int] = []
         matched_hashes: List[int] = []
         for h in hashes:

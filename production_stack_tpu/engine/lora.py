@@ -1,0 +1,198 @@
+"""LoRA adapter management: PEFT checkpoint loading + device slot bank.
+
+The reference serves adapters through vLLM's LoRA support, driven over HTTP
+by the operator (`loraadapter_controller.go:582-611` load/unload). Here the
+TPU-native design keeps every loaded adapter in a **stacked device bank**:
+for each targeted projection ``t`` the model params carry
+
+    lora_a_<t>  [L, slots, in_dim,  r_max]
+    lora_b_<t>  [L, slots, r_max, out_dim]
+
+(slot 0 is all-zeros = "no adapter"). The forward pass gathers each batch
+row's slot and adds ``scaling * (x @ A) @ B`` to the projection — so any mix
+of adapters serves in ONE compiled step, no per-adapter recompilation and no
+weight merging. Rank is padded to ``r_max`` with zeros (exact math).
+
+Checkpoint format: a local directory in PEFT layout — ``adapter_config.json``
+(r, lora_alpha, target_modules) + ``adapter_model.safetensors`` with keys
+``...layers.{i}.self_attn.q_proj.lora_A.weight`` [r, in] / ``lora_B.weight``
+[out, r]. Downloading from HF/S3/HTTP is the sidecar's job
+(`scripts/adapter_downloader.py`, reference `docker/Dockerfile.sidecar`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# HF module name -> our stacked-param name (matches llama._HF_LAYER_MAP).
+TARGETS = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+}
+
+
+@dataclasses.dataclass
+class LoadedAdapter:
+    name: str
+    slot: int
+    rank: int
+    scaling: float
+    path: str
+
+
+class LoraManager:
+    """Host-side slot registry; the runner owns the device bank arrays."""
+
+    def __init__(self, model_cfg, max_loras: int, max_rank: int,
+                 adapter_dir: str = "/adapters"):
+        self.model_cfg = model_cfg
+        self.max_loras = max_loras
+        self.max_rank = max_rank
+        self.adapter_dir = adapter_dir
+        self._adapters: Dict[str, LoadedAdapter] = {}
+        self._free_slots: List[int] = list(range(max_loras, 0, -1))  # 1-based
+        self._lock = threading.Lock()
+
+    # -- queries -----------------------------------------------------------
+
+    def get(self, name: str) -> Optional[LoadedAdapter]:
+        return self._adapters.get(name)
+
+    def list_adapters(self) -> List[LoadedAdapter]:
+        return sorted(self._adapters.values(), key=lambda a: a.slot)
+
+    def bank_shapes(self) -> Dict[str, Tuple[Tuple[int, ...], Tuple[int, ...]]]:
+        """(A, B) array shapes per target (without the leading layer axis)."""
+        cfg = self.model_cfg
+        dims = {
+            "wq": (cfg.hidden_size, cfg.q_size),
+            "wk": (cfg.hidden_size, cfg.kv_size),
+            "wv": (cfg.hidden_size, cfg.kv_size),
+            "wo": (cfg.q_size, cfg.hidden_size),
+        }
+        out = {}
+        for t, (din, dout) in dims.items():
+            out[t] = (
+                (self.max_loras + 1, din, self.max_rank),
+                (self.max_loras + 1, self.max_rank, dout),
+            )
+        return out
+
+    # -- load / unload -----------------------------------------------------
+
+    def resolve_path(self, name: str, path: Optional[str]) -> str:
+        if path:
+            return path
+        return os.path.join(self.adapter_dir, name)
+
+    def load(self, name: str, path: Optional[str] = None):
+        """Parse a PEFT checkpoint → (adapter, host arrays per target).
+
+        Returns (LoadedAdapter, {target: (A [L, in, r_max], B [L, r_max, out])}).
+        The caller (runner) installs the arrays into the device bank slot.
+        """
+        with self._lock:
+            if name in self._adapters:
+                return self._adapters[name], None  # already resident
+            if not self._free_slots:
+                raise RuntimeError(
+                    f"no free LoRA slots (max_loras={self.max_loras})"
+                )
+            adapter_path = self.resolve_path(name, path)
+            arrays, rank, scaling = self._parse_peft(adapter_path)
+            slot = self._free_slots.pop()
+            ad = LoadedAdapter(
+                name=name, slot=slot, rank=rank, scaling=scaling,
+                path=adapter_path,
+            )
+            self._adapters[name] = ad
+            logger.info(
+                "loaded LoRA %r (rank %d, scaling %.3f) into slot %d",
+                name, rank, scaling, slot,
+            )
+            return ad, arrays
+
+    def unload(self, name: str) -> Optional[LoadedAdapter]:
+        """Remove the name from the registry. The slot is NOT freed here —
+        in-flight sequences may still reference it; the engine calls
+        :meth:`release_slot` once the last such sequence drains (zeroing and
+        reusing the slot earlier would silently swap the weights under a
+        running request)."""
+        with self._lock:
+            return self._adapters.pop(name, None)
+
+    def release_slot(self, slot: int) -> None:
+        with self._lock:
+            if slot not in self._free_slots:
+                self._free_slots.append(slot)
+
+    # -- PEFT parsing ------------------------------------------------------
+
+    def _parse_peft(self, path: str):
+        from safetensors import safe_open
+
+        cfg_path = os.path.join(path, "adapter_config.json")
+        st_path = os.path.join(path, "adapter_model.safetensors")
+        if not os.path.isfile(cfg_path) or not os.path.isfile(st_path):
+            raise FileNotFoundError(
+                f"not a PEFT adapter dir (need adapter_config.json + "
+                f"adapter_model.safetensors): {path}"
+            )
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        rank = int(acfg.get("r", 8))
+        alpha = float(acfg.get("lora_alpha", rank))
+        scaling = alpha / rank
+        if rank > self.max_rank:
+            raise ValueError(
+                f"adapter rank {rank} exceeds max_lora_rank={self.max_rank}"
+            )
+
+        L = self.model_cfg.num_layers
+        shapes = self.bank_shapes()
+        arrays = {}
+        for t, (a_shape, b_shape) in shapes.items():
+            arrays[t] = (
+                np.zeros((L,) + a_shape[1:], np.float32),
+                np.zeros((L,) + b_shape[1:], np.float32),
+            )
+
+        found = 0
+        with safe_open(st_path, framework="numpy") as f:
+            keys = list(f.keys())
+            for key in keys:
+                # ...model.layers.{i}.self_attn.{q_proj}.lora_{A,B}.weight
+                parts = key.split(".")
+                try:
+                    li = parts.index("layers")
+                except ValueError:
+                    continue
+                layer = int(parts[li + 1])
+                module = parts[li + 3] if parts[li + 2] == "self_attn" else None
+                if module not in TARGETS or layer >= L:
+                    continue
+                ours = TARGETS[module]
+                w = np.asarray(f.get_tensor(key), np.float32)
+                if ".lora_A." in key:
+                    # PEFT stores A as [r, in]; our forward is x @ A -> [.., r]
+                    arrays[ours][0][layer, :, : w.shape[0]] = w.T
+                    found += 1
+                elif ".lora_B." in key:
+                    # PEFT stores B as [out, r]
+                    arrays[ours][1][layer, : w.shape[1], :] = w.T
+                    found += 1
+        if not found:
+            raise ValueError(f"no LoRA tensors for {list(TARGETS)} in {st_path}")
+        return arrays, rank, scaling

@@ -87,6 +87,11 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
             runner._dispatch_drop_kv()
         elif kind == "restore_kv":
             runner._dispatch_restore_kv()
+        elif kind == "install_adapter":
+            slot, arrays = payload
+            runner._dispatch_install_adapter(int(slot), arrays)
+        elif kind == "uninstall_adapter":
+            runner._dispatch_uninstall_adapter(int(payload))
         else:  # future-proof: unknown step kinds are fatal (order contract)
             raise RuntimeError(f"unknown multihost step kind: {kind!r}")
 

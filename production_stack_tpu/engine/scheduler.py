@@ -176,7 +176,9 @@ class Scheduler:
             if not seq.block_ids:
                 toks = seq.all_token_ids
                 matchable = toks[: len(toks) - 1]
-                blocks, hashes = self.allocator.match_prefix(matchable)
+                blocks, hashes = self.allocator.match_prefix(
+                    matchable, salt=getattr(seq, "cache_salt", 0)
+                )
                 if blocks:
                     seq.adopt_cached_prefix(blocks, hashes)
                     seq.num_computed_tokens = len(blocks) * self.allocator.block_size

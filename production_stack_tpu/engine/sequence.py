@@ -67,6 +67,9 @@ class Sequence:
         prompt_token_ids: Seq[int],
         sampling: SamplingParams,
         arrival_time: Optional[float] = None,
+        lora_idx: int = 0,
+        lora_scale: float = 0.0,
+        cache_salt: int = 0,
     ):
         self.request_id = request_id
         self.prompt_token_ids: List[int] = list(prompt_token_ids)
@@ -76,6 +79,13 @@ class Sequence:
         self.arrival_time = arrival_time or time.time()
         self.first_token_time: Optional[float] = None  # TTFT marker
         self.finish_reason: Optional[str] = None
+        # LoRA bank slot serving this request (0 = base model) and its
+        # alpha/r scaling; cache_salt seeds the block-hash chain so KV
+        # produced under one adapter never serves as a prefix hit for
+        # another (the KV itself differs).
+        self.lora_idx = lora_idx
+        self.lora_scale = lora_scale
+        self.cache_salt = cache_salt
 
         # KV bookkeeping.
         self.block_ids: List[int] = []
@@ -83,7 +93,7 @@ class Sequence:
         self.num_cached_prompt_tokens = 0  # prefix-cache hits at admission
         self.block_hashes: List[int] = []  # hash per committed block
         self._committed_blocks = 0
-        self._last_hash = 0
+        self._last_hash = cache_salt
         # Chunk-hash cursor (controller registration granularity).
         self._chunk_cursor = 0
         self._chunk_last_hash = 0
@@ -166,7 +176,7 @@ class Sequence:
         self.num_cached_prompt_tokens = 0
         self.block_hashes = []
         self._committed_blocks = 0
-        self._last_hash = 0
+        self._last_hash = self.cache_salt
         self._chunk_cursor = 0
         self._chunk_last_hash = 0
         self.status = SequenceStatus.PREEMPTED

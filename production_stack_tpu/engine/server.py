@@ -162,9 +162,20 @@ def create_engine_app(
     app = web.Application(middlewares=[auth_middleware])
     model_name = engine.engine.model_name
     metrics = EngineMetrics(model_name)
-    lora_adapters: List[str] = []
     app["engine"] = engine
     app["metrics"] = metrics
+
+    def _lora_names() -> List[str]:
+        mgr = engine.engine.lora_manager
+        return [a.name for a in mgr.list_adapters()] if mgr else []
+
+    def _resolve_lora(requested_model: str) -> Optional[str]:
+        """Request model == a loaded adapter name → serve under that LoRA."""
+        if requested_model and requested_model != model_name:
+            mgr = engine.engine.lora_manager
+            if mgr is not None and mgr.get(requested_model) is not None:
+                return requested_model
+        return None
 
     # -- model listing -------------------------------------------------
 
@@ -176,7 +187,7 @@ def create_engine_app(
         ] + [
             {"id": a, "object": "model", "created": now,
              "owned_by": "production-stack-tpu", "root": None, "parent": model_name}
-            for a in lora_adapters
+            for a in _lora_names()
         ]
         return web.json_response({"object": "list", "data": data})
 
@@ -303,7 +314,8 @@ def create_engine_app(
         obj = "chat.completion.chunk" if is_chat else "text_completion"
 
         gen = engine.generate(
-            prompt_token_ids=ids, sampling=sampling, request_id=rid
+            prompt_token_ids=ids, sampling=sampling, request_id=rid,
+            lora_name=_resolve_lora(getattr(req, "model", "")),
         )
 
         if req.stream:
@@ -535,20 +547,37 @@ def create_engine_app(
         return web.json_response({"status": "awake"})
 
     async def load_lora(request: web.Request) -> web.Response:
+        """Parse the PEFT checkpoint and install it into a device bank slot
+        (reference loadAdapter, loraadapter_controller.go:582-611). The
+        safetensors read + device write run off the event loop."""
         body = await request.json()
         name = body.get("lora_name")
         if not name:
             return _error("lora_name required")
-        if name not in lora_adapters:
-            lora_adapters.append(name)
-        return web.json_response({"status": "ok"})
+        if engine.engine.lora_manager is None:
+            return _error("LoRA not enabled (--enable-lora)", 400)
+        path = body.get("lora_path")
+        try:
+            ad = await asyncio.get_running_loop().run_in_executor(
+                None, engine.engine.load_lora, name, path
+            )
+        except FileNotFoundError as e:
+            return _error(str(e), 404, "not_found_error")
+        except (ValueError, RuntimeError) as e:
+            return _error(str(e), 400)
+        return web.json_response(
+            {"status": "ok", "name": ad.name, "rank": ad.rank, "slot": ad.slot}
+        )
 
     async def unload_lora(request: web.Request) -> web.Response:
         body = await request.json()
         name = body.get("lora_name")
-        if name in lora_adapters:
-            lora_adapters.remove(name)
-        return web.json_response({"status": "ok"})
+        if not name:
+            return _error("lora_name required")
+        removed = await asyncio.get_running_loop().run_in_executor(
+            None, engine.engine.unload_lora, name
+        )
+        return web.json_response({"status": "ok", "removed": bool(removed)})
 
     async def version(request: web.Request) -> web.Response:
         return web.json_response({"version": __version__})
@@ -607,6 +636,14 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--api-key", default=None)
+    # LoRA serving (vLLM --enable-lora analogue).
+    p.add_argument("--enable-lora", action="store_true", default=False)
+    p.add_argument("--max-loras", type=int, default=8)
+    p.add_argument("--max-lora-rank", type=int, default=16)
+    p.add_argument("--lora-dir", default="/adapters")
+    # Decode burst + batch-shape floors.
+    p.add_argument("--num-decode-steps", type=int, default=1)
+    p.add_argument("--min-decode-bucket", type=int, default=1)
     # KV tiering / controller (LMCache env-var analogues).
     p.add_argument("--cpu-offload-blocks", type=int, default=0)
     p.add_argument("--remote-kv-url", default=None)
@@ -637,6 +674,12 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         attn_impl=args.attn_impl,
         enable_prefix_caching=args.enable_prefix_caching,
         seed=args.seed,
+        enable_lora=args.enable_lora,
+        max_loras=args.max_loras,
+        max_lora_rank=args.max_lora_rank,
+        lora_dir=args.lora_dir,
+        num_decode_steps=args.num_decode_steps,
+        min_decode_bucket=args.min_decode_bucket,
         cpu_offload_blocks=args.cpu_offload_blocks,
         remote_kv_url=args.remote_kv_url,
         cache_controller_url=args.cache_controller_url,

@@ -207,6 +207,48 @@ class Llama:
         return specs
 
     # ------------------------------------------------------------------
+    # LoRA bank (stacked adapter slots — engine/lora.py owns the registry)
+    # ------------------------------------------------------------------
+
+    LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+    def init_lora_bank(self, max_loras: int, max_rank: int) -> Params:
+        """Zero-filled stacked adapter bank, merged into params["layers"]:
+        ``lora_a_<t>`` [L, slots, in, r], ``lora_b_<t>`` [L, slots, r, out].
+        Slot 0 stays zero forever = "no adapter" (exact no-op delta)."""
+        cfg = self.cfg
+        d = cfg.jdtype
+        L, S, R = cfg.num_layers, max_loras + 1, max_rank
+        dims = {
+            "wq": (cfg.hidden_size, cfg.q_size),
+            "wk": (cfg.hidden_size, cfg.kv_size),
+            "wv": (cfg.hidden_size, cfg.kv_size),
+            "wo": (cfg.q_size, cfg.hidden_size),
+        }
+        bank: Params = {}
+        for t, (din, dout) in dims.items():
+            bank[f"lora_a_{t}"] = jnp.zeros((L, S, din, R), d)
+            bank[f"lora_b_{t}"] = jnp.zeros((L, S, R, dout), d)
+        return bank
+
+    def lora_pspecs(self, pipeline: bool = False) -> Params:
+        """PartitionSpecs for the bank: B matrices follow their projection's
+        output sharding (column-parallel q/k/v), A for wo follows its input
+        sharding (row-parallel) — the deltas then compose with the base
+        matmuls under the same collectives XLA already inserts."""
+        pp = "pp" if pipeline else None
+        return {
+            "lora_a_wq": P(pp, None, None, None),
+            "lora_b_wq": P(pp, None, None, AXIS_TENSOR),
+            "lora_a_wk": P(pp, None, None, None),
+            "lora_b_wk": P(pp, None, None, AXIS_TENSOR),
+            "lora_a_wv": P(pp, None, None, None),
+            "lora_b_wv": P(pp, None, None, AXIS_TENSOR),
+            "lora_a_wo": P(pp, None, AXIS_TENSOR, None),
+            "lora_b_wo": P(pp, None, None, None),
+        }
+
+    # ------------------------------------------------------------------
     # KV cache
     # ------------------------------------------------------------------
 
@@ -252,6 +294,8 @@ class Llama:
         last_idx: jax.Array,  # [B] int32 index in T of each row's last token
         kv_cache: jax.Array,  # [L, nb, 2, bs, KH*hd] (donated by caller's jit)
         *,
+        lora_idx: Optional[jax.Array] = None,  # [B] int32 bank slots (0=none)
+        lora_scale: Optional[jax.Array] = None,  # [B] f32 alpha/r per row
         attn_impl: str = "auto",
         pp_size: int = 1,
         mesh=None,
@@ -270,55 +314,91 @@ class Llama:
         x = params["embed"][tokens]  # [B, T, D]
         rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         flat_write_real = write_idx.reshape(-1)  # [B*T]
+        has_lora = "lora_a_wq" in params["layers"]
+        if has_lora and lora_idx is None:
+            lora_idx = jnp.zeros((B,), jnp.int32)
+            lora_scale = jnp.zeros((B,), jnp.float32)
 
-        def layer_fn(ctx, x, scanned):
+        def lora_delta(lp, t: str, inp: jax.Array) -> jax.Array:
+            """scaling * (inp @ A[slot]) @ B[slot] per batch row (slot 0 is
+            zeros, so no-adapter rows get an exact zero delta)."""
+            a = lp[f"lora_a_{t}"][lora_idx]  # [B, in, r]
+            b = lp[f"lora_b_{t}"][lora_idx]  # [B, r, out]
+            d = jnp.einsum(
+                "btd,bdr->btr", inp, a, preferred_element_type=jnp.float32
+            )
+            d = jnp.einsum(
+                "btr,bro->bto", d.astype(b.dtype), b,
+                preferred_element_type=jnp.float32,
+            )
+            return d * lora_scale[:, None, None]
+
+        def layer_fn(ctx, x, kv_all, lp, li):
             # ctx: traced arrays shared by every layer. Threaded explicitly
             # (not closed over) so the pp shard_map can pass them through.
+            # kv_all: the FULL stacked cache [L, nb, 2, bs, KH*hd]; li is
+            # this layer's index into it. The cache is never sliced — the
+            # attention kernel takes (cache, layer) and reads only the live
+            # pages, and the write is a scatter at layer-offset rows, so the
+            # carried buffer updates in place (a per-layer slice/update pair
+            # would copy the whole layer cache twice per layer per step).
             flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
-            lp, kv_pages = scanned  # cache: [nb, 2, bs, KH*hd]
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q = _proj(h, lp["wq"], lp.get("bq"))
             k = _proj(h, lp["wk"], lp.get("bk"))
             v = _proj(h, lp["wv"], lp.get("bv"))
+            if has_lora:
+                q = q + lora_delta(lp, "wq", h).astype(q.dtype)
+                k = k + lora_delta(lp, "wk", h).astype(k.dtype)
+                v = v + lora_delta(lp, "wv", h).astype(v.dtype)
             q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
             k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             q = _apply_rope(q, rope_cos, rope_sin)
             k = _apply_rope(k, rope_cos, rope_sin)
 
-            # Write this step's K/V into the pages (one scatter over the
-            # flattened [nb*2*bs, KH*hd] row view: slot (blk, pos) holds its
-            # K row at blk*2*bs + pos and its V row bs rows later), then
-            # attend through the block table — prefix hits and chunked
-            # prefill need no special casing because the cache is always the
-            # source of truth.
+            # One scatter over the flattened [L*nb*2*bs, KH*hd] row view:
+            # slot (blk, pos) of layer li holds its K row at
+            # (li*nb + blk)*2*bs + pos and its V row bs rows later. The drop
+            # sentinel (flat_write == nb*bs) must map OUT of the whole
+            # array, not merely past this layer's rows — past-the-layer
+            # would land in layer li+1's first page.
+            n_layers_total = kv_all.shape[0]
             blk = flat_write // bs
             pos = flat_write % bs
-            idx_k = blk * (2 * bs) + pos  # drop slot nb*bs maps OOB → dropped
+            oob = n_layers_total * nb * 2 * bs
+            idx_k = jnp.where(
+                flat_write >= nb * bs,
+                oob,
+                (li * nb + blk) * (2 * bs) + pos,
+            )
             kvd = jnp.concatenate(
                 [
                     k.reshape(B * T, cfg.kv_size),
                     v.reshape(B * T, cfg.kv_size),
                 ],
                 axis=0,
-            ).astype(kv_pages.dtype)  # [2*B*T, KH*hd]
+            ).astype(kv_all.dtype)  # [2*B*T, KH*hd]
             idx = jnp.concatenate([idx_k, idx_k + bs])
-            kv_pages = (
-                kv_pages.reshape(nb * 2 * bs, cfg.kv_size)
+            kv_all = (
+                kv_all.reshape(n_layers_total * nb * 2 * bs, cfg.kv_size)
                 .at[idx]
                 .set(kvd, mode="drop")
-                .reshape(nb, 2, bs, cfg.kv_size)
+                .reshape(n_layers_total, nb, 2, bs, cfg.kv_size)
             )
 
             attn = paged_attention(
-                q, kv_pages, block_tables, kv_lens, positions,
+                q, kv_all, block_tables, kv_lens, positions, li,
                 scale=scale, impl=attn_impl,
             )
             attn = attn.reshape(B, T, cfg.q_size)
-            x = x + jnp.einsum(
+            o = jnp.einsum(
                 "btq,qd->btd", attn.astype(lp["wo"].dtype), lp["wo"],
                 preferred_element_type=jnp.float32,
-            ).astype(x.dtype)
+            )
+            if has_lora:
+                o = o + lora_delta(lp, "wo", attn.astype(lp["wo"].dtype))
+            x = x + o.astype(x.dtype)
 
             h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             gate = _proj(h, lp["w_gate"])
@@ -329,7 +409,26 @@ class Llama:
             x = x + jnp.einsum(
                 "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
             ).astype(x.dtype)
-            return x, kv_pages
+            return x, kv_all
+
+        def scan_layers(ctx, x, kv_all, layers, n_layers):
+            # The cache rides the scan CARRY — carried while-loop buffers
+            # alias across iterations, so peak HBM holds ONE cache. (As scan
+            # xs/ys the stacked outputs would be a second full-size
+            # allocation: at the 32k-context bench config that is +11 GiB
+            # and an instant OOM.) The body never slices the cache; see
+            # layer_fn.
+            def body(carry, sl):
+                x, kv_all = carry
+                lp, i = sl
+                x, kv_all = layer_fn(ctx, x, kv_all, lp, i)
+                return (x, kv_all), None
+
+            (x, kv_all), _ = jax.lax.scan(
+                body, (x, kv_all),
+                (layers, jnp.arange(n_layers, dtype=jnp.int32)),
+            )
+            return x, kv_all
 
         ctx = (flat_write_real, rope_cos, rope_sin, block_tables, kv_lens,
                positions)
@@ -341,10 +440,9 @@ class Llama:
                 # write KV; others write to the dropped slot (nb*bs).
                 fw = jnp.where(gate, fw, nb * bs)
                 layers_local, kv_local = scanned_local
-                x, kv_local = jax.lax.scan(
-                    lambda c, s: layer_fn((fw, *rest), c, s),
-                    x,
-                    (layers_local, kv_local),
+                x, kv_local = scan_layers(
+                    (fw, *rest), x, kv_local, layers_local,
+                    cfg.num_layers // pp_size,
                 )
                 return x, (layers_local, kv_local)
 
@@ -353,10 +451,8 @@ class Llama:
                 pp_size, mesh,
             )
         else:
-            x, kv_cache = jax.lax.scan(
-                lambda c, s: layer_fn(ctx, c, s),
-                x,
-                (params["layers"], kv_cache),
+            x, kv_cache = scan_layers(
+                ctx, x, kv_cache, params["layers"], cfg.num_layers
             )
 
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

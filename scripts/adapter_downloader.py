@@ -1,0 +1,117 @@
+"""LoRA adapter download sidecar.
+
+Reference analogue: `docker/Dockerfile.sidecar` + the downloader service the
+LoraAdapter reconciler drives (`loraadapter_controller.go:394` placement →
+pod-local adapter files). Runs next to the engine container sharing the
+adapter volume; the operator (or a human) POSTs a download request and the
+engine then loads the files with `/v1/load_lora_adapter`.
+
+API:
+  POST /download {"name": "my-adapter", "source": "<uri>"}
+      hf://org/repo          HuggingFace snapshot (needs egress + HF_TOKEN)
+      http(s)://...          single-file or .tar.gz archive fetch
+      file:///path           copy from an already-mounted path
+  GET  /adapters             list downloaded adapter dirs
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import tarfile
+import tempfile
+
+from aiohttp import ClientSession, web
+
+ADAPTER_DIR = os.environ.get("ADAPTER_DIR", "/adapters")
+PORT = int(os.environ.get("PORT", "8010"))
+
+
+async def _fetch_http(url: str, dest_dir: str) -> None:
+    fname = url.rstrip("/").rsplit("/", 1)[-1] or "adapter.bin"
+    os.makedirs(dest_dir, exist_ok=True)
+    async with ClientSession() as session:
+        async with session.get(url) as resp:
+            resp.raise_for_status()
+            with tempfile.NamedTemporaryFile(delete=False) as tmp:
+                while True:
+                    chunk = await resp.content.read(1 << 20)
+                    if not chunk:
+                        break
+                    tmp.write(chunk)
+    if fname.endswith((".tar.gz", ".tgz", ".tar")):
+        with tarfile.open(tmp.name) as tar:
+            tar.extractall(dest_dir, filter="data")
+        os.unlink(tmp.name)
+    else:
+        shutil.move(tmp.name, os.path.join(dest_dir, fname))
+
+
+def _fetch_hf(repo: str, dest_dir: str) -> None:
+    from huggingface_hub import snapshot_download
+
+    snapshot_download(
+        repo_id=repo,
+        local_dir=dest_dir,
+        token=os.environ.get("HF_TOKEN") or None,
+        allow_patterns=["*.safetensors", "*.json"],
+    )
+
+
+async def download(request: web.Request) -> web.Response:
+    body = await request.json()
+    name, source = body.get("name"), body.get("source", "")
+    if not name or "/" in name or name.startswith("."):
+        return web.json_response({"error": "invalid adapter name"}, status=400)
+    dest = os.path.join(ADAPTER_DIR, name)
+    try:
+        if source.startswith("hf://"):
+            await asyncio.get_running_loop().run_in_executor(
+                None, _fetch_hf, source[len("hf://"):], dest
+            )
+        elif source.startswith(("http://", "https://")):
+            await _fetch_http(source, dest)
+        elif source.startswith("file://"):
+            src = source[len("file://"):]
+            if os.path.isdir(src):
+                shutil.copytree(src, dest, dirs_exist_ok=True)
+            else:
+                os.makedirs(dest, exist_ok=True)
+                shutil.copy(src, dest)
+        else:
+            return web.json_response(
+                {"error": f"unsupported source scheme: {source}"}, status=400
+            )
+    except Exception as e:  # noqa: BLE001 — report, don't crash the sidecar
+        return web.json_response({"error": str(e)}, status=502)
+    return web.json_response({"name": name, "path": dest, "status": "ok"})
+
+
+async def list_adapters(request: web.Request) -> web.Response:
+    if not os.path.isdir(ADAPTER_DIR):
+        return web.json_response({"adapters": []})
+    return web.json_response(
+        {"adapters": sorted(
+            d for d in os.listdir(ADAPTER_DIR)
+            if os.path.isdir(os.path.join(ADAPTER_DIR, d))
+        )}
+    )
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+def main() -> None:
+    app = web.Application()
+    app.router.add_post("/download", download)
+    app.router.add_get("/adapters", list_adapters)
+    app.router.add_get("/healthz", healthz)
+    os.makedirs(ADAPTER_DIR, exist_ok=True)
+    web.run_app(app, port=PORT)
+
+
+if __name__ == "__main__":
+    main()

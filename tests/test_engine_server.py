@@ -129,8 +129,11 @@ async def test_generation_surface():
             assert len(body["data"][0]["embedding"]) == 128  # hidden size
 
 
-async def test_admin_surface():
-    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+async def test_admin_surface(tmp_path):
+    async with EngineServer(
+        enable_lora=True, max_loras=2, max_lora_rank=8,
+        lora_dir=str(tmp_path),
+    ) as server, aiohttp.ClientSession() as sess:
         # health
         async with sess.get(f"{server.url}/health") as r:
             assert r.status == 200
@@ -153,14 +156,33 @@ async def test_admin_surface():
         ) as r:
             assert r.status == 200
 
-        # LoRA admin endpoints reflect into /v1/models.
-        await sess.post(
+        # LoRA admin endpoints: a real PEFT checkpoint loads into a device
+        # bank slot and reflects into /v1/models with parent set; a request
+        # under the adapter name serves; a bogus path 404s.
+        from tests.test_lora import _make_adapter_dir
+
+        path = _make_adapter_dir(tmp_path, server.engine.engine.model_cfg)
+        async with sess.post(
             f"{server.url}/v1/load_lora_adapter",
-            json={"lora_name": "ad1", "lora_path": "/tmp/x"},
-        )
+            json={"lora_name": "ad1", "lora_path": path},
+        ) as r:
+            assert r.status == 200
+            assert (await r.json())["slot"] == 1
         async with sess.get(f"{server.url}/v1/models") as r:
-            ids = [m["id"] for m in (await r.json())["data"]]
-            assert "ad1" in ids
+            cards = (await r.json())["data"]
+            by_id = {m["id"]: m for m in cards}
+            assert by_id["ad1"]["parent"] == "tiny-llama-debug"
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json={"model": "ad1", "prompt": "abc", "max_tokens": 2,
+                  "temperature": 0.0},
+        ) as r:
+            assert r.status == 200
+        async with sess.post(
+            f"{server.url}/v1/load_lora_adapter",
+            json={"lora_name": "nope", "lora_path": "/tmp/does-not-exist"},
+        ) as r:
+            assert r.status == 404
         await sess.post(
             f"{server.url}/v1/unload_lora_adapter", json={"lora_name": "ad1"}
         )

@@ -1,0 +1,197 @@
+"""LoRA serving: PEFT loading, forward-pass deltas, cache salting, HTTP flow.
+
+The load-bearing check is the merged-weights oracle: serving through the
+stacked adapter bank must produce exactly the tokens of a base model whose
+projection weights were merged as W' = W + scaling * A @ B (greedy).
+Reference flow: loraadapter_controller.go:582-611 + vLLM --enable-lora.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+RANK, ALPHA = 4, 8.0  # scaling = 2.0
+
+
+def _make_adapter_dir(tmp_path, model_cfg, targets=("q_proj", "v_proj"),
+                      seed=7, name="ad1"):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "adapter_config.json").write_text(json.dumps({
+        "r": RANK, "lora_alpha": ALPHA,
+        "target_modules": list(targets),
+        "peft_type": "LORA",
+    }))
+    dims = {
+        "q_proj": (model_cfg.hidden_size, model_cfg.q_size),
+        "k_proj": (model_cfg.hidden_size, model_cfg.kv_size),
+        "v_proj": (model_cfg.hidden_size, model_cfg.kv_size),
+        "o_proj": (model_cfg.q_size, model_cfg.hidden_size),
+    }
+    tensors = {}
+    for t in targets:
+        din, dout = dims[t]
+        for i in range(model_cfg.num_layers):
+            key = f"base_model.model.model.layers.{i}.self_attn.{t}"
+            # PEFT layout: A [r, in], B [out, r]. Big enough to flip greedy
+            # argmax on the random-init tiny model, small enough to stay
+            # numerically sane.
+            tensors[f"{key}.lora_A.weight"] = (
+                rng.standard_normal((RANK, din)).astype(np.float32) * 0.3
+            )
+            tensors[f"{key}.lora_B.weight"] = (
+                rng.standard_normal((dout, RANK)).astype(np.float32) * 0.3
+            )
+    save_file(tensors, str(d / "adapter_model.safetensors"))
+    return str(d)
+
+
+def _engine(tmp_path, enable_lora=True):
+    return LLMEngine(EngineConfig(
+        model="tiny-llama-debug",
+        max_model_len=256,
+        block_size=8,
+        num_kv_blocks=96,
+        max_num_seqs=4,
+        max_prefill_tokens=64,
+        attn_impl="gather",
+        enable_lora=enable_lora,
+        max_loras=2,
+        max_lora_rank=8,
+        lora_dir=str(tmp_path),
+    ))
+
+
+def _run(engine, prompt_ids, lora_name=None, max_tokens=8, rid="r"):
+    engine.add_request(
+        rid, prompt_token_ids=list(prompt_ids),
+        sampling=SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                                ignore_eos=True),
+        lora_name=lora_name,
+    )
+    toks = []
+    while engine.has_work():
+        for out in engine.step():
+            toks.extend(out.new_token_ids)
+    return toks
+
+
+def test_lora_changes_generation_and_matches_merged_weights(tmp_path):
+    import jax.numpy as jnp
+
+    eng = _engine(tmp_path)
+    path = _make_adapter_dir(tmp_path, eng.model_cfg)
+    ad = eng.load_lora("ad1", path)
+    assert ad.slot == 1 and ad.scaling == pytest.approx(ALPHA / RANK)
+
+    prompt = list(range(3, 40))
+    base_toks = _run(eng, prompt, lora_name=None, rid="base")
+    lora_toks = _run(eng, prompt, lora_name="ad1", rid="lora")
+    assert base_toks != lora_toks, "adapter had no effect on logits"
+
+    # Oracle: merge W' = W + scaling * A @ B into a fresh engine's params
+    # (same seed → identical base weights); greedy tokens must match the
+    # bank-served run exactly.
+    merged = _engine(tmp_path, enable_lora=False)
+    layers = merged.runner.params["layers"]
+    from production_stack_tpu.engine.lora import LoraManager
+
+    mgr = LoraManager(merged.model_cfg, 2, 8, str(tmp_path))
+    _, arrays = mgr.load("ad1", path)
+    for t in ("wq", "wk", "wv", "wo"):
+        a, b = arrays[t]  # [L, in, r], [L, r, out]
+        delta = jnp.einsum("ldr,lro->ldo", jnp.asarray(a), jnp.asarray(b))
+        layers[t] = (
+            layers[t] + (ALPHA / RANK) * delta.astype(layers[t].dtype)
+        ).astype(layers[t].dtype)
+    merged_toks = _run(merged, prompt, rid="merged")
+    assert merged_toks == lora_toks
+
+
+def test_lora_prefix_cache_is_salted(tmp_path):
+    """KV computed under an adapter must never serve as a prefix hit for the
+    base model (or another adapter) — the KV contents differ."""
+    eng = _engine(tmp_path)
+    path = _make_adapter_dir(tmp_path, eng.model_cfg)
+    eng.load_lora("ad1", path)
+
+    prompt = list(range(5, 38))  # 33 tokens = 4 full blocks of 8
+    _run(eng, prompt, lora_name="ad1", rid="warm")
+    eng.allocator.reset_metrics()
+    _run(eng, prompt, lora_name=None, rid="base")
+    assert eng.allocator.hit_tokens == 0, (
+        "base-model request hit adapter-salted KV blocks"
+    )
+    # Same adapter DOES hit its own cache.
+    eng.allocator.reset_metrics()
+    _run(eng, prompt, lora_name="ad1", rid="warm2")
+    assert eng.allocator.hit_tokens > 0
+
+
+def test_lora_slot_lifecycle(tmp_path):
+    eng = _engine(tmp_path)
+    cfgm = eng.model_cfg
+    p1 = _make_adapter_dir(tmp_path, cfgm, seed=1, name="a1")
+    p2 = _make_adapter_dir(tmp_path, cfgm, seed=2, name="a2")
+    eng.load_lora("a1", p1)
+    eng.load_lora("a2", p2)
+    with pytest.raises(RuntimeError):  # max_loras=2
+        eng.load_lora("a3", p1)
+    assert eng.unload_lora("a1")
+    eng.load_lora("a3", p2)  # freed slot is reusable
+    names = [a.name for a in eng.lora_manager.list_adapters()]
+    assert "a3" in names and "a1" not in names
+    with pytest.raises(ValueError):
+        _run(eng, [1, 2, 3], lora_name="a1", rid="gone")
+
+
+def test_unload_waits_for_inflight_sequences(tmp_path):
+    """Unload mid-generation must NOT swap weights under the running
+    request: the slot is zeroed/reused only after the request drains."""
+    eng = _engine(tmp_path)
+    path = _make_adapter_dir(tmp_path, eng.model_cfg)
+    eng.load_lora("ad1", path)
+    prompt = list(range(3, 40))
+
+    # Full-run reference under the adapter.
+    ref = _run(eng, prompt, lora_name="ad1", max_tokens=10, rid="ref")
+
+    eng.load_lora("ad1", path)  # re-register (unload below removed it? no —
+    # still loaded; load() short-circuits to the resident adapter)
+    eng.add_request(
+        "mid", prompt_token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=10, temperature=0.0,
+                                ignore_eos=True),
+        lora_name="ad1",
+    )
+    toks = []
+    steps = 0
+    while eng.has_work():
+        for out in eng.step():
+            toks.extend(out.new_token_ids)
+        steps += 1
+        if steps == 3:  # mid-flight: unload the adapter
+            assert eng.unload_lora("ad1")
+            assert 1 in eng._retiring_slots  # still referenced → not freed
+    assert toks == ref, "weights changed under an in-flight request"
+    assert not eng._retiring_slots, "slot not recycled after drain"
+    # The freed slot is reusable.
+    eng.load_lora("ad2", path)
+    assert eng.lora_manager.get("ad2").slot == 1
+
+
+def test_unknown_adapter_rejected(tmp_path):
+    eng = _engine(tmp_path)
+    with pytest.raises(ValueError):
+        eng.add_request("x", prompt_token_ids=[1, 2],
+                        sampling=SamplingParams(max_tokens=1),
+                        lora_name="nope")
