@@ -7,7 +7,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstring>
+#include <ctime>
 #include <sstream>
 #include <stdexcept>
 
@@ -73,7 +75,123 @@ bool send_all(int fd, const std::string& data) {
   return true;
 }
 
+// Shared response-header parsing ("HTTP/1.1 200 OK" + Transfer-Encoding
+// detection) for the buffered and streaming clients.
+int parse_status_line(const std::string& headers) {
+  auto sp = headers.find(' ');
+  if (sp == std::string::npos) return 0;
+  try {
+    return std::stoi(headers.substr(sp + 1));
+  } catch (const std::exception&) {
+    return 0;
+  }
+}
+
+bool is_chunked(const std::string& headers) {
+  std::string lower;
+  lower.reserve(headers.size());
+  for (char c : headers) lower += static_cast<char>(tolower(c));
+  return lower.find("transfer-encoding: chunked") != std::string::npos;
+}
+
 }  // namespace
+
+int http_stream(const std::string& url,
+                const std::function<bool(const std::string&)>& on_line,
+                const volatile sig_atomic_t* stop, int timeout_sec) {
+  // Never throws: watch threads have no exception handler of their own —
+  // a parse failure must degrade to "stream unavailable", not terminate.
+  Url u;
+  int fd;
+  try {
+    u = Url::parse(url);
+    fd = connect_to(u.host, u.port, /*timeout_sec=*/2);
+  } catch (const std::exception&) {
+    return 0;
+  }
+  // Short receive timeout so the stop flag is polled between reads; the
+  // overall stream lives until close/stop (K8s watch streams are long).
+  struct timeval tv{1, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  std::ostringstream req;
+  req << "GET " << u.path << " HTTP/1.1\r\n"
+      << "Host: " << u.host << ":" << u.port << "\r\n"
+      << "Connection: close\r\n"
+      << "Accept: application/json\r\n\r\n";
+  if (!send_all(fd, req.str())) {
+    close(fd);
+    return 0;
+  }
+
+  std::string raw;         // bytes before the header/body split
+  std::string body;        // de-chunked body bytes not yet emitted as lines
+  std::string chunk_buf;   // raw chunked-encoding bytes pending de-framing
+  bool headers_done = false, chunked = false;
+  int status = 0;
+  time_t deadline = time(nullptr) + timeout_sec;
+  char buf[16384];
+  while (!(stop && *stop) && time(nullptr) < deadline) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n == 0) break;  // server closed
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;  // poll stop
+      break;
+    }
+    deadline = time(nullptr) + timeout_sec;  // progress resets the idle clock
+    if (!headers_done) {
+      raw.append(buf, static_cast<size_t>(n));
+      auto he = raw.find("\r\n\r\n");
+      if (he == std::string::npos) continue;
+      std::string headers = raw.substr(0, he);
+      status = parse_status_line(headers);
+      chunked = is_chunked(headers);
+      headers_done = true;
+      chunk_buf = raw.substr(he + 4);
+      raw.clear();
+    } else {
+      chunk_buf.append(buf, static_cast<size_t>(n));
+    }
+    if (!headers_done) continue;
+    if (status < 200 || status >= 300) break;
+    if (chunked) {  // incremental de-chunk: emit complete chunks into body
+      size_t pos = 0;
+      while (true) {
+        auto le = chunk_buf.find("\r\n", pos);
+        if (le == std::string::npos) break;
+        size_t chunk_len;
+        try {
+          chunk_len = std::stoul(chunk_buf.substr(pos, le - pos), nullptr, 16);
+        } catch (const std::exception&) {
+          close(fd);
+          return status;  // malformed framing: give up on this stream
+        }
+        if (chunk_len == 0) {
+          close(fd);
+          return status;
+        }
+        if (chunk_buf.size() < le + 2 + chunk_len + 2) break;  // incomplete
+        body.append(chunk_buf, le + 2, chunk_len);
+        pos = le + 2 + chunk_len + 2;
+      }
+      chunk_buf.erase(0, pos);
+    } else {
+      body += chunk_buf;
+      chunk_buf.clear();
+    }
+    size_t nl;
+    while ((nl = body.find('\n')) != std::string::npos) {
+      std::string line = body.substr(0, nl);
+      body.erase(0, nl + 1);
+      if (!line.empty() && !on_line(line)) {
+        close(fd);
+        return status;
+      }
+    }
+  }
+  close(fd);
+  return status;
+}
 
 HttpResponse http_request(const std::string& method, const std::string& url,
                           const std::string& body,
@@ -111,21 +229,21 @@ HttpResponse http_request(const std::string& method, const std::string& url,
   std::string headers = raw.substr(0, header_end);
   std::string payload = raw.substr(header_end + 4);
 
-  // Status line: HTTP/1.1 200 OK
-  auto sp = headers.find(' ');
-  resp.status = sp == std::string::npos ? 0 : std::stoi(headers.substr(sp + 1));
+  resp.status = parse_status_line(headers);
 
   // De-chunk if needed (Connection: close means we already have every byte).
-  std::string lower_headers;
-  lower_headers.reserve(headers.size());
-  for (char c : headers) lower_headers += static_cast<char>(tolower(c));
-  if (lower_headers.find("transfer-encoding: chunked") != std::string::npos) {
+  if (is_chunked(headers)) {
     std::string out;
     size_t pos = 0;
     while (pos < payload.size()) {
       auto line_end = payload.find("\r\n", pos);
       if (line_end == std::string::npos) break;
-      size_t chunk_len = std::stoul(payload.substr(pos, line_end - pos), nullptr, 16);
+      size_t chunk_len;
+      try {
+        chunk_len = std::stoul(payload.substr(pos, line_end - pos), nullptr, 16);
+      } catch (const std::exception&) {
+        break;  // malformed framing: keep what we have
+      }
       if (chunk_len == 0) break;
       out.append(payload, line_end + 2, chunk_len);
       pos = line_end + 2 + chunk_len + 2;  // skip chunk + trailing CRLF

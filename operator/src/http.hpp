@@ -7,6 +7,8 @@
 // (loraadapter_controller.go:582-611).
 #pragma once
 
+#include <csignal>
+#include <functional>
 #include <string>
 
 namespace pst {
@@ -29,5 +31,14 @@ HttpResponse http_request(const std::string& method, const std::string& url,
                           const std::string& body = "",
                           const std::string& content_type = "application/json",
                           int timeout_sec = 10);
+
+// Streaming GET: de-chunks the response incrementally and invokes on_line for
+// every newline-terminated line of the body (the K8s watch wire format:
+// one JSON event object per line). Returns when the server closes the
+// stream, a socket timeout elapses with *stop set, or on_line returns false.
+// Returns the HTTP status (0 on transport error before headers).
+int http_stream(const std::string& url,
+                const std::function<bool(const std::string&)>& on_line,
+                const volatile sig_atomic_t* stop, int timeout_sec = 30);
 
 }  // namespace pst

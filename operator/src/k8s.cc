@@ -61,6 +61,14 @@ bool K8sClient::destroy(const std::string& api_prefix,
   return resp.ok() || resp.status == 404;
 }
 
+int K8sClient::watch(const std::string& api_prefix, const std::string& plural,
+                     const std::function<bool(const std::string&)>& on_event,
+                     const volatile sig_atomic_t* stop,
+                     int idle_timeout_sec) const {
+  return http_stream(url(api_prefix, plural, "", "watch=true"), on_event,
+                     stop, idle_timeout_sec);
+}
+
 bool K8sClient::patch_status(const std::string& api_prefix,
                              const std::string& plural, const std::string& name,
                              const Json& status) const {

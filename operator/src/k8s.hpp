@@ -34,6 +34,14 @@ class K8sClient {
   bool patch_status(const std::string& api_prefix, const std::string& plural,
                     const std::string& name, const Json& status) const;
 
+  // Long-poll watch stream (?watch=true): on_event receives each event line
+  // (a JSON object {"type": "ADDED|MODIFIED|DELETED", "object": {...}});
+  // return false from it to stop. Blocks until server close/stop/idle
+  // timeout; returns the HTTP status (0 = transport error).
+  int watch(const std::string& api_prefix, const std::string& plural,
+            const std::function<bool(const std::string&)>& on_event,
+            const volatile sig_atomic_t* stop, int idle_timeout_sec = 60) const;
+
  private:
   std::string url(const std::string& api_prefix, const std::string& plural,
                   const std::string& name = "",

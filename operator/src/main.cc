@@ -10,12 +10,16 @@
 // is a kubectl-proxy/TLS-terminating sidecar on localhost (no TLS libs in
 // the runtime image — see operator/README.md).
 
+#include <condition_variable>
 #include <csignal>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <map>
+#include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 #include <unistd.h>
 
 #include "k8s.hpp"
@@ -31,6 +35,7 @@ struct Options {
   std::string ns = "default";
   int interval_sec = 10;
   bool once = false;  // single pass (tests / CI)
+  bool watch = true;  // event-driven reconcile (interval is the fallback)
   bool leader_election = false;
   std::string identity;
 };
@@ -49,11 +54,12 @@ Options parse_options(int argc, char** argv) {
     else if (a == "--namespace") o.ns = next();
     else if (a == "--interval") o.interval_sec = std::stoi(next());
     else if (a == "--once") o.once = true;
+    else if (a == "--no-watch") o.watch = false;
     else if (a == "--leader-elect") o.leader_election = true;
     else if (a == "--identity") o.identity = next();
     else if (a == "--help") {
       printf("pst-operator --api-server URL --namespace NS [--interval S]"
-             " [--once] [--leader-elect] [--identity ID]\n");
+             " [--once] [--no-watch] [--leader-elect] [--identity ID]\n");
       exit(0);
     }
   }
@@ -145,6 +151,122 @@ void reconcile_all(const pst::K8sClient& k8s) {
   }
 }
 
+// Event-driven convergence (the reference's controller-runtime informers,
+// operator/cmd/main.go:58-231): one watch stream per CRD kind plus the
+// engine-pod watch (pods trigger LoraAdapter re-placement the way
+// findLoraAdaptersForPod does, loraadapter_controller.go:278). Any event
+// marks the loop dirty; the interval pass remains as a safety net and as
+// graceful degradation when the API server rejects ?watch=true.
+class WatchHub {
+ public:
+  WatchHub(const pst::K8sClient& k8s) : k8s_(k8s) {}
+
+  void start() {
+    static const std::pair<const char*, const char*> streams[] = {
+        {pst::kPstV1, "tpuruntimes"},
+        {pst::kPstV1, "tpurouters"},
+        {pst::kPstV1, "cacheservers"},
+        {pst::kPstV1, "loraadapters"},
+        {pst::kCoreV1, "pods"},
+    };
+    for (const auto& s : streams) {
+      threads_.emplace_back([this, api = s.first, plural = s.second] {
+        bool warned = false;
+        const bool own_kind = std::string(api) == pst::kPstV1;
+        while (!g_stop) {
+          int status = k8s_.watch(
+              api, plural,
+              [this, own_kind, plural](const std::string& line) {
+                if (relevant(own_kind, plural, line)) notify();
+                return !g_stop;
+              },
+              &g_stop);
+          if (g_stop) break;
+          if (status == 404 || status == 400) {
+            // API server without watch support: interval fallback only.
+            if (!warned) {
+              fprintf(stderr, "[operator] watch %s unsupported (%d); "
+                      "falling back to interval polling\n", plural, status);
+              warned = true;
+            }
+            for (int i = 0; i < 300 && !g_stop; ++i)
+              std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          } else {
+            // Stream closed / transport error: brief backoff, re-watch.
+            for (int i = 0; i < 10 && !g_stop; ++i)
+              std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          }
+        }
+      });
+    }
+  }
+
+  // Wait until an event arrives or timeout; clears the dirty flag. Waits in
+  // short slices: the signal handler only flips g_stop (it cannot safely
+  // notify a condition variable), so shutdown must be polled.
+  void wait_dirty(int timeout_sec) {
+    std::unique_lock<std::mutex> lock(mu_);
+    for (int waited_ms = 0; waited_ms < timeout_sec * 1000 && !g_stop;
+         waited_ms += 200) {
+      if (cv_.wait_for(lock, std::chrono::milliseconds(200),
+                       [this] { return dirty_; }))
+        break;
+    }
+    dirty_ = false;
+  }
+
+  void join() {
+    for (auto& t : threads_) t.join();
+  }
+
+ private:
+  // Event filter: the reconcilers end every pass with a status patch, which
+  // on a real API server emits a MODIFIED event on the object just
+  // reconciled. Waking on those would make the operator reconcile in a
+  // permanent ~150ms hot loop. `metadata.generation` only increments on
+  // spec changes, so for our own CRDs: ADDED/DELETED always wake,
+  // MODIFIED wakes only on a generation change or a pending
+  // deletionTimestamp (finalizer flow). Pod events always wake — the
+  // operator never writes pods, so they are externally caused.
+  bool relevant(bool own_kind, const std::string& plural,
+                const std::string& line) {
+    if (!own_kind) return true;
+    try {
+      pst::Json ev = pst::Json::parse(line);
+      const std::string type = ev.at("type").as_string();
+      const pst::Json& meta = ev.at({"object", "metadata"});
+      const std::string key = plural + "/" + meta.at("name").as_string();
+      const long gen = meta.at("generation").as_int(-1);
+      std::lock_guard<std::mutex> lock(gen_mu_);
+      if (type == "DELETED") {
+        generations_.erase(key);
+        return true;
+      }
+      if (!meta.at("deletionTimestamp").as_string_or("").empty()) return true;
+      auto it = generations_.find(key);
+      const bool changed = it == generations_.end() || it->second != gen;
+      generations_[key] = gen;
+      return changed;
+    } catch (const std::exception&) {
+      return true;  // unparseable event: fail open, reconcile
+    }
+  }
+
+  void notify() {
+    std::lock_guard<std::mutex> lock(mu_);
+    dirty_ = true;
+    cv_.notify_one();
+  }
+
+  const pst::K8sClient& k8s_;
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool dirty_ = false;
+  std::mutex gen_mu_;
+  std::map<std::string, long> generations_;
+};
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -152,9 +274,14 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_signal);
   signal(SIGTERM, handle_signal);
   pst::K8sClient k8s(o.api_server, o.ns);
-  printf("[operator] managing namespace %s via %s (interval %ds)\n",
-         o.ns.c_str(), o.api_server.c_str(), o.interval_sec);
+  printf("[operator] managing namespace %s via %s (interval %ds, watch=%s)\n",
+         o.ns.c_str(), o.api_server.c_str(), o.interval_sec,
+         o.watch ? "on" : "off");
   fflush(stdout);
+
+  WatchHub hub(k8s);
+  const bool watching = o.watch && !o.once;
+  if (watching) hub.start();
 
   do {
     if (!o.leader_election || try_acquire_lease(k8s, o)) {
@@ -162,9 +289,17 @@ int main(int argc, char** argv) {
     }
     fflush(stdout);
     if (o.once) break;
-    for (int i = 0; i < o.interval_sec * 10 && !g_stop; ++i)
-      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    if (watching) {
+      hub.wait_dirty(o.interval_sec);
+      // Coalesce event bursts (a Deployment create fans out several watch
+      // events) into one reconcile pass.
+      std::this_thread::sleep_for(std::chrono::milliseconds(150));
+    } else {
+      for (int i = 0; i < o.interval_sec * 10 && !g_stop; ++i)
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
   } while (!g_stop);
   printf("[operator] shutting down\n");
+  if (watching) hub.join();
   return 0;
 }

@@ -608,6 +608,18 @@ bool post_adapter(const std::string& base, const std::string& endpoint,
 
 }  // namespace
 
+namespace {
+
+const char* kLoraFinalizer = "pst.production-stack.io/lora-unload";
+
+bool has_lora_finalizer(const Json& cr) {
+  for (const auto& f : cr.at({"metadata", "finalizers"}).items())
+    if (f.as_string() == kLoraFinalizer) return true;
+  return false;
+}
+
+}  // namespace
+
 ReconcileResult reconcile_lora_adapter(const K8sClient& k8s, const Json& cr) {
   // Placement algorithms follow the reference semantics
   // (loraadapter_controller.go:394 getOptimalPlacement):
@@ -617,10 +629,57 @@ ReconcileResult reconcile_lora_adapter(const K8sClient& k8s, const Json& cr) {
   //               multiple adapters spread across the fleet
   ReconcileResult result;
   const Json& spec = cr.at("spec");
-  const std::string adapter = spec.at("adapterName").as_string_or(
-      cr.at({"metadata", "name"}).as_string());
+  const std::string cr_name = cr.at({"metadata", "name"}).as_string();
+  const std::string adapter = spec.at("adapterName").as_string_or(cr_name);
   const std::string path = spec.at("adapterPath").as_string_or("");
   const std::string base_model = spec.at("baseModel").as_string();
+
+  // Finalizer-based deletion (reference handleDeletion,
+  // loraadapter_controller.go:868): a deleted CR first unloads the adapter
+  // from every pod that still serves it, then releases the finalizer so the
+  // API server can drop the object. Without this a delete between passes
+  // would strand adapters on pods forever.
+  const bool deleting =
+      !cr.at({"metadata", "deletionTimestamp"}).as_string_or("").empty();
+  if (deleting) {
+    // Unload is posted to EVERY matching pod unconditionally: probing
+    // adapter_loaded() first would let a transiently-unreachable pod read
+    // as "not loaded", release the finalizer, and strand the adapter on
+    // that pod forever. Unloading an absent adapter is a no-op server-side;
+    // an unreachable pod fails the POST and holds the finalizer for the
+    // next reconcile.
+    auto pods = ready_engine_pods(k8s, base_model);
+    bool all_unloaded = true;
+    for (const auto& pod : pods) {
+      all_unloaded &=
+          post_adapter(pod.base, "/v1/unload_lora_adapter", adapter, "");
+    }
+    if (all_unloaded && has_lora_finalizer(cr)) {
+      Json updated = cr;
+      Json remaining = Json::array();
+      for (const auto& f : cr.at({"metadata", "finalizers"}).items())
+        if (f.as_string() != kLoraFinalizer) remaining.push_back(f);
+      updated["metadata"]["finalizers"] = remaining;
+      k8s.replace(kPstV1, "loraadapters", cr_name, updated);
+    }
+    result.changed = true;
+    result.phase = "Deleting";
+    return result;
+  }
+  if (!has_lora_finalizer(cr)) {
+    Json updated = cr;
+    Json finalizers = Json::array();
+    for (const auto& f : cr.at({"metadata", "finalizers"}).items())
+      finalizers.push_back(f);
+    finalizers.push_back(Json(std::string(kLoraFinalizer)));
+    updated["metadata"]["finalizers"] = finalizers;
+    try {
+      k8s.replace(kPstV1, "loraadapters", cr_name, updated);
+    } catch (const std::exception& e) {
+      fprintf(stderr, "[operator] loraadapters/%s: finalizer add failed: %s\n",
+              cr_name.c_str(), e.what());
+    }
+  }
   const std::string algo =
       spec.at({"placement", "algorithm"}).as_string_or("default");
   long want = spec.at({"placement", "replicas"}).as_int(0);
