@@ -98,14 +98,19 @@ class ModelRunner:
         else:
             params = self.model.init_params(jax.random.PRNGKey(cfg.seed))
         pspecs = self.model.param_pspecs(pipeline=pp > 1)
+        if cfg.enable_lora:
+            params["layers"].update(
+                self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
+            )
+            pspecs["layers"].update(self.model.lora_pspecs(pipeline=pp > 1))
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             params,
             pspecs,
         )
-        param_bytes = sum(
-            x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
-        )
+        leaves = jax.tree.leaves(self.params)
+        self.param_count = sum(x.size for x in leaves)
+        param_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
         logger.info(
             "params ready: %.2f GiB total, %.1fs", param_bytes / 2**30, time.time() - t0
         )
@@ -137,6 +142,8 @@ class ModelRunner:
                 batch["kv_lens"],
                 batch["last_idx"],
                 kv_cache,
+                lora_idx=batch.get("lora_idx"),
+                lora_scale=batch.get("lora_scale"),
                 attn_impl=attn_impl,
                 pp_size=pp,
                 mesh=mesh_for_pp,
@@ -200,6 +207,8 @@ class ModelRunner:
                     positions + 1,  # kv valid through the just-written slot
                     jnp.zeros_like(positions),
                     kv_cache,
+                    lora_idx=batch.get("lora_idx"),
+                    lora_scale=batch.get("lora_scale"),
                     attn_impl=attn_impl,
                     pp_size=pp,
                     mesh=mesh_for_pp,
@@ -280,6 +289,50 @@ class ModelRunner:
         self.kv_cache = self._page_set(
             self.kv_cache, blk, jnp_asarray(page, self.kv_cache.dtype)
         )
+
+    # ------------------------------------------------------------------
+    # LoRA bank slots (engine/lora.py owns name->slot; device arrays here)
+    # ------------------------------------------------------------------
+
+    def install_adapter(self, slot: int, arrays: Dict[str, Any]) -> None:
+        """Write one adapter's A/B matrices into bank slot ``slot``.
+
+        arrays: {target: (A [L, in, r_max], B [L, r_max, out])} host numpy.
+        """
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("install_adapter", (int(slot), arrays))
+            self._dispatch_install_adapter(slot, arrays)
+
+    def _dispatch_install_adapter(self, slot: int, arrays: Dict[str, Any]) -> None:
+        if not hasattr(self, "_slot_set"):
+            self._slot_set = jax.jit(
+                lambda bank, s, x: bank.at[:, s].set(x), donate_argnums=(0,)
+            )
+        layers = self.params["layers"]
+        for t, (a_np, b_np) in arrays.items():
+            for key, host in ((f"lora_a_{t}", a_np), (f"lora_b_{t}", b_np)):
+                bank = layers[key]
+                layers[key] = self._slot_set(
+                    bank, slot, jnp_asarray(host, bank.dtype)
+                )
+
+    def uninstall_adapter(self, slot: int) -> None:
+        """Zero bank slot ``slot`` (unload: the slot id may be reused)."""
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("uninstall_adapter", int(slot))
+            self._dispatch_uninstall_adapter(slot)
+
+    def _dispatch_uninstall_adapter(self, slot: int) -> None:
+        if not hasattr(self, "_slot_zero"):
+            self._slot_zero = jax.jit(
+                lambda bank, s: bank.at[:, s].set(0.0), donate_argnums=(0,)
+            )
+        layers = self.params["layers"]
+        for key in list(layers):
+            if key.startswith("lora_"):
+                layers[key] = self._slot_zero(layers[key], slot)
 
     # ------------------------------------------------------------------
     # Sleep / wake (reference tutorial 19: free accelerator memory without
@@ -364,15 +417,17 @@ class ModelRunner:
                 self.publisher.announce("multi_step", (batch, n_steps))
             return self._dispatch_multi_step(batch, n_steps)[: len(seqs)]
 
-    def _dispatch_multi_step(self, batch: Dict[str, np.ndarray], n_steps: int) -> np.ndarray:
+    def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """ONE device_put for the whole batch tree. Separate puts cost a
+        round trip each on remote-attached chips (~1 ms apiece through the
+        tunnel — a 12-array batch was paying ~11 ms of pure RPC per step)."""
         B = batch["kv_lens"].shape[0]
         row_shard = self._dp > 1 and B % self._dp == 0
-        dev_batch = {
-            k: jax.device_put(v, self._row if row_shard else self._repl)
-            for k, v in batch.items()
-        }
+        return jax.device_put(batch, self._row if row_shard else self._repl)
+
+    def _dispatch_multi_step(self, batch: Dict[str, np.ndarray], n_steps: int) -> np.ndarray:
         toks, self.kv_cache = self._multi_step(
-            self.params, self.kv_cache, dev_batch, n_steps
+            self.params, self.kv_cache, self._put_batch(batch), n_steps
         )
         return np.asarray(jax.device_get(toks))
 
@@ -395,14 +450,8 @@ class ModelRunner:
             return self._dispatch_step(batch)
 
     def _dispatch_step(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        B = batch["kv_lens"].shape[0]
-        row_shard = self._dp > 1 and B % self._dp == 0
-        dev_batch = {
-            k: jax.device_put(v, self._row if row_shard else self._repl)
-            for k, v in batch.items()
-        }
         toks, self.kv_cache = self._step(
-            self.params, self.kv_cache, dev_batch
+            self.params, self.kv_cache, self._put_batch(batch)
         )
         return np.asarray(jax.device_get(toks))
 
@@ -421,7 +470,7 @@ class ModelRunner:
     ) -> Dict[str, np.ndarray]:
         B = len(seqs)
         Bb = _pow2(B, cap=_pow2(self.cfg.max_num_seqs))
-        Bb = max(Bb, B, self._dp)
+        Bb = max(Bb, B, self._dp, self.cfg.min_decode_bucket)
         W = max(len(s.block_ids) for s in seqs)
         Wb = max(
             _pow2(W, cap=_pow2(self.max_table_width)),
@@ -524,6 +573,14 @@ class ModelRunner:
             "min_ps": min_ps,
             "seeds": seeds,
         }
+        if self.cfg.enable_lora:
+            lora_idx = np.zeros(B, np.int32)
+            lora_scale = np.zeros(B, np.float32)
+            for i, s in enumerate(seqs):
+                lora_idx[i] = getattr(s, "lora_idx", 0)
+                lora_scale[i] = getattr(s, "lora_scale", 0.0)
+            out["lora_idx"] = lora_idx
+            out["lora_scale"] = lora_scale
         if any(s.sampling.has_penalties for s in seqs):
             out.update(self._penalty_arrays(seqs, B))
         return out

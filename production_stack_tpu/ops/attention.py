@@ -13,17 +13,22 @@ is TPU-native with two interchangeable implementations:
   with double-buffered DMA
   (:mod:`production_stack_tpu.ops.paged_attention_pallas`).
 
-Shapes (one layer):
+Shapes:
   q            [B, T, H, hd]       T=1 for decode rows, T=chunk for prefill
-  kv_pages     [nb, 2, bs, KH*hd]  combined pages: row 0 = K, row 1 = V;
+  kv_pages     [L, nb, 2, bs, KH*hd] combined pages: row 0 = K, row 1 = V;
                                    each token row spans all kv heads in the
                                    lane dim (one DMA per page in the kernel;
-                                   minor dims stay tiling-exact)
+                                   minor dims stay tiling-exact). The FULL
+                                   stacked cache is passed with a layer
+                                   index — a per-layer slice inside the
+                                   model's layer scan would materialize a
+                                   copy of the layer cache every step.
   block_tables [B, W] int32        page ids per sequence (W*bs >= kv_len)
   kv_lens      [B]   int32         valid KV length per sequence
   q_positions  [B, T] int32        absolute position of each query token
                                    (padding rows may hold any value; they are
                                    masked out downstream via last_idx/sampling)
+  layer        int32 scalar        layer to attend against (may be traced)
 """
 
 from __future__ import annotations
@@ -51,6 +56,7 @@ def paged_attention(
     block_tables: jax.Array,
     kv_lens: jax.Array,
     q_positions: jax.Array,
+    layer=0,
     *,
     scale: float,
     impl: str = "auto",
@@ -62,10 +68,11 @@ def paged_attention(
         from .paged_attention_pallas import pallas_paged_attention
 
         return pallas_paged_attention(
-            q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
+            q, kv_pages, block_tables, kv_lens, q_positions, layer,
+            scale=scale,
         )
     return gather_paged_attention(
-        q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
+        q, kv_pages, block_tables, kv_lens, q_positions, layer, scale=scale
     )
 
 
@@ -75,19 +82,22 @@ def gather_paged_attention(
     block_tables: jax.Array,
     kv_lens: jax.Array,
     q_positions: jax.Array,
+    layer=0,
     *,
     scale: float,
 ) -> jax.Array:
     B, T, H, hd = q.shape
-    nb, _, bs, lanes = kv_pages.shape
+    _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
     W = block_tables.shape[1]
     S = W * bs
     G = H // KH
 
-    # [B, W, 2, bs, KH*hd] -> [B, S, KH, hd] per half. Out-of-range table
-    # entries are clipped by XLA gather semantics; masked below anyway.
-    kv = kv_pages[block_tables]
+    # [W...] -> [B, S, KH, hd] per half. Out-of-range table entries are
+    # clipped by XLA gather semantics; they are masked below anyway. (The
+    # layer slice materializes here — acceptable for the test/CPU path.)
+    pages = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
+    kv = pages[block_tables]
     k = kv[:, :, 0].reshape(B, S, KH, hd)
     v = kv[:, :, 1].reshape(B, S, KH, hd)
 

@@ -27,14 +27,21 @@ efficiency, not grid geometry:
 Scalar-prefetched block tables address the pages (``PrefetchScalarGridSpec``)
 so page ids are in SMEM before the body runs.
 
-Shapes (one layer):
+The kernels take the FULL stacked cache ``[L, nb, 2, bs, KH*hd]`` plus a
+(possibly traced) layer index rather than a per-layer slice: inside the
+model's layer scan a slice would materialize the whole 100s-of-MB layer
+cache as a copy per layer per step, while the ANY-space operand costs
+nothing — the DMA engine reads only the pages the sequence actually needs.
+
+Shapes:
   q           [B, T, H, hd]        T=1 decode, T=chunk prefill
-  kv_pages    [nb, 2, bs, KH*hd]   combined K(row 0)/V(row 1) pages
+  kv_pages    [L, nb, 2, bs, KH*hd] combined K(row 0)/V(row 1) pages
   tables      [B, W] int32         page ids (W*bs >= kv_len)
   kv_lens     [B] int32            valid KV length per sequence (0 = padding)
   q_positions [B, T] int32         absolute position per query token; the
                                    prefill kernel uses row 0 (chunks are
                                    consecutive positions — runner contract)
+  layer       int32 scalar         layer to read (scalar-prefetched)
 """
 
 from __future__ import annotations
@@ -54,48 +61,40 @@ def _interpret() -> bool:
     return bool(os.environ.get("PST_FORCE_PALLAS_INTERPRET"))
 
 
-def _chunk_pages(bs: int) -> int:
-    """Pages per DMA buffer slot: target ~512 tokens per chunk."""
-    return max(512 // bs, 1)
+def _chunk_pages(bs: int, target_tokens: int) -> int:
+    """Pages per DMA buffer slot (~target_tokens per chunk). Decode uses
+    bigger chunks than prefill: its per-chunk fixed cost (fori iteration,
+    semaphore waits, G-row flash updates on mostly-empty vregs) dominates
+    at long context, while prefill's larger per-chunk compute amortizes it
+    already — and prefill's VMEM budget also carries the big q tile."""
+    return max(target_tokens // bs, 1)
 
 
-def _chunked_flash(
+def _page_dma_loop(
     *,
     b,  # batch index (program id)
+    layer,  # int32 layer index into the stacked cache
     n_chunks,  # traced: chunks of C pages to stream
     tables_ref,  # [B, W] SMEM
-    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     buf,  # [2, C, 2, bs, KH*hd] VMEM scratch
     sems,  # [2, C] DMA semaphores
-    q_heads,  # list of KH fp32 arrays [R, hd]
-    bounds,  # [R, 1] exclusive per-row attention bound (causality + kv_len)
-    m_ref,  # [KH, R, 128] fp32 scratch (col 0 live)
-    l_ref,  # [KH, R, 128]
-    acc_ref,  # [KH, R, hd]
-    scale: float,
-    block_size: int,
     chunk: int,
     table_width: int,
-    head_dim: int,
+    compute_chunk,  # (page [C, 2, bs, KH*hd], chunk_index) -> None
 ):
-    """Stream ``n_chunks`` KV chunks with double-buffered DMA and fold each
-    into the per-head flash accumulators. Shared by decode and prefill —
-    decode is the R=G, bounds=kv_len special case."""
-    C, W, hd = chunk, table_width, head_dim
-    KH = acc_ref.shape[0]
+    """Double-buffered page streaming shared by decode and prefill: chunk
+    ``c+1``'s DMAs are in flight while ``compute_chunk`` folds chunk ``c``."""
+    C, W = chunk, table_width
 
     def dma(c, j, slot):
         # Page ids past the live range clamp to the table's last entry;
-        # their columns are masked below (only the ragged final chunk
-        # fetches any).
+        # their columns are masked by the caller (only the ragged final
+        # chunk fetches any).
         page = tables_ref[b, jnp.minimum(c * C + j, W - 1)]
         return pltpu.make_async_copy(
-            kv_hbm.at[page], buf.at[slot, j], sems.at[slot, j]
+            kv_hbm.at[layer, page], buf.at[slot, j], sems.at[slot, j]
         )
-
-    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-    l_ref[...] = jnp.zeros_like(l_ref)
-    acc_ref[...] = jnp.zeros_like(acc_ref)
 
     @pl.when(n_chunks > 0)
     def _warmup():
@@ -113,18 +112,47 @@ def _chunked_flash(
 
         for j in range(C):
             dma(c, j, slot).wait()
+        compute_chunk(buf[slot], c)
+        return 0
 
-        page = buf[slot]  # [C, 2, bs, KH*hd]
-        S = C * block_size
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+
+
+def _chunked_flash(
+    *,
+    b, layer, n_chunks, tables_ref, kv_hbm, buf, sems,
+    q_heads,  # list of KH arrays [R, hd] (native dtype)
+    bounds,  # [R, 1] exclusive per-row attention bound (causality + kv_len)
+    m_ref,  # [KH, R, 128] fp32 scratch (col 0 live)
+    l_ref,  # [KH, R, 128]
+    acc_ref,  # [KH, R, hd]
+    scale: float,
+    block_size: int,
+    chunk: int,
+    table_width: int,
+    head_dim: int,
+):
+    """Per-head flash accumulation over streamed KV chunks (the prefill
+    shape: R = Tq*G rows per head keep the MXU busy per head). Matmuls run
+    in the operands' native dtype with fp32 accumulation — MXU-native for
+    the bf16 serving path, exact for the fp32 oracle tests."""
+    hd = head_dim
+    KH = acc_ref.shape[0]
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute(page, c):
+        S = chunk * block_size
         col = c * S + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
         for h in range(KH):
             kh = page[:, 0, :, h * hd : (h + 1) * hd].reshape(S, hd)
             vh = page[:, 1, :, h * hd : (h + 1) * hd].reshape(S, hd)
             s = jax.lax.dot_general(
-                q_heads[h], kh.astype(jnp.float32),
-                (((1,), (1,)), ((), ())),
+                q_heads[h], kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # [R, S]
+            ) * scale  # [R, S] fp32
             s = jnp.where(col < bounds, s, _NEG_INF)
             m_prev = m_ref[h, :, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -135,20 +163,23 @@ def _chunked_flash(
             )
             m_ref[h, :, :1] = m_new
             acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
-                p, vh.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        return 0
 
-    jax.lax.fori_loop(0, n_chunks, body, 0)
+    _page_dma_loop(
+        b=b, layer=layer, n_chunks=n_chunks, tables_ref=tables_ref,
+        kv_hbm=kv_hbm, buf=buf, sems=sems, chunk=chunk,
+        table_width=table_width, compute_chunk=compute,
+    )
 
 
 def _decode_kernel(
-    tables_ref, lens_ref,  # scalar prefetch (SMEM)
+    tables_ref, lens_ref, layer_ref,  # scalar prefetch (SMEM)
     q_ref,  # [1, H, hd] VMEM
-    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     o_ref,  # [1, H, hd] VMEM
-    buf, sems, m_ref, l_ref, acc_ref,  # scratch
+    buf, sems, m_ref, l_ref, acc_ref,  # scratch (m/l [H,128], acc [H,hd])
     *,
     scale: float,
     block_size: int,
@@ -157,38 +188,74 @@ def _decode_kernel(
     group: int,
     head_dim: int,
 ):
+    """Dense folded-q decode: per-head [G, hd] x [hd, S] mat-vecs waste the
+    MXU (G of 128 rows live) and burn VPU on per-head slices, so instead q
+    is scattered block-diagonally into the page's lane layout —
+    ``q_sparse[r]`` holds row r's head at lane block r//G, zeros elsewhere —
+    and ONE [H, KH*hd] x [KH*hd, S] matmul per chunk yields every head's
+    scores (cross-head lanes contribute exact zeros). The p@V product runs
+    dense the same way; each row's own head block is extracted from
+    [H, KH, hd] with the same mask. ~KH x more MACs, all on otherwise-idle
+    MXU rows; the VPU flash update shrinks from KH G-row passes to one
+    full-vreg [H, S] pass."""
     b = pl.program_id(0)
-    G, KH = group, acc_ref.shape[0]
+    G, hd = group, head_dim
+    H = q_ref.shape[1]
+    KH = H // G
     kv_len = lens_ref[b]
     n_chunks = (kv_len + chunk * block_size - 1) // (chunk * block_size)
 
-    q = q_ref[0].astype(jnp.float32)  # [H, hd]
-    _chunked_flash(
-        b=b,
-        n_chunks=n_chunks,
-        tables_ref=tables_ref,
-        kv_hbm=kv_hbm,
-        buf=buf,
-        sems=sems,
-        q_heads=[q[h * G : (h + 1) * G] for h in range(KH)],
-        bounds=jnp.full((G, 1), kv_len, jnp.int32),
-        m_ref=m_ref,
-        l_ref=l_ref,
-        acc_ref=acc_ref,
-        scale=scale,
-        block_size=block_size,
-        chunk=chunk,
-        table_width=table_width,
-        head_dim=head_dim,
+    q = q_ref[0]  # [H, hd] native dtype
+    # Arithmetic 0/1 mask (born 3D): Mosaic cannot minor-dim-reshape or
+    # relayout sub-32-bit (bool) vectors, so the block-diagonal selector is
+    # built as floats and applied by multiplication.
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (H, KH, 1), 0) // G
+    head_idx = jax.lax.broadcasted_iota(jnp.int32, (H, KH, 1), 1)
+    blockdiag = (row_head == head_idx).astype(jnp.float32)  # [H, KH, 1]
+    q_sparse = (
+        q[:, None, :] * blockdiag.astype(q.dtype)
+    ).reshape(H, KH * hd)
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute(page, c):
+        S = chunk * block_size
+        k = page[:, 0].reshape(S, KH * hd)
+        v = page[:, 1].reshape(S, KH * hd)
+        col = c * S + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+        s = jax.lax.dot_general(
+            q_sparse, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [H, S] fp32
+        s = jnp.where(col < kv_len, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:, :1] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(H, KH, hd)
+        own = (pv * blockdiag).sum(axis=1)  # each row's own head block
+        acc_ref[...] = acc_ref[...] * alpha + own
+
+    _page_dma_loop(
+        b=b, layer=layer_ref[0], n_chunks=n_chunks, tables_ref=tables_ref,
+        kv_hbm=kv_hbm, buf=buf, sems=sems, chunk=chunk,
+        table_width=table_width, compute_chunk=compute,
     )
-    out = acc_ref[...] / jnp.maximum(l_ref[:, :, :1], 1e-20)  # [KH, G, hd]
-    o_ref[0] = out.reshape(KH * G, head_dim).astype(o_ref.dtype)
+    out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-20)  # [H, hd]
+    o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _prefill_kernel(
-    tables_ref, lens_ref, starts_ref,  # scalar prefetch (SMEM)
+    tables_ref, lens_ref, starts_ref, layer_ref,  # scalar prefetch (SMEM)
     q_ref,  # [1, Tq, H, hd] VMEM
-    kv_hbm,  # [nb, 2, bs, KH*hd] ANY
+    kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     o_ref,  # [1, Tq, H, hd] VMEM
     buf, sems, m_ref, l_ref, acc_ref,  # scratch
     *,
@@ -219,13 +286,12 @@ def _prefill_kernel(
     bounds = jnp.minimum(q_pos + 1, kv_len)
 
     qh = [
-        q_ref[0, :, h * G : (h + 1) * G, :]
-        .reshape(Tq * G, head_dim)
-        .astype(jnp.float32)
+        q_ref[0, :, h * G : (h + 1) * G, :].reshape(Tq * G, head_dim)
         for h in range(KH)
     ]
     _chunked_flash(
         b=b,
+        layer=layer_ref[0],
         n_chunks=n_chunks,
         tables_ref=tables_ref,
         kv_hbm=kv_hbm,
@@ -261,23 +327,29 @@ def _scratch(C, bs, lanes, R, KH, hd, kv_dtype):
     ]
 
 
-def _decode_call(q3, kv_pages, block_tables, kv_lens, *, scale):
+def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, *, scale):
     B, H, hd = q3.shape
-    nb, _, bs, lanes = kv_pages.shape
+    _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
     W = block_tables.shape[1]
     G = H // KH
-    C = _chunk_pages(bs)
+    C = _chunk_pages(bs, 1024)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, t, l: (b, 0, 0)),
+            pl.BlockSpec((1, H, hd), lambda b, t, l, ly: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l: (b, 0, 0)),
-        scratch_shapes=_scratch(C, bs, lanes, G, KH, hd, kv_pages.dtype),
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l, ly: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, 2, bs, lanes), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, C)),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
+        ],
     )
     kernel = functools.partial(
         _decode_kernel,
@@ -294,29 +366,33 @@ def _decode_call(q3, kv_pages, block_tables, kv_lens, *, scale):
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q3.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=_interpret(),
-    )(block_tables, kv_lens, q3, kv_pages)
+    )(block_tables, kv_lens, layer, q3, kv_pages)
 
 
-def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, *, scale, q_tile):
+def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer,
+                  *, scale, q_tile):
     B, T, H, hd = q.shape
-    nb, _, bs, lanes = kv_pages.shape
+    _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
     W = block_tables.shape[1]
     G = H // KH
-    C = _chunk_pages(bs)
+    C = _chunk_pages(bs, 512)
     n_tiles = T // q_tile
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, q_tile, H, hd), lambda b, t, tt, l, s: (b, t, 0, 0)),
+            pl.BlockSpec(
+                (1, q_tile, H, hd), lambda b, t, tt, l, s, ly: (b, t, 0, 0)
+            ),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, q_tile, H, hd), lambda b, t, tt, l, s: (b, t, 0, 0)
+            (1, q_tile, H, hd), lambda b, t, tt, l, s, ly: (b, t, 0, 0)
         ),
         scratch_shapes=_scratch(C, bs, lanes, q_tile * G, KH, hd, kv_pages.dtype),
     )
@@ -336,39 +412,49 @@ def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, *, scale, q_tile):
         out_shape=jax.ShapeDtypeStruct((B, T, H, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
+            # The 256-row q tile + 512-token KV chunks exceed the default
+            # 16 MiB scoped-vmem budget; the chip has far more.
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=_interpret(),
-    )(block_tables, kv_lens, starts, q, kv_pages)
+    )(block_tables, kv_lens, starts, layer, q, kv_pages)
 
 
 def pallas_paged_attention(
     q: jax.Array,  # [B, T, H, hd]
-    kv_pages: jax.Array,  # [nb, 2, bs, KH*hd]
+    kv_pages: jax.Array,  # [L, nb, 2, bs, KH*hd]
     block_tables: jax.Array,  # [B, W]
     kv_lens: jax.Array,  # [B]
     q_positions: jax.Array,  # [B, T] absolute positions (row 0 = chunk start)
+    layer=0,  # int32 scalar (may be traced — e.g. the model's layer scan)
     *,
     scale: float,
 ) -> jax.Array:
     B, T, H, hd = q.shape
     tables = block_tables.astype(jnp.int32)
     lens = kv_lens.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     if T == 1:
-        out = _decode_call(q[:, 0], kv_pages, tables, lens, scale=scale)
+        out = _decode_call(
+            q[:, 0], kv_pages, tables, lens, layer_arr, scale=scale
+        )
         return out[:, None]
 
     # Chunk positions are consecutive from row 0's position (the runner
     # builds prefill batches that way), so the kernel derives causality from
     # starts alone. Padding rows attend past their chunk; their outputs are
     # discarded downstream (last_idx / dropped writes).
-    q_tile = min(T, 128)
+    # 256-row q tiles: every tile re-streams the sequence's earlier KV, so
+    # at long context halving the tile count halves attention HBM traffic.
+    q_tile = min(T, 256)
     if T % q_tile:  # odd shapes: runner buckets are powers of two
         from .attention import gather_paged_attention
 
         return gather_paged_attention(
-            q, kv_pages, block_tables, kv_lens, q_positions, scale=scale
+            q, kv_pages, block_tables, kv_lens, q_positions, layer, scale=scale
         )
     starts = q_positions[:, 0].astype(jnp.int32)
     return _prefill_call(
-        q, kv_pages, tables, lens, starts, scale=scale, q_tile=q_tile
+        q, kv_pages, tables, lens, starts, layer_arr, scale=scale,
+        q_tile=q_tile,
     )

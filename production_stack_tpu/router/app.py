@@ -21,6 +21,7 @@ from .parser import parse_args
 from .routes import routes
 from .routing.logic import (
     RoutingLogic,
+    get_routing_logic,
     initialize_routing_logic,
     teardown_routing_logic,
 )
@@ -194,6 +195,13 @@ def create_app(args) -> web.Application:
             watcher.close()
         get_engine_stats_scraper().close()
         teardown_service_discovery()
+        try:  # routers holding a long-lived client (kvaware) close it here
+            router = get_routing_logic()
+            aclose = getattr(router, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        except ValueError:
+            pass
         teardown_routing_logic()
         for key in ("client_session", "prefill_client", "decode_client"):
             session = app.get(key)

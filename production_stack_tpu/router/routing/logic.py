@@ -186,6 +186,7 @@ class KvawareRouter(RoutingInterface):
         self._tokenizer = None
         self._fallback_ring = ConsistentHashRing()
         self._rr = 0
+        self._session = None  # lazy long-lived ClientSession (hot path)
         self._initialized = True
 
     def _get_tokenizer(self, model: str):
@@ -195,23 +196,37 @@ class KvawareRouter(RoutingInterface):
             self._tokenizer = get_tokenizer(self.tokenizer_name or model)
         return self._tokenizer
 
-    async def _lookup(self, model: str, token_ids: List[int]) -> Dict[str, int]:
-        """Controller lookup: chunk-hash the prefix, return url->matched tokens."""
+    def _get_session(self):
+        """One long-lived ClientSession for controller lookups. Opening a
+        session (connector + cookie jar) per request is hot-path connection
+        churn — the reference reuses its shared client the same way."""
         import aiohttp
 
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=2)
+            )
+        return self._session
+
+    async def aclose(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+    async def _lookup(self, model: str, token_ids: List[int]) -> Dict[str, int]:
+        """Controller lookup: chunk-hash the prefix, return url->matched tokens."""
         from ...kvcache.hashing import chunk_hashes
 
         hashes = chunk_hashes(token_ids)
         if not hashes:
             return {}
-        async with aiohttp.ClientSession() as session:
-            async with session.post(
-                f"{self.controller_url}/lookup",
-                json={"model": model, "hashes": hashes},
-                timeout=aiohttp.ClientTimeout(total=2),
-            ) as resp:
-                resp.raise_for_status()
-                data = await resp.json()
+        session = self._get_session()
+        async with session.post(
+            f"{self.controller_url}/lookup",
+            json={"model": model, "hashes": hashes},
+        ) as resp:
+            resp.raise_for_status()
+            data = await resp.json()
         return {k: int(v) for k, v in (data.get("matches") or {}).items()}
 
     async def route_request(self, endpoints, engine_stats, request_stats, headers, request_json=None) -> str:

@@ -35,7 +35,7 @@ def run_case(B, T, H, KH, hd, nb, bs, W, kv_fill, rng, check=True,
     """kv_fill: fraction of the table width actually holding live KV."""
     q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.bfloat16)
     kv = jnp.asarray(
-        rng.standard_normal((nb, 2, bs, KH * hd)), jnp.bfloat16
+        rng.standard_normal((1, nb, 2, bs, KH * hd)), jnp.bfloat16
     )
     tables = jnp.asarray(
         (rng.permutation(nb - 1)[: B * W] + 1).reshape(B, W).astype(np.int32)

@@ -14,10 +14,10 @@ from production_stack_tpu.ops.paged_attention_pallas import pallas_paged_attenti
 
 
 def _pack(k, v):
-    # [KH, nb, bs, hd] pair -> combined [nb, 2, bs, KH*hd]
+    # [KH, nb, bs, hd] pair -> stacked combined [L=1, nb, 2, bs, KH*hd]
     KH, nb, bs, hd = k.shape
     fold = lambda x: x.transpose(1, 2, 0, 3).reshape(nb, bs, KH * hd)
-    return np.stack([fold(k), fold(v)], axis=1)
+    return np.stack([fold(k), fold(v)], axis=1)[None]
 
 
 def _setup(B=3, H=8, KH=4, hd=32, nb=32, bs=8, W=4, seed=0):
