@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Teardown for gateway/install.sh (reference delete.sh analogue).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+kubectl delete -f configs/httproute.yaml --ignore-not-found
+kubectl delete -f configs/inferencemodel.yaml --ignore-not-found
+kubectl delete -f configs/inferencepool.yaml --ignore-not-found
+kubectl delete -f configs/engine-deployment.yaml --ignore-not-found
+helm uninstall kgateway -n kgateway-system || true
+helm uninstall kgateway-crds -n kgateway-system || true

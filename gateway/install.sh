@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-shot Gateway API inference-extension install for production-stack-tpu.
+# Reference parity: src/gateway_inference_extension/install.sh (same CRD
+# ladder, picker + pool + model + route applied from configs/).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+KGTW_VERSION=${KGTW_VERSION:-v2.0.2}
+GWAPI_VERSION=${GWAPI_VERSION:-v1.3.0}
+INFEXT_VERSION=${INFEXT_VERSION:-v0.3.0}
+
+# KGateway CRDs + Gateway API CRDs + inference-extension CRDs.
+helm upgrade -i --create-namespace --namespace kgateway-system \
+  --version "$KGTW_VERSION" kgateway-crds \
+  oci://cr.kgateway.dev/kgateway-dev/charts/kgateway-crds
+kubectl apply -f "https://github.com/kubernetes-sigs/gateway-api/releases/download/${GWAPI_VERSION}/standard-install.yaml"
+kubectl apply -f "https://github.com/kubernetes-sigs/gateway-api-inference-extension/releases/download/${INFEXT_VERSION}/manifests.yaml"
+
+# KGateway with the inference extension enabled.
+helm upgrade -i --namespace kgateway-system --version "$KGTW_VERSION" \
+  kgateway oci://cr.kgateway.dev/kgateway-dev/charts/kgateway \
+  --set inferenceExtension.enabled=true
+
+# TPU engine fleet (TPURuntime CR; the operator reconciles it), then the
+# picker + pool + model + route.
+kubectl apply -f ../operator/crds/crds.yaml
+kubectl apply -f configs/engine-deployment.yaml
+kubectl apply -f configs/inferencepool.yaml
+kubectl apply -f configs/inferencemodel.yaml
+kubectl apply -f "https://github.com/kubernetes-sigs/gateway-api-inference-extension/raw/main/config/manifests/gateway/kgateway/gateway.yaml"
+kubectl apply -f configs/httproute.yaml
+
+echo "gateway stack installed; route traffic at the inference-gateway address"

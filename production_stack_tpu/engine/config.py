@@ -54,6 +54,14 @@ class EngineConfig:
     # is a multi-second TTFT outlier). Padding rows carry kv_len=0 and cost
     # ~nothing — the pallas kernel streams zero pages for them.
     min_decode_bucket: int = 1
+    # Pipelined decode: keep one burst in flight and overlap its token fetch
+    # with the next burst's execution (hides the host<->device round trip).
+    # Raises decode throughput on dispatch-latency-bound setups but ADDS up
+    # to one extra in-flight burst of queueing delay before a new arrival's
+    # prefill can run — measured on the 20k-context protocol bench it trades
+    # ~35% decode throughput for ~60% worse p50 TTFT, so it is off by
+    # default and meant for throughput-oriented (batch) serving.
+    async_decode: bool = False
     enforce_eager: bool = False  # reserved; XLA always compiles
     seed: int = 0
     # KV tiering (LMCache-analogue knobs; SURVEY.md §2.4).

@@ -39,6 +39,9 @@ class RequestOutput:
     num_output_tokens: int = 0
     num_cached_prompt_tokens: int = 0
     ttft: Optional[float] = None
+    # One entry per new token when SamplingParams.logprobs is set:
+    # {"token_id", "logprob", "top": [(token_id, logprob), ...]}.
+    logprobs: Optional[List[dict]] = None
 
 
 class LLMEngine:
@@ -71,9 +74,18 @@ class LLMEngine:
                 max_prefill_tokens=cfg.max_prefill_tokens,
                 max_model_len=cfg.max_model_len,
                 num_decode_steps=cfg.num_decode_steps,
+                # The in-flight continuation writes one burst past the host
+                # view, so its pages must already exist at dispatch time.
+                decode_lookahead=2 if cfg.async_decode else 1,
             ),
             self.allocator,
         )
+        # Pipelined-decode bookkeeping: membership of the in-flight burst
+        # (original order, including members that finished meanwhile) and
+        # sequences whose page release is deferred until the drain.
+        self._burst_seqs: List[Sequence] = []
+        self._burst_n = 0
+        self._burst_deferred: List[Sequence] = []
         if cfg.enable_lora:
             from .lora import LoraManager
 
@@ -180,16 +192,30 @@ class LLMEngine:
             self.lora_manager.release_slot(slot)
 
     def abort_request(self, request_id: str) -> bool:
-        seq = self.scheduler.abort(request_id)
+        if self.runner.burst_in_flight and any(
+            s.request_id == request_id for s in self._burst_seqs
+        ):
+            seq = self.scheduler.detach(request_id)
+            if seq is not None:
+                self._burst_deferred.append(seq)
+        else:
+            seq = self.scheduler.abort(request_id)
         self._seqs.pop(request_id, None)
         self._detok.pop(request_id, None)
         return seq is not None
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        # An in-flight burst counts as work even with empty queues: its
+        # results must be drained (and its deferred pages released).
+        return self.scheduler.has_work() or self.runner.burst_in_flight
 
     def abort_all_requests(self) -> int:
         """Abort everything queued or running (sleep / fatal-error paths)."""
+        if self.runner.burst_in_flight:
+            self.runner.burst_drain()  # discard: everything is going away
+            self._burst_seqs = []
+            self._burst_n = 0
+            self._release_burst_deferred()
         rids = list(self._seqs.keys())
         for rid in rids:
             self.abort_request(rid)
@@ -234,32 +260,60 @@ class LLMEngine:
     # ------------------------------------------------------------------
 
     def step(self) -> List[RequestOutput]:
-        sched = self.scheduler.schedule()
+        outputs: List[RequestOutput] = []
+        if self.runner.burst_in_flight:
+            locked = frozenset(s.request_id for s in self._burst_seqs)
+            sched = self.scheduler.schedule(locked=locked)
+            self.num_preempted_total += len(sched.preempted)
+            if self._can_continue_burst(sched):
+                rows = self.runner.burst_continue(self._burst_seqs)
+                outputs += self._process_burst_rows(rows)
+                self._sweep_retiring_slots()
+                return outputs
+            # A new arrival's prefill can slip in BEHIND the in-flight
+            # burst: dispatch it first (the device serializes the two), then
+            # drain the burst while the prefill executes — one combined wait
+            # instead of drain-then-prefill round trips. Safe because the
+            # prefill touches only its own freshly-allocated pages (locked
+            # members could not be evicted by its allocation).
+            prefill_handle = None
+            if sched.prefills and not sched.blocked_on_locked:
+                prefill_handle = self.runner.prefill_dispatch(sched.prefills)
+            rows = self.runner.burst_drain()
+            outputs += self._process_burst_rows(rows)
+            self._release_burst_deferred()
+            if prefill_handle is not None:
+                prows = self.runner.prefill_fetch(
+                    prefill_handle, len(sched.prefills)
+                )
+                outputs += self._process_prefill_rows(sched.prefills, prows)
+                self._sweep_retiring_slots()
+                return outputs
+            sched = self.scheduler.schedule()
+        else:
+            sched = self.scheduler.schedule()
         self.num_preempted_total += len(sched.preempted)
         if sched.is_empty:
-            return []
-        outputs: List[RequestOutput] = []
+            self._sweep_retiring_slots()
+            return outputs
         if sched.prefills:
-            tokens = self.runner.execute_prefill_batch(sched.prefills)
-            for item, token in zip(sched.prefills, tokens):
-                seq = item.seq
-                seq.num_computed_tokens = item.end
-                self._commit(seq)
-                # Sample only when this chunk completes a *fresh* prompt;
-                # recompute chunks (post-preemption) must not re-emit tokens.
-                if item.end == seq.num_prompt_tokens and not seq.output_token_ids:
-                    out = self._append_token(seq, int(token))
-                    if out is not None:
-                        outputs.append(out)
+            rows = self.runner.execute_prefill_batch(sched.prefills)
+            outputs += self._process_prefill_rows(sched.prefills, rows)
+        elif self._pipeline_ok(sched):
+            # First burst of a pipeline: dispatch only; its tokens surface
+            # on the NEXT step, overlapped with the following burst.
+            self._burst_seqs = list(sched.decodes)
+            self._burst_n = sched.n_decode_steps
+            self.runner.burst_start(sched.decodes, sched.n_decode_steps)
         else:
             bursts = self.runner.execute_decode_multi(
                 sched.decodes, sched.n_decode_steps
             )
-            for seq, row in zip(sched.decodes, bursts):
-                for token in row:
+            for seq, rows in zip(sched.decodes, bursts):
+                for row in rows:
                     seq.num_computed_tokens += 1
                     self._commit(seq)
-                    out = self._append_token(seq, int(token))
+                    out = self._append_token(seq, int(row[0]), lp_row=row)
                     if out is not None:
                         outputs.append(out)
                     if seq.is_finished:
@@ -267,14 +321,88 @@ class LLMEngine:
         self._sweep_retiring_slots()
         return outputs
 
+    def _process_prefill_rows(self, prefills, rows) -> List[RequestOutput]:
+        outputs: List[RequestOutput] = []
+        for item, row in zip(prefills, rows):
+            seq = item.seq
+            seq.num_computed_tokens = item.end
+            self._commit(seq)
+            # Sample only when this chunk completes a *fresh* prompt;
+            # recompute chunks (post-preemption) must not re-emit tokens.
+            if item.end == seq.num_prompt_tokens and not seq.output_token_ids:
+                out = self._append_token(seq, int(row[0]), lp_row=row)
+                if out is not None:
+                    outputs.append(out)
+        return outputs
+
+    # -- pipelined decode internals ------------------------------------
+
+    def _pipeline_ok(self, sched) -> bool:
+        return (
+            self.cfg.async_decode
+            and bool(sched.decodes)
+            # Penalties need per-token host-updated count arrays.
+            and not any(s.sampling.has_penalties for s in sched.decodes)
+        )
+
+    def _can_continue_burst(self, sched) -> bool:
+        """The in-flight burst may chain iff nothing about the step shape
+        changed and the NEXT burst's writes are provably covered."""
+        alive = [s for s in self._burst_seqs if not s.is_finished]
+        n = self._burst_n
+        return (
+            not sched.prefills
+            and not sched.blocked_on_locked
+            and self.scheduler.num_waiting == 0  # drain so admission can run
+            and alive
+            and sched.decodes == alive
+            and sched.n_decode_steps == n
+            and self.runner.burst_width_stable(self._burst_seqs)
+            # The continuation writes up to num_tokens + 2n (host view lags
+            # one burst); past max_model_len its pages would not exist.
+            and all(
+                s.num_tokens + 2 * n <= self.cfg.max_model_len for s in alive
+            )
+        )
+
+    def _process_burst_rows(self, rows) -> List[RequestOutput]:
+        """Apply one fetched burst's tokens. Rows align with
+        ``self._burst_seqs`` (original membership order); rows of members
+        that finished earlier are speculative garbage and are skipped.
+        While another burst is still in flight, page releases and dedup
+        swaps are deferred — the device writes through these page ids."""
+        outputs: List[RequestOutput] = []
+        inflight = self.runner.burst_in_flight
+        for seq, seq_rows in zip(self._burst_seqs, rows):
+            if seq.is_finished:
+                continue
+            for row in seq_rows:
+                seq.num_computed_tokens += 1
+                self._commit(seq, allow_swap=not inflight)
+                out = self._append_token(seq, int(row[0]), lp_row=row)
+                if out is not None:
+                    outputs.append(out)
+                if seq.is_finished:
+                    break  # trim speculative tail of the burst
+        if not inflight:
+            self._burst_seqs = []
+            self._burst_n = 0
+        return outputs
+
+    def _release_burst_deferred(self) -> None:
+        for seq in self._burst_deferred:
+            self.allocator.release_all(seq.block_ids)
+            seq.block_ids = []
+        self._burst_deferred = []
+
     # Controller-registration hygiene: chunk claims older than the TTL (or
     # beyond the cap) are dropped so KV-aware routing doesn't chase KV that
     # LRU eviction already reclaimed, and the dict can't grow unboundedly.
     CHUNK_CLAIM_TTL = 20 * 60.0
     CHUNK_CLAIM_CAP = 200_000
 
-    def _commit(self, seq: Sequence) -> None:
-        seq.commit_full_blocks(self.allocator)
+    def _commit(self, seq: Sequence, allow_swap: bool = True) -> None:
+        seq.commit_full_blocks(self.allocator, allow_swap=allow_swap)
         now = time.time()
         for h in seq.commit_full_chunks(CHUNK_TOKENS):
             self.resident_chunk_hashes.pop(h, None)  # refresh insertion order
@@ -309,7 +437,9 @@ class LLMEngine:
     # Token bookkeeping
     # ------------------------------------------------------------------
 
-    def _append_token(self, seq: Sequence, token: int) -> Optional[RequestOutput]:
+    def _append_token(
+        self, seq: Sequence, token: int, lp_row=None
+    ) -> Optional[RequestOutput]:
         sp = seq.sampling
         seq.output_token_ids.append(token)
         self.generation_tokens_total += 1
@@ -346,6 +476,24 @@ class LLMEngine:
                     break
         st["emitted"] += delta
 
+        logprobs_entry = None
+        if (
+            sp.logprobs is not None
+            and lp_row is not None
+            and lp_row.shape[-1] > 1  # width-1 rows: compiled without logprobs
+        ):
+            from ..ops.sampling import unpack_sampled
+
+            _, chosen, top_lps, top_ids = unpack_sampled(lp_row)
+            k = min(int(sp.logprobs), top_ids.shape[-1])
+            logprobs_entry = {
+                "token_id": token,
+                "logprob": float(chosen),
+                "top": [
+                    (int(top_ids[j]), float(top_lps[j])) for j in range(k)
+                ],
+            }
+
         out = RequestOutput(
             request_id=seq.request_id,
             text_delta=delta,
@@ -354,6 +502,7 @@ class LLMEngine:
             num_output_tokens=len(seq.output_token_ids),
             num_cached_prompt_tokens=seq.num_cached_prompt_tokens,
             ttft=(seq.first_token_time - seq.arrival_time),
+            logprobs=[logprobs_entry] if logprobs_entry else None,
         )
         if finish_reason is not None:
             if self.cfg.kv_role in ("producer", "both"):
@@ -362,7 +511,13 @@ class LLMEngine:
                     logger.debug(
                         "disagg: pushed %d KV pages for %s", sent, seq.request_id
                     )
-            self.scheduler.finish(seq, finish_reason)
+            if self.runner.burst_in_flight and seq in self._burst_seqs:
+                # The in-flight burst still writes through this sequence's
+                # pages: detach now, release at drain.
+                self.scheduler.detach(seq.request_id, finish_reason)
+                self._burst_deferred.append(seq)
+            else:
+                self.scheduler.finish(seq, finish_reason)
             out.finished = True
             out.finish_reason = finish_reason
             self._seqs.pop(seq.request_id, None)

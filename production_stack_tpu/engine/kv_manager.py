@@ -96,16 +96,21 @@ class BlockAllocator:
     def incref(self, blk: int) -> None:
         self._refcount[blk] += 1
 
-    def commit(self, blk: int, h: int) -> int:
+    def commit(self, blk: int, h: int, allow_swap: bool = True) -> int:
         """Mark a freshly-written full page as content-addressed by ``h``.
 
         If another request concurrently committed the same content, dedup to
         the existing page: the caller must swap to the returned id.
+        ``allow_swap=False`` suppresses that (and the release of the
+        duplicate) — required while the page is referenced by an in-flight
+        pipelined decode burst, whose device block table still points at it.
         """
         if not self.enable_prefix_caching:
             return blk
         existing = self._block_of_hash.get(h)
         if existing is not None and existing != blk:
+            if not allow_swap:
+                return blk  # keep our copy un-addressed; existing stays owner
             self.release(blk)
             self.incref(existing)
             if existing in self._reusable:

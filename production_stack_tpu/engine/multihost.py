@@ -71,10 +71,11 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
             logger.info("follower shutting down")
             return
         if kind == "step":
-            runner._dispatch_step(payload)
+            batch, want_lp = payload
+            runner._dispatch_step(batch, want_lp)
         elif kind == "multi_step":
-            batch, n_steps = payload
-            runner._dispatch_multi_step(batch, n_steps)
+            batch, n_steps, want_lp = payload
+            runner._dispatch_multi_step(batch, n_steps, want_lp)
         elif kind == "encode":
             toks, length = payload
             runner._dispatch_encode(toks, length)
@@ -92,6 +93,12 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
             runner._dispatch_install_adapter(int(slot), arrays)
         elif kind == "uninstall_adapter":
             runner._dispatch_uninstall_adapter(int(payload))
+        elif kind == "burst_start":
+            batch, n_steps, want_lp = payload
+            runner._dispatch_burst_start(batch, n_steps, want_lp)
+        elif kind == "burst_cont":
+            tables, kv_lens = payload
+            runner._dispatch_burst_continue(tables, kv_lens)
         else:  # future-proof: unknown step kinds are fatal (order contract)
             raise RuntimeError(f"unknown multihost step kind: {kind!r}")
 

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..logging_utils import init_logger
 from ..models.llama import Llama, LlamaConfig, load_hf_params
 from ..models.registry import get_model_config
-from ..ops.sampling import apply_penalties, sample_tokens
+from ..ops.sampling import apply_penalties, sample_tokens_packed
 from ..parallel.mesh import MeshConfig, build_mesh
 from .config import EngineConfig, resolve_num_kv_blocks
 from .scheduler import PrefillItem
@@ -50,6 +50,20 @@ def _pow2(n: int, cap: Optional[int] = None) -> int:
 # kernel skips out-of-range pages anyway (only the gather fallback pays for
 # the extra width).
 _MIN_TABLE_BUCKET = 64
+
+
+def _fetch(arr) -> np.ndarray:
+    """Device→host fetch tuned for remote-attached chips: the blocking
+    device_get path costs ~2x a readiness-polled async copy there, and when
+    the copy was already started at dispatch time (see the burst pipeline)
+    the array is host-resident before anyone asks."""
+    try:
+        arr.copy_to_host_async()
+    except Exception:  # pragma: no cover — backends without async copy
+        return np.asarray(jax.device_get(arr))
+    while not arr.is_ready():
+        time.sleep(0.0003)
+    return np.asarray(arr)
 
 
 def _seed_for(seq: Sequence) -> int:
@@ -132,7 +146,7 @@ class ModelRunner:
         attn_impl = cfg.attn_impl
         mesh_for_pp = self.mesh if pp > 1 else None
 
-        def step(params, kv_cache, batch: Dict[str, Any]):
+        def step(params, kv_cache, batch: Dict[str, Any], want_lp: bool):
             logits, kv_cache = model.forward(
                 params,
                 batch["tokens"],
@@ -157,21 +171,26 @@ class ModelRunner:
                     batch["frequency"],
                     batch["repetition"],
                 )
-            toks = sample_tokens(
+            # Packed rows: [token] or [token, chosen_lp, top_lps,
+            # top_ids] — one fetch serves both sampling and logprobs, and
+            # the logprobs math compiles in only when requested.
+            packed = sample_tokens_packed(
                 logits,
                 batch["temps"],
                 batch["top_ps"],
                 batch["top_ks"],
                 batch["min_ps"],
                 batch["seeds"],
+                with_logprobs=want_lp,
             )
-            return toks, kv_cache
+            return packed, kv_cache
 
         # Sampled tokens come back replicated: on a multi-host mesh the
         # primary must be able to device_get them (only addressable shards
         # are fetchable), and an all-gather of [B] int32 is free.
         self._step = jax.jit(
             step,
+            static_argnums=(3,),
             donate_argnums=(1,),
             out_shardings=(self._repl, cache_sh),
         )
@@ -179,19 +198,23 @@ class ModelRunner:
         bs = cfg.block_size
         drop_slot = self.num_blocks * bs
 
-        def multi_step(params, kv_cache, batch, n_steps: int):
+        def multi_step(params, kv_cache, batch, tokens, positions, seed_off,
+                       n_steps: int, want_lp: bool):
             """Decode ``n_steps`` tokens per sequence in one compiled call.
 
             The inter-token dependency (sampled token feeds the next forward)
             lives inside a ``lax.scan``: positions, page write slots, and
-            per-step PRNG seeds are all derived on-device, so the host pays
-            one dispatch per burst instead of per token.
-            """
+            per-step PRNG seeds are all derived on-device. ``tokens`` /
+            ``positions`` / ``seed_off`` are explicit [B]/[B]/scalar inputs
+            and are returned advanced, so a FOLLOW-UP burst can chain from
+            the previous burst's device outputs with zero host round trips —
+            the basis of pipelined decode (one burst always in flight, its
+            fetch overlapped with the next burst's execution)."""
             tables = batch["block_tables"]
             active = batch["kv_lens"] > 0  # padding rows never write
 
             def body(carry, i):
-                kv_cache, tokens, positions = carry
+                kv_cache, tokens, positions, so = carry
                 blk = jnp.take_along_axis(
                     tables, (positions // bs)[:, None], axis=1
                 )[:, 0]
@@ -213,28 +236,35 @@ class ModelRunner:
                     pp_size=pp,
                     mesh=mesh_for_pp,
                 )
-                nxt = sample_tokens(
+                packed = sample_tokens_packed(
                     logits,
                     batch["temps"],
                     batch["top_ps"],
                     batch["top_ks"],
                     batch["min_ps"],
-                    batch["seeds"] + i.astype(jnp.uint32),
+                    batch["seeds"] + so,
+                    with_logprobs=want_lp,
                 )
-                return (kv_cache, nxt, positions + 1), nxt
+                nxt = packed[:, 0].astype(jnp.int32)
+                return (kv_cache, nxt, positions + 1, so + 1), packed
 
-            carry = (kv_cache, batch["tokens"], batch["positions"])
-            (kv_cache, _, _), toks = jax.lax.scan(
+            carry = (kv_cache, tokens, positions, seed_off)
+            (kv_cache, tokens, positions, seed_off), packed = jax.lax.scan(
                 body, carry, jnp.arange(n_steps), length=n_steps
             )
-            return toks.T, kv_cache  # [B, n_steps]
+            # [n, B, W] -> [B, n, W]
+            return packed.transpose(1, 0, 2), tokens, positions, seed_off, kv_cache
 
         self._multi_step = jax.jit(
             multi_step,
-            static_argnums=(3,),
+            static_argnums=(6, 7),
             donate_argnums=(1,),
-            out_shardings=(self._repl, cache_sh),
+            out_shardings=(
+                self._repl, self._repl, self._repl, self._repl, cache_sh
+            ),
         )
+        # Pipelined-burst state: device handles of the burst in flight.
+        self._burst = None
         # Multi-host control plane (None on single-host): installed by the
         # server when jax.process_count() > 1; every device dispatch below
         # announces first so followers issue the identical XLA call.
@@ -262,7 +292,7 @@ class ModelRunner:
             self._page_get = jax.jit(
                 lambda c, i: c[:, i], out_shardings=self._repl
             )
-        page = np.asarray(jax.device_get(self._page_get(self.kv_cache, blk)))
+        page = _fetch(self._page_get(self.kv_cache, blk))
         L, _, bs, _ = page.shape
         KH, hd = self.model_cfg.num_kv_heads, self.model_cfg.head_dim
         k = page[:, 0].reshape(L, bs, KH, hd)
@@ -395,27 +425,34 @@ class ModelRunner:
             jax.device_put(toks, self._repl),
             jax.device_put(length, self._repl),
         )
-        return np.asarray(jax.device_get(out))[0]
+        return _fetch(out)[0]
 
     # ------------------------------------------------------------------
     # Public entry points
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _want_lp(seqs: List[Sequence]) -> bool:
+        return any(s.sampling.logprobs is not None for s in seqs)
+
     def execute_decode(self, seqs: List[Sequence]) -> np.ndarray:
-        """One decode token for each sequence. Returns [len(seqs)] ids."""
+        """One decode step per sequence. Returns packed sample rows
+        [len(seqs), 1 or PACKED_WIDTH] (token [+ logprobs]; ops/sampling.py)."""
         batch = self._decode_batch(seqs)
-        return self._run(batch)[: len(seqs)]
+        return self._run(batch, self._want_lp(seqs))[: len(seqs)]
 
     def execute_decode_multi(self, seqs: List[Sequence], n_steps: int) -> np.ndarray:
         """Decode burst: ``n_steps`` tokens per sequence in one device call.
-        Returns [len(seqs), n_steps] token ids (host trims at stops)."""
+        Returns packed rows [len(seqs), n_steps, PACKED_WIDTH] (host trims
+        at stops)."""
         if n_steps == 1:
             return self.execute_decode(seqs)[:, None]
         batch = self._decode_batch(seqs, multi=True)
+        want_lp = self._want_lp(seqs)
         with self._device_lock:
             if self.publisher is not None:
-                self.publisher.announce("multi_step", (batch, n_steps))
-            return self._dispatch_multi_step(batch, n_steps)[: len(seqs)]
+                self.publisher.announce("multi_step", (batch, n_steps, want_lp))
+            return self._dispatch_multi_step(batch, n_steps, want_lp)[: len(seqs)]
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """ONE device_put for the whole batch tree. Separate puts cost a
@@ -425,35 +462,169 @@ class ModelRunner:
         row_shard = self._dp > 1 and B % self._dp == 0
         return jax.device_put(batch, self._row if row_shard else self._repl)
 
-    def _dispatch_multi_step(self, batch: Dict[str, np.ndarray], n_steps: int) -> np.ndarray:
-        toks, self.kv_cache = self._multi_step(
-            self.params, self.kv_cache, self._put_batch(batch), n_steps
+    def _dispatch_multi_step(
+        self, batch: Dict[str, np.ndarray], n_steps: int, want_lp: bool = False
+    ) -> np.ndarray:
+        dev = self._put_batch(batch)
+        seed0 = jax.device_put(np.zeros((), np.uint32), self._repl)
+        tokens = dev.pop("tokens")
+        positions = dev.pop("positions")
+        toks, _, _, _, self.kv_cache = self._multi_step(
+            self.params, self.kv_cache, dev, tokens, positions, seed0,
+            n_steps, want_lp,
         )
-        return np.asarray(jax.device_get(toks))
+        return _fetch(toks)
+
+    # ------------------------------------------------------------------
+    # Pipelined decode bursts: one burst always in flight; its token fetch
+    # overlaps the next burst's execution, hiding the host<->device round
+    # trip (~70 ms on tunnel-attached chips, the decode-latency floor of a
+    # synchronous loop).
+    # ------------------------------------------------------------------
+
+    @property
+    def burst_in_flight(self) -> bool:
+        return self._burst is not None
+
+    def burst_start(self, seqs: List[Sequence], n_steps: int) -> None:
+        """Dispatch the first burst of a pipeline (async; nothing fetched)."""
+        assert self._burst is None, "burst already in flight (drain first)"
+        batch = self._decode_batch(seqs, multi=True)
+        want_lp = self._want_lp(seqs)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce(
+                    "burst_start", (batch, n_steps, want_lp)
+                )
+            self._dispatch_burst_start(batch, n_steps, want_lp)
+
+    def _dispatch_burst_start(
+        self, batch: Dict[str, np.ndarray], n_steps: int, want_lp: bool = False
+    ) -> None:
+        dev = self._put_batch(batch)
+        seed = jax.device_put(np.zeros((), np.uint32), self._repl)
+        tokens = dev.pop("tokens")
+        positions = dev.pop("positions")
+        toks, tokens, positions, seed, self.kv_cache = self._multi_step(
+            self.params, self.kv_cache, dev, tokens, positions, seed,
+            n_steps, want_lp,
+        )
+        try:  # start the host copy NOW; the eventual fetch finds it resident
+            toks.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        self._burst = {
+            "batch": dev, "tokens": tokens, "positions": positions,
+            "seed": seed, "toks": toks, "n": n_steps, "want_lp": want_lp,
+        }
+
+    def burst_width_stable(self, members: List[Sequence]) -> bool:
+        """True while the members' block tables still fit the width bucket
+        the in-flight burst compiled with (growth past it needs a drain)."""
+        if self._burst is None:
+            return False
+        Wb = self._burst["batch"]["block_tables"].shape[1]
+        return max(len(s.block_ids) for s in members) <= Wb
+
+    def burst_continue(self, members: List[Sequence]) -> np.ndarray:
+        """Dispatch the NEXT burst, then fetch and return the PREVIOUS
+        burst's tokens [Bb, n] (the fetch overlaps the new burst's
+        execution). ``members`` is the pipeline's original membership, in
+        order: their block tables are refreshed (the scheduler reserves
+        lookahead pages host-side; the device table must see them) and
+        members that finished host-side get kv_len 0 so their speculative
+        rows stop writing KV."""
+        assert self._burst is not None
+        Wb = self._burst["batch"]["block_tables"].shape[1]
+        Bb = self._burst["batch"]["kv_lens"].shape[0]
+        tables = np.zeros((Bb, Wb), np.int32)
+        kv_lens = np.zeros(Bb, np.int32)
+        for i, s in enumerate(members):
+            tables[i] = self._table_row(s, Wb)
+            kv_lens[i] = 0 if s.is_finished else max(s.num_tokens, 1)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("burst_cont", (tables, kv_lens))
+            return self._dispatch_burst_continue(tables, kv_lens)
+
+    def _dispatch_burst_continue(
+        self, tables: np.ndarray, kv_lens: np.ndarray
+    ) -> np.ndarray:
+        st = self._burst
+        prev = st["toks"]
+        st["batch"].update(
+            self._put_batch({"block_tables": tables, "kv_lens": kv_lens})
+        )
+        toks, tokens, positions, seed, self.kv_cache = self._multi_step(
+            self.params, self.kv_cache, st["batch"], st["tokens"],
+            st["positions"], st["seed"], st["n"], st["want_lp"],
+        )
+        try:  # start the host copy NOW; the eventual fetch finds it resident
+            toks.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        st.update(tokens=tokens, positions=positions, seed=seed, toks=toks)
+        return _fetch(prev)
+
+    def burst_drain(self) -> np.ndarray:
+        """Fetch the in-flight burst's tokens and end the pipeline."""
+        assert self._burst is not None
+        st, self._burst = self._burst, None
+        # No device op, so no multihost announce: followers hold no pending
+        # fetch (they never read tokens) and their next announced dispatch
+        # keeps program order identical.
+        return _fetch(st["toks"])
 
     def execute_prefill(self, item: PrefillItem) -> int:
         """Process one prefill chunk; returns the sampled token id (only
         meaningful when the chunk completes the prompt)."""
         batch = self._prefill_batch([item])
-        return int(self._run(batch)[0])
+        return int(self._run(batch, self._want_lp([item.seq]))[0, 0])
 
     def execute_prefill_batch(self, items: List[PrefillItem]) -> np.ndarray:
         """Prefill several chunks in one device call (rows padded to a
-        common chunk bucket). Returns [len(items)] sampled token ids."""
+        common chunk bucket). Returns packed sample rows
+        [len(items), PACKED_WIDTH] (token + logprobs)."""
         batch = self._prefill_batch(items)
-        return self._run(batch)[: len(items)]
+        return self._run(batch, self._want_lp([i.seq for i in items]))[: len(items)]
 
-    def _run(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+    def prefill_dispatch(self, items: List[PrefillItem]):  # noqa: D401
+        """Async half of a prefill step: dispatch and return the device
+        handle without fetching. Used to slip a new arrival's prefill in
+        BEHIND an in-flight decode burst (the device serializes them; the
+        burst drain then overlaps the prefill's execution), cutting one full
+        host<->device round trip out of TTFT."""
+        batch = self._prefill_batch(items)
+        want_lp = self._want_lp([i.seq for i in items])
         with self._device_lock:
             if self.publisher is not None:
-                self.publisher.announce("step", batch)
-            return self._dispatch_step(batch)
+                self.publisher.announce("step", (batch, want_lp))
+            dev = self._put_batch(batch)
+            toks, self.kv_cache = self._step(
+                self.params, self.kv_cache, dev, want_lp
+            )
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        return toks
 
-    def _dispatch_step(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+    def prefill_fetch(self, handle, n_items: int) -> np.ndarray:
+        return _fetch(handle)[:n_items]
+
+    def _run(self, batch: Dict[str, np.ndarray], want_lp: bool = False) -> np.ndarray:
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("step", (batch, want_lp))
+            return self._dispatch_step(batch, want_lp)
+
+    def _dispatch_step(
+        self, batch: Dict[str, np.ndarray], want_lp: bool = False
+    ) -> np.ndarray:
         toks, self.kv_cache = self._step(
-            self.params, self.kv_cache, self._put_batch(batch)
+            self.params, self.kv_cache, self._put_batch(batch), want_lp
         )
-        return np.asarray(jax.device_get(toks))
+        return _fetch(toks)
 
     # ------------------------------------------------------------------
     # Batch construction (host side, numpy)

@@ -31,6 +31,10 @@ class SchedulerConfig:
     max_prefill_tokens: int = 2048  # per-step chunked-prefill token budget
     max_model_len: int = 4096
     num_decode_steps: int = 1  # decode burst length per device call
+    # Bursts of page reservation per decode pass. 2 when the engine
+    # pipelines bursts (the in-flight continuation writes one burst past
+    # what the host has seen, so its pages must exist at dispatch time).
+    decode_lookahead: int = 1
 
 
 @dataclasses.dataclass
@@ -46,6 +50,10 @@ class SchedulerOutput:
     decodes: List[Sequence] = dataclasses.field(default_factory=list)
     preempted: List[Sequence] = dataclasses.field(default_factory=list)
     n_decode_steps: int = 1
+    # A locked (in-flight-burst) sequence needed pages it could not get
+    # without evicting another locked sequence: the engine must drain the
+    # burst and re-schedule.
+    blocked_on_locked: bool = False
 
     @property
     def is_empty(self) -> bool:
@@ -83,6 +91,21 @@ class Scheduler:
                     return seq
         return None
 
+    def detach(self, request_id: str, reason: str = "abort") -> Optional[Sequence]:
+        """Remove a sequence from the queues WITHOUT releasing its pages.
+
+        For sequences referenced by an in-flight pipelined burst: the device
+        is still writing through their block tables, so the pages must stay
+        owned until the burst drains (the engine releases them then)."""
+        for q in (self.waiting, self.running):
+            for seq in list(q):
+                if seq.request_id == request_id:
+                    q.remove(seq)
+                    seq.status = SequenceStatus.FINISHED
+                    seq.finish_reason = reason
+                    return seq
+        return None
+
     def finish(self, seq: Sequence, reason: str) -> None:
         if seq in self.running:
             self.running.remove(seq)
@@ -107,7 +130,11 @@ class Scheduler:
 
     # -- the step ---------------------------------------------------------
 
-    def schedule(self) -> SchedulerOutput:
+    def schedule(self, locked: frozenset = frozenset()) -> SchedulerOutput:
+        """``locked``: request ids whose pages an in-flight burst references;
+        they must not be preempted this pass (the engine drains the burst
+        and re-schedules when that constraint binds)."""
+        self._locked = locked
         out = SchedulerOutput()
         self._admit(out)
 
@@ -147,12 +174,14 @@ class Scheduler:
             n = min(n, max(self.config.max_model_len - seq.num_tokens, 1))
             if seq.sampling.has_penalties:
                 n = 1  # penalties need per-token count updates host-side
+        look = max(self.config.decode_lookahead, 1)
         for seq in list(self.running):
             if seq not in self.running:  # lost pages to an earlier preemption
                 continue
-            if not self._ensure_blocks(
-                seq, seq.num_tokens + n - 1, out, protect=seq
-            ):
+            reserve = min(
+                seq.num_tokens + look * n - 1, self.config.max_model_len
+            )
+            if not self._ensure_blocks(seq, reserve, out, protect=seq):
                 continue
             out.decodes.append(seq)
         out.n_decode_steps = n
@@ -220,6 +249,7 @@ class Scheduler:
     ) -> bool:
         """Allocate pages for ``seq`` up to ``up_to_tokens``, preempting the
         youngest other sequence on exhaustion. False if ``seq`` itself lost."""
+        locked = getattr(self, "_locked", frozenset())
         while True:
             try:
                 for _ in range(seq.blocks_needed(up_to_tokens, self.allocator.block_size)):
@@ -228,14 +258,22 @@ class Scheduler:
             except NoFreeBlocksError:
                 victim = self._pick_victim(exclude=protect or seq)
                 if victim is None:
+                    if seq.request_id in locked:
+                        # Cannot self-preempt a sequence whose pages an
+                        # in-flight burst still writes through: signal the
+                        # engine to drain and retry.
+                        out.blocked_on_locked = True
+                        out.decodes[:] = [s for s in out.decodes if s is not seq]
+                        return False
                     # Nothing left to evict but this sequence itself.
                     self._preempt(seq, out)
                     return False
                 self._preempt(victim, out)
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        locked = getattr(self, "_locked", frozenset())
         for seq in reversed(self.running):  # youngest first (vLLM policy)
-            if seq is not exclude:
+            if seq is not exclude and seq.request_id not in locked:
                 return seq
         return None
 

@@ -127,6 +127,16 @@ class EngineMetrics:
 def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
     limit = max(max_model_len - prompt_len - 1, 1)
     want = req.max_completion_tokens or req.max_tokens
+    # OpenAI shapes: completions carry an int `logprobs` (top-N count);
+    # chat carries bool `logprobs` + int `top_logprobs` (0 is valid: chosen
+    # token's logprob only, no alternatives).
+    lp = getattr(req, "logprobs", None)
+    if isinstance(lp, bool):
+        if lp:
+            top = getattr(req, "top_logprobs", None)
+            lp = int(top) if top is not None else 0
+        else:
+            lp = None
     return SamplingParams(
         max_tokens=min(want, limit) if want else limit,
         temperature=req.temperature,
@@ -140,7 +150,54 @@ def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
         presence_penalty=req.presence_penalty,
         frequency_penalty=req.frequency_penalty,
         repetition_penalty=req.repetition_penalty,
+        logprobs=int(lp) if lp is not None else None,
     )
+
+
+def _fmt_completion_logprobs(tok, entries, echo_ids=None, base_offset=0):
+    """OpenAI completions `logprobs` object. Echoed prompt tokens carry null
+    logprobs (the engine does not keep prefill logits; same shape as the
+    API's null-first-token convention). ``base_offset`` anchors text_offset
+    into the FULL accumulated completion text for streaming chunks."""
+    tokens, token_lps, top_lps, offsets = [], [], [], []
+    off = base_offset
+    for tid in echo_ids or []:
+        s = tok.decode([tid])
+        tokens.append(s)
+        token_lps.append(None)
+        top_lps.append(None)
+        offsets.append(off)
+        off += len(s)
+    for e in entries:
+        s = tok.decode([e["token_id"]])
+        tokens.append(s)
+        token_lps.append(e["logprob"])
+        top_lps.append({tok.decode([t]): lp for t, lp in e["top"]})
+        offsets.append(off)
+        off += len(s)
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_lps,
+        "top_logprobs": top_lps,
+        "text_offset": offsets,
+    }
+
+
+def _fmt_chat_logprobs(tok, entries):
+    """OpenAI chat `logprobs.content` entries."""
+    def one(tid, lp):
+        s = tok.decode([tid])
+        return {"token": s, "logprob": lp, "bytes": list(s.encode())}
+
+    return {
+        "content": [
+            dict(
+                one(e["token_id"], e["logprob"]),
+                top_logprobs=[one(t, lp) for t, lp in e["top"]],
+            )
+            for e in entries
+        ]
+    }
 
 
 def create_engine_app(
@@ -312,10 +369,22 @@ def create_engine_app(
         created = int(time.time())
         start = time.time()
         obj = "chat.completion.chunk" if is_chat else "text_completion"
+        n_choices = max(int(getattr(req, "n", 1) or 1), 1)
+        echo = bool(getattr(req, "echo", False)) and not is_chat
+        want_lp = sampling.logprobs is not None
+        lora = _resolve_lora(getattr(req, "model", ""))
+
+        if n_choices > 1:
+            if req.stream:
+                return _error("streaming with n > 1 is not supported")
+            return await _serve_n_choices(
+                req, ids, sampling, rid, created, is_chat, n_choices, echo,
+                lora,
+            )
 
         gen = engine.generate(
             prompt_token_ids=ids, sampling=sampling, request_id=rid,
-            lora_name=_resolve_lora(getattr(req, "model", "")),
+            lora_name=lora,
         )
 
         if req.stream:
@@ -334,18 +403,40 @@ def create_engine_app(
                                      "finish_reason": None}],
                     }
                     await resp.write(f"data: {json.dumps(first)}\n\n".encode())
+                first_chunk = True
+                # Running char offset into the accumulated completion text
+                # (echo prefix included) so streamed text_offset entries
+                # stay globally consistent, not chunk-relative.
+                char_off = len(engine.engine.tokenizer.decode(ids)) if echo else 0
                 async for out in gen:
                     n_out = out.num_output_tokens
                     if out.num_output_tokens == 1 and out.ttft is not None:
                         metrics.ttft.observe(out.ttft)
+                    lp_obj = None
+                    if want_lp and out.logprobs:
+                        if is_chat:
+                            lp_obj = _fmt_chat_logprobs(
+                                engine.engine.tokenizer, out.logprobs
+                            )
+                        else:
+                            lp_obj = _fmt_completion_logprobs(
+                                engine.engine.tokenizer, out.logprobs,
+                                base_offset=char_off,
+                            )
                     if is_chat:
                         delta = {"content": out.text_delta} if out.text_delta else {}
                         choice = {"index": 0, "delta": delta,
+                                  "logprobs": lp_obj,
                                   "finish_reason": out.finish_reason}
                     else:
-                        choice = {"index": 0, "text": out.text_delta,
-                                  "logprobs": None,
+                        text = out.text_delta
+                        if echo and first_chunk:
+                            text = engine.engine.tokenizer.decode(ids) + text
+                        choice = {"index": 0, "text": text,
+                                  "logprobs": lp_obj,
                                   "finish_reason": out.finish_reason}
+                    char_off += len(out.text_delta)
+                    first_chunk = False
                     chunk = {"id": rid, "object": obj, "created": created,
                              "model": req.model, "choices": [choice]}
                     if out.finished and getattr(req, "stream_options", None) and (
@@ -369,46 +460,112 @@ def create_engine_app(
             return resp
 
         # Non-streaming: accumulate.
-        text_parts: List[str] = []
-        token_ids: List[int] = []
-        finish_reason = None
         try:
-            async for out in gen:
-                if out.num_output_tokens == 1 and out.ttft is not None:
-                    metrics.ttft.observe(out.ttft)
-                text_parts.append(out.text_delta)
-                token_ids.extend(out.new_token_ids)
-                finish_reason = out.finish_reason or finish_reason
+            result = await _collect(gen)
         except asyncio.CancelledError:
             await engine.abort(rid)
             raise
-        text = "".join(text_parts)
         usage = {
             "prompt_tokens": len(ids),
-            "completion_tokens": len(token_ids),
-            "total_tokens": len(ids) + len(token_ids),
+            "completion_tokens": len(result["token_ids"]),
+            "total_tokens": len(ids) + len(result["token_ids"]),
         }
         metrics.e2e.observe(time.time() - start)
         metrics.success.inc()
         metrics.prompt_tokens.inc(len(ids))
-        metrics.generation_tokens.inc(len(token_ids))
+        metrics.generation_tokens.inc(len(result["token_ids"]))
+        choice = _build_choice(req, result, 0, is_chat, echo, ids)
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if is_chat else "text_completion",
+            "created": created, "model": req.model,
+            "choices": [choice], "usage": usage,
+        }
+        return web.json_response(payload, headers={"X-Request-Id": rid})
+
+    async def _collect(gen) -> dict:
+        """Drain one generation stream into text/tokens/logprobs/finish."""
+        text_parts: List[str] = []
+        token_ids: List[int] = []
+        lp_entries: List[dict] = []
+        finish_reason = None
+        async for out in gen:
+            if out.num_output_tokens == 1 and out.ttft is not None:
+                metrics.ttft.observe(out.ttft)
+            text_parts.append(out.text_delta)
+            token_ids.extend(out.new_token_ids)
+            if out.logprobs:
+                lp_entries.extend(out.logprobs)
+            finish_reason = out.finish_reason or finish_reason
+        return {
+            "text": "".join(text_parts), "token_ids": token_ids,
+            "logprobs": lp_entries, "finish_reason": finish_reason,
+        }
+
+    def _build_choice(req, result, index, is_chat, echo, prompt_ids) -> dict:
+        tok = engine.engine.tokenizer
+        lp_obj = None
+        if result["logprobs"]:
+            if is_chat:
+                lp_obj = _fmt_chat_logprobs(tok, result["logprobs"])
+            else:
+                lp_obj = _fmt_completion_logprobs(
+                    tok, result["logprobs"],
+                    echo_ids=prompt_ids if echo else None,
+                )
         if is_chat:
-            payload = {
-                "id": rid, "object": "chat.completion", "created": created,
-                "model": req.model,
-                "choices": [{"index": 0,
-                             "message": {"role": "assistant", "content": text},
-                             "logprobs": None, "finish_reason": finish_reason}],
-                "usage": usage,
+            return {
+                "index": index,
+                "message": {"role": "assistant", "content": result["text"]},
+                "logprobs": lp_obj,
+                "finish_reason": result["finish_reason"],
             }
-        else:
-            payload = {
-                "id": rid, "object": "text_completion", "created": created,
-                "model": req.model,
-                "choices": [{"index": 0, "text": text, "logprobs": None,
-                             "finish_reason": finish_reason}],
-                "usage": usage,
-            }
+        text = result["text"]
+        if echo:
+            text = tok.decode(prompt_ids) + text
+        return {"index": index, "text": text, "logprobs": lp_obj,
+                "finish_reason": result["finish_reason"]}
+
+    async def _serve_n_choices(
+        req, ids, sampling, rid, created, is_chat, n_choices, echo, lora
+    ) -> web.Response:
+        """OpenAI `n`: serve n independent samples of one prompt (the prompt
+        prefix is KV-shared across them via the prefix cache)."""
+        import dataclasses as _dc
+
+        start = time.time()
+
+        async def one(i: int) -> dict:
+            sp = _dc.replace(
+                sampling,
+                seed=(sampling.seed + i) if sampling.seed is not None else None,
+            )
+            return await _collect(engine.generate(
+                prompt_token_ids=ids, sampling=sp, request_id=f"{rid}-{i}",
+                lora_name=lora,
+            ))
+
+        results = list(await asyncio.gather(*(one(i) for i in range(n_choices))))
+        completion_tokens = sum(len(r["token_ids"]) for r in results)
+        usage = {
+            "prompt_tokens": len(ids),
+            "completion_tokens": completion_tokens,
+            "total_tokens": len(ids) + completion_tokens,
+        }
+        metrics.e2e.observe(time.time() - start)
+        metrics.success.inc()
+        metrics.prompt_tokens.inc(len(ids))
+        metrics.generation_tokens.inc(completion_tokens)
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if is_chat else "text_completion",
+            "created": created, "model": req.model,
+            "choices": [
+                _build_choice(req, r, i, is_chat, echo, ids)
+                for i, r in enumerate(results)
+            ],
+            "usage": usage,
+        }
         return web.json_response(payload, headers={"X-Request-Id": rid})
 
     # -- embeddings / rerank / score ----------------------------------
@@ -636,6 +793,7 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--api-key", default=None)
+    p.add_argument("--sentry-dsn", default=None)
     # LoRA serving (vLLM --enable-lora analogue).
     p.add_argument("--enable-lora", action="store_true", default=False)
     p.add_argument("--max-loras", type=int, default=8)
@@ -732,6 +890,13 @@ def main(argv=None) -> None:
 
     args = parse_engine_args(argv)
     cfg = engine_config_from_args(args)
+
+    # Optional error reporting + tracing (no-ops without the SDKs; OTel
+    # activates via the standard OTEL_* env contract the chart wires in).
+    from ..utils_tracing import init_otel, init_sentry
+
+    init_sentry(args.sentry_dsn)
+    init_otel("pst-engine")
 
     # Multi-host boot (the ray-cluster head/worker analogue): every process
     # joins the jax.distributed runtime; host 0 serves HTTP, the rest mirror

@@ -105,6 +105,14 @@ class LlamaConfig:
     num_kv_heads: int = 32
     head_dim: int = 128
     rope_theta: float = 10000.0
+    # Llama-3.1-style rope scaling (HF config.json "rope_scaling" with
+    # rope_type "llama3"). factor 0 = disabled. Without this, checkpoints
+    # trained with scaled rope are silently wrong past their original
+    # context (e.g. Llama-3.1 beyond 8k).
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 131072
     tie_word_embeddings: bool = False
@@ -312,7 +320,7 @@ class Llama:
         scale = 1.0 / math.sqrt(cfg.head_dim)
 
         x = params["embed"][tokens]  # [B, T, D]
-        rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        rope_cos, rope_sin = _rope_tables(positions, cfg)
         flat_write_real = write_idx.reshape(-1)  # [B*T]
         has_lora = "lora_a_wq" in params["layers"]
         if has_lora and lora_idx is None:
@@ -478,7 +486,7 @@ class Llama:
         B, T = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
         x = params["embed"][tokens]
-        rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        rope_cos, rope_sin = _rope_tables(positions, cfg)
         valid = positions < lengths[:, None]  # [B, T]
         causal = (
             positions[:, None, :] <= positions[:, :, None]
@@ -563,13 +571,34 @@ def _proj(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Arra
 
 
 def _rope_tables(
-    positions: jax.Array, head_dim: int, theta: float
+    positions: jax.Array, cfg: "LlamaConfig"
 ) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables [B, T, hd/2] for the given absolute positions."""
-    half = head_dim // 2
+    """cos/sin tables [B, T, hd/2] for the given absolute positions.
+
+    Applies Llama-3.1 "llama3" rope scaling when configured: long-wavelength
+    frequencies are divided by ``factor``, short ones kept, with a smooth
+    ramp between ``low_freq_factor`` and ``high_freq_factor`` thresholds of
+    the original context length (HF ``modeling_rope_utils`` semantics)."""
+    half = cfg.head_dim // 2
     freqs = 1.0 / (
-        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )  # [half]
+    if cfg.rope_scaling_factor:
+        wavelen = 2.0 * math.pi / freqs
+        low_w = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+        high_w = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+        smooth = (
+            cfg.rope_original_max_position / wavelen - cfg.rope_low_freq_factor
+        ) / (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (
+            (1.0 - smooth) * freqs / cfg.rope_scaling_factor + smooth * freqs
+        )
+        freqs = jnp.where(
+            wavelen > low_w,
+            freqs / cfg.rope_scaling_factor,  # long wavelengths: full scale
+            jnp.where(wavelen < high_w, freqs, scaled),  # short: keep; mid: ramp
+        )
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, T, half]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -684,7 +713,24 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
     eos = hf.get("eos_token_id", 2)
     eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
     heads = hf["num_attention_heads"]
+    # Llama-3.1-style rope scaling. "linear"/"dynamic" variants are not
+    # implemented — refuse loudly rather than serve wrong long-context math.
+    rs = hf.get("rope_scaling") or {}
+    rs_kind = rs.get("rope_type") or rs.get("type") or ""
+    if rs and rs_kind not in ("llama3", "default", ""):
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_kind!r} (llama3 only)"
+        )
+    scaling = dict(
+        rope_scaling_factor=float(rs.get("factor", 0.0)) if rs_kind == "llama3" else 0.0,
+        rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        rope_original_max_position=int(
+            rs.get("original_max_position_embeddings", 8192)
+        ),
+    )
     return LlamaConfig(
+        **scaling,
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
         intermediate_size=hf["intermediate_size"],

@@ -15,6 +15,11 @@ import jax
 import jax.numpy as jnp
 
 SAMPLE_K_CAP = 256
+# Top-logprob entries returned per sampled token (OpenAI allows up to 20).
+LOGPROBS_K = 20
+# Packed row layout (see sample_tokens_packed): token, chosen logprob,
+# LOGPROBS_K top logprobs, LOGPROBS_K top token ids.
+PACKED_WIDTH = 2 + 2 * LOGPROBS_K
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -50,6 +55,54 @@ def sample_tokens(
     choice = jax.vmap(one)(seeds, scaled, keep)  # [B]
     sampled = jnp.take_along_axis(idxs, choice[:, None], axis=1)[:, 0]
     return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
+
+
+def sample_tokens_packed(
+    logits: jax.Array,  # [B, V] float32
+    temps: jax.Array,
+    top_ps: jax.Array,
+    top_ks: jax.Array,
+    min_ps: jax.Array,
+    seeds: jax.Array,
+    with_logprobs: bool = False,
+) -> jax.Array:
+    """Sample into ONE packed f32 array — ``[token]`` per row, or with
+    ``with_logprobs`` (a trace-time constant: the runner compiles separate
+    no-logprobs/logprobs step variants, like its penalties gating)
+    ``[token, chosen_logprob, top_lps(K), top_ids(K)]``.
+
+    Packing matters on remote-attached chips: one array = one host fetch.
+    Token ids ride as f32 — exact for any vocab < 2^24. Logprobs are raw
+    ``log_softmax(logits)`` (pre-temperature, the OpenAI/vLLM convention);
+    gating them keeps the full-vocab log_softmax + top-k out of the
+    latency-critical decode path when nobody asked."""
+    tokens = sample_tokens(logits, temps, top_ps, top_ks, min_ps, seeds)
+    if not with_logprobs:
+        return tokens[:, None].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=1)  # [B, 1]
+    top_lps, top_ids = jax.lax.top_k(logp, LOGPROBS_K)
+    return jnp.concatenate(
+        [
+            tokens[:, None].astype(jnp.float32),
+            chosen,
+            top_lps,
+            top_ids.astype(jnp.float32),
+        ],
+        axis=1,
+    )
+
+
+def unpack_sampled(packed) -> tuple:
+    """Host-side view of a packed row array (any leading dims):
+    (tokens int, chosen_lp, top_lps [..., K], top_ids [..., K] int)."""
+    import numpy as np
+
+    tokens = packed[..., 0].astype(np.int64)
+    chosen = packed[..., 1]
+    top_lps = packed[..., 2 : 2 + LOGPROBS_K]
+    top_ids = packed[..., 2 + LOGPROBS_K :].astype(np.int64)
+    return tokens, chosen, top_lps, top_ids
 
 
 def apply_penalties(

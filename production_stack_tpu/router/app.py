@@ -156,6 +156,17 @@ def initialize_all(app: web.Application, args) -> None:
 
 
 def create_app(args) -> web.Application:
+    # Optional error reporting + tracing (reference app.py:123-130; both
+    # no-op loudly when the SDKs are absent).
+    from ..utils_tracing import init_otel, init_sentry
+
+    init_sentry(
+        getattr(args, "sentry_dsn", None),
+        getattr(args, "sentry_traces_sample_rate", 0.0),
+        getattr(args, "sentry_profile_session_sample_rate", 0.0),
+    )
+    init_otel("pst-router")
+
     app = web.Application(middlewares=[api_key_middleware], client_max_size=64 * 2**20)
     initialize_all(app, args)
     app.add_routes(routes)

@@ -91,6 +91,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--file-storage-path", default="/tmp/pst_files")
     p.add_argument("--batch-processor", default="local")
 
+    # Error reporting / tracing (reference parser.py:338-355; no-ops when
+    # the optional SDKs are absent). OTel activates via the standard env
+    # vars (OTEL_EXPORTER_OTLP_ENDPOINT, OTEL_SERVICE_NAME).
+    p.add_argument("--sentry-dsn", default=None)
+    p.add_argument("--sentry-traces-sample-rate", type=float, default=0.0)
+    p.add_argument("--sentry-profile-session-sample-rate", type=float, default=0.0)
+
     # Dynamic config & callbacks & experimental
     p.add_argument("--dynamic-config-json", help="path to a hot-reloaded config file")
     p.add_argument("--callbacks", help="python file or module with pre/post request hooks")

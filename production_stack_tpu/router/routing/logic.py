@@ -65,6 +65,21 @@ def extract_prompt_text(request_json: Dict[str, Any]) -> str:
     return str(prompt)
 
 
+def _header(headers: Dict[str, str], key: Optional[str]) -> Optional[str]:
+    """Case-insensitive header lookup (callers pass plain dicts whose key
+    casing depends on the client's HTTP library)."""
+    if not key:
+        return None
+    v = headers.get(key)
+    if v is not None:
+        return v
+    lk = key.lower()
+    for k, val in headers.items():
+        if k.lower() == lk:
+            return val
+    return None
+
+
 class ConsistentHashRing:
     """xxhash-based ring with virtual nodes; minimal remapping on membership change."""
 
@@ -150,7 +165,7 @@ class SessionRouter(RoutingInterface):
         self._initialized = True
 
     async def route_request(self, endpoints, engine_stats, request_stats, headers, request_json=None) -> str:
-        session_id = headers.get(self.session_key) or headers.get(self.session_key.lower())
+        session_id = _header(headers, self.session_key)
         self.ring.update([e.url for e in endpoints])
         if session_id is None:
             return _lowest_qps_url(endpoints, request_stats)
@@ -246,7 +261,7 @@ class KvawareRouter(RoutingInterface):
             best_url, best_tokens = max(live_matches.items(), key=lambda kv: kv[1])
             if best_tokens >= self.threshold:
                 return best_url
-        session_id = headers.get(self.session_key) if self.session_key else None
+        session_id = _header(headers, self.session_key)
         if session_id:
             self._fallback_ring.update(list(by_url))
             url = self._fallback_ring.get_node(session_id)

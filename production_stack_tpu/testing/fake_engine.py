@@ -69,8 +69,12 @@ def create_fake_engine_app(
     model: str = "fake/model",
     speed: float = 500.0,
     ttft: float = 0.0,
+    name: str = "",
 ) -> web.Application:
     state = FakeEngineState(model, speed)
+    # Instance identity for routing-distribution e2e assertions: surfaces in
+    # the X-Served-By header of every generation response.
+    state.name = name or f"fake-{uuid.uuid4().hex[:6]}"
     app = web.Application()
     app["state"] = state
 
@@ -92,6 +96,7 @@ def create_fake_engine_app(
             if stream:
                 resp = web.StreamResponse(status=200)
                 resp.headers["Content-Type"] = "text/event-stream"
+                resp.headers["X-Served-By"] = state.name
                 await resp.prepare(request)
                 for i in range(n_tokens):
                     if is_chat:
@@ -158,7 +163,9 @@ def create_fake_engine_app(
                             "total_tokens": 10 + n_tokens,
                         },
                     }
-                return web.json_response(payload)
+                return web.json_response(
+                    payload, headers={"X-Served-By": state.name}
+                )
         finally:
             state.num_running -= 1
 
@@ -244,8 +251,9 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--model", default="fake/model")
     p.add_argument("--speed", type=float, default=500.0, help="tokens/sec")
     p.add_argument("--ttft", type=float, default=0.0, help="artificial TTFT (s)")
+    p.add_argument("--name", default="", help="instance id (X-Served-By header)")
     args = p.parse_args(argv)
-    app = create_fake_engine_app(args.model, args.speed, args.ttft)
+    app = create_fake_engine_app(args.model, args.speed, args.ttft, args.name)
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 
 

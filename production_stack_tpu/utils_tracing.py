@@ -1,0 +1,81 @@
+"""Optional error reporting + distributed tracing plumbing.
+
+Reference parity (SURVEY.md §5): the router initializes Sentry when a DSN is
+configured (`src/vllm_router/app.py:123-130`, flags `parser.py:338-355`) and
+tracing reaches the engines through standard OpenTelemetry environment
+variables applied by deployment config (`tutorials/12-distributed-tracing.md`).
+
+Both integrations are OPTIONAL dependencies: this module degrades to loud
+no-ops when `sentry_sdk` / `opentelemetry` are not installed (they are not
+part of the base image), so enabling the flags never breaks serving.
+
+OTel env contract (the chart's `observability.otelExporterEndpoint` value
+sets these on router AND engine pods; consumed here when the SDK is present):
+  OTEL_SERVICE_NAME, OTEL_EXPORTER_OTLP_ENDPOINT, OTEL_RESOURCE_ATTRIBUTES
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def init_sentry(dsn: Optional[str], traces_sample_rate: float = 0.0,
+                profile_session_sample_rate: float = 0.0) -> bool:
+    """Initialize Sentry when a DSN is given and sentry_sdk is installed."""
+    if not dsn:
+        return False
+    try:
+        import sentry_sdk
+    except ImportError:
+        logger.warning(
+            "--sentry-dsn set but sentry_sdk is not installed; "
+            "error reporting disabled (pip install sentry-sdk)"
+        )
+        return False
+    sentry_sdk.init(
+        dsn=dsn,
+        traces_sample_rate=traces_sample_rate,
+        profile_session_sample_rate=profile_session_sample_rate,
+    )
+    logger.info("sentry initialized (traces_sample_rate=%s)", traces_sample_rate)
+    return True
+
+
+def init_otel(service_name_default: str) -> bool:
+    """Initialize OpenTelemetry tracing from the standard env contract.
+
+    Activates only when OTEL_EXPORTER_OTLP_ENDPOINT is set AND the OTel SDK
+    is importable; spans export over OTLP to the configured collector (the
+    reference wires the same envs into its engines,
+    `tutorials/12-distributed-tracing.md:1-70`)."""
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if not endpoint:
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+    except ImportError:
+        logger.warning(
+            "OTEL_EXPORTER_OTLP_ENDPOINT set but the OpenTelemetry SDK is "
+            "not installed; tracing disabled (pip install opentelemetry-sdk "
+            "opentelemetry-exporter-otlp)"
+        )
+        return False
+    service = os.environ.get("OTEL_SERVICE_NAME", service_name_default)
+    provider = TracerProvider(
+        resource=Resource.create({"service.name": service})
+    )
+    provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+    trace.set_tracer_provider(provider)
+    logger.info("otel tracing initialized: %s -> %s", service, endpoint)
+    return True

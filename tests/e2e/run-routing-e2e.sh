@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Process-level routing e2e: launches the REAL router against fake engines
+# and asserts per-policy response distribution + a stress leg.
+#
+# Reference analogue: tests/e2e/run-static-discovery-routing-test.sh (policy
+# legs at :39-63) + stress-test.sh, collapsed into one command:
+#
+#   ./tests/e2e/run-routing-e2e.sh              # every policy + stress
+#   ./tests/e2e/run-routing-e2e.sh session      # one policy
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu   # the router imports no JAX, but fake engines may
+exec python3 tests/e2e/test_routing.py "${1:-all}"

@@ -1,0 +1,251 @@
+#!/usr/bin/env python3
+"""Process-level routing e2e: REAL router binary + N fake engines.
+
+Reference analogue: `tests/e2e/run-static-discovery-routing-test.sh` +
+`test-routing.py` (per-policy response-distribution assertions against a
+real `vllm-router` process). Launched by run-routing-e2e.sh; can also run
+standalone:
+
+    python tests/e2e/test_routing.py roundrobin
+    python tests/e2e/test_routing.py all
+
+Each policy leg spins up fresh processes, sends requests through the router,
+and asserts the X-Served-By distribution the policy implies.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+N_ENGINES = 3
+MODEL = "fake/model"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_http(url: str, timeout: float = 20.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return
+        except Exception:
+            time.sleep(0.2)
+    raise RuntimeError(f"{url} did not come up in {timeout}s")
+
+
+def post(url: str, payload: dict, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.headers.get("X-Served-By"), resp.read()
+
+
+class Fleet:
+    """N fake engines + one router process (static discovery)."""
+
+    def __init__(self, policy: str, router_args=None, labels=None):
+        self.procs = []
+        env = dict(os.environ, PYTHONPATH=REPO)
+        self.engine_ports = [free_port() for _ in range(N_ENGINES)]
+        for i, port in enumerate(self.engine_ports):
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", MODEL, "--speed", "2000",
+                 "--name", f"engine-{i}"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+        for port in self.engine_ports:
+            wait_http(f"http://127.0.0.1:{port}/health")
+
+        self.port = free_port()
+        backends = ",".join(f"http://127.0.0.1:{p}" for p in self.engine_ports)
+        args = [
+            sys.executable, "-m", "production_stack_tpu.router.app",
+            "--host", "127.0.0.1", "--port", str(self.port),
+            "--service-discovery", "static",
+            "--static-backends", backends,
+            "--static-models", ",".join([MODEL] * N_ENGINES),
+            "--routing-logic", policy,
+            "--engine-stats-interval", "1",
+        ]
+        if labels:
+            args += ["--static-model-labels", ",".join(labels)]
+        args += router_args or []
+        self.procs.append(subprocess.Popen(
+            args, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+        wait_http(f"http://127.0.0.1:{self.port}/health")
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        for p in self.procs:
+            p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def leg_roundrobin():
+    with Fleet("roundrobin") as f:
+        served = Counter()
+        for i in range(30):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": f"p{i}",
+                                  "max_tokens": 2})
+            assert status == 200
+            served[by] += 1
+        # Round robin: exact even split.
+        assert sorted(served.values()) == [10, 10, 10], served
+    print("PASS roundrobin", dict(served))
+
+
+def leg_session():
+    with Fleet("session", router_args=["--session-key", "x-session-id"]) as f:
+        by_session = {}
+        for sid in ("alice", "bob", "carol", "dave"):
+            seen = set()
+            for _ in range(6):
+                status, by, _ = post(
+                    f"{f.url}/v1/completions",
+                    {"model": MODEL, "prompt": "hi", "max_tokens": 2},
+                    headers={"x-session-id": sid},
+                )
+                assert status == 200
+                seen.add(by)
+            assert len(seen) == 1, f"session {sid} bounced across {seen}"
+            by_session[sid] = seen.pop()
+    print("PASS session", by_session)
+
+
+def leg_prefixaware():
+    with Fleet("prefixaware") as f:
+        prefixes = {
+            "A" * 400: set(), "B" * 400: set(), "C" * 400: set(),
+        }
+        for prefix, seen in prefixes.items():
+            for i in range(6):
+                status, by, _ = post(
+                    f"{f.url}/v1/completions",
+                    {"model": MODEL, "prompt": prefix + f" q{i}",
+                     "max_tokens": 2},
+                )
+                assert status == 200
+                seen.add(by)
+        for prefix, seen in prefixes.items():
+            assert len(seen) == 1, f"prefix bounced across {seen}"
+    print("PASS prefixaware",
+          {p[:3]: s for p, s in ((k, v) for k, v in prefixes.items())})
+
+
+def leg_kvaware():
+    # No cache controller running: kvaware must degrade to its fallback and
+    # keep serving (reference threshold-fallback behavior), spreading load.
+    with Fleet("kvaware",
+               router_args=["--cache-controller-url",
+                            "http://127.0.0.1:1"]) as f:
+        served = Counter()
+        for i in range(12):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": f"p{i}",
+                                  "max_tokens": 2})
+            assert status == 200
+            served[by] += 1
+        assert len(served) == N_ENGINES, served
+    print("PASS kvaware (controller-down fallback)", dict(served))
+
+
+def leg_disagg():
+    labels = ["prefill", "decode", "decode"]
+    with Fleet("disaggregated_prefill", labels=labels,
+               router_args=["--prefill-model-labels", "prefill",
+                            "--decode-model-labels", "decode"]) as f:
+        # max_tokens == 1 → prefill pool; everything else → decode pool.
+        prefill_served, decode_served = Counter(), Counter()
+        for i in range(6):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": "p",
+                                  "max_tokens": 1})
+            assert status == 200
+            prefill_served[by] += 1
+        for i in range(8):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": "p",
+                                  "max_tokens": 4})
+            assert status == 200
+            decode_served[by] += 1
+        assert set(prefill_served) == {"engine-0"}, prefill_served
+        assert set(decode_served) == {"engine-1", "engine-2"}, decode_served
+    print("PASS disagg", dict(prefill_served), dict(decode_served))
+
+
+def leg_stress():
+    """Concurrency leg: a burst of parallel streaming + non-streaming
+    requests all succeed (reference stress-test.sh analogue)."""
+    import concurrent.futures
+
+    with Fleet("roundrobin") as f:
+        def one(i):
+            status, _, body = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"s{i}", "max_tokens": 4},
+            )
+            return status
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+            statuses = list(ex.map(one, range(64)))
+        assert statuses == [200] * 64, Counter(statuses)
+        # Router health + metrics survive the burst.
+        with urllib.request.urlopen(f"{f.url}/health", timeout=5) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            assert b"vllm:" in r.read()
+    print("PASS stress (64 concurrent)")
+
+
+LEGS = {
+    "roundrobin": leg_roundrobin,
+    "session": leg_session,
+    "prefixaware": leg_prefixaware,
+    "kvaware": leg_kvaware,
+    "disaggregated_prefill": leg_disagg,
+    "stress": leg_stress,
+}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    legs = list(LEGS) if which == "all" else [which]
+    for name in legs:
+        LEGS[name]()
+    print(f"OK: {len(legs)} routing e2e leg(s) passed")
+
+
+if __name__ == "__main__":
+    main()

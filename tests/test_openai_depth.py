@@ -1,0 +1,144 @@
+"""OpenAI API depth: logprobs / top_logprobs, n, echo — plus llama3
+rope_scaling parsing with an oracle at >8k positions."""
+
+import asyncio
+import json
+import math
+
+import aiohttp
+import numpy as np
+import pytest
+from aiohttp import web
+
+from tests.test_engine_server import EngineServer
+
+
+async def test_completion_logprobs_and_echo():
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug", "prompt": "hello world",
+            "max_tokens": 4, "temperature": 0.0, "logprobs": 3, "echo": True,
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=payload) as r:
+            assert r.status == 200
+            body = await r.json()
+        ch = body["choices"][0]
+        lp = ch["logprobs"]
+        n_prompt = body["usage"]["prompt_tokens"]
+        n_out = body["usage"]["completion_tokens"]
+        # echo: prompt tokens present with null logprobs, then sampled ones.
+        assert len(lp["tokens"]) == n_prompt + n_out
+        assert lp["token_logprobs"][:n_prompt] == [None] * n_prompt
+        for v in lp["token_logprobs"][n_prompt:]:
+            assert v is not None and v <= 0.0
+        for top in lp["top_logprobs"][n_prompt:]:
+            assert top is not None and len(top) <= 3
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+        # echo prepends the prompt text.
+        assert ch["text"].startswith("hello world")
+
+
+async def test_chat_logprobs():
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0.0,
+            "logprobs": True, "top_logprobs": 2,
+        }
+        async with sess.post(
+            f"{server.url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+            body = await r.json()
+        content = body["choices"][0]["logprobs"]["content"]
+        assert len(content) == 3
+        for e in content:
+            assert e["logprob"] <= 0.0
+            assert len(e["top_logprobs"]) == 2
+            # The chosen token's logprob can't beat the best alternative.
+            assert e["logprob"] <= e["top_logprobs"][0]["logprob"] + 1e-5
+
+
+async def test_n_choices():
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug", "prompt": "abc",
+            "max_tokens": 4, "temperature": 0.9, "n": 3, "seed": 7,
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=payload) as r:
+            assert r.status == 200
+            body = await r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        assert body["usage"]["completion_tokens"] == 12
+        # Streaming with n>1 is rejected, not silently wrong.
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(payload, stream=True),
+        ) as r:
+            assert r.status == 400
+
+
+def test_rope_scaling_parsed_from_hf_json(tmp_path):
+    from production_stack_tpu.models.llama import config_from_hf_json
+
+    hf = {
+        "model_type": "llama", "vocab_size": 128256, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "rope_theta": 500000.0, "max_position_embeddings": 131072,
+        "rope_scaling": {
+            "factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192, "rope_type": "llama3",
+        },
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(hf))
+    cfg = config_from_hf_json(str(p))
+    assert cfg.rope_scaling_factor == 8.0
+    assert cfg.rope_original_max_position == 8192
+
+    hf["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    p.write_text(json.dumps(hf))
+    with pytest.raises(ValueError):
+        config_from_hf_json(str(p))
+
+
+def test_rope_scaling_tables_match_hf_reference():
+    """Oracle: our scaled frequencies at >8k positions match the HF
+    `_compute_llama3_parameters` formula computed independently here."""
+    import jax.numpy as jnp
+
+    from production_stack_tpu.models.llama import LlamaConfig, _rope_tables
+
+    cfg = LlamaConfig(
+        head_dim=128, rope_theta=500000.0, rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+        rope_original_max_position=8192,
+    )
+    # Recover the effective per-frequency rotation from one radian step:
+    # at position 1 the angle IS the frequency (all < pi), and atan2 is
+    # robust where comparing cos at 32k-sized angles is not (a 1-ulp f32
+    # frequency difference scales to ~0.05 in cos there).
+    positions = np.array([[1]], np.int32)
+    cos, sin = _rope_tables(jnp.asarray(positions), cfg)
+    got_freqs = np.arctan2(np.asarray(sin)[0, 0], np.asarray(cos)[0, 0])
+
+    # Independent HF-reference computation (modeling_rope_utils llama3).
+    half = cfg.head_dim // 2
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(half) / half))
+    wavelen = 2 * math.pi / inv
+    low_w = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+    high_w = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+    scaled = np.where(wavelen > low_w, inv / cfg.rope_scaling_factor, inv)
+    smooth = (cfg.rope_original_max_position / wavelen - 1.0) / (4.0 - 1.0)
+    mid = (1 - smooth) * inv / cfg.rope_scaling_factor + smooth * inv
+    is_mid = (wavelen <= low_w) & (wavelen >= high_w)
+    ref_freqs = np.where(is_mid, mid, scaled)
+    np.testing.assert_allclose(got_freqs, ref_freqs, rtol=1e-4, atol=1e-7)
+    # Scaling must actually change long-position tables vs unscaled.
+    far = np.array([[20000]], np.int32)
+    cfg0 = LlamaConfig(head_dim=128, rope_theta=500000.0)
+    c1, _ = _rope_tables(jnp.asarray(far), cfg)
+    c0, _ = _rope_tables(jnp.asarray(far), cfg0)
+    assert float(np.abs(np.asarray(c1) - np.asarray(c0)).max()) > 0.1
