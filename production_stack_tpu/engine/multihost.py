@@ -66,7 +66,22 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
     bridge = bridge or HostBridge()
     logger.info("follower loop up (process %d)", jax.process_index())
     while True:
-        kind, payload = bridge.publish(None)  # blocks on host-0 broadcast
+        try:
+            kind, payload = bridge.publish(None)  # blocks on host-0 broadcast
+        except Exception:  # noqa: BLE001
+            # Python-level broadcast failure (e.g. a payload that fails to
+            # deserialize): exit so the pod restarts instead of wedging.
+            # NOTE a DEAD PRIMARY does not reach this handler — the JAX
+            # distributed runtime detects the lost coordinator and
+            # hard-terminates the process at the C++ layer (fatal in
+            # client.h), which equally gets the pod restarted; this except
+            # covers the failures that stay inside Python. Traceback logged
+            # so either class stays diagnosable.
+            logger.error(
+                "follower broadcast failed (primary lost?); exiting",
+                exc_info=True,
+            )
+            return
         if kind == "shutdown":
             logger.info("follower shutting down")
             return

@@ -1,19 +1,26 @@
-"""Worker script for the 2-process multi-host engine test.
+"""Worker script for the multi-process multi-host engine tests.
 
-Each process gets 4 virtual CPU devices (8 global), joins jax.distributed,
-and builds the identical engine over a tp=2 dp=2 pp=2... — actually a
-dp=2 × tp=4-style mesh is overkill for 2 layers; we use pp=2 × tp=4 to span
-both hosts' devices. Process 0 runs real generation through the scheduler and
-prints the token ids; process 1 runs the follower loop. The parent test
-asserts process 0's output matches the single-host oracle.
+Each process gets 4 virtual CPU devices (8 global) and joins
+jax.distributed. Process 0 runs real generation through the scheduler and
+prints token ids; process 1 runs the follower loop. The parent test asserts
+process 0's output matches the single-host oracle.
 
-Usage: python multihost_worker.py <coordinator_port> <process_id>
+Usage: python multihost_worker.py <coordinator_port> <process_id> [mode]
+
+Modes:
+  pp_tp    (default) pp=2 x tp=4 — layer stages span the two hosts
+  dp_pp_tp dp=2 x pp=2 x tp=2 — adds in-engine data-parallel rows
+  dirty    pp=2 x tp=4, but process 0 EXITS WITHOUT announcing shutdown
+           after generating (crash simulation); the follower must notice
+           the lost primary and exit rather than wedge in a dead collective.
 """
 
 import os
 import sys
+import time
 
 port, pid = sys.argv[1], int(sys.argv[2])
+mode = sys.argv[3] if len(sys.argv) > 3 else "pp_tp"
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
@@ -42,6 +49,13 @@ assert len(jax.devices()) == 8, len(jax.devices())
 
 from production_stack_tpu.engine.config import EngineConfig  # noqa: E402
 
+if mode == "dp_pp_tp":
+    parallel = dict(
+        data_parallel_size=2, pipeline_parallel_size=2, tensor_parallel_size=2
+    )
+else:
+    parallel = dict(pipeline_parallel_size=2, tensor_parallel_size=4)
+
 cfg = EngineConfig(
     model="tiny-llama-debug",
     max_model_len=128,
@@ -49,12 +63,12 @@ cfg = EngineConfig(
     num_kv_blocks=64,
     max_num_seqs=4,
     max_prefill_tokens=32,
-    tensor_parallel_size=4,
-    pipeline_parallel_size=2,
     attn_impl="gather",
+    **parallel,
 )
 
 PROMPT = [3, 17, 98, 255, 42, 7, 11, 200, 150, 31, 8, 77, 123]
+PROMPT2 = [5, 9, 301, 44, 260, 18, 2, 90, 33]
 
 if pid == 0:
     from production_stack_tpu.engine.engine import LLMEngine
@@ -63,16 +77,21 @@ if pid == 0:
 
     engine = LLMEngine(cfg)
     engine.runner.publisher = StepPublisher()
-    out = engine.generate(
-        [list(PROMPT)], SamplingParams(max_tokens=8, temperature=0.0)
-    )[0]
+    prompts = [list(PROMPT)] + ([list(PROMPT2)] if mode == "dp_pp_tp" else [])
+    outs = engine.generate(prompts, SamplingParams(max_tokens=8, temperature=0.0))
+    for i, out in enumerate(outs):
+        suffix = str(i) if i else ""
+        print(f"TOKENS{suffix}:" + ",".join(str(t) for t in out["token_ids"]))
+    sys.stdout.flush()
+    if mode == "dirty":
+        os._exit(0)  # crash simulation: no publisher.shutdown()
     engine.runner.publisher.shutdown()
-    print("TOKENS:" + ",".join(str(t) for t in out["token_ids"]))
 else:
     from production_stack_tpu.engine.multihost import (
         make_follower_runner,
         run_follower,
     )
 
+    t0 = time.time()
     run_follower(make_follower_runner(cfg))
-    print("FOLLOWER-DONE")
+    print(f"FOLLOWER-DONE after {time.time()-t0:.1f}s")
