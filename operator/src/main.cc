@@ -10,18 +10,21 @@
 // is a kubectl-proxy/TLS-terminating sidecar on localhost (no TLS libs in
 // the runtime image — see operator/README.md).
 
+#include <atomic>
 #include <condition_variable>
 #include <csignal>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 #include <unistd.h>
 
+#include "httpserver.hpp"
 #include "k8s.hpp"
 #include "reconcilers.hpp"
 
@@ -34,11 +37,21 @@ struct Options {
   std::string api_server = "http://127.0.0.1:8001";
   std::string ns = "default";
   int interval_sec = 10;
+  int metrics_port = 0;  // 0 = disabled (reference --metrics-bind-address)
   bool once = false;  // single pass (tests / CI)
   bool watch = true;  // event-driven reconcile (interval is the fallback)
   bool leader_election = false;
   std::string identity;
 };
+
+// Reconcile counters exported at /metrics (the controller-runtime metrics
+// server analogue, reference main.go:59-88 --metrics-bind-address).
+struct Metrics {
+  std::atomic<long> passes{0};
+  std::atomic<long> reconciles{0};
+  std::atomic<long> errors{0};
+};
+Metrics g_metrics;
 
 Options parse_options(int argc, char** argv) {
   Options o;
@@ -53,13 +66,15 @@ Options parse_options(int argc, char** argv) {
     if (a == "--api-server") o.api_server = next();
     else if (a == "--namespace") o.ns = next();
     else if (a == "--interval") o.interval_sec = std::stoi(next());
+    else if (a == "--metrics-port") o.metrics_port = std::stoi(next());
     else if (a == "--once") o.once = true;
     else if (a == "--no-watch") o.watch = false;
     else if (a == "--leader-elect") o.leader_election = true;
     else if (a == "--identity") o.identity = next();
     else if (a == "--help") {
       printf("pst-operator --api-server URL --namespace NS [--interval S]"
-             " [--once] [--no-watch] [--leader-elect] [--identity ID]\n");
+             " [--metrics-port P] [--once] [--no-watch] [--leader-elect]"
+             " [--identity ID]\n");
       exit(0);
     }
   }
@@ -140,15 +155,18 @@ void reconcile_all(const pst::K8sClient& k8s) {
       const std::string name = cr.at({"metadata", "name"}).as_string();
       try {
         auto result = kind.fn(k8s, cr);
+        g_metrics.reconciles++;
         if (result.changed)
           printf("[operator] %s/%s reconciled -> %s\n", kind.plural,
                  name.c_str(), result.phase.c_str());
       } catch (const std::exception& e) {
+        g_metrics.errors++;
         fprintf(stderr, "[operator] %s/%s reconcile failed: %s\n", kind.plural,
                 name.c_str(), e.what());
       }
     }
   }
+  g_metrics.passes++;
 }
 
 // Event-driven convergence (the reference's controller-runtime informers,
@@ -283,6 +301,43 @@ int main(int argc, char** argv) {
   const bool watching = o.watch && !o.once;
   if (watching) hub.start();
 
+  // Prometheus metrics + health endpoint (controller-runtime metrics-server
+  // analogue). Served on its own thread; 0 disables.
+  std::unique_ptr<pst::HttpServer> metrics_srv;
+  if (o.metrics_port > 0 && !o.once) {
+    metrics_srv = std::make_unique<pst::HttpServer>(
+        [](const pst::HttpServerRequest& req) {
+          pst::HttpServerResponse resp;
+          if (req.path == "/healthz") {
+            resp.body = "{\"status\":\"ok\"}";
+            return resp;
+          }
+          char buf[512];
+          snprintf(buf, sizeof(buf),
+                   "# TYPE pst_operator_reconcile_passes_total counter\n"
+                   "pst_operator_reconcile_passes_total %ld\n"
+                   "# TYPE pst_operator_reconciles_total counter\n"
+                   "pst_operator_reconciles_total %ld\n"
+                   "# TYPE pst_operator_reconcile_errors_total counter\n"
+                   "pst_operator_reconcile_errors_total %ld\n",
+                   g_metrics.passes.load(), g_metrics.reconciles.load(),
+                   g_metrics.errors.load());
+          resp.content_type = "text/plain";
+          resp.body = buf;
+          return resp;
+        });
+    int port = metrics_srv->listen(o.metrics_port);
+    if (port > 0) {
+      std::thread([srv = metrics_srv.get()] { srv->serve_forever(); })
+          .detach();
+      printf("[operator] metrics on :%d\n", port);
+    } else {
+      fprintf(stderr, "[operator] metrics port %d unavailable\n",
+              o.metrics_port);
+      metrics_srv.reset();
+    }
+  }
+
   do {
     if (!o.leader_election || try_acquire_lease(k8s, o)) {
       reconcile_all(k8s);
@@ -300,6 +355,12 @@ int main(int argc, char** argv) {
     }
   } while (!g_stop);
   printf("[operator] shutting down\n");
+  if (metrics_srv) {
+    metrics_srv->stop();
+    // Handler threads are detached: destroying the server under one is a
+    // use-after-free. Intentionally leak it — the process is exiting.
+    metrics_srv.release();
+  }
   if (watching) hub.join();
   return 0;
 }

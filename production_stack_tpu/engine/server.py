@@ -613,6 +613,14 @@ def create_engine_app(
             scores.append(float(np.dot(va, vb)))
         return scores
 
+    # Scoring method surfaced in rerank/score responses: this engine serves
+    # decoder-only LLMs, so relevance is embedding cosine similarity from
+    # the model's own hidden states — NOT cross-encoder scoring. A true
+    # cross-encoder needs a dedicated scoring checkpoint; clients that
+    # require it should deploy one and must not mistake these numbers for
+    # it, hence the explicit label in the payload.
+    _SCORING_METHOD = "embedding_cosine_similarity"
+
     async def rerank(request: web.Request) -> web.Response:
         body = await request.json()
         query = body.get("query", "")
@@ -624,6 +632,7 @@ def create_engine_app(
             {
                 "id": random_id("rerank"),
                 "model": body.get("model", model_name),
+                "scoring_method": _SCORING_METHOD,
                 "results": [
                     {"index": i, "document": {"text": docs[i]},
                      "relevance_score": scores[i]}
@@ -646,6 +655,7 @@ def create_engine_app(
                 "id": random_id("score"),
                 "object": "list",
                 "model": body.get("model", model_name),
+                "scoring_method": _SCORING_METHOD,
                 "data": [
                     {"index": i, "object": "score", "score": s}
                     for i, s in enumerate(scores)

@@ -1,11 +1,16 @@
 """PII detection gate: scan request content, block on detection.
 
 Capability parity with the reference's experimental PII middleware
-(``experimental/pii/``: regex + Presidio analyzers, block-on-detect with
-Prometheus counters). Presidio is unavailable in this image, so the analyzer
-surface is pluggable with the regex analyzer as the shipped implementation
-(the reference's regex pattern classes, re-derived: email / phone / SSN /
-credit card / IP / API-key shapes).
+(``experimental/pii/``: regex + Presidio analyzers behind a factory,
+``analyzers/factory.py`` + ``analyzers/presidio.py:45``, block-on-detect
+with Prometheus counters). Two analyzers behind :func:`create_analyzer`:
+
+- ``regex`` (shipped default): pattern classes re-derived from the
+  reference's set — email / phone / SSN / credit card (Luhn-validated) /
+  IP / API-key shapes.
+- ``presidio``: the NER-based Presidio AnalyzerEngine when the optional
+  ``presidio-analyzer`` package is installed; selection fails loudly (at
+  startup, not per request) when it is not.
 """
 
 from __future__ import annotations
@@ -60,6 +65,14 @@ class RegexPIIAnalyzer:
     """Pattern scan; credit-card candidates additionally Luhn-validated."""
 
     def __init__(self, types: Optional[List[str]] = None):
+        if types is not None:
+            unknown = set(types) - set(PII_PATTERNS)
+            if unknown:
+                # A typo must not silently disable the gate.
+                raise ValueError(
+                    f"unknown PII types {sorted(unknown)}; "
+                    f"valid: {sorted(PII_PATTERNS)}"
+                )
         self.patterns = {
             k: v for k, v in PII_PATTERNS.items() if types is None or k in types
         }
@@ -73,6 +86,65 @@ class RegexPIIAnalyzer:
                 found.append(name)
                 break
         return found
+
+
+class PresidioPIIAnalyzer:
+    """NER-based analyzer (reference ``analyzers/presidio.py:45``): wraps
+    presidio-analyzer's AnalyzerEngine, mapping its entity names onto the
+    same type labels the regex analyzer emits so metrics stay comparable."""
+
+    ENTITY_MAP = {
+        "EMAIL_ADDRESS": "email",
+        "PHONE_NUMBER": "phone",
+        "US_SSN": "ssn",
+        "CREDIT_CARD": "credit_card",
+        "IP_ADDRESS": "ipv4",
+        "PERSON": "person",
+        "LOCATION": "location",
+    }
+
+    def __init__(self, types: Optional[List[str]] = None,
+                 score_threshold: float = 0.5):
+        from presidio_analyzer import AnalyzerEngine  # optional dependency
+
+        if types is not None:
+            valid = set(self.ENTITY_MAP.values())
+            unknown = set(types) - valid
+            if unknown:
+                raise ValueError(
+                    f"unknown PII types {sorted(unknown)}; "
+                    f"valid: {sorted(valid)}"
+                )
+        self._engine = AnalyzerEngine()
+        self._types = set(types) if types else None
+        self._threshold = score_threshold
+
+    def analyze(self, text: str) -> List[str]:
+        found = []
+        for res in self._engine.analyze(text=text, language="en"):
+            name = self.ENTITY_MAP.get(res.entity_type, res.entity_type.lower())
+            if res.score < self._threshold:
+                continue
+            if self._types is not None and name not in self._types:
+                continue
+            if name not in found:
+                found.append(name)
+        return found
+
+
+def create_analyzer(kind: str = "regex", types: Optional[List[str]] = None):
+    """Analyzer factory (reference ``analyzers/factory.py``)."""
+    if kind == "regex":
+        return RegexPIIAnalyzer(types)
+    if kind == "presidio":
+        try:
+            return PresidioPIIAnalyzer(types)
+        except ImportError as e:
+            raise RuntimeError(
+                "--pii-analyzer presidio requires the optional "
+                "presidio-analyzer package (pip install presidio-analyzer)"
+            ) from e
+    raise ValueError(f"unknown PII analyzer {kind!r} (regex|presidio)")
 
 
 def extract_text(request_json: dict) -> str:
@@ -90,14 +162,26 @@ def extract_text(request_json: dict) -> str:
 
 
 def install_pii_check(app: web.Application, args) -> None:
-    analyzer = RegexPIIAnalyzer()
+    types = getattr(args, "pii_types", None)
+    if isinstance(types, str):
+        types = [t.strip() for t in types.split(",") if t.strip()] or None
+    analyzer = create_analyzer(
+        getattr(args, "pii_analyzer", "regex") or "regex", types
+    )
     app["pii_analyzer"] = analyzer
 
     async def check(request_json: dict) -> Optional[web.Response]:
+        import asyncio
+
         text = extract_text(request_json)
         if not text:
             return None
-        found = analyzer.analyze(text)
+        # Off the event loop: presidio's NER inference is CPU-bound for
+        # tens-to-hundreds of ms (and regex over long prompts isn't free) —
+        # inline it would serialize every in-flight request behind the scan.
+        found = await asyncio.get_running_loop().run_in_executor(
+            None, analyzer.analyze, text
+        )
         if not found:
             return None
         for t in found:
@@ -115,4 +199,6 @@ def install_pii_check(app: web.Application, args) -> None:
         )
 
     app["pii_check"] = check
-    logger.info("PII detection enabled (regex analyzer)")
+    logger.info(
+        "PII detection enabled (%s analyzer)", type(analyzer).__name__
+    )

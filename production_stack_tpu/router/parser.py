@@ -103,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--callbacks", help="python file or module with pre/post request hooks")
     p.add_argument("--request-rewriter", default="noop")
     p.add_argument("--feature-gates", default="")
+    p.add_argument("--pii-analyzer", default="regex",
+                   choices=["regex", "presidio"])
+    p.add_argument("--pii-types", default=None,
+                   help="comma-separated PII types to block (default: all)")
     p.add_argument("--semantic-cache-model", default="all-MiniLM-L6-v2")
     p.add_argument("--semantic-cache-dir", default=None)
     p.add_argument("--semantic-cache-threshold", type=float, default=0.95)

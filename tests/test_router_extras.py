@@ -197,3 +197,26 @@ async def test_pii_gate_blocks(tmp_path):
                   "max_tokens": 2},
         ) as r:
             assert r.status == 200
+
+
+def test_pii_analyzer_factory():
+    """Analyzer factory (reference analyzers/factory.py): regex ships; the
+    presidio selection fails loudly at startup when the optional package is
+    absent; unknown names are rejected."""
+    import pytest as _pytest
+
+    from production_stack_tpu.router.experimental.pii import (
+        RegexPIIAnalyzer,
+        create_analyzer,
+    )
+
+    a = create_analyzer("regex", ["email"])
+    assert isinstance(a, RegexPIIAnalyzer)
+    assert a.analyze("mail me at a@b.com and 123-45-6789") == ["email"]
+    with _pytest.raises(ValueError):
+        create_analyzer("nope")
+    try:
+        import presidio_analyzer  # noqa: F401
+    except ImportError:
+        with _pytest.raises(RuntimeError):
+            create_analyzer("presidio")
