@@ -332,9 +332,13 @@ int main(int argc, char** argv) {
           .detach();
       printf("[operator] metrics on :%d\n", port);
     } else {
-      fprintf(stderr, "[operator] metrics port %d unavailable\n",
+      // The chart points the liveness probe here: running WITHOUT the
+      // listener would be a permanent CrashLoopBackOff of an otherwise
+      // fine operator. Fail fast instead — probe semantics then match
+      // process health.
+      fprintf(stderr, "[operator] fatal: cannot bind metrics port %d\n",
               o.metrics_port);
-      metrics_srv.reset();
+      return 1;
     }
   }
 

@@ -297,8 +297,21 @@ class LLMEngine:
             self._sweep_retiring_slots()
             return outputs
         if sched.prefills:
-            rows = self.runner.execute_prefill_batch(sched.prefills)
-            outputs += self._process_prefill_rows(sched.prefills, rows)
+            # Intermediate chunks sample nothing anyone reads: dispatch
+            # without fetching (the round trip per chunk dominated cold
+            # 20k-token prefills). Only a chunk that completes a fresh
+            # prompt needs its sampled token back.
+            any_completes = any(
+                it.end == it.seq.num_prompt_tokens
+                and not it.seq.output_token_ids
+                for it in sched.prefills
+            )
+            if any_completes:
+                rows = self.runner.execute_prefill_batch(sched.prefills)
+                outputs += self._process_prefill_rows(sched.prefills, rows)
+            else:
+                self.runner.execute_prefill_batch_nofetch(sched.prefills)
+                outputs += self._process_prefill_rows(sched.prefills, None)
         elif self._pipeline_ok(sched):
             # First burst of a pipeline: dispatch only; its tokens surface
             # on the NEXT step, overlapped with the following burst.
@@ -322,15 +335,18 @@ class LLMEngine:
         return outputs
 
     def _process_prefill_rows(self, prefills, rows) -> List[RequestOutput]:
+        """``rows is None`` for dispatch-only steps (no chunk completed a
+        fresh prompt, so there is no sampled token to read)."""
         outputs: List[RequestOutput] = []
-        for item, row in zip(prefills, rows):
+        for i, item in enumerate(prefills):
             seq = item.seq
             seq.num_computed_tokens = item.end
             self._commit(seq)
             # Sample only when this chunk completes a *fresh* prompt;
             # recompute chunks (post-preemption) must not re-emit tokens.
             if item.end == seq.num_prompt_tokens and not seq.output_token_ids:
-                out = self._append_token(seq, int(row[0]), lp_row=row)
+                assert rows is not None, "completing chunk needs its token"
+                out = self._append_token(seq, int(rows[i][0]), lp_row=rows[i])
                 if out is not None:
                     outputs.append(out)
         return outputs

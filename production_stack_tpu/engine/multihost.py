@@ -88,6 +88,8 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
         if kind == "step":
             batch, want_lp = payload
             runner._dispatch_step(batch, want_lp)
+        elif kind == "step_nofetch":
+            runner._dispatch_step_nofetch(payload)
         elif kind == "multi_step":
             batch, n_steps, want_lp = payload
             runner._dispatch_multi_step(batch, n_steps, want_lp)

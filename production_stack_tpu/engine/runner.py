@@ -584,9 +584,30 @@ class ModelRunner:
     def execute_prefill_batch(self, items: List[PrefillItem]) -> np.ndarray:
         """Prefill several chunks in one device call (rows padded to a
         common chunk bucket). Returns packed sample rows
-        [len(items), PACKED_WIDTH] (token + logprobs)."""
+        [len(items), 1 or PACKED_WIDTH] (token [+ logprobs])."""
         batch = self._prefill_batch(items)
         return self._run(batch, self._want_lp([i.seq for i in items]))[: len(items)]
+
+    def execute_prefill_batch_nofetch(self, items: List[PrefillItem]) -> None:
+        """Dispatch a prefill step WITHOUT fetching its sampled tokens.
+
+        Intermediate chunks of a long prompt sample nothing anyone reads
+        (only the prompt-completing chunk's token matters), yet a fetch
+        costs a full host<->device round trip — on tunnel-attached chips
+        that synchronization dominated cold prefill (~70 ms x ~20 chunks
+        per 20k-token prompt). The KV writes chain on-device through the
+        donated cache, so correctness is unaffected; the next fetching step
+        transitively waits for all queued work."""
+        batch = self._prefill_batch(items)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("step_nofetch", batch)
+            self._dispatch_step_nofetch(batch)
+
+    def _dispatch_step_nofetch(self, batch: Dict[str, np.ndarray]) -> None:
+        _, self.kv_cache = self._step(
+            self.params, self.kv_cache, self._put_batch(batch), False
+        )
 
     def prefill_dispatch(self, items: List[PrefillItem]):  # noqa: D401
         """Async half of a prefill step: dispatch and return the device
