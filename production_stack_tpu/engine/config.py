@@ -39,6 +39,10 @@ class EngineConfig:
     # Ray-cluster `--pipeline-parallel-size`, `ray-cluster.yaml:560-566`).
     # Stages hold L/pp layers + their KV pages; activations hop via ppermute.
     pipeline_parallel_size: int = 1
+    # Ring (context-parallel) attention over the sp mesh axis for the
+    # full-attention encode path (/v1/embeddings at contexts beyond one
+    # device group's attention memory). See ops/ring_attention.py.
+    sequence_parallel_size: int = 1
     kv_cache_dtype: Optional[str] = None  # default: model dtype
     attn_impl: str = "auto"  # auto | gather | pallas
     enable_prefix_caching: bool = True

@@ -98,11 +98,18 @@ class ModelRunner:
                 f"num_layers={self.model_cfg.num_layers} not divisible by "
                 f"pipeline_parallel_size={pp}"
             )
+        if cfg.sequence_parallel_size > 1 and pp > 1:
+            # Fail at startup, not on the first /v1/embeddings request.
+            raise ValueError(
+                "sequence_parallel_size > 1 (ring encode) does not compose "
+                "with pipeline_parallel_size > 1 yet"
+            )
         self.mesh = mesh or build_mesh(
             MeshConfig(
                 tensor_parallel_size=tp,
                 data_parallel_size=cfg.data_parallel_size,
                 pipeline_parallel_size=pp,
+                sequence_parallel_size=max(cfg.sequence_parallel_size, 1),
             )
         )
 
@@ -400,6 +407,10 @@ class ModelRunner:
 
     def encode(self, token_ids: Seq[int]) -> np.ndarray:
         T = _pow2(max(len(token_ids), 1), cap=_pow2(self.cfg.max_model_len))
+        # Ring encode shards T over sp: round the bucket UP to a multiple
+        # (a power of two is never divisible by e.g. sp=3).
+        sp = max(self.cfg.sequence_parallel_size, 1)
+        T = -(-T // sp) * sp
         toks = np.zeros((1, T), np.int32)
         toks[0, : len(token_ids)] = token_ids
         length = np.array([len(token_ids)], np.int32)
@@ -412,11 +423,12 @@ class ModelRunner:
         if not hasattr(self, "_encode_fn"):
             model = self.model
             pp = self._pp
-            mesh_for_pp = self.mesh if pp > 1 else None
+            sp = max(self.cfg.sequence_parallel_size, 1)
+            mesh = self.mesh if (pp > 1 or sp > 1) else None
 
             def enc(params, toks, length):
                 return model.encode(
-                    params, toks, length, pp_size=pp, mesh=mesh_for_pp
+                    params, toks, length, pp_size=pp, sp_size=sp, mesh=mesh
                 )
 
             self._encode_fn = jax.jit(enc, out_shardings=self._repl)

@@ -794,6 +794,8 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="ring-attention context parallel (encode path)")
     p.add_argument("--kv-cache-dtype", default=None)
     p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
@@ -838,6 +840,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
         data_parallel_size=args.data_parallel_size,
+        sequence_parallel_size=args.sequence_parallel_size,
         kv_cache_dtype=args.kv_cache_dtype,
         attn_impl=args.attn_impl,
         enable_prefix_caching=args.enable_prefix_caching,

@@ -478,19 +478,34 @@ class Llama:
         lengths: jax.Array,
         *,
         pp_size: int = 1,
+        sp_size: int = 1,
         mesh=None,
     ) -> jax.Array:
         """Embedding path (/v1/embeddings): full causal attention, no cache;
-        returns L2-normalized mean-pooled final hidden states [B, D]."""
+        returns L2-normalized mean-pooled final hidden states [B, D].
+
+        With ``sp_size > 1`` (and ``pp_size == 1``) the per-layer attention
+        runs as RING attention over the ``sp`` mesh axis
+        (:mod:`production_stack_tpu.ops.ring_attention`): the per-hop KV
+        shards across devices and no [B, T, S] score matrix ever
+        materializes, so contexts larger than one device's attention memory
+        encode across the sp group.
+        """
         cfg = self.cfg
         B, T = tokens.shape
+        use_ring = sp_size > 1 and mesh is not None
+        if use_ring and pp_size > 1:
+            raise ValueError("ring (sp) encode does not compose with pp yet")
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
         x = params["embed"][tokens]
         rope_cos, rope_sin = _rope_tables(positions, cfg)
         valid = positions < lengths[:, None]  # [B, T]
-        causal = (
-            positions[:, None, :] <= positions[:, :, None]
-        ) & valid[:, None, :]  # [B, T, S]
+        if use_ring:
+            causal = jnp.zeros((0,), jnp.bool_)  # ring derives its own masks
+        else:
+            causal = (
+                positions[:, None, :] <= positions[:, :, None]
+            ) & valid[:, None, :]  # [B, T, S]
         G = cfg.num_heads // cfg.num_kv_heads
 
         def layer(ctx, x, lp):
@@ -507,17 +522,27 @@ class Llama:
             )
             q = _apply_rope(
                 q.reshape(B, T, cfg.num_heads, cfg.head_dim), rope_cos, rope_sin
-            ).reshape(B, T, cfg.num_kv_heads, G, cfg.head_dim)
+            )
             k = _apply_rope(k, rope_cos, rope_sin)
-            scores = jnp.einsum(
-                "btkgd,bskd->bkgts", q, k, preferred_element_type=jnp.float32
-            ) / math.sqrt(cfg.head_dim)
-            scores = jnp.where(causal[:, None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            attn = jnp.einsum(
-                "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
-                preferred_element_type=jnp.float32,
-            ).reshape(B, T, cfg.q_size).astype(x.dtype)
+            if use_ring:
+                from ..ops.ring_attention import ring_self_attention
+
+                attn = ring_self_attention(
+                    q, k, v, lengths, mesh,
+                    scale=1.0 / math.sqrt(cfg.head_dim),
+                ).reshape(B, T, cfg.q_size).astype(x.dtype)
+            else:
+                qg = q.reshape(B, T, cfg.num_kv_heads, G, cfg.head_dim)
+                scores = jnp.einsum(
+                    "btkgd,bskd->bkgts", qg, k,
+                    preferred_element_type=jnp.float32,
+                ) / math.sqrt(cfg.head_dim)
+                scores = jnp.where(causal[:, None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum(
+                    "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32,
+                ).reshape(B, T, cfg.q_size).astype(x.dtype)
             x = x + jnp.einsum(
                 "btq,qd->btd", attn, lp["wo"], preferred_element_type=jnp.float32
             ).astype(x.dtype)

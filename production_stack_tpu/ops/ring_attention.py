@@ -1,0 +1,157 @@
+"""Ring attention: context-parallel causal attention over the ``sp`` axis.
+
+The building block for contexts larger than one device group's HBM
+(SURVEY.md §2.4 "sequence/context parallel" — absent from the reference,
+which caps at 32k + offload; the task's long-context requirement makes it
+first-class here). Design is the standard ring schedule mapped onto the
+scaling-book recipe — shard, ``ppermute``, let XLA place the collectives:
+
+- The sequence is sharded over ``sp``: rank ``r`` holds query block ``r``
+  and KV block ``r`` (``S_local = S / sp`` each). Peak memory per device is
+  O(S/sp) — KV for a 128k context fits a 4-way sp group of chips that
+  individually hold 32k.
+- ``sp`` hops: each hop every rank runs FLASH attention of its (stationary)
+  query block against the KV block currently resident, merges into running
+  (m, l, acc) accumulators, then rotates the KV block to the next rank with
+  ``jax.lax.ppermute`` — point-to-point neighbor traffic that rides ICI,
+  overlapped by XLA with the attention compute of the next hop.
+- Causality at BLOCK granularity: KV block ``b`` contributes to query block
+  ``q`` only when ``b <= q`` (the per-element triangle applies inside the
+  diagonal block). NOTE every rank still COMPUTES all ``sp`` hops and
+  discards non-contributing ones via ``where`` — SPMD requires one uniform
+  program, so FLOPs are the full square; wall-clock is bounded by the
+  busiest rank either way (a zigzag/load-balanced block order that earns
+  back the triangle is a known follow-up, not implemented here).
+
+This module provides the jnp/shard_map implementation (compiles on any
+backend, incl. the CPU test mesh); the per-hop inner attention is a
+standard flash block that XLA fuses — a Pallas inner kernel can be swapped
+in without touching the ring schedule.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import AXIS_SEQUENCE, AXIS_TENSOR
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _flash_block(q, k, v, mask, scale):
+    """One (m, l, acc) flash contribution of KV block (k, v) for queries q.
+
+    q: [B, Tq, H, hd]; k/v: [B, Tk, KH, hd]; mask: [B, Tq, Tk] bool.
+    Returns (m, l, acc) with m/l [B, H, Tq] and acc [B, H, Tq, hd].
+    """
+    B, Tq, H, hd = q.shape
+    KH = k.shape[2]
+    G = H // KH
+    qg = q.reshape(B, Tq, KH, G, hd)
+    s = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, KH, G, Tq, Tk]
+    s = jnp.where(mask[:, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, KH, G, Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bkgts,bskd->bkgtd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    H_ = KH * G
+    return (
+        m.reshape(B, H_, Tq),
+        l.reshape(B, H_, Tq),
+        acc.reshape(B, H_, Tq, hd),
+    )
+
+
+def _merge(state, update):
+    """Numerically-stable merge of two flash partial states."""
+    m0, l0, a0 = state
+    m1, l1, a1 = update
+    m = jnp.maximum(m0, m1)
+    w0 = jnp.exp(m0 - m)
+    w1 = jnp.exp(m1 - m)
+    return m, l0 * w0 + l1 * w1, a0 * w0[..., None] + a1 * w1[..., None]
+
+
+def ring_self_attention(
+    q: jax.Array,  # [B, S, H, hd] — S sharded over sp by the caller's specs
+    k: jax.Array,  # [B, S, KH, hd]
+    v: jax.Array,  # [B, S, KH, hd]
+    lengths: jax.Array,  # [B] valid length (padding masked)
+    mesh: Mesh,
+    *,
+    scale: float | None = None,
+    axis: str = AXIS_SEQUENCE,
+) -> jax.Array:
+    """Causal self-attention with the sequence sharded over ``axis``.
+
+    Every device holds S/sp of Q and of KV; KV blocks rotate around the
+    ring while query blocks stay put. Output is sharded like ``q``.
+    """
+    B, S, H, hd = q.shape
+    KH = k.shape[2]
+    sp = mesh.shape[axis]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    if S % sp:
+        raise ValueError(f"sequence length {S} not divisible by sp={sp}")
+    S_local = S // sp
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+    # Heads additionally shard over tp when divisible: ring-sp composes
+    # with tensor parallel with zero extra collectives (each tp rank rings
+    # its own head shard).
+    tp = mesh.shape.get(AXIS_TENSOR, 1)
+    head_axis = AXIS_TENSOR if (tp > 1 and H % tp == 0 and KH % tp == 0) else None
+    H_local = H // tp if head_axis else H
+
+    def body(q_blk, k_blk, v_blk, lengths):
+        r = jax.lax.axis_index(axis)
+        pos_q = r * S_local + jnp.arange(S_local, dtype=jnp.int32)  # [Tq]
+
+        m = jnp.full((B, H_local, S_local), _NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H_local, S_local), jnp.float32)
+        acc = jnp.zeros((B, H_local, S_local, hd), jnp.float32)
+        state = (m, l, acc)
+        kv = (k_blk, v_blk)
+
+        # Hop h: the KV block resident on rank r originated at rank r - h.
+        for h in range(sp):
+            src = (r - h) % sp
+            pos_k = src * S_local + jnp.arange(S_local, dtype=jnp.int32)
+            mask = (
+                (pos_k[None, None, :] <= pos_q[None, :, None])
+                & (pos_k[None, None, :] < lengths[:, None, None])
+            )  # [B, Tq, Tk]: causal & within each row's valid length
+            # Block-causal skip: a KV block strictly above the queries
+            # contributes nothing; its (all -inf) flash update is computed
+            # on otherwise-idle lanes and discarded, preserving one uniform
+            # program across ranks (SPMD requirement).
+            contributes = src <= r
+            merged = _merge(state, _flash_block(q_blk, kv[0], kv[1], mask, scale))
+            state = jax.tree.map(
+                lambda new, old: jnp.where(contributes, new, old),
+                merged,
+                state,
+            )
+            if h + 1 < sp:
+                kv = jax.lax.ppermute(kv, axis, perm)
+
+        m, l, acc = state
+        out = acc / jnp.maximum(l, 1e-20)[..., None]  # [B, H, Tq, hd]
+        return out.transpose(0, 2, 1, 3).astype(q_blk.dtype)  # [B, Tq, H, hd]
+
+    seq = P(None, axis, head_axis)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, P()),
+        out_specs=seq,
+    )(q, k, v, lengths)
+    return out
