@@ -64,6 +64,10 @@ containers:
       - "{{ .pipelineParallelSize | default 1 }}"
       - "--data-parallel-size"
       - "{{ .dataParallelSize | default 1 }}"
+      {{- if .sequenceParallelSize }}
+      - "--sequence-parallel-size"
+      - "{{ .sequenceParallelSize }}"
+      {{- end }}
       - "--block-size"
       - "{{ .blockSize | default 32 }}"
       - "--gpu-memory-utilization"

@@ -56,7 +56,13 @@ def _fetch(arr) -> np.ndarray:
     """Device→host fetch tuned for remote-attached chips: the blocking
     device_get path costs ~2x a readiness-polled async copy there, and when
     the copy was already started at dispatch time (see the burst pipeline)
-    the array is host-resident before anyone asks."""
+    the array is host-resident before anyone asks.
+
+    Poll interval note: isolated probes suggested longer sleeps (5-10 ms)
+    can beat tight polling on a single-core host (the loop competes with
+    the tunnel client's IO threads), but end-to-end bench runs did not
+    reproduce the win against the environment's run-to-run drift — the
+    short interval keeps small fetches cheap and measured best overall."""
     try:
         arr.copy_to_host_async()
     except Exception:  # pragma: no cover — backends without async copy
