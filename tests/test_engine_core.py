@@ -293,3 +293,43 @@ def test_engine_stats_surface(engine):
         "prefix_cache_hit_rate",
     ):
         assert key in s
+
+
+def test_fp8_kv_cache_serves():
+    """fp8 (e4m3) KV cache: half the bytes per token — double the contexts
+    per chip. Greedy generation must run the full stack (write cast, paged
+    attention read, prefix reuse) deterministically. No cross-dtype token
+    match here: this random-init tiny model's logits are near-uniform, so
+    fp8 rounding legitimately flips argmax (on-chip llama-1b agreed with
+    bf16 for the first 5 greedy tokens)."""
+    import numpy as np
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    prompt = list(range(5, 120))
+
+    def run(dtype):
+        eng = LLMEngine(EngineConfig(
+            model="tiny-llama-debug", max_model_len=256, block_size=8,
+            num_kv_blocks=96, max_num_seqs=4, max_prefill_tokens=64,
+            attn_impl="gather", kv_cache_dtype=dtype,
+        ))
+        out = eng.generate(
+            [prompt], SamplingParams(max_tokens=8, temperature=0.0,
+                                     ignore_eos=True)
+        )[0]["token_ids"]
+        # Same engine, warm cache: prefix hits must serve from fp8 pages.
+        eng.allocator.reset_metrics()
+        out2 = eng.generate(
+            [prompt], SamplingParams(max_tokens=8, temperature=0.0,
+                                     ignore_eos=True)
+        )[0]["token_ids"]
+        assert out2 == out
+        assert eng.allocator.hit_tokens > 0
+        return out
+
+    fp8 = run("float8_e4m3fn")
+    assert len(fp8) == 8
+    assert all(0 <= t < 512 for t in fp8)
