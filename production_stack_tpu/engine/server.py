@@ -284,6 +284,10 @@ def create_engine_app(
             return await _serve_generation(request, req, str(p), is_chat=False)
         if req.stream:
             return _error("streaming is not supported for batched prompts")
+        if (req.n or 1) > 1 or (req.best_of or 1) > 1:
+            # Explicit rejection beats silently returning one unranked
+            # sample per prompt.
+            return _error("n/best_of > 1 is not supported for batched prompts")
         return await _serve_completion_batch(request, req, prompts)
 
     async def _serve_completion_batch(
@@ -370,16 +374,25 @@ def create_engine_app(
         start = time.time()
         obj = "chat.completion.chunk" if is_chat else "text_completion"
         n_choices = max(int(getattr(req, "n", 1) or 1), 1)
+        # best_of is a completions-only OpenAI field; on chat it would be
+        # an unvalidated extra (pydantic extra=\"allow\") — ignore it there
+        # like every other unknown field.
+        best_of = n_choices if is_chat else int(req.best_of or n_choices)
+        if best_of < n_choices:
+            return _error("best_of must be >= n")
+        if best_of > 20 or n_choices > 20:
+            return _error("n/best_of must be <= 20")  # OpenAI cap; also the
+            # fan-out bound for one request's concurrent generations
         echo = bool(getattr(req, "echo", False)) and not is_chat
         want_lp = sampling.logprobs is not None
         lora = _resolve_lora(getattr(req, "model", ""))
 
-        if n_choices > 1:
+        if n_choices > 1 or best_of > 1:
             if req.stream:
-                return _error("streaming with n > 1 is not supported")
+                return _error("streaming with n/best_of > 1 is not supported")
             return await _serve_n_choices(
                 req, ids, sampling, rid, created, is_chat, n_choices, echo,
-                lora,
+                lora, best_of,
             )
 
         gen = engine.generate(
@@ -527,35 +540,58 @@ def create_engine_app(
                 "finish_reason": result["finish_reason"]}
 
     async def _serve_n_choices(
-        req, ids, sampling, rid, created, is_chat, n_choices, echo, lora
+        req, ids, sampling, rid, created, is_chat, n_choices, echo, lora,
+        best_of=None,
     ) -> web.Response:
-        """OpenAI `n`: serve n independent samples of one prompt (the prompt
-        prefix is KV-shared across them via the prefix cache)."""
+        """OpenAI `n` / `best_of`: sample ``best_of`` independent candidates
+        of one prompt (the prompt prefix is KV-shared across them via the
+        prefix cache); when ``best_of > n``, keep the n candidates with the
+        highest mean token logprob (which forces logprobs on internally)."""
         import dataclasses as _dc
 
         start = time.time()
+        n_sample = best_of or n_choices
+        rank = n_sample > n_choices
+
+        # Ranking needs per-token logprobs even when the client did not ask
+        # for them in the response.
+        lp_setting = (
+            0 if rank and sampling.logprobs is None else sampling.logprobs
+        )
 
         async def one(i: int) -> dict:
             sp = _dc.replace(
                 sampling,
                 seed=(sampling.seed + i) if sampling.seed is not None else None,
+                logprobs=lp_setting,
             )
             return await _collect(engine.generate(
                 prompt_token_ids=ids, sampling=sp, request_id=f"{rid}-{i}",
                 lora_name=lora,
             ))
 
-        results = list(await asyncio.gather(*(one(i) for i in range(n_choices))))
-        completion_tokens = sum(len(r["token_ids"]) for r in results)
+        results = list(await asyncio.gather(*(one(i) for i in range(n_sample))))
+        # OpenAI bills EVERY best_of candidate in completion_tokens.
+        sampled_tokens = sum(len(r["token_ids"]) for r in results)
+        if rank:
+            def mean_lp(r):
+                lps = [e["logprob"] for e in r["logprobs"]]
+                return sum(lps) / max(len(lps), 1)
+
+            results.sort(key=mean_lp, reverse=True)
+            results = results[:n_choices]
+            if sampling.logprobs is None:  # client didn't ask: strip
+                for r in results:
+                    r["logprobs"] = []
         usage = {
             "prompt_tokens": len(ids),
-            "completion_tokens": completion_tokens,
-            "total_tokens": len(ids) + completion_tokens,
+            "completion_tokens": sampled_tokens,
+            "total_tokens": len(ids) + sampled_tokens,
         }
         metrics.e2e.observe(time.time() - start)
         metrics.success.inc()
         metrics.prompt_tokens.inc(len(ids))
-        metrics.generation_tokens.inc(completion_tokens)
+        metrics.generation_tokens.inc(sampled_tokens)
         payload = {
             "id": rid,
             "object": "chat.completion" if is_chat else "text_completion",

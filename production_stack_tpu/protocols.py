@@ -115,6 +115,9 @@ class CompletionRequest(SamplingFields):
     prompt: Union[str, List[str], List[int], List[List[int]]] = ""
     echo: bool = False
     suffix: Optional[str] = None
+    # best_of > n: sample best_of candidates, return the n with the highest
+    # mean token logprob (OpenAI/vLLM semantics; non-streaming only).
+    best_of: Optional[int] = None
 
 
 class ChatCompletionRequest(SamplingFields):

@@ -142,3 +142,40 @@ def test_rope_scaling_tables_match_hf_reference():
     c1, _ = _rope_tables(jnp.asarray(far), cfg)
     c0, _ = _rope_tables(jnp.asarray(far), cfg0)
     assert float(np.abs(np.asarray(c1) - np.asarray(c0)).max()) > 0.1
+
+
+async def test_best_of():
+    """best_of > n: sample best_of candidates, return the n with highest
+    mean token logprob (forced internally; stripped when unrequested)."""
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug", "prompt": "abc", "max_tokens": 4,
+            "temperature": 1.0, "n": 2, "best_of": 4, "seed": 11,
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=payload) as r:
+            assert r.status == 200
+            body = await r.json()
+        assert len(body["choices"]) == 2
+        assert [c["index"] for c in body["choices"]] == [0, 1]
+        # Client didn't request logprobs: none in the response.
+        assert all(c["logprobs"] is None for c in body["choices"])
+        # OpenAI bills EVERY best_of candidate: 4 candidates x 4 tokens.
+        assert body["usage"]["completion_tokens"] == 16
+        # best_of < n rejected; absurd fan-out rejected.
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(payload, n=3, best_of=2),
+        ) as r:
+            assert r.status == 400
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(payload, best_of=100000),
+        ) as r:
+            assert r.status == 400
+        # chat ignores best_of (completions-only OpenAI field).
+        async with sess.post(
+            f"{server.url}/v1/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}],
+                  "max_tokens": 2, "best_of": "two"},
+        ) as r:
+            assert r.status == 200
