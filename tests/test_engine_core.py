@@ -333,3 +333,42 @@ def test_fp8_kv_cache_serves():
     fp8 = run("float8_e4m3fn")
     assert len(fp8) == 8
     assert all(0 <= t < 512 for t in fp8)
+
+
+def test_qwen2_style_attention_bias_family():
+    """The one-architecture-class claim (llama/mistral/qwen2) must hold for
+    the qwen2 variant: QKV biases flow through init, pspecs, and the
+    forward pass; generation is deterministic."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.models import registry
+    from production_stack_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=16,
+        attention_bias=True,  # the qwen2 delta
+        name="tiny-qwen2-debug", eos_token_ids=(0,), bos_token_id=None,
+        dtype="float32",
+    )
+    registry.PRESETS["tiny-qwen2-debug"] = cfg
+    try:
+        eng = LLMEngine(EngineConfig(
+            model="tiny-qwen2-debug", max_model_len=128, block_size=8,
+            num_kv_blocks=64, max_num_seqs=2, max_prefill_tokens=32,
+            attn_impl="gather",
+        ))
+        assert "bq" in eng.runner.params["layers"]
+        prompt = list(range(7, 40))
+        out1 = eng.generate(
+            [prompt], SamplingParams(max_tokens=6, temperature=0.0,
+                                     ignore_eos=True)
+        )[0]["token_ids"]
+        out2 = eng.generate(
+            [prompt], SamplingParams(max_tokens=6, temperature=0.0,
+                                     ignore_eos=True)
+        )[0]["token_ids"]
+        assert out1 == out2 and len(out1) == 6
+    finally:
+        registry.PRESETS.pop("tiny-qwen2-debug", None)
