@@ -68,6 +68,10 @@ containers:
       - "--sequence-parallel-size"
       - "{{ .sequenceParallelSize }}"
       {{- end }}
+      {{- if .expertParallelSize }}
+      - "--expert-parallel-size"
+      - "{{ .expertParallelSize }}"
+      {{- end }}
       - "--block-size"
       - "{{ .blockSize | default 32 }}"
       - "--gpu-memory-utilization"

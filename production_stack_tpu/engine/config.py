@@ -43,8 +43,15 @@ class EngineConfig:
     # full-attention encode path (/v1/embeddings at contexts beyond one
     # device group's attention memory). See ops/ring_attention.py.
     sequence_parallel_size: int = 1
+    # Expert parallel (MoE models): the expert bank shards over the ep mesh
+    # axis; the combine reduction is the one ep all-reduce XLA inserts.
+    expert_parallel_size: int = 1
     kv_cache_dtype: Optional[str] = None  # default: model dtype
     attn_impl: str = "auto"  # auto | gather | pallas
+    # MoE execution strategy: ragged (dropless lax.ragged_dot grouped
+    # matmul — FLOP-proportional, the single-shard default) | dense
+    # (expert-batched einsums, GSPMD-shardable over ep/tp) | auto.
+    moe_impl: str = "auto"
     enable_prefix_caching: bool = True
     # Decode tokens generated per device call (lax.scan over steps inside one
     # jit). Amortizes host⇄device dispatch — the dominant cost for small

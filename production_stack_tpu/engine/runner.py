@@ -110,12 +110,23 @@ class ModelRunner:
                 "sequence_parallel_size > 1 (ring encode) does not compose "
                 "with pipeline_parallel_size > 1 yet"
             )
+        ep = max(cfg.expert_parallel_size, 1)
+        if ep > 1 and (
+            not self.model_cfg.num_experts
+            or self.model_cfg.num_experts % ep
+        ):
+            raise ValueError(
+                f"expert_parallel_size={ep} needs a MoE model with "
+                f"num_experts divisible by it "
+                f"(model has {self.model_cfg.num_experts})"
+            )
         self.mesh = mesh or build_mesh(
             MeshConfig(
                 tensor_parallel_size=tp,
                 data_parallel_size=cfg.data_parallel_size,
                 pipeline_parallel_size=pp,
                 sequence_parallel_size=max(cfg.sequence_parallel_size, 1),
+                expert_parallel_size=ep,
             )
         )
 
@@ -158,6 +169,19 @@ class ModelRunner:
         model = self.model
         attn_impl = cfg.attn_impl
         mesh_for_pp = self.mesh if pp > 1 else None
+        # MoE strategy: ragged_dot is the FLOP-proportional single-shard
+        # path; whenever the expert bank is mesh-sharded (ep/tp/pp) use the
+        # dense einsum formulation, whose contractions GSPMD partitions
+        # cleanly (ragged_dot has no partitioning rule — XLA would gather
+        # the full bank to every device).
+        moe_impl = cfg.moe_impl
+        if moe_impl == "auto":
+            mesh_shape = dict(self.mesh.shape)
+            sharded = (
+                mesh_shape.get("ep", 1) > 1 or tp > 1 or pp > 1
+            )
+            moe_impl = "dense" if sharded else "ragged"
+        self._moe_impl = moe_impl
 
         def step(params, kv_cache, batch: Dict[str, Any], want_lp: bool):
             logits, kv_cache = model.forward(
@@ -172,6 +196,7 @@ class ModelRunner:
                 lora_idx=batch.get("lora_idx"),
                 lora_scale=batch.get("lora_scale"),
                 attn_impl=attn_impl,
+                moe_impl=moe_impl,
                 pp_size=pp,
                 mesh=mesh_for_pp,
             )
@@ -246,6 +271,7 @@ class ModelRunner:
                     lora_idx=batch.get("lora_idx"),
                     lora_scale=batch.get("lora_scale"),
                     attn_impl=attn_impl,
+                    moe_impl=moe_impl,
                     pp_size=pp,
                     mesh=mesh_for_pp,
                 )
@@ -432,9 +458,12 @@ class ModelRunner:
             sp = max(self.cfg.sequence_parallel_size, 1)
             mesh = self.mesh if (pp > 1 or sp > 1) else None
 
+            moe_impl = self._moe_impl
+
             def enc(params, toks, length):
                 return model.encode(
-                    params, toks, length, pp_size=pp, sp_size=sp, mesh=mesh
+                    params, toks, length, pp_size=pp, sp_size=sp,
+                    moe_impl=moe_impl, mesh=mesh,
                 )
 
             self._encode_fn = jax.jit(enc, out_shardings=self._repl)

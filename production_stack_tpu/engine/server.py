@@ -835,6 +835,10 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--sequence-parallel-size", type=int, default=1,
                    help="ring-attention context parallel (encode path)")
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="MoE expert bank sharding over the ep mesh axis")
+    p.add_argument("--moe-impl", default="auto",
+                   choices=["auto", "ragged", "dense"])
     p.add_argument("--kv-cache-dtype", default=None)
     p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
@@ -880,8 +884,10 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         pipeline_parallel_size=args.pipeline_parallel_size,
         data_parallel_size=args.data_parallel_size,
         sequence_parallel_size=args.sequence_parallel_size,
+        expert_parallel_size=args.expert_parallel_size,
         kv_cache_dtype=args.kv_cache_dtype,
         attn_impl=args.attn_impl,
+        moe_impl=args.moe_impl,
         enable_prefix_caching=args.enable_prefix_caching,
         seed=args.seed,
         enable_lora=args.enable_lora,

@@ -38,7 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..logging_utils import init_logger
 from ..ops.attention import paged_attention
-from ..parallel.mesh import AXIS_PIPELINE, AXIS_TENSOR
+from ..parallel.mesh import AXIS_EXPERT, AXIS_PIPELINE, AXIS_TENSOR
 
 logger = init_logger(__name__)
 
@@ -117,6 +117,10 @@ class LlamaConfig:
     max_position_embeddings: int = 131072
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # Qwen2-style QKV biases
+    # Mixture-of-experts (Mixtral-style sparse SwiGLU MLP; HF
+    # ``num_local_experts`` / ``num_experts_per_tok``). 0 experts = dense.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
     dtype: str = "bfloat16"
     # Serving identity / tokenizer hints (not part of the math).
     name: str = "llama"
@@ -150,7 +154,7 @@ class Llama:
         """Random (serving-scale-correct) initialization, for tests/bench."""
         cfg = self.cfg
         d = cfg.jdtype
-        k = jax.random.split(rng, 8)
+        k = jax.random.split(rng, 9)
         D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
 
         def dense(key, shape, fan_in):
@@ -158,6 +162,24 @@ class Llama:
                 jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
             ).astype(d)
 
+        if cfg.num_experts:
+            E = cfg.num_experts
+            mlp = {
+                # Router kept [D, E] so routing is a plain x @ w (HF stores
+                # the transpose). Experts are stacked on their own axis so
+                # the whole bank feeds one grouped matmul (ragged_dot) or one
+                # expert-batched einsum — and shards over the ep mesh axis.
+                "w_router": dense(k[8], (L, D, E), D),
+                "w_gate": dense(k[5], (L, E, D, F), D),
+                "w_up": dense(k[6], (L, E, D, F), D),
+                "w_down": dense(k[7], (L, E, F, D), F),
+            }
+        else:
+            mlp = {
+                "w_gate": dense(k[5], (L, D, F), D),
+                "w_up": dense(k[6], (L, D, F), D),
+                "w_down": dense(k[7], (L, F, D), F),
+            }
         params: Params = {
             "embed": dense(k[0], (cfg.vocab_size, D), D),
             "layers": {
@@ -167,9 +189,7 @@ class Llama:
                 "wv": dense(k[3], (L, D, cfg.kv_size), D),
                 "wo": dense(k[4], (L, cfg.q_size, D), cfg.q_size),
                 "mlp_norm": jnp.ones((L, D), d),
-                "w_gate": dense(k[5], (L, D, F), D),
-                "w_up": dense(k[6], (L, D, F), D),
-                "w_down": dense(k[7], (L, F, D), F),
+                **mlp,
             },
             "final_norm": jnp.ones((D,), d),
         }
@@ -191,6 +211,22 @@ class Llama:
         pp, giving layer-stage parallelism without restructuring the tree.
         """
         pp = "pp" if pipeline else None
+        if self.cfg.num_experts:
+            # Expert bank: experts over ep, FFN hidden over tp (each expert
+            # is itself Megatron-sharded). The combine einsum's reduction
+            # over E becomes the one all-reduce over ep XLA inserts.
+            mlp_specs = {
+                "w_router": P(pp, None, None),
+                "w_gate": P(pp, AXIS_EXPERT, None, AXIS_TENSOR),
+                "w_up": P(pp, AXIS_EXPERT, None, AXIS_TENSOR),
+                "w_down": P(pp, AXIS_EXPERT, AXIS_TENSOR, None),
+            }
+        else:
+            mlp_specs = {
+                "w_gate": P(pp, None, AXIS_TENSOR),
+                "w_up": P(pp, None, AXIS_TENSOR),
+                "w_down": P(pp, AXIS_TENSOR, None),
+            }
         specs: Params = {
             "embed": P(None, AXIS_TENSOR),
             "layers": {
@@ -200,9 +236,7 @@ class Llama:
                 "wv": P(pp, None, AXIS_TENSOR),
                 "wo": P(pp, AXIS_TENSOR, None),
                 "mlp_norm": P(pp, None),
-                "w_gate": P(pp, None, AXIS_TENSOR),
-                "w_up": P(pp, None, AXIS_TENSOR),
-                "w_down": P(pp, AXIS_TENSOR, None),
+                **mlp_specs,
             },
             "final_norm": P(None),
         }
@@ -305,6 +339,7 @@ class Llama:
         lora_idx: Optional[jax.Array] = None,  # [B] int32 bank slots (0=none)
         lora_scale: Optional[jax.Array] = None,  # [B] f32 alpha/r per row
         attn_impl: str = "auto",
+        moe_impl: str = "auto",
         pp_size: int = 1,
         mesh=None,
     ) -> Tuple[jax.Array, jax.Array]:
@@ -409,14 +444,7 @@ class Llama:
             x = x + o.astype(x.dtype)
 
             h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            gate = _proj(h, lp["w_gate"])
-            up = _proj(h, lp["w_up"])
-            ff = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(
-                lp["w_down"].dtype
-            )
-            x = x + jnp.einsum(
-                "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
-            ).astype(x.dtype)
+            x = x + _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
             return x, kv_all
 
         def scan_layers(ctx, x, kv_all, layers, n_layers):
@@ -479,6 +507,7 @@ class Llama:
         *,
         pp_size: int = 1,
         sp_size: int = 1,
+        moe_impl: str = "auto",
         mesh=None,
     ) -> jax.Array:
         """Embedding path (/v1/embeddings): full causal attention, no cache;
@@ -547,13 +576,7 @@ class Llama:
                 "btq,qd->btd", attn, lp["wo"], preferred_element_type=jnp.float32
             ).astype(x.dtype)
             h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            ff = (
-                jax.nn.silu(_proj(h, lp["w_gate"]).astype(jnp.float32))
-                * _proj(h, lp["w_up"]).astype(jnp.float32)
-            ).astype(x.dtype)
-            x = x + jnp.einsum(
-                "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
-            ).astype(x.dtype)
+            x = x + _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
             return x, None
 
         ctx = (rope_cos, rope_sin, causal)
@@ -586,6 +609,89 @@ def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return ((xf * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w
+
+
+def _mlp(cfg: "LlamaConfig", lp: Params, h: jax.Array, moe_impl: str = "auto") -> jax.Array:
+    """SwiGLU MLP block output [B, T, D] in fp32 — dense, or Mixtral-style
+    sparse mixture-of-experts when ``cfg.num_experts``."""
+    if not cfg.num_experts:
+        gate = _proj(h, lp["w_gate"])
+        up = _proj(h, lp["w_up"])
+        ff = (
+            jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)
+        ).astype(lp["w_down"].dtype)
+        return jnp.einsum(
+            "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
+        )
+    B, T, D = h.shape
+    return _moe_mlp(cfg, lp, h.reshape(B * T, D), moe_impl).reshape(B, T, D)
+
+
+def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Array:
+    """Sparse MoE SwiGLU over flattened tokens ``x`` [N, D] → fp32 [N, D].
+
+    Router math in fp32 (HF Mixtral convention), top-k weights renormalized.
+    Two TPU execution strategies:
+
+    - ``ragged`` — dropless grouped matmul via ``lax.ragged_dot``: token-
+      expert pairs are sorted by expert and each expert multiplies exactly
+      the tokens routed to it. FLOPs stay proportional to N*k (no capacity
+      padding, no token dropping). The idiomatic single-shard / tp-only path.
+    - ``dense`` — expert-batched einsums over ALL tokens with a one-hot
+      combine. E/k× the FLOPs, but every contraction is a plain einsum that
+      GSPMD shards cleanly over the ``ep``/``tp`` mesh axes (experts stay
+      resident on their shard; the combine reduction becomes the ep
+      all-reduce). Used whenever the expert bank is mesh-sharded.
+
+    ``auto`` resolves to ``ragged`` (the engine passes ``dense`` explicitly
+    on ep/tp/pp-sharded meshes — see runner).
+    """
+    N, D = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    logits = jnp.einsum(
+        "nd,de->ne", x.astype(jnp.float32), lp["w_router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E] fp32
+    weights, ids = jax.lax.top_k(probs, K)  # [N, K]
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    if impl not in ("ragged", "dense", "auto"):
+        raise ValueError(f"unknown moe_impl {impl!r} (ragged|dense|auto)")
+    if impl in ("ragged", "auto"):
+        flat_ids = ids.reshape(-1)  # [N*K]
+        order = jnp.argsort(flat_ids)  # sorted-by-expert slot order
+        tok = order // K  # originating token of each sorted slot
+        xs = x[tok]  # [N*K, D]
+        group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
+        g = jax.lax.ragged_dot(
+            xs, lp["w_gate"], group_sizes,
+            preferred_element_type=jnp.float32,
+        )
+        u = jax.lax.ragged_dot(
+            xs, lp["w_up"], group_sizes, preferred_element_type=jnp.float32
+        )
+        hh = (jax.nn.silu(g) * u).astype(lp["w_down"].dtype)
+        y = jax.lax.ragged_dot(
+            hh, lp["w_down"], group_sizes, preferred_element_type=jnp.float32
+        )  # [N*K, D]
+        wsort = weights.reshape(-1)[order]  # [N*K]
+        return (
+            jnp.zeros((N, D), jnp.float32).at[tok].add(y * wsort[:, None])
+        )
+    # dense: combine[n, e] = summed top-k weight of expert e for token n.
+    combine = jnp.sum(
+        jax.nn.one_hot(ids, E, dtype=jnp.float32) * weights[..., None], axis=1
+    )  # [N, E]
+    g = jnp.einsum(
+        "nd,edf->enf", x, lp["w_gate"], preferred_element_type=jnp.float32
+    )
+    u = jnp.einsum(
+        "nd,edf->enf", x, lp["w_up"], preferred_element_type=jnp.float32
+    )
+    hh = (jax.nn.silu(g) * u).astype(lp["w_down"].dtype)
+    y = jnp.einsum(
+        "enf,efd->end", hh, lp["w_down"], preferred_element_type=jnp.float32
+    )
+    return jnp.einsum("end,ne->nd", y, combine)
 
 
 def _proj(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
@@ -708,7 +814,34 @@ def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
     if "lm_head.weight" in raw:
         params["lm_head"] = cast(raw.pop("lm_head.weight"))
 
-    for hf_name, ours in _HF_LAYER_MAP.items():
+    layer_map = dict(_HF_LAYER_MAP)
+    if cfg.num_experts:
+        # Mixtral: per-expert w1/w3/w2 (gate/up/down) + the router. Experts
+        # are stacked on axis 0 of each layer to form the bank the grouped
+        # matmuls consume.
+        for hf_name in ("mlp.gate_proj", "mlp.up_proj", "mlp.down_proj"):
+            del layer_map[hf_name]
+        hf_expert = {"w_gate": "w1", "w_up": "w3", "w_down": "w2"}
+        for ours, wname in hf_expert.items():
+            layer_acc[ours] = [
+                np.stack(
+                    [
+                        raw[
+                            f"model.layers.{i}.block_sparse_moe.experts."
+                            f"{e}.{wname}.weight"
+                        ].T
+                        for e in range(cfg.num_experts)
+                    ],
+                    axis=0,
+                )
+                for i in range(L)
+            ]
+        layer_acc["w_router"] = [
+            raw[f"model.layers.{i}.block_sparse_moe.gate.weight"].T
+            for i in range(L)
+        ]
+
+    for hf_name, ours in layer_map.items():
         stack = []
         for i in range(L):
             w = raw[f"model.layers.{i}.{hf_name}.weight"]
@@ -733,7 +866,7 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
     with open(config_path) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2"):
+    if mt not in ("llama", "mistral", "qwen2", "mixtral"):
         raise ValueError(f"unsupported model_type {mt!r} (llama-family only)")
     eos = hf.get("eos_token_id", 2)
     eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
@@ -768,6 +901,8 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
         max_position_embeddings=hf.get("max_position_embeddings", 4096),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
         attention_bias=mt == "qwen2" or hf.get("attention_bias", False),
+        num_experts=hf.get("num_local_experts", 0) if mt == "mixtral" else 0,
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         name=name or hf.get("_name_or_path", mt),
         eos_token_ids=eos_ids,
         bos_token_id=hf.get("bos_token_id"),

@@ -88,6 +88,39 @@ PRESETS: Dict[str, LlamaConfig] = {
         name="mistral-7b",
         eos_token_ids=(2,),
     ),
+    # Tiny MoE debug model (Mixtral-style sparse MLP; 4 experts, top-2).
+    "tiny-mixtral-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=8,
+        head_dim=16,
+        max_position_embeddings=2048,
+        num_experts=4,
+        num_experts_per_tok=2,
+        name="tiny-mixtral-debug",
+        eos_token_ids=(0,),
+        bos_token_id=None,
+        dtype="float32",
+    ),
+    # Mixtral-8x7B shapes (sparse MoE flagship; 47B params, 13B active).
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        num_experts=8,
+        num_experts_per_tok=2,
+        name="mixtral-8x7b",
+        eos_token_ids=(2,),
+    ),
     "qwen2-7b": LlamaConfig(
         vocab_size=152064,
         hidden_size=3584,
