@@ -65,6 +65,13 @@ class EngineConfig:
     # is a multi-second TTFT outlier). Padding rows carry kv_len=0 and cost
     # ~nothing — the pallas kernel streams zero pages for them.
     min_decode_bucket: int = 1
+    # Speculative decoding via n-gram prompt lookup (engine/spec.py): draft
+    # up to this many tokens per greedy sequence per step and verify them in
+    # one forward pass. 0 = off. Output is exactly the non-speculative
+    # greedy output; sampled (temperature>0) batches bypass speculation.
+    speculative_ngram: int = 0
+    ngram_min: int = 1  # shortest suffix n-gram to match
+    ngram_max: int = 3  # longest suffix n-gram to match
     # Pipelined decode: keep one burst in flight and overlap its token fetch
     # with the next burst's execution (hides the host<->device round trip).
     # Raises decode throughput on dispatch-latency-bound setups but ADDS up

@@ -15,6 +15,8 @@ import os
 import time
 from typing import Dict, List, Optional, Sequence as Seq, Union
 
+import numpy as np
+
 from ..kvcache.hashing import CHUNK_TOKENS
 from ..logging_utils import init_logger
 from ..models.registry import get_model_config
@@ -77,9 +79,21 @@ class LLMEngine:
                 # The in-flight continuation writes one burst past the host
                 # view, so its pages must already exist at dispatch time.
                 decode_lookahead=2 if cfg.async_decode else 1,
+                spec_tokens=0 if cfg.async_decode else cfg.speculative_ngram,
             ),
             self.allocator,
         )
+        if cfg.async_decode and cfg.speculative_ngram:
+            # Pipelined bursts win every decode step, so the spec branch
+            # would never run — surface the conflict instead of silently
+            # reserving pages for it.
+            logger.warning(
+                "speculative_ngram is disabled while async_decode is on "
+                "(pipelined bursts preempt the speculation path)"
+            )
+        # Speculative-decoding counters (engine.stats / observability).
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
         # Pipelined-decode bookkeeping: membership of the in-flight burst
         # (original order, including members that finished meanwhile) and
         # sequences whose page release is deferred until the drain.
@@ -318,6 +332,8 @@ class LLMEngine:
             self._burst_seqs = list(sched.decodes)
             self._burst_n = sched.n_decode_steps
             self.runner.burst_start(sched.decodes, sched.n_decode_steps)
+        elif (drafts := self._spec_drafts(sched.decodes)) is not None:
+            outputs += self._spec_step(sched.decodes, drafts)
         else:
             bursts = self.runner.execute_decode_multi(
                 sched.decodes, sched.n_decode_steps
@@ -332,6 +348,71 @@ class LLMEngine:
                     if seq.is_finished:
                         break  # trim speculative tail of the burst
         self._sweep_retiring_slots()
+        return outputs
+
+    # -- speculative decoding (n-gram prompt lookup; engine/spec.py) ----
+
+    def _spec_drafts(
+        self, decodes
+    ) -> "Optional[tuple[np.ndarray, np.ndarray]]":
+        """Per-sequence draft tokens [B, K] for this decode batch, or None
+        when speculation should not engage: disabled, non-greedy /
+        penalized / logprobs rows (exactness policy), too-long rows, or too
+        few sequences with an n-gram hit to beat a plain burst."""
+        K = self.cfg.speculative_ngram
+        if not K or self.cfg.async_decode or not decodes:
+            return None
+        from .spec import propose_ngram
+
+        for s in decodes:
+            if (
+                s.sampling.temperature > 0.0
+                or s.sampling.has_penalties
+                or s.sampling.logprobs is not None
+                or s.sampling.logit_bias  # verify argmax is unbiased
+            ):
+                return None
+        drafts = np.zeros((len(decodes), K), np.int32)
+        lens = np.zeros(len(decodes), np.int32)
+        for i, s in enumerate(decodes):
+            if s.num_tokens + K > self.cfg.max_model_len:
+                continue  # verify writes would run past the last page
+            d = propose_ngram(
+                s.all_token_ids, K, self.cfg.ngram_min, self.cfg.ngram_max
+            )
+            if d:
+                drafts[i, : len(d)] = d
+                lens[i] = len(d)
+        # A verify pass costs ~one (K+1)-token step; worth it only when
+        # enough rows actually carry drafts.
+        if int(np.count_nonzero(lens)) * 2 < len(decodes):
+            return None
+        return drafts, lens
+
+    def _spec_step(self, decodes, spec) -> List[RequestOutput]:
+        """One verify pass: commit each row's accepted draft prefix plus the
+        model's own next token (exactly the greedy output)."""
+        from .spec import count_accepted
+
+        drafts, lens = spec
+        rows = self.runner.execute_spec_verify(decodes, drafts)
+        outputs: List[RequestOutput] = []
+        for i, seq in enumerate(decodes):
+            draft = [int(t) for t in drafts[i][: lens[i]]]
+            a = count_accepted(draft, rows[i])
+            # Clamp: never emit past max_model_len.
+            a = min(a, self.cfg.max_model_len - seq.num_tokens - 1)
+            self.spec_proposed_total += len(draft)
+            self.spec_accepted_total += a
+            emitted = draft[:a] + [int(rows[i][a])]
+            for tok in emitted:
+                seq.num_computed_tokens += 1
+                self._commit(seq)
+                out = self._append_token(seq, tok)
+                if out is not None:
+                    outputs.append(out)
+                if seq.is_finished:
+                    break
         return outputs
 
     def _process_prefill_rows(self, prefills, rows) -> List[RequestOutput]:
@@ -595,6 +676,13 @@ class LLMEngine:
             "prefix_cache_hits_total": float(self.allocator.hit_tokens),
             "prefix_cache_queries_total": float(self.allocator.query_tokens),
         }
+        if self.cfg.speculative_ngram:
+            out["spec_decode_num_draft_tokens_total"] = float(
+                self.spec_proposed_total
+            )
+            out["spec_decode_num_accepted_tokens_total"] = float(
+                self.spec_accepted_total
+            )
         # Tiering KPIs (present when the LMCache-analogue layer is on).
         for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):
             if hasattr(self.allocator, attr):

@@ -116,6 +116,8 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
         elif kind == "burst_cont":
             tables, kv_lens = payload
             runner._dispatch_burst_continue(tables, kv_lens)
+        elif kind == "spec_verify":
+            runner._dispatch_spec_verify(payload)
         else:  # future-proof: unknown step kinds are fatal (order contract)
             raise RuntimeError(f"unknown multihost step kind: {kind!r}")
 

@@ -29,7 +29,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..logging_utils import init_logger
 from ..models.llama import Llama, LlamaConfig, load_hf_params
 from ..models.registry import get_model_config
-from ..ops.sampling import apply_penalties, sample_tokens_packed
+from ..ops.sampling import (
+    apply_logit_bias,
+    apply_penalties,
+    sample_tokens_packed,
+)
 from ..parallel.mesh import MeshConfig, build_mesh
 from .config import EngineConfig, resolve_num_kv_blocks
 from .scheduler import PrefillItem
@@ -209,6 +213,10 @@ class ModelRunner:
                     batch["frequency"],
                     batch["repetition"],
                 )
+            if "bias_ids" in batch:
+                logits = apply_logit_bias(
+                    logits, batch["bias_ids"], batch["bias_vals"]
+                )
             # Packed rows: [token] or [token, chosen_lp, top_lps,
             # top_ids] — one fetch serves both sampling and logprobs, and
             # the logprobs math compiles in only when requested.
@@ -275,6 +283,10 @@ class ModelRunner:
                     pp_size=pp,
                     mesh=mesh_for_pp,
                 )
+                if "bias_ids" in batch:
+                    logits = apply_logit_bias(
+                        logits, batch["bias_ids"], batch["bias_vals"]
+                    )
                 packed = sample_tokens_packed(
                     logits,
                     batch["temps"],
@@ -622,6 +634,108 @@ class ModelRunner:
         # keeps program order identical.
         return _fetch(st["toks"])
 
+    def execute_spec_verify(
+        self, seqs: List[Sequence], drafts: np.ndarray
+    ) -> np.ndarray:
+        """Speculative-decoding verify step: score each sequence's last
+        committed token plus its K draft tokens in ONE forward pass.
+
+        ``drafts`` is [B, K] int32. Returns the model's greedy argmax at
+        every scored position, [B, K+1] int32 — row j's argmax is the token
+        the model itself would emit after consuming positions ≤ p0+j, which
+        the engine compares against the drafts to count acceptances. KV for
+        all K+1 positions is written during the pass; rejected positions sit
+        past the committed kv_len and are overwritten on real decode.
+        """
+        B, K = drafts.shape
+        batch = self._spec_batch(seqs, drafts)
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("spec_verify", batch)
+            return self._dispatch_spec_verify(batch)[: len(seqs)]
+
+    def _spec_batch(
+        self, seqs: List[Sequence], drafts: np.ndarray
+    ) -> Dict[str, np.ndarray]:
+        B, K = drafts.shape
+        T = K + 1
+        Bb = self._row_bucket(B)
+        Wb = self._table_bucket(seqs)
+        bs = self.cfg.block_size
+        tokens = np.zeros((Bb, T), np.int32)
+        positions = np.zeros((Bb, T), np.int32)
+        write_idx = np.full((Bb, T), self._drop_slot, np.int32)
+        tables = np.zeros((Bb, Wb), np.int32)
+        kv_lens = np.zeros(Bb, np.int32)
+        last_idx = np.zeros(Bb, np.int32)
+        for i, s in enumerate(seqs):
+            p0 = s.num_tokens - 1  # the not-yet-computed last token
+            tokens[i, 0] = s.all_token_ids[-1]
+            tokens[i, 1:] = drafts[i]
+            positions[i] = p0 + np.arange(T, dtype=np.int32)
+            covered = len(s.block_ids) * bs  # draftless near-limit rows may
+            for j in range(T):  # not have pages for all K+1 positions
+                pos = p0 + j
+                if pos < covered:
+                    write_idx[i, j] = s.block_ids[pos // bs] * bs + pos % bs
+            tables[i] = self._table_row(s, Wb)
+            kv_lens[i] = min(s.num_tokens + K, covered)
+        batch = {
+            "tokens": tokens,
+            "positions": positions,
+            "write_idx": write_idx,
+            "block_tables": tables,
+            "kv_lens": kv_lens,
+            "last_idx": last_idx,
+        }
+        if self.cfg.enable_lora:
+            # Verify must score drafts WITH each row's adapter, or accepted
+            # tokens would be the base model's, not the adapter's.
+            batch.update(self._lora_arrays(seqs, Bb))
+        return batch
+
+    def _dispatch_spec_verify(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        if not hasattr(self, "_spec_step"):
+            model = self.model
+            attn_impl = self.cfg.attn_impl
+            pp = self._pp
+            mesh_for_pp = self.mesh if pp > 1 else None
+            moe_impl = self._moe_impl
+
+            def spec_step(params, kv_cache, batch):
+                logits, kv_cache = model.forward(
+                    params,
+                    batch["tokens"],
+                    batch["positions"],
+                    batch["write_idx"],
+                    batch["block_tables"],
+                    batch["kv_lens"],
+                    batch["last_idx"],
+                    kv_cache,
+                    lora_idx=batch.get("lora_idx"),
+                    lora_scale=batch.get("lora_scale"),
+                    attn_impl=attn_impl,
+                    moe_impl=moe_impl,
+                    pp_size=pp,
+                    mesh=mesh_for_pp,
+                    all_logits=True,
+                )
+                ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+                return ids, kv_cache
+
+            cache_sh = NamedSharding(
+                self.mesh, Llama.cache_pspec(pipeline=pp > 1)
+            )
+            self._spec_step = jax.jit(
+                spec_step,
+                donate_argnums=(1,),
+                out_shardings=(self._repl, cache_sh),
+            )
+        ids, self.kv_cache = self._spec_step(
+            self.params, self.kv_cache, self._put_batch(batch)
+        )
+        return _fetch(ids)
+
     def execute_prefill(self, item: PrefillItem) -> int:
         """Process one prefill chunk; returns the sampled token id (only
         meaningful when the chunk completes the prompt)."""
@@ -704,17 +818,33 @@ class ModelRunner:
         row[:n] = seq.block_ids[:n]
         return row
 
+    def _row_bucket(self, B: int) -> int:
+        """Decode/verify batch-row bucket: pow2, floored by dp divisibility
+        and the compile-stability floor."""
+        Bb = _pow2(B, cap=_pow2(self.cfg.max_num_seqs))
+        return max(Bb, B, self._dp, self.cfg.min_decode_bucket)
+
+    def _table_bucket(self, seqs: List[Sequence]) -> int:
+        W = max(max(len(s.block_ids) for s in seqs), 1)
+        return max(
+            _pow2(W, cap=_pow2(self.max_table_width)),
+            min(_MIN_TABLE_BUCKET, _pow2(self.max_table_width)),
+        )
+
+    def _lora_arrays(self, seqs: List[Sequence], B: int) -> Dict[str, np.ndarray]:
+        lora_idx = np.zeros(B, np.int32)
+        lora_scale = np.zeros(B, np.float32)
+        for i, s in enumerate(seqs):
+            lora_idx[i] = getattr(s, "lora_idx", 0)
+            lora_scale[i] = getattr(s, "lora_scale", 0.0)
+        return {"lora_idx": lora_idx, "lora_scale": lora_scale}
+
     def _decode_batch(
         self, seqs: List[Sequence], multi: bool = False
     ) -> Dict[str, np.ndarray]:
         B = len(seqs)
-        Bb = _pow2(B, cap=_pow2(self.cfg.max_num_seqs))
-        Bb = max(Bb, B, self._dp, self.cfg.min_decode_bucket)
-        W = max(len(s.block_ids) for s in seqs)
-        Wb = max(
-            _pow2(W, cap=_pow2(self.max_table_width)),
-            min(_MIN_TABLE_BUCKET, _pow2(self.max_table_width)),
-        )
+        Bb = self._row_bucket(B)
+        Wb = self._table_bucket(seqs)
         bs = self.cfg.block_size
 
         shape = (Bb,) if multi else (Bb, 1)
@@ -751,13 +881,7 @@ class ModelRunner:
         chunk_max = max(it.end - it.start for it in items)
         Tb = _pow2(chunk_max, cap=_pow2(self.cfg.max_prefill_tokens))
         Tb = max(Tb, chunk_max)
-        Wb = max(
-            _pow2(
-                max(max(len(it.seq.block_ids) for it in items), 1),
-                cap=_pow2(self.max_table_width),
-            ),
-            min(_MIN_TABLE_BUCKET, _pow2(self.max_table_width)),
-        )
+        Wb = self._table_bucket([it.seq for it in items])
         bs = self.cfg.block_size
 
         tokens = np.zeros((Bb, Tb), np.int32)
@@ -813,15 +937,21 @@ class ModelRunner:
             "seeds": seeds,
         }
         if self.cfg.enable_lora:
-            lora_idx = np.zeros(B, np.int32)
-            lora_scale = np.zeros(B, np.float32)
-            for i, s in enumerate(seqs):
-                lora_idx[i] = getattr(s, "lora_idx", 0)
-                lora_scale[i] = getattr(s, "lora_scale", 0.0)
-            out["lora_idx"] = lora_idx
-            out["lora_scale"] = lora_scale
+            out.update(self._lora_arrays(seqs, B))
         if any(s.sampling.has_penalties for s in seqs):
             out.update(self._penalty_arrays(seqs, B))
+        if any(s.sampling.logit_bias for s in seqs):
+            V = self.model_cfg.vocab_size  # pad id: dropped by the scatter
+            Nb = _pow2(max(max(len(s.sampling.logit_bias) for s in seqs), 1))
+            bias_ids = np.full((B, Nb), V, np.int32)
+            bias_vals = np.zeros((B, Nb), np.float32)
+            for i, s in enumerate(seqs):
+                for j, (tid, bv) in enumerate(s.sampling.logit_bias[:Nb]):
+                    if 0 <= tid < V:
+                        bias_ids[i, j] = tid
+                        bias_vals[i, j] = bv
+            out["bias_ids"] = bias_ids
+            out["bias_vals"] = bias_vals
         return out
 
     def _penalty_arrays(

@@ -32,6 +32,9 @@ class SamplingParams:
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
     logprobs: Optional[int] = None
+    # OpenAI logit_bias: additive per-token-id logit offsets, applied before
+    # sampling (and before greedy argmax).
+    logit_bias: Tuple[Tuple[int, float], ...] = ()
 
     @property
     def greedy(self) -> bool:

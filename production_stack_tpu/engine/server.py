@@ -113,6 +113,14 @@ class EngineMetrics:
             (0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64),
         )
         self.success = counter("vllm:request_success_total", "finished requests")
+        self.spec_draft = gauge(
+            "vllm:spec_decode_num_draft_tokens_total",
+            "speculative draft tokens proposed",
+        )
+        self.spec_accepted = gauge(
+            "vllm:spec_decode_num_accepted_tokens_total",
+            "speculative draft tokens accepted",
+        )
 
     def refresh(self, stats: dict) -> None:
         self.running.set(stats["num_requests_running"])
@@ -122,6 +130,21 @@ class EngineMetrics:
         self.hit_rate.set(stats["prefix_cache_hit_rate"])
         self.hits.set(stats["prefix_cache_hits_total"])
         self.queries.set(stats["prefix_cache_queries_total"])
+        self.spec_draft.set(stats.get("spec_decode_num_draft_tokens_total", 0))
+        self.spec_accepted.set(
+            stats.get("spec_decode_num_accepted_tokens_total", 0)
+        )
+
+
+def _parse_logit_bias(raw) -> tuple:
+    """OpenAI logit_bias keys are stringified token ids; a non-numeric key
+    must surface as a 400, not a 500 (callers catch ValueError)."""
+    if not raw:
+        return ()
+    try:
+        return tuple((int(k), float(v)) for k, v in raw.items())
+    except (TypeError, ValueError):
+        raise ValueError("logit_bias keys must be integer token ids")
 
 
 def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
@@ -151,6 +174,7 @@ def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
         frequency_penalty=req.frequency_penalty,
         repetition_penalty=req.repetition_penalty,
         logprobs=int(lp) if lp is not None else None,
+        logit_bias=_parse_logit_bias(getattr(req, "logit_bias", None)),
     )
 
 
@@ -311,7 +335,10 @@ def create_engine_app(
             if len(ids) >= max_len:
                 return {"error": f"prompt has {len(ids)} tokens (max {max_len})",
                         "ids": ids}
-            sampling = build_sampling(req, max_len, len(ids))
+            try:
+                sampling = build_sampling(req, max_len, len(ids))
+            except ValueError as e:
+                return {"error": str(e), "ids": ids}
             parts, n_out, finish = [], 0, None
             async for out in engine.generate(prompt_token_ids=ids, sampling=sampling):
                 parts.append(out.text_delta)
@@ -368,7 +395,10 @@ def create_engine_app(
             return _error(
                 f"prompt has {len(ids)} tokens, exceeds max_model_len={max_len}"
             )
-        sampling = build_sampling(req, max_len, len(ids))
+        try:
+            sampling = build_sampling(req, max_len, len(ids))
+        except ValueError as e:
+            return _error(str(e))
         rid = random_id("chatcmpl" if is_chat else "cmpl")
         created = int(time.time())
         start = time.time()
@@ -857,6 +887,11 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     # Decode burst + batch-shape floors.
     p.add_argument("--num-decode-steps", type=int, default=1)
     p.add_argument("--min-decode-bucket", type=int, default=1)
+    # Speculative decoding (n-gram prompt lookup; 0 = off).
+    p.add_argument("--speculative-ngram", type=int, default=0,
+                   help="max draft tokens per step via n-gram prompt lookup")
+    p.add_argument("--ngram-min", type=int, default=1)
+    p.add_argument("--ngram-max", type=int, default=3)
     # KV tiering / controller (LMCache env-var analogues).
     p.add_argument("--cpu-offload-blocks", type=int, default=0)
     p.add_argument("--remote-kv-url", default=None)
@@ -896,6 +931,9 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         lora_dir=args.lora_dir,
         num_decode_steps=args.num_decode_steps,
         min_decode_bucket=args.min_decode_bucket,
+        speculative_ngram=args.speculative_ngram,
+        ngram_min=args.ngram_min,
+        ngram_max=args.ngram_max,
         cpu_offload_blocks=args.cpu_offload_blocks,
         remote_kv_url=args.remote_kv_url,
         cache_controller_url=args.cache_controller_url,

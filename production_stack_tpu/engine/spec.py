@@ -1,0 +1,71 @@
+"""Speculative decoding: n-gram prompt-lookup drafting.
+
+The TPU-native analogue of vLLM's ``[ngram]`` speculative model (which the
+reference stack passes through to its engines via ``extraArgs``,
+``helm/values.yaml:81``): no draft model — draft tokens are proposed by
+matching the sequence's own recent suffix against its history (prompt +
+generated text). Multi-round-QA-style workloads re-quote their history
+constantly, so lookup drafts hit often; the target model then scores all K
+drafts in ONE forward pass (``all_logits``) instead of K sequential decode
+steps.
+
+Exactness: the engine engages speculation only for greedy (temperature=0)
+batches and accepts a draft prefix exactly as long as it matches the
+model's own argmax at every position — output token-for-token identical to
+non-speculative decoding. The paged KV design makes rollback free: rejected
+positions' cache writes sit past the committed ``kv_len`` and are
+overwritten when those positions are decoded for real.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def propose_ngram(
+    token_ids: List[int],
+    k: int,
+    min_n: int = 1,
+    max_n: int = 3,
+) -> Optional[List[int]]:
+    """Draft up to ``k`` tokens by prompt lookup.
+
+    Finds the longest n-gram (``max_n`` down to ``min_n``) such that the
+    sequence's last n tokens also occur earlier in the sequence; drafts the
+    tokens that followed the MOST RECENT earlier occurrence. None if no
+    n-gram recurs (the caller falls back to plain decoding).
+    """
+    L = len(token_ids)
+    if L < min_n + 1 or k <= 0:
+        return None
+    a = np.asarray(token_ids, np.int64)
+    for n in range(min(max_n, L - 1), min_n - 1, -1):
+        suf = a[-n:]
+        # Match windows a[s : s+n] for starts s in [0, L-n) — vectorized
+        # per-offset equality. The suffix itself (start L-n) lies past the
+        # range, so every candidate is a genuine earlier (possibly
+        # overlapping) occurrence.
+        ok = np.ones(L - n, bool)
+        for t in range(n):
+            ok &= a[t : L - n + t] == suf[t]
+        starts = np.flatnonzero(ok)
+        if starts.size:
+            s = int(starts[-1])  # most recent occurrence
+            cont = a[s + n : s + n + k]
+            if cont.size:
+                return cont.astype(np.int64).tolist()
+    return None
+
+
+def count_accepted(draft: List[int], argmax_ids: np.ndarray) -> int:
+    """Accepted draft prefix length: position j's draft survives iff it
+    equals the model's argmax at position j-1 AND every earlier draft
+    survived. ``argmax_ids`` is the verify step's [K+1] argmax row."""
+    a = 0
+    for j, d in enumerate(draft):
+        if int(argmax_ids[j]) != int(d):
+            break
+        a += 1
+    return a

@@ -121,6 +121,20 @@ class LlamaConfig:
     # ``num_local_experts`` / ``num_experts_per_tok``). 0 experts = dense.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Gemma-family architecture knobs (all default to the Llama conventions).
+    hidden_act: str = "silu"  # silu | gelu_tanh (Gemma GeGLU)
+    norm_unit_offset: bool = False  # RMSNorm weight is (1 + w) (Gemma)
+    embed_scale: bool = False  # scale embeddings by sqrt(D) (Gemma)
+    query_pre_attn_scalar: float = 0.0  # attn scale override (Gemma-2; 0=hd)
+    attn_logit_softcap: float = 0.0  # tanh cap on attention logits (Gemma-2)
+    final_logit_softcap: float = 0.0  # tanh cap on LM-head logits (Gemma-2)
+    post_block_norms: bool = False  # Gemma-2 post-attn / post-mlp RMSNorms
+    # Sliding-window (local) attention: each query sees at most the last
+    # `sliding_window` positions (Mistral-v0.1, Gemma-2). With
+    # `sliding_window_pattern` = N > 1, every Nth layer (li+1 ≡ 0 mod N) is
+    # global and the rest are local (Gemma-2: N=2); 1 = all layers local.
+    sliding_window: int = 0
+    sliding_window_pattern: int = 1
     dtype: str = "bfloat16"
     # Serving identity / tokenizer hints (not part of the math).
     name: str = "llama"
@@ -130,6 +144,11 @@ class LlamaConfig:
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def attn_scale(self) -> float:
+        base = self.query_pre_attn_scalar or self.head_dim
+        return 1.0 / math.sqrt(base)
 
     @property
     def q_size(self) -> int:
@@ -197,6 +216,9 @@ class Llama:
             params["layers"]["bq"] = jnp.zeros((L, cfg.q_size), d)
             params["layers"]["bk"] = jnp.zeros((L, cfg.kv_size), d)
             params["layers"]["bv"] = jnp.zeros((L, cfg.kv_size), d)
+        if cfg.post_block_norms:
+            params["layers"]["post_attn_norm"] = jnp.ones((L, D), d)
+            params["layers"]["post_mlp_norm"] = jnp.ones((L, D), d)
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(k[0], (cfg.vocab_size, D), D)
         return params
@@ -244,6 +266,9 @@ class Llama:
             specs["layers"]["bq"] = P(pp, AXIS_TENSOR)
             specs["layers"]["bk"] = P(pp, AXIS_TENSOR)
             specs["layers"]["bv"] = P(pp, AXIS_TENSOR)
+        if self.cfg.post_block_norms:
+            specs["layers"]["post_attn_norm"] = P(pp, None)
+            specs["layers"]["post_mlp_norm"] = P(pp, None)
         if not self.cfg.tie_word_embeddings:
             specs["lm_head"] = P(None, AXIS_TENSOR)
         return specs
@@ -342,8 +367,12 @@ class Llama:
         moe_impl: str = "auto",
         pp_size: int = 1,
         mesh=None,
+        all_logits: bool = False,
     ) -> Tuple[jax.Array, jax.Array]:
-        """One engine step. Returns (last-token logits [B, V], new cache).
+        """One engine step. Returns (last-token logits [B, V], new cache) —
+        or ([B, T, V] logits for every position when ``all_logits`` (the
+        speculative-decoding verify step scores each draft position in one
+        pass; ``last_idx`` is ignored).
 
         With ``pp_size > 1`` the stacked layer axis (params and cache) is
         sharded over the ``pp`` mesh axis and composed via
@@ -352,9 +381,14 @@ class Llama:
         cfg = self.cfg
         B, T = tokens.shape
         nb, bs = kv_cache.shape[1], kv_cache.shape[3]
-        scale = 1.0 / math.sqrt(cfg.head_dim)
+        scale = cfg.attn_scale
+        offset = cfg.norm_unit_offset
 
         x = params["embed"][tokens]  # [B, T, D]
+        if cfg.embed_scale:
+            # HF-Gemma convention: the sqrt(D) normalizer is rounded to the
+            # model dtype before multiplying.
+            x = x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
         rope_cos, rope_sin = _rope_tables(positions, cfg)
         flat_write_real = write_idx.reshape(-1)  # [B*T]
         has_lora = "lora_a_wq" in params["layers"]
@@ -376,7 +410,7 @@ class Llama:
             )
             return d * lora_scale[:, None, None]
 
-        def layer_fn(ctx, x, kv_all, lp, li):
+        def layer_fn(ctx, x, kv_all, lp, li, li_global):
             # ctx: traced arrays shared by every layer. Threaded explicitly
             # (not closed over) so the pp shard_map can pass them through.
             # kv_all: the FULL stacked cache [L, nb, 2, bs, KH*hd]; li is
@@ -386,7 +420,7 @@ class Llama:
             # carried buffer updates in place (a per-layer slice/update pair
             # would copy the whole layer cache twice per layer per step).
             flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
-            h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
             q = _proj(h, lp["wq"], lp.get("bq"))
             k = _proj(h, lp["wk"], lp.get("bk"))
             v = _proj(h, lp["wv"], lp.get("bv"))
@@ -433,6 +467,10 @@ class Llama:
             attn = paged_attention(
                 q, kv_all, block_tables, kv_lens, positions, li,
                 scale=scale, impl=attn_impl,
+                # Window pattern keys off the GLOBAL layer index (under pp,
+                # li is the stage-local cache index).
+                window=_layer_window(cfg, li_global),
+                softcap=cfg.attn_logit_softcap,
             )
             attn = attn.reshape(B, T, cfg.q_size)
             o = jnp.einsum(
@@ -441,23 +479,30 @@ class Llama:
             )
             if has_lora:
                 o = o + lora_delta(lp, "wo", attn.astype(lp["wo"].dtype))
-            x = x + o.astype(x.dtype)
+            o = o.astype(x.dtype)
+            if cfg.post_block_norms:  # Gemma-2 post-attention norm
+                o = _rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps, offset)
+            x = x + o
 
-            h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
+            h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset)
+            ff = _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
+            if cfg.post_block_norms:  # Gemma-2 post-feedforward norm
+                ff = _rms_norm(ff, lp["post_mlp_norm"], cfg.rms_norm_eps, offset)
+            x = x + ff
             return x, kv_all
 
-        def scan_layers(ctx, x, kv_all, layers, n_layers):
+        def scan_layers(ctx, x, kv_all, layers, n_layers, li_base=0):
             # The cache rides the scan CARRY — carried while-loop buffers
             # alias across iterations, so peak HBM holds ONE cache. (As scan
             # xs/ys the stacked outputs would be a second full-size
             # allocation: at the 32k-context bench config that is +11 GiB
             # and an instant OOM.) The body never slices the cache; see
-            # layer_fn.
+            # layer_fn. ``li_base`` is the stage's global layer offset
+            # (nonzero under pp, where the scan index is stage-local).
             def body(carry, sl):
                 x, kv_all = carry
                 lp, i = sl
-                x, kv_all = layer_fn(ctx, x, kv_all, lp, i)
+                x, kv_all = layer_fn(ctx, x, kv_all, lp, i, li_base + i)
                 return (x, kv_all), None
 
             (x, kv_all), _ = jax.lax.scan(
@@ -476,9 +521,10 @@ class Llama:
                 # write KV; others write to the dropped slot (nb*bs).
                 fw = jnp.where(gate, fw, nb * bs)
                 layers_local, kv_local = scanned_local
+                n_local = cfg.num_layers // pp_size
                 x, kv_local = scan_layers(
-                    (fw, *rest), x, kv_local, layers_local,
-                    cfg.num_layers // pp_size,
+                    (fw, *rest), x, kv_local, layers_local, n_local,
+                    li_base=jax.lax.axis_index(AXIS_PIPELINE) * n_local,
                 )
                 return x, (layers_local, kv_local)
 
@@ -491,12 +537,18 @@ class Llama:
                 ctx, x, kv_cache, params["layers"], cfg.num_layers
             )
 
-        x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-        last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
+        x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps, offset)
         unembed = params.get("lm_head", params["embed"])  # [V, D]
-        logits = jnp.einsum(
-            "bd,vd->bv", last, unembed, preferred_element_type=jnp.float32
-        )
+        if all_logits:
+            logits = jnp.einsum(
+                "btd,vd->btv", x, unembed, preferred_element_type=jnp.float32
+            )
+        else:
+            last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+            logits = jnp.einsum(
+                "bd,vd->bv", last, unembed, preferred_element_type=jnp.float32
+            )
+        logits = _softcap(logits, cfg.final_logit_softcap)
         return logits, kv_cache
 
     def encode(
@@ -525,8 +577,16 @@ class Llama:
         use_ring = sp_size > 1 and mesh is not None
         if use_ring and pp_size > 1:
             raise ValueError("ring (sp) encode does not compose with pp yet")
+        if use_ring and (cfg.sliding_window or cfg.attn_logit_softcap):
+            raise ValueError(
+                "ring (sp) encode does not support sliding-window/"
+                "softcap models yet"
+            )
+        offset = cfg.norm_unit_offset
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
         x = params["embed"][tokens]
+        if cfg.embed_scale:
+            x = x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
         rope_cos, rope_sin = _rope_tables(positions, cfg)
         valid = positions < lengths[:, None]  # [B, T]
         if use_ring:
@@ -537,9 +597,9 @@ class Llama:
             ) & valid[:, None, :]  # [B, T, S]
         G = cfg.num_heads // cfg.num_kv_heads
 
-        def layer(ctx, x, lp):
+        def layer(ctx, x, lp, li):
             rope_cos, rope_sin, causal = ctx
-            h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
             q = _proj(h, lp["wq"], lp.get("bq")).reshape(
                 B, T, cfg.num_kv_heads, G, cfg.head_dim
             )
@@ -558,33 +618,52 @@ class Llama:
 
                 attn = ring_self_attention(
                     q, k, v, lengths, mesh,
-                    scale=1.0 / math.sqrt(cfg.head_dim),
+                    scale=cfg.attn_scale,
                 ).reshape(B, T, cfg.q_size).astype(x.dtype)
             else:
                 qg = q.reshape(B, T, cfg.num_kv_heads, G, cfg.head_dim)
                 scores = jnp.einsum(
                     "btkgd,bskd->bkgts", qg, k,
                     preferred_element_type=jnp.float32,
-                ) / math.sqrt(cfg.head_dim)
-                scores = jnp.where(causal[:, None, None], scores, -1e30)
+                ) * cfg.attn_scale
+                scores = _softcap(scores, cfg.attn_logit_softcap)
+                mask = causal
+                if cfg.sliding_window:
+                    win = _layer_window(cfg, li)
+                    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
+                    mask = mask & (
+                        positions[:, None, :] > positions[:, :, None] - win_eff
+                    )
+                scores = jnp.where(mask[:, None, None], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
                 attn = jnp.einsum(
                     "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
                     preferred_element_type=jnp.float32,
                 ).reshape(B, T, cfg.q_size).astype(x.dtype)
-            x = x + jnp.einsum(
+            o = jnp.einsum(
                 "btq,qd->btd", attn, lp["wo"], preferred_element_type=jnp.float32
             ).astype(x.dtype)
-            h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
+            if cfg.post_block_norms:
+                o = _rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps, offset)
+            x = x + o
+            h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset)
+            ff = _mlp(cfg, lp, h, moe_impl).astype(x.dtype)
+            if cfg.post_block_norms:
+                ff = _rms_norm(ff, lp["post_mlp_norm"], cfg.rms_norm_eps, offset)
+            x = x + ff
             return x, None
 
         ctx = (rope_cos, rope_sin, causal)
         if pp_size > 1:
+            n_local = cfg.num_layers // pp_size
+
             def run_stage(x, repl, scanned_local, gate):
                 (layers_local,) = scanned_local
+                base = jax.lax.axis_index(AXIS_PIPELINE) * n_local
                 x, _ = jax.lax.scan(
-                    lambda c, s: layer(repl, c, s), x, layers_local
+                    lambda c, s: layer(repl, c, s[0], base + s[1]),
+                    x,
+                    (layers_local, jnp.arange(n_local, dtype=jnp.int32)),
                 )
                 return x, (layers_local,)
 
@@ -592,8 +671,15 @@ class Llama:
                 run_stage, x, ctx, (params["layers"],), pp_size, mesh
             )
         else:
-            x, _ = jax.lax.scan(lambda c, s: layer(ctx, c, s), x, params["layers"])
-        x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+            x, _ = jax.lax.scan(
+                lambda c, s: layer(ctx, c, s[0], s[1]),
+                x,
+                (
+                    params["layers"],
+                    jnp.arange(cfg.num_layers, dtype=jnp.int32),
+                ),
+            )
+        x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps, offset)
         mask = valid[..., None].astype(jnp.float32)
         pooled = (x.astype(jnp.float32) * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
         norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
@@ -605,20 +691,52 @@ class Llama:
 # ----------------------------------------------------------------------------
 
 
-def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def _rms_norm(
+    x: jax.Array, w: jax.Array, eps: float, unit_offset: bool = False
+) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return ((xf * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w
+    normed = xf * jax.lax.rsqrt(var + eps)
+    if unit_offset:  # Gemma stores w with effective weight (1 + w), fp32 math
+        return (normed * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+    return normed.astype(x.dtype) * w
+
+
+def _act(cfg: "LlamaConfig"):
+    if cfg.hidden_act == "gelu_tanh":  # Gemma GeGLU
+        return lambda v: jax.nn.gelu(v, approximate=True)
+    if cfg.hidden_act != "silu":
+        raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
+    return jax.nn.silu
+
+
+def _layer_window(cfg: "LlamaConfig", li) -> jax.Array:
+    """Sliding window for (traced) layer index ``li``: 0 = global."""
+    if not cfg.sliding_window:
+        return jnp.int32(0)
+    pat = cfg.sliding_window_pattern
+    if pat <= 1:
+        return jnp.int32(cfg.sliding_window)
+    return jnp.where(
+        (jnp.asarray(li, jnp.int32) + 1) % pat == 0,
+        jnp.int32(0),
+        jnp.int32(cfg.sliding_window),
+    )
+
+
+def _softcap(logits: jax.Array, cap: float) -> jax.Array:
+    return jnp.tanh(logits / cap) * cap if cap else logits
 
 
 def _mlp(cfg: "LlamaConfig", lp: Params, h: jax.Array, moe_impl: str = "auto") -> jax.Array:
     """SwiGLU MLP block output [B, T, D] in fp32 — dense, or Mixtral-style
     sparse mixture-of-experts when ``cfg.num_experts``."""
+    act = _act(cfg)
     if not cfg.num_experts:
         gate = _proj(h, lp["w_gate"])
         up = _proj(h, lp["w_up"])
         ff = (
-            jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)
+            act(gate.astype(jnp.float32)) * up.astype(jnp.float32)
         ).astype(lp["w_down"].dtype)
         return jnp.einsum(
             "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
@@ -669,7 +787,7 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
         u = jax.lax.ragged_dot(
             xs, lp["w_up"], group_sizes, preferred_element_type=jnp.float32
         )
-        hh = (jax.nn.silu(g) * u).astype(lp["w_down"].dtype)
+        hh = (_act(cfg)(g) * u).astype(lp["w_down"].dtype)
         y = jax.lax.ragged_dot(
             hh, lp["w_down"], group_sizes, preferred_element_type=jnp.float32
         )  # [N*K, D]
@@ -687,7 +805,7 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
     u = jnp.einsum(
         "nd,edf->enf", x, lp["w_up"], preferred_element_type=jnp.float32
     )
-    hh = (jax.nn.silu(g) * u).astype(lp["w_down"].dtype)
+    hh = (_act(cfg)(g) * u).astype(lp["w_down"].dtype)
     y = jnp.einsum(
         "enf,efd->end", hh, lp["w_down"], preferred_element_type=jnp.float32
     )
@@ -815,6 +933,13 @@ def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
         params["lm_head"] = cast(raw.pop("lm_head.weight"))
 
     layer_map = dict(_HF_LAYER_MAP)
+    if cfg.post_block_norms:
+        # Gemma-2 norm layout: post_attention_layernorm is the POST-attn
+        # norm (not the MLP pre-norm as in Llama), and the MLP has its own
+        # pre/post pair.
+        layer_map["post_attention_layernorm"] = "post_attn_norm"
+        layer_map["pre_feedforward_layernorm"] = "mlp_norm"
+        layer_map["post_feedforward_layernorm"] = "post_mlp_norm"
     if cfg.num_experts:
         # Mixtral: per-expert w1/w3/w2 (gate/up/down) + the router. Experts
         # are stacked on axis 0 of each layer to form the bank the grouped
@@ -866,11 +991,21 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
     with open(config_path) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2", "mixtral"):
-        raise ValueError(f"unsupported model_type {mt!r} (llama-family only)")
+    if mt not in ("llama", "mistral", "qwen2", "mixtral", "gemma", "gemma2"):
+        raise ValueError(
+            f"unsupported model_type {mt!r} "
+            "(llama/mistral/qwen2/mixtral/gemma/gemma2)"
+        )
     eos = hf.get("eos_token_id", 2)
     eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
     heads = hf["num_attention_heads"]
+    gemma = mt in ("gemma", "gemma2")
+    act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
+    act = "gelu_tanh" if act.startswith("gelu") else act
+    # Sliding window: Mistral v0.1 (all layers), Gemma-2 (alternating).
+    sliding = int(hf.get("sliding_window") or 0)
+    if mt not in ("mistral", "gemma2"):
+        sliding = 0
     # Llama-3.1-style rope scaling. "linear"/"dynamic" variants are not
     # implemented — refuse loudly rather than serve wrong long-context math.
     rs = hf.get("rope_scaling") or {}
@@ -899,10 +1034,22 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         max_position_embeddings=hf.get("max_position_embeddings", 4096),
-        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        tie_word_embeddings=hf.get("tie_word_embeddings", gemma),
         attention_bias=mt == "qwen2" or hf.get("attention_bias", False),
         num_experts=hf.get("num_local_experts", 0) if mt == "mixtral" else 0,
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        hidden_act=act,
+        norm_unit_offset=gemma,
+        embed_scale=gemma,
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar", 0.0))
+        if mt == "gemma2" else 0.0,
+        attn_logit_softcap=float(hf.get("attn_logit_softcapping") or 0.0)
+        if mt == "gemma2" else 0.0,
+        final_logit_softcap=float(hf.get("final_logit_softcapping") or 0.0)
+        if mt == "gemma2" else 0.0,
+        post_block_norms=mt == "gemma2",
+        sliding_window=sliding,
+        sliding_window_pattern=2 if mt == "gemma2" else 1,
         name=name or hf.get("_name_or_path", mt),
         eos_token_ids=eos_ids,
         bos_token_id=hf.get("bos_token_id"),

@@ -121,6 +121,94 @@ PRESETS: Dict[str, LlamaConfig] = {
         name="mixtral-8x7b",
         eos_token_ids=(2,),
     ),
+    # Tiny Gemma-1-style debug model (GeGLU, (1+w) norms, scaled embeddings,
+    # tied head).
+    "tiny-gemma-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=16,
+        max_position_embeddings=2048,
+        hidden_act="gelu_tanh",
+        norm_unit_offset=True,
+        embed_scale=True,
+        tie_word_embeddings=True,
+        name="tiny-gemma-debug",
+        eos_token_ids=(0,),
+        bos_token_id=None,
+        dtype="float32",
+    ),
+    # Tiny Gemma-2-style debug model (adds logit softcaps, post-block norms,
+    # alternating sliding-window layers).
+    "tiny-gemma2-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=16,
+        max_position_embeddings=2048,
+        hidden_act="gelu_tanh",
+        norm_unit_offset=True,
+        embed_scale=True,
+        tie_word_embeddings=True,
+        query_pre_attn_scalar=32.0,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        post_block_norms=True,
+        sliding_window=16,
+        sliding_window_pattern=2,
+        name="tiny-gemma2-debug",
+        eos_token_ids=(0,),
+        bos_token_id=None,
+        dtype="float32",
+    ),
+    "gemma-7b": LlamaConfig(
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=256,
+        rope_theta=10000.0,
+        max_position_embeddings=8192,
+        hidden_act="gelu_tanh",
+        norm_unit_offset=True,
+        embed_scale=True,
+        tie_word_embeddings=True,
+        name="gemma-7b",
+        eos_token_ids=(1,),
+        bos_token_id=2,
+    ),
+    "gemma2-9b": LlamaConfig(
+        vocab_size=256000,
+        hidden_size=3584,
+        intermediate_size=14336,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        rope_theta=10000.0,
+        max_position_embeddings=8192,
+        hidden_act="gelu_tanh",
+        norm_unit_offset=True,
+        embed_scale=True,
+        tie_word_embeddings=True,
+        query_pre_attn_scalar=256.0,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        post_block_norms=True,
+        sliding_window=4096,
+        sliding_window_pattern=2,
+        name="gemma2-9b",
+        eos_token_ids=(1,),
+        bos_token_id=2,
+    ),
     "qwen2-7b": LlamaConfig(
         vocab_size=152064,
         hidden_size=3584,

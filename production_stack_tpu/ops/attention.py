@@ -60,8 +60,16 @@ def paged_attention(
     *,
     scale: float,
     impl: str = "auto",
+    window=0,
+    softcap: float = 0.0,
 ) -> jax.Array:
-    """Causal attention of ``q`` against paged KV. Returns [B, T, H, hd]."""
+    """Causal attention of ``q`` against paged KV. Returns [B, T, H, hd].
+
+    ``window`` (int32 scalar, may be traced — e.g. derived from the layer
+    index for Gemma-2's alternating local/global layers) limits each query
+    to the last ``window`` positions; 0 = unlimited. ``softcap`` applies
+    Gemma-style attention-logit soft-capping ``tanh(s/c)*c`` (static; 0 =
+    off)."""
     if impl == "auto":
         impl = "pallas" if _use_pallas() else "gather"
     if impl == "pallas":
@@ -69,10 +77,11 @@ def paged_attention(
 
         return pallas_paged_attention(
             q, kv_pages, block_tables, kv_lens, q_positions, layer,
-            scale=scale,
+            scale=scale, window=window, softcap=softcap,
         )
     return gather_paged_attention(
-        q, kv_pages, block_tables, kv_lens, q_positions, layer, scale=scale
+        q, kv_pages, block_tables, kv_lens, q_positions, layer, scale=scale,
+        window=window, softcap=softcap,
     )
 
 
@@ -85,6 +94,8 @@ def gather_paged_attention(
     layer=0,
     *,
     scale: float,
+    window=0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     B, T, H, hd = q.shape
     _, nb, _, bs, lanes = kv_pages.shape
@@ -107,11 +118,18 @@ def gather_paged_attention(
         "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores * scale
+    if softcap:
+        scores = jnp.tanh(scores / softcap) * softcap
 
     kv_pos = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
     valid = kv_pos < kv_lens[:, None]  # [B, S]
     causal = kv_pos[:, None, :] <= q_positions[..., None]  # [B, T, S]
-    mask = (valid[:, None, :] & causal)[:, None, None]  # [B, 1, 1, T, S]
+    # Sliding window: each query sees at most the last `window` positions
+    # (0 = unlimited; `window` may be a traced scalar for per-layer windows).
+    win = jnp.asarray(window, jnp.int32)
+    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
+    in_window = kv_pos[:, None, :] > q_positions[..., None] - win_eff
+    mask = (valid[:, None, :] & causal & in_window)[:, None, None]
     scores = jnp.where(mask, scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)

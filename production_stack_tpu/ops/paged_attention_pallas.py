@@ -74,7 +74,7 @@ def _page_dma_loop(
     *,
     b,  # batch index (program id)
     layer,  # int32 layer index into the stacked cache
-    n_chunks,  # traced: chunks of C pages to stream
+    n_chunks,  # traced: chunks of C pages to stream (exclusive end)
     tables_ref,  # [B, W] SMEM
     kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     buf,  # [2, C, 2, bs, KH*hd] VMEM scratch
@@ -82,9 +82,12 @@ def _page_dma_loop(
     chunk: int,
     table_width: int,
     compute_chunk,  # (page [C, 2, bs, KH*hd], chunk_index) -> None
+    c_start=0,  # traced: first live chunk (sliding window skips below it)
 ):
     """Double-buffered page streaming shared by decode and prefill: chunk
-    ``c+1``'s DMAs are in flight while ``compute_chunk`` folds chunk ``c``."""
+    ``c+1``'s DMAs are in flight while ``compute_chunk`` folds chunk ``c``.
+    Chunks below ``c_start`` (entirely outside a sliding window) are neither
+    fetched nor folded."""
     C, W = chunk, table_width
 
     def dma(c, j, slot):
@@ -96,10 +99,10 @@ def _page_dma_loop(
             kv_hbm.at[layer, page], buf.at[slot, j], sems.at[slot, j]
         )
 
-    @pl.when(n_chunks > 0)
+    @pl.when(n_chunks > c_start)
     def _warmup():
         for j in range(C):
-            dma(0, j, 0).start()
+            dma(c_start, j, jax.lax.rem(c_start, 2)).start()
 
     def body(c, _):
         slot = jax.lax.rem(c, 2)
@@ -115,7 +118,7 @@ def _page_dma_loop(
         compute_chunk(buf[slot], c)
         return 0
 
-    jax.lax.fori_loop(0, n_chunks, body, 0)
+    jax.lax.fori_loop(c_start, n_chunks, body, 0)
 
 
 def _chunked_flash(
@@ -131,6 +134,9 @@ def _chunked_flash(
     chunk: int,
     table_width: int,
     head_dim: int,
+    lows=None,  # [R, 1] inclusive per-row lower bound (sliding window)
+    softcap: float = 0.0,
+    c_start=0,  # traced: first chunk any row's window reaches
 ):
     """Per-head flash accumulation over streamed KV chunks (the prefill
     shape: R = Tq*G rows per head keep the MXU busy per head). Matmuls run
@@ -153,7 +159,12 @@ def _chunked_flash(
                 q_heads[h], kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [R, S] fp32
-            s = jnp.where(col < bounds, s, _NEG_INF)
+            if softcap:
+                s = jnp.tanh(s / softcap) * softcap
+            live = col < bounds
+            if lows is not None:
+                live = live & (col >= lows)
+            s = jnp.where(live, s, _NEG_INF)
             m_prev = m_ref[h, :, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -170,12 +181,12 @@ def _chunked_flash(
     _page_dma_loop(
         b=b, layer=layer, n_chunks=n_chunks, tables_ref=tables_ref,
         kv_hbm=kv_hbm, buf=buf, sems=sems, chunk=chunk,
-        table_width=table_width, compute_chunk=compute,
+        table_width=table_width, compute_chunk=compute, c_start=c_start,
     )
 
 
 def _decode_kernel(
-    tables_ref, lens_ref, layer_ref,  # scalar prefetch (SMEM)
+    tables_ref, lens_ref, layer_ref, win_ref,  # scalar prefetch (SMEM)
     q_ref,  # [1, H, hd] VMEM
     kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     o_ref,  # [1, H, hd] VMEM
@@ -187,6 +198,7 @@ def _decode_kernel(
     table_width: int,
     group: int,
     head_dim: int,
+    softcap: float = 0.0,
 ):
     """Dense folded-q decode: per-head [G, hd] x [hd, S] mat-vecs waste the
     MXU (G of 128 rows live) and burn VPU on per-head slices, so instead q
@@ -204,6 +216,13 @@ def _decode_kernel(
     KH = H // G
     kv_len = lens_ref[b]
     n_chunks = (kv_len + chunk * block_size - 1) // (chunk * block_size)
+    # Sliding window (0 = unlimited): the one query row sits at position
+    # kv_len-1 and may see positions >= kv_len - window; whole chunks below
+    # that are never fetched.
+    win = win_ref[0]
+    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
+    lo = jnp.maximum(kv_len - win_eff, 0)
+    c_start = lo // (chunk * block_size)
 
     q = q_ref[0]  # [H, hd] native dtype
     # Arithmetic 0/1 mask (born 3D): Mosaic cannot minor-dim-reshape or
@@ -229,7 +248,9 @@ def _decode_kernel(
             q_sparse, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [H, S] fp32
-        s = jnp.where(col < kv_len, s, _NEG_INF)
+        if softcap:
+            s = jnp.tanh(s / softcap) * softcap
+        s = jnp.where((col >= lo) & (col < kv_len), s, _NEG_INF)
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -246,14 +267,14 @@ def _decode_kernel(
     _page_dma_loop(
         b=b, layer=layer_ref[0], n_chunks=n_chunks, tables_ref=tables_ref,
         kv_hbm=kv_hbm, buf=buf, sems=sems, chunk=chunk,
-        table_width=table_width, compute_chunk=compute,
+        table_width=table_width, compute_chunk=compute, c_start=c_start,
     )
     out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-20)  # [H, hd]
     o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _prefill_kernel(
-    tables_ref, lens_ref, starts_ref, layer_ref,  # scalar prefetch (SMEM)
+    tables_ref, lens_ref, starts_ref, layer_ref, win_ref,  # scalar prefetch
     q_ref,  # [1, Tq, H, hd] VMEM
     kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY
     o_ref,  # [1, Tq, H, hd] VMEM
@@ -266,6 +287,7 @@ def _prefill_kernel(
     group: int,
     head_dim: int,
     q_tile: int,
+    softcap: float = 0.0,
 ):
     b = pl.program_id(0)
     tq = pl.program_id(1)
@@ -284,6 +306,14 @@ def _prefill_kernel(
     rows = jax.lax.broadcasted_iota(jnp.int32, (Tq * G, 1), 0)
     q_pos = start + tq * Tq + rows // G  # [Tq*G, 1]
     bounds = jnp.minimum(q_pos + 1, kv_len)
+    # Sliding window lower bounds; chunks below the tile's FIRST row's
+    # window start are outside every row's window and are never fetched.
+    win = win_ref[0]
+    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
+    lows = jnp.maximum(q_pos + 1 - win_eff, 0)  # [Tq*G, 1]
+    c_start = jnp.maximum(start + tq * Tq + 1 - win_eff, 0) // (
+        chunk * block_size
+    )
 
     qh = [
         q_ref[0, :, h * G : (h + 1) * G, :].reshape(Tq * G, head_dim)
@@ -307,6 +337,9 @@ def _prefill_kernel(
         chunk=chunk,
         table_width=table_width,
         head_dim=head_dim,
+        lows=lows,
+        softcap=softcap,
+        c_start=c_start,
     )
     # Padding rows (kv_len == 0) accumulated nothing: l stays 0 and the
     # output is 0, matching the drop-slot contract.
@@ -327,7 +360,8 @@ def _scratch(C, bs, lanes, R, KH, hd, kv_dtype):
     ]
 
 
-def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, *, scale):
+def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, window,
+                 *, scale, softcap):
     B, H, hd = q3.shape
     _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
@@ -336,13 +370,13 @@ def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, *, scale):
     C = _chunk_pages(bs, 1024)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, t, l, ly: (b, 0, 0)),
+            pl.BlockSpec((1, H, hd), lambda b, t, l, ly, w: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l, ly: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l, ly, w: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, C, 2, bs, lanes), kv_pages.dtype),
             pltpu.SemaphoreType.DMA((2, C)),
@@ -359,6 +393,7 @@ def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, *, scale):
         table_width=W,
         group=G,
         head_dim=hd,
+        softcap=softcap,
     )
     return pl.pallas_call(
         kernel,
@@ -369,11 +404,11 @@ def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, *, scale):
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=_interpret(),
-    )(block_tables, kv_lens, layer, q3, kv_pages)
+    )(block_tables, kv_lens, layer, window, q3, kv_pages)
 
 
-def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer,
-                  *, scale, q_tile):
+def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer, window,
+                  *, scale, q_tile, softcap):
     B, T, H, hd = q.shape
     _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
@@ -383,16 +418,16 @@ def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer,
     n_tiles = T // q_tile
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(B, n_tiles),
         in_specs=[
             pl.BlockSpec(
-                (1, q_tile, H, hd), lambda b, t, tt, l, s, ly: (b, t, 0, 0)
+                (1, q_tile, H, hd), lambda b, t, tt, l, s, ly, w: (b, t, 0, 0)
             ),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, q_tile, H, hd), lambda b, t, tt, l, s, ly: (b, t, 0, 0)
+            (1, q_tile, H, hd), lambda b, t, tt, l, s, ly, w: (b, t, 0, 0)
         ),
         scratch_shapes=_scratch(C, bs, lanes, q_tile * G, KH, hd, kv_pages.dtype),
     )
@@ -405,6 +440,7 @@ def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer,
         group=G,
         head_dim=hd,
         q_tile=q_tile,
+        softcap=softcap,
     )
     return pl.pallas_call(
         kernel,
@@ -417,7 +453,7 @@ def _prefill_call(q, kv_pages, block_tables, kv_lens, starts, layer,
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=_interpret(),
-    )(block_tables, kv_lens, starts, layer, q, kv_pages)
+    )(block_tables, kv_lens, starts, layer, window, q, kv_pages)
 
 
 def pallas_paged_attention(
@@ -429,14 +465,18 @@ def pallas_paged_attention(
     layer=0,  # int32 scalar (may be traced — e.g. the model's layer scan)
     *,
     scale: float,
+    window=0,  # int32 scalar sliding window (may be traced; 0 = unlimited)
+    softcap: float = 0.0,  # attention-logit soft cap (static; 0 = off)
 ) -> jax.Array:
     B, T, H, hd = q.shape
     tables = block_tables.astype(jnp.int32)
     lens = kv_lens.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
     if T == 1:
         out = _decode_call(
-            q[:, 0], kv_pages, tables, lens, layer_arr, scale=scale
+            q[:, 0], kv_pages, tables, lens, layer_arr, win_arr,
+            scale=scale, softcap=softcap,
         )
         return out[:, None]
 
@@ -451,10 +491,11 @@ def pallas_paged_attention(
         from .attention import gather_paged_attention
 
         return gather_paged_attention(
-            q, kv_pages, block_tables, kv_lens, q_positions, layer, scale=scale
+            q, kv_pages, block_tables, kv_lens, q_positions, layer,
+            scale=scale, window=window, softcap=softcap,
         )
     starts = q_positions[:, 0].astype(jnp.int32)
     return _prefill_call(
-        q, kv_pages, tables, lens, starts, layer_arr, scale=scale,
-        q_tile=q_tile,
+        q, kv_pages, tables, lens, starts, layer_arr, win_arr, scale=scale,
+        q_tile=q_tile, softcap=softcap,
     )

@@ -105,6 +105,17 @@ def unpack_sampled(packed) -> tuple:
     return tokens, chosen, top_lps, top_ids
 
 
+def apply_logit_bias(
+    logits: jax.Array,  # [B, V] float32
+    bias_ids: jax.Array,  # [B, Nb] int32, pad = V (dropped)
+    bias_vals: jax.Array,  # [B, Nb] float32
+) -> jax.Array:
+    """OpenAI ``logit_bias``: additive per-token offsets before sampling."""
+    B = logits.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    return logits.at[rows, bias_ids].add(bias_vals, mode="drop")
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V] float32
     prompt_tokens: jax.Array,  # [B, Pp] int32, pad = V (dropped)

@@ -104,6 +104,8 @@ class SamplingFields(_Permissive):
     seed: Optional[int] = None
     logprobs: Union[bool, int, None] = None
     top_logprobs: Optional[int] = None
+    # OpenAI logit_bias: {"<token_id>": bias in [-100, 100]}.
+    logit_bias: Optional[Dict[str, float]] = None
     ignore_eos: bool = False
     stream: bool = False
     stream_options: Optional[Dict[str, Any]] = None

@@ -179,3 +179,38 @@ async def test_best_of():
                   "max_tokens": 2, "best_of": "two"},
         ) as r:
             assert r.status == 200
+
+
+async def test_logit_bias_forces_and_bans_tokens():
+    """OpenAI logit_bias: +100 on a token forces it under greedy sampling;
+    -100 on the natural argmax bans it (the next-best token wins)."""
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        base = {
+            "model": "tiny-llama-debug", "prompt": "hello world",
+            "max_tokens": 3, "temperature": 0.0, "ignore_eos": True,
+        }
+        # Unbiased greedy tokens (via logprobs' top entries we get ids
+        # indirectly; simpler: run once and re-encode the text is lossy —
+        # instead force a known token and check the output ids via echo of
+        # a second biased run).
+        forced = 17
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(base, logit_bias={str(forced): 100.0}, logprobs=1),
+        ) as r:
+            assert r.status == 200
+            body = await r.json()
+        lp = body["choices"][0]["logprobs"]
+        n_out = body["usage"]["completion_tokens"]
+        # Every sampled step must have picked the forced token: the byte
+        # tokenizer maps id 17 -> chr(16); check the emitted text directly.
+        assert body["choices"][0]["text"] == chr(16) * n_out
+
+        # Ban that same token: it must never appear.
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(base, logit_bias={str(forced): -100.0}),
+        ) as r:
+            assert r.status == 200
+            banned = await r.json()
+        assert chr(16) not in banned["choices"][0]["text"]
