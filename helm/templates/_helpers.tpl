@@ -72,6 +72,10 @@ containers:
       - "--expert-parallel-size"
       - "{{ .expertParallelSize }}"
       {{- end }}
+      {{- if .scoringModel }}
+      - "--scoring-model"
+      - "{{ .scoringModel }}"
+      {{- end }}
       - "--block-size"
       - "{{ .blockSize | default 32 }}"
       - "--gpu-memory-utilization"

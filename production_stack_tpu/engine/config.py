@@ -72,6 +72,9 @@ class EngineConfig:
     speculative_ngram: int = 0
     ngram_min: int = 1  # shortest suffix n-gram to match
     ngram_max: int = 3  # longest suffix n-gram to match
+    # Cap the prompt-lookup scan to the last N tokens (0 = whole history).
+    # Bounds the per-step host-side draft cost at long context.
+    ngram_lookback: int = 8192
     # Pipelined decode: keep one burst in flight and overlap its token fetch
     # with the next burst's execution (hides the host<->device round trip).
     # Raises decode throughput on dispatch-latency-bound setups but ADDS up

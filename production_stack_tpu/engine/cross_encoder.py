@@ -1,0 +1,86 @@
+"""Cross-encoder scoring sidecar for `/rerank` and `/score`.
+
+Wraps :class:`production_stack_tpu.models.bert.BertClassifier` with pair
+tokenization and static-shape batching: pairs are padded into pow-2 (B, T)
+buckets so repeat traffic reuses a handful of compiled programs, mirroring
+the decoder engine's bucketing discipline. Enabled via the engine server's
+``--scoring-model`` flag (the analogue of deploying a vLLM ``--task score``
+pod for bge-reranker checkpoints in the reference stack).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..logging_utils import init_logger
+from ..models.bert import (
+    BertClassifier,
+    get_bert_config,
+    load_hf_bert_params,
+)
+from .tokenizer import get_tokenizer
+
+logger = init_logger(__name__)
+
+
+def _pow2(n: int, cap: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return min(p, cap)
+
+
+class CrossEncoder:
+    """Jointly scores (query, document) pairs with a classification head."""
+
+    def __init__(self, model: str, max_len: int = 512, max_batch: int = 32):
+        self.cfg = get_bert_config(model)
+        self.model = BertClassifier(self.cfg)
+        self.max_len = min(
+            max_len,
+            self.cfg.max_position_embeddings - self.cfg.position_offset,
+        )
+        self.max_batch = max_batch
+        if os.path.isdir(model):
+            self.params = load_hf_bert_params(self.cfg, model)
+            tok_spec = model
+        else:  # preset: random weights (tests / smoke)
+            self.params = self.model.init_params(jax.random.PRNGKey(0))
+            tok_spec = None
+        self.tokenizer = get_tokenizer(tok_spec, self.cfg.vocab_size)
+        self._fn = jax.jit(self.model.forward)
+        self._lock = threading.Lock()  # one scoring dispatch at a time
+
+    def score_pairs(self, pairs: Sequence[Tuple[str, str]]) -> List[float]:
+        """Relevance logits for each (query, document) pair."""
+        out: List[float] = []
+        for i in range(0, len(pairs), self.max_batch):
+            out.extend(self._score_chunk(pairs[i : i + self.max_batch]))
+        return out
+
+    def _score_chunk(self, pairs: Sequence[Tuple[str, str]]) -> List[float]:
+        encoded = []
+        for a, b in pairs:
+            ids, types = self.tokenizer.encode_pair(a, b)
+            encoded.append((ids[: self.max_len], types[: self.max_len]))
+        B = len(encoded)
+        Bb = _pow2(B, self.max_batch)
+        Tb = _pow2(max(len(x) for x, _ in encoded), self.max_len)
+        tokens = np.full((Bb, Tb), self.cfg.pad_token_id, np.int32)
+        type_ids = np.zeros((Bb, Tb), np.int32)
+        lengths = np.zeros(Bb, np.int32)
+        for i, (x, ty) in enumerate(encoded):
+            x = [min(t, self.cfg.vocab_size - 1) for t in x]
+            tokens[i, : len(x)] = x
+            type_ids[i, : len(ty)] = ty
+            lengths[i] = len(x)
+        with self._lock:
+            scores = np.asarray(
+                self._fn(self.params, tokens, lengths, type_ids)
+            )
+        return [float(s) for s in scores[:B]]

@@ -332,7 +332,9 @@ class LLMEngine:
             self._burst_seqs = list(sched.decodes)
             self._burst_n = sched.n_decode_steps
             self.runner.burst_start(sched.decodes, sched.n_decode_steps)
-        elif (drafts := self._spec_drafts(sched.decodes)) is not None:
+        elif (
+            drafts := self._spec_drafts(sched.decodes, sched.n_decode_steps)
+        ) is not None:
             outputs += self._spec_step(sched.decodes, drafts)
         else:
             bursts = self.runner.execute_decode_multi(
@@ -353,41 +355,74 @@ class LLMEngine:
     # -- speculative decoding (n-gram prompt lookup; engine/spec.py) ----
 
     def _spec_drafts(
-        self, decodes
+        self, decodes, n_burst: int = 1
     ) -> "Optional[tuple[np.ndarray, np.ndarray]]":
         """Per-sequence draft tokens [B, K] for this decode batch, or None
-        when speculation should not engage: disabled, non-greedy /
-        penalized / logprobs rows (exactness policy), too-long rows, or too
-        few sequences with an n-gram hit to beat a plain burst."""
+        when speculation should not engage.
+
+        Gating is PER ROW where possible: only greedy rows get drafts;
+        sampled (temperature>0) rows ride the same verify step and have
+        position 0 put through the full sampling pipeline — identical to a
+        plain decode step for them. Batch-level bail-outs remain for
+        penalties (accepted tokens would change the counts mid-step) and
+        logprobs (verify returns no packed logprob rows), plus too few
+        draft-carrying rows to beat a plain burst."""
         K = self.cfg.speculative_ngram
         if not K or self.cfg.async_decode or not decodes:
             return None
         from .spec import propose_ngram
 
         for s in decodes:
-            if (
-                s.sampling.temperature > 0.0
-                or s.sampling.has_penalties
-                or s.sampling.logprobs is not None
-                or s.sampling.logit_bias  # verify argmax is unbiased
-            ):
+            if s.sampling.has_penalties or s.sampling.logprobs is not None:
                 return None
         drafts = np.zeros((len(decodes), K), np.int32)
         lens = np.zeros(len(decodes), np.int32)
         for i, s in enumerate(decodes):
+            if not s.sampling.greedy or s.sampling.guided_choice:
+                continue  # rides along; sampled/masked at position 0 only
             if s.num_tokens + K > self.cfg.max_model_len:
                 continue  # verify writes would run past the last page
             d = propose_ngram(
-                s.all_token_ids, K, self.cfg.ngram_min, self.cfg.ngram_max
+                self._spec_token_arr(s), K,
+                self.cfg.ngram_min, self.cfg.ngram_max,
+                lookback=self.cfg.ngram_lookback,
             )
             if d:
                 drafts[i, : len(d)] = d
                 lens[i] = len(d)
-        # A verify pass costs ~one (K+1)-token step; worth it only when
-        # enough rows actually carry drafts.
-        if int(np.count_nonzero(lens)) * 2 < len(decodes):
+        # A verify pass costs ~one device round trip; worth it only when
+        # enough rows carry drafts — AND when its best case (K+1 tokens per
+        # draft row, 1 per other row) beats the n-step burst it replaces
+        # (num_decode_steps>1 exists for dispatch-latency-bound setups; a
+        # verify pass that yields fewer tokens per round trip would regress
+        # exactly there).
+        B = len(decodes)
+        hits = int(np.count_nonzero(lens))
+        if hits * 2 < B or hits * (K + 1) + (B - hits) < n_burst * B:
             return None
         return drafts, lens
+
+    @staticmethod
+    def _spec_token_arr(s) -> "np.ndarray":
+        """Per-sequence token-id array for the n-gram scan, grown
+        incrementally (tokens are append-only) — rebuilding the full list
+        and array every decode step was O(context) host work per sequence."""
+        total = s.num_tokens
+        buf = getattr(s, "_spec_buf", None)
+        n = getattr(s, "_spec_buf_n", 0)
+        if buf is None or n > total:
+            buf = np.empty(max(total * 2, 256), np.int64)
+            n = 0
+        elif buf.shape[0] < total:
+            grown = np.empty(max(total * 2, buf.shape[0] * 2), np.int64)
+            grown[:n] = buf[:n]
+            buf = grown
+        P = s.num_prompt_tokens
+        prompt, output = s.prompt_token_ids, s.output_token_ids
+        for idx in range(n, total):
+            buf[idx] = prompt[idx] if idx < P else output[idx - P]
+        s._spec_buf, s._spec_buf_n = buf, total
+        return buf[:total]
 
     def _spec_step(self, decodes, spec) -> List[RequestOutput]:
         """One verify pass: commit each row's accepted draft prefix plus the
@@ -395,16 +430,21 @@ class LLMEngine:
         from .spec import count_accepted
 
         drafts, lens = spec
-        rows = self.runner.execute_spec_verify(decodes, drafts)
+        rows, sampled0 = self.runner.execute_spec_verify(decodes, drafts)
         outputs: List[RequestOutput] = []
         for i, seq in enumerate(decodes):
-            draft = [int(t) for t in drafts[i][: lens[i]]]
-            a = count_accepted(draft, rows[i])
-            # Clamp: never emit past max_model_len.
-            a = min(a, self.cfg.max_model_len - seq.num_tokens - 1)
-            self.spec_proposed_total += len(draft)
-            self.spec_accepted_total += a
-            emitted = draft[:a] + [int(rows[i][a])]
+            if lens[i] == 0:
+                # Draftless (or sampled) row: position 0 went through the
+                # full sampling pipeline — exactly one plain decode step.
+                emitted = [int(sampled0[i])]
+            else:
+                draft = [int(t) for t in drafts[i][: lens[i]]]
+                a = count_accepted(draft, rows[i])
+                # Clamp: never emit past max_model_len.
+                a = min(a, self.cfg.max_model_len - seq.num_tokens - 1)
+                self.spec_proposed_total += len(draft)
+                self.spec_accepted_total += a
+                emitted = draft[:a] + [int(rows[i][a])]
             for tok in emitted:
                 seq.num_computed_tokens += 1
                 self._commit(seq)
@@ -438,8 +478,12 @@ class LLMEngine:
         return (
             self.cfg.async_decode
             and bool(sched.decodes)
-            # Penalties need per-token host-updated count arrays.
-            and not any(s.sampling.has_penalties for s in sched.decodes)
+            # Penalties need per-token host-updated count arrays; guided
+            # masks are rebuilt per token too.
+            and not any(
+                s.sampling.has_penalties or s.sampling.guided_choice
+                for s in sched.decodes
+            )
         )
 
     def _can_continue_burst(self, sched) -> bool:
@@ -552,6 +596,8 @@ class LLMEngine:
         elif token in sp.stop_token_ids:
             finish_reason = "stop"
             is_stop_token = True
+        elif sp.guided_done(seq.output_token_ids):
+            finish_reason = "stop"  # output IS one of the guided choices
         elif len(seq.output_token_ids) >= sp.max_tokens:
             finish_reason = "length"
         elif seq.num_tokens >= self.cfg.max_model_len:

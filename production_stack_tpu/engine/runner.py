@@ -30,6 +30,7 @@ from ..logging_utils import init_logger
 from ..models.llama import Llama, LlamaConfig, load_hf_params
 from ..models.registry import get_model_config
 from ..ops.sampling import (
+    apply_allowed_mask,
     apply_logit_bias,
     apply_penalties,
     sample_tokens_packed,
@@ -216,6 +217,10 @@ class ModelRunner:
             if "bias_ids" in batch:
                 logits = apply_logit_bias(
                     logits, batch["bias_ids"], batch["bias_vals"]
+                )
+            if "allowed_ids" in batch:
+                logits = apply_allowed_mask(
+                    logits, batch["allowed_ids"], batch["allow_free"]
                 )
             # Packed rows: [token] or [token, chosen_lp, top_lps,
             # top_ids] — one fetch serves both sampling and logprobs, and
@@ -636,23 +641,27 @@ class ModelRunner:
 
     def execute_spec_verify(
         self, seqs: List[Sequence], drafts: np.ndarray
-    ) -> np.ndarray:
+    ) -> "tuple[np.ndarray, np.ndarray]":
         """Speculative-decoding verify step: score each sequence's last
         committed token plus its K draft tokens in ONE forward pass.
 
-        ``drafts`` is [B, K] int32. Returns the model's greedy argmax at
-        every scored position, [B, K+1] int32 — row j's argmax is the token
-        the model itself would emit after consuming positions ≤ p0+j, which
-        the engine compares against the drafts to count acceptances. KV for
-        all K+1 positions is written during the pass; rejected positions sit
-        past the committed kv_len and are overwritten on real decode.
+        ``drafts`` is [B, K] int32. Returns ``(argmax_ids [B, K+1],
+        sampled0 [B])`` — row j's argmax is the token the model itself would
+        emit after consuming positions ≤ p0+j (the engine compares it
+        against the drafts to count acceptances), and ``sampled0`` is
+        position 0 put through the full sampling pipeline (temperature /
+        top-p / seeds / logit_bias), so draftless rows in a mixed batch get
+        exactly the token a plain decode step would have produced. KV for
+        all K+1 positions is written during the pass; rejected positions
+        sit past the committed kv_len and are overwritten on real decode.
         """
         B, K = drafts.shape
         batch = self._spec_batch(seqs, drafts)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("spec_verify", batch)
-            return self._dispatch_spec_verify(batch)[: len(seqs)]
+            ids, sampled0 = self._dispatch_spec_verify(batch)
+            return ids[: len(seqs)], sampled0[: len(seqs)]
 
     def _spec_batch(
         self, seqs: List[Sequence], drafts: np.ndarray
@@ -688,10 +697,15 @@ class ModelRunner:
             "kv_lens": kv_lens,
             "last_idx": last_idx,
         }
-        if self.cfg.enable_lora:
-            # Verify must score drafts WITH each row's adapter, or accepted
-            # tokens would be the base model's, not the adapter's.
-            batch.update(self._lora_arrays(seqs, Bb))
+        # Full sampling arrays: position 0 is sampled exactly like a plain
+        # decode step (draftless rows in a mixed batch rely on this), and
+        # LoRA rows verify WITH their adapter.
+        batch.update(self._sampling_arrays(seqs, Bb))
+        batch.pop("penalty_prompt", None)  # penalized rows never reach spec
+        batch.pop("penalty_output", None)
+        batch.pop("presence", None)
+        batch.pop("frequency", None)
+        batch.pop("repetition", None)
         return batch
 
     def _dispatch_spec_verify(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
@@ -719,9 +733,34 @@ class ModelRunner:
                     pp_size=pp,
                     mesh=mesh_for_pp,
                     all_logits=True,
-                )
+                )  # [B, T, V] fp32
+                if "bias_ids" in batch:
+                    # logit_bias applies at EVERY verified position (a
+                    # biased greedy row's accept chain must follow the
+                    # biased argmax).
+                    logits = jax.vmap(
+                        apply_logit_bias, in_axes=(1, None, None), out_axes=1
+                    )(logits, batch["bias_ids"], batch["bias_vals"])
                 ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
-                return ids, kv_cache
+                logits0 = logits[:, 0]
+                if "allowed_ids" in batch:  # guided rows ride draftless
+                    logits0 = apply_allowed_mask(
+                        logits0, batch["allowed_ids"], batch["allow_free"]
+                    )
+                packed0 = sample_tokens_packed(
+                    logits0,
+                    batch["temps"],
+                    batch["top_ps"],
+                    batch["top_ks"],
+                    batch["min_ps"],
+                    batch["seeds"],
+                    with_logprobs=False,
+                )
+                sampled0 = packed0[:, 0].astype(jnp.int32)  # [B]
+                # ONE output array = ONE host fetch (a second fetch costs a
+                # full round trip on tunnel-attached chips): column K+1
+                # carries the sampled position-0 token.
+                return jnp.concatenate([ids, sampled0[:, None]], axis=1), kv_cache
 
             cache_sh = NamedSharding(
                 self.mesh, Llama.cache_pspec(pipeline=pp > 1)
@@ -731,10 +770,11 @@ class ModelRunner:
                 donate_argnums=(1,),
                 out_shardings=(self._repl, cache_sh),
             )
-        ids, self.kv_cache = self._spec_step(
+        packed, self.kv_cache = self._spec_step(
             self.params, self.kv_cache, self._put_batch(batch)
         )
-        return _fetch(ids)
+        packed = _fetch(packed)
+        return packed[:, :-1], packed[:, -1]
 
     def execute_prefill(self, item: PrefillItem) -> int:
         """Process one prefill chunk; returns the sampled token id (only
@@ -940,6 +980,25 @@ class ModelRunner:
             out.update(self._lora_arrays(seqs, B))
         if any(s.sampling.has_penalties for s in seqs):
             out.update(self._penalty_arrays(seqs, B))
+        if any(s.sampling.guided_choice for s in seqs):
+            V = self.model_cfg.vocab_size  # pad id: dropped by the scatter
+            per_row = [
+                s.sampling.guided_allowed(
+                    s.output_token_ids, self.model_cfg.eos_token_ids
+                )
+                for s in seqs
+            ]
+            Na = _pow2(max(max((len(a) for a in per_row if a), default=1), 1))
+            allowed_ids = np.full((B, Na), V, np.int32)
+            allow_free = np.ones(B, bool)
+            for i, allowed in enumerate(per_row):
+                if allowed is None:
+                    continue
+                allow_free[i] = False
+                for j, tid in enumerate(allowed[:Na]):
+                    allowed_ids[i, j] = tid
+            out["allowed_ids"] = allowed_ids
+            out["allow_free"] = allow_free
         if any(s.sampling.logit_bias for s in seqs):
             V = self.model_cfg.vocab_size  # pad id: dropped by the scatter
             Nb = _pow2(max(max(len(s.sampling.logit_bias) for s in seqs), 1))

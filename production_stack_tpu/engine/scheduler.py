@@ -176,8 +176,10 @@ class Scheduler:
         n = max(self.config.num_decode_steps, 1)
         for seq in self.running:
             n = min(n, max(self.config.max_model_len - seq.num_tokens, 1))
-            if seq.sampling.has_penalties:
-                n = 1  # penalties need per-token count updates host-side
+            if seq.sampling.has_penalties or seq.sampling.guided_choice:
+                # Penalties need per-token count updates host-side; guided
+                # decoding needs its allowed-token mask rebuilt per token.
+                n = 1
         look = max(self.config.decode_lookahead, 1)
         for seq in list(self.running):
             if seq not in self.running:  # lost pages to an earlier preemption

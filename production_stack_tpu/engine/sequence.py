@@ -35,10 +35,45 @@ class SamplingParams:
     # OpenAI logit_bias: additive per-token-id logit offsets, applied before
     # sampling (and before greedy argmax).
     logit_bias: Tuple[Tuple[int, float], ...] = ()
+    # Guided choice (vLLM extra-body `guided_choice` analogue): the output
+    # must be exactly one of these token-id sequences; each step's logits
+    # are masked to the tokens that continue a still-viable choice.
+    guided_choice: Tuple[Tuple[int, ...], ...] = ()
 
     @property
     def greedy(self) -> bool:
         return self.temperature <= 1e-5
+
+    def guided_allowed(
+        self, output_so_far: Seq[int], eos_ids: Seq[int] = ()
+    ) -> Optional[List[int]]:
+        """Token ids allowed next under guided_choice (None = unconstrained).
+        A choice stays viable while the output equals its prefix. When the
+        output already IS a complete choice, ``eos_ids`` are also allowed —
+        otherwise a choice that is a strict prefix of another ("yes" vs
+        "yes!") could never be produced: the mask would force continuation
+        into the longer one."""
+        if not self.guided_choice:
+            return None
+        out = tuple(output_so_far)
+        n = len(out)
+        allowed = []
+        for c in self.guided_choice:
+            if len(c) > n and c[:n] == out and c[n] not in allowed:
+                allowed.append(c[n])
+        if out in self.guided_choice:
+            for e in eos_ids:
+                if e not in allowed:
+                    allowed.append(e)
+        return allowed
+
+    def guided_done(self, output_so_far: Seq[int]) -> bool:
+        """True when the output IS one of the choices and no longer choice
+        still extends it — generation must stop."""
+        if not self.guided_choice:
+            return False
+        out = tuple(output_so_far)
+        return out in self.guided_choice and not self.guided_allowed(out)
 
     @property
     def has_penalties(self) -> bool:

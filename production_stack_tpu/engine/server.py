@@ -147,7 +147,34 @@ def _parse_logit_bias(raw) -> tuple:
         raise ValueError("logit_bias keys must be integer token ids")
 
 
-def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
+def _parse_guided_choice(raw, tok) -> tuple:
+    """Tokenize guided_choice strings (no special tokens — the choices are
+    output continuations). Invalid shapes 400 via ValueError."""
+    if not raw:
+        return ()
+    if tok is None:
+        raise ValueError("guided_choice is not supported on this endpoint")
+    if not isinstance(raw, list) or not all(
+        isinstance(c, str) and c for c in raw
+    ):
+        raise ValueError("guided_choice must be a list of non-empty strings")
+    if len(raw) > 64:
+        raise ValueError("guided_choice supports at most 64 choices")
+    choices = []
+    for c in raw:
+        ids = tuple(tok.encode(c, add_special_tokens=False))
+        if not ids or len(ids) > 256:
+            raise ValueError(
+                f"guided_choice entry tokenizes to {len(ids)} tokens "
+                "(must be 1..256)"
+            )
+        choices.append(ids)
+    return tuple(choices)
+
+
+def build_sampling(
+    req, max_model_len: int, prompt_len: int, tok=None
+) -> SamplingParams:
     limit = max(max_model_len - prompt_len - 1, 1)
     want = req.max_completion_tokens or req.max_tokens
     # OpenAI shapes: completions carry an int `logprobs` (top-N count);
@@ -160,6 +187,7 @@ def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
             lp = int(top) if top is not None else 0
         else:
             lp = None
+    gc = _parse_guided_choice(getattr(req, "guided_choice", None), tok)
     return SamplingParams(
         max_tokens=min(want, limit) if want else limit,
         temperature=req.temperature,
@@ -168,13 +196,16 @@ def build_sampling(req, max_model_len: int, prompt_len: int) -> SamplingParams:
         min_p=req.min_p,
         stop=req.stop,
         stop_token_ids=tuple(req.stop_token_ids or ()),
-        ignore_eos=req.ignore_eos,
+        # Guided requests terminate via EOS at a completed choice (the
+        # prefix-choice escape hatch) — ignore_eos would deadlock the mask.
+        ignore_eos=req.ignore_eos and not gc,
         seed=req.seed,
         presence_penalty=req.presence_penalty,
         frequency_penalty=req.frequency_penalty,
         repetition_penalty=req.repetition_penalty,
         logprobs=int(lp) if lp is not None else None,
         logit_bias=_parse_logit_bias(getattr(req, "logit_bias", None)),
+        guided_choice=gc,
     )
 
 
@@ -225,7 +256,9 @@ def _fmt_chat_logprobs(tok, entries):
 
 
 def create_engine_app(
-    engine: AsyncLLMEngine, api_key: Optional[str] = None
+    engine: AsyncLLMEngine,
+    api_key: Optional[str] = None,
+    cross_encoder=None,
 ) -> web.Application:
     # Everything except unauthenticated probe/scrape endpoints is guarded
     # when --api-key is set (/sleep in particular is destructive). Enforced
@@ -336,7 +369,7 @@ def create_engine_app(
                 return {"error": f"prompt has {len(ids)} tokens (max {max_len})",
                         "ids": ids}
             try:
-                sampling = build_sampling(req, max_len, len(ids))
+                sampling = build_sampling(req, max_len, len(ids), tok)
             except ValueError as e:
                 return {"error": str(e), "ids": ids}
             parts, n_out, finish = [], 0, None
@@ -396,7 +429,7 @@ def create_engine_app(
                 f"prompt has {len(ids)} tokens, exceeds max_model_len={max_len}"
             )
         try:
-            sampling = build_sampling(req, max_len, len(ids))
+            sampling = build_sampling(req, max_len, len(ids), tok)
         except ValueError as e:
             return _error(str(e))
         rid = random_id("chatcmpl" if is_chat else "cmpl")
@@ -682,20 +715,31 @@ def create_engine_app(
             scores.append(float(np.dot(va, vb)))
         return scores
 
-    # Scoring method surfaced in rerank/score responses: this engine serves
-    # decoder-only LLMs, so relevance is embedding cosine similarity from
-    # the model's own hidden states — NOT cross-encoder scoring. A true
-    # cross-encoder needs a dedicated scoring checkpoint; clients that
-    # require it should deploy one and must not mistake these numbers for
-    # it, hence the explicit label in the payload.
-    _SCORING_METHOD = "embedding_cosine_similarity"
+    # Scoring method surfaced in rerank/score responses. With a
+    # --scoring-model loaded (bge-reranker-style checkpoint), (query, doc)
+    # pairs are scored JOINTLY by the cross-encoder's classification head —
+    # real reranking. Without one, relevance falls back to embedding cosine
+    # similarity from the decoder's own hidden states; the explicit label
+    # keeps clients from mistaking the approximation for the real thing.
+    _SCORING_METHOD = (
+        "cross_encoder" if cross_encoder else "embedding_cosine_similarity"
+    )
+
+    async def _pair_scores(
+        texts_a: List[str], texts_b: List[str]
+    ) -> List[float]:
+        if cross_encoder is not None:
+            return await asyncio.get_event_loop().run_in_executor(
+                None, cross_encoder.score_pairs, list(zip(texts_a, texts_b))
+            )
+        return await _similarity(texts_a, texts_b)
 
     async def rerank(request: web.Request) -> web.Response:
         body = await request.json()
         query = body.get("query", "")
         docs = body.get("documents", [])
         top_n = body.get("top_n") or len(docs)
-        scores = await _similarity([query] * len(docs), docs)
+        scores = await _pair_scores([query] * len(docs), docs)
         order = sorted(range(len(docs)), key=lambda i: -scores[i])[:top_n]
         return web.json_response(
             {
@@ -718,7 +762,7 @@ def create_engine_app(
         l2 = t2 if isinstance(t2, list) else [t2]
         if len(l1) == 1 and len(l2) > 1:
             l1 = l1 * len(l2)
-        scores = await _similarity(l1, l2)
+        scores = await _pair_scores(l1, l2)
         return web.json_response(
             {
                 "id": random_id("score"),
@@ -892,6 +936,8 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                    help="max draft tokens per step via n-gram prompt lookup")
     p.add_argument("--ngram-min", type=int, default=1)
     p.add_argument("--ngram-max", type=int, default=3)
+    p.add_argument("--ngram-lookback", type=int, default=8192,
+                   help="cap prompt-lookup scan to last N tokens (0 = all)")
     # KV tiering / controller (LMCache env-var analogues).
     p.add_argument("--cpu-offload-blocks", type=int, default=0)
     p.add_argument("--remote-kv-url", default=None)
@@ -901,6 +947,10 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
         "--kv-role", default="none",
         choices=["none", "producer", "consumer", "both"],
     )
+    # Cross-encoder scoring sidecar for /rerank and /score (bge-reranker-
+    # style HF dir or a bert preset). Without it those endpoints fall back
+    # to embedding cosine similarity.
+    p.add_argument("--scoring-model", default=None)
     return p.parse_args(argv)
 
 
@@ -934,6 +984,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         speculative_ngram=args.speculative_ngram,
         ngram_min=args.ngram_min,
         ngram_max=args.ngram_max,
+        ngram_lookback=args.ngram_lookback,
         cpu_offload_blocks=args.cpu_offload_blocks,
         remote_kv_url=args.remote_kv_url,
         cache_controller_url=args.cache_controller_url,
@@ -1011,7 +1062,17 @@ def main(argv=None) -> None:
         from .multihost import StepPublisher
 
         engine.engine.runner.publisher = StepPublisher()
-    app = create_engine_app(engine, api_key=args.api_key)
+    cross_encoder = None
+    if args.scoring_model:
+        from .cross_encoder import CrossEncoder
+
+        cross_encoder = CrossEncoder(args.scoring_model)
+        logger.info(
+            "cross-encoder scoring model loaded: %s", cross_encoder.cfg.name
+        )
+    app = create_engine_app(
+        engine, api_key=args.api_key, cross_encoder=cross_encoder
+    )
 
     async def on_startup(app):
         engine.start(asyncio.get_event_loop())

@@ -25,10 +25,11 @@ import numpy as np
 
 
 def propose_ngram(
-    token_ids: List[int],
+    token_ids,
     k: int,
     min_n: int = 1,
     max_n: int = 3,
+    lookback: int = 0,
 ) -> Optional[List[int]]:
     """Draft up to ``k`` tokens by prompt lookup.
 
@@ -36,11 +37,18 @@ def propose_ngram(
     sequence's last n tokens also occur earlier in the sequence; drafts the
     tokens that followed the MOST RECENT earlier occurrence. None if no
     n-gram recurs (the caller falls back to plain decoding).
+
+    ``token_ids`` may be a list or an int numpy array (the engine caches
+    one per sequence — rebuilding 32k-token arrays every decode step was
+    measurable host time). ``lookback`` > 0 caps the scan to the last that
+    many tokens, bounding per-step host work at long context.
     """
-    L = len(token_ids)
+    a = np.asarray(token_ids, np.int64)
+    if lookback > 0 and a.shape[0] > lookback:
+        a = a[-lookback:]
+    L = a.shape[0]
     if L < min_n + 1 or k <= 0:
         return None
-    a = np.asarray(token_ids, np.int64)
     for n in range(min(max_n, L - 1), min_n - 1, -1):
         suf = a[-n:]
         # Match windows a[s : s+n] for starts s in [0, L-n) — vectorized

@@ -54,6 +54,12 @@ class ByteTokenizer:
             "utf-8", errors="replace"
         )
 
+    def encode_pair(self, a: str, b: str) -> Tuple[List[int], List[int]]:
+        # 258 = synthetic separator (outside the byte id range 1..256).
+        # Segment ids: 0 for the first text (+sep), 1 for the second.
+        ia, ib = self.encode(a), self.encode(b)
+        return ia + [258] + ib, [0] * (len(ia) + 1) + [1] * len(ib)
+
     def apply_chat_template(
         self, messages: List[ChatMessage], add_generation_prompt: bool = True
     ) -> str:
@@ -78,6 +84,15 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def encode_pair(self, a: str, b: str) -> Tuple[List[int], List[int]]:
+        """Sentence-pair encoding with the model's own pair template
+        (RoBERTa: <s> a </s></s> b </s>; BERT: [CLS] a [SEP] b [SEP] with
+        segment ids) — what cross-encoders were trained on."""
+        enc = self._tok(a, b)
+        ids = enc["input_ids"]
+        types = enc.get("token_type_ids") or [0] * len(ids)
+        return ids, types
 
     def apply_chat_template(
         self, messages: List[ChatMessage], add_generation_prompt: bool = True

@@ -1,0 +1,333 @@
+"""BERT/RoBERTa/XLM-R-family encoder with a sequence-classification head.
+
+The TRUE cross-encoder scoring path for `/rerank` and `/score`: the
+reference stack serves these endpoints from engines running dedicated
+scoring checkpoints (bge-reranker-* — XLM-RoBERTa encoders with a 1-label
+classification head) via vLLM's `--task score`. The decoder-family engine
+approximated relevance with embedding cosine similarity; this module scores
+(query, document) PAIRS jointly, which is what a reranker actually is.
+
+TPU-first notes: bidirectional attention over short (≤512-token) pairs is a
+single dense [B, T, T] softmax — no paging, no masking subtleties beyond
+padding — and the whole encoder is one `lax.scan` over stacked layers, so
+one compiled layer body serves any depth. Weights are small (≈0.3-0.6B);
+the forward runs replicated (no sharding) by design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 250002
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 514
+    layer_norm_eps: float = 1e-5
+    num_labels: int = 1
+    # BERT proper distinguishes segment A (query) from segment B (document)
+    # via learned type embeddings; RoBERTa/XLM-R collapse to one type.
+    type_vocab_size: int = 1
+    # RoBERTa-family position ids start at pad_token_id + 1 (= 2): the
+    # checkpoint's position table rows 0/1 are never used for real tokens.
+    position_offset: int = 2
+    pad_token_id: int = 1
+    name: str = "bert"
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+BERT_PRESETS: Dict[str, BertConfig] = {
+    # Tiny debug encoder for tests (random weights).
+    "tiny-bert-debug": BertConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        max_position_embeddings=130,
+        type_vocab_size=2,
+        name="tiny-bert-debug",
+    ),
+    # bge-reranker-base shapes (XLM-RoBERTa base, 1-label head).
+    "bge-reranker-base": BertConfig(name="bge-reranker-base"),
+    # bge-reranker-large shapes (XLM-RoBERTa large).
+    "bge-reranker-large": BertConfig(
+        hidden_size=1024,
+        intermediate_size=4096,
+        num_layers=24,
+        num_heads=16,
+        name="bge-reranker-large",
+    ),
+}
+
+
+class BertClassifier:
+    """Stateless encoder + classification-head functions bound to a config."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng: jax.Array) -> Params:
+        cfg = self.cfg
+        d = cfg.jdtype
+        D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+        k = jax.random.split(rng, 10)
+
+        def dense(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+            ).astype(d)
+
+        def ln():
+            return {"w": jnp.ones((L, D), d), "b": jnp.zeros((L, D), d)}
+
+        return {
+            "word_emb": dense(k[0], (cfg.vocab_size, D), D),
+            "pos_emb": dense(k[1], (cfg.max_position_embeddings, D), D),
+            "type_emb": jnp.zeros((cfg.type_vocab_size, D), d),
+            "emb_ln_w": jnp.ones((D,), d),
+            "emb_ln_b": jnp.zeros((D,), d),
+            "layers": {
+                "wq": dense(k[2], (L, D, D), D),
+                "bq": jnp.zeros((L, D), d),
+                "wk": dense(k[3], (L, D, D), D),
+                "bk": jnp.zeros((L, D), d),
+                "wv": dense(k[4], (L, D, D), D),
+                "bv": jnp.zeros((L, D), d),
+                "wo": dense(k[5], (L, D, D), D),
+                "bo": jnp.zeros((L, D), d),
+                "attn_ln": ln(),
+                "w1": dense(k[6], (L, D, F), D),
+                "b1": jnp.zeros((L, F), d),
+                "w2": dense(k[7], (L, F, D), F),
+                "b2": jnp.zeros((L, D), d),
+                "mlp_ln": ln(),
+            },
+            "cls_dense_w": dense(k[8], (D, D), D),
+            "cls_dense_b": jnp.zeros((D,), d),
+            "cls_out_w": dense(k[9], (D, cfg.num_labels), D),
+            "cls_out_b": jnp.zeros((cfg.num_labels,), d),
+        }
+
+    def forward(
+        self,
+        params: Params,
+        tokens: jax.Array,  # [B, T] int32 (pad with cfg.pad_token_id)
+        lengths: jax.Array,  # [B] int32 valid lengths
+        type_ids: Optional[jax.Array] = None,  # [B, T] segment ids (BERT)
+    ) -> jax.Array:
+        """Relevance logits [B] (label 0 of the classification head)."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        H, hd = cfg.num_heads, cfg.head_dim
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :] + cfg.position_offset
+        valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+
+        if type_ids is None:
+            type_ids = jnp.zeros((B, T), jnp.int32)
+        type_ids = jnp.minimum(type_ids, cfg.type_vocab_size - 1)
+        x = (
+            params["word_emb"][tokens]
+            + params["pos_emb"][jnp.minimum(
+                positions, cfg.max_position_embeddings - 1
+            )]
+            + params["type_emb"][type_ids]
+        )
+        x = _layer_norm(x, params["emb_ln_w"], params["emb_ln_b"],
+                        cfg.layer_norm_eps)
+
+        mask = valid[:, None, None, :]  # [B, 1, 1, T] — padding only (bidir)
+
+        def layer(x, lp):
+            q = (x @ lp["wq"] + lp["bq"]).reshape(B, T, H, hd)
+            kk = (x @ lp["wk"] + lp["bk"]).reshape(B, T, H, hd)
+            v = (x @ lp["wv"] + lp["bv"]).reshape(B, T, H, hd)
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q, kk, preferred_element_type=jnp.float32
+            ) / math.sqrt(hd)
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum(
+                "bhts,bshd->bthd", probs.astype(v.dtype), v,
+                preferred_element_type=jnp.float32,
+            ).reshape(B, T, cfg.hidden_size).astype(x.dtype)
+            a = attn @ lp["wo"] + lp["bo"]
+            x = _layer_norm(x + a, lp["attn_ln"]["w"], lp["attn_ln"]["b"],
+                            cfg.layer_norm_eps)
+            f = jax.nn.gelu(
+                (x @ lp["w1"] + lp["b1"]).astype(jnp.float32),
+                approximate=False,
+            ).astype(x.dtype)
+            f = f @ lp["w2"] + lp["b2"]
+            x = _layer_norm(x + f, lp["mlp_ln"]["w"], lp["mlp_ln"]["b"],
+                            cfg.layer_norm_eps)
+            return x, None
+
+        x, _ = jax.lax.scan(layer, x, params["layers"])
+        # RoBERTa classification head: dense+tanh on the <s> (first) token.
+        cls = x[:, 0]
+        h = jnp.tanh(cls @ params["cls_dense_w"] + params["cls_dense_b"])
+        logits = h @ params["cls_out_w"] + params["cls_out_b"]
+        return logits[:, 0].astype(jnp.float32)
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def bert_config_from_hf(config_path: str, name: str = "") -> BertConfig:
+    with open(config_path) as f:
+        hf = json.load(f)
+    mt = hf.get("model_type", "")
+    if mt not in ("bert", "roberta", "xlm-roberta"):
+        raise ValueError(
+            f"unsupported scoring model_type {mt!r} (bert/roberta/xlm-roberta)"
+        )
+    roberta = mt != "bert"
+    return BertConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        max_position_embeddings=hf["max_position_embeddings"],
+        layer_norm_eps=hf.get("layer_norm_eps", 1e-5),
+        num_labels=len(hf.get("id2label", {0: ""})) or 1,
+        position_offset=(hf.get("pad_token_id", 1) or 0) + 1 if roberta else 0,
+        pad_token_id=hf.get("pad_token_id", 1 if roberta else 0),
+        type_vocab_size=hf.get("type_vocab_size", 1),
+        name=name or mt,
+    )
+
+
+def load_hf_bert_params(cfg: BertConfig, model_dir: str) -> Params:
+    """Load an HF ...ForSequenceClassification checkpoint (safetensors).
+
+    Handles the `roberta.`/`bert.`/bare prefixes and both head layouts:
+    RoBERTa (`classifier.dense` + `classifier.out_proj`) and BERT
+    (`bert.pooler.dense` + bare `classifier`).
+    """
+    from safetensors import safe_open
+
+    files = sorted(
+        os.path.join(model_dir, f)
+        for f in os.listdir(model_dir)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+    raw: Dict[str, np.ndarray] = {}
+    for path in files:
+        with safe_open(path, framework="numpy") as f:
+            for key in f.keys():
+                raw[key] = np.asarray(f.get_tensor(key))
+
+    prefix = ""
+    for p in ("roberta.", "bert.", ""):
+        if f"{p}embeddings.word_embeddings.weight" in raw:
+            prefix = p
+            break
+
+    d = cfg.jdtype
+    cast = lambda a: jnp.asarray(a, d)  # noqa: E731
+    g = lambda k: raw[prefix + k]  # noqa: E731
+
+    L, D = cfg.num_layers, cfg.hidden_size
+    lay = {
+        "wq": [], "bq": [], "wk": [], "bk": [], "wv": [], "bv": [],
+        "wo": [], "bo": [],
+        "attn_ln": {"w": [], "b": []},
+        "w1": [], "b1": [], "w2": [], "b2": [],
+        "mlp_ln": {"w": [], "b": []},
+    }
+    for i in range(L):
+        e = f"encoder.layer.{i}."
+        lay["wq"].append(g(e + "attention.self.query.weight").T)
+        lay["bq"].append(g(e + "attention.self.query.bias"))
+        lay["wk"].append(g(e + "attention.self.key.weight").T)
+        lay["bk"].append(g(e + "attention.self.key.bias"))
+        lay["wv"].append(g(e + "attention.self.value.weight").T)
+        lay["bv"].append(g(e + "attention.self.value.bias"))
+        lay["wo"].append(g(e + "attention.output.dense.weight").T)
+        lay["bo"].append(g(e + "attention.output.dense.bias"))
+        lay["attn_ln"]["w"].append(g(e + "attention.output.LayerNorm.weight"))
+        lay["attn_ln"]["b"].append(g(e + "attention.output.LayerNorm.bias"))
+        lay["w1"].append(g(e + "intermediate.dense.weight").T)
+        lay["b1"].append(g(e + "intermediate.dense.bias"))
+        lay["w2"].append(g(e + "output.dense.weight").T)
+        lay["b2"].append(g(e + "output.dense.bias"))
+        lay["mlp_ln"]["w"].append(g(e + "output.LayerNorm.weight"))
+        lay["mlp_ln"]["b"].append(g(e + "output.LayerNorm.bias"))
+
+    def stack(v):
+        if isinstance(v, dict):
+            return {kk: stack(vv) for kk, vv in v.items()}
+        return cast(np.stack(v, axis=0))
+
+    if "classifier.dense.weight" in raw:  # RoBERTa head
+        head = {
+            "cls_dense_w": cast(raw["classifier.dense.weight"].T),
+            "cls_dense_b": cast(raw["classifier.dense.bias"]),
+            "cls_out_w": cast(raw["classifier.out_proj.weight"].T),
+            "cls_out_b": cast(raw["classifier.out_proj.bias"]),
+        }
+    else:  # BERT head: pooler dense+tanh then classifier
+        head = {
+            "cls_dense_w": cast(g("pooler.dense.weight").T),
+            "cls_dense_b": cast(g("pooler.dense.bias")),
+            "cls_out_w": cast(raw["classifier.weight"].T),
+            "cls_out_b": cast(raw["classifier.bias"]),
+        }
+
+    params: Params = {
+        "word_emb": cast(g("embeddings.word_embeddings.weight")),
+        "pos_emb": cast(g("embeddings.position_embeddings.weight")),
+        "type_emb": cast(g("embeddings.token_type_embeddings.weight")),
+        "emb_ln_w": cast(g("embeddings.LayerNorm.weight")),
+        "emb_ln_b": cast(g("embeddings.LayerNorm.bias")),
+        "layers": stack(lay),
+        **head,
+    }
+    logger.info("loaded %d cross-encoder tensors from %s", len(raw), model_dir)
+    return params
+
+
+def get_bert_config(model: str) -> BertConfig:
+    if model in BERT_PRESETS:
+        return BERT_PRESETS[model]
+    cfg_path = os.path.join(model, "config.json")
+    if os.path.isfile(cfg_path):
+        return bert_config_from_hf(cfg_path, name=model)
+    raise ValueError(
+        f"unknown scoring model {model!r}: not a preset "
+        f"({', '.join(sorted(BERT_PRESETS))}) and no local HF dir found"
+    )

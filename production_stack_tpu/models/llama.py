@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..logging_utils import init_logger
-from ..ops.attention import paged_attention
+from ..ops.attention import paged_attention, window_eff
 from ..parallel.mesh import AXIS_EXPERT, AXIS_PIPELINE, AXIS_TENSOR
 
 logger = init_logger(__name__)
@@ -121,6 +121,9 @@ class LlamaConfig:
     # ``num_local_experts`` / ``num_experts_per_tok``). 0 experts = dense.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Qwen3-style per-head RMSNorm on q/k (applied over head_dim, before
+    # rope; params q_norm/k_norm [L, hd]).
+    qk_norm: bool = False
     # Gemma-family architecture knobs (all default to the Llama conventions).
     hidden_act: str = "silu"  # silu | gelu_tanh (Gemma GeGLU)
     norm_unit_offset: bool = False  # RMSNorm weight is (1 + w) (Gemma)
@@ -216,6 +219,9 @@ class Llama:
             params["layers"]["bq"] = jnp.zeros((L, cfg.q_size), d)
             params["layers"]["bk"] = jnp.zeros((L, cfg.kv_size), d)
             params["layers"]["bv"] = jnp.zeros((L, cfg.kv_size), d)
+        if cfg.qk_norm:
+            params["layers"]["q_norm"] = jnp.ones((L, cfg.head_dim), d)
+            params["layers"]["k_norm"] = jnp.ones((L, cfg.head_dim), d)
         if cfg.post_block_norms:
             params["layers"]["post_attn_norm"] = jnp.ones((L, D), d)
             params["layers"]["post_mlp_norm"] = jnp.ones((L, D), d)
@@ -266,6 +272,9 @@ class Llama:
             specs["layers"]["bq"] = P(pp, AXIS_TENSOR)
             specs["layers"]["bk"] = P(pp, AXIS_TENSOR)
             specs["layers"]["bv"] = P(pp, AXIS_TENSOR)
+        if self.cfg.qk_norm:
+            specs["layers"]["q_norm"] = P(pp, None)
+            specs["layers"]["k_norm"] = P(pp, None)
         if self.cfg.post_block_norms:
             specs["layers"]["post_attn_norm"] = P(pp, None)
             specs["layers"]["post_mlp_norm"] = P(pp, None)
@@ -431,6 +440,9 @@ class Llama:
             q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
             k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3: per-head RMSNorm over hd, pre-rope
+                q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+                k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
             q = _apply_rope(q, rope_cos, rope_sin)
             k = _apply_rope(k, rope_cos, rope_sin)
 
@@ -609,9 +621,11 @@ class Llama:
             v = _proj(h, lp["wv"], lp.get("bv")).reshape(
                 B, T, cfg.num_kv_heads, cfg.head_dim
             )
-            q = _apply_rope(
-                q.reshape(B, T, cfg.num_heads, cfg.head_dim), rope_cos, rope_sin
-            )
+            q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3: per-head RMSNorm over hd, pre-rope
+                q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+                k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+            q = _apply_rope(q, rope_cos, rope_sin)
             k = _apply_rope(k, rope_cos, rope_sin)
             if use_ring:
                 from ..ops.ring_attention import ring_self_attention
@@ -629,10 +643,10 @@ class Llama:
                 scores = _softcap(scores, cfg.attn_logit_softcap)
                 mask = causal
                 if cfg.sliding_window:
-                    win = _layer_window(cfg, li)
-                    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
                     mask = mask & (
-                        positions[:, None, :] > positions[:, :, None] - win_eff
+                        positions[:, None, :]
+                        > positions[:, :, None]
+                        - window_eff(_layer_window(cfg, li))
                     )
                 scores = jnp.where(mask[:, None, None], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
@@ -933,6 +947,9 @@ def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
         params["lm_head"] = cast(raw.pop("lm_head.weight"))
 
     layer_map = dict(_HF_LAYER_MAP)
+    if cfg.qk_norm:
+        layer_map["self_attn.q_norm"] = "q_norm"
+        layer_map["self_attn.k_norm"] = "k_norm"
     if cfg.post_block_norms:
         # Gemma-2 norm layout: post_attention_layernorm is the POST-attn
         # norm (not the MLP pre-norm as in Llama), and the MLP has its own
@@ -991,10 +1008,12 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
     with open(config_path) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2", "mixtral", "gemma", "gemma2"):
+    if mt not in (
+        "llama", "mistral", "qwen2", "qwen3", "mixtral", "gemma", "gemma2",
+    ):
         raise ValueError(
             f"unsupported model_type {mt!r} "
-            "(llama/mistral/qwen2/mixtral/gemma/gemma2)"
+            "(llama/mistral/qwen2/qwen3/mixtral/gemma/gemma2)"
         )
     eos = hf.get("eos_token_id", 2)
     eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
@@ -1036,6 +1055,7 @@ def config_from_hf_json(config_path: str, name: str = "") -> LlamaConfig:
         max_position_embeddings=hf.get("max_position_embeddings", 4096),
         tie_word_embeddings=hf.get("tie_word_embeddings", gemma),
         attention_bias=mt == "qwen2" or hf.get("attention_bias", False),
+        qk_norm=mt == "qwen3",
         num_experts=hf.get("num_local_experts", 0) if mt == "mixtral" else 0,
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         hidden_act=act,

@@ -209,6 +209,37 @@ PRESETS: Dict[str, LlamaConfig] = {
         eos_token_ids=(1,),
         bos_token_id=2,
     ),
+    # Tiny Qwen3-style debug model (per-head q/k RMSNorm, no QKV bias).
+    "tiny-qwen3-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=16,
+        max_position_embeddings=2048,
+        qk_norm=True,
+        name="tiny-qwen3-debug",
+        eos_token_ids=(0,),
+        bos_token_id=None,
+        dtype="float32",
+    ),
+    "qwen3-8b": LlamaConfig(
+        vocab_size=151936,
+        hidden_size=4096,
+        intermediate_size=12288,
+        num_layers=36,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        max_position_embeddings=40960,
+        qk_norm=True,
+        name="qwen3-8b",
+        eos_token_ids=(151645, 151643),
+        bos_token_id=None,
+    ),
     "qwen2-7b": LlamaConfig(
         vocab_size=152064,
         hidden_size=3584,

@@ -41,6 +41,16 @@ import jax.numpy as jnp
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def window_eff(window) -> jax.Array:
+    """Effective sliding window as an int32 scalar: the configured window,
+    or a past-any-context sentinel when 0/negative (= unlimited). Shared by
+    the gather path, both Pallas kernels, and the encode path so the
+    window-bound convention (`key_pos > q_pos - window_eff`) lives in one
+    place."""
+    win = jnp.asarray(window, jnp.int32)
+    return jnp.where(win > 0, win, jnp.int32(1 << 30))
+
+
 def _use_pallas() -> bool:
     if os.environ.get("PST_DISABLE_PALLAS"):
         return False
@@ -126,9 +136,7 @@ def gather_paged_attention(
     causal = kv_pos[:, None, :] <= q_positions[..., None]  # [B, T, S]
     # Sliding window: each query sees at most the last `window` positions
     # (0 = unlimited; `window` may be a traced scalar for per-layer windows).
-    win = jnp.asarray(window, jnp.int32)
-    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
-    in_window = kv_pos[:, None, :] > q_positions[..., None] - win_eff
+    in_window = kv_pos[:, None, :] > q_positions[..., None] - window_eff(window)
     mask = (valid[:, None, :] & causal & in_window)[:, None, None]
     scores = jnp.where(mask, scores, _NEG_INF)
 

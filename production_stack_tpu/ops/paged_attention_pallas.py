@@ -54,6 +54,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import window_eff
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -219,9 +221,7 @@ def _decode_kernel(
     # Sliding window (0 = unlimited): the one query row sits at position
     # kv_len-1 and may see positions >= kv_len - window; whole chunks below
     # that are never fetched.
-    win = win_ref[0]
-    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
-    lo = jnp.maximum(kv_len - win_eff, 0)
+    lo = jnp.maximum(kv_len - window_eff(win_ref[0]), 0)
     c_start = lo // (chunk * block_size)
 
     q = q_ref[0]  # [H, hd] native dtype
@@ -308,8 +308,7 @@ def _prefill_kernel(
     bounds = jnp.minimum(q_pos + 1, kv_len)
     # Sliding window lower bounds; chunks below the tile's FIRST row's
     # window start are outside every row's window and are never fetched.
-    win = win_ref[0]
-    win_eff = jnp.where(win > 0, win, jnp.int32(1 << 30))
+    win_eff = window_eff(win_ref[0])
     lows = jnp.maximum(q_pos + 1 - win_eff, 0)  # [Tq*G, 1]
     c_start = jnp.maximum(start + tq * Tq + 1 - win_eff, 0) // (
         chunk * block_size

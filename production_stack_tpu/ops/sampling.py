@@ -116,6 +116,24 @@ def apply_logit_bias(
     return logits.at[rows, bias_ids].add(bias_vals, mode="drop")
 
 
+def apply_allowed_mask(
+    logits: jax.Array,  # [B, V] float32
+    allowed_ids: jax.Array,  # [B, Na] int32, pad = V (dropped)
+    allow_free: jax.Array,  # [B] bool — True: row is unconstrained
+) -> jax.Array:
+    """Guided decoding: restrict each constrained row to its allowed token
+    set (everything else to -inf); unconstrained rows pass through."""
+    B, V = logits.shape
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    mask = (
+        jnp.zeros((B, V), jnp.bool_)
+        .at[rows, allowed_ids]
+        .set(True, mode="drop")
+    )
+    mask = mask | allow_free[:, None]
+    return jnp.where(mask, logits, _NEG)
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V] float32
     prompt_tokens: jax.Array,  # [B, Pp] int32, pad = V (dropped)

@@ -106,6 +106,9 @@ class SamplingFields(_Permissive):
     top_logprobs: Optional[int] = None
     # OpenAI logit_bias: {"<token_id>": bias in [-100, 100]}.
     logit_bias: Optional[Dict[str, float]] = None
+    # Guided decoding (vLLM extra-body extension): constrain the output to
+    # be exactly one of these strings.
+    guided_choice: Optional[List[str]] = None
     ignore_eos: bool = False
     stream: bool = False
     stream_options: Optional[Dict[str, Any]] = None

@@ -20,7 +20,7 @@ from production_stack_tpu.engine.server import create_engine_app
 
 
 class EngineServer:
-    def __init__(self, **cfg_over):
+    def __init__(self, cross_encoder=None, **cfg_over):
         kw = dict(
             model="tiny-llama-debug",
             max_model_len=256,
@@ -31,11 +31,12 @@ class EngineServer:
         )
         kw.update(cfg_over)
         self.cfg = EngineConfig(**kw)
+        self.cross_encoder = cross_encoder
         self.url = None
 
     async def __aenter__(self):
         self.engine = AsyncLLMEngine(self.cfg)
-        app = create_engine_app(self.engine)
+        app = create_engine_app(self.engine, cross_encoder=self.cross_encoder)
         self.runner = web.AppRunner(app)
         await self.runner.setup()
         site = web.TCPSite(self.runner, "127.0.0.1", 0)

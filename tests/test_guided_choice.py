@@ -1,0 +1,172 @@
+"""Guided-choice constrained decoding: the output must be exactly one of
+the given choices — enforced by per-step allowed-token masks, not by hope.
+"""
+
+import aiohttp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+from tests.test_engine_server import EngineServer
+
+
+def make_engine(**over):
+    kw = dict(
+        model="tiny-llama-debug",
+        max_model_len=256,
+        block_size=8,
+        num_kv_blocks=128,
+        max_num_seqs=8,
+        max_prefill_tokens=64,
+    )
+    kw.update(over)
+    return LLMEngine(EngineConfig(**kw))
+
+
+def run(eng, rid, prompt, sampling):
+    eng.add_request(rid, prompt_token_ids=list(prompt), sampling=sampling)
+    toks = []
+    finish = None
+    while eng.has_work():
+        for out in eng.step():
+            toks.extend(out.new_token_ids)
+            finish = out.finish_reason or finish
+    return toks, finish
+
+
+PROMPT = [3, 17, 98, 255, 42, 7, 11, 200]
+CHOICES = ((9, 4, 33), (9, 7), (120,))
+
+
+def test_output_is_exactly_one_choice_greedy():
+    eng = make_engine()
+    toks, finish = run(
+        eng, "g0", PROMPT,
+        SamplingParams(max_tokens=16, temperature=0.0,
+                       guided_choice=CHOICES),
+    )
+    assert tuple(toks) in CHOICES
+    assert finish == "stop"
+
+
+def test_output_is_a_choice_under_sampling():
+    for seed in range(4):
+        eng = make_engine()
+        toks, finish = run(
+            eng, f"s{seed}", PROMPT,
+            SamplingParams(max_tokens=16, temperature=1.5, seed=seed,
+                           guided_choice=CHOICES),
+        )
+        assert tuple(toks) in CHOICES
+        assert finish == "stop"
+
+
+def test_shared_prefix_choices_resolve():
+    """Choices (9,4,33) and (9,7) share token 9: after emitting 9 the mask
+    must narrow to {4, 7}, never stop early at (9,)."""
+    eng = make_engine()
+    toks, _ = run(
+        eng, "p0", PROMPT,
+        SamplingParams(max_tokens=16, temperature=0.0,
+                       guided_choice=((9, 4, 33), (9, 7))),
+    )
+    assert tuple(toks) in ((9, 4, 33), (9, 7))
+    assert len(toks) >= 2
+
+
+def test_prefix_choice_offers_eos_escape():
+    """When one choice is a strict prefix of another ("yes" vs "yes!"),
+    the completed short choice must offer EOS so it stays reachable."""
+    sp = SamplingParams(guided_choice=((9,), (9, 7)))
+    eos = (0,)
+    # Before any output: only the shared first token.
+    assert sp.guided_allowed([], eos) == [9]
+    # After emitting the short choice: continuation AND eos are allowed.
+    assert sorted(sp.guided_allowed([9], eos)) == [0, 7]
+    # The long choice completed: nothing extends it; eos only.
+    assert sp.guided_allowed([9, 7], eos) == [0]
+    assert sp.guided_done([9, 7])
+    # End-to-end: biasing EOS makes the engine actually take the escape.
+    eng = make_engine()
+    eos_id = eng.model_cfg.eos_token_ids[0]
+    toks, finish = run(
+        eng, "e0", PROMPT,
+        SamplingParams(max_tokens=8, temperature=0.0,
+                       guided_choice=((9,), (9, 7)),
+                       logit_bias=((eos_id, 100.0),)),
+    )
+    assert toks[0] == 9 and finish == "stop"
+
+
+def test_guided_alongside_free_requests():
+    """Guided and unconstrained sequences batch together; the free row's
+    output must equal its solo run (allow_free passthrough)."""
+    base = make_engine()
+    free_solo, _ = run(
+        base, "f0", PROMPT,
+        SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True),
+    )
+    eng = make_engine()
+    eng.add_request(
+        "guided", prompt_token_ids=list(PROMPT),
+        sampling=SamplingParams(max_tokens=16, temperature=0.0,
+                                guided_choice=CHOICES),
+    )
+    eng.add_request(
+        "free", prompt_token_ids=list(PROMPT),
+        sampling=SamplingParams(max_tokens=8, temperature=0.0,
+                                ignore_eos=True),
+    )
+    outs = {"guided": [], "free": []}
+    while eng.has_work():
+        for out in eng.step():
+            outs[out.request_id].extend(out.new_token_ids)
+    assert tuple(outs["guided"]) in CHOICES
+    assert outs["free"] == free_solo
+
+
+def test_guided_with_spec_decode_enabled():
+    """speculative_ngram on: guided rows must ride draftless and still obey
+    the mask."""
+    eng = make_engine(speculative_ngram=4)
+    rep = [11, 22, 33, 44] * 4
+    eng.add_request(
+        "guided", prompt_token_ids=list(PROMPT),
+        sampling=SamplingParams(max_tokens=16, temperature=0.0,
+                                guided_choice=CHOICES),
+    )
+    eng.add_request(
+        "greedy", prompt_token_ids=rep,
+        sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                ignore_eos=True),
+    )
+    outs = {"guided": [], "greedy": []}
+    while eng.has_work():
+        for out in eng.step():
+            outs[out.request_id].extend(out.new_token_ids)
+    assert tuple(outs["guided"]) in CHOICES
+    assert len(outs["greedy"]) == 12
+
+
+async def test_guided_choice_over_http():
+    """guided_choice through /v1/completions: the byte tokenizer maps
+    text reversibly, so the response text must be one of the choices."""
+    async with EngineServer() as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug", "prompt": "pick a color:",
+            "max_tokens": 8, "temperature": 0.0,
+            "guided_choice": ["red", "green", "blue"],
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=payload) as r:
+            assert r.status == 200
+            body = await r.json()
+        assert body["choices"][0]["text"] in ("red", "green", "blue")
+        assert body["choices"][0]["finish_reason"] == "stop"
+
+        # Invalid shapes 400.
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json=dict(payload, guided_choice=[""]),
+        ) as r:
+            assert r.status == 400

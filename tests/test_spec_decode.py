@@ -145,14 +145,43 @@ def test_spec_batch_of_sequences_identical():
 
 
 def test_spec_sampled_requests_bypass_speculation():
-    """temperature>0 rows must take the normal sampling path (speculation
-    is greedy-exact only) — and seeded sampling stays reproducible."""
+    """A lone temperature>0 request never triggers a verify pass (no
+    draft-carrying rows) — and seeded sampling stays reproducible."""
     eng = make_engine(speculative_ngram=4)
     a = run_greedy(eng, "t0", REPEAT, 12, temperature=0.8, seed=7)
     assert eng.spec_proposed_total == 0
     eng2 = make_engine()
     b = run_greedy(eng2, "t1", REPEAT, 12, temperature=0.8, seed=7)
     assert a == b
+
+
+def test_spec_mixed_greedy_and_sampled_batch_identical():
+    """Sampled rows ride the verify step (position 0 fully sampled) while
+    greedy rows speculate — both must match their solo non-spec runs."""
+    def run_pair(spec: bool):
+        eng = make_engine(**({"speculative_ngram": 4} if spec else {}))
+        eng.add_request(
+            "g", prompt_token_ids=list(REPEAT),
+            sampling=SamplingParams(
+                max_tokens=16, temperature=0.0, ignore_eos=True
+            ),
+        )
+        eng.add_request(
+            "s", prompt_token_ids=list(RANDOM),
+            sampling=SamplingParams(
+                max_tokens=16, temperature=0.9, seed=11, ignore_eos=True
+            ),
+        )
+        outs = {"g": [], "s": []}
+        while eng.has_work():
+            for out in eng.step():
+                outs[out.request_id].extend(out.new_token_ids)
+        return outs, eng
+
+    base, _ = run_pair(spec=False)
+    spec, eng = run_pair(spec=True)
+    assert spec == base
+    assert eng.spec_proposed_total > 0  # the greedy row did speculate
 
 
 def test_spec_respects_max_model_len():
