@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""ShareGPT → multi-round-QA workload preprocessing (reference parity:
+`benchmarks/multi-round-qa/data_preprocessing.py`).
+
+Takes a local ShareGPT-format JSON dump (zero-egress environment: the file
+must already be on disk) and emits the workload JSON ``multi_round_qa.py
+--workload`` consumes: per-user conversations with alternating
+human/assistant turns, filtered to a turn-count range and trimmed to a
+token budget (approximated at 4 chars/token, as the reference does before
+real tokenization happens engine-side).
+
+Usage:
+  python benchmarks/data_preprocessing.py ShareGPT_V3.json \
+      -o workload.json --num-users 32 --min-rounds 4 --max-history-chars 80000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+
+
+def conversations(raw) -> list:
+    """Normalize the two common ShareGPT layouts to
+    [{"rounds": [{"question": ..., "answer": ...}, ...]}]."""
+    out = []
+    items = raw if isinstance(raw, list) else raw.get("data", [])
+    for item in items:
+        turns = item.get("conversations") or item.get("items") or []
+        rounds = []
+        q = None
+        for t in turns:
+            who = t.get("from") or t.get("role") or ""
+            text = t.get("value") or t.get("content") or ""
+            if who in ("human", "user"):
+                q = text
+            elif who in ("gpt", "assistant") and q is not None:
+                rounds.append({"question": q, "answer": text})
+                q = None
+        if rounds:
+            out.append({"rounds": rounds})
+    return out
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input", help="local ShareGPT-format JSON file")
+    p.add_argument("-o", "--output", default="workload.json")
+    p.add_argument("--num-users", type=int, default=32)
+    p.add_argument("--min-rounds", type=int, default=2)
+    p.add_argument("--max-rounds", type=int, default=20)
+    p.add_argument("--max-history-chars", type=int, default=80000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    with open(args.input) as f:
+        raw = json.load(f)
+    convs = [
+        c
+        for c in conversations(raw)
+        if args.min_rounds <= len(c["rounds"])
+    ]
+    rng = random.Random(args.seed)
+    rng.shuffle(convs)
+    users = []
+    for c in convs[: args.num_users]:
+        rounds, total = [], 0
+        for r in c["rounds"][: args.max_rounds]:
+            total += len(r["question"]) + len(r["answer"])
+            if total > args.max_history_chars:
+                break
+            rounds.append(r)
+        if rounds:
+            users.append({"rounds": rounds})
+    with open(args.output, "w") as f:
+        json.dump({"users": users}, f)
+    n_rounds = sum(len(u["rounds"]) for u in users)
+    print(
+        f"wrote {args.output}: {len(users)} users, {n_rounds} rounds "
+        f"(from {len(convs)} eligible conversations)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,10 @@ class WorkloadConfig:
     api_key: Optional[str] = None
     stream: bool = True
     seed: int = 0
+    # ShareGPT-mode workload (benchmarks/data_preprocessing.py output):
+    # real per-user conversations replace the synthetic histories; each
+    # round replays the conversation's next question.
+    workload_path: Optional[str] = None
 
 
 @dataclass
@@ -71,24 +75,44 @@ def synth_words(rng: random.Random, approx_tokens: int) -> str:
 
 
 class UserSession:
-    def __init__(self, cfg: WorkloadConfig, user_id: int, system_prompt: str):
+    def __init__(
+        self,
+        cfg: WorkloadConfig,
+        user_id: int,
+        system_prompt: str,
+        conversation: Optional[List[dict]] = None,
+    ):
         self.cfg = cfg
         self.user_id = user_id
         rng = random.Random(cfg.seed * 1000 + user_id)
+        self.conversation = conversation  # ShareGPT rounds, or None
+        first_user_msg = (
+            conversation[0]["question"]
+            if conversation
+            else synth_words(rng, cfg.chat_history_len)
+        )
         self.messages: List[dict] = [
             {"role": "system", "content": system_prompt},
-            {"role": "user",
-             "content": synth_words(rng, cfg.chat_history_len)},
+            {"role": "user", "content": first_user_msg},
         ]
         self.rng = rng
         self.round = 0
 
+    @property
+    def max_rounds(self) -> int:
+        if self.conversation is not None:
+            return min(self.cfg.num_rounds, len(self.conversation))
+        return self.cfg.num_rounds
+
     async def run_round(self, session: aiohttp.ClientSession) -> RequestRecord:
         rec = RequestRecord(user=self.user_id, round=self.round)
         if self.round > 0:
-            self.messages.append(
-                {"role": "user", "content": synth_words(self.rng, 32)}
+            nxt = (
+                self.conversation[self.round]["question"]
+                if self.conversation is not None
+                else synth_words(self.rng, 32)
             )
+            self.messages.append({"role": "user", "content": nxt})
         payload = {
             "model": self.cfg.model,
             "messages": self.messages,
@@ -152,7 +176,17 @@ class UserSession:
 async def run_benchmark(cfg: WorkloadConfig) -> List[RequestRecord]:
     rng = random.Random(cfg.seed)
     system_prompt = synth_words(rng, cfg.system_prompt_len)
-    users = [UserSession(cfg, u, system_prompt) for u in range(cfg.num_users)]
+    convs: Optional[List[List[dict]]] = None
+    if cfg.workload_path:
+        with open(cfg.workload_path) as f:
+            convs = [u["rounds"] for u in json.load(f)["users"]]
+    users = [
+        UserSession(
+            cfg, u, system_prompt,
+            conversation=convs[u % len(convs)] if convs else None,
+        )
+        for u in range(cfg.num_users)
+    ]
     records: List[RequestRecord] = []
     sem_done: List[asyncio.Task] = []
 
@@ -162,7 +196,7 @@ async def run_benchmark(cfg: WorkloadConfig) -> List[RequestRecord]:
     ) as session:
 
         async def user_loop(user: UserSession):
-            for _ in range(cfg.num_rounds):
+            for _ in range(user.max_rounds):
                 records.append(await user.run_round(session))
 
         # Poisson arrivals: stagger user starts at the target QPS.
@@ -205,6 +239,8 @@ def main(argv=None) -> dict:
     p.add_argument("--no-stream", dest="stream", action="store_false")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--output", default=None, help="per-request CSV path")
+    p.add_argument("--workload", default=None,
+                   help="ShareGPT workload JSON (data_preprocessing.py)")
     args = p.parse_args(argv)
 
     cfg = WorkloadConfig(
@@ -213,6 +249,7 @@ def main(argv=None) -> dict:
         chat_history_len=args.chat_history_len, answer_len=args.answer_len,
         model=args.model, base_url=args.base_url.rstrip("/"),
         api_key=args.api_key, stream=args.stream, seed=args.seed,
+        workload_path=args.workload,
     )
     t0 = time.time()
     records = asyncio.run(run_benchmark(cfg))

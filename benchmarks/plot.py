@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Plot multi-round-QA sweep results (the reference's `plot.py` analogue).
+
+Input: one or more per-request CSVs written by ``multi_round_qa.py
+--output`` (or a directory of them), each typically one QPS point of a
+sweep driven by ``run.sh``/``run_single.sh``. Output: a two-panel figure —
+TTFT percentiles vs served QPS, and completion-token throughput vs served
+QPS — the comparison chart the reference publishes for router/KV-offload
+configurations.
+
+Usage:
+  python benchmarks/plot.py results/*.csv -o sweep.png
+  python benchmarks/plot.py results_dir/ -o sweep.png --label my-config
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from typing import Dict, List
+
+
+def load_csv(path: str) -> List[dict]:
+    with open(path, newline="") as f:
+        return [row for row in csv.DictReader(f)]
+
+
+def point(rows: List[dict]) -> Dict[str, float]:
+    import numpy as np
+
+    ok = [r for r in rows if r["status"] == "200" and float(r["ttft_s"]) >= 0]
+    if not ok:
+        return {}
+    ttfts = np.array([float(r["ttft_s"]) for r in ok])
+    launches = np.array([float(r["launch_time"]) for r in ok])
+    lat = np.array([float(r["latency_s"]) for r in ok])
+    toks = np.array([int(r["completion_tokens"]) for r in ok])
+    wall = max(float(launches.max() + lat.max() - launches.min()), 1e-9)
+    return {
+        "qps": len(ok) / wall,
+        "ttft_p50_ms": float(np.percentile(ttfts, 50)) * 1e3,
+        "ttft_p99_ms": float(np.percentile(ttfts, 99)) * 1e3,
+        "gen_tok_per_s": float(toks.sum()) / wall,
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+", help="CSV files or directories")
+    p.add_argument("-o", "--output", default="sweep.png")
+    p.add_argument("--label", default="production-stack-tpu")
+    args = p.parse_args(argv)
+
+    paths: List[str] = []
+    for item in args.inputs:
+        if os.path.isdir(item):
+            paths += sorted(
+                os.path.join(item, f)
+                for f in os.listdir(item)
+                if f.endswith(".csv")
+            )
+        else:
+            paths.append(item)
+    pts = [pt for pt in (point(load_csv(pp)) for pp in paths) if pt]
+    if not pts:
+        raise SystemExit("no valid request rows found in the inputs")
+    pts.sort(key=lambda d: d["qps"])
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    qps = [d["qps"] for d in pts]
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2))
+    ax1.plot(qps, [d["ttft_p50_ms"] for d in pts], "o-", label="p50 TTFT")
+    ax1.plot(qps, [d["ttft_p99_ms"] for d in pts], "s--", label="p99 TTFT")
+    ax1.set_xlabel("served QPS")
+    ax1.set_ylabel("TTFT (ms)")
+    ax1.set_title(f"TTFT vs QPS — {args.label}")
+    ax1.legend()
+    ax1.grid(alpha=0.3)
+    ax2.plot(qps, [d["gen_tok_per_s"] for d in pts], "o-")
+    ax2.set_xlabel("served QPS")
+    ax2.set_ylabel("generation tok/s")
+    ax2.set_title("Throughput vs QPS")
+    ax2.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=140)
+    print(f"wrote {args.output} ({len(pts)} sweep points)")
+
+
+if __name__ == "__main__":
+    main()

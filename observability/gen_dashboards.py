@@ -114,6 +114,17 @@ def fleet_dashboard():
     p.append(panel("Router request stats (QPS per backend)", [
         ('vllm:current_qps', "{{server}}"),
     ], 16, 18))
+    # Row 5 — speculative decoding (engines started with --speculative-ngram).
+    p.append(panel("Speculative decode: draft vs accepted tok/s", [
+        ('sum(rate(vllm:spec_decode_num_draft_tokens_total[2m]))', "drafted"),
+        ('sum(rate(vllm:spec_decode_num_accepted_tokens_total[2m]))',
+         "accepted"),
+    ], 0, 25))
+    p.append(panel("Speculative decode: acceptance rate", [
+        ('sum(rate(vllm:spec_decode_num_accepted_tokens_total[2m])) / '
+         'clamp_min(sum(rate(vllm:spec_decode_num_draft_tokens_total[2m])),'
+         ' 1e-9)', "accept rate"),
+    ], 8, 25, unit="percentunit"))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

@@ -98,7 +98,7 @@ bool is_chunked(const std::string& headers) {
 
 int http_stream(const std::string& url,
                 const std::function<bool(const std::string&)>& on_line,
-                const volatile sig_atomic_t* stop, int timeout_sec) {
+                const std::atomic<int>* stop, int timeout_sec) {
   // Never throws: watch threads have no exception handler of their own —
   // a parse failure must degrade to "stream unavailable", not terminate.
   Url u;
@@ -131,7 +131,8 @@ int http_stream(const std::string& url,
   int status = 0;
   time_t deadline = time(nullptr) + timeout_sec;
   char buf[16384];
-  while (!(stop && *stop) && time(nullptr) < deadline) {
+  while (!(stop && stop->load(std::memory_order_relaxed)) &&
+         time(nullptr) < deadline) {
     ssize_t n = recv(fd, buf, sizeof(buf), 0);
     if (n == 0) break;  // server closed
     if (n < 0) {

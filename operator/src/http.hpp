@@ -8,6 +8,7 @@
 #pragma once
 
 #include <csignal>
+#include <atomic>
 #include <functional>
 #include <string>
 
@@ -39,6 +40,6 @@ HttpResponse http_request(const std::string& method, const std::string& url,
 // Returns the HTTP status (0 on transport error before headers).
 int http_stream(const std::string& url,
                 const std::function<bool(const std::string&)>& on_line,
-                const volatile sig_atomic_t* stop, int timeout_sec = 30);
+                const std::atomic<int>* stop, int timeout_sec = 30);
 
 }  // namespace pst

@@ -63,7 +63,7 @@ bool K8sClient::destroy(const std::string& api_prefix,
 
 int K8sClient::watch(const std::string& api_prefix, const std::string& plural,
                      const std::function<bool(const std::string&)>& on_event,
-                     const volatile sig_atomic_t* stop,
+                     const std::atomic<int>* stop,
                      int idle_timeout_sec) const {
   return http_stream(url(api_prefix, plural, "", "watch=true"), on_event,
                      stop, idle_timeout_sec);

@@ -40,7 +40,7 @@ class K8sClient {
   // timeout; returns the HTTP status (0 = transport error).
   int watch(const std::string& api_prefix, const std::string& plural,
             const std::function<bool(const std::string&)>& on_event,
-            const volatile sig_atomic_t* stop, int idle_timeout_sec = 60) const;
+            const std::atomic<int>* stop, int idle_timeout_sec = 60) const;
 
  private:
   std::string url(const std::string& api_prefix, const std::string& plural,

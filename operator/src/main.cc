@@ -30,8 +30,11 @@
 
 namespace {
 
-volatile sig_atomic_t g_stop = 0;
-void handle_signal(int) { g_stop = 1; }
+// std::atomic<int>: written by the signal handler AND read by the
+// watch/metrics threads — sig_atomic_t is only signal-safe, not
+// thread-safe (TSAN flags the pair). Lock-free atomic int is both.
+std::atomic<int> g_stop{0};
+void handle_signal(int) { g_stop.store(1, std::memory_order_relaxed); }
 
 struct Options {
   std::string api_server = "http://127.0.0.1:8001";

@@ -23,16 +23,10 @@ from ..models.bert import (
     get_bert_config,
     load_hf_bert_params,
 )
+from .runner import _pow2
 from .tokenizer import get_tokenizer
 
 logger = init_logger(__name__)
-
-
-def _pow2(n: int, cap: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return min(p, cap)
 
 
 class CrossEncoder:

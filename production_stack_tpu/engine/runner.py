@@ -679,7 +679,13 @@ class ModelRunner:
         last_idx = np.zeros(Bb, np.int32)
         for i, s in enumerate(seqs):
             p0 = s.num_tokens - 1  # the not-yet-computed last token
-            tokens[i, 0] = s.all_token_ids[-1]
+            # Direct last-token read: all_token_ids would rebuild the full
+            # prompt+output list per row per step (O(context) host work).
+            tokens[i, 0] = (
+                s.output_token_ids[-1]
+                if s.output_token_ids
+                else s.prompt_token_ids[-1]
+            )
             tokens[i, 1:] = drafts[i]
             positions[i] = p0 + np.arange(T, dtype=np.int32)
             covered = len(s.block_ids) * bs  # draftless near-limit rows may

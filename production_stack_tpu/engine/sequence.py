@@ -68,12 +68,13 @@ class SamplingParams:
         return allowed
 
     def guided_done(self, output_so_far: Seq[int]) -> bool:
-        """True when the output IS one of the choices and no longer choice
-        still extends it — generation must stop."""
+        """True when no choice continuation remains — the completed-choice
+        case, and also any dead end (e.g. EOS emitted under ignore_eos at a
+        completed prefix choice): stopping beats serving a fully-masked
+        logit row whose argmax would be garbage token 0."""
         if not self.guided_choice:
             return False
-        out = tuple(output_so_far)
-        return out in self.guided_choice and not self.guided_allowed(out)
+        return self.guided_allowed(output_so_far) == []
 
     @property
     def has_penalties(self) -> bool:
