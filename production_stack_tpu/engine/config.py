@@ -47,6 +47,12 @@ class EngineConfig:
     # axis; the combine reduction is the one ep all-reduce XLA inserts.
     expert_parallel_size: int = 1
     kv_cache_dtype: Optional[str] = None  # default: model dtype
+    # Weight-only quantization: "int8" stores matmul weights as int8 with
+    # per-output-channel scales (models/llama.py quantize_leaf). Halves
+    # weight HBM and decode's weight-read bandwidth — what fits Llama-3-8B
+    # plus its KV on one 16 GiB v5e chip (the reference serves the same 8B
+    # benchmark model on a 40 GiB A100). None = native dtype.
+    quantization: Optional[str] = None  # None | int8
     attn_impl: str = "auto"  # auto | gather | pallas
     # MoE execution strategy: ragged (dropless lax.ragged_dot grouped
     # matmul — FLOP-proportional, the single-shard default) | dense
@@ -108,6 +114,18 @@ class EngineConfig:
     kv_role: str = "none"  # none | producer | consumer | both
 
 
+# Known per-chip HBM for backends whose memory_stats() is empty (the tunnel-
+# attached chips used for bench runs report none). Public TPU specs.
+_HBM_BY_DEVICE_KIND = {
+    "TPU v5 lite": 16 * 1024**3,
+    "TPU v5e": 16 * 1024**3,
+    "TPU v4": 32 * 1024**3,
+    "TPU v5p": 95 * 1024**3,
+    "TPU v6 lite": 32 * 1024**3,
+    "TPU v6e": 32 * 1024**3,
+}
+
+
 def resolve_num_kv_blocks(
     cfg: EngineConfig, model_cfg: LlamaConfig, param_bytes_per_device: int
 ) -> int:
@@ -139,6 +157,10 @@ def resolve_num_kv_blocks(
     except Exception:
         pass
     hbm = stats.get("bytes_limit")
+    if not hbm:
+        # Some backends (e.g. remote-attached chips) report no memory stats;
+        # fall back to the known HBM of the device kind.
+        hbm = _HBM_BY_DEVICE_KIND.get(getattr(dev, "device_kind", ""))
     if not hbm:
         # Virtual CPU devices: keep the cache modest (tests override anyway).
         budget = 512 * 1024 * 1024

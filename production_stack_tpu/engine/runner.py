@@ -14,6 +14,7 @@ Sampling runs inside the same jit (logits never leave the device); only the
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 import time
@@ -27,7 +28,16 @@ from jax.numpy import asarray as jnp_asarray
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..logging_utils import init_logger
-from ..models.llama import Llama, LlamaConfig, load_hf_params
+from ..models.llama import (
+    QUANT_LAYER_KEYS,
+    QUANT_SUFFIX,
+    QUANT_TOP_KEYS,
+    Llama,
+    LlamaConfig,
+    init_leaf,
+    load_hf_params,
+    quantize_leaf,
+)
 from ..models.registry import get_model_config
 from ..ops.sampling import (
     apply_allowed_mask,
@@ -136,21 +146,45 @@ class ModelRunner:
         )
 
         t0 = time.time()
+        quant = cfg.quantization or None
+        if quant not in (None, "int8"):
+            raise ValueError(f"unsupported quantization {quant!r} (int8 only)")
+        pspecs = self.model.param_pspecs(pipeline=pp > 1, quantize=bool(quant))
+        if cfg.enable_lora:
+            pspecs["layers"].update(self.model.lora_pspecs(pipeline=pp > 1))
         if os.path.isdir(cfg.model):
-            params = load_hf_params(self.model_cfg, cfg.model)
+            # quantize=True stages + quantizes in numpy on the host: the
+            # bf16 tree of an 8B model never exists in HBM next to the int8
+            # one (and no CPU JAX backend is needed under a pinned
+            # JAX_PLATFORMS).
+            params = load_hf_params(
+                self.model_cfg, cfg.model, quantize=bool(quant)
+            )
+            if cfg.enable_lora:
+                params["layers"].update(
+                    self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
+                )
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                params,
+                pspecs,
+            )
+        elif quant:
+            # Preset (random-init) + quantized: materialize leaf-by-leaf
+            # straight into device shardings — peak HBM is the int8 tree
+            # plus one transient bf16 leaf.
+            self.params = self._init_params_streamed(pspecs)
         else:
             params = self.model.init_params(jax.random.PRNGKey(cfg.seed))
-        pspecs = self.model.param_pspecs(pipeline=pp > 1)
-        if cfg.enable_lora:
-            params["layers"].update(
-                self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
+            if cfg.enable_lora:
+                params["layers"].update(
+                    self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
+                )
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                params,
+                pspecs,
             )
-            pspecs["layers"].update(self.model.lora_pspecs(pipeline=pp > 1))
-        self.params = jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            params,
-            pspecs,
-        )
         leaves = jax.tree.leaves(self.params)
         self.param_count = sum(x.size for x in leaves)
         param_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
@@ -330,6 +364,70 @@ class ModelRunner:
         # otherwise interleave broadcasts, diverging the followers' XLA
         # program order from the primary's (collective deadlock).
         self._device_lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # Streamed param materialization (quantized presets)
+    # ------------------------------------------------------------------
+
+    def _init_params_streamed(self, pspecs: Dict[str, Any]) -> Dict[str, Any]:
+        """Random-init params leaf-by-leaf, each jitted directly into its
+        device sharding and (for matmul weights) quantized to int8 on
+        device before the next leaf materializes. Peak HBM = final int8
+        tree + ONE transient bf16 leaf — how an 8B preset initializes on a
+        16 GiB chip where the bf16 tree alone would OOM."""
+        cfg = self.cfg
+        rng = jax.random.PRNGKey(cfg.seed)
+        shapes = jax.eval_shape(self.model.init_params, rng)
+        if cfg.enable_lora:
+            shapes["layers"].update(
+                jax.eval_shape(
+                    functools.partial(
+                        self.model.init_lora_bank,
+                        cfg.max_loras,
+                        cfg.max_lora_rank,
+                    )
+                )
+            )
+
+        def build(name, sds, specs_at, into):
+            key = jax.random.fold_in(
+                rng, xxhash.xxh32(name.encode()).intdigest() & 0x7FFF_FFFF
+            )
+            qaxis = (
+                -2 if name in QUANT_LAYER_KEYS
+                else -1 if name in QUANT_TOP_KEYS
+                else None
+            )
+            if qaxis is None:
+                into[name] = jax.jit(
+                    functools.partial(init_leaf, name, sds.shape, sds.dtype),
+                    out_shardings=NamedSharding(self.mesh, specs_at[name]),
+                )(key)
+                return
+
+            def init_q(k):  # one jit per leaf: init + quantize fused
+                return quantize_leaf(
+                    init_leaf(name, sds.shape, sds.dtype, k), axis=qaxis
+                )
+
+            qname = name + QUANT_SUFFIX
+            q, s = jax.jit(
+                init_q,
+                out_shardings=(
+                    NamedSharding(self.mesh, specs_at[name]),
+                    NamedSharding(self.mesh, specs_at[qname]),
+                ),
+            )(key)
+            into[name], into[qname] = q, s
+
+        out: Dict[str, Any] = {"layers": {}}
+        for name, sds in shapes.items():
+            if name == "layers":
+                continue
+            build(name, sds, pspecs, out)
+        for name, sds in shapes["layers"].items():
+            build(name, sds, pspecs["layers"], out["layers"])
+        return out
 
     # ------------------------------------------------------------------
     # Page I/O for KV tiering (HBM ↔ host DRAM, the LMCache-offload hook).
@@ -512,6 +610,12 @@ class ModelRunner:
         if n_steps == 1:
             return self.execute_decode(seqs)[:, None]
         batch = self._decode_batch(seqs, multi=True)
+        # Guided-choice masks are rebuilt per token host-side; the scan body
+        # cannot apply them. The scheduler forces n=1 for guided rows — fail
+        # loudly if that invariant ever breaks instead of dropping the mask.
+        assert "allowed_ids" not in batch, (
+            "guided-choice rows reached a multi-step decode burst"
+        )
         want_lp = self._want_lp(seqs)
         with self._device_lock:
             if self.publisher is not None:
@@ -554,6 +658,9 @@ class ModelRunner:
         """Dispatch the first burst of a pipeline (async; nothing fetched)."""
         assert self._burst is None, "burst already in flight (drain first)"
         batch = self._decode_batch(seqs, multi=True)
+        assert "allowed_ids" not in batch, (
+            "guided-choice rows reached a pipelined decode burst"
+        )
         want_lp = self._want_lp(seqs)
         with self._device_lock:
             if self.publisher is not None:

@@ -914,6 +914,9 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--moe-impl", default="auto",
                    choices=["auto", "ragged", "dense"])
     p.add_argument("--kv-cache-dtype", default=None)
+    # Weight-only int8 (per-output-channel scales): the `vllm serve
+    # --quantization` analogue; what fits an 8B model + KV on one 16 GiB v5e.
+    p.add_argument("--quantization", default=None, choices=["int8"])
     p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument(
@@ -971,6 +974,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         sequence_parallel_size=args.sequence_parallel_size,
         expert_parallel_size=args.expert_parallel_size,
         kv_cache_dtype=args.kv_cache_dtype,
+        quantization=args.quantization,
         attn_impl=args.attn_impl,
         moe_impl=args.moe_impl,
         enable_prefix_caching=args.enable_prefix_caching,

@@ -44,6 +44,77 @@ logger = init_logger(__name__)
 
 Params = Dict[str, Any]
 
+# ----------------------------------------------------------------------------
+# Weight-only int8 quantization (per-output-channel symmetric).
+#
+# The reference serves its 8B benchmark model on a 40 GiB A100
+# (`tutorials/07-benchmark-multi-round-qa-single-gpu.md:5`); one v5e chip has
+# 16 GiB, so bf16 8B weights (~16 GiB) cannot sit next to their KV. Weight-only
+# int8 halves weight HBM (and decode's weight-read bandwidth, the decode-step
+# floor) while keeping activations/accumulation in bf16/fp32 on the MXU:
+# ``y = (x @ w_int8→bf16) * scale`` is exact for per-output-channel scales, and
+# XLA fuses the int8→bf16 convert into the matmul's HBM read.
+#
+# The scale for quantized leaf ``w`` is stored as sibling leaf ``w_qs``.
+# Matmul weights ([..., in, out] layout) quantize over their input dim
+# (axis -2); embedding tables ([V, D]) over the hidden dim (axis -1) so one
+# per-row scale serves both the lookup and the tied unembed.
+# ----------------------------------------------------------------------------
+
+QUANT_SUFFIX = "_qs"
+QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANT_TOP_KEYS = ("embed", "lm_head")
+
+
+def quantize_leaf(w: jax.Array, axis: int = -2) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8: returns (int8 weights, fp32 scales).
+    ``axis`` is the contraction (input) dim the scale reduces over."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / jnp.expand_dims(s, axis)), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def quantize_tree(params: Params) -> Params:
+    """Quantize all matmul weights of a loaded param tree in place.
+    Used by the HF-checkpoint path (host-side); random-init presets use the
+    streamed per-leaf path in the runner instead (never holds the bf16 tree)."""
+    layers = params["layers"]
+    for k in QUANT_LAYER_KEYS:
+        if k in layers:
+            q, s = quantize_leaf(layers[k], axis=-2)
+            layers[k] = q
+            layers[k + QUANT_SUFFIX] = s
+    for k in QUANT_TOP_KEYS:
+        if k in params:
+            q, s = quantize_leaf(params[k], axis=-1)
+            params[k] = q
+            params[k + QUANT_SUFFIX] = s
+    return params
+
+
+def _wcast(w: jax.Array, dtype) -> jax.Array:
+    """Weight operand for a matmul: int8 leaves convert on the fly (XLA
+    fuses the convert into the dot's HBM read — the bandwidth saving is
+    kept); everything else passes through."""
+    return w.astype(dtype) if w.dtype == jnp.int8 else w
+
+
+def init_leaf(name: str, shape, dtype, key: jax.Array) -> jax.Array:
+    """One param leaf's random init, matching :meth:`Llama.init_params`
+    distributions by name. Used by the runner's streamed materialization
+    (leaf-by-leaf, jitted straight into its device sharding) so big-model
+    init never holds the full bf16 tree anywhere."""
+    if "norm" in name:
+        return jnp.ones(shape, dtype)
+    if name.startswith(("b", "lora_")):
+        return jnp.zeros(shape, dtype)
+    fan_in = shape[-1] if name in QUANT_TOP_KEYS else shape[-2]
+    return (
+        jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+    ).astype(dtype)
+
 
 def pp_compose(run_stage, x, replicated, scanned, pp_size: int, mesh):
     """Compose layer-stages across the ``pp`` mesh axis by rotating
@@ -229,7 +300,9 @@ class Llama:
             params["lm_head"] = dense(k[0], (cfg.vocab_size, D), D)
         return params
 
-    def param_pspecs(self, pipeline: bool = False) -> Params:
+    def param_pspecs(
+        self, pipeline: bool = False, quantize: bool = False
+    ) -> Params:
         """PartitionSpec tree matching :meth:`init_params`.
 
         tp shards attention heads and the FFN hidden dim (Megatron layout:
@@ -237,6 +310,8 @@ class Llama:
         emits the single all-reduce per block that layout implies). With
         ``pipeline=True`` the stacked layer axis is additionally sharded over
         pp, giving layer-stage parallelism without restructuring the tree.
+        With ``quantize=True`` the tree additionally carries the int8 scale
+        leaves (``*_qs``), sharded like their weight's output channels.
         """
         pp = "pp" if pipeline else None
         if self.cfg.num_experts:
@@ -280,6 +355,24 @@ class Llama:
             specs["layers"]["post_mlp_norm"] = P(pp, None)
         if not self.cfg.tie_word_embeddings:
             specs["lm_head"] = P(None, AXIS_TENSOR)
+        if quantize:
+            # Scale spec = weight spec minus the reduced (input) axis: the
+            # scale shards exactly like its weight's output channels.
+            def drop_axis(spec: P, ndim: int, axis: int) -> P:
+                ent = list(spec) + [None] * (ndim - len(spec))
+                del ent[axis]
+                return P(*ent)
+
+            moe = bool(self.cfg.num_experts)
+            for k in QUANT_LAYER_KEYS:
+                if k in specs["layers"]:
+                    ndim = 4 if (moe and k in ("w_gate", "w_up", "w_down")) else 3
+                    specs["layers"][k + QUANT_SUFFIX] = drop_axis(
+                        specs["layers"][k], ndim, -2
+                    )
+            for k in QUANT_TOP_KEYS:
+                if k in specs:
+                    specs[k + QUANT_SUFFIX] = drop_axis(specs[k], 2, -1)
         return specs
 
     # ------------------------------------------------------------------
@@ -393,7 +486,7 @@ class Llama:
         scale = cfg.attn_scale
         offset = cfg.norm_unit_offset
 
-        x = params["embed"][tokens]  # [B, T, D]
+        x = _embed_lookup(params, tokens, cfg)  # [B, T, D]
         if cfg.embed_scale:
             # HF-Gemma convention: the sqrt(D) normalizer is rounded to the
             # model dtype before multiplying.
@@ -430,9 +523,9 @@ class Llama:
             # would copy the whole layer cache twice per layer per step).
             flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
-            q = _proj(h, lp["wq"], lp.get("bq"))
-            k = _proj(h, lp["wk"], lp.get("bk"))
-            v = _proj(h, lp["wv"], lp.get("bv"))
+            q = _proj(h, lp["wq"], lp.get("bq"), lp.get("wq" + QUANT_SUFFIX))
+            k = _proj(h, lp["wk"], lp.get("bk"), lp.get("wk" + QUANT_SUFFIX))
+            v = _proj(h, lp["wv"], lp.get("bv"), lp.get("wv" + QUANT_SUFFIX))
             if has_lora:
                 q = q + lora_delta(lp, "wq", h).astype(q.dtype)
                 k = k + lora_delta(lp, "wk", h).astype(k.dtype)
@@ -484,13 +577,15 @@ class Llama:
                 window=_layer_window(cfg, li_global),
                 softcap=cfg.attn_logit_softcap,
             )
-            attn = attn.reshape(B, T, cfg.q_size)
+            attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
             o = jnp.einsum(
-                "btq,qd->btd", attn.astype(lp["wo"].dtype), lp["wo"],
+                "btq,qd->btd", attn, _wcast(lp["wo"], x.dtype),
                 preferred_element_type=jnp.float32,
             )
+            if "wo" + QUANT_SUFFIX in lp:
+                o = o * lp["wo" + QUANT_SUFFIX]
             if has_lora:
-                o = o + lora_delta(lp, "wo", attn.astype(lp["wo"].dtype))
+                o = o + lora_delta(lp, "wo", attn)
             o = o.astype(x.dtype)
             if cfg.post_block_norms:  # Gemma-2 post-attention norm
                 o = _rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps, offset)
@@ -550,7 +645,9 @@ class Llama:
             )
 
         x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps, offset)
-        unembed = params.get("lm_head", params["embed"])  # [V, D]
+        head = "lm_head" if "lm_head" in params else "embed"
+        unembed = _wcast(params[head], x.dtype)  # [V, D]
+        uqs = params.get(head + QUANT_SUFFIX)
         if all_logits:
             logits = jnp.einsum(
                 "btd,vd->btv", x, unembed, preferred_element_type=jnp.float32
@@ -560,6 +657,8 @@ class Llama:
             logits = jnp.einsum(
                 "bd,vd->bv", last, unembed, preferred_element_type=jnp.float32
             )
+        if uqs is not None:
+            logits = logits * uqs  # per-vocab-row scale, broadcast over batch
         logits = _softcap(logits, cfg.final_logit_softcap)
         return logits, kv_cache
 
@@ -596,7 +695,7 @@ class Llama:
             )
         offset = cfg.norm_unit_offset
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-        x = params["embed"][tokens]
+        x = _embed_lookup(params, tokens, cfg)
         if cfg.embed_scale:
             x = x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
         rope_cos, rope_sin = _rope_tables(positions, cfg)
@@ -612,15 +711,15 @@ class Llama:
         def layer(ctx, x, lp, li):
             rope_cos, rope_sin, causal = ctx
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
-            q = _proj(h, lp["wq"], lp.get("bq")).reshape(
-                B, T, cfg.num_kv_heads, G, cfg.head_dim
-            )
-            k = _proj(h, lp["wk"], lp.get("bk")).reshape(
-                B, T, cfg.num_kv_heads, cfg.head_dim
-            )
-            v = _proj(h, lp["wv"], lp.get("bv")).reshape(
-                B, T, cfg.num_kv_heads, cfg.head_dim
-            )
+            q = _proj(
+                h, lp["wq"], lp.get("bq"), lp.get("wq" + QUANT_SUFFIX)
+            ).reshape(B, T, cfg.num_kv_heads, G, cfg.head_dim)
+            k = _proj(
+                h, lp["wk"], lp.get("bk"), lp.get("wk" + QUANT_SUFFIX)
+            ).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            v = _proj(
+                h, lp["wv"], lp.get("bv"), lp.get("wv" + QUANT_SUFFIX)
+            ).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
             if cfg.qk_norm:  # Qwen3: per-head RMSNorm over hd, pre-rope
                 q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
@@ -655,8 +754,12 @@ class Llama:
                     preferred_element_type=jnp.float32,
                 ).reshape(B, T, cfg.q_size).astype(x.dtype)
             o = jnp.einsum(
-                "btq,qd->btd", attn, lp["wo"], preferred_element_type=jnp.float32
-            ).astype(x.dtype)
+                "btq,qd->btd", attn, _wcast(lp["wo"], x.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            if "wo" + QUANT_SUFFIX in lp:
+                o = o * lp["wo" + QUANT_SUFFIX]
+            o = o.astype(x.dtype)
             if cfg.post_block_norms:
                 o = _rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps, offset)
             x = x + o
@@ -742,19 +845,33 @@ def _softcap(logits: jax.Array, cap: float) -> jax.Array:
     return jnp.tanh(logits / cap) * cap if cap else logits
 
 
+def _embed_lookup(params: Params, tokens: jax.Array, cfg: "LlamaConfig") -> jax.Array:
+    """Token embedding gather; int8 tables dequantize with their per-row
+    scale (the same rows the tied unembed scales by)."""
+    x = params["embed"][tokens]
+    s = params.get("embed" + QUANT_SUFFIX)
+    if s is not None:
+        x = (x.astype(jnp.float32) * s[tokens][..., None]).astype(cfg.jdtype)
+    return x
+
+
 def _mlp(cfg: "LlamaConfig", lp: Params, h: jax.Array, moe_impl: str = "auto") -> jax.Array:
     """SwiGLU MLP block output [B, T, D] in fp32 — dense, or Mixtral-style
     sparse mixture-of-experts when ``cfg.num_experts``."""
     act = _act(cfg)
     if not cfg.num_experts:
-        gate = _proj(h, lp["w_gate"])
-        up = _proj(h, lp["w_up"])
+        gate = _proj(h, lp["w_gate"], None, lp.get("w_gate" + QUANT_SUFFIX))
+        up = _proj(h, lp["w_up"], None, lp.get("w_up" + QUANT_SUFFIX))
         ff = (
             act(gate.astype(jnp.float32)) * up.astype(jnp.float32)
-        ).astype(lp["w_down"].dtype)
-        return jnp.einsum(
-            "btf,fd->btd", ff, lp["w_down"], preferred_element_type=jnp.float32
+        ).astype(h.dtype)
+        out = jnp.einsum(
+            "btf,fd->btd", ff, _wcast(lp["w_down"], h.dtype),
+            preferred_element_type=jnp.float32,
         )
+        if "w_down" + QUANT_SUFFIX in lp:
+            out = out * lp["w_down" + QUANT_SUFFIX]
+        return out
     B, T, D = h.shape
     return _moe_mlp(cfg, lp, h.reshape(B * T, D), moe_impl).reshape(B, T, D)
 
@@ -788,6 +905,14 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
     weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
     if impl not in ("ragged", "dense", "auto"):
         raise ValueError(f"unknown moe_impl {impl!r} (ragged|dense|auto)")
+
+    def deq(key: str) -> jax.Array:
+        # ragged_dot has no mixed-dtype story: int8 expert banks dequantize
+        # to one transient [E, ., .] bf16 bank (per layer inside the scan —
+        # storage stays int8, only this layer's working copy is bf16).
+        w, s = lp[key], lp.get(key + QUANT_SUFFIX)
+        return w if s is None else w.astype(x.dtype) * s[:, None, :].astype(x.dtype)
+
     if impl in ("ragged", "auto"):
         flat_ids = ids.reshape(-1)  # [N*K]
         order = jnp.argsort(flat_ids)  # sorted-by-expert slot order
@@ -795,15 +920,15 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
         xs = x[tok]  # [N*K, D]
         group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
         g = jax.lax.ragged_dot(
-            xs, lp["w_gate"], group_sizes,
+            xs, deq("w_gate"), group_sizes,
             preferred_element_type=jnp.float32,
         )
         u = jax.lax.ragged_dot(
-            xs, lp["w_up"], group_sizes, preferred_element_type=jnp.float32
+            xs, deq("w_up"), group_sizes, preferred_element_type=jnp.float32
         )
-        hh = (_act(cfg)(g) * u).astype(lp["w_down"].dtype)
+        hh = (_act(cfg)(g) * u).astype(x.dtype)
         y = jax.lax.ragged_dot(
-            hh, lp["w_down"], group_sizes, preferred_element_type=jnp.float32
+            hh, deq("w_down"), group_sizes, preferred_element_type=jnp.float32
         )  # [N*K, D]
         wsort = weights.reshape(-1)[order]  # [N*K]
         return (
@@ -814,20 +939,37 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
         jax.nn.one_hot(ids, E, dtype=jnp.float32) * weights[..., None], axis=1
     )  # [N, E]
     g = jnp.einsum(
-        "nd,edf->enf", x, lp["w_gate"], preferred_element_type=jnp.float32
+        "nd,edf->enf", x, _wcast(lp["w_gate"], x.dtype),
+        preferred_element_type=jnp.float32,
     )
     u = jnp.einsum(
-        "nd,edf->enf", x, lp["w_up"], preferred_element_type=jnp.float32
+        "nd,edf->enf", x, _wcast(lp["w_up"], x.dtype),
+        preferred_element_type=jnp.float32,
     )
-    hh = (_act(cfg)(g) * u).astype(lp["w_down"].dtype)
+    if "w_gate" + QUANT_SUFFIX in lp:
+        g = g * lp["w_gate" + QUANT_SUFFIX][:, None, :]
+        u = u * lp["w_up" + QUANT_SUFFIX][:, None, :]
+    hh = (_act(cfg)(g) * u).astype(x.dtype)
     y = jnp.einsum(
-        "enf,efd->end", hh, lp["w_down"], preferred_element_type=jnp.float32
+        "enf,efd->end", hh, _wcast(lp["w_down"], x.dtype),
+        preferred_element_type=jnp.float32,
     )
+    if "w_down" + QUANT_SUFFIX in lp:
+        y = y * lp["w_down" + QUANT_SUFFIX][:, None, :]
     return jnp.einsum("end,ne->nd", y, combine)
 
 
-def _proj(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
-    out = jnp.einsum("btd,do->bto", x, w, preferred_element_type=jnp.float32)
+def _proj(
+    x: jax.Array,
+    w: jax.Array,
+    b: Optional[jax.Array] = None,
+    s: Optional[jax.Array] = None,
+) -> jax.Array:
+    out = jnp.einsum(
+        "btd,do->bto", x, _wcast(w, x.dtype), preferred_element_type=jnp.float32
+    )
+    if s is not None:  # int8 per-output-channel scale
+        out = out * s
     if b is not None:
         out = out + b.astype(out.dtype)
     return out.astype(x.dtype)
@@ -900,12 +1042,31 @@ _HF_BIAS_MAP = {
 }
 
 
-def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
+def _np_quantize(w: np.ndarray, axis: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side (numpy) int8 quantization for checkpoint loading: the bf16
+    tree of a big model must never land on the device, and the CPU JAX
+    backend may be absent when JAX_PLATFORMS pins the TPU platform."""
+    if w.dtype == np.uint16:  # raw bf16 bit pattern from safetensors
+        import ml_dtypes
+
+        w = w.view(ml_dtypes.bfloat16)
+    wf = w.astype(np.float32)
+    amax = np.max(np.abs(wf), axis=axis)
+    s = np.maximum(amax, 1e-8) / 127.0
+    q = np.clip(np.round(wf / np.expand_dims(s, axis)), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def load_hf_params(
+    cfg: LlamaConfig, model_dir: str, quantize: bool = False
+) -> Params:
     """Load HF-format safetensors from a local directory into the pytree.
 
     HF linear weights are stored ``[out, in]``; ours are ``[in, out]`` so the
     forward is a plain ``x @ w`` (no transposes at serve time). Layers are
-    stacked on axis 0 to match the scan layout.
+    stacked on axis 0 to match the scan layout. With ``quantize``, matmul
+    weights become int8 + ``*_qs`` scales, computed in numpy on the host —
+    the big leaves stay host-resident until the runner's sharded device_put.
     """
     from safetensors import safe_open
 
@@ -941,10 +1102,17 @@ def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
             ).astype(d)
         return jnp.asarray(arr).astype(d)
 
-    params["embed"] = cast(raw.pop("model.embed_tokens.weight"))
+    def put_top(name: str, arr: np.ndarray) -> None:
+        if quantize and name in QUANT_TOP_KEYS:
+            q, s = _np_quantize(arr, axis=-1)
+            params[name], params[name + QUANT_SUFFIX] = q, s
+        else:
+            params[name] = cast(arr)
+
+    put_top("embed", raw.pop("model.embed_tokens.weight"))
     params["final_norm"] = cast(raw.pop("model.norm.weight"))
     if "lm_head.weight" in raw:
-        params["lm_head"] = cast(raw.pop("lm_head.weight"))
+        put_top("lm_head", raw.pop("lm_head.weight"))
 
     layer_map = dict(_HF_LAYER_MAP)
     if cfg.qk_norm:
@@ -998,7 +1166,13 @@ def load_hf_params(cfg: LlamaConfig, model_dir: str) -> Params:
             ]
 
     for name, stack in layer_acc.items():
-        params["layers"][name] = cast(np.stack(stack, axis=0))
+        stacked = np.stack(stack, axis=0)
+        if quantize and name in QUANT_LAYER_KEYS:
+            q, s = _np_quantize(stacked, axis=-2)
+            params["layers"][name] = q
+            params["layers"][name + QUANT_SUFFIX] = s
+        else:
+            params["layers"][name] = cast(stacked)
     logger.info("loaded %d HF tensors from %s", len(raw) + 3, model_dir)
     return params
 
