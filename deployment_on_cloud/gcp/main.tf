@@ -1,0 +1,98 @@
+# GKE cluster with a v5e TPU node pool for production-stack-tpu.
+# Reference analogue: deployment_on_cloud/gcp (GPU GKE terraform), re-aimed
+# at TPU node pools (`google.com/tpu` resources, ct5lp machine types).
+#
+# Usage:
+#   cd deployment_on_cloud/gcp
+#   terraform init
+#   terraform apply -var project_id=my-proj -var region=us-west4
+#   gcloud container clusters get-credentials pst --region us-west4
+#   ../../utils/install-lws-crd.sh && helm install pst ../../helm \
+#       -f ../../helm/examples/values-minimal.yaml
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.30"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+resource "google_container_cluster" "pst" {
+  name     = var.cluster_name
+  location = var.region
+
+  # One small CPU node pool for the router/operator/observability pods;
+  # TPU pools attach below.
+  remove_default_node_pool = true
+  initial_node_count       = 1
+  deletion_protection      = false
+
+  release_channel {
+    channel = "REGULAR"
+  }
+}
+
+resource "google_container_node_pool" "cpu" {
+  name     = "cpu-pool"
+  cluster  = google_container_cluster.pst.id
+  location = var.region
+
+  node_count = var.cpu_node_count
+  node_config {
+    machine_type = var.cpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# TPU v5e node pool. Machine type encodes the per-VM topology:
+#   ct5lp-hightpu-1t  -> 1 chip/VM  (single-chip engines)
+#   ct5lp-hightpu-4t  -> 4 chips/VM (tp=4 engines)
+#   ct5lp-hightpu-8t  -> 8 chips/VM (tp=8 engines)
+# Multi-host slices (v5e-16 and up: the BASELINE.md north-star pool) use
+# placement_policy tpu_topology + the LWS multihost template
+# (helm/templates/multihost-engine.yaml).
+resource "google_container_node_pool" "tpu" {
+  name     = "tpu-v5e-pool"
+  cluster  = google_container_cluster.pst.id
+  location = var.region
+
+  initial_node_count = var.tpu_node_count
+  node_config {
+    machine_type = var.tpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+
+    # Engine pods select this pool (helm values nodeSelectorTerms).
+    labels = {
+      "pst/pool" = "tpu-v5e"
+    }
+  }
+
+  dynamic "placement_policy" {
+    for_each = var.tpu_topology == "" ? [] : [var.tpu_topology]
+    content {
+      type         = "COMPACT"
+      tpu_topology = placement_policy.value # e.g. "4x4" for v5e-16
+    }
+  }
+
+  autoscaling {
+    min_node_count = var.tpu_min_nodes
+    max_node_count = var.tpu_max_nodes
+  }
+}
+
+output "cluster_name" {
+  value = google_container_cluster.pst.name
+}
+
+output "get_credentials" {
+  value = "gcloud container clusters get-credentials ${google_container_cluster.pst.name} --region ${var.region} --project ${var.project_id}"
+}

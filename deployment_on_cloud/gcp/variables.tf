@@ -1,0 +1,53 @@
+variable "project_id" {
+  type        = string
+  description = "GCP project to deploy into"
+}
+
+variable "region" {
+  type        = string
+  default     = "us-west4" # v5e availability
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "pst"
+}
+
+variable "cpu_machine_type" {
+  type    = string
+  default = "e2-standard-8"
+}
+
+variable "cpu_node_count" {
+  type    = number
+  default = 2
+}
+
+# ct5lp-hightpu-4t = one v5e host VM with 4 chips (tp=4 engine per pod).
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-4t"
+}
+
+variable "tpu_node_count" {
+  type    = number
+  default = 1
+}
+
+variable "tpu_min_nodes" {
+  type    = number
+  default = 0
+}
+
+variable "tpu_max_nodes" {
+  type    = number
+  default = 4
+}
+
+# Multi-host slice topology ("" = single-host pools). "4x4" provisions a
+# v5e-16 slice — the BASELINE.md north-star pool — whose hosts the
+# LeaderWorkerSet multihost template spans.
+variable "tpu_topology" {
+  type    = string
+  default = ""
+}

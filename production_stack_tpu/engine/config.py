@@ -65,6 +65,15 @@ class EngineConfig:
     # after the burst; at most n-1 speculatively-decoded tokens are discarded
     # per finished request. 1 = classic per-token stepping.
     num_decode_steps: int = 1
+    # Adaptive burst depth: when the arrival stream has been quiet for
+    # ``adaptive_decode_quiet_s`` and nothing is waiting, decode bursts
+    # deepen to this many steps (amortizing the fixed per-dispatch
+    # host<->device latency — ~73 ms on tunnel-attached chips — over more
+    # tokens). Gated on PAST arrivals only, so a live Poisson stream keeps
+    # bursts at num_decode_steps and tail latency is unaffected; saturated
+    # decode (batch/offline phases) runs at the deep setting. 0 = off.
+    adaptive_decode_steps: int = 0
+    adaptive_decode_quiet_s: float = 0.5
     # Floor for the decode-batch row bucket. Serving workloads whose active
     # set fluctuates otherwise walk through every power-of-two width,
     # compiling each one the first time it appears (an XLA compile mid-burst

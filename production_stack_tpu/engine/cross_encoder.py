@@ -60,8 +60,8 @@ class CrossEncoder:
     def _score_chunk(self, pairs: Sequence[Tuple[str, str]]) -> List[float]:
         encoded = []
         for a, b in pairs:
-            ids, types = self.tokenizer.encode_pair(a, b)
-            encoded.append((ids[: self.max_len], types[: self.max_len]))
+            ids, types = self.tokenizer.encode_pair(a, b, max_len=self.max_len)
+            encoded.append((ids, types))
         B = len(encoded)
         Bb = _pow2(B, self.max_batch)
         Tb = _pow2(max(len(x) for x, _ in encoded), self.max_len)

@@ -110,6 +110,8 @@ class LLMEngine:
             self.lora_manager = None
         # Unloaded-adapter slots awaiting their last in-flight sequence.
         self._retiring_slots: set = set()
+        # Last request arrival (adaptive burst-depth gate).
+        self._last_arrival = 0.0
         self._seqs: Dict[str, Sequence] = {}
         # Incremental detokenizer state per request:
         # emitted text + [prefix_offset, read_offset) decode window.
@@ -165,6 +167,7 @@ class LLMEngine:
             lora_scale=lora_scale,
             cache_salt=salt,
         )
+        self._last_arrival = time.time()
         self.scheduler.add(seq)
         self._seqs[request_id] = seq
         self._detok[request_id] = {"emitted": "", "prefix": 0, "read": 0}
@@ -273,11 +276,26 @@ class LLMEngine:
     # Stepping
     # ------------------------------------------------------------------
 
+    def _decode_depth_hint(self) -> Optional[int]:
+        """Adaptive burst depth: deepen only when the arrival stream has
+        been quiet (PAST arrivals only — a live request stream keeps bursts
+        at the configured depth, so the deepening never costs tail latency
+        it didn't already have)."""
+        cap = self.cfg.adaptive_decode_steps
+        if not cap or cap <= self.cfg.num_decode_steps:
+            return None
+        if self.scheduler.num_waiting:
+            return None
+        if time.time() - self._last_arrival < self.cfg.adaptive_decode_quiet_s:
+            return None
+        return cap
+
     def step(self) -> List[RequestOutput]:
         outputs: List[RequestOutput] = []
+        hint = self._decode_depth_hint()
         if self.runner.burst_in_flight:
             locked = frozenset(s.request_id for s in self._burst_seqs)
-            sched = self.scheduler.schedule(locked=locked)
+            sched = self.scheduler.schedule(locked=locked, n_decode=hint)
             self.num_preempted_total += len(sched.preempted)
             if self._can_continue_burst(sched):
                 rows = self.runner.burst_continue(self._burst_seqs)
@@ -303,9 +321,9 @@ class LLMEngine:
                 outputs += self._process_prefill_rows(sched.prefills, prows)
                 self._sweep_retiring_slots()
                 return outputs
-            sched = self.scheduler.schedule()
+            sched = self.scheduler.schedule(n_decode=hint)
         else:
-            sched = self.scheduler.schedule()
+            sched = self.scheduler.schedule(n_decode=hint)
         self.num_preempted_total += len(sched.preempted)
         if sched.is_empty:
             self._sweep_retiring_slots()

@@ -86,13 +86,11 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
             logger.info("follower shutting down")
             return
         if kind == "step":
-            batch, want_lp = payload
-            runner._dispatch_step(batch, want_lp)
+            runner._dispatch_step(*payload)
         elif kind == "step_nofetch":
             runner._dispatch_step_nofetch(payload)
         elif kind == "multi_step":
-            batch, n_steps, want_lp = payload
-            runner._dispatch_multi_step(batch, n_steps, want_lp)
+            runner._dispatch_multi_step(*payload)
         elif kind == "encode":
             toks, length = payload
             runner._dispatch_encode(toks, length)
@@ -111,8 +109,7 @@ def run_follower(runner, bridge: Optional[HostBridge] = None) -> None:
         elif kind == "uninstall_adapter":
             runner._dispatch_uninstall_adapter(int(payload))
         elif kind == "burst_start":
-            batch, n_steps, want_lp = payload
-            runner._dispatch_burst_start(batch, n_steps, want_lp)
+            runner._dispatch_burst_start(*payload)
         elif kind == "burst_cont":
             tables, kv_lens = payload
             runner._dispatch_burst_continue(tables, kv_lens)

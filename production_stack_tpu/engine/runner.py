@@ -68,10 +68,16 @@ _MIN_TABLE_BUCKET = 64
 
 
 def _fetch(arr) -> np.ndarray:
-    """Device→host fetch tuned for remote-attached chips: the blocking
-    device_get path costs ~2x a readiness-polled async copy there, and when
-    the copy was already started at dispatch time (see the burst pipeline)
-    the array is host-resident before anyone asks.
+    """Device→host fetch tuned for remote-attached chips: start the async
+    copy, poll readiness, then read through ``jax.device_get``.
+
+    The final read MUST be device_get, not ``np.asarray``: on the tunneled
+    backend ``np.asarray`` issues a fresh synchronous transfer RPC every
+    call (~45 ms for 128 BYTES) even when the async copy already landed,
+    while device_get returns the copied value in ~0.2 ms. Measured
+    (scripts/tpu_decode_profile.py methodology, r4): asarray(ready) 46.8 ms
+    vs device_get(ready) 0.2 ms — this one line was most of the decode
+    step's 80 ms non-compute overhead.
 
     Poll interval note: isolated probes suggested longer sleeps (5-10 ms)
     can beat tight polling on a single-core host (the loop competes with
@@ -84,7 +90,7 @@ def _fetch(arr) -> np.ndarray:
         return np.asarray(jax.device_get(arr))
     while not arr.is_ready():
         time.sleep(0.0003)
-    return np.asarray(arr)
+    return np.asarray(jax.device_get(arr))
 
 
 def _seed_for(seq: Sequence) -> int:
@@ -160,22 +166,16 @@ class ModelRunner:
             params = load_hf_params(
                 self.model_cfg, cfg.model, quantize=bool(quant)
             )
-            if cfg.enable_lora:
-                params["layers"].update(
-                    self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
-                )
-            self.params = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-                params,
-                pspecs,
-            )
         elif quant:
             # Preset (random-init) + quantized: materialize leaf-by-leaf
             # straight into device shardings — peak HBM is the int8 tree
-            # plus one transient bf16 leaf.
+            # plus one transient bf16 leaf. (Includes the LoRA bank; no
+            # host-side tree to device_put below.)
+            params = None
             self.params = self._init_params_streamed(pspecs)
         else:
             params = self.model.init_params(jax.random.PRNGKey(cfg.seed))
+        if params is not None:
             if cfg.enable_lora:
                 params["layers"].update(
                     self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
@@ -222,7 +222,10 @@ class ModelRunner:
             moe_impl = "dense" if sharded else "ragged"
         self._moe_impl = moe_impl
 
-        def step(params, kv_cache, batch: Dict[str, Any], want_lp: bool):
+        def step(
+            params, kv_cache, batch: Dict[str, Any], want_lp: bool,
+            greedy: bool,
+        ):
             logits, kv_cache = model.forward(
                 params,
                 batch["tokens"],
@@ -267,6 +270,7 @@ class ModelRunner:
                 batch["min_ps"],
                 batch["seeds"],
                 with_logprobs=want_lp,
+                greedy_only=greedy,
             )
             return packed, kv_cache
 
@@ -275,7 +279,7 @@ class ModelRunner:
         # are fetchable), and an all-gather of [B] int32 is free.
         self._step = jax.jit(
             step,
-            static_argnums=(3,),
+            static_argnums=(3, 4),
             donate_argnums=(1,),
             out_shardings=(self._repl, cache_sh),
         )
@@ -284,7 +288,7 @@ class ModelRunner:
         drop_slot = self.num_blocks * bs
 
         def multi_step(params, kv_cache, batch, tokens, positions, seed_off,
-                       n_steps: int, want_lp: bool):
+                       n_steps: int, want_lp: bool, greedy: bool):
             """Decode ``n_steps`` tokens per sequence in one compiled call.
 
             The inter-token dependency (sampled token feeds the next forward)
@@ -334,6 +338,7 @@ class ModelRunner:
                     batch["min_ps"],
                     batch["seeds"] + so,
                     with_logprobs=want_lp,
+                    greedy_only=greedy,
                 )
                 nxt = packed[:, 0].astype(jnp.int32)
                 return (kv_cache, nxt, positions + 1, so + 1), packed
@@ -347,7 +352,7 @@ class ModelRunner:
 
         self._multi_step = jax.jit(
             multi_step,
-            static_argnums=(6, 7),
+            static_argnums=(6, 7, 8),
             donate_argnums=(1,),
             out_shardings=(
                 self._repl, self._repl, self._repl, self._repl, cache_sh
@@ -597,11 +602,19 @@ class ModelRunner:
     def _want_lp(seqs: List[Sequence]) -> bool:
         return any(s.sampling.logprobs is not None for s in seqs)
 
+    @staticmethod
+    def _all_greedy(seqs: List[Sequence]) -> bool:
+        """True when every row is greedy: the compiled step then skips the
+        full sampling machinery (static fast path in ops/sampling.py)."""
+        return all(s.sampling.greedy for s in seqs)
+
     def execute_decode(self, seqs: List[Sequence]) -> np.ndarray:
         """One decode step per sequence. Returns packed sample rows
         [len(seqs), 1 or PACKED_WIDTH] (token [+ logprobs]; ops/sampling.py)."""
         batch = self._decode_batch(seqs)
-        return self._run(batch, self._want_lp(seqs))[: len(seqs)]
+        return self._run(
+            batch, self._want_lp(seqs), self._all_greedy(seqs)
+        )[: len(seqs)]
 
     def execute_decode_multi(self, seqs: List[Sequence], n_steps: int) -> np.ndarray:
         """Decode burst: ``n_steps`` tokens per sequence in one device call.
@@ -617,10 +630,15 @@ class ModelRunner:
             "guided-choice rows reached a multi-step decode burst"
         )
         want_lp = self._want_lp(seqs)
+        greedy = self._all_greedy(seqs)
         with self._device_lock:
             if self.publisher is not None:
-                self.publisher.announce("multi_step", (batch, n_steps, want_lp))
-            return self._dispatch_multi_step(batch, n_steps, want_lp)[: len(seqs)]
+                self.publisher.announce(
+                    "multi_step", (batch, n_steps, want_lp, greedy)
+                )
+            return self._dispatch_multi_step(
+                batch, n_steps, want_lp, greedy
+            )[: len(seqs)]
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """ONE device_put for the whole batch tree. Separate puts cost a
@@ -631,7 +649,11 @@ class ModelRunner:
         return jax.device_put(batch, self._row if row_shard else self._repl)
 
     def _dispatch_multi_step(
-        self, batch: Dict[str, np.ndarray], n_steps: int, want_lp: bool = False
+        self,
+        batch: Dict[str, np.ndarray],
+        n_steps: int,
+        want_lp: bool = False,
+        greedy: bool = False,
     ) -> np.ndarray:
         dev = self._put_batch(batch)
         seed0 = jax.device_put(np.zeros((), np.uint32), self._repl)
@@ -639,7 +661,7 @@ class ModelRunner:
         positions = dev.pop("positions")
         toks, _, _, _, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, dev, tokens, positions, seed0,
-            n_steps, want_lp,
+            n_steps, want_lp, greedy,
         )
         return _fetch(toks)
 
@@ -662,15 +684,20 @@ class ModelRunner:
             "guided-choice rows reached a pipelined decode burst"
         )
         want_lp = self._want_lp(seqs)
+        greedy = self._all_greedy(seqs)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
-                    "burst_start", (batch, n_steps, want_lp)
+                    "burst_start", (batch, n_steps, want_lp, greedy)
                 )
-            self._dispatch_burst_start(batch, n_steps, want_lp)
+            self._dispatch_burst_start(batch, n_steps, want_lp, greedy)
 
     def _dispatch_burst_start(
-        self, batch: Dict[str, np.ndarray], n_steps: int, want_lp: bool = False
+        self,
+        batch: Dict[str, np.ndarray],
+        n_steps: int,
+        want_lp: bool = False,
+        greedy: bool = False,
     ) -> None:
         dev = self._put_batch(batch)
         seed = jax.device_put(np.zeros((), np.uint32), self._repl)
@@ -678,7 +705,7 @@ class ModelRunner:
         positions = dev.pop("positions")
         toks, tokens, positions, seed, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, dev, tokens, positions, seed,
-            n_steps, want_lp,
+            n_steps, want_lp, greedy,
         )
         try:  # start the host copy NOW; the eventual fetch finds it resident
             toks.copy_to_host_async()
@@ -687,6 +714,7 @@ class ModelRunner:
         self._burst = {
             "batch": dev, "tokens": tokens, "positions": positions,
             "seed": seed, "toks": toks, "n": n_steps, "want_lp": want_lp,
+            "greedy": greedy,
         }
 
     def burst_width_stable(self, members: List[Sequence]) -> bool:
@@ -729,6 +757,7 @@ class ModelRunner:
         toks, tokens, positions, seed, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, st["batch"], st["tokens"],
             st["positions"], st["seed"], st["n"], st["want_lp"],
+            st.get("greedy", False),
         )
         try:  # start the host copy NOW; the eventual fetch finds it resident
             toks.copy_to_host_async()
@@ -893,14 +922,21 @@ class ModelRunner:
         """Process one prefill chunk; returns the sampled token id (only
         meaningful when the chunk completes the prompt)."""
         batch = self._prefill_batch([item])
-        return int(self._run(batch, self._want_lp([item.seq]))[0, 0])
+        return int(
+            self._run(
+                batch, self._want_lp([item.seq]), self._all_greedy([item.seq])
+            )[0, 0]
+        )
 
     def execute_prefill_batch(self, items: List[PrefillItem]) -> np.ndarray:
         """Prefill several chunks in one device call (rows padded to a
         common chunk bucket). Returns packed sample rows
         [len(items), 1 or PACKED_WIDTH] (token [+ logprobs])."""
+        seqs = [i.seq for i in items]
         batch = self._prefill_batch(items)
-        return self._run(batch, self._want_lp([i.seq for i in items]))[: len(items)]
+        return self._run(
+            batch, self._want_lp(seqs), self._all_greedy(seqs)
+        )[: len(items)]
 
     def execute_prefill_batch_nofetch(self, items: List[PrefillItem]) -> None:
         """Dispatch a prefill step WITHOUT fetching its sampled tokens.
@@ -919,8 +955,10 @@ class ModelRunner:
             self._dispatch_step_nofetch(batch)
 
     def _dispatch_step_nofetch(self, batch: Dict[str, np.ndarray]) -> None:
+        # greedy=True: nobody reads an intermediate chunk's sample, so the
+        # cheapest sampling variant (plain argmax) is always correct here.
         _, self.kv_cache = self._step(
-            self.params, self.kv_cache, self._put_batch(batch), False
+            self.params, self.kv_cache, self._put_batch(batch), False, True
         )
 
     def prefill_dispatch(self, items: List[PrefillItem]):  # noqa: D401
@@ -931,12 +969,13 @@ class ModelRunner:
         host<->device round trip out of TTFT."""
         batch = self._prefill_batch(items)
         want_lp = self._want_lp([i.seq for i in items])
+        greedy = self._all_greedy([i.seq for i in items])
         with self._device_lock:
             if self.publisher is not None:
-                self.publisher.announce("step", (batch, want_lp))
+                self.publisher.announce("step", (batch, want_lp, greedy))
             dev = self._put_batch(batch)
             toks, self.kv_cache = self._step(
-                self.params, self.kv_cache, dev, want_lp
+                self.params, self.kv_cache, dev, want_lp, greedy
             )
         try:
             toks.copy_to_host_async()
@@ -947,17 +986,25 @@ class ModelRunner:
     def prefill_fetch(self, handle, n_items: int) -> np.ndarray:
         return _fetch(handle)[:n_items]
 
-    def _run(self, batch: Dict[str, np.ndarray], want_lp: bool = False) -> np.ndarray:
+    def _run(
+        self,
+        batch: Dict[str, np.ndarray],
+        want_lp: bool = False,
+        greedy: bool = False,
+    ) -> np.ndarray:
         with self._device_lock:
             if self.publisher is not None:
-                self.publisher.announce("step", (batch, want_lp))
-            return self._dispatch_step(batch, want_lp)
+                self.publisher.announce("step", (batch, want_lp, greedy))
+            return self._dispatch_step(batch, want_lp, greedy)
 
     def _dispatch_step(
-        self, batch: Dict[str, np.ndarray], want_lp: bool = False
+        self,
+        batch: Dict[str, np.ndarray],
+        want_lp: bool = False,
+        greedy: bool = False,
     ) -> np.ndarray:
         toks, self.kv_cache = self._step(
-            self.params, self.kv_cache, self._put_batch(batch), want_lp
+            self.params, self.kv_cache, self._put_batch(batch), want_lp, greedy
         )
         return _fetch(toks)
 

@@ -70,6 +70,7 @@ class Scheduler:
         self.allocator = allocator
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
+        self._n_decode_hint: Optional[int] = None
         # (request_id, num_free) of the last head-of-line admission failure:
         # until the free-page count changes there is no point re-running the
         # prefix match every step (it is O(prompt) hashing and would skew the
@@ -134,11 +135,21 @@ class Scheduler:
 
     # -- the step ---------------------------------------------------------
 
-    def schedule(self, locked: frozenset = frozenset()) -> SchedulerOutput:
+    def schedule(
+        self,
+        locked: frozenset = frozenset(),
+        n_decode: Optional[int] = None,
+    ) -> SchedulerOutput:
         """``locked``: request ids whose pages an in-flight burst references;
         they must not be preempted this pass (the engine drains the burst
-        and re-schedules when that constraint binds)."""
+        and re-schedules when that constraint binds).
+
+        ``n_decode``: burst-depth override for this pass (the engine's
+        adaptive-depth hint — deeper bursts amortize the fixed per-step
+        dispatch+fetch latency when the arrival stream is quiet); clamped
+        by the same per-sequence limits as the configured depth."""
         self._locked = locked
+        self._n_decode_hint = n_decode
         out = SchedulerOutput()
         self._admit(out)
 
@@ -173,7 +184,7 @@ class Scheduler:
         # Phase 2: a decode burst for every running sequence. Burst length is
         # bounded so no sequence writes KV past max_model_len; early stops
         # are trimmed host-side (≤ n-1 wasted tokens per finishing request).
-        n = max(self.config.num_decode_steps, 1)
+        n = max(self._n_decode_hint or self.config.num_decode_steps, 1)
         for seq in self.running:
             n = min(n, max(self.config.max_model_len - seq.num_tokens, 1))
             if seq.sampling.has_penalties or seq.sampling.guided_choice:

@@ -113,14 +113,26 @@ class EngineMetrics:
             (0.1, 0.25, 0.5, 1, 2, 4, 8, 16, 32, 64),
         )
         self.success = counter("vllm:request_success_total", "finished requests")
-        self.spec_draft = gauge(
-            "vllm:spec_decode_num_draft_tokens_total",
+        # Counters (not gauges): the _total suffix promises monotonic
+        # counter semantics — rate()/increase() queries and counter-typed
+        # dashboards break across restarts otherwise (vLLM exports these as
+        # Counters). The engine reports cumulative totals, so refresh()
+        # inc()s by delta.
+        self.spec_draft = counter(
+            "vllm:spec_decode_num_draft_tokens",
             "speculative draft tokens proposed",
         )
-        self.spec_accepted = gauge(
-            "vllm:spec_decode_num_accepted_tokens_total",
+        self.spec_accepted = counter(
+            "vllm:spec_decode_num_accepted_tokens",
             "speculative draft tokens accepted",
         )
+        self._counter_last: dict = {}
+
+    def _counter_to(self, c, key: str, total: float) -> None:
+        last = self._counter_last.get(key, 0.0)
+        if total > last:
+            c.inc(total - last)
+            self._counter_last[key] = total
 
     def refresh(self, stats: dict) -> None:
         self.running.set(stats["num_requests_running"])
@@ -130,21 +142,33 @@ class EngineMetrics:
         self.hit_rate.set(stats["prefix_cache_hit_rate"])
         self.hits.set(stats["prefix_cache_hits_total"])
         self.queries.set(stats["prefix_cache_queries_total"])
-        self.spec_draft.set(stats.get("spec_decode_num_draft_tokens_total", 0))
-        self.spec_accepted.set(
-            stats.get("spec_decode_num_accepted_tokens_total", 0)
+        self._counter_to(
+            self.spec_draft, "draft",
+            stats.get("spec_decode_num_draft_tokens_total", 0),
+        )
+        self._counter_to(
+            self.spec_accepted, "accepted",
+            stats.get("spec_decode_num_accepted_tokens_total", 0),
         )
 
 
 def _parse_logit_bias(raw) -> tuple:
     """OpenAI logit_bias keys are stringified token ids; a non-numeric key
-    must surface as a 400, not a 500 (callers catch ValueError)."""
+    must surface as a 400, not a 500 (callers catch ValueError). Values are
+    validated to OpenAI's documented [-100, 100] range — unbounded biases
+    can force tokens users only meant to discourage."""
     if not raw:
         return ()
     try:
-        return tuple((int(k), float(v)) for k, v in raw.items())
+        parsed = tuple((int(k), float(v)) for k, v in raw.items())
     except (TypeError, ValueError):
         raise ValueError("logit_bias keys must be integer token ids")
+    for _, v in parsed:
+        if not (-100.0 <= v <= 100.0):
+            raise ValueError(
+                "logit_bias values must be in [-100, 100]"
+            )
+    return parsed
 
 
 def _parse_guided_choice(raw, tok) -> tuple:
@@ -933,6 +957,9 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--lora-dir", default="/adapters")
     # Decode burst + batch-shape floors.
     p.add_argument("--num-decode-steps", type=int, default=1)
+    p.add_argument("--adaptive-decode-steps", type=int, default=0,
+                   help="deep burst cap when the arrival stream is quiet")
+    p.add_argument("--adaptive-decode-quiet-s", type=float, default=0.5)
     p.add_argument("--min-decode-bucket", type=int, default=1)
     # Speculative decoding (n-gram prompt lookup; 0 = off).
     p.add_argument("--speculative-ngram", type=int, default=0,
@@ -984,6 +1011,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_lora_rank=args.max_lora_rank,
         lora_dir=args.lora_dir,
         num_decode_steps=args.num_decode_steps,
+        adaptive_decode_steps=args.adaptive_decode_steps,
+        adaptive_decode_quiet_s=args.adaptive_decode_quiet_s,
         min_decode_bucket=args.min_decode_bucket,
         speculative_ngram=args.speculative_ngram,
         ngram_min=args.ngram_min,

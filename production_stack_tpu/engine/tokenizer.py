@@ -54,10 +54,21 @@ class ByteTokenizer:
             "utf-8", errors="replace"
         )
 
-    def encode_pair(self, a: str, b: str) -> Tuple[List[int], List[int]]:
+    def encode_pair(
+        self, a: str, b: str, max_len: Optional[int] = None
+    ) -> Tuple[List[int], List[int]]:
         # 258 = synthetic separator (outside the byte id range 1..256).
         # Segment ids: 0 for the first text (+sep), 1 for the second.
+        # longest-first truncation keeps the pair template intact (ADVICE
+        # r3: tail-slicing dropped the final separator on long documents).
         ia, ib = self.encode(a), self.encode(b)
+        if max_len is not None:
+            budget = max_len - 1  # separator
+            while len(ia) + len(ib) > budget:
+                if len(ia) >= len(ib):
+                    ia.pop()
+                else:
+                    ib.pop()
         return ia + [258] + ib, [0] * (len(ia) + 1) + [1] * len(ib)
 
     def apply_chat_template(
@@ -85,11 +96,18 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
-    def encode_pair(self, a: str, b: str) -> Tuple[List[int], List[int]]:
+    def encode_pair(
+        self, a: str, b: str, max_len: Optional[int] = None
+    ) -> Tuple[List[int], List[int]]:
         """Sentence-pair encoding with the model's own pair template
         (RoBERTa: <s> a </s></s> b </s>; BERT: [CLS] a [SEP] b [SEP] with
-        segment ids) — what cross-encoders were trained on."""
-        enc = self._tok(a, b)
+        segment ids) — what cross-encoders were trained on. Tokenizer-side
+        ``longest_first`` truncation preserves the final special tokens
+        (ADVICE r3: tail-slicing silently degraded long-document scores)."""
+        kwargs = {}
+        if max_len is not None:
+            kwargs = {"truncation": "longest_first", "max_length": max_len}
+        enc = self._tok(a, b, **kwargs)
         ids = enc["input_ids"]
         types = enc.get("token_type_ids") or [0] * len(ids)
         return ids, types

@@ -1,0 +1,1 @@
+"""Gateway API integration: Envoy ext-proc endpoint-picker shim."""

@@ -1,0 +1,242 @@
+"""Envoy ext-proc endpoint-picker service (`pst-extproc`).
+
+The actual wire protocol a Gateway API inference-extension deployment
+consults: Envoy's ext_proc filter opens a gRPC
+``envoy.service.ext_proc.v3.ExternalProcessor/Process`` stream per HTTP
+request, sends the request headers and (buffered) body, and applies the
+header mutations we return before routing. The reference's pickers live
+inside the Go endpoint-picker framework speaking exactly this protocol
+(`/root/reference/src/gateway_inference_extension/prefix_aware_picker.go:27`);
+here the protocol front-end is this Python service and the picking policies
+stay in the native C++ ``pst-picker`` (`operator/src/picker_main.cc`), which
+it consults over its ``POST /pick`` API.
+
+Flow per request stream:
+  1. ``request_headers`` → CONTINUE (ask Envoy for the body next).
+  2. ``request_body`` (end_of_stream) → parse the OpenAI JSON, extract the
+     prompt text exactly like the router's prefix policy
+     (``router/routing/logic.py`` extract_prompt_text), call the picker,
+     and return a header mutation setting ``x-gateway-destination-endpoint``
+     (the inference-extension contract: the gateway's original-destination
+     cluster routes on that header).
+
+Wire stubs: ``extproc_pb2`` is protoc-generated from
+``gateway/proto/extproc.proto`` — a hand-trimmed, field-number-compatible
+subset of the public Envoy API (see that file's provenance note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import socket
+import urllib.request
+from concurrent import futures
+from typing import Iterator, List, Optional
+
+import grpc
+
+from ..router.routing.logic import extract_prompt_text
+from . import extproc_pb2 as pb2
+
+logger = logging.getLogger("pst.extproc")
+
+SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+DEST_HEADER = "x-gateway-destination-endpoint"
+
+
+class PickerClient:
+    """Resolves the pod set and asks pst-picker's /pick for an endpoint."""
+
+    def __init__(
+        self,
+        picker_url: str,
+        policy: Optional[str] = None,
+        pods: Optional[List[dict]] = None,
+        pods_dns: Optional[str] = None,
+        pods_port: int = 8000,
+        timeout: float = 2.0,
+    ):
+        self.picker_url = picker_url.rstrip("/")
+        self.policy = policy
+        self.static_pods = pods or []
+        self.pods_dns = pods_dns
+        self.pods_port = pods_port
+        self.timeout = timeout
+
+    def resolve_pods(self) -> List[dict]:
+        if self.static_pods:
+            return self.static_pods
+        if self.pods_dns:
+            # Headless-service lookup: one A record per engine pod (the
+            # K8s-native analogue of the EPP's InferencePool pod watch).
+            try:
+                infos = socket.getaddrinfo(
+                    self.pods_dns, self.pods_port, proto=socket.IPPROTO_TCP
+                )
+                addrs = sorted({i[4][0] for i in infos})
+                return [
+                    {"name": a, "address": f"{a}:{self.pods_port}"}
+                    for a in addrs
+                ]
+            except OSError as e:
+                logger.warning("pod DNS resolve failed: %s", e)
+        return []
+
+    def pick(self, model: str, prompt: str) -> Optional[str]:
+        pods = self.resolve_pods()
+        if not pods:
+            return None
+        payload = {"model": model, "prompt": prompt, "pods": pods}
+        if self.policy:
+            payload["policy"] = self.policy
+        req = urllib.request.Request(
+            self.picker_url + "/pick",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — fall through to no-mutation
+            logger.warning("picker /pick failed: %s", e)
+            return None
+        name = out.get("pod")
+        for p in pods:
+            if p.get("name") == name:
+                return p.get("address") or name
+        return name
+
+
+def _continue_headers() -> pb2.ProcessingResponse:
+    return pb2.ProcessingResponse(
+        request_headers=pb2.HeadersResponse(
+            response=pb2.CommonResponse(
+                status=pb2.CommonResponse.CONTINUE
+            )
+        )
+    )
+
+
+def _body_response(endpoint: Optional[str]) -> pb2.ProcessingResponse:
+    common = pb2.CommonResponse(status=pb2.CommonResponse.CONTINUE)
+    if endpoint:
+        common.header_mutation.set_headers.append(
+            pb2.HeaderValueOption(
+                header=pb2.HeaderValue(
+                    key=DEST_HEADER, raw_value=endpoint.encode()
+                )
+            )
+        )
+    return pb2.ProcessingResponse(
+        request_body=pb2.BodyResponse(response=common)
+    )
+
+
+class ExtProcHandler:
+    """One instance serves all streams; per-stream state is local."""
+
+    def __init__(self, picker: PickerClient):
+        self.picker = picker
+
+    def process(
+        self, request_iterator: Iterator[pb2.ProcessingRequest], context
+    ) -> Iterator[pb2.ProcessingResponse]:
+        for msg in request_iterator:
+            kind = msg.WhichOneof("request")
+            if kind == "request_headers":
+                if msg.request_headers.end_of_stream:
+                    # Bodyless request (GET): nothing to hash — still pick
+                    # so round-robin style policies work.
+                    endpoint = self.picker.pick("", "")
+                    resp = _continue_headers()
+                    if endpoint:
+                        resp.request_headers.response.header_mutation.set_headers.append(
+                            pb2.HeaderValueOption(
+                                header=pb2.HeaderValue(
+                                    key=DEST_HEADER,
+                                    raw_value=endpoint.encode(),
+                                )
+                            )
+                        )
+                    yield resp
+                else:
+                    yield _continue_headers()
+            elif kind == "request_body":
+                body = msg.request_body.body
+                model, prompt = "", ""
+                try:
+                    req_json = json.loads(body) if body else {}
+                    model = str(req_json.get("model", ""))
+                    prompt = extract_prompt_text(req_json)
+                except (ValueError, TypeError):
+                    logger.warning("unparseable request body (%d bytes)", len(body))
+                yield _body_response(self.picker.pick(model, prompt))
+            elif kind in ("response_headers", "response_body"):
+                # Pass-through: we only steer requests.
+                if kind == "response_headers":
+                    yield pb2.ProcessingResponse(
+                        response_headers=pb2.HeadersResponse()
+                    )
+                else:
+                    yield pb2.ProcessingResponse(
+                        response_body=pb2.BodyResponse()
+                    )
+
+
+def make_server(picker: PickerClient, port: int, max_workers: int = 16):
+    """grpc.Server wired via generic handlers (no generated service stubs —
+    grpc_tools is not in the image; the method path + message framing are
+    what matter on the wire)."""
+    handler = ExtProcHandler(picker)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    rpc = grpc.stream_stream_rpc_method_handler(
+        handler.process,
+        request_deserializer=pb2.ProcessingRequest.FromString,
+        response_serializer=pb2.ProcessingResponse.SerializeToString,
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, {"Process": rpc}),)
+    )
+    bound = server.add_insecure_port(f"[::]:{port}")
+    return server, bound
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, default=9002)
+    p.add_argument("--picker-url", default="http://localhost:9001")
+    p.add_argument(
+        "--policy", default=None,
+        help="override pst-picker's default policy per pick",
+    )
+    p.add_argument(
+        "--pods", default=None,
+        help="static pod list name=addr,name=addr (else --pods-dns)",
+    )
+    p.add_argument(
+        "--pods-dns", default=None,
+        help="headless service name resolving to engine pod IPs",
+    )
+    p.add_argument("--pods-port", type=int, default=8000)
+    args = p.parse_args(argv)
+
+    pods = None
+    if args.pods:
+        pods = []
+        for ent in args.pods.split(","):
+            name, _, addr = ent.partition("=")
+            pods.append({"name": name, "address": addr or name})
+    picker = PickerClient(
+        args.picker_url, args.policy, pods, args.pods_dns, args.pods_port
+    )
+    logging.basicConfig(level=logging.INFO)
+    server, bound = make_server(picker, args.port)
+    server.start()
+    logger.info("pst-extproc listening on :%d -> %s", bound, args.picker_url)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -194,7 +194,12 @@ class BertClassifier:
         cls = x[:, 0]
         h = jnp.tanh(cls @ params["cls_dense_w"] + params["cls_dense_b"])
         logits = h @ params["cls_out_w"] + params["cls_out_b"]
-        return logits[:, 0].astype(jnp.float32)
+        # Relevance score: 1-label heads (bge-reranker style) score column
+        # 0; 2-label sequence-classification heads conventionally put the
+        # positive class at label 1 (ADVICE r3: column 0 would score the
+        # negative class). >2 labels are rejected at config parse.
+        col = 1 if cfg.num_labels == 2 else 0
+        return logits[:, col].astype(jnp.float32)
 
 
 def _layer_norm(x, w, b, eps):
@@ -213,6 +218,14 @@ def bert_config_from_hf(config_path: str, name: str = "") -> BertConfig:
             f"unsupported scoring model_type {mt!r} (bert/roberta/xlm-roberta)"
         )
     roberta = mt != "bert"
+    n_labels = len(hf.get("id2label", {0: ""})) or 1
+    if n_labels > 2:
+        # A >2-class head has no single "relevance" column; refuse loudly
+        # rather than silently scoring an arbitrary class.
+        raise ValueError(
+            f"scoring model has {n_labels} labels; cross-encoder scoring "
+            "supports 1-label (regression) or 2-label (positive=1) heads"
+        )
     return BertConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -221,7 +234,7 @@ def bert_config_from_hf(config_path: str, name: str = "") -> BertConfig:
         num_heads=hf["num_attention_heads"],
         max_position_embeddings=hf["max_position_embeddings"],
         layer_norm_eps=hf.get("layer_norm_eps", 1e-5),
-        num_labels=len(hf.get("id2label", {0: ""})) or 1,
+        num_labels=n_labels,
         position_offset=(hf.get("pad_token_id", 1) or 0) + 1 if roberta else 0,
         pad_token_id=hf.get("pad_token_id", 1 if roberta else 0),
         type_vocab_size=hf.get("type_vocab_size", 1),

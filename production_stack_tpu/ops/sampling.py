@@ -30,7 +30,15 @@ def sample_tokens(
     top_ks: jax.Array,  # [B] int32 (<=0: disabled)
     min_ps: jax.Array,  # [B]
     seeds: jax.Array,  # [B] uint32 (per-seq, per-step)
+    greedy_only: bool = False,
 ) -> jax.Array:
+    """``greedy_only`` is a trace-time constant set by the runner when every
+    row in the batch is greedy: skips the top-k/softmax/gumbel machinery
+    entirely (a top_k over a 128k vocab costs real milliseconds per decode
+    scan step, and greedy batches — the common serving case — need only the
+    argmax XLA fuses into the unembed matmul's epilogue)."""
+    if greedy_only:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     B, V = logits.shape
     K = min(V, SAMPLE_K_CAP)
     greedy = temps <= 1e-5
@@ -65,6 +73,7 @@ def sample_tokens_packed(
     min_ps: jax.Array,
     seeds: jax.Array,
     with_logprobs: bool = False,
+    greedy_only: bool = False,
 ) -> jax.Array:
     """Sample into ONE packed f32 array — ``[token]`` per row, or with
     ``with_logprobs`` (a trace-time constant: the runner compiles separate
@@ -76,7 +85,9 @@ def sample_tokens_packed(
     ``log_softmax(logits)`` (pre-temperature, the OpenAI/vLLM convention);
     gating them keeps the full-vocab log_softmax + top-k out of the
     latency-critical decode path when nobody asked."""
-    tokens = sample_tokens(logits, temps, top_ps, top_ks, min_ps, seeds)
+    tokens = sample_tokens(
+        logits, temps, top_ps, top_ks, min_ps, seeds, greedy_only=greedy_only
+    )
     if not with_logprobs:
         return tokens[:, None].astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)  # [B, V]

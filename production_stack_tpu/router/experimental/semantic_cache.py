@@ -63,21 +63,117 @@ def hash_embed(text: str, dim: int = _DIM) -> np.ndarray:
     return vec / n if n > 0 else vec
 
 
+class EngineEmbedder:
+    """Embed via a backend's ``/v1/embeddings`` — real model embeddings for
+    true semantic similarity (the reference's sentence-transformers role,
+    served by the TPU engine's encode path instead)."""
+
+    def __init__(self, app, model: Optional[str] = None, timeout: float = 5.0):
+        self._app = app
+        self.model = model  # None: pin to the first model that answers
+        self.timeout = timeout
+        # One index = one vector space: without an explicit model, the
+        # first successful embed pins the model; endpoint flips must not
+        # silently switch embedding spaces mid-index.
+        self._pinned: Optional[str] = model
+
+    async def __call__(self, text: str) -> Optional[np.ndarray]:
+        from ..service_discovery import get_service_discovery
+
+        session = self._app.get("client_session")
+        if session is None:
+            return None
+        try:
+            endpoints = get_service_discovery().get_endpoint_info()
+        except Exception:  # noqa: BLE001 — discovery not up yet
+            return None
+        for ep in endpoints:
+            if getattr(ep, "sleep", False):
+                continue
+            models = getattr(ep, "model_names", None) or []
+            model = self._pinned or (models[0] if models else None)
+            if not model or (self._pinned and self._pinned not in models):
+                continue
+            try:
+                async with session.post(
+                    f"{ep.url.rstrip('/')}/v1/embeddings",
+                    json={"model": model, "input": [text[:8192]]},
+                    timeout=self.timeout,
+                ) as resp:
+                    if resp.status != 200:
+                        continue
+                    data = await resp.json()
+                vec = np.asarray(
+                    data["data"][0]["embedding"], np.float32
+                )
+                self._pinned = model
+                n = float(np.linalg.norm(vec))
+                return vec / n if n > 0 else vec
+            except Exception:  # noqa: BLE001 — try the next endpoint
+                continue
+        return None
+
+
 class SemanticCache:
     def __init__(
         self, cache_dir: Optional[str], threshold: float,
         persist_interval: float = 5.0,
+        embedder: str = "auto",
+        engine_embed: Optional[EngineEmbedder] = None,
     ):
         self.threshold = threshold
         self.cache_dir = cache_dir
         self.persist_interval = persist_interval
         self._last_persist = 0.0
+        # Embedder selection (VERDICT r3 #9): "engine" = real embeddings
+        # via /v1/embeddings; "hash" = dependency-free lexical features;
+        # "auto" = probe once at first use — engine when a backend answers
+        # /v1/embeddings, else hash. The persisted index is tagged with the
+        # embedder that built it (mixing vector spaces would be garbage).
+        self.embedder = embedder
+        self.engine_embed = engine_embed
+        self._mode: Optional[str] = (
+            None if embedder == "auto" else embedder
+        )
         self.vectors = np.zeros((0, _DIM), np.float32)
         self.entries: List[dict] = []  # {"model":..., "response": body-json}
         self._lock = asyncio.Lock()
         if cache_dir:
             os.makedirs(cache_dir, exist_ok=True)
             self._load()
+
+    async def _embed(self, text: str) -> Optional[np.ndarray]:
+        """Embed under the selected mode, deciding the mode on first use."""
+        if self._mode is None:
+            vec = (
+                await self.engine_embed(text)
+                if self.engine_embed is not None
+                else None
+            )
+            self._mode = "engine" if vec is not None else "hash"
+            logger.info("semantic cache: auto-selected %r embedder", self._mode)
+            if self._mode == "engine":
+                self._reset_if_dim_mismatch(vec.shape[0])
+                return vec
+        if self._mode == "engine":
+            vec = await self.engine_embed(text) if self.engine_embed else None
+            if vec is not None:
+                self._reset_if_dim_mismatch(vec.shape[0])
+            return vec  # None: backend briefly unavailable -> skip cache
+        vec = hash_embed(text)
+        self._reset_if_dim_mismatch(vec.shape[0])
+        return vec
+
+    def _reset_if_dim_mismatch(self, dim: int) -> None:
+        if self.vectors.shape[1] != dim:
+            if len(self.entries):
+                logger.warning(
+                    "semantic cache: embedder dim changed (%d -> %d); "
+                    "dropping %d entries",
+                    self.vectors.shape[1], dim, len(self.entries),
+                )
+            self.vectors = np.zeros((0, dim), np.float32)
+            self.entries = []
 
     # -- persistence ------------------------------------------------------
 
@@ -86,7 +182,23 @@ class SemanticCache:
         jl = os.path.join(self.cache_dir, "entries.jsonl")
         if os.path.exists(npz) and os.path.exists(jl):
             try:
-                self.vectors = np.load(npz)["vectors"]
+                loaded = np.load(npz, allow_pickle=False)
+                saved_mode = str(loaded["embedder"]) if "embedder" in loaded else "hash"
+                if self._mode is not None and saved_mode != self._mode:
+                    logger.warning(
+                        "semantic cache: on-disk index built with %r embedder, "
+                        "current mode %r — starting empty", saved_mode, self._mode
+                    )
+                    return
+                if self._mode is None:
+                    # auto: adopt the persisted index's vector space — a
+                    # later hash fallback must not mix into engine vectors.
+                    self._mode = saved_mode
+                    logger.info(
+                        "semantic cache: adopting persisted %r embedder",
+                        saved_mode,
+                    )
+                self.vectors = loaded["vectors"]
                 with open(jl) as f:
                     self.entries = [json.loads(line) for line in f]
                 logger.info("semantic cache: loaded %d entries", len(self.entries))
@@ -94,7 +206,11 @@ class SemanticCache:
                 logger.warning("semantic cache load failed: %s", e)
 
     def _persist_snapshot(self, vectors: np.ndarray, entries: List[dict]) -> None:
-        np.savez(os.path.join(self.cache_dir, "vectors.npz"), vectors=vectors)
+        np.savez(
+            os.path.join(self.cache_dir, "vectors.npz"),
+            vectors=vectors,
+            embedder=np.asarray(self._mode or "hash"),
+        )
         with open(os.path.join(self.cache_dir, "entries.jsonl"), "w") as f:
             for e in entries:
                 f.write(json.dumps(e) + "\n")
@@ -116,7 +232,10 @@ class SemanticCache:
         text = self.request_text(request_json)
         if not text:
             return None
-        vec = hash_embed(text)
+        vec = await self._embed(text)
+        if vec is None:
+            misses_total.inc()
+            return None
         async with self._lock:
             if len(self.entries) == 0:
                 misses_total.inc()
@@ -135,7 +254,9 @@ class SemanticCache:
         text = self.request_text(request_json)
         if not text:
             return
-        vec = hash_embed(text)
+        vec = await self._embed(text)
+        if vec is None:
+            return
         async with self._lock:
             self.vectors = np.vstack([self.vectors, vec[None, :]])
             self.entries.append(
@@ -155,7 +276,19 @@ class SemanticCache:
 
 
 def install_semantic_cache(app: web.Application, args) -> None:
-    cache = SemanticCache(args.semantic_cache_dir, args.semantic_cache_threshold)
+    embedder = getattr(args, "semantic_cache_embedder", "auto")
+    cache = SemanticCache(
+        args.semantic_cache_dir,
+        args.semantic_cache_threshold,
+        embedder=embedder,
+        engine_embed=(
+            EngineEmbedder(
+                app, getattr(args, "semantic_cache_embed_model", None)
+            )
+            if embedder in ("auto", "engine")
+            else None
+        ),
+    )
     app["semantic_cache"] = cache
 
     async def check(request_json: dict) -> Optional[web.Response]:

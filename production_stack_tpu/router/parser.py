@@ -110,6 +110,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--semantic-cache-model", default="all-MiniLM-L6-v2")
     p.add_argument("--semantic-cache-dir", default=None)
     p.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+    # auto: real embeddings via a backend's /v1/embeddings when one
+    # answers, else the dependency-free hash embedder (VERDICT r3 #9).
+    p.add_argument(
+        "--semantic-cache-embedder",
+        default="auto",
+        choices=["auto", "engine", "hash"],
+    )
+    # Restrict engine embedding to a specific served model (e.g. a BERT
+    # embedding pod); default: any backend's own model.
+    p.add_argument("--semantic-cache-embed-model", default=None)
 
     # Misc
     p.add_argument("--api-key", default=None, help="require this bearer token from clients")

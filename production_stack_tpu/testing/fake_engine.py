@@ -230,7 +230,37 @@ def create_fake_engine_app(
         tokens = list(text.encode())
         return web.json_response({"tokens": tokens, "count": len(tokens)})
 
+    async def embeddings(request: web.Request) -> web.Response:
+        """Deterministic 64-dim embeddings (the real engine serves model
+        embeddings via its encode path; same text → same vector is what
+        router-side consumers like the semantic cache need from a fake)."""
+        import xxhash
+
+        body = await request.json()
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        data = []
+        for i, text in enumerate(inputs or []):
+            raw = [
+                (xxhash.xxh32_intdigest(f"{text}\x00{j}") % 2001) / 1000.0 - 1.0
+                for j in range(64)
+            ]
+            norm = sum(v * v for v in raw) ** 0.5 or 1.0
+            data.append({
+                "object": "embedding",
+                "index": i,
+                "embedding": [v / norm for v in raw],
+            })
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", state.model),
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })
+
     app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/metrics", metrics)

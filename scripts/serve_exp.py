@@ -1,0 +1,75 @@
+"""Serving-config experiment: one protocol run, knobs via argv.
+
+Usage: python scripts/serve_exp.py <model> <n_users> <num_decode_steps> \
+          <async 0|1> <qps> [n_rounds] [quant]
+Prints one JSON line: p50/p99 TTFT + decode tok/s for the config.
+Used to tune num_decode_steps / pipelined-decode / quantization against
+the reference protocol (VERDICT r3 items 2-3: decode throughput + p99 tail).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    model = sys.argv[1]
+    n_users = int(sys.argv[2])
+    n_steps = int(sys.argv[3])
+    use_async = bool(int(sys.argv[4]))
+    qps = float(sys.argv[5])
+    n_rounds = int(sys.argv[6]) if len(sys.argv) > 6 else 4
+    quant = sys.argv[7] if len(sys.argv) > 7 else None
+
+    from benchmarks.protocol import ProtocolRunner
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    import os
+
+    blocks = {"llama-1b": 1408, "llama-3-8b": 860}[model]
+    cfg = EngineConfig(
+        model=model,
+        quantization=quant,
+        max_model_len=32768,
+        block_size=128,
+        num_kv_blocks=blocks,
+        max_num_seqs=16,
+        max_prefill_tokens=1024,
+        attn_impl="pallas",
+        kv_cache_dtype="float8_e4m3fn",
+        num_decode_steps=n_steps,
+        adaptive_decode_steps=int(os.environ.get("PST_ADAPTIVE", "0")),
+        adaptive_decode_quiet_s=float(os.environ.get("PST_QUIET", "0.5")),
+        min_decode_bucket=min(8, n_users),
+        async_decode=use_async,
+    )
+    t0 = time.time()
+    engine = LLMEngine(cfg)
+    print(f"[exp] up in {time.time()-t0:.0f}s", file=sys.stderr, flush=True)
+    pr = ProtocolRunner(engine, n_users)
+    t0 = time.time()
+    pr.cold_prefill()
+    print(f"[exp] cold {time.time()-t0:.0f}s", file=sys.stderr, flush=True)
+    pr.warm_compile()
+    print("[exp] warm done", file=sys.stderr, flush=True)
+    t0 = time.time()
+    ttfts = pr.measured_rounds(qps, n_rounds)
+    wall = time.time() - t0
+    rate = pr.decode_probe()
+    print(json.dumps({
+        "model": model, "n_users": n_users, "num_decode_steps": n_steps,
+        "async": use_async, "qps": qps, "quant": quant,
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+        "p99_ttft_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 1),
+        "n_requests": len(ttfts),
+        "decode_tok_per_s": round(rate, 1) if rate else None,
+        "measure_wall_s": round(wall, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

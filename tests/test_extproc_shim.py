@@ -1,0 +1,156 @@
+"""Gateway ext-proc wire-protocol e2e (VERDICT r3 #6).
+
+Drives the ACTUAL protocol a gateway uses: a gRPC
+``envoy.service.ext_proc.v3.ExternalProcessor/Process`` bidirectional
+stream (headers → body → header-mutation response), through the Python
+``pst-extproc`` shim into the real C++ ``pst-picker`` binary, asserting the
+``x-gateway-destination-endpoint`` mutation the inference-extension
+contract routes on. Reference analogue:
+`/root/reference/src/gateway_inference_extension/prefix_aware_picker.go:27-129`.
+"""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from production_stack_tpu.gateway import extproc_pb2 as pb2  # noqa: E402
+from production_stack_tpu.gateway.extproc import (  # noqa: E402
+    DEST_HEADER,
+    SERVICE,
+    PickerClient,
+    make_server,
+)
+
+OPERATOR_DIR = Path(__file__).resolve().parent.parent / "operator"
+PODS = [
+    {"name": "pod-a", "address": "10.0.0.1:8000"},
+    {"name": "pod-b", "address": "10.0.0.2:8000"},
+    {"name": "pod-c", "address": "10.0.0.3:8000"},
+]
+
+
+@pytest.fixture(scope="module")
+def picker_proc():
+    subprocess.run(["make"], cwd=OPERATOR_DIR, check=True, capture_output=True)
+    proc = subprocess.Popen(
+        [str(OPERATOR_DIR / "build" / "pst-picker"), "--port", "0",
+         "--policy", "prefixaware"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline()
+    port = int(line.rsplit(":", 1)[1])
+    yield f"http://127.0.0.1:{port}"
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture()
+def shim(picker_proc):
+    picker = PickerClient(picker_proc, pods=PODS)
+    server, port = make_server(picker, 0)
+    server.start()
+    yield f"localhost:{port}"
+    server.stop(0)
+
+
+def _process(channel_target, messages):
+    """Run one ext-proc stream over a real gRPC channel and collect the
+    responses — the exact wire exchange Envoy performs."""
+    channel = grpc.insecure_channel(channel_target)
+    stub = channel.stream_stream(
+        f"/{SERVICE}/Process",
+        request_serializer=pb2.ProcessingRequest.SerializeToString,
+        response_deserializer=pb2.ProcessingResponse.FromString,
+    )
+    out = list(stub(iter(messages)))
+    channel.close()
+    return out
+
+
+def _headers_msg(path="/v1/chat/completions", end_of_stream=False):
+    return pb2.ProcessingRequest(
+        request_headers=pb2.HttpHeaders(
+            headers=pb2.HeaderMap(
+                headers=[
+                    pb2.HeaderValue(key=":method", raw_value=b"POST"),
+                    pb2.HeaderValue(key=":path", raw_value=path.encode()),
+                ]
+            ),
+            end_of_stream=end_of_stream,
+        )
+    )
+
+
+def _body_msg(payload: dict):
+    return pb2.ProcessingRequest(
+        request_body=pb2.HttpBody(
+            body=json.dumps(payload).encode(), end_of_stream=True
+        )
+    )
+
+
+def _dest(resp: pb2.ProcessingResponse) -> str:
+    kind = resp.WhichOneof("response")
+    mut = getattr(resp, kind).response.header_mutation
+    for opt in mut.set_headers:
+        if opt.header.key == DEST_HEADER:
+            return opt.header.raw_value.decode()
+    return ""
+
+
+def test_stream_sets_destination_header(shim):
+    body = {
+        "model": "llama-3-8b",
+        "messages": [{"role": "user", "content": "hello " * 100}],
+    }
+    resps = _process(shim, [_headers_msg(), _body_msg(body)])
+    assert len(resps) == 2
+    assert resps[0].WhichOneof("response") == "request_headers"
+    assert resps[1].WhichOneof("response") == "request_body"
+    dest = _dest(resps[1])
+    assert dest in {p["address"] for p in PODS}
+
+
+def test_prefix_stickiness_through_wire(shim):
+    """Same long prefix → same endpoint across streams (the prefix-aware
+    policy working end-to-end through the gRPC wire + C++ trie)."""
+    long_prefix = "s" * 600
+    def ask(suffix):
+        body = {"model": "m", "prompt": long_prefix + suffix}
+        resps = _process(shim, [_headers_msg(), _body_msg(body)])
+        return _dest(resps[1])
+
+    first = ask("one")
+    assert first  # picked something
+    for i in range(5):
+        assert ask(f"again-{i}") == first
+    # A disjoint prompt is not forced to the same pod by prefix matching
+    # (it may still land there by random tie-break; just assert it picks).
+    body = {"model": "m", "prompt": "zz"}
+    resps = _process(shim, [_headers_msg(), _body_msg(body)])
+    assert _dest(resps[1]) in {p["address"] for p in PODS}
+
+
+def test_bodyless_request_still_picks(shim):
+    resps = _process(shim, [_headers_msg(path="/v1/models", end_of_stream=True)])
+    assert len(resps) == 1
+    assert _dest(resps[0]) in {p["address"] for p in PODS}
+
+
+def test_unparseable_body_continues_without_mutation(shim):
+    resps = _process(
+        shim,
+        [
+            _headers_msg(),
+            pb2.ProcessingRequest(
+                request_body=pb2.HttpBody(body=b"\x00notjson", end_of_stream=True)
+            ),
+        ],
+    )
+    # Unparseable body → model/prompt empty → picker still picks (policy
+    # falls back); the stream must complete without error either way.
+    assert resps[1].WhichOneof("response") == "request_body"

@@ -93,6 +93,8 @@ class FakeK8s:
                 try:
                     while True:
                         event = await q.get()
+                        if event is None:  # shutdown sentinel: clean EOF
+                            break
                         await resp.write(
                             (json.dumps(event) + "\n").encode()
                         )
@@ -178,9 +180,9 @@ class FakeK8s:
         asyncio.set_event_loop(self._loop)
 
         async def boot():
-            runner = web.AppRunner(self.make_app())
-            await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0)
+            self._runner = web.AppRunner(self.make_app())
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
             await site.start()
             self.url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
             self._ready.set()
@@ -189,8 +191,26 @@ class FakeK8s:
         self._loop.run_forever()
 
     def stop(self):
-        if self._loop:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        """Graceful teardown: end watch streams with a sentinel (clean EOF
+        to the operator, no mid-write ConnectionResets), clean the runner
+        up on its own loop, then stop the loop. Keeps teardown log noise
+        from burying real failures (VERDICT r3 #10; envtest's clean
+        lifecycle is the model, suite_test.go:1-88)."""
+        if not self._loop:
+            return
+
+        async def shutdown():
+            for qs in self._watchers.values():
+                for q in list(qs):
+                    q.put_nowait(None)
+            await asyncio.sleep(0.05)  # let handlers write EOF and return
+            if getattr(self, "_runner", None) is not None:
+                await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
 
 PST = "/apis/pst.production-stack.io/v1alpha1"

@@ -43,6 +43,7 @@ class Cluster:
             *self.extra_args,
         ]
         router_app = create_app(parse_args(argv))
+        self.router_app = router_app
         r = web.AppRunner(router_app)
         await r.setup()
         site = web.TCPSite(r, "127.0.0.1", 0)
@@ -174,6 +175,51 @@ async def test_semantic_cache_serves_repeat(tmp_path):
             assert r.headers.get("X-Semantic-Cache") == "hit"
             second = await r.json()
         assert second["choices"] == first["choices"]
+
+
+async def test_semantic_cache_auto_selects_engine_embedder(tmp_path):
+    """VERDICT r3 #9: with a backend answering /v1/embeddings, auto mode
+    must pick the engine embedder (real vectors) and still serve repeats."""
+    async with Cluster(
+        ["--feature-gates", "SemanticCache=true",
+         "--semantic-cache-dir", str(tmp_path / "cache"),
+         "--semantic-cache-threshold", "0.99"]
+    ) as c, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "fake/model",
+            "messages": [{"role": "user", "content": "engine embed probe"}],
+            "max_tokens": 4,
+        }
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Semantic-Cache") == "hit"
+
+
+async def test_semantic_cache_engine_mode_vectors(tmp_path):
+    """The engine embedder produces backend vectors (64-dim fake-engine
+    space, not the 256-dim hash space) once auto-selection runs."""
+    async with Cluster(
+        ["--feature-gates", "SemanticCache=true",
+         "--semantic-cache-threshold", "0.99"]
+    ) as c, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "fake/model",
+            "messages": [{"role": "user", "content": "vector space check"}],
+            "max_tokens": 2,
+        }
+        async with sess.post(
+            f"{c.router_url}/v1/chat/completions", json=payload
+        ) as r:
+            assert r.status == 200
+        cache = c.router_app["semantic_cache"]
+        assert cache._mode == "engine"
+        assert cache.vectors.shape[1] == 64
 
 
 async def test_pii_gate_blocks(tmp_path):

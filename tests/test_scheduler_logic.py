@@ -77,3 +77,54 @@ def test_admission_rematches_prefix_once_space_frees():
     assert any(item.seq is b for item in out.prefills)
     # Prefix hit was re-established on the second attempt.
     assert b.num_cached_prompt_tokens == 24
+
+
+def test_decode_depth_hint_overrides_and_clamps():
+    """Adaptive burst depth (engine hint): schedule(n_decode=) deepens the
+    burst; per-sequence clamps (max_model_len margin, guided/penalty rows)
+    still apply over the hint."""
+    sched, alloc = _sched(num_blocks=32, bs=4, num_decode_steps=2)
+    a = Sequence("a", [1, 2, 3, 4, 5], SamplingParams(max_tokens=64))
+    sched.add(a)
+    out = sched.schedule()  # prefill pass
+    a.num_computed_tokens = out.prefills[0].end
+    a.commit_full_blocks(alloc)
+    a.output_token_ids.append(7)
+
+    out = sched.schedule()
+    assert out.n_decode_steps == 2  # configured depth
+    out = sched.schedule(n_decode=16)
+    assert out.n_decode_steps == 16  # hint deepens
+    # The hint does not stick: the next pass reverts to the config depth.
+    out = sched.schedule()
+    assert out.n_decode_steps == 2
+
+    # Guided rows force n=1 regardless of hint.
+    a.sampling = SamplingParams(max_tokens=64, guided_choice=(("x", (9,)),))
+    out = sched.schedule(n_decode=16)
+    assert out.n_decode_steps == 1
+
+
+def test_engine_decode_depth_gate(monkeypatch):
+    """LLMEngine._decode_depth_hint: deepens only when adaptive is on, the
+    waiting queue is empty, and the arrival stream has been quiet."""
+    import time as _time
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    eng = LLMEngine(EngineConfig(
+        model="tiny-llama-debug", max_model_len=128, block_size=8,
+        num_kv_blocks=64, max_num_seqs=4, max_prefill_tokens=32,
+        attn_impl="gather", num_decode_steps=2,
+        adaptive_decode_steps=8, adaptive_decode_quiet_s=0.2,
+    ))
+    assert eng._decode_depth_hint() == 8  # no arrivals ever: quiet
+    eng.add_request("r1", prompt_token_ids=[1, 2, 3])
+    assert eng._decode_depth_hint() is None  # waiting + recent arrival
+    while eng.has_work():
+        eng.step()
+    eng._last_arrival = _time.time()
+    assert eng._decode_depth_hint() is None  # within the quiet window
+    eng._last_arrival -= 1.0
+    assert eng._decode_depth_hint() == 8  # quiet again

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Install Helm if missing. Reference analogue: utils/install-helm.sh.
+set -euo pipefail
+if command -v helm >/dev/null 2>&1; then
+  echo "helm already installed: $(helm version --short)"
+  exit 0
+fi
+curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+helm version --short

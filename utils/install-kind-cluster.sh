@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Bare VM -> kind cluster ready for `helm install` of the stack.
+# Reference analogue: utils/install-kind-cluster.sh (minikube variant below).
+#
+#   ./utils/install-kind-cluster.sh            # cluster + LWS CRD
+#   INSTALL_PROM=1 ./utils/install-kind-cluster.sh   # + kube-prom-stack
+set -euo pipefail
+cd "$(dirname "$0")"
+
+./install-kubectl.sh
+./install-helm.sh
+./install-kind.sh
+
+CLUSTER=${CLUSTER_NAME:-pst}
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+  kind create cluster --name "$CLUSTER" --wait 120s
+fi
+kubectl cluster-info --context "kind-${CLUSTER}"
+
+# LWS CRDs (multihost engine template) — best-effort on clusters that
+# will never run multi-host slices.
+./install-lws-crd.sh || echo "WARN: LWS install failed (multihost template unavailable)"
+
+if [[ "${INSTALL_PROM:-0}" == "1" ]]; then
+  ./install-kube-prom-stack.sh
+fi
+
+cat <<EOF
+
+Cluster ready. Install the stack:
+
+  helm install pst ./helm -f helm/examples/values-minimal.yaml
+
+EOF

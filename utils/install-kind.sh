@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Install kind (Kubernetes-in-Docker) if missing. Reference: utils/install-kind.sh.
+set -euo pipefail
+if command -v kind >/dev/null 2>&1; then
+  echo "kind already installed: $(kind version)"
+  exit 0
+fi
+ARCH=$(uname -m); case "$ARCH" in x86_64) ARCH=amd64 ;; aarch64) ARCH=arm64 ;; esac
+KIND_VERSION=${KIND_VERSION:-v0.23.0}
+curl -fsSLo /tmp/kind "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-${ARCH}"
+sudo install -m 0755 /tmp/kind /usr/local/bin/kind
+kind version

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# kube-prometheus-stack with the repo's scrape/dashboard values.
+# Reference analogue: the observability install steps in
+# observability/README + tutorials (kube-prom-stack.yaml values).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts >/dev/null
+helm repo update >/dev/null
+helm upgrade -i kube-prom prometheus-community/kube-prometheus-stack \
+  --namespace monitoring --create-namespace \
+  -f observability/kube-prom-stack.yaml \
+  --wait --timeout 10m
+
+# Grafana dashboards as ConfigMaps (sidecar-discovered).
+kubectl -n monitoring create configmap pst-dashboards \
+  --from-file=observability/pst-dashboard.json \
+  --from-file=observability/kv-tiering-dashboard.json \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl -n monitoring label configmap pst-dashboards grafana_dashboard=1 --overwrite
+
+# Custom-metrics adapter (HPA/KEDA on vllm:num_requests_waiting).
+kubectl apply -f observability/prom-adapter.yaml || \
+  echo "WARN: prom-adapter apply failed (HPA on engine metrics unavailable)"
+echo "observability stack installed (namespace: monitoring)"

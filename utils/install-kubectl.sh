@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Install kubectl if missing. Reference analogue: utils/install-kubectl.sh.
+set -euo pipefail
+if command -v kubectl >/dev/null 2>&1; then
+  echo "kubectl already installed: $(kubectl version --client --output=yaml | head -2)"
+  exit 0
+fi
+ARCH=$(uname -m); case "$ARCH" in x86_64) ARCH=amd64 ;; aarch64) ARCH=arm64 ;; esac
+VER=$(curl -fsSL https://dl.k8s.io/release/stable.txt)
+curl -fsSLo /tmp/kubectl "https://dl.k8s.io/release/${VER}/bin/linux/${ARCH}/kubectl"
+sudo install -m 0755 /tmp/kubectl /usr/local/bin/kubectl
+kubectl version --client

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Install the LeaderWorkerSet (LWS) operator + CRDs — required by
+# helm/templates/multihost-engine.yaml (the Ray-cluster replacement for
+# multi-host TPU slices; SURVEY.md §2.4 "Pipeline parallel, multi-host").
+set -euo pipefail
+LWS_VERSION=${LWS_VERSION:-v0.5.1}
+kubectl apply --server-side \
+  -f "https://github.com/kubernetes-sigs/lws/releases/download/${LWS_VERSION}/manifests.yaml"
+kubectl -n lws-system rollout status deploy/lws-controller-manager --timeout=180s
+echo "LeaderWorkerSet ${LWS_VERSION} installed"

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Bare VM -> single-node minikube cluster ready for `helm install`.
+# Reference analogue: utils/install-minikube-cluster.sh (GPU operator swapped
+# for the TPU device-plugin DaemonSet on real TPU-VM nodes; kind is the
+# lighter CI option — see install-kind-cluster.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+./install-kubectl.sh
+./install-helm.sh
+
+if ! command -v minikube >/dev/null 2>&1; then
+  ARCH=$(uname -m); case "$ARCH" in x86_64) ARCH=amd64 ;; aarch64) ARCH=arm64 ;; esac
+  curl -fsSLo /tmp/minikube \
+    "https://storage.googleapis.com/minikube/releases/latest/minikube-linux-${ARCH}"
+  sudo install -m 0755 /tmp/minikube /usr/local/bin/minikube
+fi
+
+minikube status >/dev/null 2>&1 || minikube start --driver=docker --memory=8g --cpus=4
+kubectl cluster-info
+
+./install-lws-crd.sh || echo "WARN: LWS install failed (multihost template unavailable)"
+
+# On a real TPU-VM node pool, expose google.com/tpu resources to kubelet.
+# (No-op on laptops/CI — the fake engine image needs no TPU resource.)
+if [[ "${INSTALL_TPU_PLUGIN:-0}" == "1" ]]; then
+  kubectl apply -f https://raw.githubusercontent.com/GoogleCloudPlatform/ai-on-gke/main/tpu-provisioner/deploy/device-plugin.yaml || \
+    echo "WARN: TPU device plugin apply failed"
+fi
+
+cat <<EOF
+
+Cluster ready. Install the stack:
+
+  helm install pst ./helm -f helm/examples/values-minimal.yaml
+
+EOF
