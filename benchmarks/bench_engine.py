@@ -1,0 +1,221 @@
+"""Engine-phase benchmark: the reference multi-round-QA protocol in-process.
+
+Run as a subprocess by the top-level ``bench.py`` (it owns the chip while it
+runs; the stack phase needs the chip afterwards). Prints ONE JSON object.
+
+Phases (BASELINE.md protocol; reference `run_single.sh:12-40`):
+  0. env probe   — trivial dispatch+fetch round trips → the tunnel's RPC
+                   floor. TTFT on a remote-attached chip cannot go below
+                   this; recording it makes runs comparable across the
+                   environment's hour-to-hour drift.
+  1. 8B headline — llama-3-8b (int8 weights + fp8 KV on one 16 GiB chip),
+                   4 users x (1000 sys + 20000 history), cold prefill →
+                   prefill probe → warm compile → QPS sweep (p50/p99 per
+                   point) → saturated decode probe.
+  2. 1B secondary — llama-1b at the r1-r3 workload (8 users, qps 1.0) for
+                   round-over-round comparability + its decode probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_PEAK_FLOPS = 197e12  # bf16 peak of one v5e chip (MXU)
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def env_probe() -> float:
+    """Median trivial dispatch→fetch round trip (ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(32, dtype=jnp.int32)
+    f = jax.jit(lambda x, i: x + i)
+    jax.block_until_ready(f(x, 0))
+    vals = []
+    for i in range(7):
+        t0 = time.perf_counter()
+        jax.device_get(f(x, i))
+        vals.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(vals))
+
+
+def mfu(n_params: int, rate) -> float | None:
+    return (
+        round(2 * n_params * rate / V5E_PEAK_FLOPS, 4) if rate else None
+    )
+
+
+def run_model_phase(
+    model: str,
+    *,
+    quantization=None,
+    n_users: int,
+    sys_len: int,
+    hist_len: int,
+    question_len: int,
+    answer_len: int,
+    num_kv_blocks,
+    sweep,  # [(qps, n_rounds), ...]
+    stagger,
+    decode_probe_tokens: int,
+    num_decode_steps: int = 4,
+    adaptive: int = 16,
+    block_size: int = 128,
+    max_model_len: int = 32768,
+    attn_impl: str = "pallas",
+    kv_cache_dtype="float8_e4m3fn",
+    with_prefill_probe: bool = True,
+) -> dict:
+    from benchmarks.protocol import ProtocolRunner
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    cfg = EngineConfig(
+        model=model,
+        quantization=quantization,
+        max_model_len=max_model_len,
+        block_size=block_size,
+        num_kv_blocks=num_kv_blocks,
+        hbm_utilization=0.88,
+        max_num_seqs=max(2 * n_users, 8),
+        max_prefill_tokens=1024,
+        attn_impl=attn_impl,
+        kv_cache_dtype=kv_cache_dtype,
+        num_decode_steps=num_decode_steps,
+        adaptive_decode_steps=adaptive,
+        adaptive_decode_quiet_s=2.0,
+        min_decode_bucket=min(8, n_users),
+    )
+    t0 = time.time()
+    engine = LLMEngine(cfg)
+    log(f"{model}: engine up in {time.time()-t0:.1f}s, "
+        f"{engine.runner.param_count/1e9:.2f}B params, "
+        f"{engine.runner.num_blocks} kv pages")
+    pr = ProtocolRunner(
+        engine, n_users, sys_len, hist_len, question_len, answer_len
+    )
+    t0 = time.time()
+    pr.cold_prefill()
+    log(f"{model}: cold prefill {time.time()-t0:.1f}s")
+    prefill_rate = pr.prefill_probe() if with_prefill_probe else None
+    if prefill_rate:
+        log(f"{model}: warm prefill {prefill_rate:.0f} tok/s")
+    pr.warm_compile(stagger)
+    log(f"{model}: warm compile done")
+
+    points = []
+    all_ttfts: list = []
+    t_meas = time.time()
+    for qps, n_rounds in sweep:
+        ttfts = pr.measured_rounds(qps, n_rounds, tag=f"q{qps}")
+        points.append({
+            "qps": qps,
+            "n_requests": len(ttfts),
+            "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1),
+            "p99_ttft_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 1),
+        })
+        all_ttfts.extend(ttfts)
+        log(f"{model}: qps {qps}: {points[-1]}")
+    measure_wall = time.time() - t_meas
+
+    decode_rate = pr.decode_probe(max_tokens=decode_probe_tokens)
+    n_params = engine.runner.param_count
+    out = {
+        "model": engine.model_cfg.name,
+        "quantization": quantization,
+        "kv_cache_dtype": str(cfg.kv_cache_dtype or engine.model_cfg.dtype),
+        "n_users": n_users,
+        "system_prompt_tokens": sys_len,
+        "history_tokens": hist_len,
+        "max_model_len": max_model_len,
+        "p50_ttft_ms": round(float(np.percentile(all_ttfts, 50)) * 1e3, 2),
+        "p99_ttft_ms": round(float(np.percentile(all_ttfts, 99)) * 1e3, 2),
+        "sweep": points,
+        "n_measured_requests": len(all_ttfts),
+        "measure_wall_s": round(measure_wall, 1),
+        "prefill_tok_per_s": round(prefill_rate, 1) if prefill_rate else None,
+        "prefill_mfu": mfu(n_params, prefill_rate),
+        "decode_tok_per_s_chip": round(decode_rate, 1) if decode_rate else None,
+        "decode_mfu": mfu(n_params, decode_rate),
+        "prefix_cache_hit_rate": round(engine.allocator.hit_rate, 3),
+    }
+    del pr
+    del engine
+    return out
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    result: dict = {"backend": backend}
+
+    if on_tpu:
+        result["rpc_floor_ms"] = round(env_probe(), 1)
+        log(f"rpc floor {result['rpc_floor_ms']} ms")
+        if os.environ.get("PST_BENCH_SKIP_8B") != "1":
+            result["flagship"] = run_model_phase(
+                "llama-3-8b",
+                quantization="int8",
+                n_users=4,
+                sys_len=1000,
+                hist_len=20000,
+                question_len=28,
+                answer_len=100,
+                num_kv_blocks=None,  # auto from the 16 GiB budget
+                sweep=[(0.3, 4), (0.7, 10), (1.1, 20)],
+                stagger=((0,), (1, 2), (3,)),
+                decode_probe_tokens=192,
+            )
+        if os.environ.get("PST_BENCH_SKIP_1B") != "1":
+            result["llama_1b"] = run_model_phase(
+                "llama-1b",
+                n_users=8,
+                sys_len=1000,
+                hist_len=20000,
+                question_len=28,
+                answer_len=100,
+                num_kv_blocks=1408,
+                sweep=[(1.0, 4)],
+                stagger=((0,), (1, 2), (3, 4, 5, 6), (7,)),
+                decode_probe_tokens=192,
+                adaptive=24,
+            )
+    else:
+        # CPU smoke: tiny model, tiny protocol — keeps the bench runnable
+        # (and CI-checkable) anywhere.
+        result["flagship"] = run_model_phase(
+            "tiny-llama-debug",
+            n_users=4,
+            sys_len=64,
+            hist_len=96,
+            question_len=12,
+            answer_len=16,
+            num_kv_blocks=512,
+            sweep=[(8.0, 2)],
+            stagger=((0,), (1, 2), (3,)),
+            decode_probe_tokens=16,
+            num_decode_steps=4,
+            adaptive=8,
+            block_size=8,
+            max_model_len=512,
+            attn_impl="gather",
+            kv_cache_dtype=None,
+        )
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
