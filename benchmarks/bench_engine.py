@@ -75,7 +75,6 @@ def run_model_phase(
     max_model_len: int = 32768,
     attn_impl: str = "pallas",
     kv_cache_dtype="float8_e4m3fn",
-    with_prefill_probe: bool = True,
 ) -> dict:
     from benchmarks.protocol import ProtocolRunner
     from production_stack_tpu.engine.config import EngineConfig
@@ -94,7 +93,11 @@ def run_model_phase(
         kv_cache_dtype=kv_cache_dtype,
         num_decode_steps=num_decode_steps,
         adaptive_decode_steps=adaptive,
-        adaptive_decode_quiet_s=2.0,
+        # Deepen only when the arrival stream pauses AND every user's
+        # request is already running (closed-loop traffic: nobody is left
+        # to arrive, so a deep burst cannot delay a TTFT).
+        adaptive_decode_quiet_s=1.0,
+        adaptive_decode_min_running=n_users,
         min_decode_bucket=min(8, n_users),
     )
     t0 = time.time()
@@ -108,9 +111,8 @@ def run_model_phase(
     t0 = time.time()
     pr.cold_prefill()
     log(f"{model}: cold prefill {time.time()-t0:.1f}s")
-    prefill_rate = pr.prefill_probe() if with_prefill_probe else None
-    if prefill_rate:
-        log(f"{model}: warm prefill {prefill_rate:.0f} tok/s")
+    prefill_rate = pr.prefill_probe()
+    log(f"{model}: warm prefill {prefill_rate:.0f} tok/s")
     pr.warm_compile(stagger)
     log(f"{model}: warm compile done")
 
@@ -169,6 +171,15 @@ def main() -> None:
             result["flagship"] = run_model_phase(
                 "llama-3-8b",
                 quantization="int8",
+                # 4 users x ~21.6k tokens ≈ 86k of fp8 KV next to 7.5 GiB
+                # of int8 weights: the 16 GiB budget's ~108k-token cache
+                # (844 pages) holds every history resident INCLUDING the
+                # ~14k tokens the histories grow across the sweep and the
+                # prefill probe's fresh history (evicted first — see
+                # prefill_probe). A 5th user would cross capacity
+                # mid-sweep and thrash (each evicted page costs a
+                # re-prefill or, through the bench tunnel, a ~100 ms/page
+                # fault).
                 n_users=4,
                 sys_len=1000,
                 hist_len=20000,
@@ -178,6 +189,7 @@ def main() -> None:
                 sweep=[(0.3, 4), (0.7, 10), (1.1, 20)],
                 stagger=((0,), (1, 2), (3,)),
                 decode_probe_tokens=192,
+                adaptive=32,
             )
         if os.environ.get("PST_BENCH_SKIP_1B") != "1":
             result["llama_1b"] = run_model_phase(
@@ -190,8 +202,8 @@ def main() -> None:
                 num_kv_blocks=1408,
                 sweep=[(1.0, 4)],
                 stagger=((0,), (1, 2), (3, 4, 5, 6), (7,)),
-                decode_probe_tokens=192,
-                adaptive=24,
+                decode_probe_tokens=256,
+                adaptive=32,
             )
     else:
         # CPU smoke: tiny model, tiny protocol — keeps the bench runnable

@@ -143,13 +143,32 @@ class UserSession:
                         data = line[6:]
                         if data == "[DONE]":
                             break
+                        chunk = json.loads(data)
+                        if not chunk.get("choices"):
+                            continue  # usage-only trailer (some servers)
+                        choice = chunk["choices"][0]
+                        delta = choice.get("delta", {})
+                        if "role" in delta and not delta.get("content"):
+                            # Stream preamble (role announcement), sent
+                            # before any token computes — not the TTFT.
+                            continue
+                        text = (
+                            delta.get("content")
+                            if delta
+                            else choice.get("text")
+                        )
+                        if not text and choice.get("finish_reason") and not delta:
+                            # vLLM-style dedicated finish trailer: carries
+                            # no token of its own.
+                            continue
+                        # Every other chunk is one generated token (this
+                        # repo's engine emits one per token even while the
+                        # detokenizer holds back partial characters).
                         if rec.ttft < 0:
                             rec.ttft = time.time() - rec.launch_time
-                        chunk = json.loads(data)
-                        delta = chunk["choices"][0].get("delta", {})
-                        if delta.get("content"):
-                            answer_parts.append(delta["content"])
-                            rec.completion_tokens += 1
+                        rec.completion_tokens += 1
+                        if text:
+                            answer_parts.append(text)
                 else:
                     body = await resp.json()
                     rec.ttft = time.time() - rec.launch_time

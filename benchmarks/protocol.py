@@ -154,7 +154,9 @@ class ProtocolRunner:
 
     def prefill_probe(self) -> float:
         """Phase 2: one fresh user-sized prompt, warm compiles — prefill
-        tok/s over the non-cached suffix."""
+        tok/s over the non-cached suffix. The probe's pages are never
+        re-touched afterwards, so later allocation pressure evicts exactly
+        them (LRU) rather than any live user history."""
         fresh = self.system_prompt + self._toks(
             len(self.histories[0]) - len(self.system_prompt)
         )

@@ -74,6 +74,11 @@ class EngineConfig:
     # decode (batch/offline phases) runs at the deep setting. 0 = off.
     adaptive_decode_steps: int = 0
     adaptive_decode_quiet_s: float = 0.5
+    # Additional deepening gate: require at least this many running
+    # sequences. In closed-loop/multi-round traffic a full running set
+    # means no client has a request left to send — exactly when a deep
+    # burst cannot delay anyone's TTFT. 0 = no constraint.
+    adaptive_decode_min_running: int = 0
     # Floor for the decode-batch row bucket. Serving workloads whose active
     # set fluctuates otherwise walk through every power-of-two width,
     # compiling each one the first time it appears (an XLA compile mid-burst

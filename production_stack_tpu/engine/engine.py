@@ -286,6 +286,8 @@ class LLMEngine:
             return None
         if self.scheduler.num_waiting:
             return None
+        if self.scheduler.num_running < self.cfg.adaptive_decode_min_running:
+            return None
         if time.time() - self._last_arrival < self.cfg.adaptive_decode_quiet_s:
             return None
         return cap

@@ -960,6 +960,7 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--adaptive-decode-steps", type=int, default=0,
                    help="deep burst cap when the arrival stream is quiet")
     p.add_argument("--adaptive-decode-quiet-s", type=float, default=0.5)
+    p.add_argument("--adaptive-decode-min-running", type=int, default=0)
     p.add_argument("--min-decode-bucket", type=int, default=1)
     # Speculative decoding (n-gram prompt lookup; 0 = off).
     p.add_argument("--speculative-ngram", type=int, default=0,
@@ -1013,6 +1014,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         num_decode_steps=args.num_decode_steps,
         adaptive_decode_steps=args.adaptive_decode_steps,
         adaptive_decode_quiet_s=args.adaptive_decode_quiet_s,
+        adaptive_decode_min_running=args.adaptive_decode_min_running,
         min_decode_bucket=args.min_decode_bucket,
         speculative_ngram=args.speculative_ngram,
         ngram_min=args.ngram_min,
