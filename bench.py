@@ -182,7 +182,7 @@ def run_stack_phase(on_tpu: bool) -> dict:
 
         def drive(base_url: str, tag: str, rounds: int) -> dict:
             cfg = WorkloadConfig(
-                num_users=4, num_rounds=rounds, qps=0.5,
+                num_users=4, num_rounds=rounds, qps=1.0,
                 system_prompt_len=sys_len, chat_history_len=hist_len,
                 answer_len=answer_len, model=model, base_url=base_url,
                 seed=7,  # same histories both legs: second leg runs warm
