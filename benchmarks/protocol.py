@@ -167,13 +167,23 @@ class ProtocolRunner:
 
     def warm_compile(self, stagger_groups=((0,), (1, 2), (3, 4, 5, 6), (7,))):
         """Phase 3: all-at-once rounds + a staggered round so every batch
-        bucket the Poisson phase can hit is compiled."""
+        bucket the Poisson phase can hit is compiled — including the
+        adaptive deep-burst shape (its first use must not land inside a
+        measured phase: an XLA compile there reads as seconds of fake
+        latency)."""
         for r in range(2):
             self.qa_round(f"warmup{r}")
         for group in stagger_groups:
             group = [u for u in group if u < self.n_users]
             if group:
                 self.qa_round(f"stagger{group[0]}", users=list(group))
+        cfg = self.engine.cfg
+        if cfg.adaptive_decode_steps > cfg.num_decode_steps:
+            # Long enough that the quiet gate opens mid-round (arrivals
+            # reset the timer) and the deep shape compiles + runs here.
+            self.qa_round(
+                "warmdeep", max_tokens=3 * cfg.adaptive_decode_steps
+            )
         self.engine.allocator.reset_metrics()
 
     def measured_rounds(

@@ -30,7 +30,7 @@ def main() -> None:
 
     import os
 
-    blocks = {"llama-1b": 1408, "llama-3-8b": 860}[model]
+    blocks = {"llama-1b": 1408, "llama-3-8b": 840}[model]
     cfg = EngineConfig(
         model=model,
         quantization=quant,
@@ -44,6 +44,7 @@ def main() -> None:
         num_decode_steps=n_steps,
         adaptive_decode_steps=int(os.environ.get("PST_ADAPTIVE", "0")),
         adaptive_decode_quiet_s=float(os.environ.get("PST_QUIET", "0.5")),
+        adaptive_decode_min_running=int(os.environ.get("PST_MINRUN", "0")),
         min_decode_bucket=min(8, n_users),
         async_decode=use_async,
     )
