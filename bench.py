@@ -202,26 +202,34 @@ def run_stack_phase(on_tpu: bool) -> dict:
         # pattern missed.
         drive(f"http://127.0.0.1:{eport}", "warmup", rounds=2)
         drive(f"http://127.0.0.1:{eport}", "warmup2", rounds=2)
-        # Sandwich design: direct → via → direct. The environment's TTFT
-        # floor drifts minute-to-minute by tens of ms; averaging the two
-        # direct legs cancels linear drift so the via−direct delta
-        # isolates the router hop.
-        direct1 = drive(f"http://127.0.0.1:{eport}", "engine-direct", rounds=2)
-        via = drive(f"http://127.0.0.1:{rport}", "via-router", rounds=2)
-        direct2 = drive(f"http://127.0.0.1:{eport}", "engine-direct-2", rounds=2)
+        # Interleaved legs with MEDIANS: the tunnel's TTFT floor both
+        # drifts (tens of ms/minute) and throws multi-second one-sided
+        # transients; a mean over two direct legs let a single transient
+        # flip the delta's sign. Alternating D/V legs and taking medians
+        # keeps one bad leg from biasing either side.
+        import statistics
+
+        direct_legs, via_legs = [], []
+        for i in range(3):
+            direct_legs.append(
+                drive(f"http://127.0.0.1:{eport}", f"direct-{i}", rounds=2)
+            )
+            via_legs.append(
+                drive(f"http://127.0.0.1:{rport}", f"via-{i}", rounds=2)
+            )
         direct_p50 = round(
-            (direct1["ttft_p50_ms"] + direct2["ttft_p50_ms"]) / 2, 1
+            statistics.median(leg["ttft_p50_ms"] for leg in direct_legs), 1
+        )
+        via_p50 = round(
+            statistics.median(leg["ttft_p50_ms"] for leg in via_legs), 1
         )
         return {
             "model": model,
             "engine_direct_p50_ttft_ms": direct_p50,
-            "via_router_p50_ttft_ms": via["ttft_p50_ms"],
-            "router_overhead_ms": round(
-                via["ttft_p50_ms"] - direct_p50, 1
-            ),
-            "engine_direct_leg1": direct1,
-            "engine_direct_leg2": direct2,
-            "via_router": via,
+            "via_router_p50_ttft_ms": via_p50,
+            "router_overhead_ms": round(via_p50 - direct_p50, 1),
+            "engine_direct_legs": direct_legs,
+            "via_router_legs": via_legs,
         }
     finally:
         for proc in (router, engine):

@@ -179,11 +179,24 @@ class ProtocolRunner:
                 self.qa_round(f"stagger{group[0]}", users=list(group))
         cfg = self.engine.cfg
         if cfg.adaptive_decode_steps > cfg.num_decode_steps:
-            # Long enough that the quiet gate opens mid-round (arrivals
-            # reset the timer) and the deep shape compiles + runs here.
-            self.qa_round(
-                "warmdeep", max_tokens=3 * cfg.adaptive_decode_steps
-            )
+            # Force the adaptive gate open so the deep-burst shape
+            # DETERMINISTICALLY compiles here (relying on the quiet timer
+            # is racy: a fast model can drain the round before it opens).
+            # drive() directly — not qa_round — so user histories are NOT
+            # extended: measured rounds must start from identical context
+            # whether or not the adaptive warm-up ran.
+            old = (cfg.adaptive_decode_quiet_s, cfg.adaptive_decode_min_running)
+            cfg.adaptive_decode_quiet_s = 0.0
+            cfg.adaptive_decode_min_running = 0
+            try:
+                self.drive([
+                    (f"warmdeep-{u}", u, self.histories[u],
+                     2 * cfg.adaptive_decode_steps)
+                    for u in range(self.n_users)
+                ])
+            finally:
+                cfg.adaptive_decode_quiet_s = old[0]
+                cfg.adaptive_decode_min_running = old[1]
         self.engine.allocator.reset_metrics()
 
     def measured_rounds(
