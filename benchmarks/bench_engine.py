@@ -189,6 +189,12 @@ def main() -> None:
                 sweep=[(0.3, 4), (0.7, 10), (1.1, 20)],
                 stagger=((0,), (1, 2), (3,)),
                 decode_probe_tokens=192,
+                # Shallow live bursts + deep saturation bursts: at the 8B
+                # compute/floor ratio, n=2 cuts the burst wall an arrival
+                # can stall behind (p99/p50 1.44 vs ~1.8 at n=4, measured)
+                # while the min-running-gated deep bursts carry saturated
+                # decode.
+                num_decode_steps=2,
                 adaptive=32,
             )
         if os.environ.get("PST_BENCH_SKIP_1B") != "1":
@@ -220,7 +226,7 @@ def main() -> None:
             stagger=((0,), (1, 2), (3,)),
             decode_probe_tokens=16,
             num_decode_steps=4,
-            adaptive=8,
+            adaptive=0,  # CPU drains the probe before the quiet gate opens
             block_size=8,
             max_model_len=512,
             attn_impl="gather",

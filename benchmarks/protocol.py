@@ -70,9 +70,18 @@ class ProtocolRunner:
         n_users*num_decode_steps) — the saturated-decode throughput."""
         engine = self.engine
         if decode_burst is None:
-            decode_burst = self.n_users * max(
-                engine.cfg.num_decode_steps, 1
+            # Saturated-decode qualification: count only full-width,
+            # full-depth bursts. With adaptive depth enabled that means
+            # DEEP bursts — the shallow ramp before the gate opens spends
+            # a whole tunnel round trip on n_users*num_decode_steps tokens
+            # and would drag the "saturated" average far below the
+            # steady-state rate.
+            steps = max(
+                engine.cfg.num_decode_steps,
+                engine.cfg.adaptive_decode_steps,
+                1,
             )
+            decode_burst = self.n_users * steps
         t_base = time.time()
         offset = 0.0
         pending = []
