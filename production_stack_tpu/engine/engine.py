@@ -110,8 +110,10 @@ class LLMEngine:
             self.lora_manager = None
         # Unloaded-adapter slots awaiting their last in-flight sequence.
         self._retiring_slots: set = set()
-        # Last request arrival (adaptive burst-depth gate).
+        # Last request arrival (adaptive burst-depth gate) + observability
+        # counter for deep bursts actually executed.
         self._last_arrival = 0.0
+        self.adaptive_deep_bursts_total = 0
         self._seqs: Dict[str, Sequence] = {}
         # Incremental detokenizer state per request:
         # emitted text + [prefix_offset, read_offset) decode window.
@@ -300,6 +302,8 @@ class LLMEngine:
             sched = self.scheduler.schedule(locked=locked, n_decode=hint)
             self.num_preempted_total += len(sched.preempted)
             if self._can_continue_burst(sched):
+                if self._burst_n > self.cfg.num_decode_steps:
+                    self.adaptive_deep_bursts_total += 1
                 rows = self.runner.burst_continue(self._burst_seqs)
                 outputs += self._process_burst_rows(rows)
                 self._sweep_retiring_slots()
@@ -351,12 +355,20 @@ class LLMEngine:
             # on the NEXT step, overlapped with the following burst.
             self._burst_seqs = list(sched.decodes)
             self._burst_n = sched.n_decode_steps
+            if sched.n_decode_steps > self.cfg.num_decode_steps:
+                self.adaptive_deep_bursts_total += 1
             self.runner.burst_start(sched.decodes, sched.n_decode_steps)
         elif (
             drafts := self._spec_drafts(sched.decodes, sched.n_decode_steps)
         ) is not None:
             outputs += self._spec_step(sched.decodes, drafts)
         else:
+            if (
+                hint is not None
+                and sched.decodes
+                and sched.n_decode_steps > self.cfg.num_decode_steps
+            ):
+                self.adaptive_deep_bursts_total += 1
             bursts = self.runner.execute_decode_multi(
                 sched.decodes, sched.n_decode_steps
             )
@@ -748,6 +760,10 @@ class LLMEngine:
             )
             out["spec_decode_num_accepted_tokens_total"] = float(
                 self.spec_accepted_total
+            )
+        if self.cfg.adaptive_decode_steps:
+            out["adaptive_deep_bursts_total"] = float(
+                self.adaptive_deep_bursts_total
             )
         # Tiering KPIs (present when the LMCache-analogue layer is on).
         for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):

@@ -126,6 +126,10 @@ class EngineMetrics:
             "vllm:spec_decode_num_accepted_tokens",
             "speculative draft tokens accepted",
         )
+        self.adaptive_deep = counter(
+            "pst:adaptive_deep_bursts",
+            "decode bursts executed at the adaptive deep depth",
+        )
         self._counter_last: dict = {}
 
     def _counter_to(self, c, key: str, total: float) -> None:
@@ -149,6 +153,10 @@ class EngineMetrics:
         self._counter_to(
             self.spec_accepted, "accepted",
             stats.get("spec_decode_num_accepted_tokens_total", 0),
+        )
+        self._counter_to(
+            self.adaptive_deep, "deep",
+            stats.get("adaptive_deep_bursts_total", 0),
         )
 
 

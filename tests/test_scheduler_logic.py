@@ -128,3 +128,38 @@ def test_engine_decode_depth_gate(monkeypatch):
     assert eng._decode_depth_hint() is None  # within the quiet window
     eng._last_arrival -= 1.0
     assert eng._decode_depth_hint() == 8  # quiet again
+
+
+def test_adaptive_deep_bursts_execute_and_count():
+    """End-to-end deep path: with the gate open, decode runs at the deep
+    depth, the counter advances, and output length is exact (the burst's
+    speculative tail past max_tokens is trimmed host-side)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    eng = LLMEngine(EngineConfig(
+        model="tiny-llama-debug", max_model_len=256, block_size=8,
+        num_kv_blocks=128, max_num_seqs=4, max_prefill_tokens=32,
+        attn_impl="gather", num_decode_steps=2,
+        adaptive_decode_steps=8, adaptive_decode_quiet_s=0.0,
+        adaptive_decode_min_running=2,
+    ))
+    out = eng.generate(
+        [[1, 2, 3], [4, 5, 6]],
+        SamplingParams(max_tokens=21, temperature=0.0, ignore_eos=True),
+    )
+    assert all(len(o["token_ids"]) == 21 for o in out)
+    assert eng.adaptive_deep_bursts_total >= 2
+    assert eng.stats()["adaptive_deep_bursts_total"] >= 2
+
+    # Deep output must equal shallow output token-for-token (greedy).
+    eng2 = LLMEngine(EngineConfig(
+        model="tiny-llama-debug", max_model_len=256, block_size=8,
+        num_kv_blocks=128, max_num_seqs=4, max_prefill_tokens=32,
+        attn_impl="gather", num_decode_steps=1,
+    ))
+    out2 = eng2.generate(
+        [[1, 2, 3], [4, 5, 6]],
+        SamplingParams(max_tokens=21, temperature=0.0, ignore_eos=True),
+    )
+    assert [o["token_ids"] for o in out] == [o["token_ids"] for o in out2]
