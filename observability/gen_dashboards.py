@@ -125,6 +125,10 @@ def fleet_dashboard():
          'clamp_min(sum(rate(vllm:spec_decode_num_draft_tokens_total[2m])),'
          ' 1e-9)', "accept rate"),
     ], 8, 25, unit="percentunit"))
+    p.append(panel("Adaptive deep decode bursts /s", [
+        ('sum(rate(pst:adaptive_deep_bursts_total[2m])) by (model_name)',
+         "{{model_name}}"),
+    ], 16, 25))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 
