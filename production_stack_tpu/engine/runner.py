@@ -625,10 +625,12 @@ class ModelRunner:
         batch = self._decode_batch(seqs, multi=True)
         # Guided-choice masks are rebuilt per token host-side; the scan body
         # cannot apply them. The scheduler forces n=1 for guided rows — fail
-        # loudly if that invariant ever breaks instead of dropping the mask.
-        assert "allowed_ids" not in batch, (
-            "guided-choice rows reached a multi-step decode burst"
-        )
+        # loudly if that invariant ever breaks instead of dropping the mask
+        # (RuntimeError, not assert: must survive `python -O`).
+        if "allowed_ids" in batch:
+            raise RuntimeError(
+                "guided-choice rows reached a multi-step decode burst"
+            )
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
         with self._device_lock:
@@ -678,11 +680,13 @@ class ModelRunner:
 
     def burst_start(self, seqs: List[Sequence], n_steps: int) -> None:
         """Dispatch the first burst of a pipeline (async; nothing fetched)."""
-        assert self._burst is None, "burst already in flight (drain first)"
+        if self._burst is not None:
+            raise RuntimeError("burst already in flight (drain first)")
         batch = self._decode_batch(seqs, multi=True)
-        assert "allowed_ids" not in batch, (
-            "guided-choice rows reached a pipelined decode burst"
-        )
+        if "allowed_ids" in batch:
+            raise RuntimeError(
+                "guided-choice rows reached a pipelined decode burst"
+            )
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
         with self._device_lock:

@@ -137,6 +137,14 @@ class EngineMetrics:
         if total > last:
             c.inc(total - last)
             self._counter_last[key] = total
+        elif total < last:
+            # Engine-side cumulative stat reset in-process: counting
+            # restarted from 0, so everything counted since the reset is
+            # `total`. Export it and re-baseline, instead of freezing until
+            # the total re-exceeds the stale high-water mark.
+            if total > 0:
+                c.inc(total)
+            self._counter_last[key] = total
 
     def refresh(self, stats: dict) -> None:
         self.running.set(stats["num_requests_running"])

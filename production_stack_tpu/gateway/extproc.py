@@ -183,6 +183,20 @@ class ExtProcHandler:
                     yield pb2.ProcessingResponse(
                         response_body=pb2.BodyResponse()
                     )
+            else:
+                # Unhandled message kind (e.g. request_trailers sent by a
+                # processing mode the trimmed proto doesn't model —
+                # WhichOneof returns None). Envoy matches response oneof to
+                # request oneof, so answering with a headers response would
+                # be a protocol error; we also can't build the right oneof
+                # (the trimmed proto lacks it). Close the stream cleanly:
+                # Envoy then continues the HTTP request without further
+                # external processing instead of stalling on a reply.
+                logger.warning(
+                    "unhandled ext-proc message kind %r: closing stream "
+                    "(request proceeds unprocessed)", kind,
+                )
+                return
 
 
 def make_server(picker: PickerClient, port: int, max_workers: int = 16):

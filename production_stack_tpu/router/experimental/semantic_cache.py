@@ -135,6 +135,12 @@ class SemanticCache:
         self._mode: Optional[str] = (
             None if embedder == "auto" else embedder
         )
+        # Embedding model the index was built with (engine mode only).
+        # Persisted alongside the embedder tag: in engine mode with no
+        # explicit --semantic-cache-embed-model, a restart can pin a
+        # different served model with the same dimension — same-dim but
+        # different vector spaces must not silently mix.
+        self._index_model: Optional[str] = None
         self.vectors = np.zeros((0, _DIM), np.float32)
         self.entries: List[dict] = []  # {"model":..., "response": body-json}
         self._lock = asyncio.Lock()
@@ -154,15 +160,38 @@ class SemanticCache:
             logger.info("semantic cache: auto-selected %r embedder", self._mode)
             if self._mode == "engine":
                 self._reset_if_dim_mismatch(vec.shape[0])
+                self._reset_if_model_mismatch(
+                    getattr(self.engine_embed, "_pinned", None)
+                )
                 return vec
         if self._mode == "engine":
             vec = await self.engine_embed(text) if self.engine_embed else None
             if vec is not None:
                 self._reset_if_dim_mismatch(vec.shape[0])
+                self._reset_if_model_mismatch(
+                    getattr(self.engine_embed, "_pinned", None)
+                )
             return vec  # None: backend briefly unavailable -> skip cache
         vec = hash_embed(text)
         self._reset_if_dim_mismatch(vec.shape[0])
         return vec
+
+    def _reset_if_model_mismatch(self, model: Optional[str]) -> None:
+        if model is None:
+            return
+        if self._index_model is None:
+            self._index_model = model
+            return
+        if self._index_model != model:
+            if len(self.entries):
+                logger.warning(
+                    "semantic cache: embedding model changed (%r -> %r); "
+                    "dropping %d entries",
+                    self._index_model, model, len(self.entries),
+                )
+            self.vectors = np.zeros((0, self.vectors.shape[1]), np.float32)
+            self.entries = []
+            self._index_model = model
 
     def _reset_if_dim_mismatch(self, dim: int) -> None:
         if self.vectors.shape[1] != dim:
@@ -198,6 +227,10 @@ class SemanticCache:
                         "semantic cache: adopting persisted %r embedder",
                         saved_mode,
                     )
+                saved_model = (
+                    str(loaded["model"]) if "model" in loaded else ""
+                )
+                self._index_model = saved_model or None
                 self.vectors = loaded["vectors"]
                 with open(jl) as f:
                     self.entries = [json.loads(line) for line in f]
@@ -210,6 +243,7 @@ class SemanticCache:
             os.path.join(self.cache_dir, "vectors.npz"),
             vectors=vectors,
             embedder=np.asarray(self._mode or "hash"),
+            model=np.asarray(self._index_model or ""),
         )
         with open(os.path.join(self.cache_dir, "entries.jsonl"), "w") as f:
             for e in entries:
