@@ -51,8 +51,12 @@ class EngineConfig:
     # per-output-channel scales (models/llama.py quantize_leaf). Halves
     # weight HBM and decode's weight-read bandwidth — what fits Llama-3-8B
     # plus its KV on one 16 GiB v5e chip (the reference serves the same 8B
-    # benchmark model on a 40 GiB A100). None = native dtype.
-    quantization: Optional[str] = None  # None | int8
+    # benchmark model on a 40 GiB A100). "int4" packs two group-wise-scaled
+    # (g=128, AWQ/GPTQ-family) nibbles per byte for the per-layer matmuls
+    # (embed/lm_head stay int8): quarters weight HBM, freeing room for
+    # ~2x the resident KV — 8 concurrent 20k-context users on one chip.
+    # None = native dtype.
+    quantization: Optional[str] = None  # None | int8 | int4
     attn_impl: str = "auto"  # auto | gather | pallas
     # MoE execution strategy: ragged (dropless lax.ragged_dot grouped
     # matmul — FLOP-proportional, the single-shard default) | dense

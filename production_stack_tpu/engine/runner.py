@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..logging_utils import init_logger
 from ..models.llama import (
+    QUANT4_SUFFIX,
     QUANT_LAYER_KEYS,
     QUANT_SUFFIX,
     QUANT_TOP_KEYS,
@@ -37,6 +38,7 @@ from ..models.llama import (
     init_leaf,
     load_hf_params,
     quantize_leaf,
+    quantize_leaf_int4,
 )
 from ..models.registry import get_model_config
 from ..ops.sampling import (
@@ -153,9 +155,12 @@ class ModelRunner:
 
         t0 = time.time()
         quant = cfg.quantization or None
-        if quant not in (None, "int8"):
-            raise ValueError(f"unsupported quantization {quant!r} (int8 only)")
-        pspecs = self.model.param_pspecs(pipeline=pp > 1, quantize=bool(quant))
+        if quant not in (None, "int8", "int4"):
+            raise ValueError(
+                f"unsupported quantization {quant!r} (int8 or int4)"
+            )
+        self._quant = quant
+        pspecs = self.model.param_pspecs(pipeline=pp > 1, quantize=quant or False)
         if cfg.enable_lora:
             pspecs["layers"].update(self.model.lora_pspecs(pipeline=pp > 1))
         if os.path.isdir(cfg.model):
@@ -164,7 +169,7 @@ class ModelRunner:
             # one (and no CPU JAX backend is needed under a pinned
             # JAX_PLATFORMS).
             params = load_hf_params(
-                self.model_cfg, cfg.model, quantize=bool(quant)
+                self.model_cfg, cfg.model, quantize=quant or False
             )
         elif quant:
             # Preset (random-init) + quantized: materialize leaf-by-leaf
@@ -181,7 +186,9 @@ class ModelRunner:
                     self.model.init_lora_bank(cfg.max_loras, cfg.max_lora_rank)
                 )
             self.params = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, self._fit_spec(s, x.shape, x.dtype))
+                ),
                 params,
                 pspecs,
             )
@@ -374,6 +381,44 @@ class ModelRunner:
     # Streamed param materialization (quantized presets)
     # ------------------------------------------------------------------
 
+    # Leaves above this replicate-instead-of-shard threshold still raise on
+    # non-divisible dims: silently replicating a multi-GB weight across tp
+    # would turn a clear startup misconfiguration into a distant OOM.
+    _FIT_SPEC_MAX_BYTES = 4 << 20
+
+    def _fit_spec(self, spec: P, shape, dtype=None) -> P:
+        """Drop sharding on SMALL axes the array's dims don't divide
+        (replicate instead). Real serving shapes always divide; tiny debug
+        models can end up with e.g. 2 int4 scale groups under tp=4 —
+        replicating a few-KB scale there beats failing the mesh placement.
+        Big leaves keep the loud divisibility error."""
+        ent = list(spec) + [None] * (len(shape) - len(spec))
+        for i, ax in enumerate(ent):
+            if ax is None:
+                continue
+            axes = (ax,) if isinstance(ax, str) else tuple(ax)
+            size = 1
+            for a in axes:
+                size *= self.mesh.shape[a]
+            if shape[i] % size:
+                nbytes = int(np.prod(shape)) * (
+                    np.dtype(dtype).itemsize if dtype is not None else 4
+                )
+                if nbytes > self._FIT_SPEC_MAX_BYTES:
+                    raise ValueError(
+                        f"param leaf of shape {tuple(shape)} ({nbytes>>20} MiB)"
+                        f" is not divisible by mesh axis {ax!r}"
+                        f" (size {size}) on dim {i}; refusing to replicate a"
+                        " large leaf — fix the parallelism config"
+                    )
+                logger.debug(
+                    "replicating small leaf %s on mesh axis %r "
+                    "(dim %d=%d not divisible by %d)",
+                    tuple(shape), ax, i, shape[i], size,
+                )
+                ent[i] = None
+        return P(*ent)
+
     def _init_params_streamed(self, pspecs: Dict[str, Any]) -> Dict[str, Any]:
         """Random-init params leaf-by-leaf, each jitted directly into its
         device sharding and (for matmul weights) quantized to int8 on
@@ -398,6 +443,9 @@ class ModelRunner:
             key = jax.random.fold_in(
                 rng, xxhash.xxh32(name.encode()).intdigest() & 0x7FFF_FFFF
             )
+            # Per-layer matmuls follow the configured mode (int8 or group-
+            # wise int4); embed/lm_head stay per-channel int8 in both modes.
+            int4 = self._quant == "int4" and name in QUANT_LAYER_KEYS
             qaxis = (
                 -2 if name in QUANT_LAYER_KEYS
                 else -1 if name in QUANT_TOP_KEYS
@@ -406,21 +454,30 @@ class ModelRunner:
             if qaxis is None:
                 into[name] = jax.jit(
                     functools.partial(init_leaf, name, sds.shape, sds.dtype),
-                    out_shardings=NamedSharding(self.mesh, specs_at[name]),
+                    out_shardings=NamedSharding(
+                        self.mesh, self._fit_spec(specs_at[name], sds.shape, sds.dtype)
+                    ),
                 )(key)
                 return
 
             def init_q(k):  # one jit per leaf: init + quantize fused
-                return quantize_leaf(
-                    init_leaf(name, sds.shape, sds.dtype, k), axis=qaxis
+                w = init_leaf(name, sds.shape, sds.dtype, k)
+                return (
+                    quantize_leaf_int4(w) if int4
+                    else quantize_leaf(w, axis=qaxis)
                 )
 
-            qname = name + QUANT_SUFFIX
+            qname = name + (QUANT4_SUFFIX if int4 else QUANT_SUFFIX)
+            q_sds, s_sds = jax.eval_shape(init_q, key)
             q, s = jax.jit(
                 init_q,
                 out_shardings=(
-                    NamedSharding(self.mesh, specs_at[name]),
-                    NamedSharding(self.mesh, specs_at[qname]),
+                    NamedSharding(
+                        self.mesh, self._fit_spec(specs_at[name], q_sds.shape, q_sds.dtype)
+                    ),
+                    NamedSharding(
+                        self.mesh, self._fit_spec(specs_at[qname], s_sds.shape, s_sds.dtype)
+                    ),
                 ),
             )(key)
             into[name], into[qname] = q, s

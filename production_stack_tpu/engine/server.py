@@ -956,7 +956,7 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kv-cache-dtype", default=None)
     # Weight-only int8 (per-output-channel scales): the `vllm serve
     # --quantization` analogue; what fits an 8B model + KV on one 16 GiB v5e.
-    p.add_argument("--quantization", default=None, choices=["int8"])
+    p.add_argument("--quantization", default=None, choices=["int8", "int4"])
     p.add_argument("--attn-impl", default="auto", choices=["auto", "gather", "pallas"])
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument(

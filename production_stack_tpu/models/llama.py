@@ -62,6 +62,8 @@ Params = Dict[str, Any]
 # ----------------------------------------------------------------------------
 
 QUANT_SUFFIX = "_qs"
+QUANT4_SUFFIX = "_q4s"
+QUANT4_GROUP = 128
 QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 QUANT_TOP_KEYS = ("embed", "lm_head")
 
@@ -76,16 +78,74 @@ def quantize_leaf(w: jax.Array, axis: int = -2) -> Tuple[jax.Array, jax.Array]:
     return q.astype(jnp.int8), s
 
 
-def quantize_tree(params: Params) -> Params:
+def _q4_group(din: int) -> int:
+    """Largest group size ≤ QUANT4_GROUP dividing the contraction dim (tiny
+    debug models have dims < 128; real models hit 128 exactly)."""
+    g = QUANT4_GROUP
+    while din % g:
+        g //= 2
+        if g < 2:
+            raise ValueError(f"int4 needs an even contraction dim, got {din}")
+    return g
+
+
+def quantize_leaf_int4(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric group-wise int4 over the contraction axis (-2), the
+    AWQ/GPTQ-family layout (group size 128). Returns (packed int8
+    [..., in/2, out] — even contraction rows in the low nibble, odd in the
+    high — and fp32 scales [..., in/G, out]). Packed int8 (not jnp.int4):
+    s4 arrays cannot cross jit boundaries on remote-attached backends."""
+    wf = w.astype(jnp.float32)
+    *lead, din, dout = wf.shape
+    g = _q4_group(din)
+    wg = wf.reshape(*lead, din // g, g, dout)
+    amax = jnp.max(jnp.abs(wg), axis=-2)  # [..., G, out]
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / s[..., :, None, :]), -7, 7).astype(jnp.int8)
+    q = q.reshape(*lead, din, dout)
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(lo, jnp.int8(0x0F)), jnp.left_shift(hi, 4)
+    )
+    return packed, s
+
+
+def dequant_int4(packed: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Unpack + scale an int4 weight to the compute dtype. All ops here are
+    elementwise/reshape on the packed array — XLA fuses them into the
+    consuming dot's HBM read, so the stream stays 0.5 byte/weight."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)  # sign-extended
+    hi = jnp.right_shift(packed, 4)  # arithmetic shift
+    w = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+    shape = w.shape[:-3] + (w.shape[-3] * 2, w.shape[-1])
+    w = w.reshape(shape).astype(dtype)
+    G = scales.shape[-2]
+    g = shape[-2] // G
+    w = w.reshape(shape[:-2] + (G, g, shape[-1])) * scales[
+        ..., :, None, :
+    ].astype(dtype)
+    return w.reshape(shape)
+
+
+def quantize_tree(params: Params, mode: str = "int8") -> Params:
     """Quantize all matmul weights of a loaded param tree in place.
     Used by the HF-checkpoint path (host-side); random-init presets use the
-    streamed per-leaf path in the runner instead (never holds the bf16 tree)."""
+    streamed per-leaf path in the runner instead (never holds the bf16 tree).
+    ``mode``: "int8" (per-channel) or "int4" (group-wise for the per-layer
+    matmuls; embed/lm_head stay int8 — the gather and post-matmul-scale
+    paths are exact there and the per-step byte win is negligible)."""
     layers = params["layers"]
     for k in QUANT_LAYER_KEYS:
         if k in layers:
-            q, s = quantize_leaf(layers[k], axis=-2)
-            layers[k] = q
-            layers[k + QUANT_SUFFIX] = s
+            if mode == "int4":
+                q, s = quantize_leaf_int4(layers[k])
+                layers[k] = q
+                layers[k + QUANT4_SUFFIX] = s
+            else:
+                q, s = quantize_leaf(layers[k], axis=-2)
+                layers[k] = q
+                layers[k + QUANT_SUFFIX] = s
     for k in QUANT_TOP_KEYS:
         if k in params:
             q, s = quantize_leaf(params[k], axis=-1)
@@ -99,6 +159,21 @@ def _wcast(w: jax.Array, dtype) -> jax.Array:
     fuses the convert into the dot's HBM read — the bandwidth saving is
     kept); everything else passes through."""
     return w.astype(dtype) if w.dtype == jnp.int8 else w
+
+
+def _wmat(p: Params, name: str, dtype) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Matmul weight operand under any quantization mode.
+
+    Returns (operand in compute dtype, post-matmul scale or None): int4
+    leaves dequantize pre-matmul (group scales vary along the contraction
+    dim, so no post-scale exists) — the unpack+scale fuses into the dot's
+    operand read; int8 leaves convert on the fly and hand back their
+    per-output-channel scale for the caller to apply post-matmul (exact)."""
+    w = p[name]
+    q4s = p.get(name + QUANT4_SUFFIX)
+    if q4s is not None:
+        return dequant_int4(w, q4s, dtype), None
+    return _wcast(w, dtype), p.get(name + QUANT_SUFFIX)
 
 
 def init_leaf(name: str, shape, dtype, key: jax.Array) -> jax.Array:
@@ -301,7 +376,7 @@ class Llama:
         return params
 
     def param_pspecs(
-        self, pipeline: bool = False, quantize: bool = False
+        self, pipeline: bool = False, quantize=False
     ) -> Params:
         """PartitionSpec tree matching :meth:`init_params`.
 
@@ -310,9 +385,13 @@ class Llama:
         emits the single all-reduce per block that layout implies). With
         ``pipeline=True`` the stacked layer axis is additionally sharded over
         pp, giving layer-stage parallelism without restructuring the tree.
-        With ``quantize=True`` the tree additionally carries the int8 scale
-        leaves (``*_qs``), sharded like their weight's output channels.
+        ``quantize``: False, or a mode — "int8"/True adds the per-channel
+        scale leaves (``*_qs``) sharded like their weight's output channels;
+        "int4" adds group-wise scale leaves (``*_q4s``, same rank and mesh
+        axes as their weight — only the contraction dim shrinks) for the
+        per-layer matmuls plus int8 ``*_qs`` for embed/lm_head.
         """
+        mode = "int8" if quantize is True else quantize
         pp = "pp" if pipeline else None
         if self.cfg.num_experts:
             # Expert bank: experts over ep, FFN hidden over tp (each expert
@@ -355,9 +434,11 @@ class Llama:
             specs["layers"]["post_mlp_norm"] = P(pp, None)
         if not self.cfg.tie_word_embeddings:
             specs["lm_head"] = P(None, AXIS_TENSOR)
-        if quantize:
-            # Scale spec = weight spec minus the reduced (input) axis: the
-            # scale shards exactly like its weight's output channels.
+        if mode:
+            # int8 scale spec = weight spec minus the reduced (input) axis:
+            # the scale shards exactly like its weight's output channels.
+            # int4 scale spec = weight spec verbatim (the group axis lives
+            # where the contraction axis does and shards the same way).
             def drop_axis(spec: P, ndim: int, axis: int) -> P:
                 ent = list(spec) + [None] * (ndim - len(spec))
                 del ent[axis]
@@ -366,6 +447,9 @@ class Llama:
             moe = bool(self.cfg.num_experts)
             for k in QUANT_LAYER_KEYS:
                 if k in specs["layers"]:
+                    if mode == "int4":
+                        specs["layers"][k + QUANT4_SUFFIX] = specs["layers"][k]
+                        continue
                     ndim = 4 if (moe and k in ("w_gate", "w_up", "w_down")) else 3
                     specs["layers"][k + QUANT_SUFFIX] = drop_axis(
                         specs["layers"][k], ndim, -2
@@ -523,9 +607,9 @@ class Llama:
             # would copy the whole layer cache twice per layer per step).
             flat_write, rope_cos, rope_sin, block_tables, kv_lens, positions = ctx
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
-            q = _proj(h, lp["wq"], lp.get("bq"), lp.get("wq" + QUANT_SUFFIX))
-            k = _proj(h, lp["wk"], lp.get("bk"), lp.get("wk" + QUANT_SUFFIX))
-            v = _proj(h, lp["wv"], lp.get("bv"), lp.get("wv" + QUANT_SUFFIX))
+            q = _proj(h, lp, "wq", lp.get("bq"))
+            k = _proj(h, lp, "wk", lp.get("bk"))
+            v = _proj(h, lp, "wv", lp.get("bv"))
             if has_lora:
                 q = q + lora_delta(lp, "wq", h).astype(q.dtype)
                 k = k + lora_delta(lp, "wk", h).astype(k.dtype)
@@ -578,12 +662,13 @@ class Llama:
                 softcap=cfg.attn_logit_softcap,
             )
             attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
+            wo, wo_s = _wmat(lp, "wo", x.dtype)
             o = jnp.einsum(
-                "btq,qd->btd", attn, _wcast(lp["wo"], x.dtype),
+                "btq,qd->btd", attn, wo,
                 preferred_element_type=jnp.float32,
             )
-            if "wo" + QUANT_SUFFIX in lp:
-                o = o * lp["wo" + QUANT_SUFFIX]
+            if wo_s is not None:
+                o = o * wo_s
             if has_lora:
                 o = o + lora_delta(lp, "wo", attn)
             o = o.astype(x.dtype)
@@ -711,15 +796,15 @@ class Llama:
         def layer(ctx, x, lp, li):
             rope_cos, rope_sin, causal = ctx
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset)
-            q = _proj(
-                h, lp["wq"], lp.get("bq"), lp.get("wq" + QUANT_SUFFIX)
-            ).reshape(B, T, cfg.num_kv_heads, G, cfg.head_dim)
-            k = _proj(
-                h, lp["wk"], lp.get("bk"), lp.get("wk" + QUANT_SUFFIX)
-            ).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-            v = _proj(
-                h, lp["wv"], lp.get("bv"), lp.get("wv" + QUANT_SUFFIX)
-            ).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            q = _proj(h, lp, "wq", lp.get("bq")).reshape(
+                B, T, cfg.num_kv_heads, G, cfg.head_dim
+            )
+            k = _proj(h, lp, "wk", lp.get("bk")).reshape(
+                B, T, cfg.num_kv_heads, cfg.head_dim
+            )
+            v = _proj(h, lp, "wv", lp.get("bv")).reshape(
+                B, T, cfg.num_kv_heads, cfg.head_dim
+            )
             q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
             if cfg.qk_norm:  # Qwen3: per-head RMSNorm over hd, pre-rope
                 q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
@@ -753,12 +838,13 @@ class Llama:
                     "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
                     preferred_element_type=jnp.float32,
                 ).reshape(B, T, cfg.q_size).astype(x.dtype)
+            wo, wo_s = _wmat(lp, "wo", x.dtype)
             o = jnp.einsum(
-                "btq,qd->btd", attn, _wcast(lp["wo"], x.dtype),
+                "btq,qd->btd", attn, wo,
                 preferred_element_type=jnp.float32,
             )
-            if "wo" + QUANT_SUFFIX in lp:
-                o = o * lp["wo" + QUANT_SUFFIX]
+            if wo_s is not None:
+                o = o * wo_s
             o = o.astype(x.dtype)
             if cfg.post_block_norms:
                 o = _rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps, offset)
@@ -860,17 +946,18 @@ def _mlp(cfg: "LlamaConfig", lp: Params, h: jax.Array, moe_impl: str = "auto") -
     sparse mixture-of-experts when ``cfg.num_experts``."""
     act = _act(cfg)
     if not cfg.num_experts:
-        gate = _proj(h, lp["w_gate"], None, lp.get("w_gate" + QUANT_SUFFIX))
-        up = _proj(h, lp["w_up"], None, lp.get("w_up" + QUANT_SUFFIX))
+        gate = _proj(h, lp, "w_gate")
+        up = _proj(h, lp, "w_up")
         ff = (
             act(gate.astype(jnp.float32)) * up.astype(jnp.float32)
         ).astype(h.dtype)
+        wd, wd_s = _wmat(lp, "w_down", h.dtype)
         out = jnp.einsum(
-            "btf,fd->btd", ff, _wcast(lp["w_down"], h.dtype),
+            "btf,fd->btd", ff, wd,
             preferred_element_type=jnp.float32,
         )
-        if "w_down" + QUANT_SUFFIX in lp:
-            out = out * lp["w_down" + QUANT_SUFFIX]
+        if wd_s is not None:
+            out = out * wd_s
         return out
     B, T, D = h.shape
     return _moe_mlp(cfg, lp, h.reshape(B * T, D), moe_impl).reshape(B, T, D)
@@ -907,11 +994,13 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
         raise ValueError(f"unknown moe_impl {impl!r} (ragged|dense|auto)")
 
     def deq(key: str) -> jax.Array:
-        # ragged_dot has no mixed-dtype story: int8 expert banks dequantize
-        # to one transient [E, ., .] bf16 bank (per layer inside the scan —
-        # storage stays int8, only this layer's working copy is bf16).
-        w, s = lp[key], lp.get(key + QUANT_SUFFIX)
-        return w if s is None else w.astype(x.dtype) * s[:, None, :].astype(x.dtype)
+        # ragged_dot has no mixed-dtype story: int8/int4 expert banks
+        # dequantize to one transient [E, ., .] bf16 bank (per layer inside
+        # the scan — storage stays quantized, only this layer's working copy
+        # is bf16). _wmat already dequantizes int4 pre-matmul; int8 hands
+        # back its per-channel scale to fold in here.
+        w, s = _wmat(lp, key, x.dtype)
+        return w if s is None else w * s[:, None, :].astype(x.dtype)
 
     if impl in ("ragged", "auto"):
         flat_ids = ids.reshape(-1)  # [N*K]
@@ -938,35 +1027,37 @@ def _moe_mlp(cfg: "LlamaConfig", lp: Params, x: jax.Array, impl: str) -> jax.Arr
     combine = jnp.sum(
         jax.nn.one_hot(ids, E, dtype=jnp.float32) * weights[..., None], axis=1
     )  # [N, E]
+    wg, wg_s = _wmat(lp, "w_gate", x.dtype)
+    wu, wu_s = _wmat(lp, "w_up", x.dtype)
     g = jnp.einsum(
-        "nd,edf->enf", x, _wcast(lp["w_gate"], x.dtype),
-        preferred_element_type=jnp.float32,
+        "nd,edf->enf", x, wg, preferred_element_type=jnp.float32
     )
     u = jnp.einsum(
-        "nd,edf->enf", x, _wcast(lp["w_up"], x.dtype),
-        preferred_element_type=jnp.float32,
+        "nd,edf->enf", x, wu, preferred_element_type=jnp.float32
     )
-    if "w_gate" + QUANT_SUFFIX in lp:
-        g = g * lp["w_gate" + QUANT_SUFFIX][:, None, :]
-        u = u * lp["w_up" + QUANT_SUFFIX][:, None, :]
+    if wg_s is not None:
+        g = g * wg_s[:, None, :]
+    if wu_s is not None:
+        u = u * wu_s[:, None, :]
     hh = (_act(cfg)(g) * u).astype(x.dtype)
+    wd, wd_s = _wmat(lp, "w_down", x.dtype)
     y = jnp.einsum(
-        "enf,efd->end", hh, _wcast(lp["w_down"], x.dtype),
-        preferred_element_type=jnp.float32,
+        "enf,efd->end", hh, wd, preferred_element_type=jnp.float32
     )
-    if "w_down" + QUANT_SUFFIX in lp:
-        y = y * lp["w_down" + QUANT_SUFFIX][:, None, :]
+    if wd_s is not None:
+        y = y * wd_s[:, None, :]
     return jnp.einsum("end,ne->nd", y, combine)
 
 
 def _proj(
     x: jax.Array,
-    w: jax.Array,
+    p: Params,
+    name: str,
     b: Optional[jax.Array] = None,
-    s: Optional[jax.Array] = None,
 ) -> jax.Array:
+    w, s = _wmat(p, name, x.dtype)
     out = jnp.einsum(
-        "btd,do->bto", x, _wcast(w, x.dtype), preferred_element_type=jnp.float32
+        "btd,do->bto", x, w, preferred_element_type=jnp.float32
     )
     if s is not None:  # int8 per-output-channel scale
         out = out * s
@@ -1057,17 +1148,40 @@ def _np_quantize(w: np.ndarray, axis: int) -> Tuple[np.ndarray, np.ndarray]:
     return q, s.astype(np.float32)
 
 
+def _np_quantize_int4(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side group-wise int4 (contraction axis -2), numpy mirror of
+    :func:`quantize_leaf_int4` — bit-identical packing."""
+    if w.dtype == np.uint16:
+        import ml_dtypes
+
+        w = w.view(ml_dtypes.bfloat16)
+    wf = w.astype(np.float32)
+    *lead, din, dout = wf.shape
+    g = _q4_group(din)
+    wg = wf.reshape(*lead, din // g, g, dout)
+    amax = np.max(np.abs(wg), axis=-2)
+    s = np.maximum(amax, 1e-8) / 7.0
+    q = np.clip(np.round(wg / s[..., :, None, :]), -7, 7).astype(np.int8)
+    q = q.reshape(*lead, din, dout)
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    packed = ((lo & 0x0F) | (hi << 4)).astype(np.int8)
+    return packed, s.astype(np.float32)
+
+
 def load_hf_params(
-    cfg: LlamaConfig, model_dir: str, quantize: bool = False
+    cfg: LlamaConfig, model_dir: str, quantize=False
 ) -> Params:
     """Load HF-format safetensors from a local directory into the pytree.
 
     HF linear weights are stored ``[out, in]``; ours are ``[in, out]`` so the
     forward is a plain ``x @ w`` (no transposes at serve time). Layers are
-    stacked on axis 0 to match the scan layout. With ``quantize``, matmul
-    weights become int8 + ``*_qs`` scales, computed in numpy on the host —
-    the big leaves stay host-resident until the runner's sharded device_put.
+    stacked on axis 0 to match the scan layout. ``quantize``: False, or
+    "int8"/True (per-channel) or "int4" (group-wise per-layer matmuls,
+    embed/lm_head int8) — computed in numpy on the host so the big leaves
+    stay host-resident until the runner's sharded device_put.
     """
+    qmode = "int8" if quantize is True else quantize
     from safetensors import safe_open
 
     files = sorted(
@@ -1103,7 +1217,7 @@ def load_hf_params(
         return jnp.asarray(arr).astype(d)
 
     def put_top(name: str, arr: np.ndarray) -> None:
-        if quantize and name in QUANT_TOP_KEYS:
+        if qmode and name in QUANT_TOP_KEYS:
             q, s = _np_quantize(arr, axis=-1)
             params[name], params[name + QUANT_SUFFIX] = q, s
         else:
@@ -1167,10 +1281,15 @@ def load_hf_params(
 
     for name, stack in layer_acc.items():
         stacked = np.stack(stack, axis=0)
-        if quantize and name in QUANT_LAYER_KEYS:
-            q, s = _np_quantize(stacked, axis=-2)
-            params["layers"][name] = q
-            params["layers"][name + QUANT_SUFFIX] = s
+        if qmode and name in QUANT_LAYER_KEYS:
+            if qmode == "int4":
+                q, s = _np_quantize_int4(stacked)
+                params["layers"][name] = q
+                params["layers"][name + QUANT4_SUFFIX] = s
+            else:
+                q, s = _np_quantize(stacked, axis=-2)
+                params["layers"][name] = q
+                params["layers"][name + QUANT_SUFFIX] = s
         else:
             params["layers"][name] = cast(stacked)
     logger.info("loaded %d HF tensors from %s", len(raw) + 3, model_dir)
