@@ -239,6 +239,21 @@ class TieredAllocator(BlockAllocator):
                 return page
         return None
 
+    def acquire_resident(self, h: int) -> Optional[int]:
+        """HBM hit, else fault the page up from host DRAM / remote store."""
+        blk = self.acquire_cached(h)
+        if blk is not None:
+            return blk
+        page = self._fetch_lower_tier(h)
+        if page is None:
+            return None
+        try:
+            blk = self.allocate()
+        except NoFreeBlocksError:
+            return None
+        self.page_io.upload_page(blk, *page)
+        return self.commit(blk, h)
+
     def match_prefix(
         self, token_ids: Sequence[int], salt: int = 0
     ) -> Tuple[List[int], List[int]]:

@@ -123,6 +123,17 @@ class EngineConfig:
     max_loras: int = 8
     max_lora_rank: int = 16
     lora_dir: str = "/adapters"
+    # Live-sequence KV swap (engine/swap.py; vLLM --swap-space analogue).
+    # Preemption parks KV host-side instead of recomputing, and the
+    # scheduler timeslices more concurrent 20k-context users than HBM
+    # holds. Committed pages never move (content-addressed in place /
+    # existing tier); only uncommitted tail pages are stashed.
+    kv_swap: bool = True
+    # Rotate a running sequence out after this many decoded tokens when
+    # parked/queued work exists (0 = only swap under allocation pressure).
+    swap_quantum_tokens: int = 256
+    # Host-DRAM budget for stashed tail pages, in KV pages.
+    swap_stash_blocks: int = 4096
     # Disaggregated prefill role (reference: --kv-transfer-config
     # kv_producer/kv_consumer, `deployment-vllm-multi.yaml:180-189`).
     # producer: push each completed prefill's KV pages to the remote store

@@ -70,6 +70,14 @@ class LLMEngine:
             self.allocator = BlockAllocator(
                 self.runner.num_blocks, cfg.block_size, cfg.enable_prefix_caching
             )
+        if cfg.kv_swap:
+            from .swap import KVSwapper
+
+            self.swapper: Optional["KVSwapper"] = KVSwapper(
+                self.runner, max_stash_blocks=cfg.swap_stash_blocks
+            )
+        else:
+            self.swapper = None
         self.scheduler = Scheduler(
             SchedulerConfig(
                 max_num_seqs=cfg.max_num_seqs,
@@ -80,8 +88,10 @@ class LLMEngine:
                 # view, so its pages must already exist at dispatch time.
                 decode_lookahead=2 if cfg.async_decode else 1,
                 spec_tokens=0 if cfg.async_decode else cfg.speculative_ngram,
+                swap_quantum=cfg.swap_quantum_tokens,
             ),
             self.allocator,
+            swapper=self.swapper,
         )
         if cfg.async_decode and cfg.speculative_ngram:
             # Pipelined bursts win every decode step, so the spec branch
@@ -746,6 +756,7 @@ class LLMEngine:
         out = {
             "num_requests_running": float(self.scheduler.num_running),
             "num_requests_waiting": float(self.scheduler.num_waiting),
+            "num_requests_swapped": float(self.scheduler.num_swapped),
             "num_preemptions_total": float(self.num_preempted_total),
             "prompt_tokens_total": float(self.prompt_tokens_total),
             "generation_tokens_total": float(self.generation_tokens_total),
@@ -769,4 +780,14 @@ class LLMEngine:
         for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):
             if hasattr(self.allocator, attr):
                 out[f"kv_offload_{attr}"] = float(getattr(self.allocator, attr))
+        if self.swapper is not None:
+            out["kv_swap_out_total"] = float(self.swapper.swap_out_total)
+            out["kv_swap_in_total"] = float(self.swapper.swap_in_total)
+            out["kv_swap_tail_pages_total"] = float(
+                self.swapper.tail_pages_moved
+            )
+            out["kv_swap_fallback_recompute_total"] = float(
+                self.swapper.fallback_recompute_total
+            )
+            out["kv_swap_stash_blocks"] = float(self.swapper.stash_blocks)
         return out

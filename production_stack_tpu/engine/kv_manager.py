@@ -96,6 +96,14 @@ class BlockAllocator:
     def incref(self, blk: int) -> None:
         self._refcount[blk] += 1
 
+    def acquire_resident(self, h: int) -> Optional[int]:
+        """Reacquire the page holding hash ``h`` from wherever it survives.
+        Base allocator: HBM residency only; the tiered allocator overrides
+        this to also fault pages back up from host DRAM / the remote store.
+        Used by the swap path to resurrect a parked sequence's committed
+        prefix without copying bytes that never left."""
+        return self.acquire_cached(h)
+
     def commit(self, blk: int, h: int, allow_swap: bool = True) -> int:
         """Mark a freshly-written full page as content-addressed by ``h``.
 

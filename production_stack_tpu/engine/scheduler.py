@@ -39,6 +39,11 @@ class SchedulerConfig:
     # step writes KV at up to spec_tokens positions past the committed
     # length, so those pages must exist before dispatch.
     spec_tokens: int = 0
+    # Fair timeslicing when more live users than HBM holds (needs a
+    # swapper): after a running sequence has decoded this many tokens since
+    # its last (re)admission, it may rotate out in favor of a parked or
+    # waiting one. 0 = rotate only under allocation pressure.
+    swap_quantum: int = 0
 
 
 @dataclasses.dataclass
@@ -53,6 +58,9 @@ class SchedulerOutput:
     prefills: List[PrefillItem] = dataclasses.field(default_factory=list)
     decodes: List[Sequence] = dataclasses.field(default_factory=list)
     preempted: List[Sequence] = dataclasses.field(default_factory=list)
+    # Sequences parked via KV swap this pass (subset-disjoint from
+    # ``preempted``, which stays the recompute path).
+    swapped_out: List[Sequence] = dataclasses.field(default_factory=list)
     n_decode_steps: int = 1
     # A locked (in-flight-burst) sequence needed pages it could not get
     # without evicting another locked sequence: the engine must drain the
@@ -65,11 +73,27 @@ class SchedulerOutput:
 
 
 class Scheduler:
-    def __init__(self, config: SchedulerConfig, allocator: BlockAllocator):
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        allocator: BlockAllocator,
+        swapper=None,
+    ):
         self.config = config
         self.allocator = allocator
+        # Optional engine/swap.KVSwapper: preemption parks KV host-side and
+        # resumes without recompute; quantum rotation timeslices more live
+        # users than HBM holds.
+        self.swapper = swapper
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
+        self.swapped: Deque[Sequence] = deque()
+        # Monotonic admission stamp: ``waiting`` and ``swapped`` form ONE
+        # logical FIFO (else rotation would free pages for a waiting request
+        # only for the rotated-out sequence to reclaim them — livelock).
+        # Involuntary preemption/swap keeps the original stamp (front of
+        # line); voluntary rotation takes a fresh one (back of line).
+        self._stamp = 0
         self._n_decode_hint: Optional[int] = None
         # (request_id, num_free) of the last head-of-line admission failure:
         # until the free-page count changes there is no point re-running the
@@ -85,10 +109,43 @@ class Scheduler:
                 f"prompt of {seq.num_prompt_tokens} tokens exceeds "
                 f"max_model_len={self.config.max_model_len}"
             )
+        bs = self.allocator.block_size
+        if -(-(seq.num_prompt_tokens + 1) // bs) > self.allocator.num_blocks:
+            # Infeasible outright (prompt + its first decode token exceed
+            # the whole pool): full-prompt admission would queue it forever,
+            # and admitting it would self-preempt in a zero-progress loop.
+            # Fail loudly (HTTP 400) instead. (Auto-sized pools always hold
+            # a full max_model_len sequence plus one page —
+            # config.resolve_num_kv_blocks — so this fires only on
+            # explicitly undersized num_kv_blocks.)
+            raise ValueError(
+                f"prompt of {seq.num_prompt_tokens} tokens needs more KV "
+                f"pages than the engine has ({self.allocator.num_blocks})"
+            )
+        seq.queue_stamp = self._next_stamp()
         self.waiting.append(seq)
 
+    def _next_stamp(self) -> int:
+        self._stamp += 1
+        return self._stamp
+
+    @staticmethod
+    def _insert_by_stamp(dq: "Deque[Sequence]", seq: Sequence) -> None:
+        """Insert keeping the deque ascending by queue_stamp. Involuntary
+        preemption re-queues with the ORIGINAL stamp, and after rotate/
+        resume cycles the running list is no longer stamp-ordered — a plain
+        appendleft could put a newer victim in front of an older one,
+        breaking the one-logical-FIFO invariant _admit relies on."""
+        if not dq or dq[-1].queue_stamp <= seq.queue_stamp:
+            dq.append(seq)
+            return
+        for i, s in enumerate(dq):
+            if s.queue_stamp > seq.queue_stamp:
+                dq.insert(i, seq)
+                return
+
     def abort(self, request_id: str) -> Optional[Sequence]:
-        for q in (self.waiting, self.running):
+        for q in (self.waiting, self.running, self.swapped):
             for seq in list(q):
                 if seq.request_id == request_id:
                     q.remove(seq)
@@ -102,7 +159,7 @@ class Scheduler:
         For sequences referenced by an in-flight pipelined burst: the device
         is still writing through their block tables, so the pages must stay
         owned until the burst drains (the engine releases them then)."""
-        for q in (self.waiting, self.running):
+        for q in (self.waiting, self.running, self.swapped):
             for seq in list(q):
                 if seq.request_id == request_id:
                     q.remove(seq)
@@ -121,6 +178,8 @@ class Scheduler:
         seq.finish_reason = reason
         self.allocator.release_all(seq.block_ids)
         seq.block_ids = []
+        if self.swapper is not None:
+            self.swapper.drop(seq.request_id)
 
     @property
     def num_waiting(self) -> int:
@@ -130,8 +189,12 @@ class Scheduler:
     def num_running(self) -> int:
         return len(self.running)
 
+    @property
+    def num_swapped(self) -> int:
+        return len(self.swapped)
+
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.swapped)
 
     # -- the step ---------------------------------------------------------
 
@@ -152,6 +215,15 @@ class Scheduler:
         self._n_decode_hint = n_decode
         out = SchedulerOutput()
         self._admit(out)
+        # Fair timeslicing: if parked/queued work remains after admission,
+        # rotate out the running sequence with the most decode progress past
+        # the quantum — next pass admits the beneficiary into its pages.
+        if (
+            self.swapper is not None
+            and (self.swapped or self.waiting)
+            and len(self.running) > 1
+        ):
+            self._rotate(out)
 
         # Phase 1: sequences needing prompt (or post-preemption recompute)
         # work get chunks, oldest first, bounded by the step token budget.
@@ -207,9 +279,76 @@ class Scheduler:
 
     # -- internals --------------------------------------------------------
 
+    def _rotate(self, out: SchedulerOutput) -> None:
+        """Swap out at most ONE quantum-expired running sequence per pass
+        (bounds thrash; steady state rotates every ``swap_quantum`` tokens)."""
+        q = self.config.swap_quantum
+        if q <= 0:
+            return
+        locked = getattr(self, "_locked", frozenset())
+        best: Optional[Sequence] = None
+        for seq in self.running:
+            if seq.request_id in locked or seq.in_prefill:
+                continue
+            progress = seq.num_tokens - seq.resume_marker
+            if progress >= q and (
+                best is None
+                or progress > best.num_tokens - best.resume_marker
+            ):
+                best = seq
+        if best is not None and self.swapper.can_stash(best, self.allocator):
+            self.running.remove(best)
+            self.swapper.swap_out(best, self.allocator)
+            best.queue_stamp = self._next_stamp()  # back of the line
+            self.swapped.append(best)
+            out.swapped_out.append(best)
+            self._admit_blocked = None  # free pages changed
+
     def _admit(self, out: SchedulerOutput) -> None:
+        # ``swapped`` and ``waiting`` admit as one stamp-ordered FIFO.
+        # Swap-in is gated by a worst-case page check so a blocked resume
+        # does not churn fault-up I/O every pass; resume is nearly free
+        # when the parked pages never left HBM.
+        while self.swapped and len(self.running) < self.config.max_num_seqs:
+            seq = self.swapped[0]
+            if self.waiting and (
+                self.waiting[0].queue_stamp
+                < getattr(seq, "queue_stamp", 0)
+            ):
+                break  # an older waiting request admits first
+            # Headroom beyond the bare resume need: each running sequence
+            # may grow a page within a few steps, and a resume that leaves
+            # zero slack gets swapped right back out (I/O churn: resumed →
+            # victim → resumed, downloading its tail every pass). With
+            # NOTHING running the gate must not hold (a sequence that once
+            # filled the whole pool has worst-case need == pool size, and
+            # gating it forever would deadlock the engine) — attempt the
+            # resume; swap_in itself degrades safely if pages are short.
+            reserve = len(self.running) + 1
+            if self.running and (
+                self.swapper.blocks_needed(seq) + reserve
+                > self.allocator.num_free
+            ):
+                return  # no room for the line's head: nobody jumps it
+            self.swapped.popleft()
+            if not self.swapper.swap_in(seq, self.allocator):
+                self._insert_by_stamp(self.swapped, seq)
+                return
+            if seq.status == SequenceStatus.RUNNING:
+                seq.resume_marker = seq.num_tokens
+                self.running.append(seq)
+            else:
+                # Fallback: part of the committed chain was unrecoverable;
+                # the sequence recomputes from its longest surviving prefix.
+                self._insert_by_stamp(self.waiting, seq)
         while self.waiting and len(self.running) < self.config.max_num_seqs:
             seq = self.waiting[0]
+            if self.swapped and (
+                getattr(self.swapped[0], "queue_stamp", 0) < seq.queue_stamp
+            ):
+                # A parked sequence is older but could not resume (page
+                # gate above): hold the line rather than jump it.
+                break
             if self._admit_blocked == (
                 seq.request_id,
                 self.allocator.num_free,
@@ -230,12 +369,13 @@ class Scheduler:
                     seq.adopt_cached_prefix(blocks, hashes)
                     seq.num_computed_tokens = len(blocks) * self.allocator.block_size
                     seq.num_cached_prompt_tokens = seq.num_computed_tokens
-            first_chunk = min(
-                seq.num_prompt_tokens - seq.num_computed_tokens,
-                self.config.max_prefill_tokens,
-            )
+            # Admission requires pages for the FULL prompt (vLLM-style), not
+            # just the first chunk: chunk-level admission of a long prompt
+            # overcommits the pool, and its later chunks then preempt
+            # fully-prefilled sequences — which re-prefill and evict others
+            # in turn (prefill thrash at near-capacity).
             need = seq.blocks_needed(
-                seq.num_computed_tokens + first_chunk, self.allocator.block_size
+                seq.num_prompt_tokens, self.allocator.block_size
             )
             if need > self.allocator.num_free:
                 # Engine full; stays queued (vllm:num_requests_waiting). The
@@ -256,6 +396,7 @@ class Scheduler:
             self.waiting.popleft()
             self._admit_blocked = None
             seq.status = SequenceStatus.RUNNING
+            seq.resume_marker = seq.num_tokens
             self.running.append(seq)
 
     def _ensure_blocks(
@@ -296,14 +437,30 @@ class Scheduler:
         return None
 
     def _preempt(self, seq: Sequence, out: SchedulerOutput) -> None:
-        logger.warning("preempting request %s (out of KV pages)", seq.request_id)
         if seq in self.running:
             self.running.remove(seq)
         # The victim may already have been granted work this step — revoke it
         # (its pages are about to be surrendered).
         out.decodes[:] = [s for s in out.decodes if s is not seq]
         out.prefills[:] = [it for it in out.prefills if it.seq is not seq]
+        if (
+            self.swapper is not None
+            and not seq.in_prefill
+            and self.swapper.can_stash(seq, self.allocator)
+        ):
+            # Park KV instead of recompute: the committed prefix stays
+            # content-addressed in place; only the tail pages move host-side.
+            logger.info(
+                "swapping out request %s (out of KV pages)", seq.request_id
+            )
+            self.swapper.swap_out(seq, self.allocator)
+            # Involuntary: keeps its original (old) stamp, so the sorted
+            # insert lands it at/near the front of the resume line.
+            self._insert_by_stamp(self.swapped, seq)
+            out.swapped_out.append(seq)
+            return
+        logger.warning("preempting request %s (out of KV pages)", seq.request_id)
         self.allocator.release_all(seq.block_ids)
         seq.reset_for_recompute()
-        self.waiting.appendleft(seq)
+        self._insert_by_stamp(self.waiting, seq)
         out.preempted.append(seq)

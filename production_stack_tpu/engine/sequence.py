@@ -94,6 +94,7 @@ class SequenceStatus(Enum):
     WAITING = "waiting"
     RUNNING = "running"
     PREEMPTED = "preempted"
+    SWAPPED = "swapped"  # live KV parked host-side (engine/swap.py)
     FINISHED = "finished"
 
 
@@ -136,6 +137,11 @@ class Sequence:
         # Chunk-hash cursor (controller registration granularity).
         self._chunk_cursor = 0
         self._chunk_last_hash = 0
+        # Token count at admission / last swap-in: the scheduler's rotation
+        # quantum measures decode progress since this marker.
+        self.resume_marker = 0
+        # Admission-FIFO stamp across waiting+swapped (scheduler._admit).
+        self.queue_stamp = 0
 
     # -- lengths ----------------------------------------------------------
 

@@ -83,7 +83,12 @@ class EngineMetrics:
 
         self.running = gauge("vllm:num_requests_running", "running requests")
         self.waiting = gauge("vllm:num_requests_waiting", "waiting requests")
-        self.swapped = gauge("vllm:num_requests_swapped", "preempted requests")
+        self.swapped = gauge(
+            "vllm:num_requests_swapped", "sequences with KV parked host-side"
+        )
+        self.preemptions = counter(
+            "vllm:num_preemptions", "recompute preemptions"
+        )
         self.cache_usage = gauge(
             "vllm:gpu_cache_usage_perc", "KV page usage (HBM)"
         )
@@ -130,6 +135,23 @@ class EngineMetrics:
             "pst:adaptive_deep_bursts",
             "decode bursts executed at the adaptive deep depth",
         )
+        self.swap_out = counter(
+            "pst:kv_swap_out", "sequences swapped out (KV parked)"
+        )
+        self.swap_in = counter(
+            "pst:kv_swap_in", "sequences swapped back in (KV resumed)"
+        )
+        self.swap_tail_pages = counter(
+            "pst:kv_swap_tail_pages",
+            "uncommitted tail pages physically moved by swap",
+        )
+        self.swap_fallback = counter(
+            "pst:kv_swap_fallback_recompute",
+            "swap-ins that degraded to recompute (committed pages lost)",
+        )
+        self.swap_stash = gauge(
+            "pst:kv_swap_stash_blocks", "host-DRAM stash occupancy (pages)"
+        )
         self._counter_last: dict = {}
 
     def _counter_to(self, c, key: str, total: float) -> None:
@@ -149,7 +171,27 @@ class EngineMetrics:
     def refresh(self, stats: dict) -> None:
         self.running.set(stats["num_requests_running"])
         self.waiting.set(stats["num_requests_waiting"])
-        self.swapped.set(stats["num_preemptions_total"])
+        self.swapped.set(
+            stats.get("num_requests_swapped", stats["num_preemptions_total"])
+        )
+        self._counter_to(
+            self.preemptions, "preempt", stats["num_preemptions_total"]
+        )
+        self._counter_to(
+            self.swap_out, "swap_out", stats.get("kv_swap_out_total", 0)
+        )
+        self._counter_to(
+            self.swap_in, "swap_in", stats.get("kv_swap_in_total", 0)
+        )
+        self._counter_to(
+            self.swap_tail_pages, "swap_tail",
+            stats.get("kv_swap_tail_pages_total", 0),
+        )
+        self._counter_to(
+            self.swap_fallback, "swap_fallback",
+            stats.get("kv_swap_fallback_recompute_total", 0),
+        )
+        self.swap_stash.set(stats.get("kv_swap_stash_blocks", 0))
         self.cache_usage.set(stats["kv_cache_usage_perc"])
         self.hit_rate.set(stats["prefix_cache_hit_rate"])
         self.hits.set(stats["prefix_cache_hits_total"])
@@ -985,6 +1027,14 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ngram-max", type=int, default=3)
     p.add_argument("--ngram-lookback", type=int, default=8192,
                    help="cap prompt-lookup scan to last N tokens (0 = all)")
+    # Live-sequence KV swap (vLLM --swap-space analogue; engine/swap.py).
+    p.add_argument("--kv-swap", action="store_true", default=True)
+    p.add_argument("--no-kv-swap", dest="kv_swap", action="store_false")
+    p.add_argument("--swap-quantum-tokens", type=int, default=256,
+                   help="decode tokens before a running seq may rotate out "
+                        "for parked/queued work (0 = only under pressure)")
+    p.add_argument("--swap-stash-blocks", type=int, default=4096,
+                   help="host-DRAM budget for stashed tail pages (KV pages)")
     # KV tiering / controller (LMCache env-var analogues).
     p.add_argument("--cpu-offload-blocks", type=int, default=0)
     p.add_argument("--remote-kv-url", default=None)
@@ -1036,6 +1086,9 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         ngram_min=args.ngram_min,
         ngram_max=args.ngram_max,
         ngram_lookback=args.ngram_lookback,
+        kv_swap=args.kv_swap,
+        swap_quantum_tokens=args.swap_quantum_tokens,
+        swap_stash_blocks=args.swap_stash_blocks,
         cpu_offload_blocks=args.cpu_offload_blocks,
         remote_kv_url=args.remote_kv_url,
         cache_controller_url=args.cache_controller_url,

@@ -1,0 +1,197 @@
+"""Live-sequence KV swap: preempt by parking KV, not by recompute.
+
+Reference mechanism: vLLM's swap space (``--swap-space``, preemption mode
+``swap``) copies a preempted sequence's entire KV to CPU RAM and back; the
+reference stack leans on it (plus LMCache CPU offload,
+``helm/templates/deployment-vllm-multi.yaml:301-308``) to serve more
+concurrent users than accelerator memory holds.
+
+TPU-native redesign — almost nothing moves. The engine content-addresses
+every filled page (``Sequence.commit_full_blocks``), so when a sequence is
+parked:
+
+- its **committed pages stay where they are**: released to the allocator's
+  reusable set they keep their content and hash addressing, serve prefix
+  hits for other requests meanwhile, and — under HBM pressure — spill down
+  the existing HBM→host→remote tier (``cache_tiering.TieredAllocator``),
+  from which resume faults them back up;
+- only the **uncommitted tail** (at most one partial page, plus pages
+  reserved ahead of the write cursor) is physically downloaded into a
+  host-DRAM stash.
+
+Resume re-acquires the committed chain by hash (``acquire_resident`` —
+free for pages that never left HBM), uploads the stashed tail, and decode
+continues at the exact token it stopped at. If part of the chain is
+unrecoverable (evicted with no lower tier), the sequence falls back to the
+recompute path from the longest recovered prefix — strictly no worse than
+classic recompute preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..logging_utils import init_logger
+from .kv_manager import BlockAllocator, NoFreeBlocksError
+from .sequence import Sequence, SequenceStatus
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class _SwapRecord:
+    hashes: List[int]  # committed-prefix block hashes (in order)
+    # (K page, V page) per page past the committed chain, in sequence
+    # order — the tail is contiguous starting at len(hashes).
+    tail: List[Tuple[np.ndarray, np.ndarray]]
+    num_computed_tokens: int
+    num_blocks: int  # pages holding computed KV at swap-out
+
+
+class KVSwapper:
+    """Parks/resumes live sequences' KV. ``page_io`` is the runner adapter
+    (``download_page``/``upload_page`` — the device DMA endpoints)."""
+
+    def __init__(self, page_io, max_stash_blocks: int = 4096):
+        self.page_io = page_io
+        self.max_stash_blocks = max_stash_blocks
+        self._stash: Dict[str, _SwapRecord] = {}
+        self._stash_blocks = 0
+        # KPIs (engine.stats → /metrics).
+        self.swap_out_total = 0
+        self.swap_in_total = 0
+        self.tail_pages_moved = 0
+        self.fallback_recompute_total = 0
+
+    def __contains__(self, request_id: str) -> bool:
+        return request_id in self._stash
+
+    @property
+    def stash_blocks(self) -> int:
+        return self._stash_blocks
+
+    @staticmethod
+    def _tail_range(seq: Sequence, allocator: BlockAllocator) -> Tuple[int, int]:
+        """(committed, used) page bounds for a swap: pages in
+        [committed, used) must be physically stashed. Pages ≥ ``used`` are
+        lookahead reserve holding no computed KV — resume re-reserves them
+        instead of moving garbage. With prefix caching off nothing is
+        hash-recoverable, so everything up to ``used`` is tail."""
+        bs = allocator.block_size
+        used = -(-seq.num_computed_tokens // bs)
+        committed = (
+            min(seq._committed_blocks, used)
+            if allocator.enable_prefix_caching
+            else 0
+        )
+        return committed, used
+
+    def can_stash(self, seq: Sequence, allocator: BlockAllocator) -> bool:
+        committed, used = self._tail_range(seq, allocator)
+        return self._stash_blocks + (used - committed) <= self.max_stash_blocks
+
+    def swap_out(self, seq: Sequence, allocator: BlockAllocator) -> None:
+        """Download the uncommitted tail, release all pages, park the
+        sequence. The committed prefix needs no copying — content-addressed
+        pages survive release (reusable set / lower tiers)."""
+        committed, used = self._tail_range(seq, allocator)
+        tail: List[Tuple[np.ndarray, np.ndarray]] = []
+        for i in range(committed, used):
+            tail.append(self.page_io.download_page(seq.block_ids[i]))
+        self._stash[seq.request_id] = _SwapRecord(
+            hashes=list(seq.block_hashes[:committed]),
+            tail=tail,
+            num_computed_tokens=seq.num_computed_tokens,
+            num_blocks=used,
+        )
+        self._stash_blocks += len(tail)
+        allocator.release_all(seq.block_ids)
+        seq.block_ids = []
+        seq.status = SequenceStatus.SWAPPED
+        self.swap_out_total += 1
+        self.tail_pages_moved += len(tail)
+        logger.debug(
+            "swapped out %s: %d committed pages stay addressed, %d tail "
+            "pages stashed", seq.request_id, committed, len(tail),
+        )
+
+    def swap_in(self, seq: Sequence, allocator: BlockAllocator) -> bool:
+        """Resurrect a parked sequence. True → seq is RUNNING-ready with its
+        full KV resident and ``num_computed_tokens`` restored. False → could
+        not (no free pages): caller keeps it parked and retries later.
+
+        An unrecoverable committed page (evicted, no lower tier) downgrades
+        to recompute-from-longest-prefix: the stash is dropped, the sequence
+        re-enters the classic preempted flow — correctness is unaffected.
+        In that case the sequence is left WAITING with the recovered prefix
+        adopted and True is returned (it is schedulable)."""
+        rec = self._stash.get(seq.request_id)
+        assert rec is not None, f"no swap record for {seq.request_id}"
+        acquired: List[int] = []
+        for h in rec.hashes:
+            blk = allocator.acquire_resident(h)
+            if blk is None:
+                break
+            acquired.append(blk)
+        if len(acquired) < len(rec.hashes):
+            # Part of the chain is gone. Keep what survives as an adopted
+            # prefix and recompute the rest (chunked-prefill path).
+            self._drop_record(seq.request_id, rec)
+            self.fallback_recompute_total += 1
+            seq.reset_for_recompute()
+            if acquired:
+                seq.adopt_cached_prefix(
+                    acquired, rec.hashes[: len(acquired)]
+                )
+                seq.num_computed_tokens = (
+                    len(acquired) * allocator.block_size
+                )
+            seq.status = SequenceStatus.WAITING
+            logger.warning(
+                "swap-in of %s lost %d/%d committed pages; recomputing "
+                "from token %d", seq.request_id,
+                len(rec.hashes) - len(acquired), len(rec.hashes),
+                seq.num_computed_tokens,
+            )
+            return True
+        # Allocate + upload the stashed tail.
+        fresh: List[int] = []
+        try:
+            for _ in rec.tail:
+                fresh.append(allocator.allocate())
+        except NoFreeBlocksError:
+            for blk in fresh:
+                allocator.release(blk)
+            for blk in acquired:
+                allocator.release(blk)
+            return False
+        for (k, v), blk in zip(rec.tail, fresh):
+            self.page_io.upload_page(blk, k, v)
+        seq.block_ids = acquired + fresh
+        seq.block_hashes = list(rec.hashes)
+        seq._committed_blocks = len(rec.hashes)
+        seq._last_hash = rec.hashes[-1] if rec.hashes else seq.cache_salt
+        seq.num_computed_tokens = rec.num_computed_tokens
+        seq.status = SequenceStatus.RUNNING
+        self._drop_record(seq.request_id, rec)
+        self.swap_in_total += 1
+        return True
+
+    def blocks_needed(self, seq: Sequence) -> int:
+        """Worst-case fresh pages a swap-in may allocate (committed pages
+        that fault up from a lower tier + the stashed tail)."""
+        rec = self._stash.get(seq.request_id)
+        return rec.num_blocks if rec is not None else 0
+
+    def drop(self, request_id: str) -> None:
+        """Forget a parked sequence's stash (abort/finish)."""
+        rec = self._stash.pop(request_id, None)
+        if rec is not None:
+            self._stash_blocks -= len(rec.tail)
+
+    def _drop_record(self, request_id: str, rec: _SwapRecord) -> None:
+        self._stash.pop(request_id, None)
+        self._stash_blocks -= len(rec.tail)

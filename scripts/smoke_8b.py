@@ -1,4 +1,7 @@
-"""Smoke: llama-3-8b int8 on one real chip — startup, prefill, decode probe."""
+"""Smoke: llama-3-8b quantized on one real chip — startup, prefill, decode.
+
+Usage: smoke_8b.py [n_users] [history_tokens] [quant]   (quant: int8|int4)
+"""
 import sys
 import time
 
@@ -12,14 +15,15 @@ def main() -> None:
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.sequence import SamplingParams
 
-    print("backend:", jax.default_backend(), flush=True)
+    quant = sys.argv[3] if len(sys.argv) > 3 else "int8"
+    print("backend:", jax.default_backend(), "quant:", quant, flush=True)
     t0 = time.time()
     cfg = EngineConfig(
         model="llama-3-8b",
-        quantization="int8",
+        quantization=quant,
         max_model_len=32768,
         block_size=128,
-        max_num_seqs=8,
+        max_num_seqs=16,
         max_prefill_tokens=1024,
         attn_impl="pallas",
         kv_cache_dtype="float8_e4m3fn",

@@ -1,0 +1,227 @@
+"""Live-sequence KV swap (engine/swap.py): park instead of recompute.
+
+Parity target: vLLM's swap-space preemption + LMCache CPU offload let the
+reference serve more concurrent users than accelerator memory holds
+(`helm/templates/deployment-vllm-multi.yaml:301-308`). Here the TPU-native
+version keeps committed pages content-addressed in place and stashes only
+uncommitted tail pages host-side.
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.kv_manager import BlockAllocator
+from production_stack_tpu.engine.sequence import (
+    SamplingParams,
+    Sequence,
+    SequenceStatus,
+)
+from production_stack_tpu.engine.swap import KVSwapper
+
+pytestmark = pytest.mark.fast
+
+
+class FakePageIO:
+    """In-memory page store standing in for the runner's device DMA."""
+
+    def __init__(self, num_blocks=64, shape=(2, 8, 2, 4)):
+        self.pages = np.zeros((num_blocks, 2) + shape, np.float32)
+
+    def download_page(self, blk):
+        return self.pages[blk, 0].copy(), self.pages[blk, 1].copy()
+
+    def upload_page(self, blk, k, v):
+        self.pages[blk, 0], self.pages[blk, 1] = k, v
+
+
+def _seq(rid, n_prompt=20, n_out=0, bs=8):
+    s = Sequence(rid, list(range(1, n_prompt + 1)), SamplingParams())
+    s.output_token_ids = list(range(100, 100 + n_out))
+    return s
+
+
+def test_swap_out_stashes_only_tail():
+    io = FakePageIO()
+    alloc = BlockAllocator(num_blocks=16, block_size=8)
+    sw = KVSwapper(io)
+    seq = _seq("a", n_prompt=20)  # 20 tokens -> 2 full pages + 1 tail
+    seq.block_ids = [alloc.allocate() for _ in range(3)]
+    for blk in seq.block_ids:
+        io.pages[blk] = np.random.default_rng(blk).random(io.pages[blk].shape)
+    seq.num_computed_tokens = 20
+    seq.commit_full_blocks(alloc)  # 2 committed
+    assert seq._committed_blocks == 2
+    tail_blk = seq.block_ids[2]
+    tail_before = io.pages[tail_blk].copy()
+
+    free_before = alloc.num_free
+    sw.swap_out(seq, alloc)
+    assert seq.status == SequenceStatus.SWAPPED
+    assert seq.block_ids == []
+    assert sw.stash_blocks == 1  # only the tail moved
+    assert alloc.num_free == free_before + 3
+
+    # Resume: committed pages reacquired by hash (no copy), tail uploaded.
+    ok = sw.swap_in(seq, alloc)
+    assert ok and seq.status == SequenceStatus.RUNNING
+    assert seq.num_computed_tokens == 20
+    assert len(seq.block_ids) == 3
+    np.testing.assert_array_equal(io.pages[seq.block_ids[2]], tail_before)
+    assert sw.swap_in_total == 1 and sw.swap_out_total == 1
+
+
+def test_swap_in_fallback_when_pages_lost():
+    """Committed pages evicted with no lower tier -> recompute from the
+    longest surviving prefix, never a wrong answer."""
+    io = FakePageIO()
+    alloc = BlockAllocator(num_blocks=8, block_size=8)
+    sw = KVSwapper(io)
+    seq = _seq("a", n_prompt=20)
+    seq.block_ids = [alloc.allocate() for _ in range(3)]
+    seq.num_computed_tokens = 20
+    seq.commit_full_blocks(alloc)
+    sw.swap_out(seq, alloc)
+
+    # Evict everything: churn the pool through fresh allocations.
+    held = []
+    for _ in range(8):
+        held.append(alloc.allocate())
+    for b in held:
+        alloc.release(b)
+
+    ok = sw.swap_in(seq, alloc)
+    assert ok  # schedulable — but via recompute
+    assert seq.status == SequenceStatus.WAITING
+    assert seq.num_computed_tokens == 0
+    assert sw.fallback_recompute_total == 1
+    assert "a" not in sw  # stash dropped
+
+
+def test_swap_in_blocked_returns_false_and_restores():
+    io = FakePageIO()
+    alloc = BlockAllocator(num_blocks=4, block_size=8, enable_prefix_caching=False)
+    sw = KVSwapper(io)
+    seq = _seq("a", n_prompt=20)
+    seq.block_ids = [alloc.allocate() for _ in range(3)]
+    seq.num_computed_tokens = 20
+    seq.commit_full_blocks(alloc)  # no-op (prefix caching off): all tail
+    sw.swap_out(seq, alloc)
+    assert sw.stash_blocks == 3
+    hog = [alloc.allocate() for _ in range(3)]  # leave 1 free < 3 needed
+    assert sw.swap_in(seq, alloc) is False
+    assert seq.status == SequenceStatus.SWAPPED
+    # Nothing leaked: the one free page is still free.
+    assert alloc.num_free == 1
+    for b in hog:
+        alloc.release(b)
+    assert sw.swap_in(seq, alloc) is True
+
+
+def _engine(num_blocks, **kw):
+    cfg = dict(
+        model="tiny-llama-debug",
+        max_model_len=256,
+        block_size=8,
+        num_kv_blocks=num_blocks,
+        max_num_seqs=8,
+        max_prefill_tokens=64,
+        attn_impl="gather",
+    )
+    cfg.update(kw)
+    return LLMEngine(EngineConfig(**cfg))
+
+
+def test_swap_preemption_preserves_greedy_outputs():
+    """A pool too small for all sequences forces swapping; greedy outputs
+    must equal the big-pool run token for token."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 500, size=40).tolist() for _ in range(4)]
+    sp = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+
+    big = _engine(128)
+    ref = big.generate(prompts, sp)
+
+    small = _engine(24, swap_quantum_tokens=0)
+    out = small.generate(prompts, sp)
+    assert small.swapper.swap_out_total > 0, "swap path never engaged"
+    for r, o in zip(ref, out):
+        assert r["token_ids"] == o["token_ids"]
+
+
+def test_rotation_makes_all_users_progress():
+    """More users than the pool holds: quantum rotation timeslices them all
+    to completion (and the outputs still match the big-pool run)."""
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(1, 500, size=40).tolist() for _ in range(6)]
+    sp = SamplingParams(max_tokens=32, temperature=0.0, ignore_eos=True)
+
+    ref = _engine(256).generate(prompts, sp)
+
+    eng = _engine(40, swap_quantum_tokens=8)
+    out = eng.generate(prompts, sp)
+    assert eng.swapper.swap_out_total >= 2, "rotation never engaged"
+    for r, o in zip(ref, out):
+        assert r["token_ids"] == o["token_ids"]
+    # The stash never leaks records past completion.
+    assert eng.swapper.stash_blocks == 0
+
+
+def test_swap_disabled_falls_back_to_recompute():
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, 500, size=40).tolist() for _ in range(4)]
+    sp = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    eng = _engine(24, kv_swap=False)
+    ref = _engine(128, kv_swap=False).generate(prompts, sp)
+    out = eng.generate(prompts, sp)
+    assert eng.swapper is None
+    assert eng.num_preempted_total > 0
+    for r, o in zip(ref, out):
+        assert r["token_ids"] == o["token_ids"]
+
+
+def test_abort_swapped_sequence_drops_stash():
+    eng = _engine(24, swap_quantum_tokens=0)
+    rng = np.random.default_rng(10)
+    prompts = [rng.integers(1, 500, size=40).tolist() for _ in range(4)]
+    sp = SamplingParams(max_tokens=64, temperature=0.0, ignore_eos=True)
+    for i, p in enumerate(prompts):
+        eng.add_request(f"r{i}", prompt_token_ids=p, sampling=sp)
+    # Step until something is parked, then abort it.
+    for _ in range(200):
+        eng.step()
+        if eng.scheduler.num_swapped:
+            break
+    assert eng.scheduler.num_swapped > 0
+    rid = eng.scheduler.swapped[0].request_id
+    assert eng.abort_request(rid)
+    assert rid not in eng.swapper
+    # Remaining requests still finish.
+    while eng.has_work():
+        eng.step()
+    assert eng.swapper.stash_blocks == 0
+
+
+def test_swap_with_tiering_resumes_without_recompute():
+    """The production pairing: swap + host-DRAM tier. Committed pages
+    evicted from HBM spill to the host pool and fault back up at resume,
+    so swap-ins succeed (no recompute fallback) and metrics export."""
+    eng = _engine(24, swap_quantum_tokens=8, cpu_offload_blocks=128)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 500, size=40).tolist() for _ in range(5)]
+    ref = _engine(256).generate(
+        prompts, SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    )
+    out = eng.generate(
+        prompts, SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    )
+    for r, o in zip(ref, out):
+        assert r["token_ids"] == o["token_ids"]
+    stats = eng.stats()
+    assert stats["kv_swap_out_total"] >= 1
+    assert stats["kv_swap_in_total"] >= 1, (
+        "with a host tier, resumes must not fall back to recompute"
+    )
+    assert "kv_swap_tail_pages_total" in stats
+    assert stats["num_requests_swapped"] == 0.0  # all drained

@@ -4,6 +4,8 @@ Ring-1 strategy (SURVEY.md §4): stub-free unit tests over the admission and
 preemption state machine alone.
 """
 
+import pytest
+
 from production_stack_tpu.engine.kv_manager import BlockAllocator
 from production_stack_tpu.engine.scheduler import Scheduler, SchedulerConfig
 from production_stack_tpu.engine.sequence import (
@@ -24,7 +26,7 @@ def test_admission_releases_pinned_prefix_on_capacity_shortfall():
     """A waiting seq whose prefix-cache hit pins pages must surrender them
     when the capacity check fails — otherwise admission can deadlock with
     nothing running and most pages pinned by un-admittable waiters."""
-    sched, alloc = _sched(num_blocks=8, bs=4)
+    sched, alloc = _sched(num_blocks=9, bs=4)
 
     # Request A computes 24 prompt tokens (6 pages) and finishes, leaving
     # those pages cached (refcount 0, reusable).
@@ -35,48 +37,63 @@ def test_admission_releases_pinned_prefix_on_capacity_shortfall():
     a.num_computed_tokens = out.prefills[0].end
     a.commit_full_blocks(alloc)
     sched.finish(a, "stop")
-    assert alloc.num_free == 8
+    assert alloc.num_free == 9
 
-    # Request B shares A's 24-token prefix but needs 10 pages total — the
-    # prefix match pins 6, the remaining need (4) exceeds the 2 untouched
-    # pages, so B cannot be admitted this round.
-    b = Sequence("b", list(range(1, 25)) + list(range(100, 116)),
+    # Hog C takes the 2 untouched pages and stays running.
+    c = Sequence("c", list(range(200, 208)), SamplingParams(max_tokens=64))
+    sched.add(c)
+    out = sched.schedule()
+    assert out.prefills and out.prefills[0].seq is c
+    c.num_computed_tokens = out.prefills[0].end
+
+    # Request B shares A's 24-token prefix and needs 8 pages total — the
+    # prefix match pins 6 reusable pages, but the 2 fresh pages it still
+    # needs are held by C, so B cannot be admitted this round.
+    b = Sequence("b", list(range(1, 25)) + list(range(100, 108)),
                  SamplingParams(max_tokens=1))
     sched.add(b)
-    out = sched.schedule()
-    assert not out.prefills and b.status == SequenceStatus.WAITING
+    sched.schedule()
+    assert b.status == SequenceStatus.WAITING
     # The regression: B must not keep the 6 matched pages pinned while
     # waiting — every page must be back in the reusable pool, and repeated
     # scheduling attempts must not leak pins either.
     assert b.block_ids == []
-    assert alloc.num_free == 8
+    assert alloc.num_free == 7
     for _ in range(3):
         sched.schedule()
-        assert b.block_ids == [] and alloc.num_free == 8
+        assert b.block_ids == [] and alloc.num_free == 7
 
 
-def test_admission_rematches_prefix_once_space_frees():
-    sched, alloc = _sched(num_blocks=8, bs=4)
+def test_admission_matches_prefix_with_sharing():
+    """Full-prompt admission accounts for shared pages: a request whose
+    prefix pages are already resident admits into the remainder only."""
+    sched, alloc = _sched(num_blocks=9, bs=4)
     a = Sequence("a", list(range(1, 25)), SamplingParams(max_tokens=1))
     sched.add(a)
     out = sched.schedule()
     a.num_computed_tokens = out.prefills[0].end
     a.commit_full_blocks(alloc)
-    sched.finish(a, "stop")
 
-    b = Sequence("b", list(range(1, 25)) + list(range(100, 116)),
+    # B needs 8 pages total, but 6 are A's live committed pages (shared via
+    # the prefix match) — only 2 fresh pages are required, which is exactly
+    # what remains. Admits immediately, prefix hit established.
+    b = Sequence("b", list(range(1, 25)) + list(range(100, 108)),
                  SamplingParams(max_tokens=1))
     sched.add(b)
-    sched.schedule()  # rejected: needs 10 pages, only 8 exist... with chunking
-    # With a smaller first chunk the same request fits: shrink the budget so
-    # the first chunk needs fewer new pages than are free.
-    sched.config = SchedulerConfig(
-        max_num_seqs=4, max_prefill_tokens=8, max_model_len=256
-    )
     out = sched.schedule()
     assert any(item.seq is b for item in out.prefills)
-    # Prefix hit was re-established on the second attempt.
     assert b.num_cached_prompt_tokens == 24
+    assert alloc.num_free == 1  # 6 shared + 2 fresh of the 9-page pool
+
+
+def test_infeasible_prompt_rejected_at_add():
+    """Full-prompt admission makes an oversized prompt permanently
+    unschedulable — it must 400 at add(), not queue forever."""
+    sched, alloc = _sched(num_blocks=8, bs=4)
+    with pytest.raises(ValueError, match="KV pages"):
+        sched.add(
+            Sequence("big", list(range(1, 41)), SamplingParams(max_tokens=1))
+        )
 
 
 def test_decode_depth_hint_overrides_and_clamps():
