@@ -136,6 +136,9 @@ class AsyncLLMEngine:
                 item = await queue.get()
                 if item is _SENTINEL:
                     break
+                if isinstance(item, Exception):
+                    finished = True  # never admitted: nothing to reclaim
+                    raise item
                 yield item
                 if item.finished:
                     finished = True
@@ -171,13 +174,22 @@ class AsyncLLMEngine:
                         self.engine.add_request(rid, **kwargs)
                     except Exception as e:  # noqa: BLE001 — per-request error
                         logger.warning("add_request %s failed: %s", rid, e)
-                        self._sentinel_one(rid)
+                        # Surface the error to the waiting client (HTTP 400
+                        # for ValueError) instead of an empty 200 stream.
+                        self._error_one(rid, e)
 
     def _sentinel_one(self, rid: str) -> None:
         if self._loop is None:
             return
         self._loop.call_soon_threadsafe(
             lambda: self._queues.get(rid) and self._queues[rid].put_nowait(_SENTINEL)
+        )
+
+    def _error_one(self, rid: str, exc: Exception) -> None:
+        if self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(
+            lambda: self._queues.get(rid) and self._queues[rid].put_nowait(exc)
         )
 
     def _run(self) -> None:

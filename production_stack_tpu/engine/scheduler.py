@@ -58,9 +58,6 @@ class SchedulerOutput:
     prefills: List[PrefillItem] = dataclasses.field(default_factory=list)
     decodes: List[Sequence] = dataclasses.field(default_factory=list)
     preempted: List[Sequence] = dataclasses.field(default_factory=list)
-    # Sequences parked via KV swap this pass (subset-disjoint from
-    # ``preempted``, which stays the recompute path).
-    swapped_out: List[Sequence] = dataclasses.field(default_factory=list)
     n_decode_steps: int = 1
     # A locked (in-flight-burst) sequence needed pages it could not get
     # without evicting another locked sequence: the engine must drain the
@@ -301,14 +298,24 @@ class Scheduler:
             self.swapper.swap_out(best, self.allocator)
             best.queue_stamp = self._next_stamp()  # back of the line
             self.swapped.append(best)
-            out.swapped_out.append(best)
             self._admit_blocked = None  # free pages changed
+
+    def _promised_pages(self) -> int:
+        """Pages already-admitted sequences will still allocate to finish
+        their prompts. Admission allocates nothing itself, so gating each
+        candidate against raw ``num_free`` would admit several long prompts
+        into the same pages — re-creating prefill thrash one level up."""
+        bs = self.allocator.block_size
+        return sum(
+            s.blocks_needed(s.num_prompt_tokens, bs) for s in self.running
+        )
 
     def _admit(self, out: SchedulerOutput) -> None:
         # ``swapped`` and ``waiting`` admit as one stamp-ordered FIFO.
         # Swap-in is gated by a worst-case page check so a blocked resume
         # does not churn fault-up I/O every pass; resume is nearly free
         # when the parked pages never left HBM.
+        promised = self._promised_pages()
         while self.swapped and len(self.running) < self.config.max_num_seqs:
             seq = self.swapped[0]
             if self.waiting and (
@@ -326,7 +333,7 @@ class Scheduler:
             # resume; swap_in itself degrades safely if pages are short.
             reserve = len(self.running) + 1
             if self.running and (
-                self.swapper.blocks_needed(seq) + reserve
+                self.swapper.blocks_needed(seq) + reserve + promised
                 > self.allocator.num_free
             ):
                 return  # no room for the line's head: nobody jumps it
@@ -377,7 +384,7 @@ class Scheduler:
             need = seq.blocks_needed(
                 seq.num_prompt_tokens, self.allocator.block_size
             )
-            if need > self.allocator.num_free:
+            if need + promised > self.allocator.num_free:
                 # Engine full; stays queued (vllm:num_requests_waiting). The
                 # prefix blocks adopted above must be released: they are
                 # refcounted and nothing in the preemption path reclaims
@@ -398,6 +405,7 @@ class Scheduler:
             seq.status = SequenceStatus.RUNNING
             seq.resume_marker = seq.num_tokens
             self.running.append(seq)
+            promised += need  # this admission's unprefilled pages
 
     def _ensure_blocks(
         self,
@@ -457,7 +465,6 @@ class Scheduler:
             # Involuntary: keeps its original (old) stamp, so the sorted
             # insert lands it at/near the front of the resume line.
             self._insert_by_stamp(self.swapped, seq)
-            out.swapped_out.append(seq)
             return
         logger.warning("preempting request %s (out of KV pages)", seq.request_id)
         self.allocator.release_all(seq.block_ids)

@@ -510,6 +510,14 @@ def create_engine_app(
             return _error(
                 f"prompt has {len(ids)} tokens, exceeds max_model_len={max_len}"
             )
+        alloc = engine.engine.allocator
+        if -(-(len(ids) + 1) // alloc.block_size) > alloc.num_blocks:
+            # Mirrors Scheduler.add's feasibility guard at the HTTP layer so
+            # the client sees a 400, not an engine-thread error.
+            return _error(
+                f"prompt of {len(ids)} tokens needs more KV pages than the "
+                f"engine has ({alloc.num_blocks})"
+            )
         try:
             sampling = build_sampling(req, max_len, len(ids), tok)
         except ValueError as e:

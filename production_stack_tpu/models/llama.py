@@ -166,14 +166,39 @@ def _wmat(p: Params, name: str, dtype) -> Tuple[jax.Array, Optional[jax.Array]]:
 
     Returns (operand in compute dtype, post-matmul scale or None): int4
     leaves dequantize pre-matmul (group scales vary along the contraction
-    dim, so no post-scale exists) — the unpack+scale fuses into the dot's
-    operand read; int8 leaves convert on the fly and hand back their
-    per-output-channel scale for the caller to apply post-matmul (exact)."""
+    dim, so no post-scale exists); int8 leaves convert on the fly — a bare
+    convert XLA fuses into the dot's HBM read — and hand back their
+    per-output-channel scale for the caller to apply post-matmul (exact).
+
+    NOTE: the XLA int4 dequant does NOT fuse (the unpack's stack/reshape
+    defeats operand fusion, materializing the bf16 weights per layer) —
+    serving-shape int4 matmuls go through :func:`_qdot`'s Pallas kernel
+    instead; this path remains for tiny/odd shapes and the MoE bank."""
     w = p[name]
     q4s = p.get(name + QUANT4_SUFFIX)
     if q4s is not None:
         return dequant_int4(w, q4s, dtype), None
     return _wcast(w, dtype), p.get(name + QUANT_SUFFIX)
+
+
+def _qdot(
+    x: jax.Array, p: Params, name: str
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """``x [..., din] @ weight`` under any quantization mode. Returns
+    (fp32 output, post-matmul scale or None). int4 weights at serving
+    shapes stream through the Pallas kernel (0.5 byte/weight from HBM);
+    everything else is a plain einsum over :func:`_wmat`'s operand."""
+    q4s = p.get(name + QUANT4_SUFFIX)
+    if q4s is not None:
+        from ..ops.int4_matmul import use_int4_kernel, int4_matmul
+
+        if use_int4_kernel(p[name], q4s):
+            lead = x.shape[:-1]
+            y = int4_matmul(x.reshape(-1, x.shape[-1]), p[name], q4s)
+            return y.reshape(*lead, y.shape[-1]), None
+    w, s = _wmat(p, name, x.dtype)
+    out = jnp.einsum("...d,do->...o", x, w, preferred_element_type=jnp.float32)
+    return out, s
 
 
 def init_leaf(name: str, shape, dtype, key: jax.Array) -> jax.Array:
@@ -662,11 +687,7 @@ class Llama:
                 softcap=cfg.attn_logit_softcap,
             )
             attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
-            wo, wo_s = _wmat(lp, "wo", x.dtype)
-            o = jnp.einsum(
-                "btq,qd->btd", attn, wo,
-                preferred_element_type=jnp.float32,
-            )
+            o, wo_s = _qdot(attn, lp, "wo")
             if wo_s is not None:
                 o = o * wo_s
             if has_lora:
@@ -838,11 +859,7 @@ class Llama:
                     "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
                     preferred_element_type=jnp.float32,
                 ).reshape(B, T, cfg.q_size).astype(x.dtype)
-            wo, wo_s = _wmat(lp, "wo", x.dtype)
-            o = jnp.einsum(
-                "btq,qd->btd", attn, wo,
-                preferred_element_type=jnp.float32,
-            )
+            o, wo_s = _qdot(attn, lp, "wo")
             if wo_s is not None:
                 o = o * wo_s
             o = o.astype(x.dtype)
@@ -951,11 +968,7 @@ def _mlp(cfg: "LlamaConfig", lp: Params, h: jax.Array, moe_impl: str = "auto") -
         ff = (
             act(gate.astype(jnp.float32)) * up.astype(jnp.float32)
         ).astype(h.dtype)
-        wd, wd_s = _wmat(lp, "w_down", h.dtype)
-        out = jnp.einsum(
-            "btf,fd->btd", ff, wd,
-            preferred_element_type=jnp.float32,
-        )
+        out, wd_s = _qdot(ff, lp, "w_down")
         if wd_s is not None:
             out = out * wd_s
         return out
@@ -1055,10 +1068,7 @@ def _proj(
     name: str,
     b: Optional[jax.Array] = None,
 ) -> jax.Array:
-    w, s = _wmat(p, name, x.dtype)
-    out = jnp.einsum(
-        "btd,do->bto", x, w, preferred_element_type=jnp.float32
-    )
+    out, s = _qdot(x, p, name)
     if s is not None:  # int8 per-output-channel scale
         out = out * s
     if b is not None:

@@ -141,8 +141,12 @@ def gather_paged_attention(
     scores = jnp.where(mask, scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
+    # 1-byte (fp8/int8) caches: the PV dot runs in the query dtype —
+    # casting probs to the cache dtype would quantize the softmax weights
+    # themselves (model-level numerics oracle regression).
+    dt = q.dtype if jnp.dtype(v.dtype).itemsize == 1 else v.dtype
     out = jnp.einsum(
-        "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
+        "bkgts,bskd->btkgd", probs.astype(dt), v.astype(dt),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, T, H, hd).astype(q.dtype)

@@ -59,6 +59,37 @@ from .attention import window_eff
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _pv_dot(p, v):
+    """probs @ V with fp32 accumulation, correct for quantized caches.
+
+    With an fp8 cache, casting probs to e4m3 for the dot quantizes the
+    softmax weights themselves to ~2 significant digits (caught by the
+    model-level numerics oracle) — but converting the STREAMED V chunks up
+    to bf16 costs a per-chunk relayout that measured 6x slower end to end.
+    Instead: split-precision in fp8. The main dot uses e4m3-rounded probs;
+    a second dot carries the 16x-scaled rounding residual (≤ p/16, so the
+    scale re-centers it in e4m3's mantissa range). Effective probs
+    precision ~2^-8 — bf16-equivalent — while V never leaves its 1-byte
+    layout and the PV MXU cost (a small slice of a DMA-bound kernel)
+    merely doubles."""
+    if jnp.dtype(v.dtype).itemsize != 1:
+        return jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    p8 = p.astype(v.dtype)
+    resid = ((p - p8.astype(jnp.float32)) * 16.0).astype(v.dtype)
+    main = jax.lax.dot_general(
+        p8, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    fix = jax.lax.dot_general(
+        resid, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return main + fix * 0.0625
+
+
 def _interpret() -> bool:
     return bool(os.environ.get("PST_FORCE_PALLAS_INTERPRET"))
 
@@ -175,10 +206,7 @@ def _chunked_flash(
                 p, axis=-1, keepdims=True
             )
             m_ref[h, :, :1] = m_new
-            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
-                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+            acc_ref[h] = acc_ref[h] * alpha + _pv_dot(p, vh)
 
     _page_dma_loop(
         b=b, layer=layer, n_chunks=n_chunks, tables_ref=tables_ref,
@@ -257,10 +285,7 @@ def _decode_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:, :1] = m_new
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).reshape(H, KH, hd)
+        pv = _pv_dot(p, v).reshape(H, KH, hd)
         own = (pv * blockdiag).sum(axis=1)  # each row's own head block
         acc_ref[...] = acc_ref[...] * alpha + own
 

@@ -67,26 +67,32 @@ def main() -> None:
     print(f"warm prefill: {hist} tokens in {dt:.1f}s ({hist/dt:.0f} tok/s)",
           flush=True)
 
-    # Decode probe: n_users concurrent at full context.
+    # Decode probe: n_users concurrent at full context. Timed window opens
+    # only once EVERY user is past prefill (otherwise the other users'
+    # prefill chunks pollute the decode rate).
     prompts = [rng.integers(1, V - 1, size=hist).tolist() for _ in range(n_users)]
     for i, p in enumerate(prompts):
         engine.add_request(f"dec-{i}", prompt_token_ids=p,
-                           sampling=SamplingParams(max_tokens=64, temperature=0.0,
+                           sampling=SamplingParams(max_tokens=96, temperature=0.0,
                                                    ignore_eos=True))
-    toks = 0
-    t_first = None
-    t0 = time.time()
+    emitted = {f"dec-{i}": 0 for i in range(n_users)}
     while engine.has_work():
-        outs = engine.step()
-        n = sum(len(o.new_token_ids) for o in outs)
-        if n and t_first is None:
-            t_first = time.time()
-            toks = 0
-        toks += n
-    dt = time.time() - (t_first or t0)
-    print(f"decode probe ({n_users} users x 64 toks @ {hist} ctx): "
+        for o in engine.step():
+            emitted[o.request_id] += len(o.new_token_ids)
+        if all(v >= 1 for v in emitted.values()):
+            break  # every user decoding now
+    t0 = time.time()
+    base = sum(emitted.values())
+    while engine.has_work():
+        for o in engine.step():
+            emitted[o.request_id] += len(o.new_token_ids)
+    dt = time.time() - t0
+    toks = sum(emitted.values()) - base
+    print(f"decode probe ({n_users} users @ {hist} ctx, saturated window): "
           f"{toks} tokens, {toks/max(dt, 1e-9):.0f} tok/s", flush=True)
-    print("kv usage:", engine.allocator.usage, flush=True)
+    print("kv usage:", engine.allocator.usage,
+          "swaps:", engine.swapper.swap_out_total if engine.swapper else 0,
+          flush=True)
 
 
 if __name__ == "__main__":
