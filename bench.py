@@ -1,18 +1,22 @@
 """Benchmark: the reference multi-round-QA protocol on the real chip.
 
-Orchestrates two phases as separate processes (each needs sole chip
-ownership) and prints ONE JSON line:
+Orchestrates three phases as separate processes (the engine phases need
+sole chip ownership) and prints ONE JSON line:
 
-  1. Engine phase (`benchmarks/bench_engine.py`): Llama-3-8B — int8 weights
-     + fp8 KV on one 16 GiB v5e chip, the reference's own benchmark model
-     (`tutorials/07-benchmark-multi-round-qa-single-gpu.md:5`) — through a
-     QPS sweep of the 1000/20000-token protocol with p50/p99 per point,
-     plus a saturated decode probe; then llama-1b at the r1-r3 workload for
-     round-over-round comparability.
-  2. Stack phase: a REAL engine server + the REAL router as subprocesses,
-     driven over HTTP by `benchmarks/multi_round_qa.py` — first directly
-     against the engine, then through the router. The p50 delta IS the
-     router overhead (reference: `router-e2e-test.yml:49-74`).
+  1. Engine phase (`benchmarks/bench_engine.py`): Llama-3-8B — int4
+     group-wise weights (Pallas streaming matmul) + fp8 KV serving EIGHT
+     20k-history users on one 16 GiB v5e chip — through a 6-point QPS
+     sweep (0.1-1.1, ≥300 measured requests, per-point p50/p99 + RPC
+     floor + drift-corrected TTFT) and a pipelined-deep-burst saturated
+     decode probe; then llama-1b at the r1-r3 workload for round-over-
+     round comparability.
+  2. Stack phase: a REAL engine server + the REAL router as subprocesses;
+     router overhead as the mean ± 95% CI of PAIRED per-request deltas
+     (same warm prompt direct vs via-router, order alternating) over
+     ≥200 pairs (reference: `router-e2e-test.yml:49-74`).
+  3. Fleet phase: multi-round QA through the real router over TWO engines
+     (CPU), fleet KV hit rate read via the router's own scrape parser —
+     prefix-aware vs round-robin against the ≥60% north star.
 
 Headline `value` = p50 TTFT over every measured flagship request across the
 sweep; `vs_baseline` = (200 ms north star) / value, >1.0 beats it.
@@ -67,7 +71,7 @@ def run_engine_phase() -> dict:
         stdout=subprocess.PIPE,
         text=True,
         env=child_env(),
-        timeout=int(os.environ.get("PST_BENCH_ENGINE_TIMEOUT", "2400")),
+        timeout=int(os.environ.get("PST_BENCH_ENGINE_TIMEOUT", "4200")),
     )
     lines = proc.stdout.strip().splitlines()
     if proc.returncode != 0 or not lines:
@@ -110,6 +114,81 @@ def wait_http(url: str, timeout: float, proc=None, log_path=None) -> bool:
         except Exception:
             time.sleep(1.0)
     return False
+
+
+def paired_router_overhead(
+    direct_url: str,
+    router_url: str,
+    model: str,
+    sys_len: int,
+    hist_len: int,
+    n_pairs: int = 220,
+) -> dict:
+    """Mean ± 95% CI of per-request router overhead over paired requests.
+
+    Each pair streams the SAME (warm, prefix-cached) prompt once direct to
+    the engine and once through the router, back to back, order alternating
+    pair to pair; TTFT is client-measured time to the first SSE byte. The
+    per-pair delta cancels engine compute and the tunnel floor (both legs
+    of a pair see the same drift window), isolating the router hop —
+    reference methodology: router-e2e-test.yml's direct-vs-router compare,
+    upgraded from aggregate medians to a paired design.
+    """
+    import statistics
+
+    import aiohttp
+
+    rng = __import__("random").Random(11)
+    prompts = [
+        " ".join(
+            "w%d" % rng.randrange(5000) for _ in range(sys_len + hist_len)
+        )
+        for _ in range(16)
+    ]
+
+    async def ttft(session: "aiohttp.ClientSession", base: str, prompt: str) -> float:
+        t0 = time.perf_counter()
+        async with session.post(
+            f"{base}/v1/completions",
+            json={
+                "model": model, "prompt": prompt, "max_tokens": 4,
+                "temperature": 0.0, "stream": True,
+            },
+        ) as resp:
+            resp.raise_for_status()
+            async for _ in resp.content.iter_any():
+                return time.perf_counter() - t0
+        raise RuntimeError("empty stream")
+
+    async def run() -> dict:
+        deltas: list = []
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=120)
+        ) as session:
+            for p in prompts:  # warm both paths (prefill + compile + cache)
+                await ttft(session, direct_url, p)
+                await ttft(session, router_url, p)
+            for i in range(n_pairs):
+                p = prompts[i % len(prompts)]
+                if i % 2 == 0:
+                    d = await ttft(session, direct_url, p)
+                    v = await ttft(session, router_url, p)
+                else:
+                    v = await ttft(session, router_url, p)
+                    d = await ttft(session, direct_url, p)
+                deltas.append((v - d) * 1e3)
+        mean = statistics.fmean(deltas)
+        sd = statistics.stdev(deltas)
+        ci = 1.96 * sd / (len(deltas) ** 0.5)
+        return {
+            "router_overhead_ms": round(mean, 2),
+            "router_overhead_ci95_ms": round(ci, 2),
+            "router_overhead_median_ms": round(statistics.median(deltas), 2),
+            "n_pairs": len(deltas),
+            "overhead_significant": bool(abs(mean) > ci),
+        }
+
+    return asyncio.run(run())
 
 
 def run_stack_phase(on_tpu: bool) -> dict:
@@ -194,43 +273,23 @@ def run_stack_phase(on_tpu: bool) -> dict:
             log(f"stack[{tag}]: {s}")
             return s
 
-        # Warm-up legs cover BOTH rounds the measured legs replay (greedy
-        # answers are deterministic, so round-1 prompts repeat exactly):
-        # otherwise the direct leg would pay cold prefills + XLA compiles
-        # the via-router leg then inherits warm, biasing the delta low.
-        # The second pass catches any bucket the first pass's arrival
-        # pattern missed.
-        drive(f"http://127.0.0.1:{eport}", "warmup", rounds=2)
-        drive(f"http://127.0.0.1:{eport}", "warmup2", rounds=2)
-        # Interleaved legs with MEDIANS: the tunnel's TTFT floor both
-        # drifts (tens of ms/minute) and throws multi-second one-sided
-        # transients; a mean over two direct legs let a single transient
-        # flip the delta's sign. Alternating D/V legs and taking medians
-        # keeps one bad leg from biasing either side.
-        import statistics
-
-        direct_legs, via_legs = [], []
-        for i in range(3):
-            direct_legs.append(
-                drive(f"http://127.0.0.1:{eport}", f"direct-{i}", rounds=2)
-            )
-            via_legs.append(
-                drive(f"http://127.0.0.1:{rport}", f"via-{i}", rounds=2)
-            )
-        direct_p50 = round(
-            statistics.median(leg["ttft_p50_ms"] for leg in direct_legs), 1
+        # One short leg sanity-checks the stack end to end (and compiles
+        # the decode buckets its concurrency hits); the paired phase warms
+        # its OWN prompts before measuring, so no further warm-up is
+        # needed for the delta to be unbiased.
+        drive(f"http://127.0.0.1:{eport}", "sanity", rounds=1)
+        # Paired per-request deltas (r4 verdict: the leg-median sandwich
+        # produced a negative, noise-dominated number): each PAIR sends the
+        # SAME warm prompt direct and via the router back-to-back, with the
+        # order alternating pair to pair so tunnel drift cancels within
+        # each drift window; the statistic is the mean per-pair delta with
+        # a 95% CI over >=200 pairs.
+        pairs = paired_router_overhead(
+            f"http://127.0.0.1:{eport}", f"http://127.0.0.1:{rport}",
+            model, sys_len, hist_len,
+            n_pairs=int(os.environ.get("PST_BENCH_PAIRS", "220")),
         )
-        via_p50 = round(
-            statistics.median(leg["ttft_p50_ms"] for leg in via_legs), 1
-        )
-        return {
-            "model": model,
-            "engine_direct_p50_ttft_ms": direct_p50,
-            "via_router_p50_ttft_ms": via_p50,
-            "router_overhead_ms": round(via_p50 - direct_p50, 1),
-            "engine_direct_legs": direct_legs,
-            "via_router_legs": via_legs,
-        }
+        return {"model": model, **pairs}
     finally:
         for proc in (router, engine):
             if proc is not None:
@@ -239,6 +298,112 @@ def run_stack_phase(on_tpu: bool) -> dict:
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+def run_fleet_phase() -> dict:
+    """Fleet-level KV hit rate THROUGH the routing path (the second
+    north-star metric): multi-round QA through the real router over TWO
+    engine processes, hit rate read from each engine's /metrics via the
+    router's own scrape parser. CPU engines — the metric path, not chip
+    speed, is under test. Prefix-aware routing must keep sessions hot
+    (≥60% fleet hit rate) and beat round-robin, which splits each user's
+    rounds across engines and halves the attainable rate."""
+    from benchmarks.multi_round_qa import WorkloadConfig, run_benchmark
+    from production_stack_tpu.router.stats.engine_stats import EngineStats
+
+    model = "tiny-llama-debug"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PST_FORCE_PALLAS_INTERPRET"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def measure(policy: str, base_port: int) -> dict:
+        eports = [base_port, base_port + 1]
+        rport = base_port + 2
+        for p in eports + [rport]:
+            ensure_port_free(p)
+        procs = []
+        logs = []
+        try:
+            for p in eports:
+                lg = f"/tmp/pst_fleet_engine_{p}.log"
+                logs.append(lg)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "production_stack_tpu.engine.server",
+                     "--port", str(p), "--model", model,
+                     "--max-model-len", "2048", "--block-size", "8",
+                     "--num-kv-blocks", "2100", "--max-num-seqs", "8",
+                     "--max-num-batched-tokens", "128",
+                     "--attn-impl", "gather",
+                     "--num-decode-steps", "4"],
+                    stdout=open(lg, "w"), stderr=subprocess.STDOUT,
+                    cwd=REPO, env=env,
+                ))
+            for p, proc, lg in zip(eports, procs, logs):
+                if not wait_http(f"http://127.0.0.1:{p}/health", 180,
+                                 proc=proc, log_path=lg):
+                    raise RuntimeError(f"fleet engine :{p} not healthy")
+            rlog = f"/tmp/pst_fleet_router_{policy}.log"
+            router = subprocess.Popen(
+                [sys.executable, "-m", "production_stack_tpu.router.app",
+                 "--port", str(rport),
+                 "--service-discovery", "static",
+                 "--static-backends",
+                 ",".join(f"http://127.0.0.1:{p}" for p in eports),
+                 "--static-models", f"{model},{model}",
+                 "--routing-logic", policy],
+                stdout=open(rlog, "w"), stderr=subprocess.STDOUT,
+                cwd=REPO,
+            )
+            procs.append(router)
+            if not wait_http(f"http://127.0.0.1:{rport}/health", 60,
+                             proc=router, log_path=rlog):
+                raise RuntimeError("fleet router not healthy")
+            cfg = WorkloadConfig(
+                num_users=8, num_rounds=6, qps=2.0,
+                system_prompt_len=24, chat_history_len=96, answer_len=8,
+                model=model, base_url=f"http://127.0.0.1:{rport}", seed=13,
+            )
+            asyncio.run(run_benchmark(cfg))
+            hits = queries = 0.0
+            per_engine = []
+            for p in eports:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{p}/metrics", timeout=10
+                ) as r:
+                    # The router's own scrape parser — the path KV-aware
+                    # routing relies on in production.
+                    st = EngineStats.from_vllm_scrape(r.read().decode())
+                hits += st.gpu_prefix_cache_hits_total
+                queries += st.gpu_prefix_cache_queries_total
+                per_engine.append({
+                    "engine": p,
+                    "hit_rate": round(st.gpu_prefix_cache_hit_rate, 3),
+                })
+            rate = hits / queries if queries else 0.0
+            return {"policy": policy, "fleet_hit_rate": round(rate, 3),
+                    "per_engine": per_engine}
+        finally:
+            for proc in procs:
+                proc.send_signal(signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    prefix = measure("prefixaware", 18300)
+    rr = measure("roundrobin", 18310)
+    return {
+        "prefixaware": prefix,
+        "roundrobin": rr,
+        "target_hit_rate": 0.6,
+        "meets_target": prefix["fleet_hit_rate"] >= 0.6,
+        "beats_roundrobin": (
+            prefix["fleet_hit_rate"] > rr["fleet_hit_rate"]
+        ),
+    }
 
 
 def probe_backend() -> str:
@@ -265,6 +430,14 @@ def main() -> None:
             log(f"stack phase failed: {e}")
             stack = {"error": str(e)}
 
+    fleet = None
+    if os.environ.get("PST_BENCH_SKIP_FLEET") != "1":
+        try:
+            fleet = run_fleet_phase()
+        except Exception as e:  # noqa: BLE001 — fleet numbers are additive
+            log(f"fleet phase failed: {e}")
+            fleet = {"error": str(e)}
+
     flag = engine_res.get("flagship", {})
     p50 = flag.get("p50_ttft_ms")
     out = {
@@ -279,6 +452,7 @@ def main() -> None:
         **{k: v for k, v in flag.items() if k != "p50_ttft_ms"},
         "llama_1b": engine_res.get("llama_1b"),
         "stack": stack,
+        "fleet": fleet,
     }
     print(json.dumps(out), flush=True)
 

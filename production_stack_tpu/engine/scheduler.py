@@ -100,14 +100,22 @@ class Scheduler:
 
     # -- queue ops --------------------------------------------------------
 
+    def prompt_fits(self, n_prompt_tokens: int) -> bool:
+        """Whether a prompt (plus its first decode token) can EVER be
+        scheduled in this pool. Shared by add() and the server's HTTP-layer
+        400 precheck so the two cannot drift."""
+        bs = self.allocator.block_size
+        return (
+            -(-(n_prompt_tokens + 1) // bs) <= self.allocator.num_blocks
+        )
+
     def add(self, seq: Sequence) -> None:
         if seq.num_prompt_tokens >= self.config.max_model_len:
             raise ValueError(
                 f"prompt of {seq.num_prompt_tokens} tokens exceeds "
                 f"max_model_len={self.config.max_model_len}"
             )
-        bs = self.allocator.block_size
-        if -(-(seq.num_prompt_tokens + 1) // bs) > self.allocator.num_blocks:
+        if not self.prompt_fits(seq.num_prompt_tokens):
             # Infeasible outright (prompt + its first decode token exceed
             # the whole pool): full-prompt admission would queue it forever,
             # and admitting it would self-preempt in a zero-progress loop.

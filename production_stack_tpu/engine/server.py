@@ -510,13 +510,12 @@ def create_engine_app(
             return _error(
                 f"prompt has {len(ids)} tokens, exceeds max_model_len={max_len}"
             )
-        alloc = engine.engine.allocator
-        if -(-(len(ids) + 1) // alloc.block_size) > alloc.num_blocks:
-            # Mirrors Scheduler.add's feasibility guard at the HTTP layer so
-            # the client sees a 400, not an engine-thread error.
+        if not engine.engine.scheduler.prompt_fits(len(ids)):
+            # Scheduler.add's feasibility guard at the HTTP layer (shared
+            # helper) so the client sees a 400, not an engine-thread error.
             return _error(
                 f"prompt of {len(ids)} tokens needs more KV pages than the "
-                f"engine has ({alloc.num_blocks})"
+                f"engine has ({engine.engine.allocator.num_blocks})"
             )
         try:
             sampling = build_sampling(req, max_len, len(ids), tok)
@@ -621,6 +620,16 @@ def create_engine_app(
             except (ConnectionResetError, asyncio.CancelledError):
                 await engine.abort(rid)
                 raise
+            except ValueError as e:
+                # Rejected on the engine thread (add-time validation not
+                # mirrored by an HTTP precheck). The 200 headers are gone —
+                # emit an OpenAI-style error event, then terminate.
+                err = {"error": {"message": str(e),
+                                 "type": "invalid_request_error"}}
+                await resp.write(f"data: {json.dumps(err)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
             metrics.e2e.observe(time.time() - start)
             metrics.success.inc()
             metrics.prompt_tokens.inc(len(ids))
@@ -634,6 +643,8 @@ def create_engine_app(
         except asyncio.CancelledError:
             await engine.abort(rid)
             raise
+        except ValueError as e:  # engine-thread rejection → HTTP 400
+            return _error(str(e))
         usage = {
             "prompt_tokens": len(ids),
             "completion_tokens": len(result["token_ids"]),
@@ -726,7 +737,12 @@ def create_engine_app(
                 lora_name=lora,
             ))
 
-        results = list(await asyncio.gather(*(one(i) for i in range(n_sample))))
+        try:
+            results = list(
+                await asyncio.gather(*(one(i) for i in range(n_sample)))
+            )
+        except ValueError as e:  # engine-thread rejection → HTTP 400
+            return _error(str(e))
         # OpenAI bills EVERY best_of candidate in completion_tokens.
         sampled_tokens = sum(len(r["token_ids"]) for r in results)
         if rank:
