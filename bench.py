@@ -3,13 +3,15 @@
 Orchestrates three phases as separate processes (the engine phases need
 sole chip ownership) and prints ONE JSON line:
 
-  1. Engine phase (`benchmarks/bench_engine.py`): Llama-3-8B — int4
-     group-wise weights (Pallas streaming matmul) + fp8 KV serving EIGHT
-     20k-history users on one 16 GiB v5e chip — through a 6-point QPS
-     sweep (0.1-1.1, ≥300 measured requests, per-point p50/p99 + RPC
-     floor + drift-corrected TTFT) and a pipelined-deep-burst saturated
-     decode probe; then llama-1b at the r1-r3 workload for round-over-
-     round comparability.
+  1. Engine phase (`benchmarks/bench_engine.py`): Llama-3-8B, int4
+     group-wise weights (Pallas streaming matmul) + fp8 KV on one 16 GiB
+     v5e chip. Two sub-phases: a 4-user TTFT sweep (6 QPS points
+     0.1-1.1, ≥300 measured requests, per-point p50/p99 + RPC floor +
+     drift-corrected TTFT — the workload must FIT so TTFT measures the
+     engine, not eviction thrash) and an 8-users-×-20k CONCURRENCY phase
+     (more live KV than HBM holds; live-KV swap rotates the overflow)
+     ending in a pipelined-deep-burst saturated decode probe; then
+     llama-1b for round-over-round comparability.
   2. Stack phase: a REAL engine server + the REAL router as subprocesses;
      router overhead as the mean ± 95% CI of PAIRED per-request deltas
      (same warm prompt direct vs via-router, order alternating) over

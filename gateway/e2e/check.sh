@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Assert the real-Envoy ext_proc path steers and serves.
+#   1. identical prompts land on the SAME engine (prefix-aware steering)
+#   2. responses stream back through Envoy intact
+set -euo pipefail
+URL="${1:-http://localhost:10000}"
+
+body='{"model": "fake/model", "prompt": "the same long prefix for affinity", "max_tokens": 8}'
+
+first=$(curl -sf -D- -o /tmp/pst_e2e_resp1.json "$URL/v1/completions" \
+  -H 'Content-Type: application/json' -d "$body" | grep -i x-envoy-upstream || true)
+resp1=$(cat /tmp/pst_e2e_resp1.json)
+echo "$resp1" | grep -q '"text"' || { echo "FAIL: no completion body"; exit 1; }
+
+# Same prompt 5x: prefix-aware must keep hitting one engine.
+engines=()
+for i in 1 2 3 4 5; do
+  dest=$(curl -sf "$URL/v1/completions" -H 'Content-Type: application/json' \
+    -d "$body" -o /dev/null -w '%{header_json}' | python3 -c \
+    'import json,sys; h=json.load(sys.stdin); print(h.get("x-pst-destination", ["?"])[0])' \
+    2>/dev/null || echo "?")
+  engines+=("$dest")
+done
+uniq_count=$(printf '%s\n' "${engines[@]}" | sort -u | wc -l)
+if [ "$uniq_count" -gt 1 ]; then
+  echo "FAIL: identical prompts split across engines: ${engines[*]}"
+  exit 1
+fi
+echo "PASS: served through Envoy ext_proc; affinity held (${engines[0]})"

@@ -129,6 +129,23 @@ def fleet_dashboard():
         ('sum(rate(pst:adaptive_deep_bursts_total[2m])) by (model_name)',
          "{{model_name}}"),
     ], 16, 25))
+    # Row 6 — fleet hit rate (the ≥0.6 north star) + live-KV swap.
+    p.append(panel("Fleet KV hit rate (all engines)", [
+        ('sum(vllm:gpu_prefix_cache_hits_total) / '
+         'clamp_min(sum(vllm:gpu_prefix_cache_queries_total), 1)', "fleet"),
+        ('0.6', "north star (0.6)"),
+    ], 0, 32, unit="percentunit"))
+    p.append(panel("KV swap traffic (park / resume / tail pages)", [
+        ('sum(rate(pst:kv_swap_out_total[2m]))', "swap-out /s"),
+        ('sum(rate(pst:kv_swap_in_total[2m]))', "swap-in /s"),
+        ('sum(rate(pst:kv_swap_tail_pages_total[2m]))', "tail pages /s"),
+        ('sum(rate(pst:kv_swap_fallback_recompute_total[2m]))',
+         "fallback recompute /s"),
+    ], 8, 32))
+    p.append(panel("KV swap stash occupancy (host DRAM pages)", [
+        ('sum(pst:kv_swap_stash_blocks)', "stashed pages"),
+        ('sum(vllm:num_requests_swapped)', "parked sequences"),
+    ], 16, 32))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

@@ -623,7 +623,10 @@ def create_engine_app(
             except ValueError as e:
                 # Rejected on the engine thread (add-time validation not
                 # mirrored by an HTTP precheck). The 200 headers are gone —
-                # emit an OpenAI-style error event, then terminate.
+                # emit an OpenAI-style error event, then terminate. Abort
+                # in case the failure happened mid-stream (the sequence
+                # must not keep decoding for a dead client).
+                await engine.abort(rid)
                 err = {"error": {"message": str(e),
                                  "type": "invalid_request_error"}}
                 await resp.write(f"data: {json.dumps(err)}\n\n".encode())
@@ -644,6 +647,7 @@ def create_engine_app(
             await engine.abort(rid)
             raise
         except ValueError as e:  # engine-thread rejection → HTTP 400
+            await engine.abort(rid)
             return _error(str(e))
         usage = {
             "prompt_tokens": len(ids),
@@ -741,7 +745,12 @@ def create_engine_app(
             results = list(
                 await asyncio.gather(*(one(i) for i in range(n_sample)))
             )
-        except ValueError as e:  # engine-thread rejection → HTTP 400
+        except ValueError as e:
+            # One candidate rejected on the engine thread: abort ALL
+            # candidates (gather returned on the first failure — siblings
+            # are still decoding for a request the client sees fail).
+            for i in range(n_sample):
+                await engine.abort(f"{rid}-{i}")
             return _error(str(e))
         # OpenAI bills EVERY best_of candidate in completion_tokens.
         sampled_tokens = sum(len(r["token_ids"]) for r in results)
