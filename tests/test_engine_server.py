@@ -227,3 +227,30 @@ async def test_api_key_auth():
                 assert not server.engine.sleeping
         finally:
             await runner.cleanup()
+
+
+async def test_infeasible_prompt_400_not_hang():
+    """A prompt whose pages can never fit must 400 at the HTTP layer
+    (shared Scheduler.prompt_fits guard) — not queue forever or return an
+    empty 200 stream (r5 advisor finding)."""
+    async with EngineServer(
+        num_kv_blocks=8, max_model_len=512, block_size=8
+    ) as server, aiohttp.ClientSession() as sess:
+        payload = {
+            "model": "tiny-llama-debug",
+            "prompt": list(range(1, 101)),  # 100 toks > 64-token pool
+            "max_tokens": 4,
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=payload) as r:
+            assert r.status == 400
+            body = await r.json()
+            assert "KV pages" in body["message"]
+        # The engine is still healthy and serves feasible prompts.
+        ok = {
+            "model": "tiny-llama-debug",
+            "prompt": [1, 2, 3],
+            "max_tokens": 4,
+            "temperature": 0.0,
+        }
+        async with sess.post(f"{server.url}/v1/completions", json=ok) as r:
+            assert r.status == 200
