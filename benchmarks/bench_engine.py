@@ -80,6 +80,7 @@ def run_model_phase(
     kv_cache_dtype="float8_e4m3fn",
     hbm_utilization: float = 0.88,
     pipelined_probe: bool = False,
+    async_decode: bool = False,
 ) -> dict:
     from benchmarks.protocol import ProtocolRunner
     from production_stack_tpu.engine.config import EngineConfig
@@ -97,6 +98,7 @@ def run_model_phase(
         attn_impl=attn_impl,
         kv_cache_dtype=kv_cache_dtype,
         num_decode_steps=num_decode_steps,
+        async_decode=async_decode,
         adaptive_decode_steps=adaptive,
         # Deepen only when the arrival stream pauses AND every user's
         # request is already running (closed-loop traffic: nobody is left
@@ -226,13 +228,14 @@ def main() -> None:
                        (0.9, 18), (1.1, 22)],
                 stagger=((0,), (1, 2), (3,)),
                 decode_probe_tokens=192,
-                # Shallow live bursts: n=2 cuts the burst wall an arrival
-                # can stall behind; the saturated probe runs PIPELINED
-                # deep bursts (fetch overlapped with the next burst's
-                # execution, so the tunnel sync floor vanishes from the
-                # steady state).
+                # Pipelined shallow bursts (async n=2): one burst always
+                # in flight, fetch overlapped — the ~110 ms tunnel sync no
+                # longer idles the chip between bursts, so sweep-time
+                # decode keeps up with the arrival stream (the synchronous
+                # variant saturated at qps 1.1: queueing blew p99 to 6 s).
                 num_decode_steps=2,
                 adaptive=32,
+                async_decode=True,
                 pipelined_probe=True,
             )
         if os.environ.get("PST_BENCH_SKIP_8B_CONC") != "1":
@@ -258,6 +261,7 @@ def main() -> None:
                 decode_probe_tokens=192,
                 num_decode_steps=2,
                 adaptive=32,
+                async_decode=True,
                 pipelined_probe=True,
             )
         if os.environ.get("PST_BENCH_SKIP_1B") != "1":

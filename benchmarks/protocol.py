@@ -221,7 +221,7 @@ class ProtocolRunner:
         return out
 
     def decode_probe(
-        self, max_tokens: int = 96, pipelined: bool = False, burst: int = 16
+        self, max_tokens: int = 96, pipelined: bool = False, burst: int = 32
     ) -> Optional[float]:
         """Phase 5: all users decode concurrently at full context; tok/s
         over full-burst steps.

@@ -648,44 +648,63 @@ class Llama:
             q = _apply_rope(q, rope_cos, rope_sin)
             k = _apply_rope(k, rope_cos, rope_sin)
 
-            # One scatter over the flattened [L*nb*2*bs, KH*hd] row view:
-            # slot (blk, pos) of layer li holds its K row at
-            # (li*nb + blk)*2*bs + pos and its V row bs rows later. The drop
-            # sentinel (flat_write == nb*bs) must map OUT of the whole
-            # array, not merely past this layer's rows — past-the-layer
-            # would land in layer li+1's first page.
-            n_layers_total = kv_all.shape[0]
-            blk = flat_write // bs
-            pos = flat_write % bs
-            oob = n_layers_total * nb * 2 * bs
-            idx_k = jnp.where(
-                flat_write >= nb * bs,
-                oob,
-                (li * nb + blk) * (2 * bs) + pos,
-            )
-            kvd = jnp.concatenate(
-                [
-                    k.reshape(B * T, cfg.kv_size),
-                    v.reshape(B * T, cfg.kv_size),
-                ],
-                axis=0,
-            ).astype(kv_all.dtype)  # [2*B*T, KH*hd]
-            idx = jnp.concatenate([idx_k, idx_k + bs])
-            kv_all = (
-                kv_all.reshape(n_layers_total * nb * 2 * bs, cfg.kv_size)
-                .at[idx]
-                .set(kvd, mode="drop")
-                .reshape(n_layers_total, nb, 2, bs, cfg.kv_size)
-            )
+            if _decode_write_fused(attn_impl) and T == 1:
+                # Decode on the Pallas path: the KV write rides INSIDE the
+                # attention kernel (one DMA per sequence before the read
+                # loop) — the per-layer XLA scatter below is pure op
+                # overhead on the 10 GiB carried buffer at decode shapes.
+                from ..ops.paged_attention_pallas import (
+                    pallas_paged_attention_decode_write,
+                )
 
-            attn = paged_attention(
-                q, kv_all, block_tables, kv_lens, positions, li,
-                scale=scale, impl=attn_impl,
-                # Window pattern keys off the GLOBAL layer index (under pp,
-                # li is the stage-local cache index).
-                window=_layer_window(cfg, li_global),
-                softcap=cfg.attn_logit_softcap,
-            )
+                attn, kv_all = pallas_paged_attention_decode_write(
+                    q[:, 0], kv_all, block_tables, kv_lens, li,
+                    k.reshape(B, cfg.kv_size), v.reshape(B, cfg.kv_size),
+                    flat_write,  # [B*T] == [B] at T==1
+                    scale=scale,
+                    window=_layer_window(cfg, li_global),
+                    softcap=cfg.attn_logit_softcap,
+                )
+                attn = attn[:, None]
+            else:
+                # One scatter over the flattened [L*nb*2*bs, KH*hd] row
+                # view: slot (blk, pos) of layer li holds its K row at
+                # (li*nb + blk)*2*bs + pos and its V row bs rows later. The
+                # drop sentinel (flat_write == nb*bs) must map OUT of the
+                # whole array, not merely past this layer's rows —
+                # past-the-layer would land in layer li+1's first page.
+                n_layers_total = kv_all.shape[0]
+                blk = flat_write // bs
+                pos = flat_write % bs
+                oob = n_layers_total * nb * 2 * bs
+                idx_k = jnp.where(
+                    flat_write >= nb * bs,
+                    oob,
+                    (li * nb + blk) * (2 * bs) + pos,
+                )
+                kvd = jnp.concatenate(
+                    [
+                        k.reshape(B * T, cfg.kv_size),
+                        v.reshape(B * T, cfg.kv_size),
+                    ],
+                    axis=0,
+                ).astype(kv_all.dtype)  # [2*B*T, KH*hd]
+                idx = jnp.concatenate([idx_k, idx_k + bs])
+                kv_all = (
+                    kv_all.reshape(n_layers_total * nb * 2 * bs, cfg.kv_size)
+                    .at[idx]
+                    .set(kvd, mode="drop")
+                    .reshape(n_layers_total, nb, 2, bs, cfg.kv_size)
+                )
+
+                attn = paged_attention(
+                    q, kv_all, block_tables, kv_lens, positions, li,
+                    scale=scale, impl=attn_impl,
+                    # Window pattern keys off the GLOBAL layer index (under
+                    # pp, li is the stage-local cache index).
+                    window=_layer_window(cfg, li_global),
+                    softcap=cfg.attn_logit_softcap,
+                )
             attn = attn.reshape(B, T, cfg.q_size).astype(x.dtype)
             o, wo_s = _qdot(attn, lp, "wo")
             if wo_s is not None:
@@ -909,6 +928,27 @@ class Llama:
 # ----------------------------------------------------------------------------
 # Layer primitives
 # ----------------------------------------------------------------------------
+
+
+def _decode_write_fused(attn_impl: str) -> bool:
+    """Whether single-token decode should fold the KV write into the
+    Pallas attention kernel (skips the per-layer XLA scatter).
+
+    OFF by default: measured on v5e at the 8B bench shape, the fold's
+    page round-trip (sub-row DMA into a tiled fp8 page is not
+    expressible, so the kernel pulls/splices/pushes the whole page) costs
+    MORE than the XLA scatter it removes (36.2 vs 32.5 ms/step at batch
+    8 x 20k). Kept behind PST_FUSED_KV_WRITE=1 with its exact-parity test
+    for revisiting on hardware where row-granular HBM writes are legal."""
+    if os.environ.get("PST_FUSED_KV_WRITE") != "1":
+        return False
+    if attn_impl == "pallas":
+        return True
+    if attn_impl == "gather":
+        return False
+    from ..ops.attention import _use_pallas
+
+    return _use_pallas()
 
 
 def _rms_norm(

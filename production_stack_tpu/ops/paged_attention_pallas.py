@@ -298,6 +298,135 @@ def _decode_kernel(
     o_ref[0] = out.astype(o_ref.dtype)
 
 
+def _decode_write_kernel(
+    tables_ref, lens_ref, layer_ref, win_ref, wf_ref,  # scalar prefetch
+    q_ref,  # [1, H, hd] VMEM
+    k_ref,  # [1, 1, KH*hd] VMEM — this step's K row for this sequence
+    v_ref,  # [1, 1, KH*hd] VMEM
+    kv_hbm,  # [L, nb, 2, bs, KH*hd] ANY (aliased with kv_out)
+    o_ref,  # [1, H, hd] VMEM
+    kv_out,  # [L, nb, 2, bs, KH*hd] ANY — the SAME buffer (in-place)
+    buf, sems, wbuf, wsems, m_ref, l_ref, acc_ref,
+    **kw,
+):
+    """Decode step with the KV write folded in: each grid cell pulls its
+    write page into VMEM, splices the new K/V row in with a masked select
+    (sub-row DMA into a tiled fp8 page is not expressible — HBM slices
+    must be tiling-aligned), pushes the page back, waits, then runs the
+    standard flash read loop — the row just written is the newest position
+    and is read back in the final chunk. Folding removes the per-layer
+    XLA scatter from the decode step (a fixed ~0.2 ms x layers of pure op
+    overhead on a 10 GiB carried buffer); the page round trip is ~512 KB
+    per sequence per layer, noise next to the KV stream."""
+    b = pl.program_id(0)
+    bs = kv_hbm.shape[3]
+    nb = kv_hbm.shape[1]
+    wf = wf_ref[b]
+    ly = layer_ref[0]
+
+    @pl.when(wf < nb * bs)
+    def _write():
+        blk = wf // bs
+        pos = wf % bs
+        pull = pltpu.make_async_copy(
+            kv_out.at[ly, blk], wbuf, wsems.at[0]
+        )
+        pull.start()
+        pull.wait()
+        row = jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        mask = row == pos
+        page_k = jnp.where(
+            mask, k_ref[0].astype(jnp.float32), wbuf[0].astype(jnp.float32)
+        ).astype(wbuf.dtype)
+        page_v = jnp.where(
+            mask, v_ref[0].astype(jnp.float32), wbuf[1].astype(jnp.float32)
+        ).astype(wbuf.dtype)
+        wbuf[0] = page_k
+        wbuf[1] = page_v
+        push = pltpu.make_async_copy(
+            wbuf, kv_out.at[ly, blk], wsems.at[1]
+        )
+        push.start()
+        push.wait()
+
+    _decode_kernel(
+        tables_ref, lens_ref, layer_ref, win_ref,
+        q_ref, kv_out, o_ref, buf, sems, m_ref, l_ref, acc_ref, **kw,
+    )
+
+
+def pallas_paged_attention_decode_write(
+    q3: jax.Array,  # [B, H, hd]
+    kv_pages: jax.Array,  # [L, nb, 2, bs, KH*hd] (donated by the caller)
+    block_tables: jax.Array,  # [B, W]
+    kv_lens: jax.Array,  # [B] valid length INCLUDING the row being written
+    layer,  # int32 scalar
+    k_new: jax.Array,  # [B, KH*hd]
+    v_new: jax.Array,  # [B, KH*hd]
+    write_flat: jax.Array,  # [B] flat slot blk*bs+pos; >= nb*bs drops
+    *,
+    scale: float,
+    window=0,
+    softcap: float = 0.0,
+) -> "tuple[jax.Array, jax.Array]":
+    """Fused write+attend decode step. Returns (out [B, H, hd], cache).
+    The cache is updated IN PLACE (input/output aliased)."""
+    B, H, hd, bs, lanes, C, kw, scratch, flash = _decode_geometry(
+        q3, kv_pages, block_tables, scale=scale, softcap=softcap
+    )
+    nb = kv_pages.shape[1]
+    tables = block_tables.astype(jnp.int32)
+    lens = kv_lens.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    wf = write_flat.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, t, l, ly, w, f: (b, 0, 0)),
+            # [B, 1, lanes] with a singleton sublane dim: a (1, lanes)
+            # trailing block is only legal when the sublane block equals
+            # the array dim.
+            pl.BlockSpec((1, 1, lanes), lambda b, t, l, ly, w, f: (b, 0, 0)),
+            pl.BlockSpec((1, 1, lanes), lambda b, t, l, ly, w, f: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, t, l, ly, w, f: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=scratch + [
+            pltpu.VMEM((2, bs, lanes), kv_pages.dtype),  # write page
+            pltpu.SemaphoreType.DMA((2,)),
+        ] + flash,
+    )
+    kernel = functools.partial(_decode_write_kernel, **kw)
+    out, cache = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, hd), q3.dtype),
+            jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype),
+        ],
+        # Operand index 8 = kv_pages (after 5 scalar-prefetch args and
+        # q/k/v); aliased onto output 1 so the 10 GiB cache updates in
+        # place instead of copying.
+        input_output_aliases={8: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        interpret=_interpret(),
+    )(tables, lens, layer_arr, win_arr, wf,
+      q3,
+      k_new.astype(kv_pages.dtype)[:, None],
+      v_new.astype(kv_pages.dtype)[:, None],
+      kv_pages)
+    return out, cache
+
+
 def _prefill_kernel(
     tables_ref, lens_ref, starts_ref, layer_ref, win_ref,  # scalar prefetch
     q_ref,  # [1, Tq, H, hd] VMEM
@@ -384,14 +513,37 @@ def _scratch(C, bs, lanes, R, KH, hd, kv_dtype):
     ]
 
 
-def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, window,
-                 *, scale, softcap):
+def _decode_geometry(q3, kv_pages, block_tables, *, scale, softcap):
+    """Shared decode-call geometry: chunking, flash scratch, and the kernel
+    kwargs — ONE source of truth for the plain and fused-write wrappers
+    (a tuning change here reaches both)."""
     B, H, hd = q3.shape
     _, nb, _, bs, lanes = kv_pages.shape
     KH = lanes // hd
     W = block_tables.shape[1]
     G = H // KH
     C = _chunk_pages(bs, 1024)
+    kwargs = dict(
+        scale=scale, block_size=bs, chunk=C, table_width=W, group=G,
+        head_dim=hd, softcap=softcap,
+    )
+    scratch = [
+        pltpu.VMEM((2, C, 2, bs, lanes), kv_pages.dtype),
+        pltpu.SemaphoreType.DMA((2, C)),
+    ]
+    flash_scratch = [
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, hd), jnp.float32),
+    ]
+    return B, H, hd, bs, lanes, C, kwargs, scratch, flash_scratch
+
+
+def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, window,
+                 *, scale, softcap):
+    B, H, hd, bs, lanes, C, kw, scratch, flash = _decode_geometry(
+        q3, kv_pages, block_tables, scale=scale, softcap=softcap
+    )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -401,24 +553,9 @@ def _decode_call(q3, kv_pages, block_tables, kv_lens, layer, window,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, H, hd), lambda b, t, l, ly, w: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, C, 2, bs, lanes), kv_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, C)),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, hd), jnp.float32),
-        ],
+        scratch_shapes=scratch + flash,
     )
-    kernel = functools.partial(
-        _decode_kernel,
-        scale=scale,
-        block_size=bs,
-        chunk=C,
-        table_width=W,
-        group=G,
-        head_dim=hd,
-        softcap=softcap,
-    )
+    kernel = functools.partial(_decode_kernel, **kw)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

@@ -5,6 +5,7 @@ the pair dim, V rows at index 1; heads folded into the lane dim) — the
 layout the kernels DMA whole pages of.
 """
 
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -119,3 +120,57 @@ def test_pallas_prefill_odd_tile_falls_back():
     out = pallas_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     ref = gather_paged_attention(q, kv, tables, kv_lens, q_pos, scale=scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float8_e4m3fn])
+def test_decode_write_fused_matches_scatter_then_read(dtype):
+    """The fused write+attend decode kernel must equal scatter-then-read
+    exactly: same cache bytes, same attention output (incl. the drop
+    sentinel row and an fp8 cache)."""
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        pallas_paged_attention,
+        pallas_paged_attention_decode_write,
+    )
+
+    rng = np.random.default_rng(0)
+    L, nb, bs, KH, hd, G = 2, 32, 8, 2, 16, 4
+    H, lanes = KH * G, KH * 16
+    B, W = 3, 6
+    kv = jnp.asarray(rng.standard_normal((L, nb, 2, bs, lanes)), dtype)
+    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    # Disjoint per-row pages (the allocator's ownership invariant).
+    tables = jnp.asarray((np.arange(B * W).reshape(B, W) % nb).astype(np.int32))
+    lens_l = [13, 1, 40]
+    lens = jnp.asarray(lens_l, jnp.int32)
+    k_new = jnp.asarray(rng.standard_normal((B, lanes)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, lanes)), jnp.float32)
+    wf = []
+    for i, ln in enumerate(lens_l):
+        p = ln - 1
+        wf.append(int(tables[i, p // bs]) * bs + p % bs)
+    wf[1] = nb * bs  # row 1: drop sentinel (padding rows never write)
+    wf = jnp.asarray(wf, jnp.int32)
+    layer = 1
+
+    kv_ref = np.asarray(kv.astype(jnp.float32)).copy()
+    for i in range(B):
+        w = int(wf[i])
+        if w < nb * bs:
+            kv_ref[layer, w // bs, 0, w % bs] = np.asarray(k_new)[i]
+            kv_ref[layer, w // bs, 1, w % bs] = np.asarray(v_new)[i]
+    kv_ref = jnp.asarray(kv_ref, dtype)
+    ref = pallas_paged_attention(
+        q[:, None], kv_ref, tables, lens, (lens - 1)[:, None], layer,
+        scale=0.25,
+    )
+
+    out, kv_out = pallas_paged_attention_decode_write(
+        q, kv, tables, lens, layer, k_new, v_new, wf, scale=0.25
+    )
+    np.testing.assert_array_equal(
+        np.asarray(kv_out.astype(jnp.float32)),
+        np.asarray(kv_ref.astype(jnp.float32)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref[:, 0]), atol=1e-5
+    )
