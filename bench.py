@@ -452,6 +452,7 @@ def main() -> None:
         "backend": backend,
         "rpc_floor_ms": engine_res.get("rpc_floor_ms"),
         **{k: v for k, v in flag.items() if k != "p50_ttft_ms"},
+        "concurrency_8users": engine_res.get("concurrency_8users"),
         "llama_1b": engine_res.get("llama_1b"),
         "stack": stack,
         "fleet": fleet,
