@@ -146,6 +146,29 @@ def fleet_dashboard():
         ('sum(pst:kv_swap_stash_blocks)', "stashed pages"),
         ('sum(vllm:num_requests_swapped)', "parked sequences"),
     ], 16, 32))
+    # Row 7 — resilience (breakers, retry/failover, admission, drain).
+    p.append(panel("Circuit breaker state per engine (0=closed, 1=half-open, 2=open)", [
+        ('pst_resilience_breaker_state', "{{server}}"),
+    ], 0, 39))
+    p.append(panel("Retries / failovers / upstream failures per second", [
+        ('sum(rate(pst_resilience_retries_total[2m]))', "retries /s"),
+        ('sum(rate(pst_resilience_failovers_total[2m]))', "failovers /s"),
+        ('sum(rate(pst_resilience_upstream_failures_total[2m]))',
+         "upstream failures /s"),
+        ('sum(rate(pst_resilience_client_disconnects_total[2m]))',
+         "client disconnects /s"),
+    ], 8, 39))
+    p.append(panel("Admission control (admitted vs shed, queue depth)", [
+        ('sum(rate(pst_resilience_admitted_total[2m]))', "admitted /s"),
+        ('sum(rate(pst_resilience_sheds_total[2m])) by (reason)',
+         "shed {{reason}} /s"),
+        ('pst_resilience_queue_depth', "queue depth"),
+    ], 16, 39))
+    p.append(stat("Open breakers",
+                  'count(pst_resilience_breaker_state == 2) or vector(0)',
+                  0, 46))
+    p.append(stat("Draining engines",
+                  'pst_resilience_draining_engines', 4, 46))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

@@ -37,6 +37,7 @@ class AsyncLLMEngine:
         self._stop = False
         self._sleeping = False
         self._sleep_level = 0
+        self._draining = False
         self._queues: Dict[str, asyncio.Queue] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -100,6 +101,35 @@ class AsyncLLMEngine:
         self._sleep_level = 0
         self._work.set()
         logger.info("engine awake")
+
+    # -- drain ------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop accepting new sequences; in-flight ones keep decoding to
+        completion (the step loop is untouched — only the HTTP admission
+        gate closes). Router-side discovery marks draining engines
+        unroutable; /undrain reverses."""
+        self._draining = True
+        logger.info("engine draining (in-flight sequences will finish)")
+
+    def undrain(self) -> None:
+        self._draining = False
+        logger.info("engine accepting new sequences again")
+
+    def num_inflight(self) -> int:
+        # Swapped (preempted) sequences are still pending work — a drain
+        # that ignored them would let preStop complete with generations
+        # parked mid-flight.
+        stats = self.engine.stats()
+        return int(
+            stats.get("num_requests_running", 0)
+            + stats.get("num_requests_waiting", 0)
+            + stats.get("num_requests_swapped", 0)
+        )
 
     # -- submission -------------------------------------------------------
 

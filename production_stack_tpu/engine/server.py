@@ -53,11 +53,22 @@ from .sequence import SamplingParams
 logger = init_logger(__name__)
 
 
-def _error(message: str, status: int = 400, etype: str = "invalid_request_error"):
+def _error(message: str, status: int = 400, etype: str = "invalid_request_error",
+           headers: Optional[dict] = None):
     return web.json_response(
         ErrorResponse(message=message, type=etype, code=status).model_dump(),
         status=status,
+        headers=headers,
     )
+
+
+def _drain_error():
+    # The X-PST-Draining marker lets the router tell a deliberate drain
+    # rejection apart from a backend failure: it reconciles its drain state
+    # from live traffic (even with health probes off) instead of tripping
+    # the circuit breaker.
+    return _error("engine is draining", 503, "service_unavailable",
+                  headers={"X-PST-Draining": "1"})
 
 
 class EngineMetrics:
@@ -345,7 +356,7 @@ def create_engine_app(
     # Everything except unauthenticated probe/scrape endpoints is guarded
     # when --api-key is set (/sleep in particular is destructive). Enforced
     # as a middleware so no handler can be forgotten.
-    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping"}
+    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping", "/is_draining"}
 
     @web.middleware
     async def auth_middleware(request: web.Request, handler):
@@ -396,6 +407,8 @@ def create_engine_app(
             return _error(f"invalid request body: {e}")
         if engine.sleeping:
             return _error("engine is sleeping", 503, "service_unavailable")
+        if engine.draining:
+            return _drain_error()
         prompt = engine.engine.tokenizer.apply_chat_template(req.messages)
         return await _serve_generation(request, req, prompt, is_chat=True)
 
@@ -406,6 +419,8 @@ def create_engine_app(
             return _error(f"invalid request body: {e}")
         if engine.sleeping:
             return _error("engine is sleeping", 503, "service_unavailable")
+        if engine.draining:
+            return _drain_error()
         prompt = req.prompt
         # Normalize the four OpenAI prompt forms: str, [str, ...],
         # [int, ...] (one tokenized prompt), [[int, ...], ...] (a batch).
@@ -917,7 +932,11 @@ def create_engine_app(
 
     async def health(request: web.Request) -> web.Response:
         if engine.is_healthy():
-            return web.json_response({"status": "ok"})
+            # Draining is still healthy (the pod must stay alive to finish
+            # in-flight work) — the status string tells K8s dashboards and
+            # humans apart from a routable engine.
+            status = "draining" if engine.draining else "ok"
+            return web.json_response({"status": status})
         return web.json_response(
             {"status": "unhealthy", "error": engine.step_error}, status=503
         )
@@ -940,6 +959,34 @@ def create_engine_app(
     async def wake_up(request: web.Request) -> web.Response:
         engine.wake_up()
         return web.json_response({"status": "awake"})
+
+    async def drain(request: web.Request) -> web.Response:
+        """Graceful drain: stop admitting new sequences, finish in-flight
+        ones. ``?wait=1`` blocks (up to ``?timeout=`` seconds, default 30)
+        until the engine is idle — the preStop-hook shape."""
+        engine.drain()
+        if request.query.get("wait"):
+            try:
+                timeout = float(request.query.get("timeout", "30"))
+            except ValueError:
+                timeout = 30.0
+            deadline = time.time() + timeout
+            while time.time() < deadline and engine.num_inflight() > 0:
+                await asyncio.sleep(0.1)
+        return web.json_response(
+            {"status": "draining", "in_flight": engine.num_inflight()}
+        )
+
+    async def undrain(request: web.Request) -> web.Response:
+        engine.undrain()
+        return web.json_response(
+            {"status": "accepting", "in_flight": engine.num_inflight()}
+        )
+
+    async def is_draining(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"is_draining": engine.draining, "in_flight": engine.num_inflight()}
+        )
 
     async def load_lora(request: web.Request) -> web.Response:
         """Parse the PEFT checkpoint and install it into a device bank slot
@@ -993,6 +1040,9 @@ def create_engine_app(
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
+    app.router.add_post("/drain", drain)
+    app.router.add_post("/undrain", undrain)
+    app.router.add_get("/is_draining", is_draining)
     app.router.add_post("/v1/load_lora_adapter", load_lora)
     app.router.add_post("/v1/unload_lora_adapter", unload_lora)
     app.router.add_get("/version", version)

@@ -23,6 +23,8 @@ everything before it.
 from __future__ import annotations
 
 import argparse
+import asyncio
+import contextlib
 import time
 from typing import Dict, Set
 
@@ -111,6 +113,10 @@ def create_controller_app(instance_ttl: float = 120.0) -> web.Application:
         return web.json_response({"matches": matches})
 
     async def instances(request: web.Request) -> web.Response:
+        # Expire here too: lookup() used to be the only caller of expire(),
+        # so engines that deregistered-but-were-never-looked-up kept dead
+        # URLs alive in this listing indefinitely.
+        state.expire()
         return web.json_response(
             {
                 model: {url: len(hashes) for url, hashes in per_model.items()}
@@ -126,6 +132,28 @@ def create_controller_app(instance_ttl: float = 120.0) -> web.Application:
     app.router.add_post("/lookup", lookup)
     app.router.add_get("/instances", instances)
     app.router.add_get("/health", health)
+
+    async def _expire_loop(app: web.Application) -> None:
+        # Periodic expiry so stale engines age out even with zero traffic
+        # (lookups and /instances both expire inline, but an idle
+        # controller should not hold dead URLs for days).
+        interval = max(1.0, instance_ttl / 2)
+        while True:
+            await asyncio.sleep(interval)
+            state.expire()
+
+    async def _start_expiry(app: web.Application) -> None:
+        app["expire_task"] = asyncio.create_task(_expire_loop(app))
+
+    async def _stop_expiry(app: web.Application) -> None:
+        task = app.get("expire_task")
+        if task is not None:
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+
+    app.on_startup.append(_start_expiry)
+    app.on_cleanup.append(_stop_expiry)
     return app
 
 

@@ -1,0 +1,89 @@
+"""Resilience subsystem: circuit breakers, retry/failover, admission control.
+
+The reference stack leans on Envoy (outlier detection, retries) and K8s
+probes for production survivability; this stack serves straight from the
+router, so the protections live here natively:
+
+- :mod:`breaker` — per-backend circuit breakers (closed/open/half-open,
+  keyed by engine URL) fed by proxy outcomes and health probes; routing
+  consults them before picking an engine.
+- :mod:`admission` — token-bucket rate limiting plus a bounded priority
+  queue with deadline-based load shedding (429 + ``Retry-After``) ahead
+  of ``route_general_request``.
+- :mod:`retry` — backoff schedule for proxy retry/failover (only ever
+  before the first streamed byte reaches the client).
+- :mod:`metrics` — the ``pst_resilience_*`` Prometheus surface.
+
+Lifecycle mirrors the other router singletons (initialize/get/teardown);
+``get_*`` accessors return ``None`` when the subsystem is not configured
+so every caller degrades to the pre-resilience behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .admission import AdmissionController
+from .breaker import BreakerState, CircuitBreaker, CircuitBreakerRegistry
+from .retry import RetryPolicy
+
+_breaker_registry: Optional[CircuitBreakerRegistry] = None
+_admission_controller: Optional[AdmissionController] = None
+_retry_policy: Optional[RetryPolicy] = None
+
+
+def initialize_resilience(args) -> None:
+    """Create the resilience singletons from parsed router args."""
+    global _breaker_registry, _admission_controller, _retry_policy
+    _breaker_registry = CircuitBreakerRegistry(
+        failure_threshold=getattr(args, "breaker_failure_threshold", 5),
+        recovery_time=getattr(args, "breaker_recovery_time", 10.0),
+        half_open_probes=getattr(args, "breaker_half_open_probes", 1),
+    )
+    _admission_controller = AdmissionController(
+        rate=getattr(args, "admission_rate", 0.0),
+        burst=getattr(args, "admission_burst", 0),
+        max_queue=getattr(args, "admission_queue_size", 128),
+        queue_timeout=getattr(args, "admission_queue_timeout", 5.0),
+    )
+    _retry_policy = RetryPolicy(
+        max_attempts=getattr(args, "proxy_retries", 2) + 1,
+        backoff_base=getattr(args, "retry_backoff", 0.1),
+        connect_timeout=getattr(args, "proxy_connect_timeout", 30.0),
+        read_timeout=getattr(args, "proxy_read_timeout", 0.0),
+    )
+
+
+def get_breaker_registry() -> Optional[CircuitBreakerRegistry]:
+    return _breaker_registry
+
+
+def get_admission_controller() -> Optional[AdmissionController]:
+    return _admission_controller
+
+
+def get_retry_policy() -> Optional[RetryPolicy]:
+    return _retry_policy
+
+
+def teardown_resilience() -> None:
+    global _breaker_registry, _admission_controller, _retry_policy
+    if _admission_controller is not None:
+        _admission_controller.close()
+    _breaker_registry = None
+    _admission_controller = None
+    _retry_policy = None
+
+
+__all__ = [
+    "AdmissionController",
+    "BreakerState",
+    "CircuitBreaker",
+    "CircuitBreakerRegistry",
+    "RetryPolicy",
+    "initialize_resilience",
+    "get_breaker_registry",
+    "get_admission_controller",
+    "get_retry_policy",
+    "teardown_resilience",
+]

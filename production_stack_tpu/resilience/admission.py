@@ -1,0 +1,203 @@
+"""Admission control: token-bucket rate limiting + bounded priority queue
+with deadline-based load shedding.
+
+Sits ahead of ``route_general_request`` (wired as an aiohttp middleware in
+the router app). Semantics:
+
+- ``rate`` requests/second refill a bucket of ``burst`` capacity. A request
+  that finds a token is admitted immediately.
+- Without a token, the request waits in a bounded priority queue (priority
+  from the ``X-Request-Priority`` header, higher served first; FIFO within
+  a priority level). A dispatcher task grants tokens to waiters as they
+  refill.
+- Shedding is deadline-based: a request is rejected with 429 +
+  ``Retry-After`` when the queue is full, when the bucket cannot possibly
+  produce its token within ``queue_timeout`` (no point parking it), or
+  when its wait actually exceeds ``queue_timeout``.
+
+``rate <= 0`` disables rate limiting entirely (every request admitted).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import math
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..logging_utils import init_logger
+from . import metrics
+
+logger = init_logger(__name__)
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: int):
+        self.rate = rate
+        self.capacity = max(1, burst)
+        self.tokens = float(self.capacity)
+        # Anchored on first use so callers may drive the bucket on any
+        # monotonic timebase (tests pass synthetic timestamps).
+        self.last_refill: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self.last_refill is None:
+            self.last_refill = now
+        if now > self.last_refill:
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self.last_refill) * self.rate
+            )
+            self.last_refill = now
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def time_until_tokens(self, n: float, now: Optional[float] = None) -> float:
+        """Seconds until ``n`` tokens are available (0 if already there)."""
+        now = now if now is not None else time.time()
+        self._refill(now)
+        if self.tokens >= n:
+            return 0.0
+        return (n - self.tokens) / self.rate
+
+
+@dataclass
+class AdmissionDecision:
+    admitted: bool
+    reason: str = ""  # queue_full | deadline | timeout
+    retry_after: float = 0.0
+
+    @property
+    def retry_after_header(self) -> str:
+        return str(max(1, math.ceil(self.retry_after)))
+
+
+_ADMIT = AdmissionDecision(admitted=True)
+
+
+@dataclass(order=True)
+class _Waiter:
+    sort_key: Tuple[float, int]
+    future: asyncio.Future = field(compare=False)
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        rate: float = 0.0,
+        burst: int = 0,
+        max_queue: int = 128,
+        queue_timeout: float = 5.0,
+    ):
+        self.rate = rate
+        self.enabled = rate > 0
+        self.max_queue = max(0, max_queue)
+        self.queue_timeout = queue_timeout
+        self.bucket = TokenBucket(rate, burst or math.ceil(rate)) if self.enabled else None
+        self._heap: List[_Waiter] = []
+        self._seq = 0
+        self._dispatcher: Optional[asyncio.Task] = None
+        self._wakeup: Optional[asyncio.Event] = None
+
+    # -- internals --------------------------------------------------------
+
+    def queue_len(self) -> int:
+        return sum(1 for w in self._heap if not w.future.done())
+
+    def _waiters_ahead(self, priority: int) -> int:
+        """Waiters the dispatcher would serve before a new request at
+        ``priority``: strictly higher priorities, plus equal priorities
+        already queued (FIFO within a level)."""
+        return sum(
+            1
+            for w in self._heap
+            if not w.future.done() and w.sort_key[0] <= -priority
+        )
+
+    def _ensure_dispatcher(self) -> None:
+        if self._dispatcher is None or self._dispatcher.done():
+            self._wakeup = asyncio.Event()
+            self._dispatcher = asyncio.get_running_loop().create_task(
+                self._dispatch_loop()
+            )
+
+    async def _dispatch_loop(self) -> None:
+        """Grant refilled tokens to waiters, highest priority first."""
+        while True:
+            while not self._heap:
+                self._wakeup.clear()
+                await self._wakeup.wait()
+            delay = self.bucket.time_until_tokens(1.0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            while self._heap and self._heap[0].future.done():
+                heapq.heappop(self._heap)  # timed out / cancelled waiters
+            if not self._heap:
+                continue
+            if self.bucket.try_acquire():
+                waiter = heapq.heappop(self._heap)
+                if not waiter.future.done():  # may have timed out just now
+                    waiter.future.set_result(True)
+                metrics.queue_depth.set(self.queue_len())
+
+    # -- public API -------------------------------------------------------
+
+    async def admit(self, priority: int = 0) -> AdmissionDecision:
+        """Admit, queue, or shed one request. Priority: higher served first."""
+        if not self.enabled:
+            metrics.admitted_total.inc()
+            return _ADMIT
+        now = time.time()
+        if not self._heap and self.bucket.try_acquire(now):
+            metrics.admitted_total.inc()
+            return _ADMIT
+        queue_len = self.queue_len()
+        if queue_len >= self.max_queue:
+            return self._shed(
+                "queue_full", self.bucket.time_until_tokens(queue_len + 1, now)
+            )
+        # Deadline check up front: if the bucket cannot produce this
+        # request's token before the deadline even in the best case, shed
+        # now instead of parking doomed work in the queue. Only waiters the
+        # dispatcher would serve first count toward the estimate — a
+        # high-priority request must not be shed because the queue is full
+        # of low-priority work it would jump.
+        est = self.bucket.time_until_tokens(self._waiters_ahead(priority) + 1, now)
+        if est > self.queue_timeout:
+            return self._shed("deadline", est)
+        self._ensure_dispatcher()
+        self._seq += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        waiter = _Waiter(sort_key=(-priority, self._seq), future=fut)
+        heapq.heappush(self._heap, waiter)
+        metrics.queue_depth.set(self.queue_len())
+        self._wakeup.set()
+        try:
+            await asyncio.wait_for(fut, timeout=self.queue_timeout)
+        except asyncio.TimeoutError:
+            metrics.queue_depth.set(self.queue_len())
+            return self._shed("timeout", self.bucket.time_until_tokens(1.0))
+        metrics.admitted_total.inc()
+        return _ADMIT
+
+    def _shed(self, reason: str, retry_after: float) -> AdmissionDecision:
+        metrics.sheds_total.labels(reason=reason).inc()
+        return AdmissionDecision(
+            admitted=False, reason=reason, retry_after=max(retry_after, 0.001)
+        )
+
+    def close(self) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.cancel()
+            self._dispatcher = None
+        for w in self._heap:
+            if not w.future.done():
+                w.future.cancel()
+        self._heap.clear()

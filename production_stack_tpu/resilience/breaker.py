@@ -1,0 +1,238 @@
+"""Per-backend circuit breakers, keyed by engine URL.
+
+Classic three-state breaker (Nygard; the Envoy outlier-detection role in
+the reference deployment):
+
+- CLOSED: requests flow; ``failure_threshold`` consecutive failures trip
+  the breaker OPEN.
+- OPEN: the engine is not offered to routing. After ``recovery_time``
+  seconds the breaker transitions to HALF_OPEN.
+- HALF_OPEN: up to ``half_open_probes`` live requests are let through as
+  probes. One success closes the breaker; one failure re-opens it (and
+  restarts the recovery clock).
+
+Fed from two directions: the proxy layer reports per-request outcomes
+(connect errors / 5xx = failure, any streamed response = success) and the
+service-discovery health loop reports probe outcomes. Both go through
+``record_success`` / ``record_failure`` so the state machine has a single
+writer surface. All methods are synchronous and loop-safe (no awaits, no
+locks needed under asyncio's single-threaded execution).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import time
+from typing import Dict, List, Optional
+
+from ..logging_utils import init_logger
+from . import metrics
+
+logger = init_logger(__name__)
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+# Gauge encoding for pst_resilience_breaker_state (dashboards map these).
+STATE_VALUE = {
+    BreakerState.CLOSED: 0,
+    BreakerState.HALF_OPEN: 1,
+    BreakerState.OPEN: 2,
+}
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        url: str,
+        failure_threshold: int = 5,
+        recovery_time: float = 10.0,
+        half_open_probes: int = 1,
+    ):
+        self.url = url
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_time = recovery_time
+        self.half_open_probes = max(1, half_open_probes)
+        self.state = BreakerState.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        # HALF_OPEN probe reservations (timestamps). Entries expire after
+        # recovery_time so an allows() answer that never became a request
+        # (routing filtered this engine out) cannot wedge the breaker.
+        self._probes: List[float] = []
+
+    def _transition(self, state: BreakerState, now: float) -> None:
+        if state is self.state:
+            return
+        logger.info(
+            "breaker %s: %s -> %s", self.url, self.state.value, state.value
+        )
+        self.state = state
+        metrics.breaker_transitions_total.labels(
+            server=self.url, state=state.value
+        ).inc()
+        metrics.breaker_state.labels(server=self.url).set(STATE_VALUE[state])
+        if state is BreakerState.OPEN:
+            self.opened_at = now
+            self._probes.clear()
+        elif state is BreakerState.CLOSED:
+            self.consecutive_failures = 0
+            self.opened_at = None
+            self._probes.clear()
+
+    def _maybe_half_open(self, now: float) -> None:
+        if (
+            self.state is BreakerState.OPEN
+            and self.opened_at is not None
+            and now - self.opened_at >= self.recovery_time
+        ):
+            self._transition(BreakerState.HALF_OPEN, now)
+
+    def current_state(self, now: Optional[float] = None) -> BreakerState:
+        """Effective state (advances OPEN → HALF_OPEN when the recovery
+        window has elapsed) WITHOUT reserving a probe slot — safe for
+        observability readers."""
+        self._maybe_half_open(now if now is not None else time.time())
+        return self.state
+
+    def _free_probe_slot(self, now: float) -> bool:
+        ttl = max(self.recovery_time, 1.0)
+        self._probes = [t for t in self._probes if now - t < ttl]
+        return len(self._probes) < self.half_open_probes
+
+    def would_allow(self, now: Optional[float] = None) -> bool:
+        """State check WITHOUT reserving a probe slot — what routing uses
+        to build the candidate list. Only ``allows()`` on the engine that
+        routing actually picked consumes a slot."""
+        now = now if now is not None else time.time()
+        self._maybe_half_open(now)
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.HALF_OPEN:
+            return self._free_probe_slot(now)
+        return False
+
+    def allows(self, now: Optional[float] = None) -> bool:
+        """May a request be sent to this engine right now?
+
+        In HALF_OPEN, each ``allows() == True`` answer reserves one probe
+        slot; the slot is released by the matching record_success/failure
+        (and self-expires, so a reservation that never became a request
+        cannot wedge the breaker).
+        """
+        now = now if now is not None else time.time()
+        self._maybe_half_open(now)
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.HALF_OPEN:
+            if self._free_probe_slot(now):
+                self._probes.append(now)
+                return True
+            return False
+        return False
+
+    def record_success(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        self.consecutive_failures = 0
+        if self.state is BreakerState.HALF_OPEN:
+            self._transition(BreakerState.CLOSED, now)
+        elif self.state is BreakerState.OPEN:
+            # A success while OPEN (e.g. a health probe racing the trip):
+            # the engine answered, so recover directly.
+            self._transition(BreakerState.CLOSED, now)
+
+    def record_failure(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        self._maybe_half_open(now)
+        if self.state is BreakerState.HALF_OPEN:
+            self._transition(BreakerState.OPEN, now)
+            return
+        self.consecutive_failures += 1
+        if (
+            self.state is BreakerState.CLOSED
+            and self.consecutive_failures >= self.failure_threshold
+        ):
+            self._transition(BreakerState.OPEN, now)
+
+
+class CircuitBreakerRegistry:
+    """One breaker per engine URL, created on first sighting."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        recovery_time: float = 10.0,
+        half_open_probes: int = 1,
+    ):
+        self.failure_threshold = failure_threshold
+        self.recovery_time = recovery_time
+        self.half_open_probes = half_open_probes
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def get(self, url: str) -> CircuitBreaker:
+        b = self._breakers.get(url)
+        if b is None:
+            b = CircuitBreaker(
+                url,
+                failure_threshold=self.failure_threshold,
+                recovery_time=self.recovery_time,
+                half_open_probes=self.half_open_probes,
+            )
+            self._breakers[url] = b
+            metrics.breaker_state.labels(server=url).set(
+                STATE_VALUE[BreakerState.CLOSED]
+            )
+        return b
+
+    def allows(self, url: str, now: Optional[float] = None) -> bool:
+        return self.get(url).allows(now)
+
+    def state(self, url: str) -> BreakerState:
+        return self.get(url).current_state()
+
+    def record_success(self, url: str, now: Optional[float] = None) -> None:
+        self.get(url).record_success(now)
+
+    def record_failure(self, url: str, now: Optional[float] = None) -> None:
+        self.get(url).record_failure(now)
+
+    def would_allow(self, url: str, now: Optional[float] = None) -> bool:
+        return self.get(url).would_allow(now)
+
+    def filter_available(
+        self, urls: List[str], now: Optional[float] = None
+    ) -> List[str]:
+        """URLs whose breakers admit traffic right now (side-effect-free).
+
+        Fails open: if EVERY candidate's breaker refuses, return the full
+        list — an all-dead fleet should surface real upstream errors (and
+        give a recovered-but-not-yet-probed engine a chance), not turn the
+        router into a permanent 503 wall.
+        """
+        allowed = [u for u in urls if self.would_allow(u, now)]
+        return allowed or list(urls)
+
+    def snapshot(self) -> Dict[str, str]:
+        return {u: b.state.value for u, b in self._breakers.items()}
+
+    def evict(self, url: str) -> None:
+        """Drop the breaker and its per-server metric series for an engine
+        that left the fleet (pod deleted / service removed). Without this,
+        pod churn grows the registry and Prometheus label cardinality
+        without bound."""
+        if self._breakers.pop(url, None) is None:
+            return
+        with contextlib.suppress(KeyError):
+            metrics.breaker_state.remove(url)
+        for state in BreakerState:
+            with contextlib.suppress(KeyError):
+                metrics.breaker_transitions_total.remove(url, state.value)
+        with contextlib.suppress(KeyError):
+            metrics.retries_total.remove(url)
+        with contextlib.suppress(KeyError):
+            metrics.upstream_failures_total.remove(url)

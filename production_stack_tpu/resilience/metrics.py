@@ -1,0 +1,51 @@
+"""``pst_resilience_*`` Prometheus surface (default registry, like
+:mod:`..router.services.metrics_service`).
+
+Counters increment at event sites (breaker transitions, retries, sheds);
+gauges are refreshed by the router's ``/metrics`` handler from live state.
+"""
+
+from prometheus_client import Counter, Gauge
+
+breaker_state = Gauge(
+    "pst_resilience_breaker_state",
+    "Circuit breaker state per engine (0=closed, 1=half-open, 2=open)",
+    ["server"],
+)
+breaker_transitions_total = Counter(
+    "pst_resilience_breaker_transitions_total",
+    "Circuit breaker state transitions",
+    ["server", "state"],
+)
+retries_total = Counter(
+    "pst_resilience_retries_total",
+    "Proxy attempts retried against the same or another engine",
+    ["server"],
+)
+failovers_total = Counter(
+    "pst_resilience_failovers_total",
+    "Requests re-routed to a different engine after a failure",
+)
+upstream_failures_total = Counter(
+    "pst_resilience_upstream_failures_total",
+    "Upstream request failures observed by the proxy (connect error / 5xx)",
+    ["server"],
+)
+admitted_total = Counter(
+    "pst_resilience_admitted_total", "Requests admitted by admission control"
+)
+sheds_total = Counter(
+    "pst_resilience_sheds_total",
+    "Requests shed by admission control (429)",
+    ["reason"],
+)
+queue_depth = Gauge(
+    "pst_resilience_queue_depth", "Requests waiting in the admission queue"
+)
+client_disconnects_total = Counter(
+    "pst_resilience_client_disconnects_total",
+    "Client disconnects propagated as upstream aborts",
+)
+draining_engines = Gauge(
+    "pst_resilience_draining_engines", "Engines currently draining"
+)

@@ -1,0 +1,44 @@
+"""Retry/failover policy for the proxy hot path.
+
+Only decides *whether* and *when* to try again; *where* stays with the
+routing logic (the proxy re-routes among the remaining healthy candidates
+on each attempt). The hard safety rule lives with the caller: never retry
+after the first upstream byte has been streamed to the client.
+"""
+
+from __future__ import annotations
+
+
+class RetryPolicy:
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        backoff_base: float = 0.1,
+        connect_timeout: float = 30.0,
+        read_timeout: float = 0.0,
+    ):
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_base = backoff_base
+        # Per-attempt upstream timeouts. A connect timeout is always safe
+        # (TCP handshake only) and turns a black-holed backend into a
+        # retryable failure. The read timeout bounds the gap between
+        # socket reads — it catches an engine that accepted the request
+        # and went silent, but would also abort a legitimately quiet
+        # non-streamed long generation, so it defaults to off (0).
+        self.connect_timeout = connect_timeout
+        self.read_timeout = read_timeout
+
+    def should_retry(self, attempt: int) -> bool:
+        """``attempt`` is 0-based: attempt 0 is the first try."""
+        return attempt + 1 < self.max_attempts
+
+    def backoff(self, attempt: int) -> float:
+        """Exponential backoff before attempt ``attempt + 1``."""
+        return self.backoff_base * (2**attempt)
+
+    @staticmethod
+    def is_retryable_status(status: int) -> bool:
+        """5xx before any byte reached the client = safe to re-route (the
+        request never started executing a visible response). 4xx are the
+        client's problem and must pass through."""
+        return status >= 500

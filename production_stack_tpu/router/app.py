@@ -16,6 +16,11 @@ import aiohttp
 from aiohttp import web
 
 from ..logging_utils import init_logger
+from ..resilience import (
+    get_admission_controller,
+    initialize_resilience,
+    teardown_resilience,
+)
 from ..utils import parse_comma_separated, set_ulimit
 from .parser import parse_args
 from .routes import routes
@@ -81,10 +86,63 @@ async def _log_stats_loop(app: web.Application, interval: float) -> None:
             logger.error("log_stats loop error: %s", e)
 
 
+# Endpoints admission control protects (everything that fans into
+# route_general_request — i.e. work an engine would have to execute).
+_ADMISSION_PATHS = {
+    "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
+    "/v1/rerank", "/rerank", "/v1/score", "/score",
+    "/tokenize", "/detokenize",
+}
+
+
+@web.middleware
+async def admission_middleware(request: web.Request, handler):
+    """Token-bucket + bounded-priority-queue admission ahead of routing.
+
+    Over-limit traffic is shed with 429 + ``Retry-After`` (deadline-based:
+    a request that cannot get a token before its queue timeout is rejected
+    immediately instead of parking).
+    """
+    if request.method == "POST" and request.path in _ADMISSION_PATHS:
+        controller = get_admission_controller()
+        if controller is not None and controller.enabled:
+            try:
+                priority = int(request.headers.get("X-Request-Priority", "0"))
+            except ValueError:
+                priority = 0
+            decision = await controller.admit(priority)
+            if not decision.admitted:
+                return web.json_response(
+                    {
+                        "error": {
+                            "message": (
+                                f"request shed by admission control "
+                                f"({decision.reason}); retry after "
+                                f"{decision.retry_after_header}s"
+                            ),
+                            "type": "rate_limit_exceeded",
+                            "code": 429,
+                        }
+                    },
+                    status=429,
+                    headers={"Retry-After": decision.retry_after_header},
+                )
+    return await handler(request)
+
+
+# Mutating admin endpoints: without auth these let any client drain the
+# whole fleet (or sleep it), so when an api key is configured they are
+# guarded like /v1. Read-only probes (/is_draining, /is_sleeping,
+# /engines) stay open, same as /health and /metrics.
+_GUARDED_ADMIN_PATHS = {"/drain", "/undrain", "/sleep", "/wake_up"}
+
+
 @web.middleware
 async def api_key_middleware(request: web.Request, handler):
     required = request.app.get("api_key")
-    if required and request.path.startswith("/v1"):
+    if required and (
+        request.path.startswith("/v1") or request.path in _GUARDED_ADMIN_PATHS
+    ):
         auth = request.headers.get("Authorization", "")
         if auth != f"Bearer {required}":
             return web.json_response(
@@ -106,6 +164,7 @@ def initialize_all(app: web.Application, args) -> None:
             model_labels=parse_comma_separated(args.static_model_labels) or None,
             model_types=parse_comma_separated(args.static_model_types) or None,
             static_backend_health_checks=args.static_backend_health_checks,
+            health_check_interval=args.health_check_interval,
             prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
             decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
         )
@@ -132,6 +191,7 @@ def initialize_all(app: web.Application, args) -> None:
         prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
         decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
     )
+    initialize_resilience(args)
     initialize_request_rewriter(args.request_rewriter)
     configure_custom_callbacks(args.callbacks)
     initialize_feature_gates(args.feature_gates)
@@ -167,7 +227,10 @@ def create_app(args) -> web.Application:
     )
     init_otel("pst-router")
 
-    app = web.Application(middlewares=[api_key_middleware], client_max_size=64 * 2**20)
+    app = web.Application(
+        middlewares=[api_key_middleware, admission_middleware],
+        client_max_size=64 * 2**20,
+    )
     initialize_all(app, args)
     app.add_routes(routes)
 
@@ -214,6 +277,7 @@ def create_app(args) -> web.Application:
         except ValueError:
             pass
         teardown_routing_logic()
+        teardown_resilience()
         for key in ("client_session", "prefill_client", "decode_client"):
             session = app.get(key)
             if session is not None:

@@ -58,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--static-model-labels", help="comma-separated labels (one per backend)")
     p.add_argument("--static-model-types", help="comma-separated model types (chat|completion|embeddings|rerank|score)")
     p.add_argument("--static-backend-health-checks", action="store_true")
+    p.add_argument("--health-check-interval", type=float, default=60.0,
+                   help="seconds between static-backend health/drain probes")
     p.add_argument("--k8s-namespace", default="default")
     p.add_argument("--k8s-port", type=int, default=8000)
     p.add_argument("--k8s-label-selector", default=None)
@@ -74,6 +76,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tokenizer-name", default=None, help="tokenizer for kvaware prefix hashing (defaults to request model)")
     p.add_argument("--prefill-model-labels", default=None)
     p.add_argument("--decode-model-labels", default=None)
+
+    # Resilience (circuit breakers, retry/failover, admission control)
+    p.add_argument("--admission-rate", type=float, default=0.0,
+                   help="token-bucket refill rate in requests/sec (0 = unlimited)")
+    p.add_argument("--admission-burst", type=int, default=0,
+                   help="token-bucket capacity (0 = derive from rate)")
+    p.add_argument("--admission-queue-size", type=int, default=128,
+                   help="bounded admission queue length before 429 shedding")
+    p.add_argument("--admission-queue-timeout", type=float, default=5.0,
+                   help="max seconds a request may wait for admission")
+    p.add_argument("--proxy-retries", type=int, default=2,
+                   help="failover attempts after the first (0 = no retry)")
+    p.add_argument("--retry-backoff", type=float, default=0.05,
+                   help="base backoff seconds between proxy attempts (doubles)")
+    p.add_argument("--proxy-connect-timeout", type=float, default=30.0,
+                   help="seconds to wait for an upstream TCP connect "
+                        "(0 = unlimited); connect failures retry/fail over")
+    p.add_argument("--proxy-read-timeout", type=float, default=0.0,
+                   help="max seconds between upstream socket reads "
+                        "(0 = unlimited, the default — a quiet non-streamed "
+                        "long generation is indistinguishable from a hung "
+                        "engine, so only enable this when streaming)")
+    p.add_argument("--breaker-failure-threshold", type=int, default=3,
+                   help="consecutive failures before a backend breaker opens")
+    p.add_argument("--breaker-recovery-time", type=float, default=10.0,
+                   help="seconds an open breaker waits before half-open probing")
+    p.add_argument("--breaker-half-open-probes", type=int, default=1,
+                   help="concurrent live probes allowed while half-open")
 
     # Stats / metrics
     p.add_argument("--engine-stats-interval", type=float, default=15.0)
@@ -148,6 +178,12 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError(
                 "--static-backend-health-checks requires --static-model-types"
             )
+    if args.admission_rate < 0:
+        raise ValueError("--admission-rate must be >= 0")
+    if args.proxy_retries < 0:
+        raise ValueError("--proxy-retries must be >= 0")
+    if args.breaker_failure_threshold < 1:
+        raise ValueError("--breaker-failure-threshold must be >= 1")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "disaggregated_prefill":

@@ -16,9 +16,13 @@ from prometheus_client import generate_latest
 
 from .. import __version__
 from ..logging_utils import init_logger
+from ..resilience import get_admission_controller, get_breaker_registry
+from ..resilience import metrics as res_gauges
+from ..resilience.breaker import STATE_VALUE
 from .service_discovery import get_service_discovery
 from .services import metrics_service as gauges
 from .services.request_service import (
+    route_drain_request,
     route_general_request,
     route_sleep_wakeup_request,
 )
@@ -143,6 +147,7 @@ async def engines(request: web.Request) -> web.Response:
     """Current engine pool with live engine- and request-level stats."""
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    registry = get_breaker_registry()
     out = []
     for ep in get_service_discovery().get_endpoint_info():
         es = engine_stats.get(ep.url)
@@ -154,6 +159,8 @@ async def engines(request: web.Request) -> web.Response:
                 "models": ep.model_names,
                 "model_label": ep.model_label,
                 "sleep": ep.sleep,
+                "draining": ep.draining,
+                "breaker": registry.state(ep.url).value if registry else None,
                 "pod_name": ep.pod_name,
                 "namespace": ep.namespace,
                 "engine_stats": es.__dict__ if es else None,
@@ -201,6 +208,21 @@ async def metrics(request: web.Request) -> web.Response:
             gauges.avg_itl.labels(server=url).set(rs.avg_itl)
             gauges.num_requests_swapped.labels(server=url).set(rs.num_swapped_requests)
         gauges.healthy_pods_total.labels(server=url).set(1)
+    # Resilience gauges: breaker states refresh here (covers engines whose
+    # breaker transitioned while unscraped and half-open timers elapsing
+    # between requests); queue depth + shed counters update at event sites.
+    registry = get_breaker_registry()
+    if registry is not None:
+        for ep in endpoints:
+            res_gauges.breaker_state.labels(server=ep.url).set(
+                STATE_VALUE[registry.state(ep.url)]
+            )
+    controller = get_admission_controller()
+    if controller is not None and controller.enabled:
+        res_gauges.queue_depth.set(controller.queue_len())
+    res_gauges.draining_engines.set(
+        sum(1 for ep in endpoints if ep.draining)
+    )
     # Router-process resource usage.
     proc = psutil.Process()
     gauges.router_cpu_percent.set(proc.cpu_percent())
@@ -222,3 +244,19 @@ async def wake_up(request: web.Request) -> web.Response:
 @routes.get("/is_sleeping")
 async def is_sleeping(request: web.Request) -> web.Response:
     return await route_sleep_wakeup_request(request, "is_sleeping")
+
+
+@routes.post("/drain")
+async def drain(request: web.Request) -> web.Response:
+    """Fan graceful drain out to engines (by ?model= label or ?url=)."""
+    return await route_drain_request(request, "drain")
+
+
+@routes.post("/undrain")
+async def undrain(request: web.Request) -> web.Response:
+    return await route_drain_request(request, "undrain")
+
+
+@routes.get("/is_draining")
+async def is_draining(request: web.Request) -> web.Response:
+    return await route_drain_request(request, "is_draining")

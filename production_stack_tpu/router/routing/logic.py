@@ -112,6 +112,88 @@ class ConsistentHashRing:
         return self._ring[idx][1]
 
 
+def apply_breaker_filter(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
+    """Drop engines whose circuit breaker is refusing traffic.
+
+    Fails open (registry semantics): when every candidate is refused, all
+    of them come back rather than none, so a fleet-wide brownout surfaces
+    upstream errors instead of a permanent router-side 503."""
+    from ...resilience import get_breaker_registry
+
+    registry = get_breaker_registry()
+    if registry is None or not endpoints:
+        return endpoints
+    by_url = {e.url: e for e in endpoints}
+    allowed = registry.filter_available(list(by_url))
+    return [by_url[u] for u in allowed]
+
+
+def filter_routable(
+    endpoints: List[EndpointInfo],
+    exclude: Optional[set] = None,
+    apply_breakers: bool = True,
+) -> List[EndpointInfo]:
+    """Drop endpoints routing must not pick right now: explicitly excluded
+    URLs (already tried this request), draining engines, and engines whose
+    circuit breaker is refusing traffic.
+
+    The breaker filter fails open (see ``apply_breaker_filter``); explicit
+    excludes and draining stay hard filters. ``apply_breakers=False`` skips
+    the breaker pass for routers that scope it per pool themselves (disagg
+    P/D) — filtering the merged list would defeat fail-open for a pool
+    that is entirely refused while the other pool keeps the list non-empty.
+    """
+    if exclude:
+        endpoints = [e for e in endpoints if e.url not in exclude]
+    endpoints = [e for e in endpoints if not getattr(e, "draining", False)]
+    if not apply_breakers:
+        return endpoints
+    return apply_breaker_filter(endpoints)
+
+
+async def route_with_resilience(
+    router: "RoutingInterface",
+    endpoints: List[EndpointInfo],
+    engine_stats: Dict[str, Any],
+    request_stats: Dict[str, Any],
+    headers: Dict[str, str],
+    request_json: Optional[Dict[str, Any]] = None,
+    exclude: Optional[set] = None,
+) -> str:
+    """The proxy's single entry into routing: consult circuit breakers and
+    drain state before the policy picks an engine.
+
+    The candidate filter is side-effect-free (``would_allow``); the probe
+    slot of a half-open breaker is reserved only for the engine the policy
+    actually picked (``allows``). If that slot was raced away, one
+    alternative pick is made among the other candidates; if everything
+    refuses (fleet-wide brownout) the original pick goes out anyway —
+    fail open, same rationale as ``filter_available``.
+    """
+    from ...resilience import get_breaker_registry
+
+    candidates = filter_routable(
+        endpoints, exclude,
+        apply_breakers=not getattr(router, "pool_scoped_breakers", False),
+    )
+    if not candidates:
+        raise ValueError("no routable endpoints (all excluded or draining)")
+    url = await router.route_request(
+        candidates, engine_stats, request_stats, headers, request_json
+    )
+    registry = get_breaker_registry()
+    if registry is None or registry.allows(url):
+        return url
+    others = [e for e in candidates if e.url != url]
+    if others:
+        alt = await router.route_request(
+            others, engine_stats, request_stats, headers, request_json
+        )
+        if registry.allows(alt):
+            return alt
+    return url
+
+
 class RoutingInterface(ABC, metaclass=SingletonABCMeta):
     @abstractmethod
     async def route_request(
@@ -307,6 +389,12 @@ class PrefixAwareRouter(RoutingInterface):
 class DisaggregatedPrefillRouter(RoutingInterface):
     """Split prefill and decode across disjoint engine pools by model label."""
 
+    # Breaker filtering must happen after the label split, one pool at a
+    # time: fail-open on the merged list would let healthy decode engines
+    # mask an entirely-refused prefill pool (route_with_resilience skips
+    # its own breaker pass when this is set).
+    pool_scoped_breakers = True
+
     def __init__(
         self,
         prefill_model_labels: Optional[List[str]] = None,
@@ -330,11 +418,11 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         is_prefill = request_json.get("max_tokens", 0) == 1
         if is_prefill:
             pool = [e for e in endpoints if e.model_label in self.prefill_model_labels]
-            url = self._pick(pool, self._prefill_rr)
+            url = self._pick(apply_breaker_filter(pool), self._prefill_rr)
             self._prefill_rr += 1
         else:
             pool = [e for e in endpoints if e.model_label in self.decode_model_labels]
-            url = self._pick(pool, self._decode_rr)
+            url = self._pick(apply_breaker_filter(pool), self._decode_rr)
             self._decode_rr += 1
         return url
 

@@ -75,6 +75,10 @@ class EndpointInfo:
     added_timestamp: float
     model_label: str
     sleep: bool = False
+    # Graceful drain: the engine finishes in-flight sequences but accepts
+    # no new ones — routing must treat it as unroutable (resilience
+    # subsystem; no reference counterpart).
+    draining: bool = False
     pod_name: Optional[str] = None
     service_name: Optional[str] = None
     namespace: Optional[str] = None
@@ -107,6 +111,13 @@ class ServiceDiscovery(ABC):
 
     def get_health(self) -> bool:
         return True
+
+    def set_draining(self, url: str, draining: bool) -> None:
+        """Mark/unmark an endpoint as draining immediately.
+
+        Router-initiated drain (the /drain fan-out) calls this so routing
+        reacts at once; the periodic probes / watch events still reconcile
+        drains initiated directly against an engine."""
 
     async def start(self) -> None:
         """Begin background watch/health tasks (called from app startup)."""
@@ -174,6 +185,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.prefill_model_labels = prefill_model_labels
         self.decode_model_labels = decode_model_labels
         self._unhealthy: set = set()
+        self._draining: set = set()  # urls reporting is_draining
         self._task: Optional[asyncio.Task] = None
 
     @staticmethod
@@ -193,6 +205,37 @@ class StaticServiceDiscovery(ServiceDiscovery):
             logger.debug("health probe failed for %s (%s): %s", url, model, e)
             return False
 
+    async def _probe_draining(
+        self, session: aiohttp.ClientSession, url: str
+    ) -> Optional[bool]:
+        """None means the probe itself failed (timeout / connect error) —
+        the caller keeps the last-known drain state rather than clearing a
+        router-initiated drain on a transient blip."""
+        try:
+            async with session.get(
+                url + "/is_draining", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                if resp.status == 200:
+                    return bool((await resp.json()).get("is_draining", False))
+                return False  # endpoint absent = not draining
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _feed_breaker(url: str, ok: bool) -> None:
+        """Health probe outcomes feed the per-backend circuit breakers, so
+        an engine that dies between requests trips its breaker (and a
+        recovered one closes it) without waiting for live traffic."""
+        from ..resilience import get_breaker_registry
+
+        registry = get_breaker_registry()
+        if registry is None:
+            return
+        if ok:
+            registry.record_success(url)
+        else:
+            registry.record_failure(url)
+
     async def _health_loop(self) -> None:
         if not self.model_types or len(self.model_types) != len(self.urls):
             logger.error(
@@ -200,20 +243,79 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 "backend; skipping health checking"
             )
             return
+        logger.info(
+            "static health loop started: %d backends, every %.1fs",
+            len(self.urls), self.health_check_interval,
+        )
+        async def check_backend(session, url, model, mtype) -> Optional[str]:
+            """One backend's probe pass; returns its endpoint hash when
+            unhealthy. _draining is mutated per URL (never
+            snapshot-replaced): set_draining() may mark an engine
+            mid-cycle, and an end-of-cycle overwrite would erase that mark
+            until the next probe — up to a full interval of traffic to a
+            draining engine."""
+            drain_state = await self._probe_draining(session, url)
+            if drain_state is True:
+                self._draining.add(url)
+            elif drain_state is False:
+                self._draining.discard(url)
+            # None: probe failed — keep last-known drain state.
+            if url in self._draining:
+                # Draining is deliberate, not a failure: the endpoint is
+                # unroutable but its breaker is left alone.
+                return None
+            ok = await self._probe(session, url, model, mtype)
+            self._feed_breaker(url, ok)
+            if not ok:
+                logger.warning("%s at %s failed health check", model, url)
+                return self._endpoint_hash(url, model)
+            return None
+
         async with aiohttp.ClientSession() as session:
             while True:
-                unhealthy = set()
-                for url, model, mtype in zip(self.urls, self.models, self.model_types):
-                    ok = await self._probe(session, url, model, mtype)
-                    if not ok:
-                        logger.warning("%s at %s failed health check", model, url)
-                        unhealthy.add(self._endpoint_hash(url, model))
-                self._unhealthy = unhealthy
+                try:
+                    # Concurrent per backend: serial probes would let one
+                    # black-holed engine (15s of timeouts) stall detection
+                    # for every other backend in the cycle.
+                    results = await asyncio.gather(*(
+                        check_backend(session, url, model, mtype)
+                        for url, model, mtype in zip(
+                            self.urls, self.models, self.model_types
+                        )
+                    ))
+                    self._unhealthy = {h for h in results if h is not None}
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — one bad cycle must
+                    # not silently kill health checking for good.
+                    logger.error("health loop cycle failed: %s", e)
                 await asyncio.sleep(self.health_check_interval)
 
+    async def _drain_reconcile_loop(self) -> None:
+        """Runs only when the full health loop is off: re-probe engines the
+        router has marked draining (via the /drain fan-out or a tagged
+        drain 503) so one that undrains or restarts behind the router's
+        back becomes routable again without an operator /undrain. Only
+        marked engines are probed — the loop is idle while nothing drains."""
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await asyncio.sleep(self.health_check_interval)
+                try:
+                    for url in list(self._draining):
+                        if await self._probe_draining(session, url) is False:
+                            logger.info("engine %s no longer draining", url)
+                            self._draining.discard(url)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — keep reconciling
+                    logger.error("drain reconcile cycle failed: %s", e)
+
     async def start(self) -> None:
-        if self.enable_health_checks and self._task is None:
-            self._task = asyncio.create_task(self._health_loop())
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._health_loop() if self.enable_health_checks
+                else self._drain_reconcile_loop()
+            )
         await self.initialize_client_sessions(
             self.prefill_model_labels, self.decode_model_labels
         )
@@ -222,6 +324,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
         if self._task is not None:
             self._task.cancel()
             self._task = None
+
+    def set_draining(self, url: str, draining: bool) -> None:
+        if draining:
+            self._draining.add(url)
+        else:
+            self._draining.discard(url)
 
     def get_endpoint_info(self) -> List[EndpointInfo]:
         infos = []
@@ -237,6 +345,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     added_timestamp=self.added_timestamp,
                     model_label=label,
                     sleep=False,
+                    draining=url in self._draining,
                     model_info={model: ModelInfo(id=model)},
                 )
             )
@@ -272,8 +381,32 @@ class _K8sWatcherBase(ServiceDiscovery):
     def get_endpoint_info(self) -> List[EndpointInfo]:
         return list(self.available_engines.values())
 
+    @staticmethod
+    def _evict_breaker(url: str) -> None:
+        """An engine left the fleet for good: drop its breaker, metric
+        series, and per-engine request-stat aggregates, or pod churn grows
+        all of them without bound."""
+        from ..resilience import get_breaker_registry
+        from .stats.request_stats import get_request_stats_monitor
+
+        registry = get_breaker_registry()
+        if registry is not None:
+            registry.evict(url)
+        try:
+            get_request_stats_monitor().evict_url(url)
+        except ValueError:
+            pass  # monitor not initialized (unit-test harness)
+
     def get_health(self) -> bool:
         return self._task is not None and not self._task.done()
+
+    def set_draining(self, url: str, draining: bool) -> None:
+        # No watch event fires for a router-initiated drain (the pod keeps
+        # running), so flip the flag on the live EndpointInfo directly; the
+        # next pod/service event re-fetches /is_draining and agrees.
+        for info in self.available_engines.values():
+            if info.url == url:
+                info.draining = draining
 
     async def start(self) -> None:
         if self._task is None:
@@ -297,17 +430,30 @@ class _K8sWatcherBase(ServiceDiscovery):
                 data = await resp.json()
         return {m["id"]: ModelInfo.from_dict(m) for m in data.get("data", [])}
 
-    async def _fetch_sleep_status(self, base_url: str) -> bool:
+    async def _fetch_flag(self, base_url: str, path: str, key: str) -> Optional[bool]:
+        """None means the probe itself failed (timeout / connect error);
+        a non-200 means the endpoint is absent → flag off."""
         try:
             async with aiohttp.ClientSession() as session:
                 async with session.get(
-                    f"{base_url}/is_sleeping", timeout=aiohttp.ClientTimeout(total=5)
+                    f"{base_url}{path}", timeout=aiohttp.ClientTimeout(total=5)
                 ) as resp:
                     if resp.status == 200:
-                        return bool((await resp.json()).get("is_sleeping", False))
+                        return bool((await resp.json()).get(key, False))
+                    return False
         except Exception:  # noqa: BLE001
-            pass
-        return False
+            return None
+
+    async def _fetch_sleep_status(self, base_url: str) -> bool:
+        return bool(await self._fetch_flag(base_url, "/is_sleeping", "is_sleeping"))
+
+    async def _fetch_drain_status(self, base_url: str, last_known: bool = False) -> bool:
+        """A failed /is_draining probe keeps the last-known drain state
+        (same tri-state rule as StaticServiceDiscovery._probe_draining):
+        collapsing probe failure to False would flap a draining engine
+        back to routable on any watch-event refetch that times out."""
+        flag = await self._fetch_flag(base_url, "/is_draining", "is_draining")
+        return last_known if flag is None else flag
 
     async def _watch_loop(self) -> None:
         raise NotImplementedError
@@ -354,8 +500,10 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
         deleting = meta.get("deletionTimestamp") is not None
         if etype == "DELETED" or deleting or not self._pod_ready(pod) or not ip:
             async with self._lock:
-                if self.available_engines.pop(name, None) is not None:
-                    logger.info("engine %s removed from pool", name)
+                removed = self.available_engines.pop(name, None)
+            if removed is not None:
+                logger.info("engine %s removed from pool", name)
+                self._evict_breaker(removed.url)
             return
         url = f"http://{ip}:{self.port}"
         try:
@@ -363,7 +511,11 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
         except Exception as e:  # noqa: BLE001
             logger.debug("engine %s not serving /v1/models yet: %s", name, e)
             return
-        sleep = await self._fetch_sleep_status(url)
+        prev = self.available_engines.get(name)
+        sleep, draining = await asyncio.gather(
+            self._fetch_sleep_status(url),
+            self._fetch_drain_status(url, prev.draining if prev else False),
+        )
         labels = meta.get("labels", {}) or {}
         info = EndpointInfo(
             url=url,
@@ -372,6 +524,7 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
             added_timestamp=time.time(),
             model_label=labels.get("model", labels.get("app", "default")),
             sleep=sleep,
+            draining=draining,
             pod_name=name,
             namespace=self.namespace,
             model_info=model_info,
@@ -407,7 +560,9 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
         name = meta.get("name", "")
         if etype == "DELETED":
             async with self._lock:
-                self.available_engines.pop(name, None)
+                removed = self.available_engines.pop(name, None)
+            if removed is not None:
+                self._evict_breaker(removed.url)
             return
         url = f"http://{name}.{self.namespace}.svc.cluster.local:{self.port}"
         try:
@@ -415,7 +570,11 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
         except Exception as e:  # noqa: BLE001
             logger.debug("service %s not ready: %s", name, e)
             return
-        sleep = await self._fetch_sleep_status(url)
+        prev = self.available_engines.get(name)
+        sleep, draining = await asyncio.gather(
+            self._fetch_sleep_status(url),
+            self._fetch_drain_status(url, prev.draining if prev else False),
+        )
         labels = meta.get("labels", {}) or {}
         info = EndpointInfo(
             url=url,
@@ -424,6 +583,7 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
             added_timestamp=time.time(),
             model_label=labels.get("model", labels.get("app", "default")),
             sleep=sleep,
+            draining=draining,
             service_name=name,
             namespace=self.namespace,
             model_info=model_info,

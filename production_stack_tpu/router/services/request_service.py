@@ -8,22 +8,38 @@ route_disaggregated_prefill_request :342-434, route_sleep_wakeup_request
 :437-513). aiohttp.web-native redesign: responses are
 ``web.StreamResponse`` generators; the shared upstream ClientSession
 lives on the app.
+
+Resilience (no reference counterpart — the reference defers this to Envoy):
+
+- every routing decision goes through ``route_with_resilience`` (circuit
+  breakers + drain state consulted before the policy picks);
+- ``proxy_and_stream`` retries with backoff and fails over to the
+  next-best healthy engine on connect errors / 5xx, but NEVER after the
+  first upstream byte has been streamed to the client;
+- client disconnects mid-stream abort the upstream engine request instead
+  of leaking a decoding sequence;
+- per-request outcomes feed the breakers and ``pst_resilience_*`` metrics.
 """
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import json
 import time
 import uuid
-from typing import Optional
+from typing import Awaitable, Callable, Optional
 
 import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
+from ...resilience import get_breaker_registry, get_retry_policy
+from ...resilience import metrics as res_metrics
 from ..routing.logic import (
     DisaggregatedPrefillRouter,
     get_routing_logic,
+    route_with_resilience,
 )
 from ..service_discovery import get_service_discovery
 from ..stats.engine_stats import get_engine_stats_scraper
@@ -39,6 +55,10 @@ _HOP_HEADERS = {
     "te", "trailers", "transfer-encoding", "upgrade", "host", "content-length",
 }
 
+# The next backend to fail over to, given the set of already-tried URLs
+# (None = nowhere left to go).
+FailoverFn = Callable[[set], Awaitable[Optional[str]]]
+
 
 def _forwardable(headers) -> dict:
     return {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
@@ -50,6 +70,50 @@ def _error_response(status: int, message: str, etype: str = "invalid_request_err
     )
 
 
+def _note_success(url: str) -> None:
+    registry = get_breaker_registry()
+    if registry is not None:
+        registry.record_success(url)
+
+
+def _note_failure(url: str, request_id: str = "") -> None:
+    res_metrics.upstream_failures_total.labels(server=url).inc()
+    get_request_stats_monitor().on_request_failed(url, request_id, time.time())
+    registry = get_breaker_registry()
+    if registry is not None:
+        registry.record_failure(url)
+
+
+def make_failover(candidates, headers: dict, request_json: Optional[dict]) -> FailoverFn:
+    """Failover = re-route among the not-yet-tried candidates with fresh
+    stats, through the same policy (and breaker filter) as the first pick."""
+
+    async def failover(tried: set) -> Optional[str]:
+        remaining = [e for e in candidates if e.url not in tried]
+        if not remaining:
+            return None
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = get_request_stats_monitor().get_request_stats(time.time())
+        try:
+            return await route_with_resilience(
+                get_routing_logic(), remaining, engine_stats, request_stats,
+                headers, request_json, exclude=tried,
+            )
+        except ValueError:
+            return None
+
+    return failover
+
+
+async def _next_backend(
+    failover: Optional[FailoverFn], tried: set, attempt: int
+) -> Optional[str]:
+    policy = get_retry_policy()
+    if failover is None or policy is None or not policy.should_retry(attempt):
+        return None
+    return await failover(tried)
+
+
 async def proxy_and_stream(
     request: web.Request,
     backend_url: str,
@@ -57,17 +121,24 @@ async def proxy_and_stream(
     body: bytes,
     request_id: str,
     debug_headers: Optional[dict] = None,
+    failover: Optional[FailoverFn] = None,
 ) -> web.StreamResponse:
     """Forward the request to ``backend_url``/``endpoint`` and stream back.
 
     The first upstream chunk marks TTFT (on_request_response); completion
     marks on_request_complete. Response content is accumulated only when a
     post-request hook (callbacks / semantic cache) needs it.
+
+    Failure handling: a connect error or 5xx *before the first streamed
+    byte* re-routes to the next-best healthy engine (with backoff). Once a
+    byte has reached the client the stream is committed — a mid-stream
+    upstream death truncates, and a mid-stream client disconnect aborts
+    the upstream request.
     """
     monitor = get_request_stats_monitor()
     callback = get_custom_callback_handler()
+    policy = get_retry_policy()
     session: aiohttp.ClientSession = request.app["client_session"]
-    monitor.on_new_request(backend_url, request_id, time.time())
 
     collect = callback is not None and callback.post_request is not None
     semantic_store = request.app.get("semantic_cache_store")
@@ -80,50 +151,176 @@ async def proxy_and_stream(
         and not parsed.get("stream")
     )
     collect = collect or cacheable
-    collected = bytearray()
 
-    try:
-        async with session.request(
-            request.method,
-            backend_url + endpoint,
-            data=body,
-            headers=_forwardable(request.headers),
-        ) as upstream:
-            response = web.StreamResponse(status=upstream.status)
-            for k, v in upstream.headers.items():
-                if k.lower() not in _HOP_HEADERS:
-                    response.headers[k] = v
-            response.headers["X-Request-Id"] = request_id
-            if debug_headers:
-                for k, v in debug_headers.items():
-                    response.headers[k] = v
-            await response.prepare(request)
-            async for chunk in upstream.content.iter_any():
-                # First call records TTFT; subsequent calls record ITL.
-                monitor.on_request_response(backend_url, request_id, time.time())
-                if collect:
-                    collected.extend(chunk)
-                await response.write(chunk)
-            monitor.on_request_complete(backend_url, request_id, time.time())
-            await response.write_eof()
-    except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
-        monitor.on_request_complete(backend_url, request_id, time.time())
-        logger.error("backend %s failed for %s: %s", backend_url, request_id, e)
-        return _error_response(502, f"backend error: {e}", "bad_gateway")
+    url = backend_url
+    tried = {url}
+    attempt = 0
+    # Per-attempt timeouts (total stays unlimited — streams run as long as
+    # the generation does): connect bounds the TCP handshake, sock_read the
+    # gap between reads, so a black-holed backend raises a retryable
+    # TimeoutError instead of hanging the client forever.
+    attempt_timeout = aiohttp.ClientTimeout(
+        total=None,
+        connect=(policy.connect_timeout or None) if policy else None,
+        sock_read=(policy.read_timeout or None) if policy else None,
+    )
 
-    if collect:
-        content = bytes(collected)
-        if semantic_store is not None:
-            try:
-                await semantic_store(request, content)
-            except Exception as e:  # noqa: BLE001
-                logger.debug("semantic cache store failed: %s", e)
-        if callback is not None:
-            try:
-                await callback.call_post_request(request, content)
-            except Exception as e:  # noqa: BLE001
-                logger.error("post_request callback failed: %s", e)
-    return response
+    completed = False
+
+    while True:
+        collected = bytearray()
+        response: Optional[web.StreamResponse] = None
+        failure_noted = False  # at most one breaker/stats failure per attempt
+        completed = False  # ... and at most one completion per attempt
+
+        def _complete() -> None:
+            # Idempotent per attempt: write_eof raising after the stream
+            # completed (or cancellation racing completion) must not record
+            # a second completion — the monitor would steal a prefill slot
+            # from a concurrent request and skew the routing stats.
+            nonlocal completed
+            if not completed:
+                completed = True
+                monitor.on_request_complete(url, request_id, time.time())
+
+        monitor.on_new_request(url, request_id, time.time())
+        try:
+            async with session.request(
+                request.method,
+                url + endpoint,
+                data=body,
+                headers=_forwardable(request.headers),
+                timeout=attempt_timeout,
+            ) as upstream:
+                ok = not (
+                    policy.is_retryable_status(upstream.status)
+                    if policy is not None
+                    else upstream.status >= 500
+                )
+                if not ok:
+                    if upstream.status == 503 and "X-PST-Draining" in upstream.headers:
+                        # Deliberate drain rejection, not a failure: leave
+                        # the breaker and failure stats alone, and reconcile
+                        # discovery right here — this is how an
+                        # engine-initiated drain (e.g. the preStop hook
+                        # POSTing the engine directly) becomes unroutable
+                        # even when no health-probe loop is running.
+                        get_service_discovery().set_draining(url, True)
+                    else:
+                        _note_failure(url, request_id)
+                        failure_noted = True
+                    next_url = await _next_backend(failover, tried, attempt)
+                    if next_url is not None:
+                        _complete()
+                        logger.warning(
+                            "backend %s returned %d for %s; failing over to %s",
+                            url, upstream.status, request_id, next_url,
+                        )
+                        res_metrics.retries_total.labels(server=url).inc()
+                        res_metrics.failovers_total.inc()
+                        # Give the connection back before sleeping: a
+                        # backoff with the error body unread would park a
+                        # connector slot per in-flight failover, exactly
+                        # when the pool is under failure-induced load.
+                        upstream.release()
+                        await asyncio.sleep(policy.backoff(attempt))
+                        attempt += 1
+                        url = next_url
+                        tried.add(url)
+                        continue
+                    # Nowhere left to go: stream the 5xx through unchanged.
+                try:
+                    response = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            response.headers[k] = v
+                    response.headers["X-Request-Id"] = request_id
+                    if debug_headers:
+                        for k, v in debug_headers.items():
+                            response.headers[k] = v
+                    await response.prepare(request)
+                    async for chunk in upstream.content.iter_any():
+                        # First call records TTFT; subsequent calls record ITL.
+                        monitor.on_request_response(url, request_id, time.time())
+                        if collect:
+                            collected.extend(chunk)
+                        await response.write(chunk)
+                    _complete()
+                    if ok:
+                        _note_success(url)
+                    await response.write_eof()
+                except (ConnectionResetError, ConnectionError):
+                    # Client-side socket error on prepare/write/write_eof:
+                    # the client went away — not a backend failure, so don't
+                    # feed the breaker or replay the request. Abort the
+                    # upstream request so the engine stops decoding for a
+                    # dead consumer. (Upstream read errors surface as
+                    # aiohttp.ClientError and still hit the outer handler.)
+                    res_metrics.client_disconnects_total.inc()
+                    _complete()
+                    upstream.close()
+                    logger.info(
+                        "client disconnected during response for %s; "
+                        "aborted upstream %s", request_id, url,
+                    )
+                    return response
+                except asyncio.CancelledError:
+                    # aiohttp cancels the handler when the client drops the
+                    # connection (also raised on server shutdown): same
+                    # obligation either way — don't leak the upstream
+                    # request — but only a dead client transport is a
+                    # client disconnect; a router restart with N in-flight
+                    # streams must not add N to the disconnect counter.
+                    if request.transport is None or request.transport.is_closing():
+                        res_metrics.client_disconnects_total.inc()
+                    _complete()
+                    upstream.close()
+                    raise
+        except (
+            aiohttp.ClientError, asyncio.TimeoutError, ConnectionResetError, OSError,
+        ) as e:
+            _complete()
+            if not failure_noted:
+                _note_failure(url, request_id)
+            if response is not None and response.prepared:
+                # Bytes already reached the client: the stream is committed.
+                # Truncate rather than retry (a replay would duplicate
+                # already-delivered tokens).
+                logger.error(
+                    "backend %s died mid-stream for %s: %s", url, request_id, e
+                )
+                with contextlib.suppress(Exception):
+                    await response.write_eof()
+                return response
+            next_url = await _next_backend(failover, tried, attempt)
+            if next_url is None:
+                logger.error("backend %s failed for %s: %s", url, request_id, e)
+                return _error_response(502, f"backend error: {e}", "bad_gateway")
+            logger.warning(
+                "backend %s unreachable for %s (%s); failing over to %s",
+                url, request_id, e, next_url,
+            )
+            res_metrics.retries_total.labels(server=url).inc()
+            res_metrics.failovers_total.inc()
+            await asyncio.sleep(policy.backoff(attempt))
+            attempt += 1
+            url = next_url
+            tried.add(url)
+            continue
+
+        if collect:
+            content = bytes(collected)
+            if cacheable:
+                try:
+                    await semantic_store(request, content)
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("semantic cache store failed: %s", e)
+            if callback is not None:
+                try:
+                    await callback.call_post_request(request, content)
+                except Exception as e:  # noqa: BLE001
+                    logger.error("post_request callback failed: %s", e)
+        return response
 
 
 async def route_general_request(request: web.Request, endpoint: str) -> web.StreamResponse:
@@ -200,6 +397,15 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
             "not_found_error",
         )
 
+    if pinned_id:
+        # An explicit pin is a debug escape hatch: bypass the routing policy
+        # AND the resilience filters (breakers, drain) so an operator can
+        # always reach the exact engine they asked for — and no failover,
+        # which would silently re-route off the pinned engine.
+        return await proxy_and_stream(
+            request, candidates[0].url, endpoint, body, request_id
+        )
+
     if is_disagg:
         return await route_disaggregated_prefill_request(
             request, endpoint, request_json, candidates, request_id
@@ -207,14 +413,18 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
 
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    headers = dict(request.headers)
     try:
-        backend_url = await router.route_request(
-            candidates, engine_stats, request_stats, dict(request.headers), request_json
+        backend_url = await route_with_resilience(
+            router, candidates, engine_stats, request_stats, headers, request_json
         )
     except ValueError as e:
         return _error_response(503, f"no backend available: {e}", "service_unavailable")
     logger.debug("routing %s for model %s to %s", request_id, requested_model, backend_url)
-    return await proxy_and_stream(request, backend_url, endpoint, body, request_id)
+    return await proxy_and_stream(
+        request, backend_url, endpoint, body, request_id,
+        failover=make_failover(candidates, headers, request_json),
+    )
 
 
 async def route_disaggregated_prefill_request(
@@ -244,29 +454,76 @@ async def route_disaggregated_prefill_request(
     prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
 
     try:
-        prefill_url = await router.route_request(
-            endpoints, engine_stats, request_stats, headers, prefill_json
+        prefill_url = await route_with_resilience(
+            router, endpoints, engine_stats, request_stats, headers, prefill_json
         )
     except ValueError as e:
         return _error_response(503, f"no prefill backend: {e}", "service_unavailable")
 
     session: aiohttp.ClientSession = request.app["client_session"]
-    t_prefill_start = time.time()
-    monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
-    try:
-        async with session.post(
-            prefill_url + endpoint, json=prefill_json, headers=_forwardable(headers)
-        ) as resp:
-            resp.raise_for_status()
-            await resp.json()
-    except (aiohttp.ClientError, OSError) as e:
-        monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
-        return _error_response(502, f"prefill failed: {e}", "bad_gateway")
-    monitor.on_request_response(prefill_url, f"{request_id}-prefill", time.time())
-    monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
-    logger.debug(
-        "disagg prefill for %s done in %.3fs", request_id, time.time() - t_prefill_start
+    policy = get_retry_policy()
+    # Same per-attempt bounds and retry/failover semantics as
+    # proxy_and_stream — nothing from the prefill response reaches the
+    # client, so it is always safe to re-route. Without the timeout a
+    # black-holed prefill engine would hang the request forever with the
+    # breaker never fed.
+    attempt_timeout = aiohttp.ClientTimeout(
+        total=None,
+        connect=(policy.connect_timeout or None) if policy else None,
+        sock_read=(policy.read_timeout or None) if policy else None,
     )
+    failover = make_failover(endpoints, headers, prefill_json)
+    tried = {prefill_url}
+    attempt = 0
+    while True:
+        t_prefill_start = time.time()
+        monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
+        error: Optional[str] = None
+        draining = False
+        try:
+            async with session.post(
+                prefill_url + endpoint, json=prefill_json,
+                headers=_forwardable(headers), timeout=attempt_timeout,
+            ) as resp:
+                draining = resp.status == 503 and "X-PST-Draining" in resp.headers
+                if not draining:
+                    resp.raise_for_status()
+                    await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            error = str(e)
+        if error is None and not draining:
+            monitor.on_request_response(prefill_url, f"{request_id}-prefill", time.time())
+            monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
+            _note_success(prefill_url)
+            logger.debug(
+                "disagg prefill for %s done in %.3fs",
+                request_id, time.time() - t_prefill_start,
+            )
+            break
+        monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
+        if draining:
+            # Deliberate drain, not a failure (same rule as
+            # proxy_and_stream): reconcile discovery, spare the breaker.
+            get_service_discovery().set_draining(prefill_url, True)
+        else:
+            _note_failure(prefill_url, request_id)
+        next_url = await _next_backend(failover, tried, attempt)
+        if next_url is None:
+            return _error_response(
+                502,
+                f"prefill failed: {error or 'engine draining'}",
+                "bad_gateway",
+            )
+        logger.warning(
+            "prefill engine %s failed for %s (%s); failing over to %s",
+            prefill_url, request_id, error or "draining", next_url,
+        )
+        res_metrics.retries_total.labels(server=prefill_url).inc()
+        res_metrics.failovers_total.inc()
+        await asyncio.sleep(policy.backoff(attempt))
+        attempt += 1
+        prefill_url = next_url
+        tried.add(prefill_url)
 
     decode_json = dict(request_json)
     if original_max_tokens is not None:
@@ -275,8 +532,8 @@ async def route_disaggregated_prefill_request(
     decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
     decode_json["kv_transfer_params"]["prefill_url"] = prefill_url
     try:
-        decode_url = await router.route_request(
-            endpoints, engine_stats, request_stats, headers, decode_json
+        decode_url = await route_with_resilience(
+            router, endpoints, engine_stats, request_stats, headers, decode_json
         )
     except ValueError as e:
         return _error_response(503, f"no decode backend: {e}", "service_unavailable")
@@ -287,7 +544,23 @@ async def route_disaggregated_prefill_request(
         json.dumps(decode_json).encode(),
         request_id,
         debug_headers={"X-Prefill-Url": prefill_url, "X-Decode-Url": decode_url},
+        failover=make_failover(endpoints, headers, decode_json),
     )
+
+
+async def _admin_fanout(targets, call) -> dict:
+    """Run ``call(ep)`` against every target engine concurrently. One
+    engine's failure becomes an ``{"error": ...}`` entry instead of failing
+    the whole fan-out — and a blocking call (drain ``wait=1``) costs max
+    one timeout, not one per engine."""
+
+    async def one(ep):
+        try:
+            return ep.url, await call(ep)
+        except (aiohttp.ClientError, OSError) as e:
+            return ep.url, {"error": str(e)}
+
+    return dict(await asyncio.gather(*(one(ep) for ep in targets)))
 
 
 async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.Response:
@@ -303,17 +576,79 @@ async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.R
     if not targets:
         return _error_response(404, f"no engines matching {label!r}", "not_found_error")
     session: aiohttp.ClientSession = request.app["client_session"]
-    results = {}
-    for ep in targets:
+    headers = _forwardable(request.headers)  # pass admin credentials through
+
+    async def call(ep):
+        if action == "is_sleeping":
+            async with session.get(
+                f"{ep.url}/is_sleeping", headers=headers
+            ) as resp:
+                return await resp.json()
+        level = request.query.get("level")
+        params = {"level": level} if level else None
+        async with session.post(
+            f"{ep.url}/{action}", params=params, headers=headers
+        ) as resp:
+            return {"status": resp.status}
+
+    return web.json_response(await _admin_fanout(targets, call))
+
+
+async def route_drain_request(request: web.Request, action: str) -> web.Response:
+    """Admin proxy for engine drain: POST /drain, POST /undrain,
+    GET /is_draining — fanned out like sleep/wake, by ``model`` label or to
+    a single engine via ``?url=``."""
+    discovery = get_service_discovery()
+    endpoints = discovery.get_endpoint_info()
+    label = request.query.get("model")
+    url_filter = request.query.get("url")
+    targets = [
+        e for e in endpoints
+        if (label is None or e.model_label == label or label in e.model_names)
+        and (url_filter is None or e.url == url_filter)
+    ]
+    if not targets:
+        return _error_response(404, "no engines matching filter", "not_found_error")
+    session: aiohttp.ClientSession = request.app["client_session"]
+    # Forward the caller's headers (Authorization in particular): engines
+    # behind --api-key guard /drain, and the router holds no engine
+    # credentials of its own.
+    headers = _forwardable(request.headers)
+
+    async def call(ep):
+        if action == "is_draining":
+            async with session.get(
+                f"{ep.url}/is_draining", headers=headers
+            ) as resp:
+                return await resp.json()
+        # Forward wait/timeout so a blocking drain works through the
+        # router exactly as it does against the engine directly.
+        params = {
+            k: request.query[k] for k in ("wait", "timeout")
+            if k in request.query
+        }
+        # Mark discovery up front rather than waiting for the response or
+        # the next probe/watch cycle: the engine flips state the moment it
+        # receives the POST, and a blocking drain (wait=1) holds the
+        # response until in-flight work finishes — the lag window would
+        # keep routing to the draining engine and count its deliberate
+        # 503s as breaker failures. Reverted below if the call fails.
+        if action == "drain":
+            discovery.set_draining(ep.url, True)
         try:
-            if action == "is_sleeping":
-                async with session.get(f"{ep.url}/is_sleeping") as resp:
-                    results[ep.url] = await resp.json()
-            else:
-                level = request.query.get("level")
-                params = {"level": level} if level else None
-                async with session.post(f"{ep.url}/{action}", params=params) as resp:
-                    results[ep.url] = {"status": resp.status}
-        except (aiohttp.ClientError, OSError) as e:
-            results[ep.url] = {"error": str(e)}
-    return web.json_response(results)
+            async with session.post(
+                f"{ep.url}/{action}", params=params or None, headers=headers
+            ) as resp:
+                ok = resp.status == 200
+                result = await resp.json()
+        except (aiohttp.ClientError, OSError):
+            if action == "drain":
+                discovery.set_draining(ep.url, False)
+            raise
+        if action == "drain" and not ok:
+            discovery.set_draining(ep.url, False)
+        elif action == "undrain" and ok:
+            discovery.set_draining(ep.url, False)
+        return result
+
+    return web.json_response(await _admin_fanout(targets, call))

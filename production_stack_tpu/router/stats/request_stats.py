@@ -28,6 +28,7 @@ class RequestStats:
     avg_latency: float = -1.0
     avg_itl: float = -1.0
     num_swapped_requests: int = 0
+    failed_requests: int = 0
 
 
 class MovingAverageMonitor:
@@ -89,6 +90,7 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         self.in_decoding: Dict[str, int] = {}
         self.finished: Dict[str, int] = {}
         self.swapped: Dict[str, int] = {}
+        self.failed: Dict[str, int] = {}
         self.first_query_time: Optional[float] = None
         self._initialized = True
 
@@ -144,6 +146,24 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
     def on_request_swapped(self, engine_url: str, request_id: str, timestamp: float) -> None:
         self.swapped[engine_url] = self.swapped.get(engine_url, 0) + 1
 
+    def on_request_failed(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """An upstream attempt against this engine failed (connect error or
+        5xx, reported by the proxy's resilience layer)."""
+        self.failed[engine_url] = self.failed.get(engine_url, 0) + 1
+
+    def evict_url(self, engine_url: str) -> None:
+        """Drop every per-engine aggregate for an engine that left the fleet
+        for good (pod deleted / service removed) — the counterpart of the
+        breaker registry's evict; without it pod churn grows these tables
+        (and get_request_stats output) without bound."""
+        for table in (
+            self.qps_monitors, self.ttft_monitors, self.latency_monitors,
+            self.decoding_length_monitors, self.itl_monitors,
+            self.in_prefill, self.in_decoding, self.finished,
+            self.swapped, self.failed,
+        ):
+            table.pop(engine_url, None)
+
     def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
         now = current_time if current_time is not None else time.time()
         urls = (
@@ -175,6 +195,7 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 avg_latency=avg(self.latency_monitors),
                 avg_itl=avg(self.itl_monitors),
                 num_swapped_requests=self.swapped.get(url, 0),
+                failed_requests=self.failed.get(url, 0),
             )
         return out
 

@@ -38,6 +38,31 @@ class FakeEngineState:
         self.sleeping = False
         self.lora_adapters: List[str] = []
         self.requests_seen: List[dict] = []
+        # Fault injection (resilience tests): POST /admin/fail arms one of
+        #   error — respond fail_status (default 500) immediately
+        #   hang  — accept the request and never answer
+        #   midstream — stream a few chunks, then die (tests the
+        #               never-retry-after-first-byte rule)
+        # fail_count > 0 limits the fault to the next N generations
+        # (auto-heal); -1 = until POST /admin/heal.
+        self.fail_mode: Optional[str] = None
+        self.fail_status = 500
+        self.fail_count = -1
+        self.num_faulted = 0
+        # Graceful drain: new generations 503, in-flight ones finish.
+        self.draining = False
+
+    def take_fault(self) -> Optional[str]:
+        """Consume one fault budget entry; returns the armed mode or None."""
+        if self.fail_mode is None or self.fail_count == 0:
+            return None
+        mode = self.fail_mode
+        if self.fail_count > 0:
+            self.fail_count -= 1
+            if self.fail_count == 0:
+                self.fail_mode = None
+        self.num_faulted += 1
+        return mode
 
 
 def _models_payload(state: FakeEngineState) -> dict:
@@ -84,8 +109,31 @@ def create_fake_engine_app(
     async def _generate(request: web.Request, is_chat: bool) -> web.StreamResponse:
         body = await request.json()
         state.requests_seen.append(body)
+        if state.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining",
+                           "type": "service_unavailable", "code": 503}},
+                status=503,
+                headers={"X-PST-Draining": "1"},
+            )
+        fault = state.take_fault()
+        if fault == "error":
+            return web.json_response(
+                {"error": {"message": "injected failure",
+                           "type": "internal_error",
+                           "code": state.fail_status}},
+                status=state.fail_status,
+            )
+        if fault == "hang":
+            # Hold the request open until the caller gives up (poll the
+            # transport instead of one long sleep so server shutdown isn't
+            # blocked behind a still-running handler).
+            while request.transport is not None and not request.transport.is_closing():
+                await asyncio.sleep(0.1)
+            return web.Response(status=500)
         n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
         stream = bool(body.get("stream", False))
+        die_midstream = fault == "midstream"
         state.num_running += 1
         state.prefix_queries += 1
         req_id = f"fake-{uuid.uuid4().hex[:12]}"
@@ -122,6 +170,10 @@ def create_fake_engine_app(
                             ],
                         }
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    if die_midstream and i >= 2:
+                        # Drop the connection with the stream half-sent.
+                        request.transport.close()
+                        return resp
                     if token_interval:
                         await asyncio.sleep(token_interval)
                 await resp.write(b"data: [DONE]\n\n")
@@ -197,10 +249,51 @@ def create_fake_engine_app(
         return web.Response(text=text, content_type="text/plain")
 
     async def health(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        if state.fail_mode == "error":
+            return web.json_response({"status": "failing"}, status=500)
+        status = "draining" if state.draining else "ok"
+        return web.json_response({"status": status})
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": state.sleeping})
+
+    async def admin_fail(request: web.Request) -> web.Response:
+        """Arm fault injection: {"mode": "error"|"hang"|"midstream",
+        "status": 500, "count": -1}."""
+        body = await request.json() if request.can_read_body else {}
+        mode = body.get("mode", "error")
+        if mode not in ("error", "hang", "midstream"):
+            return web.json_response({"error": f"unknown mode {mode!r}"}, status=400)
+        state.fail_mode = mode
+        state.fail_status = int(body.get("status", 500))
+        state.fail_count = int(body.get("count", -1))
+        return web.json_response({"status": "armed", "mode": mode})
+
+    async def admin_heal(request: web.Request) -> web.Response:
+        state.fail_mode = None
+        state.fail_count = -1
+        return web.json_response({"status": "healed", "faulted": state.num_faulted})
+
+    async def drain(request: web.Request) -> web.Response:
+        state.draining = True
+        if request.query.get("wait"):
+            deadline = time.time() + float(request.query.get("timeout", "30"))
+            while time.time() < deadline and state.num_running > 0:
+                await asyncio.sleep(0.05)
+        return web.json_response(
+            {"status": "draining", "in_flight": state.num_running}
+        )
+
+    async def undrain(request: web.Request) -> web.Response:
+        state.draining = False
+        return web.json_response(
+            {"status": "accepting", "in_flight": state.num_running}
+        )
+
+    async def is_draining(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"is_draining": state.draining, "in_flight": state.num_running}
+        )
 
     async def sleep(request: web.Request) -> web.Response:
         state.sleeping = True
@@ -268,6 +361,11 @@ def create_fake_engine_app(
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
+    app.router.add_post("/admin/fail", admin_fail)
+    app.router.add_post("/admin/heal", admin_heal)
+    app.router.add_post("/drain", drain)
+    app.router.add_post("/undrain", undrain)
+    app.router.add_get("/is_draining", is_draining)
     app.router.add_post("/v1/load_lora_adapter", load_lora)
     app.router.add_post("/v1/unload_lora_adapter", unload_lora)
     app.router.add_post("/tokenize", tokenize)

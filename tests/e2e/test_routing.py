@@ -229,6 +229,61 @@ def leg_stress():
     print("PASS stress (64 concurrent)")
 
 
+def leg_chaos():
+    """Chaos smoke: SIGKILL one engine mid-run under concurrent load. The
+    router's retry/failover must absorb every request (zero client-visible
+    failures) and the dead engine's circuit breaker must open — all
+    observable via pst_resilience_* metrics."""
+    import concurrent.futures
+
+    with Fleet("roundrobin",
+               router_args=["--proxy-retries", "2",
+                            "--retry-backoff", "0.01",
+                            "--breaker-failure-threshold", "2",
+                            "--breaker-recovery-time", "60"]) as f:
+        # Warm-up: all three engines serving.
+        warm = Counter()
+        for i in range(6):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": f"w{i}",
+                                  "max_tokens": 2})
+            assert status == 200
+            warm[by] += 1
+        assert len(warm) == N_ENGINES, warm
+
+        # Kill engine-0 abruptly (no drain, no warning) and keep loading.
+        f.procs[0].kill()
+
+        def one(i):
+            status, by, _ = post(f"{f.url}/v1/completions",
+                                 {"model": MODEL, "prompt": f"c{i}",
+                                  "max_tokens": 2})
+            return status, by
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            results = list(ex.map(one, range(40)))
+        statuses = Counter(s for s, _ in results)
+        assert statuses == Counter({200: 40}), statuses
+        served = Counter(by for _, by in results)
+        assert "engine-0" not in served, served
+
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert "pst_resilience_failovers_total" in metrics, "no failover metric"
+        # The dead engine's breaker opened (gauge value 2.0).
+        # Match the full server label, not a bare port substring — one
+        # random free port can be a suffix of another (8100 vs 48100).
+        dead_label = f'server="http://127.0.0.1:{f.engine_ports[0]}"'
+        for line in metrics.splitlines():
+            if (line.startswith("pst_resilience_breaker_state")
+                    and dead_label in line):
+                assert line.rstrip().endswith("2.0"), line
+                break
+        else:
+            raise AssertionError("no breaker_state sample for dead engine")
+    print("PASS chaos (engine killed mid-run, 40/40 served)", dict(served))
+
+
 LEGS = {
     "roundrobin": leg_roundrobin,
     "session": leg_session,
@@ -236,6 +291,7 @@ LEGS = {
     "kvaware": leg_kvaware,
     "disaggregated_prefill": leg_disagg,
     "stress": leg_stress,
+    "chaos": leg_chaos,
 }
 
 

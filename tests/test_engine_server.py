@@ -157,6 +157,29 @@ async def test_admin_surface(tmp_path):
         ) as r:
             assert r.status == 200
 
+        # drain / undrain cycle: new generations 503 with the
+        # X-PST-Draining marker (the router keys drain reconciliation —
+        # vs breaker failure — off that header), probes report state.
+        async with sess.get(f"{server.url}/is_draining") as r:
+            assert (await r.json())["is_draining"] is False
+        async with sess.post(f"{server.url}/drain") as r:
+            assert (await r.json())["status"] == "draining"
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json={"model": "m", "prompt": "a", "max_tokens": 1},
+        ) as r:
+            assert r.status == 503
+            assert r.headers.get("X-PST-Draining") == "1"
+        async with sess.get(f"{server.url}/health") as r:
+            assert (await r.json())["status"] == "draining"
+        async with sess.post(f"{server.url}/undrain") as r:
+            assert (await r.json())["status"] == "accepting"
+        async with sess.post(
+            f"{server.url}/v1/completions",
+            json={"model": "m", "prompt": "a", "max_tokens": 1},
+        ) as r:
+            assert r.status == 200
+
         # LoRA admin endpoints: a real PEFT checkpoint loads into a device
         # bank slot and reflects into /v1/models with parent set; a request
         # under the adapter name serves; a bogus path 404s.

@@ -1,0 +1,395 @@
+"""Unit ring for the resilience subsystem: breaker state machine, token
+bucket, admission queue/shedding, retry policy, and the routing-side
+breaker/drain filter (incl. the unhealthy-best-match fallback the KV/prefix
+routers must honor).
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from production_stack_tpu.resilience import (
+    get_breaker_registry,
+    initialize_resilience,
+    teardown_resilience,
+)
+from production_stack_tpu.resilience.admission import AdmissionController, TokenBucket
+from production_stack_tpu.resilience.breaker import (
+    BreakerState,
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+)
+from production_stack_tpu.resilience.retry import RetryPolicy
+from production_stack_tpu.kvserver.controller import ControllerState
+from production_stack_tpu.router.routing.logic import (
+    PrefixAwareRouter,
+    filter_routable,
+    route_with_resilience,
+)
+
+from .router_utils import make_endpoint, reset_router_singletons
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_after_consecutive_failures():
+    b = CircuitBreaker("http://e", failure_threshold=3, recovery_time=10.0)
+    t = 1000.0
+    assert b.allows(t)
+    b.record_failure(t)
+    b.record_failure(t)
+    assert b.state is BreakerState.CLOSED
+    b.record_failure(t)
+    assert b.state is BreakerState.OPEN
+    assert not b.allows(t + 1)
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker("http://e", failure_threshold=2)
+    t = 1000.0
+    b.record_failure(t)
+    b.record_success(t)
+    b.record_failure(t)
+    assert b.state is BreakerState.CLOSED
+
+
+def test_breaker_half_open_probe_then_close():
+    b = CircuitBreaker(
+        "http://e", failure_threshold=1, recovery_time=5.0, half_open_probes=1
+    )
+    t = 1000.0
+    b.record_failure(t)
+    assert b.state is BreakerState.OPEN
+    assert not b.allows(t + 4.9)
+    # Recovery window elapsed: one probe slot opens.
+    assert b.allows(t + 5.1)
+    assert b.state is BreakerState.HALF_OPEN
+    # Slot taken — a second concurrent request is refused.
+    assert not b.allows(t + 5.2)
+    b.record_success(t + 5.3)
+    assert b.state is BreakerState.CLOSED
+    assert b.allows(t + 5.4)
+
+
+def test_breaker_half_open_failure_reopens():
+    b = CircuitBreaker("http://e", failure_threshold=1, recovery_time=5.0)
+    t = 1000.0
+    b.record_failure(t)
+    assert b.allows(t + 5.1)  # half-open probe
+    b.record_failure(t + 5.2)
+    assert b.state is BreakerState.OPEN
+    # Recovery clock restarted from the re-open.
+    assert not b.allows(t + 9.0)
+    assert b.allows(t + 10.3)
+
+
+def test_breaker_probe_reservation_expires():
+    """An allows()==True that never became a request must not wedge the
+    breaker in HALF_OPEN forever."""
+    b = CircuitBreaker("http://e", failure_threshold=1, recovery_time=2.0)
+    t = 1000.0
+    b.record_failure(t)
+    assert b.allows(t + 2.1)       # reserve the probe slot... and drop it
+    assert not b.allows(t + 2.2)   # slot held
+    assert b.allows(t + 4.5)       # reservation expired → new probe allowed
+
+
+def test_registry_filter_fails_open_when_all_open():
+    reg = CircuitBreakerRegistry(failure_threshold=1, recovery_time=60.0)
+    # Real-time base: registry.state() reads the wall clock internally.
+    t = time.time()
+    reg.record_failure("http://a", t)
+    reg.record_failure("http://b", t)
+    assert reg.state("http://a") is BreakerState.OPEN
+    # Both open → fail open (all candidates come back).
+    assert reg.filter_available(["http://a", "http://b"], t + 1) == [
+        "http://a", "http://b"
+    ]
+    # One healthy → only it survives the filter.
+    assert reg.filter_available(["http://a", "http://c"], t + 1) == ["http://c"]
+
+
+# ---------------------------------------------------------------------------
+# Token bucket + admission
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_burst_and_refill():
+    bucket = TokenBucket(rate=10.0, burst=2)
+    t = 1000.0
+    assert bucket.try_acquire(t)
+    assert bucket.try_acquire(t)
+    assert not bucket.try_acquire(t)
+    assert bucket.time_until_tokens(1, t) == pytest.approx(0.1, abs=0.01)
+    assert bucket.try_acquire(t + 0.11)
+    # Capacity caps accumulation.
+    assert bucket.time_until_tokens(3, t + 100) == pytest.approx(0.1, abs=0.02)
+
+
+async def test_admission_unlimited_by_default():
+    ctrl = AdmissionController(rate=0.0)
+    decision = await ctrl.admit()
+    assert decision.admitted
+    ctrl.close()
+
+
+async def test_admission_queue_grants_in_priority_order():
+    ctrl = AdmissionController(rate=20.0, burst=1, max_queue=8, queue_timeout=5.0)
+    assert (await ctrl.admit()).admitted  # consumes the burst token
+    order = []
+
+    async def req(name, prio):
+        d = await ctrl.admit(priority=prio)
+        assert d.admitted
+        order.append(name)
+
+    low = asyncio.ensure_future(req("low", 0))
+    await asyncio.sleep(0.005)  # low enqueues first...
+    high = asyncio.ensure_future(req("high", 10))
+    await asyncio.gather(low, high)
+    assert order == ["high", "low"]  # ...but high priority is served first
+    ctrl.close()
+
+
+async def test_admission_sheds_when_queue_full():
+    ctrl = AdmissionController(rate=1.0, burst=1, max_queue=0, queue_timeout=5.0)
+    assert (await ctrl.admit()).admitted
+    decision = await ctrl.admit()
+    assert not decision.admitted
+    assert decision.reason == "queue_full"
+    assert decision.retry_after > 0
+    assert int(decision.retry_after_header) >= 1
+    ctrl.close()
+
+
+async def test_admission_sheds_on_hopeless_deadline():
+    # Next token is ~1s away but the queue deadline is 0.1s: shed
+    # immediately instead of parking doomed work.
+    ctrl = AdmissionController(rate=1.0, burst=1, max_queue=8, queue_timeout=0.1)
+    assert (await ctrl.admit()).admitted
+    t0 = time.monotonic()
+    decision = await ctrl.admit()
+    assert not decision.admitted
+    assert decision.reason == "deadline"
+    assert time.monotonic() - t0 < 0.05  # did not wait the timeout out
+    ctrl.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_attempts_and_backoff():
+    p = RetryPolicy(max_attempts=3, backoff_base=0.1)
+    assert p.should_retry(0) and p.should_retry(1)
+    assert not p.should_retry(2)
+    assert p.backoff(0) == pytest.approx(0.1)
+    assert p.backoff(2) == pytest.approx(0.4)
+    assert RetryPolicy.is_retryable_status(500)
+    assert RetryPolicy.is_retryable_status(503)
+    assert not RetryPolicy.is_retryable_status(404)
+    assert not RetryPolicy.is_retryable_status(429)
+
+
+# ---------------------------------------------------------------------------
+# Routing-side consult (breaker + drain filter, unhealthy-best-match fallback)
+# ---------------------------------------------------------------------------
+
+
+def test_filter_routable_drops_draining_and_open_breakers():
+    initialize_resilience(SimpleNamespace(breaker_failure_threshold=1))
+    a = make_endpoint("http://a")
+    b = make_endpoint("http://b")
+    c = make_endpoint("http://c")
+    c.draining = True
+    assert filter_routable([a, b, c]) == [a, b]
+    get_breaker_registry().record_failure("http://a")
+    assert filter_routable([a, b, c]) == [b]
+    # exclude is a hard filter even when it leaves nothing.
+    assert filter_routable([a, b], exclude={"http://a", "http://b"}) == []
+
+
+async def test_prefixaware_falls_back_when_best_match_unhealthy():
+    """Prefix/KV-aware routing must not 502 when the engine holding the
+    best prefix match is unhealthy — it falls back to a live engine."""
+    initialize_resilience(SimpleNamespace(breaker_failure_threshold=1))
+    router = PrefixAwareRouter()
+    a, b, c = (make_endpoint(f"http://{x}") for x in "abc")
+    prompt = "The quick brown fox jumps over the lazy dog" * 20
+    # Teach the trie that the prefix lives on a.
+    await router.hashtrie.insert(prompt, "http://a")
+    url = await route_with_resilience(
+        router, [a, b, c], {}, {}, {}, {"prompt": prompt}
+    )
+    assert url == "http://a"  # healthy best match wins
+    get_breaker_registry().record_failure("http://a")  # breaker opens (threshold 1)
+    url = await route_with_resilience(
+        router, [a, b, c], {}, {}, {}, {"prompt": prompt}
+    )
+    assert url in ("http://b", "http://c")
+    # Every candidate excluded/draining → ValueError (503 upstream), not 502.
+    b.draining = True
+    c.draining = True
+    with pytest.raises(ValueError):
+        await route_with_resilience(
+            router, [b, c], {}, {}, {}, {"prompt": prompt}
+        )
+    teardown_resilience()
+
+
+# ---------------------------------------------------------------------------
+# Immediate drain propagation (router-initiated /drain must not wait for
+# the next probe or watch cycle)
+# ---------------------------------------------------------------------------
+
+
+def test_static_discovery_set_draining_is_immediate():
+    from production_stack_tpu.router.service_discovery import StaticServiceDiscovery
+
+    sd = StaticServiceDiscovery(urls=["http://a", "http://b"], models=["m", "m"])
+    assert [e.draining for e in sd.get_endpoint_info()] == [False, False]
+    sd.set_draining("http://a", True)
+    flags = {e.url: e.draining for e in sd.get_endpoint_info()}
+    assert flags == {"http://a": True, "http://b": False}
+    sd.set_draining("http://a", False)
+    assert not any(e.draining for e in sd.get_endpoint_info())
+
+
+def test_k8s_discovery_set_draining_is_immediate():
+    # No watch event fires for a router-initiated drain (the pod keeps
+    # running), so the flag must flip on the live EndpointInfo directly.
+    from production_stack_tpu.router.service_discovery import (
+        K8sPodIPServiceDiscovery,
+    )
+
+    sd = K8sPodIPServiceDiscovery()
+    ep = make_endpoint("http://pod:8000")
+    sd.available_engines["pod"] = ep
+    sd.set_draining("http://pod:8000", True)
+    assert ep.draining
+    sd.set_draining("http://pod:8000", False)
+    assert not ep.draining
+
+
+async def test_disagg_fail_open_is_pool_scoped():
+    """An entirely-refused prefill pool must still fail open to a prefill
+    engine — healthy decode engines in the merged candidate list must not
+    mask it (breaker filtering happens after the label split)."""
+    from production_stack_tpu.router.routing.logic import DisaggregatedPrefillRouter
+
+    initialize_resilience(SimpleNamespace(breaker_failure_threshold=1))
+    router = DisaggregatedPrefillRouter(
+        prefill_model_labels=["prefill"], decode_model_labels=["decode"]
+    )
+    try:
+        p1 = make_endpoint("http://p1", label="prefill")
+        p2 = make_endpoint("http://p2", label="prefill")
+        d1 = make_endpoint("http://d1", label="decode")
+        reg = get_breaker_registry()
+        reg.record_failure("http://p1")
+        reg.record_failure("http://p2")
+        url = await route_with_resilience(
+            router, [p1, p2, d1], {}, {}, {}, {"max_tokens": 1}
+        )
+        assert url in ("http://p1", "http://p2")
+        # Decode pool (healthy) is unaffected.
+        url = await route_with_resilience(
+            router, [p1, p2, d1], {}, {}, {}, {"max_tokens": 8}
+        )
+        assert url == "http://d1"
+    finally:
+        DisaggregatedPrefillRouter.destroy()
+
+
+async def test_static_drain_reconcile_loop_clears_stale_marks():
+    """With health checks off, the lightweight reconcile loop re-probes
+    marked-draining engines and clears the mark once /is_draining reports
+    false — a drained-then-restarted static backend becomes routable
+    again without an operator /undrain."""
+    from aiohttp import web
+
+    from production_stack_tpu.router.service_discovery import StaticServiceDiscovery
+
+    draining = {"value": False}
+
+    async def is_draining(request):
+        return web.json_response({"is_draining": draining["value"]})
+
+    app = web.Application()
+    app.router.add_get("/is_draining", is_draining)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+    sd = StaticServiceDiscovery(
+        urls=[url], models=["m"], health_check_interval=0.05
+    )
+    try:
+        await sd.start()
+        draining["value"] = True
+        sd.set_draining(url, True)  # what the tagged-503 path does
+        await asyncio.sleep(0.2)
+        assert [e.draining for e in sd.get_endpoint_info()] == [True]
+        draining["value"] = False  # engine undrained/restarted
+        await asyncio.sleep(0.3)
+        assert [e.draining for e in sd.get_endpoint_info()] == [False]
+    finally:
+        sd.close()
+        await runner.cleanup()
+
+
+def test_request_stats_evicted_with_engine():
+    """Per-engine aggregates (incl. the failure counter) are dropped when an
+    engine leaves the fleet for good — the stats-side counterpart of
+    CircuitBreakerRegistry.evict, or pod churn grows the tables forever."""
+    from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+
+    mon = RequestStatsMonitor(sliding_window_size=10.0)
+    now = time.time()
+    mon.on_new_request("http://e1", "r1", now)
+    mon.on_request_failed("http://e1", "r1", now)
+    mon.on_request_complete("http://e1", "r1", now)
+    assert mon.get_request_stats(now)["http://e1"].failed_requests == 1
+    mon.evict_url("http://e1")
+    assert "http://e1" not in mon.get_request_stats(now)
+
+
+# ---------------------------------------------------------------------------
+# KV controller TTL (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_controller_ttl_expires_unlooked_up_instances():
+    state = ControllerState(instance_ttl=100.0)
+    state.register("http://a", "m", [1, 2, 3], replace=True)
+    state.register("http://b", "m", [1, 2], replace=True)
+    # Age a out without any lookup traffic touching it.
+    state.last_seen["http://a"] = time.time() - 200.0
+    state.expire()
+    assert "http://a" not in state.instances["m"]
+    assert "http://b" in state.instances["m"]
+    assert "http://a" not in state.last_seen
+
+
+def test_controller_lookup_skips_stale_engines():
+    state = ControllerState(instance_ttl=100.0)
+    state.register("http://stale", "m", [1, 2, 3], replace=True)
+    state.register("http://fresh", "m", [1], replace=True)
+    state.last_seen["http://stale"] = time.time() - 200.0
+    matches = state.lookup("m", [1, 2, 3])
+    assert "http://stale" not in matches
+    assert "http://fresh" in matches

@@ -1,0 +1,554 @@
+"""Ring-2 e2e for the resilience subsystem: real router app + 3 in-process
+fake engines with fault injection.
+
+Covers the acceptance scenario end to end: an engine killed mid-run under
+concurrent load produces zero failed non-streamed requests (failover), the
+dead engine's breaker opens then half-opens on recovery, over-limit traffic
+gets 429 + Retry-After, /drain lets in-flight requests finish while new
+ones route elsewhere, and client disconnects abort the upstream request —
+all observable via the pst_resilience_* Prometheus surface.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.kvserver.controller import create_controller_app
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+
+MODEL = "fake/model"
+
+# Fast-recovery resilience knobs so the whole ring stays sub-second-ish.
+RESILIENCE_ARGS = [
+    "--proxy-retries", "3",
+    "--retry-backoff", "0.01",
+    "--breaker-failure-threshold", "2",
+    "--breaker-recovery-time", "0.4",
+]
+
+
+class Cluster:
+    """Three named fake engines + a router, all on ephemeral localhost ports."""
+
+    def __init__(self, routing_logic="roundrobin", extra_args=None, speed=5000.0):
+        self.routing_logic = routing_logic
+        self.extra_args = extra_args if extra_args is not None else RESILIENCE_ARGS
+        self.speed = speed
+        self.engine_runners = []
+        self.engine_urls = []
+        self.engine_apps = []
+        self.router_runner = None
+        self.router_url = None
+
+    async def _start_site(self, app):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return runner, f"http://127.0.0.1:{port}"
+
+    async def __aenter__(self):
+        for i in range(3):
+            app = create_fake_engine_app(
+                model=MODEL, speed=self.speed, name=f"engine-{i}"
+            )
+            runner, url = await self._start_site(app)
+            self.engine_runners.append(runner)
+            self.engine_urls.append(url)
+            self.engine_apps.append(app)
+        argv = [
+            "--service-discovery", "static",
+            "--static-backends", ",".join(self.engine_urls),
+            "--static-models", ",".join([MODEL] * 3),
+            "--routing-logic", self.routing_logic,
+            "--engine-stats-interval", "0.2",
+            *self.extra_args,
+        ]
+        self.router_runner, self.router_url = await self._start_site(
+            create_app(parse_args(argv))
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.router_runner is not None:
+            await self.router_runner.cleanup()
+        for runner in self.engine_runners:
+            if runner is not None:
+                await runner.cleanup()
+        reset_router_singletons()
+
+    async def kill_engine(self, i: int) -> None:
+        await self.engine_runners[i].cleanup()
+        self.engine_runners[i] = None
+
+    def engine_state(self, i: int):
+        return self.engine_apps[i]["state"]
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+async def _completion(session, url, prompt="hi", max_tokens=4, **kw):
+    async with session.post(
+        f"{url}/v1/completions",
+        json={"model": MODEL, "prompt": prompt, "max_tokens": max_tokens},
+        **kw,
+    ) as resp:
+        return resp.status, resp.headers.get("X-Served-By"), await resp.read()
+
+
+async def _router_metrics(session, url) -> str:
+    async with session.get(f"{url}/metrics") as resp:
+        return await resp.text()
+
+
+async def _breaker_states(session, url) -> dict:
+    async with session.get(f"{url}/engines") as resp:
+        return {e["url"]: e["breaker"] for e in await resp.json()}
+
+
+async def test_failover_absorbs_killed_engine_under_concurrency():
+    """One engine killed mid-run + concurrent load → zero failed requests,
+    failovers observable in pst_resilience_* metrics."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Warm up across all three engines.
+            for _ in range(3):
+                status, _, _ = await _completion(s, c.router_url)
+                assert status == 200
+            await c.kill_engine(0)
+            results = await asyncio.gather(
+                *(_completion(s, c.router_url, prompt=f"p{i}") for i in range(24))
+            )
+            statuses = [r[0] for r in results]
+            assert statuses == [200] * 24, statuses
+            served = {r[1] for r in results}
+            assert "engine-0" not in served
+            assert served == {"engine-1", "engine-2"}
+            text = await _router_metrics(s, c.router_url)
+            assert "pst_resilience_failovers_total" in text
+            assert "pst_resilience_breaker_state" in text
+            failovers = [
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("pst_resilience_failovers_total ")
+            ][0]
+            assert failovers >= 1
+            # The dead engine's breaker tripped open (threshold 2).
+            states = await _breaker_states(s, c.router_url)
+            assert states[c.engine_urls[0]] == "open"
+
+
+async def test_breaker_opens_then_half_opens_then_recovers():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Arm engine-0 to 500 every generation; keep serving through
+            # failover until its breaker opens.
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail", json={"mode": "error"}
+            ) as resp:
+                assert resp.status == 200
+            for i in range(8):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"q{i}")
+                assert status == 200
+                assert by != "engine-0"
+            states = await _breaker_states(s, c.router_url)
+            assert states[c.engine_urls[0]] == "open"
+            # Heal the engine; after recovery_time the breaker half-opens.
+            async with s.post(f"{c.engine_urls[0]}/admin/heal") as resp:
+                assert resp.status == 200
+            await asyncio.sleep(0.5)  # > breaker-recovery-time (0.4)
+            states = await _breaker_states(s, c.router_url)
+            assert states[c.engine_urls[0]] == "half_open"
+            # Traffic probes it; a success closes the breaker and the
+            # engine serves again.
+            served = set()
+            for i in range(9):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"r{i}")
+                assert status == 200
+                served.add(by)
+            assert "engine-0" in served
+            states = await _breaker_states(s, c.router_url)
+            assert states[c.engine_urls[0]] == "closed"
+
+
+async def test_admission_sheds_over_limit_traffic_with_retry_after():
+    extra = RESILIENCE_ARGS + [
+        "--admission-rate", "5",
+        "--admission-burst", "2",
+        "--admission-queue-size", "2",
+        "--admission-queue-timeout", "0.3",
+    ]
+    async with Cluster(extra_args=extra) as c:
+        async with aiohttp.ClientSession() as s:
+            async def one(i):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": MODEL, "prompt": f"f{i}", "max_tokens": 2},
+                ) as resp:
+                    return resp.status, resp.headers.get("Retry-After")
+
+            results = await asyncio.gather(*(one(i) for i in range(20)))
+            statuses = [r[0] for r in results]
+            assert set(statuses) <= {200, 429}, statuses
+            shed = [r for r in results if r[0] == 429]
+            ok = [r for r in results if r[0] == 200]
+            assert shed, "over-limit burst should shed some traffic"
+            assert ok, "admission must not shed everything"
+            for _, retry_after in shed:
+                assert retry_after is not None and int(retry_after) >= 1
+            text = await _router_metrics(s, c.router_url)
+            assert "pst_resilience_sheds_total" in text
+            # GET endpoints bypass admission entirely.
+            async with s.get(f"{c.router_url}/health") as resp:
+                assert resp.status == 200
+
+
+async def test_drain_finishes_inflight_and_reroutes_new_requests():
+    # Slow engines so the in-flight stream outlives the drain + new traffic.
+    async with Cluster(speed=60.0) as c:
+        async with aiohttp.ClientSession() as s:
+            resp = await s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "long", "max_tokens": 30,
+                      "stream": True},
+            )
+            assert resp.status == 200
+            served_by = None
+            chunks = []
+
+            async def consume():
+                nonlocal served_by
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                        if served_by is None:
+                            # X-Served-By is set per request; streaming fake
+                            # engines put it on the response headers.
+                            served_by = resp.headers.get("X-Served-By")
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.1)  # a few tokens in
+            served_by = resp.headers.get("X-Served-By")
+            assert served_by is not None
+            victim = int(served_by.rsplit("-", 1)[1])
+            # Drain the serving engine THROUGH the router admin proxy.
+            async with s.post(
+                f"{c.router_url}/drain",
+                params={"url": c.engine_urls[victim]},
+            ) as dr:
+                assert dr.status == 200
+                body = await dr.json()
+                assert body[c.engine_urls[victim]]["status"] == "draining"
+            async with s.get(
+                f"{c.router_url}/is_draining",
+                params={"url": c.engine_urls[victim]},
+            ) as dq:
+                assert (await dq.json())[c.engine_urls[victim]]["is_draining"]
+            # New requests keep succeeding and avoid the draining engine
+            # (its 503s fail over before the breaker even matters).
+            for i in range(6):
+                status, by, _ = await _completion(
+                    s, c.router_url, prompt=f"n{i}", max_tokens=2
+                )
+                assert status == 200
+                assert by != served_by
+            # The in-flight stream finishes completely.
+            await asyncio.wait_for(task, timeout=10)
+            assert len(chunks) == 30
+            # Undrain restores the engine to the pool.
+            async with s.post(
+                f"{c.router_url}/undrain",
+                params={"url": c.engine_urls[victim]},
+            ) as ur:
+                assert ur.status == 200
+            # The drained engine's 503s may have tripped its breaker; wait
+            # out the recovery window so it can half-open and be probed.
+            await asyncio.sleep(0.5)
+            served = set()
+            for i in range(9):
+                status, by, _ = await _completion(
+                    s, c.router_url, prompt=f"u{i}", max_tokens=2
+                )
+                assert status == 200
+                served.add(by)
+            assert served_by in served
+            resp.close()
+
+
+async def test_hung_backend_times_out_and_fails_over():
+    """A backend that accepts the request and goes silent must not hang the
+    client: with --proxy-read-timeout set, the attempt times out, feeds the
+    breaker, and fails over to a healthy engine."""
+    extra = RESILIENCE_ARGS + ["--proxy-read-timeout", "0.4"]
+    async with Cluster(extra_args=extra) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail", json={"mode": "hang", "count": 1}
+            ) as resp:
+                assert resp.status == 200
+            # Round-robin walks all three engines; the one that lands on the
+            # hung engine-0 must still come back 200 via timeout + failover.
+            results = await asyncio.wait_for(
+                asyncio.gather(
+                    *(_completion(s, c.router_url, prompt=f"h{i}") for i in range(3))
+                ),
+                timeout=10,
+            )
+            assert [r[0] for r in results] == [200] * 3
+            assert c.engine_state(0).num_faulted == 1
+            text = await _router_metrics(s, c.router_url)
+            retries = [
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("pst_resilience_retries_total{")
+                and c.engine_urls[0] in line
+            ]
+            assert retries and retries[0] >= 1
+
+
+async def test_router_drain_marks_endpoint_immediately():
+    """Router-initiated drain must mark the endpoint in discovery at once
+    (no probe/watch cycle in between — this cluster runs no health checks),
+    so new traffic routes around it instead of bouncing off its 503s."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/drain", params={"url": c.engine_urls[1]}
+            ) as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/engines") as resp:
+                flags = {e["url"]: e["draining"] for e in await resp.json()}
+            assert flags[c.engine_urls[1]] is True
+            for i in range(6):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"d{i}")
+                assert status == 200
+                assert by != "engine-1"
+            # Routing avoided the engine outright — it never saw a
+            # generation (a 503-then-failover bounce would have).
+            assert c.engine_state(1).requests_seen == []
+            async with s.post(
+                f"{c.router_url}/undrain", params={"url": c.engine_urls[1]}
+            ) as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/engines") as resp:
+                flags = {e["url"]: e["draining"] for e in await resp.json()}
+            assert flags[c.engine_urls[1]] is False
+            served = set()
+            for i in range(9):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"e{i}")
+                assert status == 200
+                served.add(by)
+            assert "engine-1" in served
+
+
+DISAGG_ARGS = RESILIENCE_ARGS + [
+    "--static-model-labels", "prefill,prefill,decode",
+    "--prefill-model-labels", "prefill",
+    "--decode-model-labels", "decode",
+]
+
+
+async def test_disagg_prefill_drain_reroutes_without_tripping_breaker():
+    """A drained prefill engine: the prefill leg re-routes within the pool,
+    marks discovery, and leaves the breaker closed (same drain rule as the
+    main proxy path)."""
+    async with Cluster(
+        routing_logic="disaggregated_prefill", extra_args=DISAGG_ARGS
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{c.engine_urls[0]}/drain") as resp:
+                assert resp.status == 200
+            prefill_served = set()
+            for i in range(4):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": MODEL, "prompt": f"pd{i}", "max_tokens": 4},
+                ) as resp:
+                    assert resp.status == 200
+                    prefill_served.add(resp.headers.get("X-Prefill-Url"))
+                    await resp.read()
+            assert prefill_served == {c.engine_urls[1]}
+            async with s.get(f"{c.router_url}/engines") as resp:
+                info = {e["url"]: e for e in await resp.json()}
+            assert info[c.engine_urls[0]]["draining"] is True
+            assert info[c.engine_urls[0]]["breaker"] == "closed"
+
+
+async def test_disagg_prefill_failover_on_dead_engine():
+    """A dead prefill engine: the prefill leg fails over to the surviving
+    pool member (zero client-visible errors) and the dead engine's breaker
+    opens — an all-refused prefill pool would still fail open per-pool."""
+    async with Cluster(
+        routing_logic="disaggregated_prefill", extra_args=DISAGG_ARGS
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            await c.kill_engine(0)
+            prefill_served = set()
+            for i in range(6):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": MODEL, "prompt": f"pk{i}", "max_tokens": 4},
+                ) as resp:
+                    assert resp.status == 200
+                    prefill_served.add(resp.headers.get("X-Prefill-Url"))
+                    await resp.read()
+            assert prefill_served == {c.engine_urls[1]}
+            states = await _breaker_states(s, c.router_url)
+            assert states[c.engine_urls[0]] == "open"
+
+
+async def test_engine_initiated_drain_reconciles_via_traffic():
+    """An engine drained directly (the preStop-hook shape) while the router
+    runs no health probes: the proxy recognizes the X-PST-Draining-tagged
+    503, fails the request over, marks the endpoint draining in discovery,
+    and leaves its breaker and failure stats untouched."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Drain engine 0 behind the router's back.
+            async with s.post(f"{c.engine_urls[0]}/drain") as resp:
+                assert resp.status == 200
+            for i in range(6):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"t{i}")
+                assert status == 200
+                assert by != "engine-0"
+            async with s.get(f"{c.router_url}/engines") as resp:
+                info = {e["url"]: e for e in await resp.json()}
+            assert info[c.engine_urls[0]]["draining"] is True
+            # Deliberate drain rejections are not failures: breaker closed,
+            # no upstream-failure series for the drained engine.
+            assert info[c.engine_urls[0]]["breaker"] == "closed"
+            text = await _router_metrics(s, c.router_url)
+            assert (
+                f'pst_resilience_upstream_failures_total{{server="{c.engine_urls[0]}"}}'
+                not in text
+            )
+
+
+async def test_admin_endpoints_require_router_api_key():
+    """With --api-key set, mutating admin endpoints (/drain, /undrain) are
+    guarded like /v1 — an unauthenticated client must not be able to drain
+    the fleet. Read-only probes stay open."""
+    async with Cluster(extra_args=RESILIENCE_ARGS + ["--api-key", "sekrit"]) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/drain", params={"url": c.engine_urls[0]}
+            ) as resp:
+                assert resp.status == 401
+            async with s.get(f"{c.router_url}/engines") as resp:
+                flags = {e["url"]: e["draining"] for e in await resp.json()}
+            assert flags[c.engine_urls[0]] is False  # nothing was marked
+            async with s.get(f"{c.router_url}/is_draining") as resp:
+                assert resp.status == 200
+            hdrs = {"Authorization": "Bearer sekrit"}
+            async with s.post(
+                f"{c.router_url}/drain", params={"url": c.engine_urls[0]},
+                headers=hdrs,
+            ) as resp:
+                assert resp.status == 200
+            async with s.post(
+                f"{c.router_url}/undrain", params={"url": c.engine_urls[0]},
+                headers=hdrs,
+            ) as resp:
+                assert resp.status == 200
+
+
+async def test_client_disconnect_aborts_upstream_request():
+    async with Cluster(speed=20.0) as c:
+        async with aiohttp.ClientSession() as s:
+            resp = await s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 200,
+                      "stream": True},
+            )
+            assert resp.status == 200
+            await resp.content.read(64)  # a couple of chunks
+            resp.close()  # client walks away mid-stream
+            # The router must abort the upstream request: the fake engine's
+            # running count returns to 0 well before the 10s of stream left.
+            def running_total():
+                return sum(c.engine_state(i).num_running for i in range(3))
+
+            for _ in range(40):
+                await asyncio.sleep(0.1)
+                if running_total() == 0:
+                    break
+            assert running_total() == 0
+            text = await _router_metrics(s, c.router_url)
+            disconnects = [
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("pst_resilience_client_disconnects_total ")
+            ][0]
+            assert disconnects >= 1
+
+
+async def test_no_retry_after_first_streamed_byte():
+    """An engine dying mid-stream must truncate, not replay, the stream
+    (a retry would duplicate already-delivered tokens)."""
+    async with Cluster(speed=100.0) as c:
+        async with aiohttp.ClientSession() as s:
+            # Arm exactly one midstream death; the engines that serve the
+            # retries (there must be none) would answer normally.
+            for url in c.engine_urls:
+                async with s.post(
+                    f"{url}/admin/fail",
+                    json={"mode": "midstream", "count": 1},
+                ) as resp:
+                    assert resp.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 30,
+                      "stream": True},
+            ) as resp:
+                assert resp.status == 200
+                payload = await resp.content.read()
+            seen = payload.decode(errors="replace")
+            # Stream is truncated (no [DONE]) and nothing was replayed:
+            # tok0 appears exactly once across the whole body.
+            assert seen.count("tok0 ") == 1
+            assert "data: [DONE]" not in seen
+            text = await _router_metrics(s, c.router_url)
+            assert "pst_resilience_upstream_failures_total" in text
+
+
+async def test_kv_controller_instances_expire_without_lookups():
+    """Satellite: /instances self-expires and a periodic task ages out
+    engines that never see lookup traffic."""
+    app = create_controller_app(instance_ttl=0.2)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/register",
+                json={"url": "http://e1", "model": "m", "hashes": [1, 2]},
+            ) as resp:
+                assert resp.status == 200
+            async with s.get(f"{url}/instances") as resp:
+                data = await resp.json()
+                assert data == {"m": {"http://e1": 2}}
+            await asyncio.sleep(0.3)  # > instance_ttl, no lookups in between
+            async with s.get(f"{url}/instances") as resp:
+                data = await resp.json()
+                assert data == {"m": {}}
+            assert app["expire_task"] is not None and not app["expire_task"].done()
+    finally:
+        await runner.cleanup()
