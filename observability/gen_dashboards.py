@@ -169,6 +169,35 @@ def fleet_dashboard():
                   0, 46))
     p.append(stat("Draining engines",
                   'pst_resilience_draining_engines', 4, 46))
+    # Row 8 — deadlines & hedging (docs/resilience.md).
+    p.append(panel("Request budget at admission (p50/p90/p99 ms)", [
+        ('histogram_quantile(0.5, sum(rate(pst_deadline_budget_ms_bucket[2m])) by (le))', "p50"),
+        ('histogram_quantile(0.9, sum(rate(pst_deadline_budget_ms_bucket[2m])) by (le))', "p90"),
+        ('histogram_quantile(0.99, sum(rate(pst_deadline_budget_ms_bucket[2m])) by (le))', "p99"),
+    ], 0, 50, unit="ms"))
+    p.append(panel("Deadline sheds by stage (router + engine)", [
+        ('sum(rate(pst_deadline_sheds_total[2m])) by (stage)',
+         "router {{stage}} /s"),
+        ('sum(rate(pst:deadline_shed_admission[2m]))', "engine admission /s"),
+        ('sum(rate(pst:deadline_shed_queued[2m]))', "engine queued /s"),
+        ('sum(rate(pst:deadline_shed_running[2m]))', "engine running /s"),
+    ], 8, 50))
+    p.append(panel("Hedging (fired / won / cancelled / suppressed)", [
+        ('sum(rate(pst_hedge_fired_total[2m]))', "fired /s"),
+        ('sum(rate(pst_hedge_won_total[2m]))', "won /s"),
+        ('sum(rate(pst_hedge_cancelled_total[2m]))', "cancelled /s"),
+        ('sum(rate(pst_hedge_suppressed_total[2m])) by (reason)',
+         "suppressed {{reason}} /s"),
+    ], 16, 50))
+    p.append(stat("Hedge win rate (2m)",
+                  'sum(rate(pst_hedge_won_total[2m])) / '
+                  'clamp_min(sum(rate(pst_hedge_fired_total[2m])), 1e-9)',
+                  0, 57))
+    p.append(stat("Deadline sheds /s",
+                  'sum(rate(pst_deadline_sheds_total[2m])) + '
+                  'sum(rate(pst:deadline_shed_queued[2m])) + '
+                  'sum(rate(pst:deadline_shed_running[2m])) or vector(0)',
+                  4, 57))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

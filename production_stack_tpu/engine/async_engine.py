@@ -140,6 +140,7 @@ class AsyncLLMEngine:
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         lora_name: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> AsyncIterator[RequestOutput]:
         if self.step_error is not None:
             raise RuntimeError(f"engine is failed: {self.step_error}")
@@ -158,6 +159,7 @@ class AsyncLLMEngine:
                             sampling=sampling,
                             arrival_time=time.time(),
                             lora_name=lora_name,
+                            deadline=deadline,
                         ),
                     )
                 )

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,7 +71,13 @@ class HostKVPool:
 
 
 class RemoteKVClient:
-    """Blocking HTTP client for the remote KV block server (engine thread)."""
+    """Blocking HTTP client for the remote KV block server (engine thread).
+
+    Every call is bounded by ``timeout`` (connect + read — a hung kvserver
+    must surface as a tier miss, never hang the engine step thread), and
+    callers on a request deadline can tighten it per call so a block fetch
+    never outlives the request's remaining budget.
+    """
 
     def __init__(self, base_url: str, timeout: float = 5.0):
         import requests
@@ -79,24 +86,35 @@ class RemoteKVClient:
         self.timeout = timeout
         self._session = requests.Session()
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> bool:
+    def _effective_timeout(self, timeout: Optional[float]) -> float:
+        if timeout is None:
+            return self.timeout
+        return max(min(self.timeout, timeout), 0.001)
+
+    def put(
+        self, h: int, k: np.ndarray, v: np.ndarray,
+        timeout: Optional[float] = None,
+    ) -> bool:
         try:
             payload = _serialize_page(k, v)
             r = self._session.put(
                 f"{self.base_url}/blocks/{h}",
                 data=payload,
                 headers={"Content-Type": "application/octet-stream"},
-                timeout=self.timeout,
+                timeout=self._effective_timeout(timeout),
             )
             return r.status_code == 200
         except Exception as e:  # noqa: BLE001 — remote tier is best-effort
             logger.debug("remote KV put failed: %s", e)
             return False
 
-    def get(self, h: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def get(
+        self, h: int, timeout: Optional[float] = None
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         try:
             r = self._session.get(
-                f"{self.base_url}/blocks/{h}", timeout=self.timeout
+                f"{self.base_url}/blocks/{h}",
+                timeout=self._effective_timeout(timeout),
             )
             if r.status_code != 200:
                 return None
@@ -224,14 +242,26 @@ class TieredAllocator(BlockAllocator):
 
     # -- fault up ---------------------------------------------------------
 
-    def _fetch_lower_tier(self, h: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def _fetch_lower_tier(
+        self, h: int, deadline: Optional[float] = None
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """``deadline`` is a monotonic expiry (Sequence.deadline): the host
+        pool is always consulted (memcpy-fast), but a remote fetch is
+        bounded by the remaining budget and skipped entirely once the
+        budget is gone — recomputing the prefix beats blocking an expired
+        request's shed on a DCN round trip."""
         if self.host_pool is not None:
             page = self.host_pool.get(h)
             if page is not None:
                 self.host_hit_blocks += 1
                 return page
         if self.remote is not None:
-            page = self.remote.get(h)
+            remaining: Optional[float] = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+            page = self.remote.get(h, timeout=remaining)
             if page is not None:
                 self.remote_hit_blocks += 1
                 if self.host_pool is not None:  # promote to the warmer tier
@@ -255,7 +285,10 @@ class TieredAllocator(BlockAllocator):
         return self.commit(blk, h)
 
     def match_prefix(
-        self, token_ids: Sequence[int], salt: int = 0
+        self,
+        token_ids: Sequence[int],
+        salt: int = 0,
+        deadline: Optional[float] = None,
     ) -> Tuple[List[int], List[int]]:
         self.query_tokens += len(token_ids)
         if not self.enable_prefix_caching:
@@ -266,7 +299,7 @@ class TieredAllocator(BlockAllocator):
         for h in hashes:
             blk = self.acquire_cached(h)
             if blk is None:
-                page = self._fetch_lower_tier(h)
+                page = self._fetch_lower_tier(h, deadline=deadline)
                 if page is None:
                     break
                 try:

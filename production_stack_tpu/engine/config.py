@@ -141,6 +141,11 @@ class EngineConfig:
     # consumer: fault pages up from the remote store at admission
     # (TieredAllocator.match_prefix — the NIXL-receiver analogue).
     kv_role: str = "none"  # none | producer | consumer | both
+    # Deadline shedding (docs/resilience.md "Deadlines & hedging"): honor
+    # the router-propagated X-PST-Deadline-Ms budget — 504 expired work at
+    # admission, drop expired queued sequences before they consume a
+    # prefill step, and stop decoding expired running sequences.
+    deadline_shedding: bool = True
 
 
 # Known per-chip HBM for backends whose memory_stats() is empty (the tunnel-

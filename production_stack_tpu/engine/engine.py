@@ -89,6 +89,7 @@ class LLMEngine:
                 decode_lookahead=2 if cfg.async_decode else 1,
                 spec_tokens=0 if cfg.async_decode else cfg.speculative_ngram,
                 swap_quantum=cfg.swap_quantum_tokens,
+                deadline_shedding=cfg.deadline_shedding,
             ),
             self.allocator,
             swapper=self.swapper,
@@ -152,6 +153,7 @@ class LLMEngine:
         sampling: Optional[SamplingParams] = None,
         arrival_time: Optional[float] = None,
         lora_name: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> Sequence:
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
@@ -178,6 +180,7 @@ class LLMEngine:
             lora_idx=lora_idx,
             lora_scale=lora_scale,
             cache_salt=salt,
+            deadline=deadline if self.cfg.deadline_shedding else None,
         )
         self._last_arrival = time.time()
         self.scheduler.add(seq)
@@ -311,6 +314,7 @@ class LLMEngine:
             locked = frozenset(s.request_id for s in self._burst_seqs)
             sched = self.scheduler.schedule(locked=locked, n_decode=hint)
             self.num_preempted_total += len(sched.preempted)
+            outputs += self._finish_expired(sched.expired)
             if self._can_continue_burst(sched):
                 if self._burst_n > self.cfg.num_decode_steps:
                     self.adaptive_deep_bursts_total += 1
@@ -341,6 +345,7 @@ class LLMEngine:
         else:
             sched = self.scheduler.schedule(n_decode=hint)
         self.num_preempted_total += len(sched.preempted)
+        outputs += self._finish_expired(sched.expired)
         if sched.is_empty:
             self._sweep_retiring_slots()
             return outputs
@@ -496,6 +501,29 @@ class LLMEngine:
                 if seq.is_finished:
                     break
         return outputs
+
+    def _finish_expired(self, expired) -> List[RequestOutput]:
+        """Surface scheduler deadline sheds to their waiting clients: the
+        sequence is already finished (pages released, finish_reason
+        "deadline"); emit the terminal RequestOutput so the HTTP layer can
+        answer 504 (non-streaming) or close the stream (streaming)."""
+        outs: List[RequestOutput] = []
+        for seq in expired:
+            if seq.request_id not in self._seqs:
+                continue
+            self._seqs.pop(seq.request_id, None)
+            self._detok.pop(seq.request_id, None)
+            outs.append(
+                RequestOutput(
+                    request_id=seq.request_id,
+                    finished=True,
+                    finish_reason="deadline",
+                    num_prompt_tokens=seq.num_prompt_tokens,
+                    num_output_tokens=len(seq.output_token_ids),
+                    num_cached_prompt_tokens=seq.num_cached_prompt_tokens,
+                )
+            )
+        return outs
 
     def _process_prefill_rows(self, prefills, rows) -> List[RequestOutput]:
         """``rows is None`` for dispatch-only steps (no chunk completed a
@@ -764,6 +792,12 @@ class LLMEngine:
             "prefix_cache_hit_rate": self.allocator.hit_rate,
             "prefix_cache_hits_total": float(self.allocator.hit_tokens),
             "prefix_cache_queries_total": float(self.allocator.query_tokens),
+            "deadline_sheds_queued_total": float(
+                self.scheduler.deadline_sheds_queued
+            ),
+            "deadline_sheds_running_total": float(
+                self.scheduler.deadline_sheds_running
+            ),
         }
         if self.cfg.speculative_ngram:
             out["spec_decode_num_draft_tokens_total"] = float(

@@ -145,12 +145,18 @@ class BlockAllocator:
     # -- prefix lookup ----------------------------------------------------
 
     def match_prefix(
-        self, token_ids: Sequence[int], salt: int = 0
+        self,
+        token_ids: Sequence[int],
+        salt: int = 0,
+        deadline: Optional[float] = None,
     ) -> Tuple[List[int], List[int]]:
         """Longest resident prefix of ``token_ids`` at block granularity.
 
         ``salt`` seeds the hash chain (LoRA adapters salt by adapter name so
         base-model KV never serves adapter requests and vice versa).
+        ``deadline`` (monotonic; used by the tiered allocator) bounds
+        lower-tier fetches to the request's remaining budget — the base
+        allocator is HBM-only and ignores it.
         Returns (matched block ids — increfed, their hashes). Callers start
         computing at ``len(matched) * block_size``.
         """

@@ -15,6 +15,7 @@ care about XLA.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Deque, List, Optional
 
@@ -44,6 +45,10 @@ class SchedulerConfig:
     # its last (re)admission, it may rotate out in favor of a parked or
     # waiting one. 0 = rotate only under allocation pressure.
     swap_quantum: int = 0
+    # Deadline shedding: drop sequences whose end-to-end budget
+    # (Sequence.deadline, monotonic) expired — queued ones before they
+    # consume a prefill step, running ones between decode steps.
+    deadline_shedding: bool = True
 
 
 @dataclasses.dataclass
@@ -58,6 +63,10 @@ class SchedulerOutput:
     prefills: List[PrefillItem] = dataclasses.field(default_factory=list)
     decodes: List[Sequence] = dataclasses.field(default_factory=list)
     preempted: List[Sequence] = dataclasses.field(default_factory=list)
+    # Sequences shed this pass because their deadline expired (pages
+    # already released): the engine must surface finish_reason="deadline"
+    # to their waiting clients.
+    expired: List[Sequence] = dataclasses.field(default_factory=list)
     n_decode_steps: int = 1
     # A locked (in-flight-burst) sequence needed pages it could not get
     # without evicting another locked sequence: the engine must drain the
@@ -97,6 +106,9 @@ class Scheduler:
         # prefix match every step (it is O(prompt) hashing and would skew the
         # prefix-cache hit metrics with repeated counted hits).
         self._admit_blocked: Optional[tuple] = None
+        # Deadline-shed counters (engine stats → pst:deadline_shed_*).
+        self.deadline_sheds_queued = 0  # shed before any prefill step
+        self.deadline_sheds_running = 0  # shed between decode steps
 
     # -- queue ops --------------------------------------------------------
 
@@ -219,6 +231,10 @@ class Scheduler:
         self._locked = locked
         self._n_decode_hint = n_decode
         out = SchedulerOutput()
+        # Deadline sweep FIRST: an expired sequence must never consume a
+        # device step — not a prefill chunk, not a decode slot, not even an
+        # admission that pins pages.
+        self._shed_expired(out)
         self._admit(out)
         # Fair timeslicing: if parked/queued work remains after admission,
         # rotate out the running sequence with the most decode progress past
@@ -283,6 +299,36 @@ class Scheduler:
         return out
 
     # -- internals --------------------------------------------------------
+
+    def _shed_expired(self, out: SchedulerOutput) -> None:
+        """Drop sequences whose deadline budget is gone — the point of the
+        whole deadline subsystem is that this happens *before* a TPU step
+        is spent on them. Queued/parked sequences shed from the line
+        (``deadline_sheds_queued``); running ones shed between decode
+        steps (``deadline_sheds_running``). Sequences referenced by an
+        in-flight pipelined burst are skipped (the device still writes
+        through their pages) and caught on the post-drain pass."""
+        if not self.config.deadline_shedding:
+            return
+        now = time.monotonic()
+        locked = getattr(self, "_locked", frozenset())
+        for q, running in ((self.waiting, False), (self.swapped, False),
+                           (self.running, True)):
+            for seq in [s for s in q if s.deadline_expired(now)]:
+                if seq.request_id in locked:
+                    continue
+                q.remove(seq)
+                self._finish(seq, "deadline")
+                if running:
+                    self.deadline_sheds_running += 1
+                else:
+                    self.deadline_sheds_queued += 1
+                    self._admit_blocked = None  # free pages changed
+                out.expired.append(seq)
+                logger.info(
+                    "shedding request %s (deadline exceeded while %s)",
+                    seq.request_id, "running" if running else "queued",
+                )
 
     def _rotate(self, out: SchedulerOutput) -> None:
         """Swap out at most ONE quantum-expired running sequence per pass
@@ -378,7 +424,8 @@ class Scheduler:
                 toks = seq.all_token_ids
                 matchable = toks[: len(toks) - 1]
                 blocks, hashes = self.allocator.match_prefix(
-                    matchable, salt=getattr(seq, "cache_salt", 0)
+                    matchable, salt=getattr(seq, "cache_salt", 0),
+                    deadline=seq.deadline,
                 )
                 if blocks:
                     seq.adopt_cached_prefix(blocks, hashes)

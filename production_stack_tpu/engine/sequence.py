@@ -110,6 +110,7 @@ class Sequence:
         lora_idx: int = 0,
         lora_scale: float = 0.0,
         cache_salt: int = 0,
+        deadline: Optional[float] = None,
     ):
         self.request_id = request_id
         self.prompt_token_ids: List[int] = list(prompt_token_ids)
@@ -126,6 +127,10 @@ class Sequence:
         self.lora_idx = lora_idx
         self.lora_scale = lora_scale
         self.cache_salt = cache_salt
+        # Monotonic (time.monotonic) expiry of the request's end-to-end
+        # latency budget; None = no deadline. The scheduler sheds expired
+        # sequences before they consume device steps.
+        self.deadline = deadline
 
         # KV bookkeeping.
         self.block_ids: List[int] = []
@@ -166,6 +171,11 @@ class Sequence:
     @property
     def is_finished(self) -> bool:
         return self.status == SequenceStatus.FINISHED
+
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.monotonic()) >= self.deadline
 
     # -- KV paging --------------------------------------------------------
 

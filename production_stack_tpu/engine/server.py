@@ -38,6 +38,7 @@ from prometheus_client import (
 
 from .. import __version__
 from ..logging_utils import init_logger
+from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
 from ..protocols import (
     ChatCompletionRequest,
     ChatMessage,
@@ -69,6 +70,15 @@ def _drain_error():
     # the circuit breaker.
     return _error("engine is draining", 503, "service_unavailable",
                   headers={"X-PST-Draining": "1"})
+
+
+def _deadline_error():
+    # Instant 504 for work whose router-propagated budget is already gone:
+    # cheaper to shed at HTTP admission than to let the scheduler drop it.
+    # The marker header tells the router this was a deliberate budget shed,
+    # not an engine failure.
+    return _error("deadline exceeded", 504, "deadline_exceeded",
+                  headers={DEADLINE_EXCEEDED_HEADER: "1"})
 
 
 class EngineMetrics:
@@ -146,6 +156,20 @@ class EngineMetrics:
             "pst:adaptive_deep_bursts",
             "decode bursts executed at the adaptive deep depth",
         )
+        # Deadline shedding by stage (docs/resilience.md): admission counts
+        # at the HTTP layer; queued/running refresh from scheduler stats.
+        self.deadline_shed_admission = counter(
+            "pst:deadline_shed_admission",
+            "requests shed at HTTP admission (budget already expired)",
+        )
+        self.deadline_shed_queued = counter(
+            "pst:deadline_shed_queued",
+            "queued sequences shed before consuming a prefill step",
+        )
+        self.deadline_shed_running = counter(
+            "pst:deadline_shed_running",
+            "running sequences shed between decode steps",
+        )
         self.swap_out = counter(
             "pst:kv_swap_out", "sequences swapped out (KV parked)"
         )
@@ -218,6 +242,14 @@ class EngineMetrics:
         self._counter_to(
             self.adaptive_deep, "deep",
             stats.get("adaptive_deep_bursts_total", 0),
+        )
+        self._counter_to(
+            self.deadline_shed_queued, "dl_queued",
+            stats.get("deadline_sheds_queued_total", 0),
+        )
+        self._counter_to(
+            self.deadline_shed_running, "dl_running",
+            stats.get("deadline_sheds_running_total", 0),
         )
 
 
@@ -384,6 +416,22 @@ def create_engine_app(
                 return requested_model
         return None
 
+    def _request_deadline(request: web.Request):
+        """``(error_response, deadline)``: parse the router-propagated
+        ``X-PST-Deadline-Ms`` budget. Already expired → instant 504 (the
+        cheapest shed point — no tokenization, no scheduler admission);
+        otherwise the monotonic expiry to carry on the Sequence so the
+        scheduler can shed it if the budget dies while queued/running."""
+        if not engine.engine.cfg.deadline_shedding:
+            return None, None
+        d = parse_deadline(request.headers)
+        if d is None:
+            return None, None
+        if d.expired():
+            metrics.deadline_shed_admission.inc()
+            return _deadline_error(), None
+        return None, d.expires_at
+
     # -- model listing -------------------------------------------------
 
     async def list_models(request: web.Request) -> web.Response:
@@ -450,6 +498,9 @@ def create_engine_app(
         """OpenAI batched completions: one choice per prompt, index-aligned."""
         tok = engine.engine.tokenizer
         max_len = engine.engine.cfg.max_model_len
+        err, deadline = _request_deadline(request)
+        if err is not None:
+            return err
         created = int(time.time())
         rid = random_id("cmpl")
         start = time.time()
@@ -470,7 +521,9 @@ def create_engine_app(
             except ValueError as e:
                 return {"error": str(e), "ids": ids}
             parts, n_out, finish = [], 0, None
-            async for out in engine.generate(prompt_token_ids=ids, sampling=sampling):
+            async for out in engine.generate(
+                prompt_token_ids=ids, sampling=sampling, deadline=deadline
+            ):
                 parts.append(out.text_delta)
                 n_out = out.num_output_tokens
                 finish = out.finish_reason or finish
@@ -482,6 +535,10 @@ def create_engine_app(
         results = await asyncio.gather(*(one(p) for p in prompts))
         if any("error" in r for r in results):
             return _error(next(r["error"] for r in results if "error" in r))
+        if any(r.get("finish") == "deadline" for r in results):
+            # The budget died while part of the batch was still queued or
+            # decoding: the batch cannot complete within its deadline.
+            return _deadline_error()
         usage = {
             "prompt_tokens": sum(r["n_in"] for r in results),
             "completion_tokens": sum(r["n_out"] for r in results),
@@ -536,6 +593,9 @@ def create_engine_app(
             sampling = build_sampling(req, max_len, len(ids), tok)
         except ValueError as e:
             return _error(str(e))
+        err, deadline = _request_deadline(request)
+        if err is not None:
+            return err
         rid = random_id("chatcmpl" if is_chat else "cmpl")
         created = int(time.time())
         start = time.time()
@@ -562,12 +622,12 @@ def create_engine_app(
                 return _error("streaming with n/best_of > 1 is not supported")
             return await _serve_n_choices(
                 req, ids, sampling, rid, created, is_chat, n_choices, echo,
-                lora, best_of,
+                lora, best_of, deadline=deadline,
             )
 
         gen = engine.generate(
             prompt_token_ids=ids, sampling=sampling, request_id=rid,
-            lora_name=lora,
+            lora_name=lora, deadline=deadline,
         )
 
         if req.stream:
@@ -664,6 +724,10 @@ def create_engine_app(
         except ValueError as e:  # engine-thread rejection → HTTP 400
             await engine.abort(rid)
             return _error(str(e))
+        if result["finish_reason"] == "deadline":
+            # Shed by the scheduler (queued past its budget, or expired
+            # mid-decode): nothing useful to return — 504, tagged.
+            return _deadline_error()
         usage = {
             "prompt_tokens": len(ids),
             "completion_tokens": len(result["token_ids"]),
@@ -727,7 +791,7 @@ def create_engine_app(
 
     async def _serve_n_choices(
         req, ids, sampling, rid, created, is_chat, n_choices, echo, lora,
-        best_of=None,
+        best_of=None, deadline=None,
     ) -> web.Response:
         """OpenAI `n` / `best_of`: sample ``best_of`` independent candidates
         of one prompt (the prompt prefix is KV-shared across them via the
@@ -753,7 +817,7 @@ def create_engine_app(
             )
             return await _collect(engine.generate(
                 prompt_token_ids=ids, sampling=sp, request_id=f"{rid}-{i}",
-                lora_name=lora,
+                lora_name=lora, deadline=deadline,
             ))
 
         try:
@@ -767,6 +831,8 @@ def create_engine_app(
             for i in range(n_sample):
                 await engine.abort(f"{rid}-{i}")
             return _error(str(e))
+        if any(r["finish_reason"] == "deadline" for r in results):
+            return _deadline_error()
         # OpenAI bills EVERY best_of candidate in completion_tokens.
         sampled_tokens = sum(len(r["token_ids"]) for r in results)
         if rank:
@@ -807,6 +873,13 @@ def create_engine_app(
             req = EmbeddingRequest(**await request.json())
         except Exception as e:  # noqa: BLE001
             return _error(f"invalid request body: {e}")
+        if engine.draining:
+            # Same admission gate as the generation endpoints: encode work
+            # accepted after /drain would race the preStop SIGTERM.
+            return _drain_error()
+        err, _ = _request_deadline(request)
+        if err is not None:
+            return err
         tok = engine.engine.tokenizer
         inputs = req.input if isinstance(req.input, list) else [req.input]
         if inputs and isinstance(inputs[0], int):
@@ -865,6 +938,11 @@ def create_engine_app(
         return await _similarity(texts_a, texts_b)
 
     async def rerank(request: web.Request) -> web.Response:
+        if engine.draining:
+            return _drain_error()
+        err, _ = _request_deadline(request)
+        if err is not None:
+            return err
         body = await request.json()
         query = body.get("query", "")
         docs = body.get("documents", [])
@@ -885,6 +963,11 @@ def create_engine_app(
         )
 
     async def score(request: web.Request) -> web.Response:
+        if engine.draining:
+            return _drain_error()
+        err, _ = _request_deadline(request)
+        if err is not None:
+            return err
         body = await request.json()
         t1 = body.get("text_1", "")
         t2 = body.get("text_2", "")
@@ -1131,6 +1214,12 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     # style HF dir or a bert preset). Without it those endpoints fall back
     # to embedding cosine similarity.
     p.add_argument("--scoring-model", default=None)
+    # Deadline shedding (docs/resilience.md "Deadlines & hedging"): honor
+    # the router-propagated X-PST-Deadline-Ms budget.
+    p.add_argument("--deadline-shedding", dest="deadline_shedding",
+                   action="store_true", default=True)
+    p.add_argument("--no-deadline-shedding", dest="deadline_shedding",
+                   action="store_false")
     return p.parse_args(argv)
 
 
@@ -1177,6 +1266,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         cache_controller_url=args.cache_controller_url,
         engine_url=args.engine_url,
         kv_role=args.kv_role,
+        deadline_shedding=args.deadline_shedding,
     )
 
 

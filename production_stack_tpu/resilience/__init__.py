@@ -12,7 +12,10 @@ router, so the protections live here natively:
   of ``route_general_request``.
 - :mod:`retry` — backoff schedule for proxy retry/failover (only ever
   before the first streamed byte reaches the client).
-- :mod:`metrics` — the ``pst_resilience_*`` Prometheus surface.
+- :mod:`deadline` — end-to-end deadline/budget propagation
+  (``X-PST-Deadline-Ms``) and the tail-latency hedging policy.
+- :mod:`metrics` — the ``pst_resilience_*`` / ``pst_deadline_*`` /
+  ``pst_hedge_*`` Prometheus surface.
 
 Lifecycle mirrors the other router singletons (initialize/get/teardown);
 ``get_*`` accessors return ``None`` when the subsystem is not configured
@@ -25,16 +28,26 @@ from typing import Optional
 
 from .admission import AdmissionController
 from .breaker import BreakerState, CircuitBreaker, CircuitBreakerRegistry
+from .deadline import (
+    DEADLINE_EXCEEDED_HEADER,
+    DEADLINE_HEADER,
+    Deadline,
+    HedgePolicy,
+    parse_deadline,
+)
 from .retry import RetryPolicy
 
 _breaker_registry: Optional[CircuitBreakerRegistry] = None
 _admission_controller: Optional[AdmissionController] = None
 _retry_policy: Optional[RetryPolicy] = None
+_hedge_policy: Optional[HedgePolicy] = None
+_default_deadline_ms: float = 0.0
 
 
 def initialize_resilience(args) -> None:
     """Create the resilience singletons from parsed router args."""
     global _breaker_registry, _admission_controller, _retry_policy
+    global _hedge_policy, _default_deadline_ms
     _breaker_registry = CircuitBreakerRegistry(
         failure_threshold=getattr(args, "breaker_failure_threshold", 5),
         recovery_time=getattr(args, "breaker_recovery_time", 10.0),
@@ -52,6 +65,15 @@ def initialize_resilience(args) -> None:
         connect_timeout=getattr(args, "proxy_connect_timeout", 30.0),
         read_timeout=getattr(args, "proxy_read_timeout", 0.0),
     )
+    _default_deadline_ms = float(getattr(args, "default_deadline_ms", 0) or 0)
+    _hedge_policy = HedgePolicy(
+        enabled=bool(getattr(args, "hedge_enabled", False)),
+        delay_ms=float(getattr(args, "hedge_delay_ms", 0.0) or 0.0),
+        quantile=float(getattr(args, "hedge_quantile", 0.9)),
+        max_outstanding_ratio=float(
+            getattr(args, "hedge_max_outstanding_ratio", 0.25)
+        ),
+    )
 
 
 def get_breaker_registry() -> Optional[CircuitBreakerRegistry]:
@@ -66,13 +88,24 @@ def get_retry_policy() -> Optional[RetryPolicy]:
     return _retry_policy
 
 
+def get_hedge_policy() -> Optional[HedgePolicy]:
+    return _hedge_policy
+
+
+def get_default_deadline_ms() -> float:
+    return _default_deadline_ms
+
+
 def teardown_resilience() -> None:
     global _breaker_registry, _admission_controller, _retry_policy
+    global _hedge_policy, _default_deadline_ms
     if _admission_controller is not None:
         _admission_controller.close()
     _breaker_registry = None
     _admission_controller = None
     _retry_policy = None
+    _hedge_policy = None
+    _default_deadline_ms = 0.0
 
 
 __all__ = [
@@ -80,10 +113,17 @@ __all__ = [
     "BreakerState",
     "CircuitBreaker",
     "CircuitBreakerRegistry",
+    "DEADLINE_EXCEEDED_HEADER",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "HedgePolicy",
     "RetryPolicy",
     "initialize_resilience",
     "get_breaker_registry",
     "get_admission_controller",
     "get_retry_policy",
+    "get_hedge_policy",
+    "get_default_deadline_ms",
+    "parse_deadline",
     "teardown_resilience",
 ]

@@ -14,6 +14,12 @@ the router app). Semantics:
   ``Retry-After`` when the queue is full, when the bucket cannot possibly
   produce its token within ``queue_timeout`` (no point parking it), or
   when its wait actually exceeds ``queue_timeout``.
+- Requests carrying an end-to-end budget (``X-PST-Deadline-Ms``,
+  :mod:`.deadline`) additionally cap their queue wait at the remaining
+  budget, and the *dequeue* re-checks the budget against ``min_budget``
+  (the proxy's connect-timeout floor): a request granted its token just
+  under the wire with ~0 budget left is doomed work and is shed with the
+  ``expired`` reason (mapped to 504 upstream) instead of being forwarded.
 
 ``rate <= 0`` disables rate limiting entirely (every request admitted).
 """
@@ -29,6 +35,7 @@ from typing import List, Optional, Tuple
 
 from ..logging_utils import init_logger
 from . import metrics
+from .deadline import Deadline
 
 logger = init_logger(__name__)
 
@@ -39,7 +46,9 @@ class TokenBucket:
         self.capacity = max(1, burst)
         self.tokens = float(self.capacity)
         # Anchored on first use so callers may drive the bucket on any
-        # monotonic timebase (tests pass synthetic timestamps).
+        # monotonic timebase (tests pass synthetic timestamps). Defaults
+        # ride time.monotonic(): an NTP step must neither freeze refill
+        # nor grant a burst for free.
         self.last_refill: Optional[float] = None
 
     def _refill(self, now: float) -> None:
@@ -52,7 +61,7 @@ class TokenBucket:
             self.last_refill = now
 
     def try_acquire(self, now: Optional[float] = None) -> bool:
-        now = now if now is not None else time.time()
+        now = now if now is not None else time.monotonic()
         self._refill(now)
         if self.tokens >= 1.0:
             self.tokens -= 1.0
@@ -61,7 +70,7 @@ class TokenBucket:
 
     def time_until_tokens(self, n: float, now: Optional[float] = None) -> float:
         """Seconds until ``n`` tokens are available (0 if already there)."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else time.monotonic()
         self._refill(now)
         if self.tokens >= n:
             return 0.0
@@ -71,7 +80,7 @@ class TokenBucket:
 @dataclass
 class AdmissionDecision:
     admitted: bool
-    reason: str = ""  # queue_full | deadline | timeout
+    reason: str = ""  # queue_full | deadline | timeout | expired
     retry_after: float = 0.0
 
     @property
@@ -149,12 +158,25 @@ class AdmissionController:
 
     # -- public API -------------------------------------------------------
 
-    async def admit(self, priority: int = 0) -> AdmissionDecision:
-        """Admit, queue, or shed one request. Priority: higher served first."""
+    async def admit(
+        self,
+        priority: int = 0,
+        deadline: Optional[Deadline] = None,
+        min_budget: float = 0.0,
+    ) -> AdmissionDecision:
+        """Admit, queue, or shed one request. Priority: higher served first.
+
+        ``deadline`` (optional end-to-end budget) caps the queue wait at
+        the remaining budget; ``min_budget`` is the proxy's minimum viable
+        attempt cost (connect-timeout floor) that the *dequeue* re-checks —
+        a request granted its token with less budget than that left cannot
+        complete and is shed as ``expired`` instead of forwarded."""
         if not self.enabled:
             metrics.admitted_total.inc()
             return _ADMIT
-        now = time.time()
+        now = time.monotonic()
+        if deadline is not None and deadline.expired():
+            return self._shed("expired", 0.0)
         if not self._heap and self.bucket.try_acquire(now):
             metrics.admitted_total.inc()
             return _ADMIT
@@ -163,6 +185,12 @@ class AdmissionController:
             return self._shed(
                 "queue_full", self.bucket.time_until_tokens(queue_len + 1, now)
             )
+        # The wait is bounded by the queue timeout AND the request's own
+        # remaining budget — parking a 200ms-budget request for 5s of queue
+        # timeout would just shed it later, at higher cost.
+        wait_budget = self.queue_timeout
+        if deadline is not None:
+            wait_budget = min(wait_budget, max(deadline.remaining_s(), 0.0))
         # Deadline check up front: if the bucket cannot produce this
         # request's token before the deadline even in the best case, shed
         # now instead of parking doomed work in the queue. Only waiters the
@@ -170,7 +198,7 @@ class AdmissionController:
         # high-priority request must not be shed because the queue is full
         # of low-priority work it would jump.
         est = self.bucket.time_until_tokens(self._waiters_ahead(priority) + 1, now)
-        if est > self.queue_timeout:
+        if est > wait_budget:
             return self._shed("deadline", est)
         self._ensure_dispatcher()
         self._seq += 1
@@ -180,10 +208,25 @@ class AdmissionController:
         metrics.queue_depth.set(self.queue_len())
         self._wakeup.set()
         try:
-            await asyncio.wait_for(fut, timeout=self.queue_timeout)
+            await asyncio.wait_for(fut, timeout=wait_budget)
         except asyncio.TimeoutError:
             metrics.queue_depth.set(self.queue_len())
+            # Distinguish WHY the wait ended: a wait capped by the
+            # request's own budget is a deadline shed (504 upstream), not
+            # a queue timeout (429 + Retry-After) — a client whose budget
+            # is dead must not be told to retry later.
+            if deadline is not None and (
+                deadline.expired() or deadline.remaining_s() < min_budget
+            ):
+                return self._shed("expired", 0.0)
             return self._shed("timeout", self.bucket.time_until_tokens(1.0))
+        # Dequeue re-check: the token was granted, but the wait may have
+        # eaten the budget down to where no attempt can fit — forwarding
+        # now would be doomed work the engine (or the proxy's own deadline
+        # gate) sheds later anyway. Shed here, where it is cheapest.
+        if deadline is not None and deadline.remaining_s() < min_budget:
+            metrics.queue_depth.set(self.queue_len())
+            return self._shed("expired", 0.0)
         metrics.admitted_total.inc()
         return _ADMIT
 

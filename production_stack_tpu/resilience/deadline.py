@@ -1,0 +1,206 @@
+"""End-to-end deadline/budget propagation + tail-latency request hedging.
+
+The missing layer under the SLO target: PR 1's retries, admission queue,
+and breakers all operate on *local* timeouts, so a request could be queued
+at the router, retried, queued again in the engine scheduler, and finally
+run long after the client gave up — burning TPU steps on dead work. This
+module gives every hop the request's *remaining* latency budget (gRPC-style
+deadline propagation) and lets the router hedge stragglers ("The Tail at
+Scale"): after a quantile-based delay, a second attempt goes to the
+next-best healthy engine and the first usable response wins.
+
+Wire contract (documented in docs/resilience.md):
+
+- ``X-PST-Deadline-Ms`` carries the remaining budget in milliseconds as a
+  *relative* value (like gRPC's ``grpc-timeout``), not an absolute
+  timestamp — clocks across hops never need to agree. Every hop converts
+  it to a monotonic deadline on arrival and re-serializes the remainder
+  when forwarding.
+- ``X-PST-Deadline-Exceeded: 1`` tags every 504 produced by a deadline
+  shed, wherever it happened (router admission, admission queue, proxy,
+  engine admission, scheduler).
+
+Deadlines ride ``time.monotonic()`` — wall-clock steps (NTP, leap smears)
+must never extend or shrink a budget.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional
+
+DEADLINE_HEADER = "X-PST-Deadline-Ms"
+DEADLINE_EXCEEDED_HEADER = "X-PST-Deadline-Exceeded"
+
+
+class Deadline:
+    """A monotonic deadline derived from a millisecond budget."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, budget_ms: float, now: Optional[float] = None):
+        now = now if now is not None else time.monotonic()
+        self.expires_at = now + budget_ms / 1000.0
+
+    def remaining_s(self, now: Optional[float] = None) -> float:
+        now = now if now is not None else time.monotonic()
+        return self.expires_at - now
+
+    def remaining_ms(self, now: Optional[float] = None) -> float:
+        return self.remaining_s(now) * 1000.0
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.remaining_s(now) <= 0.0
+
+    def header_value(self, now: Optional[float] = None) -> str:
+        """Remaining budget for downstream propagation. Ceil, not floor: a
+        live (not-yet-expired) deadline must never serialize to ``0``,
+        which the next hop would shed on arrival."""
+        return str(max(0, math.ceil(self.remaining_ms(now))))
+
+
+def parse_deadline(
+    headers, default_ms: float = 0.0, now: Optional[float] = None
+) -> Optional[Deadline]:
+    """Deadline from ``X-PST-Deadline-Ms`` (falling back to ``default_ms``;
+    ``None`` when neither applies). Malformed or negative header values are
+    ignored rather than erroring: a bad budget from one client must not
+    turn into request failures."""
+    raw = headers.get(DEADLINE_HEADER)
+    if raw is None:  # plain dicts from tests may carry other casing
+        lk = DEADLINE_HEADER.lower()
+        for k, v in headers.items():
+            if k.lower() == lk:
+                raw = v
+                break
+    if raw is not None:
+        try:
+            budget = float(raw)
+            if budget >= 0:
+                return Deadline(budget, now)
+        except (TypeError, ValueError):
+            pass
+    if default_ms and default_ms > 0:
+        return Deadline(default_ms, now)
+    return None
+
+
+def min_attempt_budget(policy) -> float:
+    """The budget floor below which forwarding (or retrying) is doomed
+    work: an attempt that cannot even fit the connect timeout inside the
+    remaining budget is guaranteed to blow the deadline. Deployments that
+    hand out tight budgets should set ``--proxy-connect-timeout``
+    comparable to real connect latency — the gates treat it as the
+    minimum viable attempt cost."""
+    if policy is None:
+        return 0.0
+    return float(policy.connect_timeout or 0.0)
+
+
+class LatencyTracker:
+    """Bounded reservoir of recent request latencies for quantile-based
+    hedge delays. Insertion is O(1); ``quantile`` sorts the (small) window
+    on demand — called once per hedge-eligible request."""
+
+    def __init__(self, window: int = 256):
+        self.window = max(8, window)
+        self._samples: List[float] = []
+        self._idx = 0
+
+    def observe(self, latency_s: float) -> None:
+        if len(self._samples) < self.window:
+            self._samples.append(latency_s)
+        else:
+            self._samples[self._idx] = latency_s
+            self._idx = (self._idx + 1) % self.window
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._samples:
+            return None
+        ordered = sorted(self._samples)
+        pos = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+        return ordered[pos]
+
+
+class HedgePolicy:
+    """When and whether the router may issue a tail-latency hedge.
+
+    - ``delay_ms > 0``: fixed hedge trigger delay.
+    - ``delay_ms == 0``: quantile-based — the delay tracks the observed
+      ``quantile`` of recent hedge-eligible latencies (Tail-at-Scale's
+      "defer to the p9x"), bounded below by ``min_delay_ms`` and falling
+      back to ``fallback_delay_ms`` until enough samples exist.
+    - ``max_outstanding_ratio`` caps outstanding hedges at
+      ``ceil(ratio * outstanding primaries)`` (floor 1, so a lone slow
+      request can still hedge) — hedging can *shift* load to healthy
+      engines but must never double fleet load during an incident.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        delay_ms: float = 0.0,
+        quantile: float = 0.9,
+        max_outstanding_ratio: float = 0.25,
+        min_delay_ms: float = 10.0,
+        fallback_delay_ms: float = 100.0,
+        min_samples: int = 16,
+    ):
+        self.enabled = enabled
+        self.delay_ms = delay_ms
+        self.quantile = quantile
+        self.max_outstanding_ratio = max(0.0, max_outstanding_ratio)
+        self.min_delay_ms = min_delay_ms
+        self.fallback_delay_ms = fallback_delay_ms
+        self.min_samples = min_samples
+        self.tracker = LatencyTracker()
+        self.outstanding_primaries = 0
+        self.outstanding_hedges = 0
+
+    # -- delay -------------------------------------------------------------
+
+    def delay_s(self) -> float:
+        if self.delay_ms > 0:
+            return self.delay_ms / 1000.0
+        if len(self.tracker) >= self.min_samples:
+            q = self.tracker.quantile(self.quantile)
+            if q is not None:
+                return max(q, self.min_delay_ms / 1000.0)
+        return self.fallback_delay_ms / 1000.0
+
+    def observe_latency(self, latency_s: float) -> None:
+        self.tracker.observe(latency_s)
+
+    # -- accounting --------------------------------------------------------
+
+    def note_request_start(self) -> None:
+        self.outstanding_primaries += 1
+
+    def note_request_end(self) -> None:
+        self.outstanding_primaries = max(0, self.outstanding_primaries - 1)
+
+    def try_acquire_hedge(self) -> bool:
+        cap = max(1, math.ceil(self.max_outstanding_ratio * self.outstanding_primaries))
+        if self.outstanding_hedges >= cap:
+            return False
+        self.outstanding_hedges += 1
+        return True
+
+    def release_hedge(self) -> None:
+        self.outstanding_hedges = max(0, self.outstanding_hedges - 1)
+
+
+def with_deadline_header(
+    headers: Dict[str, str], deadline: Optional[Deadline]
+) -> Dict[str, str]:
+    """Copy of ``headers`` carrying the *current* remaining budget — called
+    per attempt, so each retry/hedge/leg sees a smaller budget."""
+    if deadline is None:
+        return headers
+    out = dict(headers)
+    out[DEADLINE_HEADER] = deadline.header_value()
+    return out

@@ -5,7 +5,7 @@ Counters increment at event sites (breaker transitions, retries, sheds);
 gauges are refreshed by the router's ``/metrics`` handler from live state.
 """
 
-from prometheus_client import Counter, Gauge
+from prometheus_client import Counter, Gauge, Histogram
 
 breaker_state = Gauge(
     "pst_resilience_breaker_state",
@@ -48,4 +48,34 @@ client_disconnects_total = Counter(
 )
 draining_engines = Gauge(
     "pst_resilience_draining_engines", "Engines currently draining"
+)
+
+# -- deadlines & hedging (docs/resilience.md "Deadlines & hedging") ---------
+
+deadline_budget_ms = Histogram(
+    "pst_deadline_budget_ms",
+    "Latency budget (ms) of deadline-carrying requests at router admission",
+    buckets=(25, 50, 100, 200, 400, 800, 1600, 3200, 6400, 12800, 30000),
+)
+deadline_sheds_total = Counter(
+    "pst_deadline_sheds_total",
+    "Requests shed because their deadline budget was exhausted, by stage "
+    "(router_admission | router_queue | router_retry | router_proxy)",
+    ["stage"],
+)
+hedges_fired_total = Counter(
+    "pst_hedge_fired_total", "Tail-latency hedge attempts issued"
+)
+hedges_won_total = Counter(
+    "pst_hedge_won_total", "Hedge attempts whose response was served"
+)
+hedges_cancelled_total = Counter(
+    "pst_hedge_cancelled_total",
+    "Hedge attempts cancelled because the primary answered first",
+)
+hedges_suppressed_total = Counter(
+    "pst_hedge_suppressed_total",
+    "Hedge opportunities skipped, by reason "
+    "(capacity | breaker | budget | no_candidate)",
+    ["reason"],
 )

@@ -18,8 +18,16 @@ from aiohttp import web
 from ..logging_utils import init_logger
 from ..resilience import (
     get_admission_controller,
+    get_default_deadline_ms,
+    get_retry_policy,
     initialize_resilience,
     teardown_resilience,
+)
+from ..resilience import metrics as res_metrics
+from ..resilience.deadline import (
+    DEADLINE_EXCEEDED_HEADER,
+    min_attempt_budget,
+    parse_deadline,
 )
 from ..utils import parse_comma_separated, set_ulimit
 from .parser import parse_args
@@ -101,17 +109,52 @@ async def admission_middleware(request: web.Request, handler):
 
     Over-limit traffic is shed with 429 + ``Retry-After`` (deadline-based:
     a request that cannot get a token before its queue timeout is rejected
-    immediately instead of parking).
+    immediately instead of parking). Requests carrying an end-to-end
+    budget (``X-PST-Deadline-Ms``) cap their queue wait at the remaining
+    budget, and a dequeue whose budget can no longer fit even the connect
+    phase is shed with **504** (``expired``) instead of forwarded — the
+    request was admitted, but only to die downstream.
     """
     if request.method == "POST" and request.path in _ADMISSION_PATHS:
+        # Parse the budget once, here, for every downstream consumer
+        # (admission, routing, proxy attempts) — the monotonic deadline is
+        # anchored at arrival, so queue time counts against the budget.
+        deadline = parse_deadline(request.headers, get_default_deadline_ms())
+        if deadline is not None:
+            request["deadline"] = deadline
+            res_metrics.deadline_budget_ms.observe(
+                max(deadline.remaining_ms(), 0.0)
+            )
         controller = get_admission_controller()
         if controller is not None and controller.enabled:
             try:
                 priority = int(request.headers.get("X-Request-Priority", "0"))
             except ValueError:
                 priority = 0
-            decision = await controller.admit(priority)
+            decision = await controller.admit(
+                priority,
+                deadline=deadline,
+                min_budget=min_attempt_budget(get_retry_policy()),
+            )
             if not decision.admitted:
+                if decision.reason == "expired":
+                    res_metrics.deadline_sheds_total.labels(
+                        stage="router_queue"
+                    ).inc()
+                    return web.json_response(
+                        {
+                            "error": {
+                                "message": (
+                                    "deadline exceeded while queued for "
+                                    "admission"
+                                ),
+                                "type": "deadline_exceeded",
+                                "code": 504,
+                            }
+                        },
+                        status=504,
+                        headers={DEADLINE_EXCEEDED_HEADER: "1"},
+                    )
                 return web.json_response(
                     {
                         "error": {

@@ -105,6 +105,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-half-open-probes", type=int, default=1,
                    help="concurrent live probes allowed while half-open")
 
+    # Deadlines & hedging (docs/resilience.md "Deadlines & hedging")
+    p.add_argument("--default-deadline-ms", type=float, default=0.0,
+                   help="latency budget assigned to requests without an "
+                        "X-PST-Deadline-Ms header (0 = no deadline)")
+    p.add_argument("--hedge-enabled", action="store_true", default=False,
+                   help="hedge non-streaming idempotent requests against a "
+                        "second engine after the hedge delay")
+    p.add_argument("--hedge-delay-ms", type=float, default=0.0,
+                   help="hedge trigger delay in ms (0 = derive from the "
+                        "observed latency quantile)")
+    p.add_argument("--hedge-quantile", type=float, default=0.9,
+                   help="latency quantile the adaptive hedge delay tracks")
+    p.add_argument("--hedge-max-outstanding-ratio", type=float, default=0.25,
+                   help="cap outstanding hedges at this fraction of "
+                        "outstanding primaries (floor 1)")
+
     # Stats / metrics
     p.add_argument("--engine-stats-interval", type=float, default=15.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -184,6 +200,12 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--proxy-retries must be >= 0")
     if args.breaker_failure_threshold < 1:
         raise ValueError("--breaker-failure-threshold must be >= 1")
+    if args.default_deadline_ms < 0:
+        raise ValueError("--default-deadline-ms must be >= 0")
+    if args.hedge_max_outstanding_ratio < 0:
+        raise ValueError("--hedge-max-outstanding-ratio must be >= 0")
+    if not (0.0 < args.hedge_quantile < 1.0):
+        raise ValueError("--hedge-quantile must be in (0, 1)")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "disaggregated_prefill":

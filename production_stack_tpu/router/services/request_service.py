@@ -19,6 +19,21 @@ Resilience (no reference counterpart — the reference defers this to Envoy):
 - client disconnects mid-stream abort the upstream engine request instead
   of leaking a decoding sequence;
 - per-request outcomes feed the breakers and ``pst_resilience_*`` metrics.
+
+Deadlines & hedging (docs/resilience.md "Deadlines & hedging"):
+
+- every attempt (main path, retries, disagg legs) forwards the request's
+  *remaining* budget via ``X-PST-Deadline-Ms``; no attempt is made — and
+  no retry is scheduled — that cannot fit the connect timeout inside the
+  remaining budget (deadline sheds answer 504 + ``X-PST-Deadline-Exceeded``
+  and never feed the breakers: an exhausted budget is not engine failure);
+- non-streaming idempotent requests (completions/chat with
+  ``stream=false``, embeddings, rerank, score) may be *hedged*: after a
+  quantile-based delay a second attempt goes to the next-best healthy
+  engine, the first usable response wins, and the loser is cancelled
+  upstream. Hedges consult the breakers like any routing decision (a
+  half-open breaker's probe slot IS the hedge), never fire at an open
+  breaker, and are capped by ``HedgePolicy.max_outstanding_ratio``.
 """
 
 from __future__ import annotations
@@ -34,8 +49,21 @@ import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
-from ...resilience import get_breaker_registry, get_retry_policy
+from ...resilience import (
+    get_breaker_registry,
+    get_default_deadline_ms,
+    get_hedge_policy,
+    get_retry_policy,
+)
 from ...resilience import metrics as res_metrics
+from ...resilience.breaker import BreakerState
+from ...resilience.deadline import (
+    DEADLINE_EXCEEDED_HEADER,
+    Deadline,
+    min_attempt_budget,
+    parse_deadline,
+    with_deadline_header,
+)
 from ..routing.logic import (
     DisaggregatedPrefillRouter,
     get_routing_logic,
@@ -68,6 +96,27 @@ def _error_response(status: int, message: str, etype: str = "invalid_request_err
     return web.json_response(
         {"error": {"message": message, "type": etype, "code": status}}, status=status
     )
+
+
+def _deadline_response(message: str, stage: str) -> web.Response:
+    """504 for an exhausted budget, tagged so clients (and the tests) can
+    tell a deadline shed apart from a generic upstream timeout. Counts the
+    shed by stage; never feeds the breakers — an exhausted budget says
+    nothing about engine health."""
+    res_metrics.deadline_sheds_total.labels(stage=stage).inc()
+    return web.json_response(
+        {"error": {"message": message, "type": "deadline_exceeded", "code": 504}},
+        status=504,
+        headers={DEADLINE_EXCEEDED_HEADER: "1"},
+    )
+
+
+def _deadline_blocks_attempt(deadline: Optional[Deadline], extra: float = 0.0) -> bool:
+    """Whether the remaining budget can no longer fit one upstream attempt
+    (connect-timeout floor) plus ``extra`` (e.g. the retry backoff)."""
+    if deadline is None:
+        return False
+    return deadline.remaining_s() < min_attempt_budget(get_retry_policy()) + extra
 
 
 def _note_success(url: str) -> None:
@@ -122,6 +171,7 @@ async def proxy_and_stream(
     request_id: str,
     debug_headers: Optional[dict] = None,
     failover: Optional[FailoverFn] = None,
+    deadline: Optional[Deadline] = None,
 ) -> web.StreamResponse:
     """Forward the request to ``backend_url``/``endpoint`` and stream back.
 
@@ -134,6 +184,14 @@ async def proxy_and_stream(
     byte has reached the client the stream is committed — a mid-stream
     upstream death truncates, and a mid-stream client disconnect aborts
     the upstream request.
+
+    Deadline handling: every attempt forwards the *remaining* budget via
+    ``X-PST-Deadline-Ms``; a retry is only attempted if the budget still
+    fits backoff + connect timeout, and an exhausted budget answers 504
+    (``X-PST-Deadline-Exceeded``) without feeding the breakers. For
+    non-streaming requests the whole attempt is bounded by the remaining
+    budget; streams are bounded at connect only — once committed they run
+    to completion (the engine sheds expired sequences itself).
     """
     monitor = get_request_stats_monitor()
     callback = get_custom_callback_handler()
@@ -155,19 +213,40 @@ async def proxy_and_stream(
     url = backend_url
     tried = {url}
     attempt = 0
-    # Per-attempt timeouts (total stays unlimited — streams run as long as
-    # the generation does): connect bounds the TCP handshake, sock_read the
-    # gap between reads, so a black-holed backend raises a retryable
-    # TimeoutError instead of hanging the client forever.
-    attempt_timeout = aiohttp.ClientTimeout(
-        total=None,
-        connect=(policy.connect_timeout or None) if policy else None,
-        sock_read=(policy.read_timeout or None) if policy else None,
-    )
+    streaming = bool(parsed.get("stream"))
 
     completed = False
 
     while True:
+        if deadline is not None and deadline.expired():
+            # The budget died between attempts (backoff, slow routing):
+            # never forward work that is already expired.
+            return _deadline_response(
+                "deadline exceeded before upstream attempt", "router_proxy"
+            )
+        # Per-attempt timeouts: connect bounds the TCP handshake, sock_read
+        # the gap between reads, so a black-holed backend raises a
+        # retryable TimeoutError instead of hanging the client forever.
+        # With a deadline, non-streaming attempts are additionally bounded
+        # end-to-end by the remaining budget (recomputed per attempt);
+        # streams stay unbounded on total — the engine sheds expired
+        # sequences between decode steps itself.
+        remaining = deadline.remaining_s() if deadline is not None else None
+        connect_t = (policy.connect_timeout or None) if policy else None
+        if connect_t is not None and remaining is not None:
+            connect_t = min(connect_t, max(remaining, 0.001))
+        attempt_timeout = aiohttp.ClientTimeout(
+            total=(
+                max(remaining, 0.001)
+                if remaining is not None and not streaming
+                else None
+            ),
+            connect=connect_t,
+            sock_read=(policy.read_timeout or None) if policy else None,
+        )
+        fwd_headers = with_deadline_header(
+            _forwardable(request.headers), deadline
+        )
         collected = bytearray()
         response: Optional[web.StreamResponse] = None
         failure_noted = False  # at most one breaker/stats failure per attempt
@@ -189,7 +268,7 @@ async def proxy_and_stream(
                 request.method,
                 url + endpoint,
                 data=body,
-                headers=_forwardable(request.headers),
+                headers=fwd_headers,
                 timeout=attempt_timeout,
             ) as upstream:
                 ok = not (
@@ -197,6 +276,17 @@ async def proxy_and_stream(
                     if policy is not None
                     else upstream.status >= 500
                 )
+                if (
+                    not ok
+                    and upstream.status == 504
+                    and DEADLINE_EXCEEDED_HEADER in upstream.headers
+                ):
+                    # The engine shed this request's exhausted budget — a
+                    # deliberate deadline shed, not engine failure: no
+                    # breaker feed, and no retry (the budget downstream of
+                    # which the engine shed is gone for us too). Stream the
+                    # tagged 504 through.
+                    ok = True
                 if not ok:
                     if upstream.status == 503 and "X-PST-Draining" in upstream.headers:
                         # Deliberate drain rejection, not a failure: leave
@@ -209,7 +299,18 @@ async def proxy_and_stream(
                     else:
                         _note_failure(url, request_id)
                         failure_noted = True
-                    next_url = await _next_backend(failover, tried, attempt)
+                    backoff = policy.backoff(attempt) if policy else 0.0
+                    if _deadline_blocks_attempt(deadline, backoff):
+                        # A retry that cannot fit backoff + connect inside
+                        # the remaining budget is doomed work: shed instead
+                        # of forwarding (the 5xx still passes through below
+                        # when the budget is merely tight, 504 when gone).
+                        res_metrics.deadline_sheds_total.labels(
+                            stage="router_retry"
+                        ).inc()
+                        next_url = None
+                    else:
+                        next_url = await _next_backend(failover, tried, attempt)
                     if next_url is not None:
                         _complete()
                         logger.warning(
@@ -280,9 +381,9 @@ async def proxy_and_stream(
             aiohttp.ClientError, asyncio.TimeoutError, ConnectionResetError, OSError,
         ) as e:
             _complete()
-            if not failure_noted:
-                _note_failure(url, request_id)
             if response is not None and response.prepared:
+                if not failure_noted:
+                    _note_failure(url, request_id)
                 # Bytes already reached the client: the stream is committed.
                 # Truncate rather than retry (a replay would duplicate
                 # already-delivered tokens).
@@ -292,7 +393,28 @@ async def proxy_and_stream(
                 with contextlib.suppress(Exception):
                     await response.write_eof()
                 return response
-            next_url = await _next_backend(failover, tried, attempt)
+            if deadline is not None and deadline.expired():
+                # The budget ran out mid-attempt (the non-streaming total
+                # timeout fires exactly at the deadline): this is a client
+                # budget shed, not an engine failure — 504 and leave the
+                # breaker alone.
+                logger.info(
+                    "deadline exceeded during attempt to %s for %s",
+                    url, request_id,
+                )
+                return _deadline_response(
+                    "deadline exceeded during upstream attempt", "router_proxy"
+                )
+            if not failure_noted:
+                _note_failure(url, request_id)
+            backoff = policy.backoff(attempt) if policy else 0.0
+            if _deadline_blocks_attempt(deadline, backoff):
+                res_metrics.deadline_sheds_total.labels(
+                    stage="router_retry"
+                ).inc()
+                next_url = None
+            else:
+                next_url = await _next_backend(failover, tried, attempt)
             if next_url is None:
                 logger.error("backend %s failed for %s: %s", url, request_id, e)
                 return _error_response(502, f"backend error: {e}", "bad_gateway")
@@ -323,9 +445,331 @@ async def proxy_and_stream(
         return response
 
 
+# Endpoints that are always hedge-eligible (no streaming mode exists).
+_ALWAYS_HEDGEABLE = {"/v1/embeddings", "/v1/rerank", "/v1/score"}
+
+
+def hedge_eligible(endpoint: str, request_json: Optional[dict]) -> bool:
+    """Only non-streaming idempotent work may be hedged: a duplicate
+    completion/embedding is wasted compute, never wrong output — but a
+    stream is committed to one upstream after the first byte, and
+    mutating/admin endpoints must not execute twice."""
+    if endpoint in ("/v1/completions", "/v1/chat/completions"):
+        return not (request_json or {}).get("stream")
+    return endpoint in _ALWAYS_HEDGEABLE
+
+
+async def _buffered_attempt(
+    request: web.Request,
+    url: str,
+    endpoint: str,
+    body: bytes,
+    request_id: str,
+    deadline: Optional[Deadline],
+    suffix: str = "",
+):
+    """One fully-buffered upstream attempt (hedge path only — hedged
+    endpoints are all non-streaming, so buffering is safe and lets the
+    first *usable* response win the race). Returns
+    ``(status, headers, payload, url)``; raises on transport failure.
+    Feeds the breakers and request-stats monitor like any proxy attempt.
+    """
+    session: aiohttp.ClientSession = request.app["client_session"]
+    policy = get_retry_policy()
+    monitor = get_request_stats_monitor()
+    rid = request_id + suffix
+    fwd = with_deadline_header(_forwardable(request.headers), deadline)
+    remaining = deadline.remaining_s() if deadline is not None else None
+    timeout = aiohttp.ClientTimeout(
+        total=max(remaining, 0.001) if remaining is not None else None,
+        connect=(policy.connect_timeout or None) if policy else None,
+        sock_read=(policy.read_timeout or None) if policy else None,
+    )
+    monitor.on_new_request(url, rid, time.time())
+    try:
+        async with session.request(
+            request.method, url + endpoint, data=body, headers=fwd,
+            timeout=timeout,
+        ) as upstream:
+            payload = await upstream.read()
+            status = upstream.status
+            headers = {
+                k: v for k, v in upstream.headers.items()
+                if k.lower() not in _HOP_HEADERS
+            }
+    except asyncio.CancelledError:
+        # The race was decided against this attempt: closing the request
+        # aborts it upstream (the engine stops decoding for a loser).
+        monitor.on_request_complete(url, rid, time.time())
+        raise
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        monitor.on_request_complete(url, rid, time.time())
+        if not (deadline is not None and deadline.expired()):
+            _note_failure(url, rid)
+        raise
+    monitor.on_request_response(url, rid, time.time())
+    monitor.on_request_complete(url, rid, time.time())
+    if status == 503 and "X-PST-Draining" in headers:
+        get_service_discovery().set_draining(url, True)
+    elif status == 504 and DEADLINE_EXCEEDED_HEADER in headers:
+        pass  # deliberate budget shed: the engine is alive, not failing
+    elif status >= 500:
+        _note_failure(url, rid)
+    else:
+        _note_success(url)
+    return status, headers, payload, url
+
+
+def _attempt_result(task: asyncio.Task):
+    """Result of a done attempt task, or None if it failed/was cancelled."""
+    if task.cancelled() or task.exception() is not None:
+        return None
+    return task.result()
+
+
+async def proxy_with_hedge(
+    request: web.Request,
+    backend_url: str,
+    endpoint: str,
+    body: bytes,
+    request_id: str,
+    failover: FailoverFn,
+    deadline: Optional[Deadline],
+) -> web.StreamResponse:
+    """Tail-latency hedging ("The Tail at Scale") for non-streaming
+    idempotent requests: race the primary against one hedge attempt fired
+    after ``HedgePolicy.delay_s()``; first usable response wins, the loser
+    is cancelled upstream. The hedge pick goes through the same routing +
+    breaker path as a failover (a half-open breaker's probe slot IS the
+    hedge), never fires at an open breaker, and is capped by the hedge
+    budget so hedging cannot double fleet load during an incident."""
+    hedge = get_hedge_policy()
+    registry = get_breaker_registry()
+    policy = get_retry_policy()
+    start = time.time()
+    hedge.note_request_start()
+    hedge_acquired = False
+    tried = {backend_url}
+    primary = asyncio.ensure_future(
+        _buffered_attempt(request, backend_url, endpoint, body, request_id, deadline)
+    )
+    hedge_task: Optional[asyncio.Task] = None
+
+    async def _one_failover(failed_result) -> web.StreamResponse:
+        """Single failover after a failed attempt — plain retry semantics
+        (same gates as proxy_and_stream: ``--proxy-retries``, deadline
+        budget, tagged-504 pass-through), NOT a hedge."""
+        if failed_result is not None and (
+            failed_result[0] == 504
+            and DEADLINE_EXCEEDED_HEADER in failed_result[1]
+        ):
+            # The engine shed the budget deliberately: pass through, never
+            # replay work whose budget is gone downstream.
+            return _hedge_failure_response(failed_result)
+        if policy is not None and not policy.should_retry(0):
+            return _hedge_failure_response(failed_result)
+        if deadline is not None and deadline.expired():
+            return _deadline_response(
+                "deadline exceeded after upstream failure", "router_proxy"
+            )
+        if _deadline_blocks_attempt(deadline):
+            res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
+            return _hedge_failure_response(failed_result)
+        alt = await failover(tried)
+        if alt is None:
+            return _hedge_failure_response(failed_result)
+        res_metrics.retries_total.labels(server=backend_url).inc()
+        res_metrics.failovers_total.inc()
+        tried.add(alt)
+        try:
+            r = await _buffered_attempt(
+                request, alt, endpoint, body, request_id, deadline,
+                suffix="-retry",
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            if deadline is not None and deadline.expired():
+                return _deadline_response(
+                    "deadline exceeded during failover attempt", "router_proxy"
+                )
+            return _error_response(502, f"backend error: {e}", "bad_gateway")
+        return await _hedge_respond(request, endpoint, request_id, r)
+
+    try:
+        delay = hedge.delay_s()
+        if deadline is not None:
+            # Firing a hedge the budget can't cover is pure waste: leave at
+            # least one connect-floor of budget for the hedge attempt.
+            delay = min(
+                delay,
+                max(deadline.remaining_s() - min_attempt_budget(policy), 0.0),
+            )
+        done, _ = await asyncio.wait({primary}, timeout=delay)
+
+        if done:
+            result = _attempt_result(primary)
+            if result is not None and result[0] < 500:
+                hedge.observe_latency(time.time() - start)
+                return await _hedge_respond(request, endpoint, request_id, result)
+            # Primary failed before the hedge delay elapsed: plain failover.
+            return await _one_failover(result)
+
+        # Primary still in flight after the hedge delay: try to hedge.
+        suppressed = None
+        alt_url = await failover(tried)
+        if alt_url is None:
+            suppressed = "no_candidate"
+        elif (
+            registry is not None
+            and registry.get(alt_url).current_state() is BreakerState.OPEN
+        ):
+            # route_with_resilience fails open during a fleet-wide brownout;
+            # a hedge is optional work and must NOT ride that exception.
+            suppressed = "breaker"
+        elif _deadline_blocks_attempt(deadline):
+            suppressed = "budget"
+        elif not hedge.try_acquire_hedge():
+            suppressed = "capacity"
+        if suppressed is not None:
+            res_metrics.hedges_suppressed_total.labels(reason=suppressed).inc()
+            try:
+                result = await primary
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                if deadline is not None and deadline.expired():
+                    return _deadline_response(
+                        "deadline exceeded during upstream attempt",
+                        "router_proxy",
+                    )
+                return await _one_failover(None)
+            if result[0] >= 500:
+                # A suppressed hedge must not also lose the failover the
+                # plain proxy path would have run.
+                return await _one_failover(result)
+            hedge.observe_latency(time.time() - start)
+            return await _hedge_respond(request, endpoint, request_id, result)
+
+        hedge_acquired = True
+        tried.add(alt_url)
+        res_metrics.hedges_fired_total.inc()
+        logger.info(
+            "hedging %s: primary %s slow (>%.0fms), firing hedge to %s",
+            request_id, backend_url, delay * 1000, alt_url,
+        )
+        hedge_task = asyncio.ensure_future(
+            _buffered_attempt(
+                request, alt_url, endpoint, body, request_id, deadline,
+                suffix="-hedge",
+            )
+        )
+        pending = {primary, hedge_task}
+        winner = None
+        winner_is_hedge = False
+        while pending and winner is None:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                r = _attempt_result(t)
+                if r is not None and r[0] < 500:
+                    winner = r
+                    winner_is_hedge = t is hedge_task
+                    break
+        for t in pending:
+            t.cancel()
+            if t is hedge_task:
+                res_metrics.hedges_cancelled_total.inc()
+        if winner is None:
+            if deadline is not None and deadline.expired():
+                return _deadline_response(
+                    "deadline exceeded (primary and hedge)", "router_proxy"
+                )
+            last = _attempt_result(primary) or (
+                _attempt_result(hedge_task) if hedge_task.done() else None
+            )
+            # Both primary and hedge failed: the plain proxy path would
+            # still have retry budget — honor it with one more failover.
+            return await _one_failover(last)
+        if winner_is_hedge:
+            res_metrics.hedges_won_total.inc()
+        hedge.observe_latency(time.time() - start)
+        return await _hedge_respond(
+            request, endpoint, request_id, winner, hedged=winner_is_hedge
+        )
+    finally:
+        hedge.note_request_end()
+        if hedge_acquired:
+            hedge.release_hedge()
+        for t in (primary, hedge_task):
+            if t is not None and not t.done():
+                t.cancel()
+
+
+def _hedge_failure_response(result) -> web.Response:
+    """Both attempts failed: pass the last 5xx through unchanged — headers
+    included, so tagged sheds (X-PST-Deadline-Exceeded) survive — same rule
+    as proxy_and_stream with nowhere left to go; else a generic 502."""
+    if result is not None:
+        status, headers, payload, _ = result
+        resp = web.Response(body=payload, status=status)
+        for k, v in headers.items():
+            resp.headers[k] = v
+        return resp
+    return _error_response(502, "all upstream attempts failed", "bad_gateway")
+
+
+async def _hedge_respond(
+    request: web.Request,
+    endpoint: str,
+    request_id: str,
+    result,
+    hedged: bool = False,
+) -> web.Response:
+    """Materialize the winning buffered attempt as the client response,
+    running the same post-response hooks (semantic cache store, callbacks)
+    the streaming path runs."""
+    status, headers, payload, url = result
+    resp = web.Response(body=payload, status=status)
+    for k, v in headers.items():
+        resp.headers[k] = v
+    resp.headers["X-Request-Id"] = request_id
+    resp.headers["X-PST-Hedge"] = "won" if hedged else "primary"
+    if status < 400:
+        callback = get_custom_callback_handler()
+        semantic_store = request.app.get("semantic_cache_store")
+        parsed = request.get("parsed_json") or {}
+        if (
+            semantic_store is not None
+            and endpoint == "/v1/chat/completions"
+            and not parsed.get("stream")
+        ):
+            try:
+                await semantic_store(request, payload)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("semantic cache store failed: %s", e)
+        if callback is not None and callback.post_request is not None:
+            try:
+                await callback.call_post_request(request, payload)
+            except Exception as e:  # noqa: BLE001
+                logger.error("post_request callback failed: %s", e)
+    return resp
+
+
 async def route_general_request(request: web.Request, endpoint: str) -> web.StreamResponse:
     """Route an OpenAI-API request to an engine and stream the response."""
     request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    # End-to-end budget: parsed by the admission middleware (anchored at
+    # arrival, so queue time counts), or here for paths it does not cover.
+    deadline: Optional[Deadline] = request.get("deadline")
+    if deadline is None:
+        deadline = parse_deadline(request.headers, get_default_deadline_ms())
+        if deadline is not None:  # path the admission middleware skipped
+            res_metrics.deadline_budget_ms.observe(
+                max(deadline.remaining_ms(), 0.0)
+            )
+    if deadline is not None and deadline.expired():
+        # Cheapest shed point: nothing has been parsed, routed, or sent.
+        return _deadline_response(
+            "deadline exceeded before routing", "router_admission"
+        )
     body = await request.read()
     try:
         request_json = json.loads(body) if body else {}
@@ -401,14 +845,17 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         # An explicit pin is a debug escape hatch: bypass the routing policy
         # AND the resilience filters (breakers, drain) so an operator can
         # always reach the exact engine they asked for — and no failover,
-        # which would silently re-route off the pinned engine.
+        # which would silently re-route off the pinned engine. The deadline
+        # still propagates (the engine sheds expired work regardless).
         return await proxy_and_stream(
-            request, candidates[0].url, endpoint, body, request_id
+            request, candidates[0].url, endpoint, body, request_id,
+            deadline=deadline,
         )
 
     if is_disagg:
         return await route_disaggregated_prefill_request(
-            request, endpoint, request_json, candidates, request_id
+            request, endpoint, request_json, candidates, request_id,
+            deadline=deadline,
         )
 
     engine_stats = get_engine_stats_scraper().get_engine_stats()
@@ -421,9 +868,20 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     except ValueError as e:
         return _error_response(503, f"no backend available: {e}", "service_unavailable")
     logger.debug("routing %s for model %s to %s", request_id, requested_model, backend_url)
+    failover = make_failover(candidates, headers, request_json)
+    hedge = get_hedge_policy()
+    if (
+        hedge is not None
+        and hedge.enabled
+        and hedge_eligible(endpoint, request_json)
+    ):
+        return await proxy_with_hedge(
+            request, backend_url, endpoint, body, request_id, failover,
+            deadline,
+        )
     return await proxy_and_stream(
         request, backend_url, endpoint, body, request_id,
-        failover=make_failover(candidates, headers, request_json),
+        failover=failover, deadline=deadline,
     )
 
 
@@ -433,9 +891,15 @@ async def route_disaggregated_prefill_request(
     request_json: dict,
     endpoints: list,
     request_id: str,
+    deadline: Optional[Deadline] = None,
 ) -> web.StreamResponse:
     """Two-phase flow: prefill with max_tokens=1 (KV produced and shipped),
     then decode streams from the decode pool with the KV pulled in.
+
+    The deadline spans both legs: the prefill leg forwards the remaining
+    budget per attempt (and stops retrying when the budget cannot fit
+    another attempt), and whatever the prefill consumed is what the decode
+    leg has left.
     """
     router = get_routing_logic()
     monitor = get_request_stats_monitor()
@@ -462,20 +926,27 @@ async def route_disaggregated_prefill_request(
 
     session: aiohttp.ClientSession = request.app["client_session"]
     policy = get_retry_policy()
-    # Same per-attempt bounds and retry/failover semantics as
-    # proxy_and_stream — nothing from the prefill response reaches the
-    # client, so it is always safe to re-route. Without the timeout a
-    # black-holed prefill engine would hang the request forever with the
-    # breaker never fed.
-    attempt_timeout = aiohttp.ClientTimeout(
-        total=None,
-        connect=(policy.connect_timeout or None) if policy else None,
-        sock_read=(policy.read_timeout or None) if policy else None,
-    )
     failover = make_failover(endpoints, headers, prefill_json)
     tried = {prefill_url}
     attempt = 0
     while True:
+        if deadline is not None and deadline.expired():
+            return _deadline_response(
+                "deadline exceeded before prefill attempt", "router_proxy"
+            )
+        # Same per-attempt bounds and retry/failover semantics as
+        # proxy_and_stream — nothing from the prefill response reaches the
+        # client, so it is always safe to re-route. Without the timeout a
+        # black-holed prefill engine would hang the request forever with
+        # the breaker never fed. The prefill leg is non-streaming, so the
+        # remaining budget bounds the whole attempt.
+        remaining = deadline.remaining_s() if deadline is not None else None
+        attempt_timeout = aiohttp.ClientTimeout(
+            total=max(remaining, 0.001) if remaining is not None else None,
+            connect=(policy.connect_timeout or None) if policy else None,
+            sock_read=(policy.read_timeout or None) if policy else None,
+        )
+        fwd_headers = with_deadline_header(_forwardable(headers), deadline)
         t_prefill_start = time.time()
         monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
         error: Optional[str] = None
@@ -483,7 +954,7 @@ async def route_disaggregated_prefill_request(
         try:
             async with session.post(
                 prefill_url + endpoint, json=prefill_json,
-                headers=_forwardable(headers), timeout=attempt_timeout,
+                headers=fwd_headers, timeout=attempt_timeout,
             ) as resp:
                 draining = resp.status == 503 and "X-PST-Draining" in resp.headers
                 if not draining:
@@ -505,9 +976,19 @@ async def route_disaggregated_prefill_request(
             # Deliberate drain, not a failure (same rule as
             # proxy_and_stream): reconcile discovery, spare the breaker.
             get_service_discovery().set_draining(prefill_url, True)
+        elif deadline is not None and deadline.expired():
+            # Budget exhausted mid-prefill: a deadline shed, not a failure.
+            return _deadline_response(
+                "deadline exceeded during prefill", "router_proxy"
+            )
         else:
             _note_failure(prefill_url, request_id)
-        next_url = await _next_backend(failover, tried, attempt)
+        backoff = policy.backoff(attempt) if policy else 0.0
+        if _deadline_blocks_attempt(deadline, backoff):
+            res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
+            next_url = None
+        else:
+            next_url = await _next_backend(failover, tried, attempt)
         if next_url is None:
             return _error_response(
                 502,
@@ -545,6 +1026,7 @@ async def route_disaggregated_prefill_request(
         request_id,
         debug_headers={"X-Prefill-Url": prefill_url, "X-Decode-Url": decode_url},
         failover=make_failover(endpoints, headers, decode_json),
+        deadline=deadline,
     )
 
 

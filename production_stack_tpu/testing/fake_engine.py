@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import random
 import time
 import uuid
 from typing import List, Optional
@@ -43,14 +44,25 @@ class FakeEngineState:
         #   hang  — accept the request and never answer
         #   midstream — stream a few chunks, then die (tests the
         #               never-retry-after-first-byte rule)
+        #   slow  — inject fail_delay (+ up to fail_jitter) seconds of
+        #           latency before answering, honoring the propagated
+        #           X-PST-Deadline-Ms budget: when the injected delay would
+        #           blow the budget, reply 504 + X-PST-Deadline-Exceeded at
+        #           the deadline instead (deterministic hedging/shedding
+        #           tests)
         # fail_count > 0 limits the fault to the next N generations
         # (auto-heal); -1 = until POST /admin/heal.
         self.fail_mode: Optional[str] = None
         self.fail_status = 500
         self.fail_count = -1
+        self.fail_delay = 0.5
+        self.fail_jitter = 0.0
         self.num_faulted = 0
         # Graceful drain: new generations 503, in-flight ones finish.
         self.draining = False
+        # X-PST-Deadline-Ms header value (or None) per generation request,
+        # in arrival order — lets tests assert budget propagation/decay.
+        self.deadlines_seen: List[Optional[str]] = []
 
     def take_fault(self) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None."""
@@ -106,9 +118,34 @@ def create_fake_engine_app(
     async def list_models(request: web.Request) -> web.Response:
         return web.json_response(_models_payload(state))
 
+    def _deadline_budget_s(request: web.Request) -> Optional[float]:
+        """Remaining budget (seconds) from X-PST-Deadline-Ms, or None."""
+        raw = request.headers.get("X-PST-Deadline-Ms")
+        if raw is None:
+            return None
+        try:
+            return float(raw) / 1000.0
+        except ValueError:
+            return None
+
+    def _deadline_exceeded_response() -> web.Response:
+        return web.json_response(
+            {"error": {"message": "deadline exceeded",
+                       "type": "deadline_exceeded", "code": 504}},
+            status=504,
+            headers={"X-PST-Deadline-Exceeded": "1",
+                     "X-Served-By": state.name},
+        )
+
     async def _generate(request: web.Request, is_chat: bool) -> web.StreamResponse:
         body = await request.json()
         state.requests_seen.append(body)
+        budget = _deadline_budget_s(request)
+        state.deadlines_seen.append(request.headers.get("X-PST-Deadline-Ms"))
+        if budget is not None and budget <= 0:
+            # The real engine sheds already-expired work at admission; a
+            # router honoring the contract never forwards such a request.
+            return _deadline_exceeded_response()
         if state.draining:
             return web.json_response(
                 {"error": {"message": "engine is draining",
@@ -117,6 +154,18 @@ def create_fake_engine_app(
                 headers={"X-PST-Draining": "1"},
             )
         fault = state.take_fault()
+        if fault == "slow":
+            delay = state.fail_delay
+            if state.fail_jitter:
+                delay += random.uniform(0.0, state.fail_jitter)
+            if budget is not None and delay >= budget:
+                # The injected latency blows the budget: honor the deadline
+                # — sleep until it expires, then 504 (what a deadline-
+                # shedding engine does when a sequence expires mid-decode).
+                await asyncio.sleep(max(budget, 0.0))
+                return _deadline_exceeded_response()
+            await asyncio.sleep(delay)
+            # ... then serve normally below (slow, not broken).
         if fault == "error":
             return web.json_response(
                 {"error": {"message": "injected failure",
@@ -258,15 +307,19 @@ def create_fake_engine_app(
         return web.json_response({"is_sleeping": state.sleeping})
 
     async def admin_fail(request: web.Request) -> web.Response:
-        """Arm fault injection: {"mode": "error"|"hang"|"midstream",
-        "status": 500, "count": -1}."""
+        """Arm fault injection: {"mode": "error"|"hang"|"midstream"|"slow",
+        "status": 500, "count": -1, "delay": 0.5, "jitter": 0}. ``slow``
+        injects ``delay`` (+ uniform jitter up to ``jitter``) seconds of
+        latency per generation, honoring a propagated deadline with 504."""
         body = await request.json() if request.can_read_body else {}
         mode = body.get("mode", "error")
-        if mode not in ("error", "hang", "midstream"):
+        if mode not in ("error", "hang", "midstream", "slow"):
             return web.json_response({"error": f"unknown mode {mode!r}"}, status=400)
         state.fail_mode = mode
         state.fail_status = int(body.get("status", 500))
         state.fail_count = int(body.get("count", -1))
+        state.fail_delay = float(body.get("delay", 0.5))
+        state.fail_jitter = float(body.get("jitter", 0.0))
         return web.json_response({"status": "armed", "mode": mode})
 
     async def admin_heal(request: web.Request) -> web.Response:

@@ -54,8 +54,19 @@ def post(url: str, payload: dict, headers=None):
         headers={"Content-Type": "application/json", **(headers or {})},
         method="POST",
     )
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        return resp.status, resp.headers.get("X-Served-By"), resp.read()
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.headers.get("X-Served-By"), resp.read()
+    except urllib.error.HTTPError as e:
+        # Expected-error legs (deadline sheds) need the status + headers.
+        return e.code, e.headers.get("X-PST-Deadline-Exceeded"), e.read()
+
+
+def metric_value(metrics_text: str, name: str, label: str = "") -> float:
+    for line in metrics_text.splitlines():
+        if line.startswith(name) and (not label or label in line):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
 
 
 class Fleet:
@@ -229,18 +240,87 @@ def leg_stress():
     print("PASS stress (64 concurrent)")
 
 
+def leg_deadline():
+    """Deadline + hedging smoke: the REAL router with hedging enabled and
+    one fake engine in `slow` mode. Non-streaming requests complete within
+    budget via the hedge path (hedge-won counter > 0), already-expired
+    deadlines are never forwarded (504 at the router, shed counters
+    account for every one), and tail latency stays bounded by the hedge
+    delay rather than the injected slowness."""
+    import concurrent.futures
+
+    with Fleet("roundrobin",
+               router_args=["--proxy-retries", "2",
+                            "--retry-backoff", "0.01",
+                            "--breaker-failure-threshold", "10",
+                            "--hedge-enabled",
+                            "--hedge-delay-ms", "100",
+                            "--hedge-max-outstanding-ratio", "1.0"]) as f:
+        # Phase 1: expired budgets shed instantly at the router — zero
+        # forwarded (the fake engine would answer 504 itself if one leaked;
+        # the router's own shed counter must account for all of them).
+        for i in range(5):
+            status, exceeded, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"x{i}", "max_tokens": 2},
+                headers={"X-PST-Deadline-Ms": "0"},
+            )
+            assert status == 504, status
+            assert exceeded == "1"
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        sheds = metric_value(metrics, "pst_deadline_sheds_total",
+                             'stage="router_admission"')
+        assert sheds == 5, f"expected 5 admission sheds, saw {sheds}"
+
+        # Phase 2: one engine slow (2s injected latency), hedging on.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{f.engine_ports[0]}/admin/fail",
+            data=json.dumps({"mode": "slow", "delay": 2.0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+
+        def one(i):
+            t0 = time.time()
+            status, _, _ = post(f"{f.url}/v1/completions",
+                                {"model": MODEL, "prompt": f"d{i}",
+                                 "max_tokens": 2})
+            return status, time.time() - t0
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+            results = list(ex.map(one, range(18)))
+        statuses = Counter(s for s, _ in results)
+        assert statuses == Counter({200: 18}), statuses
+        worst = max(lat for _, lat in results)
+        # p100 bounded by hedge delay + healthy service time, not by the
+        # 2s injected slowness.
+        assert worst < 1.5, f"tail latency {worst:.2f}s not bounded by hedging"
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_hedge_fired_total") >= 1
+        assert metric_value(metrics, "pst_hedge_won_total") >= 1
+    print("PASS deadline (5/5 expired shed, 18/18 hedged within budget, "
+          f"worst {worst * 1000:.0f}ms)")
+
+
 def leg_chaos():
     """Chaos smoke: SIGKILL one engine mid-run under concurrent load. The
     router's retry/failover must absorb every request (zero client-visible
     failures) and the dead engine's circuit breaker must open — all
-    observable via pst_resilience_* metrics."""
+    observable via pst_resilience_* metrics. A second phase turns one of
+    the survivors `slow` mid-run and asserts hedging keeps p99 bounded."""
     import concurrent.futures
 
     with Fleet("roundrobin",
                router_args=["--proxy-retries", "2",
                             "--retry-backoff", "0.01",
                             "--breaker-failure-threshold", "2",
-                            "--breaker-recovery-time", "60"]) as f:
+                            "--breaker-recovery-time", "60",
+                            "--hedge-enabled",
+                            "--hedge-delay-ms", "100",
+                            "--hedge-max-outstanding-ratio", "1.0"]) as f:
         # Warm-up: all three engines serving.
         warm = Counter()
         for i in range(6):
@@ -281,7 +361,36 @@ def leg_chaos():
                 break
         else:
             raise AssertionError("no breaker_state sample for dead engine")
-    print("PASS chaos (engine killed mid-run, 40/40 served)", dict(served))
+
+        # Phase 2: one SURVIVOR turns slow mid-run (2s injected latency).
+        # Hedging must keep the tail bounded: requests landing on the slow
+        # engine complete via the hedge to the remaining healthy one.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{f.engine_ports[1]}/admin/fail",
+            data=json.dumps({"mode": "slow", "delay": 2.0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+
+        def timed(i):
+            t0 = time.time()
+            status, _, _ = post(f"{f.url}/v1/completions",
+                                {"model": MODEL, "prompt": f"s{i}",
+                                 "max_tokens": 2})
+            return status, time.time() - t0
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+            slow_results = list(ex.map(timed, range(20)))
+        slow_statuses = Counter(s for s, _ in slow_results)
+        assert slow_statuses == Counter({200: 20}), slow_statuses
+        worst = max(lat for _, lat in slow_results)
+        assert worst < 1.5, f"p99 {worst:.2f}s not bounded by hedging"
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_hedge_won_total") >= 1
+    print("PASS chaos (engine killed mid-run, 40/40 served; slow engine "
+          f"mid-run, 20/20 hedged, worst {worst * 1000:.0f}ms)", dict(served))
 
 
 LEGS = {
@@ -292,6 +401,7 @@ LEGS = {
     "disaggregated_prefill": leg_disagg,
     "stress": leg_stress,
     "chaos": leg_chaos,
+    "deadline": leg_deadline,
 }
 
 

@@ -1,0 +1,565 @@
+"""Deadline subsystem tests (docs/resilience.md "Deadlines & hedging").
+
+Ring 1: Deadline/parse units, admission dequeue re-check, scheduler
+shedding (an expired sequence never consumes a prefill step).
+Ring 2: real router app + in-process fake engines — budget parsing at
+admission, header propagation/decay across hops, deadline-gated retries,
+and the fake engine's `slow` fault mode honoring the propagated budget.
+"""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.engine.kv_manager import BlockAllocator
+from production_stack_tpu.engine.scheduler import Scheduler, SchedulerConfig
+from production_stack_tpu.engine.sequence import SamplingParams, Sequence
+from production_stack_tpu.resilience.admission import AdmissionController
+from production_stack_tpu.resilience.deadline import (
+    DEADLINE_EXCEEDED_HEADER,
+    DEADLINE_HEADER,
+    Deadline,
+    LatencyTracker,
+    parse_deadline,
+)
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+from .test_resilience_e2e import MODEL, Cluster, _completion, _router_metrics
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# Ring 1 — units
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_remaining_and_expiry():
+    d = Deadline(100.0, now=1000.0)
+    assert d.remaining_ms(now=1000.0) == pytest.approx(100.0)
+    assert not d.expired(now=1000.05)
+    assert d.expired(now=1000.1)
+    assert d.expired(now=1001.0)
+
+
+def test_header_value_never_serializes_live_deadline_to_zero():
+    d = Deadline(100.0, now=1000.0)
+    # 0.4ms left: still live, must propagate as >= 1, not 0 (the next hop
+    # sheds a 0 budget on arrival).
+    assert d.header_value(now=1000.0996) == "1"
+    # Ceil semantics (float epsilon may round one ms up, never down to 0).
+    assert int(d.header_value(now=1000.05)) in (50, 51)
+    # Expired clamps at 0 rather than going negative.
+    assert d.header_value(now=1001.0) == "0"
+
+
+def test_parse_deadline_header_default_and_garbage():
+    assert parse_deadline({}) is None
+    d = parse_deadline({DEADLINE_HEADER: "250"}, now=5.0)
+    assert d is not None and d.remaining_ms(now=5.0) == pytest.approx(250.0)
+    # Case-insensitive (plain dicts from tests / arbitrary clients).
+    assert parse_deadline({"x-pst-deadline-ms": "100"}) is not None
+    # Garbage and negative values are ignored, not errors.
+    assert parse_deadline({DEADLINE_HEADER: "soon"}) is None
+    assert parse_deadline({DEADLINE_HEADER: "-5"}) is None
+    # Default applies only when the header is absent/invalid.
+    d = parse_deadline({}, default_ms=500.0, now=1.0)
+    assert d is not None and d.remaining_ms(now=1.0) == pytest.approx(500.0)
+    d = parse_deadline({DEADLINE_HEADER: "100"}, default_ms=500.0, now=1.0)
+    assert d.remaining_ms(now=1.0) == pytest.approx(100.0)
+
+
+def test_latency_tracker_quantile():
+    t = LatencyTracker(window=16)
+    assert t.quantile(0.9) is None
+    for v in range(1, 11):  # 0.01 .. 0.10
+        t.observe(v / 100.0)
+    assert t.quantile(0.5) == pytest.approx(0.05)
+    assert t.quantile(0.9) == pytest.approx(0.09)
+    # Ring buffer: old samples rotate out.
+    for _ in range(32):
+        t.observe(1.0)
+    assert t.quantile(0.5) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Ring 1 — admission dequeue re-check (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+async def test_admission_caps_queue_wait_at_remaining_budget():
+    """A queued request whose budget is smaller than the queue timeout must
+    shed when the budget runs out — as ``expired`` (504 upstream, not a
+    429 'retry later' to a client whose deadline is already dead) — and
+    not park for the full queue timeout."""
+    ctrl = AdmissionController(rate=0.5, burst=1, max_queue=8, queue_timeout=30.0)
+    try:
+        first = await ctrl.admit()  # consumes the only token
+        assert first.admitted
+        t0 = time.monotonic()
+        decision = await ctrl.admit(deadline=Deadline(150.0))
+        waited = time.monotonic() - t0
+        assert not decision.admitted
+        assert decision.reason in ("expired", "deadline")
+        assert waited < 5.0  # nowhere near queue_timeout=30
+    finally:
+        ctrl.close()
+
+
+async def test_admission_budget_capped_wait_sheds_expired_not_timeout():
+    """Regression: a wait that ends because the request's own budget ran
+    out must report ``expired``, never ``timeout`` — the middleware maps
+    the former to 504 + X-PST-Deadline-Exceeded, the latter to 429."""
+    ctrl = AdmissionController(rate=2.0, burst=1, max_queue=8, queue_timeout=30.0)
+    try:
+        assert (await ctrl.admit()).admitted  # drain the bucket
+        # B queues with a 600ms budget (the upfront estimate — one token,
+        # ~500ms away — fits). A higher-priority waiter then steals that
+        # token, so B's wait outlives its budget and must end 'expired'.
+        task = asyncio.ensure_future(ctrl.admit(deadline=Deadline(600.0)))
+        await asyncio.sleep(0.05)  # B is parked in the queue
+        hi = asyncio.ensure_future(ctrl.admit(priority=10))
+        decision = await task
+        assert not decision.admitted
+        assert decision.reason == "expired"
+        assert (await hi).admitted
+    finally:
+        ctrl.close()
+
+
+async def test_admission_dequeue_sheds_doomed_budget_as_expired():
+    """The satellite fix: a request granted its token just under the wire
+    with less budget than one connect attempt needs is shed with the
+    ``expired`` reason (504 upstream) instead of being forwarded."""
+    ctrl = AdmissionController(rate=5.0, burst=1, max_queue=8, queue_timeout=5.0)
+    try:
+        assert (await ctrl.admit()).admitted  # drain the bucket
+        # Budget comfortably covers the ~200ms token wait, but min_budget
+        # (the connect floor) eats everything that remains at dequeue.
+        decision = await ctrl.admit(
+            deadline=Deadline(400.0), min_budget=10.0
+        )
+        assert not decision.admitted
+        assert decision.reason == "expired"
+    finally:
+        ctrl.close()
+
+
+async def test_admission_expired_on_arrival_sheds_immediately():
+    ctrl = AdmissionController(rate=100.0, burst=10, max_queue=8)
+    try:
+        d = Deadline(0.0)
+        await asyncio.sleep(0)
+        decision = await ctrl.admit(deadline=d)
+        assert not decision.admitted and decision.reason == "expired"
+    finally:
+        ctrl.close()
+
+
+async def test_admission_without_deadline_unchanged():
+    ctrl = AdmissionController(rate=100.0, burst=10, max_queue=8)
+    try:
+        assert (await ctrl.admit()).admitted
+    finally:
+        ctrl.close()
+
+
+# ---------------------------------------------------------------------------
+# Ring 1 — scheduler shedding (acceptance: an expired-at-scheduler sequence
+# never consumes a prefill step)
+# ---------------------------------------------------------------------------
+
+
+def _sched(num_blocks=16, bs=4, **over):
+    alloc = BlockAllocator(num_blocks, bs, enable_prefix_caching=True)
+    kw = dict(max_num_seqs=4, max_prefill_tokens=64, max_model_len=256)
+    kw.update(over)
+    return Scheduler(SchedulerConfig(**kw), alloc), alloc
+
+
+def test_scheduler_sheds_expired_queued_sequence_before_prefill():
+    sched, alloc = _sched()
+    expired = Sequence("dead", list(range(8)), SamplingParams(max_tokens=4),
+                       deadline=time.monotonic() - 1.0)
+    live = Sequence("live", list(range(8)), SamplingParams(max_tokens=4))
+    sched.add(expired)
+    sched.add(live)
+    out = sched.schedule()
+    # The expired sequence got NO prefill item (it never consumes a step),
+    # was finished with reason "deadline", and surfaced via out.expired.
+    assert [it.seq.request_id for it in out.prefills] == ["live"]
+    assert [s.request_id for s in out.expired] == ["dead"]
+    assert expired.is_finished and expired.finish_reason == "deadline"
+    assert expired.block_ids == []  # nothing allocated, nothing leaked
+    assert sched.deadline_sheds_queued == 1
+    assert sched.deadline_sheds_running == 0
+
+
+def test_scheduler_sheds_expired_running_sequence_between_decode_steps():
+    sched, alloc = _sched()
+    seq = Sequence("r", list(range(8)), SamplingParams(max_tokens=64))
+    sched.add(seq)
+    out = sched.schedule()
+    assert out.prefills and out.prefills[0].seq is seq
+    seq.num_computed_tokens = out.prefills[0].end
+    seq.output_token_ids.append(1)  # prefill completed, now decoding
+    free_before = alloc.num_free
+    # Budget dies mid-decode: the next schedule() pass sheds it before
+    # scheduling another decode step, releasing its pages.
+    seq.deadline = time.monotonic() - 0.001
+    out = sched.schedule()
+    assert out.decodes == [] and out.prefills == []
+    assert [s.request_id for s in out.expired] == ["r"]
+    assert seq.finish_reason == "deadline"
+    assert alloc.num_free > free_before
+    assert sched.deadline_sheds_running == 1
+
+
+def test_scheduler_expired_shed_unblocks_waiting_work():
+    """Pages released by a deadline shed must immediately serve the queue:
+    the shed is what makes room for live work."""
+    sched, alloc = _sched(num_blocks=4, bs=4)
+    hog = Sequence("hog", list(range(12)), SamplingParams(max_tokens=64))
+    sched.add(hog)
+    out = sched.schedule()
+    assert out.prefills and out.prefills[0].seq is hog
+    hog.num_computed_tokens = out.prefills[0].end
+    hog.output_token_ids.append(1)
+    blocked = Sequence("blocked", list(range(100, 112)),
+                       SamplingParams(max_tokens=4))
+    sched.add(blocked)
+    out = sched.schedule()
+    assert all(it.seq is not blocked for it in out.prefills)  # engine full
+    hog.deadline = time.monotonic() - 0.001
+    out = sched.schedule()
+    assert [s.request_id for s in out.expired] == ["hog"]
+    assert [it.seq.request_id for it in out.prefills] == ["blocked"]
+
+
+def test_scheduler_deadline_shedding_can_be_disabled():
+    sched, _ = _sched(deadline_shedding=False)
+    seq = Sequence("d", list(range(8)), SamplingParams(max_tokens=4),
+                   deadline=time.monotonic() - 1.0)
+    sched.add(seq)
+    out = sched.schedule()
+    assert out.expired == []
+    assert [it.seq.request_id for it in out.prefills] == ["d"]
+
+
+def test_scheduler_skips_locked_burst_members():
+    """A sequence referenced by an in-flight pipelined burst must not have
+    its pages released mid-burst; it is shed on the post-drain pass."""
+    sched, _ = _sched()
+    seq = Sequence("locked", list(range(8)), SamplingParams(max_tokens=64))
+    sched.add(seq)
+    out = sched.schedule()
+    seq.num_computed_tokens = out.prefills[0].end
+    seq.output_token_ids.append(1)
+    seq.deadline = time.monotonic() - 0.001
+    out = sched.schedule(locked=frozenset({"locked"}))
+    assert out.expired == []
+    assert not seq.is_finished
+    out = sched.schedule()  # burst drained: now it sheds
+    assert [s.request_id for s in out.expired] == ["locked"]
+
+
+def test_real_engine_sheds_expired_request_without_prefill_step():
+    """Acceptance: on a REAL LLMEngine, an expired-at-scheduler sequence
+    never consumes a prefill step — the device runner is never invoked,
+    the client sees finish_reason "deadline", and the engine's shed
+    metrics account for it."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    eng = LLMEngine(EngineConfig(
+        model="tiny-llama-debug", max_model_len=256, block_size=8,
+        num_kv_blocks=128, max_num_seqs=8, max_prefill_tokens=64,
+    ))
+    prefill_calls = []
+    real = eng.runner.execute_prefill_batch
+    eng.runner.execute_prefill_batch = lambda *a, **k: (
+        prefill_calls.append(1) or real(*a, **k)
+    )
+    eng.runner.execute_prefill_batch_nofetch = lambda *a, **k: (
+        prefill_calls.append(1)
+    )
+    eng.add_request("expired", prompt_token_ids=[1, 2, 3, 4],
+                    deadline=time.monotonic() - 1.0)
+    outs = eng.step()
+    assert [(o.request_id, o.finished, o.finish_reason) for o in outs] == [
+        ("expired", True, "deadline")
+    ]
+    assert prefill_calls == []  # zero device work spent on dead work
+    stats = eng.stats()
+    assert stats["deadline_sheds_queued_total"] == 1.0
+    assert stats["num_requests_waiting"] == 0
+    assert stats["num_requests_running"] == 0
+
+
+def test_real_engine_deadline_shedding_flag_off():
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    eng = LLMEngine(EngineConfig(
+        model="tiny-llama-debug", max_model_len=256, block_size=8,
+        num_kv_blocks=128, max_num_seqs=8, max_prefill_tokens=64,
+        deadline_shedding=False,
+    ))
+    seq = eng.add_request("r", prompt_token_ids=[1, 2, 3, 4],
+                          deadline=time.monotonic() - 1.0)
+    # The flag strips the deadline at admission: the request runs normally.
+    assert seq.deadline is None
+    outs = eng.step()
+    assert all(o.finish_reason != "deadline" for o in outs)
+
+
+# ---------------------------------------------------------------------------
+# Ring 2 — router e2e (deadline parsing, propagation, shed accounting)
+# ---------------------------------------------------------------------------
+
+
+def _metric_value(text: str, name: str, label: str = "") -> float:
+    for line in text.splitlines():
+        if line.startswith(name) and (not label or label in line):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+async def test_router_sheds_expired_deadline_instantly():
+    """An already-expired budget answers 504 + X-PST-Deadline-Exceeded at
+    the router without touching any engine, and the shed is accounted."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={DEADLINE_HEADER: "0"},
+            ) as resp:
+                assert resp.status == 504
+                assert resp.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+                body = await resp.json()
+                assert body["error"]["type"] == "deadline_exceeded"
+            # Zero requests forwarded with an expired deadline: no engine
+            # saw a generation, and the shed counter accounts for it.
+            assert all(
+                c.engine_state(i).requests_seen == [] for i in range(3)
+            )
+            text = await _router_metrics(s, c.router_url)
+            assert _metric_value(
+                text, "pst_deadline_sheds_total", 'stage="router_admission"'
+            ) >= 1
+            assert "pst_deadline_budget_ms" in text
+
+
+async def test_router_propagates_decaying_budget_to_engine():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            status, _, _ = await _completion(
+                s, c.router_url, headers={DEADLINE_HEADER: "30000"}
+            )
+            assert status == 200
+            seen = [
+                state.deadlines_seen
+                for state in (c.engine_state(i) for i in range(3))
+                if state.deadlines_seen
+            ]
+            assert len(seen) == 1 and len(seen[0]) == 1
+            forwarded = float(seen[0][0])
+            # The engine saw a live, already-decayed budget.
+            assert 0 < forwarded <= 30000
+
+
+async def test_router_default_deadline_applies_without_header():
+    async with Cluster(
+        extra_args=["--default-deadline-ms", "30000"]
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            status, _, _ = await _completion(s, c.router_url)
+            assert status == 200
+            seen = [
+                v
+                for i in range(3)
+                for v in c.engine_state(i).deadlines_seen
+            ]
+            assert seen and all(v is not None for v in seen)
+            assert 0 < float(seen[0]) <= 30000
+
+
+async def test_deadline_blocks_doomed_retries():
+    """With every engine failing and a budget too small to fit another
+    attempt (connect floor 10s > budget), the router must not burn retries:
+    the first failure ends the request, and the retry-stage shed says why."""
+    extra = [
+        "--proxy-retries", "3",
+        "--retry-backoff", "0.01",
+        "--breaker-failure-threshold", "50",
+        "--proxy-connect-timeout", "10",
+    ]
+    async with Cluster(extra_args=extra) as c:
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                async with s.post(
+                    f"{c.engine_urls[i]}/admin/fail", json={"mode": "error"}
+                ) as resp:
+                    assert resp.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={DEADLINE_HEADER: "2000"},
+            ) as resp:
+                assert resp.status == 500  # the engine 5xx passes through
+            text = await _router_metrics(s, c.router_url)
+            assert _metric_value(
+                text, "pst_deadline_sheds_total", 'stage="router_retry"'
+            ) >= 1
+            # Exactly one engine was tried — no doomed failover burned.
+            touched = sum(
+                1 for i in range(3) if c.engine_state(i).requests_seen
+            )
+            assert touched == 1
+
+
+async def test_engine_tagged_504_passes_through_without_breaker_feed():
+    """A slow engine that sheds on its propagated deadline answers a tagged
+    504; the router passes it through, does not count an upstream failure,
+    and leaves the breaker closed (budget sheds are not engine failures)."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail",
+                json={"mode": "slow", "delay": 5.0},
+            ) as resp:
+                assert resp.status == 200
+            # Round-robin until the slow engine is hit once.
+            saw_504 = False
+            for i in range(3):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": MODEL, "prompt": f"s{i}", "max_tokens": 2},
+                    headers={DEADLINE_HEADER: "300"},
+                ) as resp:
+                    if resp.status == 504:
+                        saw_504 = True
+                        assert resp.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+            assert saw_504
+            text = await _router_metrics(s, c.router_url)
+            assert _metric_value(
+                text, "pst_resilience_upstream_failures_total",
+                c.engine_urls[0],
+            ) == 0
+            states = await s.get(f"{c.router_url}/engines")
+            info = {e["url"]: e["breaker"] for e in await states.json()}
+            assert info[c.engine_urls[0]] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Ring 2 — fake engine `slow` fault mode (satellite)
+# ---------------------------------------------------------------------------
+
+
+async def _start_engine(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_fake_engine_slow_mode_delays_then_serves():
+    app = create_fake_engine_app(model=MODEL, speed=5000.0)
+    runner, url = await _start_engine(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/admin/fail", json={"mode": "slow", "delay": 0.3}
+            ) as resp:
+                assert resp.status == 200
+            t0 = time.monotonic()
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+            ) as resp:
+                assert resp.status == 200  # slow, not broken
+            assert time.monotonic() - t0 >= 0.3
+    finally:
+        await runner.cleanup()
+
+
+async def test_fake_engine_slow_mode_honors_deadline_with_504():
+    app = create_fake_engine_app(model=MODEL, speed=5000.0)
+    runner, url = await _start_engine(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/admin/fail", json={"mode": "slow", "delay": 5.0}
+            ) as resp:
+                assert resp.status == 200
+            t0 = time.monotonic()
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={DEADLINE_HEADER: "200"},
+            ) as resp:
+                assert resp.status == 504
+                assert resp.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+            elapsed = time.monotonic() - t0
+            # Replies at the deadline, not after the full injected delay.
+            assert 0.15 <= elapsed < 2.0
+    finally:
+        await runner.cleanup()
+
+
+async def test_fake_engine_sheds_already_expired_budget():
+    app = create_fake_engine_app(model=MODEL, speed=5000.0)
+    runner, url = await _start_engine(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={DEADLINE_HEADER: "0"},
+            ) as resp:
+                assert resp.status == 504
+    finally:
+        await runner.cleanup()
+
+
+async def test_fake_engine_slow_jitter_bounds():
+    app = create_fake_engine_app(model=MODEL, speed=5000.0)
+    runner, url = await _start_engine(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/admin/fail",
+                json={"mode": "slow", "delay": 0.05, "jitter": 0.05,
+                      "count": 2},
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(2):
+                t0 = time.monotonic()
+                async with s.post(
+                    f"{url}/v1/completions",
+                    json={"model": MODEL, "prompt": "x", "max_tokens": 1},
+                ) as resp:
+                    assert resp.status == 200
+                assert 0.05 <= time.monotonic() - t0 < 1.0
+            # count exhausted: back to fast.
+            t0 = time.monotonic()
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 1},
+            ) as resp:
+                assert resp.status == 200
+            assert time.monotonic() - t0 < 0.05
+    finally:
+        await runner.cleanup()

@@ -1,0 +1,155 @@
+"""kvserver BlockStore under pressure + client-side fetch-timeout tests.
+
+Satellite coverage: the byte-capacity LRU's eviction ordering, reads of
+evicted hashes, and — critically for the deadline work — that an engine's
+block fetch against a hung kvserver is bounded by a timeout instead of
+parking the step thread forever.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.cache_tiering import (
+    RemoteKVClient,
+    _deserialize_page,
+    _serialize_page,
+)
+from production_stack_tpu.kvserver.server import BlockStore
+
+
+def _page(nbytes: int) -> bytes:
+    return b"x" * nbytes
+
+
+# ---------------------------------------------------------------------------
+# BlockStore pressure
+# ---------------------------------------------------------------------------
+
+
+def test_blockstore_evicts_lru_first():
+    store = BlockStore(max_bytes=300)
+    store.put(1, _page(100))
+    store.put(2, _page(100))
+    store.put(3, _page(100))
+    # Touch 1 so 2 becomes the LRU, then overflow by one page.
+    assert store.get(1) is not None
+    store.put(4, _page(100))
+    assert store.get(2) is None  # LRU evicted
+    assert store.get(1) is not None
+    assert store.get(3) is not None
+    assert store.get(4) is not None
+    assert store.evictions == 1
+    assert store.bytes_used == 300
+
+
+def test_blockstore_get_on_evicted_hash_counts_miss_and_stays_gone():
+    store = BlockStore(max_bytes=200)
+    store.put(1, _page(100))
+    store.put(2, _page(100))
+    store.put(3, _page(100))  # evicts 1
+    misses_before = store.misses
+    assert store.get(1) is None
+    assert store.get(1) is None  # not resurrected by the read
+    assert store.misses == misses_before + 2
+    assert not store.contains(1)
+    assert store.contains(2) and store.contains(3)
+
+
+def test_blockstore_overwrite_same_hash_accounts_bytes_once():
+    store = BlockStore(max_bytes=1000)
+    store.put(7, _page(100))
+    store.put(7, _page(300))  # replace, not accumulate
+    assert store.bytes_used == 300
+    assert len(store._blocks) == 1
+
+
+def test_blockstore_rejects_unstorable_page_without_evicting():
+    store = BlockStore(max_bytes=200)
+    store.put(1, _page(100))
+    store.put(2, _page(100))
+    store.put(99, _page(500))  # bigger than the whole store
+    assert not store.contains(99)
+    # Nothing was sacrificed for the unstorable page.
+    assert store.contains(1) and store.contains(2)
+    assert store.evictions == 0
+
+
+def test_blockstore_eviction_under_sustained_pressure_keeps_capacity():
+    store = BlockStore(max_bytes=1000)
+    for h in range(100):
+        store.put(h, _page(100))
+    assert store.bytes_used <= 1000
+    assert len(store._blocks) == 10
+    # Strict LRU: exactly the 10 newest survive.
+    assert sorted(store._blocks) == list(range(90, 100))
+
+
+# ---------------------------------------------------------------------------
+# Client-side fetch timeout against a hung kvserver
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def hung_server():
+    """A socket that accepts connections and never answers — the
+    black-holed kvserver shape (pod wedged, conntrack half-open)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+    conns = []
+
+    def run():
+        srv.settimeout(0.1)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+                conns.append(conn)  # hold open, never respond
+            except socket.timeout:
+                continue
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    stop.set()
+    t.join(timeout=2)
+    for conn in conns:
+        conn.close()
+    srv.close()
+
+
+def test_remote_get_times_out_against_hung_kvserver(hung_server):
+    client = RemoteKVClient(hung_server, timeout=0.3)
+    t0 = time.monotonic()
+    assert client.get(123) is None  # miss, not a hang
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_remote_put_times_out_against_hung_kvserver(hung_server):
+    client = RemoteKVClient(hung_server, timeout=0.3)
+    k = np.zeros((2, 4, 2, 8), np.float32)
+    t0 = time.monotonic()
+    assert client.put(5, k, k) is False
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_remote_get_honors_per_call_deadline_tighter_than_default(hung_server):
+    """The deadline path tightens the fetch bound per call: a request with
+    200ms of budget left must not wait out the client's 5s default."""
+    client = RemoteKVClient(hung_server)  # default timeout: 5s
+    t0 = time.monotonic()
+    assert client.get(123, timeout=0.2) is None
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_page_serde_roundtrip():
+    k = np.arange(2 * 4 * 2 * 8, dtype=np.float32).reshape(2, 4, 2, 8)
+    v = k * 2.0
+    k2, v2 = _deserialize_page(_serialize_page(k, v))
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
