@@ -82,7 +82,9 @@ class ProtocolRunner:
                 1,
             )
             decode_burst = self.n_users * steps
-        t_base = time.time()
+        # Monotonic: arrival_time feeds Sequence queue/TTFT bookkeeping,
+        # which rides time.monotonic() (engine/sequence.py).
+        t_base = time.monotonic()
         offset = 0.0
         pending = []
         for req in requests:
@@ -93,7 +95,7 @@ class ProtocolRunner:
         answers: Dict[int, List[int]] = {}
         dec_toks, dec_time = 0, 0.0
         while pending or engine.has_work():
-            now = time.time()
+            now = time.monotonic()
             while pending and pending[0][0] <= now:
                 sched, (tag, u, prompt, max_tokens) = pending.pop(0)
                 engine.add_request(
@@ -103,7 +105,7 @@ class ProtocolRunner:
                     arrival_time=sched,
                 )
             if not engine.has_work():
-                time.sleep(max(min(pending[0][0] - time.time(), 0.01), 0.0))
+                time.sleep(max(min(pending[0][0] - time.monotonic(), 0.01), 0.0))
                 continue
             ts = time.time()
             outs = engine.step()

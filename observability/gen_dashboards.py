@@ -198,6 +198,23 @@ def fleet_dashboard():
                   'sum(rate(pst:deadline_shed_queued[2m])) + '
                   'sum(rate(pst:deadline_shed_running[2m])) or vector(0)',
                   4, 57))
+    # Row 9 — latency breakdown (pst_stage_duration_seconds, from the
+    # request-tracing span recorder): the true TTFT decomposition — router
+    # admission / routing / proxy vs engine queue / prefill / decode /
+    # KV-tier fetches — replacing guesswork over whole-request averages.
+    p.append(panel("Latency breakdown: router stages p90", [
+        ('histogram_quantile(0.9, sum(rate(pst_stage_duration_seconds_bucket'
+         '{component="router"}[2m])) by (le, stage))', "{{stage}}"),
+    ], 0, 61, unit="s"))
+    p.append(panel("Latency breakdown: engine stages p90", [
+        ('histogram_quantile(0.9, sum(rate(pst_stage_duration_seconds_bucket'
+         '{component="engine"}[2m])) by (le, stage))', "{{stage}}"),
+    ], 8, 61, unit="s"))
+    p.append(panel("Mean stage time per request (all components)", [
+        ('sum(rate(pst_stage_duration_seconds_sum[2m])) by (stage) / '
+         'clamp_min(sum(rate(pst_stage_duration_seconds_count[2m])) '
+         'by (stage), 1e-9)', "{{stage}}"),
+    ], 16, 61, unit="s"))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

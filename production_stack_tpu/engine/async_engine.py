@@ -157,7 +157,9 @@ class AsyncLLMEngine:
                             prompt=prompt,
                             prompt_token_ids=prompt_token_ids,
                             sampling=sampling,
-                            arrival_time=time.time(),
+                            # Monotonic, matching Sequence queue/TTFT
+                            # bookkeeping and deadline shedding.
+                            arrival_time=time.monotonic(),
                             lora_name=lora_name,
                             deadline=deadline,
                         ),

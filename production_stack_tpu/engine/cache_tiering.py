@@ -28,6 +28,7 @@ import numpy as np
 
 from ..kvcache.hashing import block_hashes
 from ..logging_utils import init_logger
+from ..obs.metrics import observe_stage
 from .kv_manager import BlockAllocator, NoFreeBlocksError
 
 logger = init_logger(__name__)
@@ -251,9 +252,11 @@ class TieredAllocator(BlockAllocator):
         budget is gone — recomputing the prefix beats blocking an expired
         request's shed on a DCN round trip."""
         if self.host_pool is not None:
+            t0 = time.monotonic()
             page = self.host_pool.get(h)
             if page is not None:
                 self.host_hit_blocks += 1
+                observe_stage("engine", "kv_fetch_host", time.monotonic() - t0)
                 return page
         if self.remote is not None:
             remaining: Optional[float] = None
@@ -261,7 +264,11 @@ class TieredAllocator(BlockAllocator):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
+            t0 = time.monotonic()
             page = self.remote.get(h, timeout=remaining)
+            # Hit or miss, a DCN round trip happened: both belong in the
+            # kv_fetch_remote latency decomposition.
+            observe_stage("engine", "kv_fetch_remote", time.monotonic() - t0)
             if page is not None:
                 self.remote_hit_blocks += 1
                 if self.host_pool is not None:  # promote to the warmer tier

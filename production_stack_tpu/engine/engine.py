@@ -41,6 +41,13 @@ class RequestOutput:
     num_output_tokens: int = 0
     num_cached_prompt_tokens: int = 0
     ttft: Optional[float] = None
+    # TTFT decomposition (monotonic durations, seconds): time queued before
+    # the first scheduler admission, first admission → first token, and —
+    # on the finished output — first token → completion. The server turns
+    # these into engine_queue/prefill/decode spans + stage histograms.
+    queue_time: Optional[float] = None
+    prefill_time: Optional[float] = None
+    decode_time: Optional[float] = None
     # One entry per new token when SamplingParams.logprobs is set:
     # {"token_id", "logprob", "top": [(token_id, logprob), ...]}.
     logprobs: Optional[List[dict]] = None
@@ -654,7 +661,7 @@ class LLMEngine:
         sp = seq.sampling
         seq.output_token_ids.append(token)
         self.generation_tokens_total += 1
-        now = time.time()
+        now = time.monotonic()  # same clock as arrival_time (sequence.py)
         if seq.first_token_time is None:
             seq.first_token_time = now
 
@@ -707,6 +714,7 @@ class LLMEngine:
                 ],
             }
 
+        scheduled = seq.first_scheduled_time
         out = RequestOutput(
             request_id=seq.request_id,
             text_delta=delta,
@@ -715,9 +723,17 @@ class LLMEngine:
             num_output_tokens=len(seq.output_token_ids),
             num_cached_prompt_tokens=seq.num_cached_prompt_tokens,
             ttft=(seq.first_token_time - seq.arrival_time),
+            queue_time=(
+                scheduled - seq.arrival_time if scheduled is not None else None
+            ),
+            prefill_time=(
+                seq.first_token_time - scheduled
+                if scheduled is not None else None
+            ),
             logprobs=[logprobs_entry] if logprobs_entry else None,
         )
         if finish_reason is not None:
+            out.decode_time = now - seq.first_token_time
             if self.cfg.kv_role in ("producer", "both"):
                 sent = self._push_kv_to_remote(seq)
                 if sent:

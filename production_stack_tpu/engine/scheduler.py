@@ -397,6 +397,8 @@ class Scheduler:
                 return
             if seq.status == SequenceStatus.RUNNING:
                 seq.resume_marker = seq.num_tokens
+                if seq.first_scheduled_time is None:
+                    seq.first_scheduled_time = time.monotonic()
                 self.running.append(seq)
             else:
                 # Fallback: part of the committed chain was unrecoverable;
@@ -459,6 +461,11 @@ class Scheduler:
             self._admit_blocked = None
             seq.status = SequenceStatus.RUNNING
             seq.resume_marker = seq.num_tokens
+            # Queue-wait end marker (first admission only: a preempted
+            # sequence's re-admission is not queue wait — its TTFT
+            # decomposition keeps the original boundary).
+            if seq.first_scheduled_time is None:
+                seq.first_scheduled_time = time.monotonic()
             self.running.append(seq)
             promised += need  # this admission's unprefilled pages
 

@@ -117,7 +117,13 @@ class Sequence:
         self.output_token_ids: List[int] = []
         self.sampling = sampling
         self.status = SequenceStatus.WAITING
-        self.arrival_time = arrival_time or time.time()
+        # Queue/TTFT bookkeeping rides time.monotonic(), same clock as
+        # `deadline` and the admission token bucket: stage durations
+        # (queue wait, prefill, decode) must survive wall-clock steps —
+        # an NTP adjustment mid-request would otherwise corrupt TTFT and
+        # the per-stage decomposition.
+        self.arrival_time = arrival_time or time.monotonic()
+        self.first_scheduled_time: Optional[float] = None  # queue-wait end
         self.first_token_time: Optional[float] = None  # TTFT marker
         self.finish_reason: Optional[str] = None
         # LoRA bank slot serving this request (0 = base model) and its

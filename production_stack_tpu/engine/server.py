@@ -38,6 +38,7 @@ from prometheus_client import (
 
 from .. import __version__
 from ..logging_utils import init_logger
+from ..obs import SpanRecorder, debug_requests_response, render_obs_metrics
 from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
 from ..protocols import (
     ChatCompletionRequest,
@@ -384,11 +385,60 @@ def create_engine_app(
     engine: AsyncLLMEngine,
     api_key: Optional[str] = None,
     cross_encoder=None,
+    tracing: bool = True,
+    debug_requests_buffer: int = 256,
 ) -> web.Application:
     # Everything except unauthenticated probe/scrape endpoints is guarded
     # when --api-key is set (/sleep in particular is destructive). Enforced
     # as a middleware so no handler can be forgotten.
-    _OPEN_PATHS = {"/health", "/metrics", "/version", "/is_sleeping", "/is_draining"}
+    # /debug/requests is deliberately NOT open: timelines carry
+    # per-request metadata (request ids, backend URLs, error strings) —
+    # when an api key is configured it is guarded like the work endpoints.
+    _OPEN_PATHS = {
+        "/health", "/metrics", "/version", "/is_sleeping", "/is_draining",
+    }
+
+    # Paths that get a root span + timeline entry (the work the router
+    # proxies; admin/probe endpoints are not traced).
+    _TRACED_PATHS = {
+        "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
+        "/rerank", "/v1/rerank", "/v2/rerank", "/score", "/v1/score",
+    }
+
+    recorder = SpanRecorder(
+        "engine", buffer=debug_requests_buffer, enabled=tracing
+    )
+
+    @web.middleware
+    async def tracing_middleware(request: web.Request, handler):
+        """Root span per generation request, joining the router's trace via
+        the propagated W3C ``traceparent``; ``X-Request-Id`` (the router's
+        id, or a fresh one) lands on every unprepared response —
+        including 503 drain and 504 deadline sheds."""
+        if not (
+            recorder.enabled
+            and request.method == "POST"
+            and request.path in _TRACED_PATHS
+        ):
+            return await handler(request)
+        request_id = request.headers.get("X-Request-Id") or random_id("req")
+        trace = recorder.trace(
+            request_id,
+            headers=request.headers,
+            name="engine_request",
+            attributes={"http.target": request.path},
+        )
+        request["trace"] = trace
+        request["request_id"] = request_id
+        status: Optional[int] = None
+        try:
+            response = await handler(request)
+            status = response.status
+            if not response.prepared:
+                response.headers.setdefault("X-Request-Id", request_id)
+            return response
+        finally:
+            trace.finish(status=status)
 
     @web.middleware
     async def auth_middleware(request: web.Request, handler):
@@ -398,11 +448,34 @@ def create_engine_app(
                 return _error("invalid API key", 401, "authentication_error")
         return await handler(request)
 
-    app = web.Application(middlewares=[auth_middleware])
+    app = web.Application(middlewares=[tracing_middleware, auth_middleware])
     model_name = engine.engine.model_name
     metrics = EngineMetrics(model_name)
     app["engine"] = engine
     app["metrics"] = metrics
+    app["span_recorder"] = recorder
+
+    def _record_engine_stages(
+        request: web.Request,
+        queue_time: Optional[float],
+        prefill_time: Optional[float],
+        decode_time: Optional[float],
+    ) -> None:
+        """Replay the Sequence's TTFT decomposition as spans: queue wait →
+        prefill → decode, laid back-to-back ending now. Post-hoc so the
+        step thread never touches the recorder."""
+        trace = request.get("trace")
+        if trace is None:
+            return
+        now = time.monotonic()
+        end_prefill = now - (decode_time or 0.0)
+        end_queue = end_prefill - (prefill_time or 0.0)
+        if queue_time is not None:
+            trace.record_span("engine_queue", queue_time, end_mono=end_queue)
+        if prefill_time is not None:
+            trace.record_span("prefill", prefill_time, end_mono=end_prefill)
+        if decode_time is not None:
+            trace.record_span("decode", decode_time, end_mono=now)
 
     def _lora_names() -> List[str]:
         mgr = engine.engine.lora_manager
@@ -429,6 +502,9 @@ def create_engine_app(
             return None, None
         if d.expired():
             metrics.deadline_shed_admission.inc()
+            trace = request.get("trace")
+            if trace is not None:
+                trace.add_event("deadline_shed", stage="engine_admission")
             return _deadline_error(), None
         return None, d.expires_at
 
@@ -569,6 +645,7 @@ def create_engine_app(
         is_chat: bool,
         prompt_ids: Optional[List[int]] = None,
     ) -> web.StreamResponse:
+        t_admission = time.monotonic()
         tok = engine.engine.tokenizer
         if prompt_ids is not None:
             try:  # malformed ids must 400 here, not poison the step thread
@@ -596,6 +673,13 @@ def create_engine_app(
         err, deadline = _request_deadline(request)
         if err is not None:
             return err
+        trace = request.get("trace")
+        if trace is not None:
+            # Tokenization + validation + budget parse = engine admission.
+            trace.record_span(
+                "engine_admission", time.monotonic() - t_admission,
+                attributes={"prompt_tokens": len(ids)},
+            )
         rid = random_id("chatcmpl" if is_chat else "cmpl")
         created = int(time.time())
         start = time.time()
@@ -621,8 +705,8 @@ def create_engine_app(
             if req.stream:
                 return _error("streaming with n/best_of > 1 is not supported")
             return await _serve_n_choices(
-                req, ids, sampling, rid, created, is_chat, n_choices, echo,
-                lora, best_of, deadline=deadline,
+                request, req, ids, sampling, rid, created, is_chat, n_choices,
+                echo, lora, best_of, deadline=deadline,
             )
 
         gen = engine.generate(
@@ -637,6 +721,7 @@ def create_engine_app(
             resp.headers["X-Request-Id"] = rid
             await resp.prepare(request)
             n_out = 0
+            last_out = None
             try:
                 if is_chat:
                     first = {
@@ -653,6 +738,7 @@ def create_engine_app(
                 char_off = len(engine.engine.tokenizer.decode(ids)) if echo else 0
                 async for out in gen:
                     n_out = out.num_output_tokens
+                    last_out = out
                     if out.num_output_tokens == 1 and out.ttft is not None:
                         metrics.ttft.observe(out.ttft)
                     lp_obj = None
@@ -708,6 +794,11 @@ def create_engine_app(
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
                 return resp
+            if last_out is not None:
+                _record_engine_stages(
+                    request, last_out.queue_time, last_out.prefill_time,
+                    last_out.decode_time,
+                )
             metrics.e2e.observe(time.time() - start)
             metrics.success.inc()
             metrics.prompt_tokens.inc(len(ids))
@@ -727,7 +818,13 @@ def create_engine_app(
         if result["finish_reason"] == "deadline":
             # Shed by the scheduler (queued past its budget, or expired
             # mid-decode): nothing useful to return — 504, tagged.
+            if trace is not None:
+                trace.add_event("deadline_shed", stage="engine_scheduler")
             return _deadline_error()
+        _record_engine_stages(
+            request, result["queue_time"], result["prefill_time"],
+            result["decode_time"],
+        )
         usage = {
             "prompt_tokens": len(ids),
             "completion_tokens": len(result["token_ids"]),
@@ -747,11 +844,13 @@ def create_engine_app(
         return web.json_response(payload, headers={"X-Request-Id": rid})
 
     async def _collect(gen) -> dict:
-        """Drain one generation stream into text/tokens/logprobs/finish."""
+        """Drain one generation stream into text/tokens/logprobs/finish
+        (plus the Sequence's stage timings for span reconstruction)."""
         text_parts: List[str] = []
         token_ids: List[int] = []
         lp_entries: List[dict] = []
         finish_reason = None
+        queue_time = prefill_time = decode_time = None
         async for out in gen:
             if out.num_output_tokens == 1 and out.ttft is not None:
                 metrics.ttft.observe(out.ttft)
@@ -760,9 +859,18 @@ def create_engine_app(
             if out.logprobs:
                 lp_entries.extend(out.logprobs)
             finish_reason = out.finish_reason or finish_reason
+            queue_time = out.queue_time if out.queue_time is not None else queue_time
+            prefill_time = (
+                out.prefill_time if out.prefill_time is not None else prefill_time
+            )
+            decode_time = (
+                out.decode_time if out.decode_time is not None else decode_time
+            )
         return {
             "text": "".join(text_parts), "token_ids": token_ids,
             "logprobs": lp_entries, "finish_reason": finish_reason,
+            "queue_time": queue_time, "prefill_time": prefill_time,
+            "decode_time": decode_time,
         }
 
     def _build_choice(req, result, index, is_chat, echo, prompt_ids) -> dict:
@@ -790,8 +898,8 @@ def create_engine_app(
                 "finish_reason": result["finish_reason"]}
 
     async def _serve_n_choices(
-        req, ids, sampling, rid, created, is_chat, n_choices, echo, lora,
-        best_of=None, deadline=None,
+        request, req, ids, sampling, rid, created, is_chat, n_choices, echo,
+        lora, best_of=None, deadline=None,
     ) -> web.Response:
         """OpenAI `n` / `best_of`: sample ``best_of`` independent candidates
         of one prompt (the prompt prefix is KV-shared across them via the
@@ -833,6 +941,13 @@ def create_engine_app(
             return _error(str(e))
         if any(r["finish_reason"] == "deadline" for r in results):
             return _deadline_error()
+        # Stage decomposition from the first candidate (all candidates
+        # share admission and the KV-shared prompt prefill; recording one
+        # keeps engine_queue/prefill/decode counts 1:1 with requests).
+        _record_engine_stages(
+            request, results[0]["queue_time"], results[0]["prefill_time"],
+            results[0]["decode_time"],
+        )
         # OpenAI bills EVERY best_of candidate in completion_tokens.
         sampled_tokens = sum(len(r["token_ids"]) for r in results)
         if rank:
@@ -1026,10 +1141,19 @@ def create_engine_app(
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
         metrics.refresh(engine.engine.stats())
+        # pst_stage_duration_seconds lives in the shared observability
+        # registry (docs/observability.md) — append it to the engine's own.
         return web.Response(
-            body=generate_latest(metrics.registry),
+            body=generate_latest(metrics.registry) + render_obs_metrics(),
             content_type="text/plain",
         )
+
+    async def debug_requests(request: web.Request) -> web.Response:
+        """Engine-side timeline ring buffer (same shape as the router's
+        GET /debug/requests, shared handler): per-request spans for
+        admission, queue wait, prefill, decode — joinable to the router's
+        timelines by trace id."""
+        return debug_requests_response(recorder, request)
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": engine.sleeping})
@@ -1120,6 +1244,7 @@ def create_engine_app(
     app.router.add_post("/detokenize", detokenize)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
@@ -1220,6 +1345,15 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                    action="store_true", default=True)
     p.add_argument("--no-deadline-shedding", dest="deadline_shedding",
                    action="store_false")
+    # Request tracing (docs/observability.md): engine-side spans for
+    # admission / queue wait / prefill / decode, joined to the router's
+    # trace via the propagated traceparent.
+    p.add_argument("--tracing", dest="tracing", action="store_true",
+                   default=True)
+    p.add_argument("--no-tracing", dest="tracing", action="store_false")
+    p.add_argument("--debug-requests-buffer", type=int, default=256,
+                   help="completed request timelines kept for "
+                        "GET /debug/requests (0 disables the endpoint)")
     return p.parse_args(argv)
 
 
@@ -1348,7 +1482,9 @@ def main(argv=None) -> None:
             "cross-encoder scoring model loaded: %s", cross_encoder.cfg.name
         )
     app = create_engine_app(
-        engine, api_key=args.api_key, cross_encoder=cross_encoder
+        engine, api_key=args.api_key, cross_encoder=cross_encoder,
+        tracing=args.tracing,
+        debug_requests_buffer=args.debug_requests_buffer,
     )
 
     async def on_startup(app):

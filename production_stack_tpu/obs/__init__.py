@@ -1,0 +1,75 @@
+"""Observability layer: end-to-end request tracing + latency decomposition.
+
+- :mod:`tracing` — in-process span recorder (always available, no SDK
+  required), W3C ``traceparent`` propagation helpers, bounded ring buffer
+  of completed request timelines (``GET /debug/requests``), optional
+  mirroring into the real OpenTelemetry SDK.
+- :mod:`metrics` — the ``pst_stage_duration_seconds{component,stage}``
+  histogram every completed span feeds.
+
+The router holds one process-wide recorder (initialize/get/teardown like
+the other router singletons); each engine server owns its own recorder
+(created in ``create_engine_app``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .http import debug_requests_response
+from .metrics import OBS_REGISTRY, observe_stage, render_obs_metrics
+from .tracing import (
+    NOOP_SPAN,
+    NOOP_TRACE,
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    RequestTrace,
+    Span,
+    SpanRecorder,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+_router_recorder: Optional[SpanRecorder] = None
+
+
+def initialize_request_tracing(
+    enabled: bool = True, buffer: int = 256
+) -> SpanRecorder:
+    """Create the router's process-wide span recorder."""
+    global _router_recorder
+    _router_recorder = SpanRecorder("router", buffer=buffer, enabled=enabled)
+    return _router_recorder
+
+
+def get_request_tracer() -> Optional[SpanRecorder]:
+    return _router_recorder
+
+
+def teardown_request_tracing() -> None:
+    global _router_recorder
+    _router_recorder = None
+
+
+__all__ = [
+    "NOOP_SPAN",
+    "NOOP_TRACE",
+    "OBS_REGISTRY",
+    "REQUEST_ID_HEADER",
+    "TRACEPARENT_HEADER",
+    "RequestTrace",
+    "Span",
+    "SpanRecorder",
+    "debug_requests_response",
+    "format_traceparent",
+    "get_request_tracer",
+    "initialize_request_tracing",
+    "new_span_id",
+    "new_trace_id",
+    "observe_stage",
+    "parse_traceparent",
+    "render_obs_metrics",
+    "teardown_request_tracing",
+]

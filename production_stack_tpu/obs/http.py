@@ -1,0 +1,41 @@
+"""Shared HTTP surface for the trace recorder.
+
+Router and engine both serve ``GET /debug/requests``; one implementation
+keeps the contract (404 semantics, ``limit``/``request_id`` params,
+response shape) from drifting between components.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from .tracing import SpanRecorder
+
+
+def debug_requests_response(
+    recorder: SpanRecorder, request: web.Request
+) -> web.Response:
+    """The ring buffer of completed request timelines, most recent first.
+
+    404s when tracing is off (``--no-tracing``) or the ring is sized 0
+    (``--debug-requests-buffer 0``) — tracing itself (histograms, header
+    propagation) still runs in the latter case.
+    """
+    if not recorder.debug_endpoint_enabled:
+        return web.json_response(
+            {"error": {"message": "request tracing timelines are disabled "
+                                  "(--no-tracing or --debug-requests-buffer 0)",
+                       "type": "not_found_error", "code": 404}},
+            status=404,
+        )
+    try:
+        limit = int(request.query.get("limit", "50"))
+    except ValueError:
+        limit = 50
+    return web.json_response({
+        "component": recorder.component,
+        "buffer_size": recorder.buffer_size,
+        "requests": recorder.timelines(
+            limit=limit, request_id=request.query.get("request_id")
+        ),
+    })

@@ -1,0 +1,48 @@
+"""``pst_stage_duration_seconds`` — the per-stage latency decomposition.
+
+One histogram, labeled by ``component`` (router | engine) and ``stage``
+(the span taxonomy in docs/observability.md), fed by every span the
+in-process recorder completes. Unlike the whole-request moving averages in
+``router/stats/request_stats.py``, these are true distributions: a TTFT
+regression decomposes into admission vs routing vs proxy vs engine queue
+vs prefill in one PromQL query.
+
+The histogram lives in its own :data:`OBS_REGISTRY` (not the process
+default registry) because router and engine expose *different* registries
+on ``/metrics`` — both handlers append :func:`render_obs_metrics` so the
+stage surface shows up on either component without double registration.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Histogram, generate_latest
+
+OBS_REGISTRY = CollectorRegistry()
+
+# Buckets span sub-ms (routing decisions) to minutes (long decodes).
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+stage_duration = Histogram(
+    "pst_stage_duration_seconds",
+    "Per-stage request latency decomposition (span durations by stage)",
+    ["component", "stage"],
+    registry=OBS_REGISTRY,
+    buckets=_BUCKETS,
+)
+
+
+def observe_stage(component: str, stage: str, seconds: float) -> None:
+    """Record one stage duration (negative durations clamp to 0 so a
+    misbehaving clock can never corrupt the histogram)."""
+    stage_duration.labels(component=component, stage=stage).observe(
+        max(seconds, 0.0)
+    )
+
+
+def render_obs_metrics() -> bytes:
+    """Prometheus exposition of the shared observability registry —
+    appended to both the router's and the engine's ``/metrics`` body."""
+    return generate_latest(OBS_REGISTRY)

@@ -10,12 +10,19 @@ from __future__ import annotations
 
 import asyncio
 import time
+import uuid
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
 from ..logging_utils import init_logger
+from ..obs import (
+    NOOP_TRACE,
+    get_request_tracer,
+    initialize_request_tracing,
+    teardown_request_tracing,
+)
 from ..resilience import (
     get_admission_controller,
     get_default_deadline_ms,
@@ -104,6 +111,43 @@ _ADMISSION_PATHS = {
 
 
 @web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """Outermost middleware: request identity + the root span.
+
+    Assigns (or adopts) the ``X-Request-Id``, opens the request's root
+    span — joining the client's W3C trace when a valid ``traceparent``
+    came in — and guarantees ``X-Request-Id`` on EVERY unprepared
+    response: success, 429/504 sheds, 502 exhausted failover, 401s.
+    Failures must be joinable to traces, not just the happy path.
+    """
+    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    request["request_id"] = request_id
+    trace = None
+    recorder = get_request_tracer()
+    if (
+        recorder is not None
+        and request.method == "POST"
+        and request.path in _ADMISSION_PATHS
+    ):
+        trace = recorder.trace(
+            request_id,
+            headers=request.headers,
+            attributes={"http.target": request.path},
+        )
+        request["trace"] = trace
+    status: Optional[int] = None
+    try:
+        response = await handler(request)
+        status = response.status
+        if not response.prepared:
+            response.headers.setdefault("X-Request-Id", request_id)
+        return response
+    finally:
+        if trace is not None:
+            trace.finish(status=status)
+
+
+@web.middleware
 async def admission_middleware(request: web.Request, handler):
     """Token-bucket + bounded-priority-queue admission ahead of routing.
 
@@ -116,12 +160,18 @@ async def admission_middleware(request: web.Request, handler):
     request was admitted, but only to die downstream.
     """
     if request.method == "POST" and request.path in _ADMISSION_PATHS:
+        trace = request.get("trace") or NOOP_TRACE
+        # The admission stage: budget parse + token-bucket/queue wait.
+        span = trace.span("admission")
         # Parse the budget once, here, for every downstream consumer
         # (admission, routing, proxy attempts) — the monotonic deadline is
         # anchored at arrival, so queue time counts against the budget.
         deadline = parse_deadline(request.headers, get_default_deadline_ms())
         if deadline is not None:
             request["deadline"] = deadline
+            span.set_attribute(
+                "deadline_ms", round(max(deadline.remaining_ms(), 0.0), 1)
+            )
             res_metrics.deadline_budget_ms.observe(
                 max(deadline.remaining_ms(), 0.0)
             )
@@ -141,6 +191,9 @@ async def admission_middleware(request: web.Request, handler):
                     res_metrics.deadline_sheds_total.labels(
                         stage="router_queue"
                     ).inc()
+                    span.set_attribute("outcome", "deadline_shed")
+                    span.add_event("deadline_shed", stage="router_queue")
+                    span.end()
                     return web.json_response(
                         {
                             "error": {
@@ -155,6 +208,9 @@ async def admission_middleware(request: web.Request, handler):
                         status=504,
                         headers={DEADLINE_EXCEEDED_HEADER: "1"},
                     )
+                span.set_attribute("outcome", "shed")
+                span.add_event("admission_shed", reason=decision.reason)
+                span.end()
                 return web.json_response(
                     {
                         "error": {
@@ -170,14 +226,19 @@ async def admission_middleware(request: web.Request, handler):
                     status=429,
                     headers={"Retry-After": decision.retry_after_header},
                 )
+        span.set_attribute("outcome", "admitted")
+        span.end()
     return await handler(request)
 
 
 # Mutating admin endpoints: without auth these let any client drain the
 # whole fleet (or sleep it), so when an api key is configured they are
 # guarded like /v1. Read-only probes (/is_draining, /is_sleeping,
-# /engines) stay open, same as /health and /metrics.
-_GUARDED_ADMIN_PATHS = {"/drain", "/undrain", "/sleep", "/wake_up"}
+# /engines) stay open, same as /health and /metrics. /debug/requests is
+# guarded too — per-request timelines (ids, backend URLs, error strings)
+# are not aggregate telemetry.
+_GUARDED_ADMIN_PATHS = {"/drain", "/undrain", "/sleep", "/wake_up",
+                        "/debug/requests"}
 
 
 @web.middleware
@@ -235,6 +296,10 @@ def initialize_all(app: web.Application, args) -> None:
         decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
     )
     initialize_resilience(args)
+    initialize_request_tracing(
+        enabled=getattr(args, "tracing", True),
+        buffer=getattr(args, "debug_requests_buffer", 256),
+    )
     initialize_request_rewriter(args.request_rewriter)
     configure_custom_callbacks(args.callbacks)
     initialize_feature_gates(args.feature_gates)
@@ -271,7 +336,7 @@ def create_app(args) -> web.Application:
     init_otel("pst-router")
 
     app = web.Application(
-        middlewares=[api_key_middleware, admission_middleware],
+        middlewares=[tracing_middleware, api_key_middleware, admission_middleware],
         client_max_size=64 * 2**20,
     )
     initialize_all(app, args)
@@ -321,6 +386,7 @@ def create_app(args) -> web.Application:
             pass
         teardown_routing_logic()
         teardown_resilience()
+        teardown_request_tracing()
         for key in ("client_session", "prefill_client", "decode_client"):
             session = app.get(key)
             if session is not None:

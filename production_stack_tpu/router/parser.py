@@ -121,6 +121,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap outstanding hedges at this fraction of "
                         "outstanding primaries (floor 1)")
 
+    # Observability (docs/observability.md): in-process request tracing
+    # with per-stage latency decomposition. Always SDK-free; spans mirror
+    # to OpenTelemetry only when OTEL_EXPORTER_OTLP_ENDPOINT + SDK exist.
+    p.add_argument("--tracing", dest="tracing", action="store_true",
+                   default=True,
+                   help="record per-request stage spans (traceparent "
+                        "propagation, pst_stage_duration_seconds, "
+                        "/debug/requests)")
+    p.add_argument("--no-tracing", dest="tracing", action="store_false")
+    p.add_argument("--debug-requests-buffer", type=int, default=256,
+                   help="completed request timelines kept for "
+                        "GET /debug/requests (0 disables the endpoint)")
+
     # Stats / metrics
     p.add_argument("--engine-stats-interval", type=float, default=15.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -202,6 +215,8 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--breaker-failure-threshold must be >= 1")
     if args.default_deadline_ms < 0:
         raise ValueError("--default-deadline-ms must be >= 0")
+    if args.debug_requests_buffer < 0:
+        raise ValueError("--debug-requests-buffer must be >= 0")
     if args.hedge_max_outstanding_ratio < 0:
         raise ValueError("--hedge-max-outstanding-ratio must be >= 0")
     if not (0.0 < args.hedge_quantile < 1.0):

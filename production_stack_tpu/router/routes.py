@@ -16,6 +16,11 @@ from prometheus_client import generate_latest
 
 from .. import __version__
 from ..logging_utils import init_logger
+from ..obs import (
+    debug_requests_response,
+    get_request_tracer,
+    render_obs_metrics,
+)
 from ..resilience import get_admission_controller, get_breaker_registry
 from ..resilience import metrics as res_gauges
 from ..resilience.breaker import STATE_VALUE
@@ -228,7 +233,29 @@ async def metrics(request: web.Request) -> web.Response:
     gauges.router_cpu_percent.set(proc.cpu_percent())
     gauges.router_memory_mb.set(proc.memory_info().rss / 1e6)
     gauges.router_disk_percent.set(psutil.disk_usage("/").percent)
-    return web.Response(body=generate_latest(), content_type="text/plain")
+    # Append the shared observability registry (pst_stage_duration_seconds)
+    # — it lives outside the default registry (docs/observability.md).
+    return web.Response(
+        body=generate_latest() + render_obs_metrics(),
+        content_type="text/plain",
+    )
+
+
+@routes.get("/debug/requests")
+async def debug_requests(request: web.Request) -> web.Response:
+    """SDK-free trace debugging: the recorder's ring buffer of completed
+    request timelines (one entry per request: trace id + per-stage spans
+    with offsets/durations/attributes/events), most recent first.
+    ``?limit=N`` bounds the reply; ``?request_id=`` filters to one request.
+    """
+    recorder = get_request_tracer()
+    if recorder is None:
+        return web.json_response(
+            {"error": {"message": "request tracing is not initialized",
+                       "type": "not_found_error", "code": 404}},
+            status=404,
+        )
+    return debug_requests_response(recorder, request)
 
 
 @routes.post("/sleep")

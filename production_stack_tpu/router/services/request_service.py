@@ -49,6 +49,7 @@ import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
+from ...obs import NOOP_TRACE, REQUEST_ID_HEADER, TRACEPARENT_HEADER
 from ...resilience import (
     get_breaker_registry,
     get_default_deadline_ms,
@@ -92,18 +93,33 @@ def _forwardable(headers) -> dict:
     return {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
 
 
+def _trace_headers(headers: dict, request_id: str, span) -> dict:
+    """Outbound hop headers: ``X-Request-Id`` always (so engine logs and
+    timelines join on one id even with tracing off), plus a W3C
+    ``traceparent`` naming ``span`` as the parent when tracing is active.
+    With tracing off the client's own traceparent (if any) passes through
+    untouched — the router stays a transparent trace hop."""
+    headers[REQUEST_ID_HEADER] = request_id
+    tp = span.traceparent() if span is not None else None
+    if tp:
+        headers[TRACEPARENT_HEADER] = tp
+    return headers
+
+
 def _error_response(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": etype, "code": status}}, status=status
     )
 
 
-def _deadline_response(message: str, stage: str) -> web.Response:
+def _deadline_response(message: str, stage: str, trace=None) -> web.Response:
     """504 for an exhausted budget, tagged so clients (and the tests) can
     tell a deadline shed apart from a generic upstream timeout. Counts the
-    shed by stage; never feeds the breakers — an exhausted budget says
-    nothing about engine health."""
+    shed by stage (and as a span event on the trace); never feeds the
+    breakers — an exhausted budget says nothing about engine health."""
     res_metrics.deadline_sheds_total.labels(stage=stage).inc()
+    if trace is not None:
+        trace.add_event("deadline_shed", stage=stage)
     return web.json_response(
         {"error": {"message": message, "type": "deadline_exceeded", "code": 504}},
         status=504,
@@ -125,12 +141,18 @@ def _note_success(url: str) -> None:
         registry.record_success(url)
 
 
-def _note_failure(url: str, request_id: str = "") -> None:
+def _note_failure(url: str, request_id: str = "", span=None) -> None:
     res_metrics.upstream_failures_total.labels(server=url).inc()
     get_request_stats_monitor().on_request_failed(url, request_id, time.time())
     registry = get_breaker_registry()
     if registry is not None:
         registry.record_failure(url)
+        if span is not None:
+            state = registry.state(url)
+            if state is not BreakerState.CLOSED:
+                # Breaker movement is part of the request's story: record
+                # it on the span that observed the failure.
+                span.add_event("breaker_state", server=url, state=state.value)
 
 
 def make_failover(candidates, headers: dict, request_json: Optional[dict]) -> FailoverFn:
@@ -197,6 +219,7 @@ async def proxy_and_stream(
     callback = get_custom_callback_handler()
     policy = get_retry_policy()
     session: aiohttp.ClientSession = request.app["client_session"]
+    trace = request.get("trace") or NOOP_TRACE
 
     collect = callback is not None and callback.post_request is not None
     semantic_store = request.app.get("semantic_cache_store")
@@ -222,8 +245,13 @@ async def proxy_and_stream(
             # The budget died between attempts (backoff, slow routing):
             # never forward work that is already expired.
             return _deadline_response(
-                "deadline exceeded before upstream attempt", "router_proxy"
+                "deadline exceeded before upstream attempt", "router_proxy",
+                trace=trace,
             )
+        attempt_span = trace.span(
+            "proxy_attempt",
+            attributes={"server": url, "attempt": attempt, "endpoint": endpoint},
+        )
         # Per-attempt timeouts: connect bounds the TCP handshake, sock_read
         # the gap between reads, so a black-holed backend raises a
         # retryable TimeoutError instead of hanging the client forever.
@@ -244,8 +272,9 @@ async def proxy_and_stream(
             connect=connect_t,
             sock_read=(policy.read_timeout or None) if policy else None,
         )
-        fwd_headers = with_deadline_header(
-            _forwardable(request.headers), deadline
+        fwd_headers = _trace_headers(
+            with_deadline_header(_forwardable(request.headers), deadline),
+            request_id, attempt_span,
         )
         collected = bytearray()
         response: Optional[web.StreamResponse] = None
@@ -297,7 +326,7 @@ async def proxy_and_stream(
                         # even when no health-probe loop is running.
                         get_service_discovery().set_draining(url, True)
                     else:
-                        _note_failure(url, request_id)
+                        _note_failure(url, request_id, span=attempt_span)
                         failure_noted = True
                     backoff = policy.backoff(attempt) if policy else 0.0
                     if _deadline_blocks_attempt(deadline, backoff):
@@ -317,6 +346,11 @@ async def proxy_and_stream(
                             "backend %s returned %d for %s; failing over to %s",
                             url, upstream.status, request_id, next_url,
                         )
+                        attempt_span.set_attribute(
+                            "http.status_code", upstream.status
+                        )
+                        attempt_span.set_attribute("outcome", "failover")
+                        attempt_span.end()
                         res_metrics.retries_total.labels(server=url).inc()
                         res_metrics.failovers_total.inc()
                         # Give the connection back before sleeping: a
@@ -340,16 +374,29 @@ async def proxy_and_stream(
                         for k, v in debug_headers.items():
                             response.headers[k] = v
                     await response.prepare(request)
+                    first_byte = True
                     async for chunk in upstream.content.iter_any():
                         # First call records TTFT; subsequent calls record ITL.
                         monitor.on_request_response(url, request_id, time.time())
+                        if first_byte:
+                            attempt_span.add_event("first_byte")
+                            first_byte = False
                         if collect:
                             collected.extend(chunk)
                         await response.write(chunk)
                     _complete()
                     if ok:
                         _note_success(url)
+                    attempt_span.set_attribute("http.status_code", upstream.status)
+                    attempt_span.set_attribute(
+                        "outcome", "ok" if ok else "error_passthrough"
+                    )
+                    # End only after write_eof: a client disconnect raised
+                    # there must still be able to flip the outcome before
+                    # the span is sealed (end() is idempotent, so the
+                    # disconnect/cancel handlers' end() wins the race).
                     await response.write_eof()
+                    attempt_span.end()
                 except (ConnectionResetError, ConnectionError):
                     # Client-side socket error on prepare/write/write_eof:
                     # the client went away — not a backend failure, so don't
@@ -360,6 +407,8 @@ async def proxy_and_stream(
                     res_metrics.client_disconnects_total.inc()
                     _complete()
                     upstream.close()
+                    attempt_span.set_attribute("outcome", "client_disconnect")
+                    attempt_span.end()
                     logger.info(
                         "client disconnected during response for %s; "
                         "aborted upstream %s", request_id, url,
@@ -376,20 +425,25 @@ async def proxy_and_stream(
                         res_metrics.client_disconnects_total.inc()
                     _complete()
                     upstream.close()
+                    attempt_span.set_attribute("outcome", "cancelled")
+                    attempt_span.end()
                     raise
         except (
             aiohttp.ClientError, asyncio.TimeoutError, ConnectionResetError, OSError,
         ) as e:
             _complete()
+            attempt_span.set_attribute("error", str(e))
             if response is not None and response.prepared:
                 if not failure_noted:
-                    _note_failure(url, request_id)
+                    _note_failure(url, request_id, span=attempt_span)
                 # Bytes already reached the client: the stream is committed.
                 # Truncate rather than retry (a replay would duplicate
                 # already-delivered tokens).
                 logger.error(
                     "backend %s died mid-stream for %s: %s", url, request_id, e
                 )
+                attempt_span.set_attribute("outcome", "midstream_death")
+                attempt_span.end()
                 with contextlib.suppress(Exception):
                     await response.write_eof()
                 return response
@@ -402,11 +456,14 @@ async def proxy_and_stream(
                     "deadline exceeded during attempt to %s for %s",
                     url, request_id,
                 )
+                attempt_span.set_attribute("outcome", "deadline_shed")
+                attempt_span.end()
                 return _deadline_response(
-                    "deadline exceeded during upstream attempt", "router_proxy"
+                    "deadline exceeded during upstream attempt", "router_proxy",
+                    trace=trace,
                 )
             if not failure_noted:
-                _note_failure(url, request_id)
+                _note_failure(url, request_id, span=attempt_span)
             backoff = policy.backoff(attempt) if policy else 0.0
             if _deadline_blocks_attempt(deadline, backoff):
                 res_metrics.deadline_sheds_total.labels(
@@ -417,11 +474,15 @@ async def proxy_and_stream(
                 next_url = await _next_backend(failover, tried, attempt)
             if next_url is None:
                 logger.error("backend %s failed for %s: %s", url, request_id, e)
+                attempt_span.set_attribute("outcome", "error")
+                attempt_span.end()
                 return _error_response(502, f"backend error: {e}", "bad_gateway")
             logger.warning(
                 "backend %s unreachable for %s (%s); failing over to %s",
                 url, request_id, e, next_url,
             )
+            attempt_span.set_attribute("outcome", "failover")
+            attempt_span.end()
             res_metrics.retries_total.labels(server=url).inc()
             res_metrics.failovers_total.inc()
             await asyncio.sleep(policy.backoff(attempt))
@@ -467,18 +528,30 @@ async def _buffered_attempt(
     request_id: str,
     deadline: Optional[Deadline],
     suffix: str = "",
+    span_name: str = "proxy_attempt",
+    kind: str = "primary",
 ):
     """One fully-buffered upstream attempt (hedge path only — hedged
     endpoints are all non-streaming, so buffering is safe and lets the
     first *usable* response win the race). Returns
     ``(status, headers, payload, url)``; raises on transport failure.
     Feeds the breakers and request-stats monitor like any proxy attempt.
+    Each leg is its own span (``proxy_attempt`` for primary/retry legs,
+    ``hedge`` for the hedge leg) carrying the same trace id downstream.
     """
     session: aiohttp.ClientSession = request.app["client_session"]
     policy = get_retry_policy()
     monitor = get_request_stats_monitor()
+    trace = request.get("trace") or NOOP_TRACE
     rid = request_id + suffix
-    fwd = with_deadline_header(_forwardable(request.headers), deadline)
+    span = trace.span(
+        span_name,
+        attributes={"server": url, "kind": kind, "endpoint": endpoint},
+    )
+    fwd = _trace_headers(
+        with_deadline_header(_forwardable(request.headers), deadline),
+        request_id, span,
+    )
     remaining = deadline.remaining_s() if deadline is not None else None
     timeout = aiohttp.ClientTimeout(
         total=max(remaining, 0.001) if remaining is not None else None,
@@ -501,22 +574,35 @@ async def _buffered_attempt(
         # The race was decided against this attempt: closing the request
         # aborts it upstream (the engine stops decoding for a loser).
         monitor.on_request_complete(url, rid, time.time())
+        span.set_attribute("outcome", "cancelled")
+        span.end()
         raise
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
         monitor.on_request_complete(url, rid, time.time())
+        span.set_attribute("error", str(e))
         if not (deadline is not None and deadline.expired()):
-            _note_failure(url, rid)
+            _note_failure(url, rid, span=span)
+            span.set_attribute("outcome", "error")
+        else:
+            span.set_attribute("outcome", "deadline_shed")
+        span.end()
         raise
     monitor.on_request_response(url, rid, time.time())
     monitor.on_request_complete(url, rid, time.time())
+    span.set_attribute("http.status_code", status)
     if status == 503 and "X-PST-Draining" in headers:
         get_service_discovery().set_draining(url, True)
+        span.set_attribute("outcome", "draining")
     elif status == 504 and DEADLINE_EXCEEDED_HEADER in headers:
-        pass  # deliberate budget shed: the engine is alive, not failing
+        span.set_attribute("outcome", "deadline_shed")
+        trace.add_event("deadline_shed", stage="engine", server=url)
     elif status >= 500:
-        _note_failure(url, rid)
+        _note_failure(url, rid, span=span)
+        span.set_attribute("outcome", "error_passthrough")
     else:
         _note_success(url)
+        span.set_attribute("outcome", "ok")
+    span.end()
     return status, headers, payload, url
 
 
@@ -570,7 +656,8 @@ async def proxy_with_hedge(
             return _hedge_failure_response(failed_result)
         if deadline is not None and deadline.expired():
             return _deadline_response(
-                "deadline exceeded after upstream failure", "router_proxy"
+                "deadline exceeded after upstream failure", "router_proxy",
+                trace=request.get("trace"),
             )
         if _deadline_blocks_attempt(deadline):
             res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
@@ -584,12 +671,13 @@ async def proxy_with_hedge(
         try:
             r = await _buffered_attempt(
                 request, alt, endpoint, body, request_id, deadline,
-                suffix="-retry",
+                suffix="-retry", kind="retry",
             )
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             if deadline is not None and deadline.expired():
                 return _deadline_response(
-                    "deadline exceeded during failover attempt", "router_proxy"
+                    "deadline exceeded during failover attempt", "router_proxy",
+                    trace=request.get("trace"),
                 )
             return _error_response(502, f"backend error: {e}", "bad_gateway")
         return await _hedge_respond(request, endpoint, request_id, r)
@@ -631,13 +719,16 @@ async def proxy_with_hedge(
             suppressed = "capacity"
         if suppressed is not None:
             res_metrics.hedges_suppressed_total.labels(reason=suppressed).inc()
+            (request.get("trace") or NOOP_TRACE).add_event(
+                "hedge_suppressed", reason=suppressed
+            )
             try:
                 result = await primary
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 if deadline is not None and deadline.expired():
                     return _deadline_response(
                         "deadline exceeded during upstream attempt",
-                        "router_proxy",
+                        "router_proxy", trace=request.get("trace"),
                     )
                 return await _one_failover(None)
             if result[0] >= 500:
@@ -650,6 +741,9 @@ async def proxy_with_hedge(
         hedge_acquired = True
         tried.add(alt_url)
         res_metrics.hedges_fired_total.inc()
+        trace = request.get("trace") or NOOP_TRACE
+        trace.add_event("hedge_fired", server=alt_url,
+                        delay_ms=round(delay * 1000.0, 1))
         logger.info(
             "hedging %s: primary %s slow (>%.0fms), firing hedge to %s",
             request_id, backend_url, delay * 1000, alt_url,
@@ -657,7 +751,7 @@ async def proxy_with_hedge(
         hedge_task = asyncio.ensure_future(
             _buffered_attempt(
                 request, alt_url, endpoint, body, request_id, deadline,
-                suffix="-hedge",
+                suffix="-hedge", span_name="hedge", kind="hedge",
             )
         )
         pending = {primary, hedge_task}
@@ -680,7 +774,8 @@ async def proxy_with_hedge(
         if winner is None:
             if deadline is not None and deadline.expired():
                 return _deadline_response(
-                    "deadline exceeded (primary and hedge)", "router_proxy"
+                    "deadline exceeded (primary and hedge)", "router_proxy",
+                    trace=request.get("trace"),
                 )
             last = _attempt_result(primary) or (
                 _attempt_result(hedge_task) if hedge_task.done() else None
@@ -690,6 +785,7 @@ async def proxy_with_hedge(
             return await _one_failover(last)
         if winner_is_hedge:
             res_metrics.hedges_won_total.inc()
+            trace.add_event("hedge_won", server=winner[3])
         hedge.observe_latency(time.time() - start)
         return await _hedge_respond(
             request, endpoint, request_id, winner, hedged=winner_is_hedge
@@ -755,7 +851,14 @@ async def _hedge_respond(
 
 async def route_general_request(request: web.Request, endpoint: str) -> web.StreamResponse:
     """Route an OpenAI-API request to an engine and stream the response."""
-    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    # The tracing middleware assigned the id (and opened the root span);
+    # fall back for paths it does not cover so the id is never absent.
+    request_id = (
+        request.get("request_id")
+        or request.headers.get("X-Request-Id")
+        or str(uuid.uuid4())
+    )
+    trace = request.get("trace") or NOOP_TRACE
     # End-to-end budget: parsed by the admission middleware (anchored at
     # arrival, so queue time counts), or here for paths it does not cover.
     deadline: Optional[Deadline] = request.get("deadline")
@@ -768,7 +871,8 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     if deadline is not None and deadline.expired():
         # Cheapest shed point: nothing has been parsed, routed, or sent.
         return _deadline_response(
-            "deadline exceeded before routing", "router_admission"
+            "deadline exceeded before routing", "router_admission",
+            trace=trace,
         )
     body = await request.read()
     try:
@@ -861,12 +965,27 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
     headers = dict(request.headers)
+    # The routing decision is its own stage: which engine, picked by which
+    # policy, from how many live candidates.
+    routing_span = trace.span(
+        "routing",
+        attributes={
+            "policy": type(router).__name__,
+            "candidates": len(candidates),
+            "model": requested_model,
+        },
+    )
     try:
         backend_url = await route_with_resilience(
             router, candidates, engine_stats, request_stats, headers, request_json
         )
     except ValueError as e:
+        routing_span.set_attribute("outcome", "no_backend")
+        routing_span.end()
         return _error_response(503, f"no backend available: {e}", "service_unavailable")
+    routing_span.set_attribute("engine", backend_url)
+    routing_span.set_attribute("outcome", "routed")
+    routing_span.end()
     logger.debug("routing %s for model %s to %s", request_id, requested_model, backend_url)
     failover = make_failover(candidates, headers, request_json)
     hedge = get_hedge_policy()
@@ -906,6 +1025,7 @@ async def route_disaggregated_prefill_request(
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
     headers = dict(request.headers)
+    trace = request.get("trace") or NOOP_TRACE
 
     original_max_tokens = request_json.get("max_tokens")
     original_stream = request_json.get("stream", False)
@@ -917,12 +1037,20 @@ async def route_disaggregated_prefill_request(
     # connector config surface, deployment-vllm-multi.yaml:180-189).
     prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
 
+    routing_span = trace.span(
+        "routing", attributes={"pool": "prefill",
+                               "policy": type(router).__name__}
+    )
     try:
         prefill_url = await route_with_resilience(
             router, endpoints, engine_stats, request_stats, headers, prefill_json
         )
     except ValueError as e:
+        routing_span.set_attribute("outcome", "no_backend")
+        routing_span.end()
         return _error_response(503, f"no prefill backend: {e}", "service_unavailable")
+    routing_span.set_attribute("engine", prefill_url)
+    routing_span.end()
 
     session: aiohttp.ClientSession = request.app["client_session"]
     policy = get_retry_policy()
@@ -932,8 +1060,12 @@ async def route_disaggregated_prefill_request(
     while True:
         if deadline is not None and deadline.expired():
             return _deadline_response(
-                "deadline exceeded before prefill attempt", "router_proxy"
+                "deadline exceeded before prefill attempt", "router_proxy",
+                trace=trace,
             )
+        prefill_span = trace.span(
+            "disagg_prefill", attributes={"server": prefill_url}
+        )
         # Same per-attempt bounds and retry/failover semantics as
         # proxy_and_stream — nothing from the prefill response reaches the
         # client, so it is always safe to re-route. Without the timeout a
@@ -946,7 +1078,10 @@ async def route_disaggregated_prefill_request(
             connect=(policy.connect_timeout or None) if policy else None,
             sock_read=(policy.read_timeout or None) if policy else None,
         )
-        fwd_headers = with_deadline_header(_forwardable(headers), deadline)
+        fwd_headers = _trace_headers(
+            with_deadline_header(_forwardable(headers), deadline),
+            request_id, prefill_span,
+        )
         t_prefill_start = time.time()
         monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
         error: Optional[str] = None
@@ -966,23 +1101,33 @@ async def route_disaggregated_prefill_request(
             monitor.on_request_response(prefill_url, f"{request_id}-prefill", time.time())
             monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
             _note_success(prefill_url)
+            prefill_span.set_attribute("outcome", "ok")
+            prefill_span.end()
             logger.debug(
                 "disagg prefill for %s done in %.3fs",
                 request_id, time.time() - t_prefill_start,
             )
             break
         monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
+        if error is not None:
+            prefill_span.set_attribute("error", error)
         if draining:
             # Deliberate drain, not a failure (same rule as
             # proxy_and_stream): reconcile discovery, spare the breaker.
             get_service_discovery().set_draining(prefill_url, True)
+            prefill_span.set_attribute("outcome", "draining")
         elif deadline is not None and deadline.expired():
             # Budget exhausted mid-prefill: a deadline shed, not a failure.
+            prefill_span.set_attribute("outcome", "deadline_shed")
+            prefill_span.end()
             return _deadline_response(
-                "deadline exceeded during prefill", "router_proxy"
+                "deadline exceeded during prefill", "router_proxy",
+                trace=trace,
             )
         else:
-            _note_failure(prefill_url, request_id)
+            _note_failure(prefill_url, request_id, span=prefill_span)
+            prefill_span.set_attribute("outcome", "error")
+        prefill_span.end()
         backoff = policy.backoff(attempt) if policy else 0.0
         if _deadline_blocks_attempt(deadline, backoff):
             res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
@@ -1012,12 +1157,20 @@ async def route_disaggregated_prefill_request(
     decode_json["stream"] = original_stream
     decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
     decode_json["kv_transfer_params"]["prefill_url"] = prefill_url
+    routing_span = trace.span(
+        "routing", attributes={"pool": "decode",
+                               "policy": type(router).__name__}
+    )
     try:
         decode_url = await route_with_resilience(
             router, endpoints, engine_stats, request_stats, headers, decode_json
         )
     except ValueError as e:
+        routing_span.set_attribute("outcome", "no_backend")
+        routing_span.end()
         return _error_response(503, f"no decode backend: {e}", "service_unavailable")
+    routing_span.set_attribute("engine", decode_url)
+    routing_span.end()
     return await proxy_and_stream(
         request,
         decode_url,

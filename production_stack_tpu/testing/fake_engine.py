@@ -23,6 +23,7 @@ from typing import List, Optional
 from aiohttp import web
 
 from ..logging_utils import init_logger
+from ..obs import observe_stage, render_obs_metrics
 
 logger = init_logger(__name__)
 
@@ -63,6 +64,10 @@ class FakeEngineState:
         # X-PST-Deadline-Ms header value (or None) per generation request,
         # in arrival order — lets tests assert budget propagation/decay.
         self.deadlines_seen: List[Optional[str]] = []
+        # (traceparent, X-Request-Id) per generation request, in arrival
+        # order — lets e2e tests assert one trace id spans every leg
+        # (primary, retries, hedges) across engines.
+        self.traces_seen: List[dict] = []
 
     def take_fault(self) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None."""
@@ -128,13 +133,27 @@ def create_fake_engine_app(
         except ValueError:
             return None
 
-    def _deadline_exceeded_response() -> web.Response:
+    def _echo_trace_headers(request: web.Request) -> dict:
+        """Echo the received trace headers back so e2e tests can assert
+        propagation on every leg — including retries, hedges, and
+        drain/shed rejections — without engine-side state."""
+        out = {}
+        tp = request.headers.get("traceparent")
+        rid = request.headers.get("X-Request-Id")
+        if tp is not None:
+            out["X-Echo-Traceparent"] = tp
+        if rid is not None:
+            out["X-Echo-Request-Id"] = rid
+        return out
+
+    def _deadline_exceeded_response(request: web.Request) -> web.Response:
         return web.json_response(
             {"error": {"message": "deadline exceeded",
                        "type": "deadline_exceeded", "code": 504}},
             status=504,
             headers={"X-PST-Deadline-Exceeded": "1",
-                     "X-Served-By": state.name},
+                     "X-Served-By": state.name,
+                     **_echo_trace_headers(request)},
         )
 
     async def _generate(request: web.Request, is_chat: bool) -> web.StreamResponse:
@@ -142,16 +161,22 @@ def create_fake_engine_app(
         state.requests_seen.append(body)
         budget = _deadline_budget_s(request)
         state.deadlines_seen.append(request.headers.get("X-PST-Deadline-Ms"))
+        state.traces_seen.append({
+            "traceparent": request.headers.get("traceparent"),
+            "request_id": request.headers.get("X-Request-Id"),
+        })
+        echo = _echo_trace_headers(request)
+        t_admission = time.monotonic()
         if budget is not None and budget <= 0:
             # The real engine sheds already-expired work at admission; a
             # router honoring the contract never forwards such a request.
-            return _deadline_exceeded_response()
+            return _deadline_exceeded_response(request)
         if state.draining:
             return web.json_response(
                 {"error": {"message": "engine is draining",
                            "type": "service_unavailable", "code": 503}},
                 status=503,
-                headers={"X-PST-Draining": "1"},
+                headers={"X-PST-Draining": "1", **echo},
             )
         fault = state.take_fault()
         if fault == "slow":
@@ -163,7 +188,7 @@ def create_fake_engine_app(
                 # — sleep until it expires, then 504 (what a deadline-
                 # shedding engine does when a sequence expires mid-decode).
                 await asyncio.sleep(max(budget, 0.0))
-                return _deadline_exceeded_response()
+                return _deadline_exceeded_response(request)
             await asyncio.sleep(delay)
             # ... then serve normally below (slow, not broken).
         if fault == "error":
@@ -172,6 +197,7 @@ def create_fake_engine_app(
                            "type": "internal_error",
                            "code": state.fail_status}},
                 status=state.fail_status,
+                headers=echo,
             )
         if fault == "hang":
             # Hold the request open until the caller gives up (poll the
@@ -188,12 +214,21 @@ def create_fake_engine_app(
         req_id = f"fake-{uuid.uuid4().hex[:12]}"
         token_interval = 1.0 / state.speed if state.speed > 0 else 0.0
         try:
+            # Mirror the real engine's stage decomposition so mixed-workload
+            # e2e tests see engine-side pst_stage_duration_seconds labels.
+            observe_stage("engine", "engine_admission",
+                          time.monotonic() - t_admission)
+            t_prefill = time.monotonic()
             if ttft:
                 await asyncio.sleep(ttft)
+            observe_stage("engine", "prefill", time.monotonic() - t_prefill)
+            t_decode = time.monotonic()
             if stream:
                 resp = web.StreamResponse(status=200)
                 resp.headers["Content-Type"] = "text/event-stream"
                 resp.headers["X-Served-By"] = state.name
+                for k, v in echo.items():
+                    resp.headers[k] = v
                 await resp.prepare(request)
                 for i in range(n_tokens):
                     if is_chat:
@@ -226,6 +261,7 @@ def create_fake_engine_app(
                     if token_interval:
                         await asyncio.sleep(token_interval)
                 await resp.write(b"data: [DONE]\n\n")
+                observe_stage("engine", "decode", time.monotonic() - t_decode)
                 await resp.write_eof()
                 return resp
             else:
@@ -264,8 +300,9 @@ def create_fake_engine_app(
                             "total_tokens": 10 + n_tokens,
                         },
                     }
+                observe_stage("engine", "decode", time.monotonic() - t_decode)
                 return web.json_response(
-                    payload, headers={"X-Served-By": state.name}
+                    payload, headers={"X-Served-By": state.name, **echo}
                 )
         finally:
             state.num_running -= 1
@@ -295,6 +332,9 @@ def create_fake_engine_app(
                 "",
             ]
         )
+        # Same contract as the real engine: pst_stage_duration_seconds
+        # rides the shared observability registry.
+        text += render_obs_metrics().decode()
         return web.Response(text=text, content_type="text/plain")
 
     async def health(request: web.Request) -> web.Response:

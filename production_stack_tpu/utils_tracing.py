@@ -23,6 +23,24 @@ from .logging_utils import init_logger
 
 logger = init_logger(__name__)
 
+# Set by init_otel: None = never attempted, False = attempted and degraded
+# (endpoint unset / SDK missing), True = a real TracerProvider is installed.
+_otel_state: Optional[bool] = None
+
+
+def otel_active() -> bool:
+    """Whether init_otel installed a real SDK TracerProvider this process.
+
+    The in-process span recorder (``obs/tracing.py``) consults this before
+    mirroring spans, so the OTel SDK is never touched unless it was
+    successfully initialized."""
+    return bool(_otel_state)
+
+
+def reset_otel_state_for_tests() -> None:
+    global _otel_state
+    _otel_state = None
+
 
 def init_sentry(dsn: Optional[str], traces_sample_rate: float = 0.0,
                 profile_session_sample_rate: float = 0.0) -> bool:
@@ -52,9 +70,19 @@ def init_otel(service_name_default: str) -> bool:
     Activates only when OTEL_EXPORTER_OTLP_ENDPOINT is set AND the OTel SDK
     is importable; spans export over OTLP to the configured collector (the
     reference wires the same envs into its engines,
-    `tutorials/12-distributed-tracing.md:1-70`)."""
+    `tutorials/12-distributed-tracing.md:1-70`).
+
+    Idempotent: a second call (router and engine bootstrap paths can both
+    reach here in one process, e.g. in tests) returns the first outcome
+    without installing a second TracerProvider — the SDK would reject it
+    and the duplicate BatchSpanProcessor would double-export every span."""
+    global _otel_state
+    if _otel_state is not None:
+        return _otel_state
     endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
     if not endpoint:
+        # Not cached: the endpoint may be configured later in-process
+        # (tests, dynamic bootstrap) and a retry should then succeed.
         return False
     try:
         from opentelemetry import trace
@@ -70,12 +98,24 @@ def init_otel(service_name_default: str) -> bool:
             "not installed; tracing disabled (pip install opentelemetry-sdk "
             "opentelemetry-exporter-otlp)"
         )
+        _otel_state = False
         return False
     service = os.environ.get("OTEL_SERVICE_NAME", service_name_default)
-    provider = TracerProvider(
-        resource=Resource.create({"service.name": service})
-    )
+    resource = Resource.create({"service.name": service})
+    try:
+        # Span-recorder mirroring (obs/tracing.py) replays spans with the
+        # recorder's own trace/span ids so exported parent links resolve;
+        # older SDKs without the id_generator kwarg fall back to random
+        # ids (spans still export, parent links degrade).
+        from .obs.tracing import MirroredIdGenerator
+
+        provider = TracerProvider(
+            resource=resource, id_generator=MirroredIdGenerator()
+        )
+    except TypeError:
+        provider = TracerProvider(resource=resource)
     provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
     trace.set_tracer_provider(provider)
     logger.info("otel tracing initialized: %s -> %s", service, endpoint)
+    _otel_state = True
     return True

@@ -3,6 +3,7 @@
 import time
 import uuid
 
+from production_stack_tpu.obs import teardown_request_tracing
 from production_stack_tpu.resilience import teardown_resilience
 from production_stack_tpu.router.routing.logic import teardown_routing_logic
 from production_stack_tpu.router.service_discovery import (
@@ -16,6 +17,7 @@ from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
 
 def reset_router_singletons():
     teardown_resilience()
+    teardown_request_tracing()
     teardown_routing_logic()
     try:
         teardown_service_discovery()

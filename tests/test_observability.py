@@ -1,0 +1,430 @@
+"""Unit ring for the observability layer (docs/observability.md).
+
+Ring 1a: span recorder — traceparent parse/format, span/timeline shape,
+ring-buffer bound, post-hoc span reconstruction, no-op mode, the
+``pst_stage_duration_seconds`` surface, and the router singleton
+lifecycle.
+Ring 1b: ``utils_tracing`` degradation paths — endpoint-unset no-op,
+SDK-absent no-op, double-init safety — plus OTel span mirroring against
+a fake in-process SDK (the real one is not a test dependency, by design).
+Ring 1c: the monotonic-clock contract for queue/TTFT bookkeeping
+(engine/sequence.py + scheduler stamps).
+"""
+
+import sys
+import time
+import types
+
+import pytest
+
+from production_stack_tpu import utils_tracing
+from production_stack_tpu.engine.kv_manager import BlockAllocator
+from production_stack_tpu.engine.scheduler import Scheduler, SchedulerConfig
+from production_stack_tpu.engine.sequence import SamplingParams, Sequence
+from production_stack_tpu.obs import (
+    NOOP_TRACE,
+    SpanRecorder,
+    format_traceparent,
+    get_request_tracer,
+    initialize_request_tracing,
+    observe_stage,
+    parse_traceparent,
+    render_obs_metrics,
+    teardown_request_tracing,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_otel_state():
+    utils_tracing.reset_otel_state_for_tests()
+    yield
+    utils_tracing.reset_otel_state_for_tests()
+    teardown_request_tracing()
+
+
+# ---------------------------------------------------------------------------
+# Ring 1a — recorder / spans / timelines
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    tid, sid = "ab" * 16, "cd" * 8
+    assert parse_traceparent(format_traceparent(tid, sid)) == (tid, sid)
+    # Future-version extra fields are tolerated (W3C allows them).
+    assert parse_traceparent(f"00-{tid}-{sid}-01-extra") == (tid, sid)
+
+
+@pytest.mark.parametrize("value", [
+    None, "", "garbage", "00-short-span-01",
+    "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",      # non-hex trace id
+    "00-" + "ab" * 16 + "-" + "cd" * 4 + "-01",     # short span id
+    "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",      # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",     # all-zero span id
+])
+def test_traceparent_malformed_starts_fresh_trace(value):
+    assert parse_traceparent(value) is None
+
+
+def test_trace_spans_and_timeline_shape():
+    rec = SpanRecorder("router", buffer=8)
+    trace = rec.trace("req-1", name="request",
+                      attributes={"http.target": "/v1/completions"})
+    admission = trace.span("admission")
+    admission.set_attribute("outcome", "admitted")
+    admission.end()
+    routing = trace.span("routing", attributes={"engine": "http://e1"})
+    routing.end()
+    attempt = trace.span("proxy_attempt", attributes={"server": "http://e1"})
+    attempt.add_event("first_byte")
+    attempt.end()
+    trace.finish(status=200)
+
+    [tl] = rec.timelines()
+    assert tl["request_id"] == "req-1"
+    assert tl["trace_id"] == trace.trace_id
+    assert tl["status"] == 200
+    names = [s["name"] for s in tl["spans"]]
+    assert names == ["request", "admission", "routing", "proxy_attempt"]
+    root = tl["spans"][0]
+    assert root["parent_id"] is None
+    # Children parent onto the root and nest inside its duration.
+    for child in tl["spans"][1:]:
+        assert child["parent_id"] == root["span_id"]
+        assert child["start_ms"] >= root["start_ms"]
+        assert (child["start_ms"] + child["duration_ms"]
+                <= root["start_ms"] + root["duration_ms"] + 1.0)
+    # Stages start in causal order.
+    starts = [s["start_ms"] for s in tl["spans"][1:]]
+    assert starts == sorted(starts)
+    assert tl["spans"][3]["events"][0]["name"] == "first_byte"
+
+
+def test_incoming_traceparent_joins_trace():
+    rec = SpanRecorder("router", buffer=4)
+    tid, sid = "ab" * 16, "cd" * 8
+    trace = rec.trace(
+        "req-j", headers={"traceparent": format_traceparent(tid, sid)}
+    )
+    assert trace.trace_id == tid
+    assert trace.root.parent_id == sid
+    # Outbound propagation names the local span as the new parent.
+    child = trace.span("proxy_attempt")
+    tp = child.traceparent()
+    assert parse_traceparent(tp) == (tid, child.span_id)
+    trace.finish(status=200)
+
+
+def test_ring_buffer_bound_and_order():
+    rec = SpanRecorder("router", buffer=4)
+    for i in range(7):
+        t = rec.trace(f"req-{i}")
+        t.finish(status=200)
+    tls = rec.timelines()
+    assert len(tls) == 4
+    # Most recent first.
+    assert [t["request_id"] for t in tls] == ["req-6", "req-5", "req-4", "req-3"]
+    assert rec.timelines(limit=2)[0]["request_id"] == "req-6"
+    assert rec.timelines(request_id="req-5")[0]["request_id"] == "req-5"
+    assert rec.timelines(request_id="req-0") == []
+
+
+def test_record_span_post_hoc_reconstruction():
+    """The engine replays queue/prefill/decode from Sequence timestamps:
+    spans laid back-to-back must come out adjacent and ordered."""
+    rec = SpanRecorder("engine", buffer=4)
+    trace = rec.trace("req-e", name="engine_request")
+    now = time.monotonic()
+    trace.record_span("engine_queue", 0.010, end_mono=now - 0.030)
+    trace.record_span("prefill", 0.020, end_mono=now - 0.010)
+    trace.record_span("decode", 0.010, end_mono=now)
+    trace.finish(status=200)
+    [tl] = rec.timelines()
+    by_name = {s["name"]: s for s in tl["spans"]}
+    q, p, d = by_name["engine_queue"], by_name["prefill"], by_name["decode"]
+    assert q["duration_ms"] == pytest.approx(10.0, abs=1.0)
+    assert p["duration_ms"] == pytest.approx(20.0, abs=1.0)
+    # queue ends where prefill starts; prefill ends where decode starts.
+    assert q["start_ms"] + q["duration_ms"] == pytest.approx(p["start_ms"], abs=1.0)
+    assert p["start_ms"] + p["duration_ms"] == pytest.approx(d["start_ms"], abs=1.0)
+
+
+def test_disabled_recorder_is_noop():
+    rec = SpanRecorder("router", buffer=8, enabled=False)
+    trace = rec.trace("req-x")
+    assert trace is NOOP_TRACE
+    # Every operation is inert and chainable — no guards needed at sites.
+    span = trace.span("routing")
+    span.set_attribute("k", "v").add_event("e")
+    span.end()
+    assert span.traceparent() is None
+    trace.record_span("prefill", 0.01)
+    trace.finish(status=500)
+    assert rec.timelines() == []
+
+
+def test_buffer_zero_disables_endpoint_not_tracing():
+    """--debug-requests-buffer 0: the /debug/requests ring is off, but
+    tracing itself (spans → histograms, propagation) keeps running."""
+    rec = SpanRecorder("router", buffer=0, enabled=True)
+    assert rec.enabled is True
+    assert rec.debug_endpoint_enabled is False
+    trace = rec.trace("req-z")
+    assert trace is not NOOP_TRACE
+    span = trace.span("routing")
+    assert span.traceparent() is not None  # propagation still works
+    span.end()
+    trace.finish(status=200)
+    assert rec.timelines() == []  # nothing retained
+    # A normally-sized recorder with tracing on serves the endpoint.
+    assert SpanRecorder("router", buffer=8).debug_endpoint_enabled is True
+    assert SpanRecorder(
+        "router", buffer=8, enabled=False
+    ).debug_endpoint_enabled is False
+
+
+def test_mirrored_id_generator_forces_recorder_ids():
+    from production_stack_tpu.obs.tracing import (
+        _FORCED_OTEL_IDS,
+        MirroredIdGenerator,
+    )
+
+    gen = MirroredIdGenerator()
+    token = _FORCED_OTEL_IDS.set((0xABC, 0xDEF))
+    try:
+        assert gen.generate_trace_id() == 0xABC
+        assert gen.generate_span_id() == 0xDEF
+    finally:
+        _FORCED_OTEL_IDS.reset(token)
+    # Outside a mirror replay: random, non-zero, full-width ids.
+    t, s = gen.generate_trace_id(), gen.generate_span_id()
+    assert t != 0 and s != 0
+    assert t != gen.generate_trace_id()
+
+
+def test_stage_duration_histogram_surface():
+    observe_stage("router", "routing", 0.005)
+    observe_stage("engine", "prefill", 0.050)
+    observe_stage("engine", "prefill", -1.0)  # clamped, never corrupts
+    text = render_obs_metrics().decode()
+    assert "pst_stage_duration_seconds" in text
+    assert 'component="router",stage="routing"' in text
+    assert 'component="engine",stage="prefill"' in text
+
+
+def test_span_end_feeds_stage_histogram():
+    rec = SpanRecorder("router", buffer=4)
+    trace = rec.trace("req-h")
+    trace.span("admission").end()
+    trace.finish(status=200)
+    text = render_obs_metrics().decode()
+    assert 'component="router",stage="admission"' in text
+    assert 'component="router",stage="request"' in text
+
+
+def test_events_are_bounded():
+    rec = SpanRecorder("router", buffer=4)
+    trace = rec.trace("req-b")
+    for i in range(100):
+        trace.root.add_event(f"e{i}")
+    trace.finish(status=200)
+    [tl] = rec.timelines()
+    assert len(tl["spans"][0]["events"]) == 32
+
+
+def test_router_singleton_lifecycle():
+    rec = initialize_request_tracing(enabled=True, buffer=16)
+    assert get_request_tracer() is rec
+    assert rec.component == "router"
+    teardown_request_tracing()
+    assert get_request_tracer() is None
+
+
+# ---------------------------------------------------------------------------
+# Ring 1b — utils_tracing degradation + OTel mirroring (fake SDK)
+# ---------------------------------------------------------------------------
+
+
+def _install_fake_otel(monkeypatch, record):
+    """A minimal in-process OpenTelemetry stand-in covering exactly the
+    surface init_otel and the span mirror touch."""
+
+    class FakeSpan:
+        def __init__(self, name, context, start_time, attributes):
+            self.name = name
+            self.context = context
+            self.start_time = start_time
+            self.attributes = attributes
+            self.events = []
+            self.end_time = None
+
+        def add_event(self, name, attrs=None, timestamp=None):
+            self.events.append((name, attrs, timestamp))
+
+        def end(self, end_time=None):
+            self.end_time = end_time
+
+    class FakeTracer:
+        def start_span(self, name, context=None, start_time=None,
+                       attributes=None):
+            s = FakeSpan(name, context, start_time, attributes)
+            record["spans"].append(s)
+            return s
+
+    class SpanContext:
+        def __init__(self, trace_id, span_id, is_remote, trace_flags=None):
+            self.trace_id = trace_id
+            self.span_id = span_id
+
+    class NonRecordingSpan:
+        def __init__(self, ctx):
+            self.ctx = ctx
+
+    class TracerProvider:
+        def __init__(self, resource=None):
+            self.processors = []
+
+        def add_span_processor(self, p):
+            self.processors.append(p)
+
+    class Resource:
+        @staticmethod
+        def create(attrs):
+            return attrs
+
+    ot = types.ModuleType("opentelemetry")
+    trace_mod = types.ModuleType("opentelemetry.trace")
+    trace_mod.SpanContext = SpanContext
+    trace_mod.TraceFlags = lambda v: v
+    trace_mod.NonRecordingSpan = NonRecordingSpan
+    trace_mod.set_span_in_context = lambda span: {"parent": span}
+    trace_mod.get_tracer = lambda name: FakeTracer()
+    trace_mod.set_tracer_provider = (
+        lambda p: record["providers"].append(p)
+    )
+    ot.trace = trace_mod
+    sdk = types.ModuleType("opentelemetry.sdk")
+    res_mod = types.ModuleType("opentelemetry.sdk.resources")
+    res_mod.Resource = Resource
+    sdktrace = types.ModuleType("opentelemetry.sdk.trace")
+    sdktrace.TracerProvider = TracerProvider
+    export_mod = types.ModuleType("opentelemetry.sdk.trace.export")
+    export_mod.BatchSpanProcessor = lambda exporter: ("bsp", exporter)
+    exp_root = types.ModuleType("opentelemetry.exporter")
+    exp_otlp = types.ModuleType("opentelemetry.exporter.otlp")
+    exp_proto = types.ModuleType("opentelemetry.exporter.otlp.proto")
+    exp_grpc = types.ModuleType("opentelemetry.exporter.otlp.proto.grpc")
+    exp_te = types.ModuleType(
+        "opentelemetry.exporter.otlp.proto.grpc.trace_exporter"
+    )
+    exp_te.OTLPSpanExporter = lambda: "otlp-exporter"
+    mods = {
+        "opentelemetry": ot,
+        "opentelemetry.trace": trace_mod,
+        "opentelemetry.sdk": sdk,
+        "opentelemetry.sdk.resources": res_mod,
+        "opentelemetry.sdk.trace": sdktrace,
+        "opentelemetry.sdk.trace.export": export_mod,
+        "opentelemetry.exporter": exp_root,
+        "opentelemetry.exporter.otlp": exp_otlp,
+        "opentelemetry.exporter.otlp.proto": exp_proto,
+        "opentelemetry.exporter.otlp.proto.grpc": exp_grpc,
+        "opentelemetry.exporter.otlp.proto.grpc.trace_exporter": exp_te,
+    }
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+
+
+def test_init_otel_noop_when_endpoint_unset(monkeypatch):
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    assert utils_tracing.init_otel("pst-test") is False
+    assert utils_tracing.otel_active() is False
+
+
+def test_init_otel_noop_when_sdk_absent(monkeypatch):
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://collector:4317")
+    # Block the import even if an SDK happens to be installed.
+    monkeypatch.setitem(sys.modules, "opentelemetry", None)
+    assert utils_tracing.init_otel("pst-test") is False
+    assert utils_tracing.otel_active() is False
+    # The degraded outcome is cached: a working SDK appearing later does
+    # not flip an already-initialized process (double-init safety).
+    record = {"spans": [], "providers": []}
+    _install_fake_otel(monkeypatch, record)
+    assert utils_tracing.init_otel("pst-test") is False
+    assert record["providers"] == []
+
+
+def test_init_otel_double_init_installs_one_provider(monkeypatch):
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://collector:4317")
+    record = {"spans": [], "providers": []}
+    _install_fake_otel(monkeypatch, record)
+    assert utils_tracing.init_otel("pst-router") is True
+    assert utils_tracing.otel_active() is True
+    # Router and engine bootstrap can both call init_otel in one process:
+    # the second call must not install a second TracerProvider.
+    assert utils_tracing.init_otel("pst-engine") is True
+    assert len(record["providers"]) == 1
+
+
+def test_spans_mirror_to_otel_when_active(monkeypatch):
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://collector:4317")
+    record = {"spans": [], "providers": []}
+    _install_fake_otel(monkeypatch, record)
+    assert utils_tracing.init_otel("pst-router") is True
+    rec = SpanRecorder("router", buffer=4)
+    trace = rec.trace("req-m")
+    span = trace.span("routing", attributes={"engine": "http://e1"})
+    span.add_event("deadline_shed", stage="router_proxy")
+    span.end()
+    trace.finish(status=200)
+    names = [s.name for s in record["spans"]]
+    assert "routing" in names and "request" in names
+    routing = next(s for s in record["spans"] if s.name == "routing")
+    assert routing.attributes["pst.request_id"] == "req-m"
+    assert routing.attributes["pst.trace_id"] == trace.trace_id
+    assert routing.end_time is not None and routing.start_time is not None
+    # Parent linkage rides a SpanContext carrying OUR ids.
+    parent_ctx = routing.context["parent"].ctx
+    assert parent_ctx.trace_id == int(trace.trace_id, 16)
+    # Events replay with their REAL wall time, not the mirror time.
+    (ev_name, _, ev_ts) = routing.events[0]
+    assert ev_name == "deadline_shed"
+    assert ev_ts is not None
+    assert routing.start_time <= ev_ts <= routing.end_time
+
+
+def test_spans_do_not_touch_otel_when_inactive():
+    rec = SpanRecorder("router", buffer=4)
+    trace = rec.trace("req-n")
+    trace.span("routing").end()
+    trace.finish(status=200)  # must not raise with no SDK importable
+    assert utils_tracing.otel_active() is False
+
+
+# ---------------------------------------------------------------------------
+# Ring 1c — monotonic queue/TTFT bookkeeping (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_arrival_time_is_monotonic():
+    seq = Sequence("r1", [1, 2, 3], SamplingParams())
+    now = time.monotonic()
+    # Same clock domain as Sequence.deadline / time.monotonic(): a
+    # wall-clock (epoch) stamp would be ~1.7e9 and fail both bounds.
+    assert seq.arrival_time <= now
+    assert now - seq.arrival_time < 5.0
+
+
+def test_scheduler_stamps_first_scheduled_time_monotonic():
+    allocator = BlockAllocator(num_blocks=16, block_size=4)
+    sched = Scheduler(SchedulerConfig(max_num_seqs=4), allocator)
+    seq = Sequence("r1", [1, 2, 3, 4, 5], SamplingParams(max_tokens=4))
+    assert seq.first_scheduled_time is None
+    sched.add(seq)
+    out = sched.schedule()
+    assert out.prefills, "sequence should be admitted and given prefill work"
+    assert seq.first_scheduled_time is not None
+    assert seq.first_scheduled_time >= seq.arrival_time
+    assert time.monotonic() - seq.first_scheduled_time < 5.0
+    # Queue wait = first_scheduled - arrival, in one clock domain.
+    assert seq.first_scheduled_time - seq.arrival_time < 5.0

@@ -1,0 +1,394 @@
+"""Ring-2 e2e for end-to-end request tracing (docs/observability.md).
+
+Real router app + in-process fake engines that echo received trace
+headers. Covers the acceptance scenario: a request driven through retry
+and hedge keeps ONE trace id across all legs on all engines,
+``GET /debug/requests`` returns a timeline whose stage set includes
+{admission, routing, proxy_attempt, hedge} with monotonic
+non-overlapping-parent timings, ``pst_stage_duration_seconds`` exposes
+≥ 6 distinct stage labels across router and engine metrics after a mixed
+workload, and ``X-Request-Id`` is present on every shed/error response
+(429 admission shed, 504 deadline shed, 502 exhausted failover).
+"""
+
+import asyncio
+import re
+
+import aiohttp
+import pytest
+
+from production_stack_tpu.obs import format_traceparent, parse_traceparent
+
+from .router_utils import reset_router_singletons
+from .test_resilience_e2e import MODEL, Cluster, _completion, _router_metrics
+
+TRACE_ARGS = [
+    "--proxy-retries", "2",
+    "--retry-backoff", "0.01",
+    "--breaker-failure-threshold", "5",
+    "--breaker-recovery-time", "60",
+    "--hedge-enabled",
+    "--hedge-delay-ms", "40",
+]
+
+CLIENT_TRACE_ID = "ab" * 16
+CLIENT_SPAN_ID = "cd" * 8
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+def _stage_labels(metrics_text: str) -> set:
+    return set(
+        re.findall(r'pst_stage_duration_seconds_count\{[^}]*stage="([^"]+)"',
+                   metrics_text)
+    )
+
+
+async def _next_rr_engine(session, c) -> int:
+    """Index of the engine the NEXT request will round-robin to (probe one
+    request and step once in the router's URL-sorted rotation) — fault
+    injection must land where the request under test will, or the
+    retry/hedge never triggers."""
+    status, by, _ = await _completion(
+        session, c.router_url, prompt="probe", max_tokens=1
+    )
+    assert status == 200 and by is not None
+    last = int(by.split("-")[-1])
+    order = sorted(range(3), key=lambda j: c.engine_urls[j])
+    return order[(order.index(last) + 1) % 3]
+
+
+async def _debug_requests(session, url, request_id=None) -> list:
+    qs = f"?request_id={request_id}" if request_id else ""
+    async with session.get(f"{url}/debug/requests{qs}") as resp:
+        assert resp.status == 200
+        return (await resp.json())["requests"]
+
+
+def _assert_timeline_well_formed(tl):
+    """Monotonic, non-overlapping-parent timings: every child span nests
+    inside the root span's window and parents onto it."""
+    root = tl["spans"][0]
+    # The root's parent is the CLIENT's span when a traceparent came in
+    # (joined trace), or absent — never another local span.
+    local_ids = {s["span_id"] for s in tl["spans"]}
+    assert root["parent_id"] is None or root["parent_id"] not in local_ids
+    root_end = root["start_ms"] + root["duration_ms"]
+    for child in tl["spans"][1:]:
+        assert child["parent_id"] == root["span_id"], child
+        assert child["start_ms"] >= root["start_ms"] - 1.0, child
+        assert (
+            child["start_ms"] + child["duration_ms"] <= root_end + 5.0
+        ), child
+        assert child["duration_ms"] >= 0.0
+    starts = [s["start_ms"] for s in tl["spans"][1:]]
+    assert starts == sorted(starts), "stages must start in causal order"
+
+
+async def test_one_trace_spans_retry_and_hedge_legs():
+    """The acceptance scenario: one request retries off a failing engine,
+    another hedges off a slow one — every leg (primary, retry, hedge) on
+    every engine carries the client's trace id, and the router timelines
+    decompose into the expected stages."""
+    async with Cluster(extra_args=TRACE_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            # --- leg 1: retry. The next-targeted engine fails once.
+            target = await _next_rr_engine(s, c)
+            async with s.post(
+                f"{c.engine_urls[target]}/admin/fail",
+                json={"mode": "error", "count": 1},
+            ) as resp:
+                assert resp.status == 200
+            headers = {
+                "X-Request-Id": "trace-retry-1",
+                "traceparent": format_traceparent(
+                    CLIENT_TRACE_ID, CLIENT_SPAN_ID
+                ),
+            }
+            status, _, _ = await _completion(
+                s, c.router_url, prompt="r", max_tokens=2, headers=headers
+            )
+            assert status == 200
+
+            # --- leg 2: hedge. The next-targeted engine goes slow once;
+            # the hedge leg wins the race.
+            target = await _next_rr_engine(s, c)
+            async with s.post(
+                f"{c.engine_urls[target]}/admin/fail",
+                json={"mode": "slow", "delay": 3.0, "count": 1},
+            ) as resp:
+                assert resp.status == 200
+            headers2 = {
+                "X-Request-Id": "trace-hedge-1",
+                "traceparent": format_traceparent(
+                    CLIENT_TRACE_ID, CLIENT_SPAN_ID
+                ),
+            }
+            status, _, _ = await _completion(
+                s, c.router_url, prompt="h", max_tokens=2, headers=headers2
+            )
+            assert status == 200
+
+            # One trace id across ALL legs on ALL engines: every
+            # generation request any engine saw carried our trace id and
+            # our request id, with a fresh per-leg parent span.
+            legs = [
+                t for i in range(3) for t in c.engine_state(i).traces_seen
+                if t["request_id"] in ("trace-retry-1", "trace-hedge-1")
+            ]
+            assert len(legs) >= 4  # primary+retry, primary+hedge
+            seen_parent_spans = set()
+            for leg in legs:
+                parsed = parse_traceparent(leg["traceparent"])
+                assert parsed is not None, leg
+                trace_id, parent_span = parsed
+                assert trace_id == CLIENT_TRACE_ID
+                assert parent_span != CLIENT_SPAN_ID  # router's own span
+                seen_parent_spans.add(parent_span)
+            # Each leg is its own span, not a reused one.
+            assert len(seen_parent_spans) == len(legs)
+
+            # Router timeline for the retry request: admission → routing →
+            # proxy_attempt (primary, kind=primary) → proxy_attempt (retry).
+            [tl] = await _debug_requests(
+                s, c.router_url, request_id="trace-retry-1"
+            )
+            assert tl["trace_id"] == CLIENT_TRACE_ID
+            _assert_timeline_well_formed(tl)
+            names = [sp["name"] for sp in tl["spans"]]
+            assert names[0] == "request"
+            assert {"admission", "routing", "proxy_attempt"} <= set(names)
+            kinds = [
+                sp["attributes"].get("kind")
+                for sp in tl["spans"] if sp["name"] == "proxy_attempt"
+            ]
+            assert "primary" in kinds and "retry" in kinds
+
+            # Router timeline for the hedged request includes the hedge leg.
+            [tl2] = await _debug_requests(
+                s, c.router_url, request_id="trace-hedge-1"
+            )
+            assert tl2["trace_id"] == CLIENT_TRACE_ID
+            _assert_timeline_well_formed(tl2)
+            names2 = {sp["name"] for sp in tl2["spans"]}
+            assert {"admission", "routing", "proxy_attempt", "hedge"} <= names2
+            events = [e["name"] for e in tl2["spans"][0]["events"]]
+            assert "hedge_fired" in events
+
+            # Combined stage set over the two acceptance timelines.
+            assert {"admission", "routing", "proxy_attempt", "hedge"} <= (
+                set(names) | names2
+            )
+
+
+async def test_stage_metrics_cover_router_and_engine():
+    """After a mixed workload (streaming + non-streaming + retry + hedge),
+    pst_stage_duration_seconds exposes ≥ 6 distinct stage labels across
+    router and engine metrics."""
+    async with Cluster(extra_args=TRACE_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            # Non-streaming (hedge-eligible) traffic.
+            for i in range(4):
+                status, _, _ = await _completion(
+                    s, c.router_url, prompt=f"m{i}", max_tokens=2
+                )
+                assert status == 200
+            # Streaming traffic.
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "s", "max_tokens": 3,
+                      "stream": True},
+            ) as resp:
+                assert resp.status == 200
+                await resp.content.read()
+            # A retry leg.
+            target = await _next_rr_engine(s, c)
+            async with s.post(
+                f"{c.engine_urls[target]}/admin/fail",
+                json={"mode": "error", "count": 1},
+            ) as resp:
+                assert resp.status == 200
+            await _completion(s, c.router_url, prompt="rr", max_tokens=2)
+            # A hedge leg.
+            target = await _next_rr_engine(s, c)
+            async with s.post(
+                f"{c.engine_urls[target]}/admin/fail",
+                json={"mode": "slow", "delay": 3.0, "count": 1},
+            ) as resp:
+                assert resp.status == 200
+            await _completion(s, c.router_url, prompt="hh", max_tokens=2)
+
+            router_stages = _stage_labels(
+                await _router_metrics(s, c.router_url)
+            )
+            async with s.get(f"{c.engine_urls[2]}/metrics") as resp:
+                engine_stages = _stage_labels(await resp.text())
+            all_stages = router_stages | engine_stages
+            assert {"request", "admission", "routing",
+                    "proxy_attempt"} <= router_stages
+            assert "hedge" in router_stages
+            assert {"engine_admission", "prefill", "decode"} <= engine_stages
+            assert len(all_stages) >= 6, all_stages
+
+
+async def test_request_id_on_all_shed_and_error_responses():
+    """Satellite: X-Request-Id must be present on 429 admission sheds,
+    504 deadline sheds, and 502 exhausted failovers — failures must be
+    joinable to traces, not just successes."""
+    shed_args = TRACE_ARGS + [
+        "--admission-rate", "0.5",
+        "--admission-burst", "1",
+        "--admission-queue-size", "1",
+        "--admission-queue-timeout", "0.05",
+    ]
+    async with Cluster(extra_args=shed_args) as c:
+        async with aiohttp.ClientSession() as s:
+            # 504 deadline shed (budget already exhausted on arrival).
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={"X-PST-Deadline-Ms": "0",
+                         "X-Request-Id": "shed-504"},
+            ) as resp:
+                assert resp.status == 504
+                assert resp.headers.get("X-PST-Deadline-Exceeded") == "1"
+                assert resp.headers.get("X-Request-Id") == "shed-504"
+
+            # 429 admission shed: burst 1 at 0.5 req/s — concurrent
+            # requests exceed the bucket + bounded queue.
+            async def one(i):
+                async with s.post(
+                    f"{c.router_url}/v1/completions",
+                    json={"model": MODEL, "prompt": f"a{i}", "max_tokens": 1},
+                ) as resp:
+                    return resp.status, resp.headers.get("X-Request-Id")
+            results = await asyncio.gather(*(one(i) for i in range(6)))
+            shed = [r for r in results if r[0] == 429]
+            assert shed, f"expected at least one 429, got {results}"
+            assert all(rid for _, rid in shed)
+
+    # 502 exhausted failover: all engines dead (connect errors).
+    async with Cluster(extra_args=TRACE_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                await c.kill_engine(i)
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 1},
+                headers={"X-Request-Id": "dead-502"},
+            ) as resp:
+                assert resp.status == 502
+                assert resp.headers.get("X-Request-Id") == "dead-502"
+            # The failed request's timeline survives for debugging, with
+            # each failed attempt recorded.
+            [tl] = await _debug_requests(
+                s, c.router_url, request_id="dead-502"
+            )
+            assert tl["status"] == 502
+            attempts = [
+                sp for sp in tl["spans"] if sp["name"] == "proxy_attempt"
+            ]
+            assert len(attempts) >= 1
+            assert all(
+                sp["attributes"].get("outcome") in ("error", "failover")
+                for sp in attempts
+            )
+
+
+async def test_tracing_disabled_passthrough_and_404():
+    """--no-tracing: /debug/requests 404s, X-Request-Id still set on every
+    response, and the client's own traceparent passes through to engines
+    untouched (the router stays a transparent hop)."""
+    async with Cluster(extra_args=TRACE_ARGS + ["--no-tracing"]) as c:
+        async with aiohttp.ClientSession() as s:
+            client_tp = format_traceparent(CLIENT_TRACE_ID, CLIENT_SPAN_ID)
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 2},
+                headers={"traceparent": client_tp},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-Request-Id")
+                assert resp.headers.get("X-Echo-Traceparent") == client_tp
+            async with s.get(f"{c.router_url}/debug/requests") as resp:
+                assert resp.status == 404
+
+
+async def test_debug_requests_buffer_and_limit():
+    async with Cluster(
+        extra_args=TRACE_ARGS + ["--debug-requests-buffer", "3"]
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            for i in range(5):
+                status, _, _ = await _completion(
+                    s, c.router_url, prompt=f"b{i}", max_tokens=1
+                )
+                assert status == 200
+            tls = await _debug_requests(s, c.router_url)
+            assert len(tls) == 3  # ring bound
+            async with s.get(
+                f"{c.router_url}/debug/requests?limit=1"
+            ) as resp:
+                assert len((await resp.json())["requests"]) == 1
+
+    # buffer 0: the endpoint 404s but tracing keeps running — stage
+    # metrics still record and traceparent still reaches the engines.
+    async with Cluster(
+        extra_args=TRACE_ARGS + ["--debug-requests-buffer", "0"]
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "z", "max_tokens": 1},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-Echo-Traceparent")
+            async with s.get(f"{c.router_url}/debug/requests") as resp:
+                assert resp.status == 404
+            assert "routing" in _stage_labels(
+                await _router_metrics(s, c.router_url)
+            )
+
+
+async def test_debug_requests_guarded_by_api_key():
+    """Timelines carry per-request metadata: with an api key configured,
+    /debug/requests requires it (unlike /metrics aggregates)."""
+    async with Cluster(extra_args=TRACE_ARGS + ["--api-key", "sekrit"]) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{c.router_url}/debug/requests") as resp:
+                assert resp.status == 401
+            async with s.get(
+                f"{c.router_url}/debug/requests",
+                headers={"Authorization": "Bearer sekrit"},
+            ) as resp:
+                assert resp.status == 200
+            async with s.get(f"{c.router_url}/metrics") as resp:
+                assert resp.status == 200  # aggregates stay open
+
+
+async def test_trace_headers_propagate_on_drain_rejection():
+    """Drain rejections echo the trace headers too — a drained engine's
+    503 is part of the request's story."""
+    async with Cluster(extra_args=TRACE_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            # Drain all engines directly (router discovery not yet aware).
+            for url in c.engine_urls:
+                async with s.post(f"{url}/drain") as resp:
+                    assert resp.status == 200
+            async with s.post(
+                f"{c.engine_urls[0]}/v1/completions",
+                json={"model": MODEL, "prompt": "x", "max_tokens": 1},
+                headers={"X-Request-Id": "drain-1",
+                         "traceparent": format_traceparent(
+                             CLIENT_TRACE_ID, CLIENT_SPAN_ID)},
+            ) as resp:
+                assert resp.status == 503
+                assert resp.headers.get("X-Echo-Request-Id") == "drain-1"
+                assert parse_traceparent(
+                    resp.headers.get("X-Echo-Traceparent")
+                )[0] == CLIENT_TRACE_ID
