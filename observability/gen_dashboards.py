@@ -198,6 +198,20 @@ def fleet_dashboard():
                   'sum(rate(pst:deadline_shed_queued[2m])) + '
                   'sum(rate(pst:deadline_shed_running[2m])) or vector(0)',
                   4, 57))
+    # Stream resumption (docs/resilience.md "Stream resumption"): broken
+    # streams continued on another engine vs visibly truncated.
+    p.append(panel("Stream resume / truncation", [
+        ('sum(rate(pst_stream_resume_attempts_total[2m]))',
+         "resume legs /s"),
+        ('sum(rate(pst_stream_resume_success_total[2m]))', "resumed /s"),
+        ('sum(rate(pst_stream_resume_failures_total[2m]))',
+         "resume failed /s"),
+        ('sum(rate(pst_stream_truncated_total[2m])) by (reason)',
+         "truncated {{reason}} /s"),
+    ], 8, 57))
+    p.append(stat("Truncated streams /s",
+                  'sum(rate(pst_stream_truncated_total[2m])) or vector(0)',
+                  16, 57))
     # Row 9 — latency breakdown (pst_stage_duration_seconds, from the
     # request-tracing span recorder): the true TTFT decomposition — router
     # admission / routing / proxy vs engine queue / prefill / decode /

@@ -533,7 +533,15 @@ def create_engine_app(
             return _error("engine is sleeping", 503, "service_unavailable")
         if engine.draining:
             return _drain_error()
-        prompt = engine.engine.tokenizer.apply_chat_template(req.messages)
+        # continue_final_message (vLLM parity, pydantic extra="allow"):
+        # render the final message's turn OPEN so generation continues it
+        # instead of starting a fresh assistant turn — what the router's
+        # stream-resume continuation requests rely on.
+        cfm = bool(getattr(req, "continue_final_message", False))
+        prompt = engine.engine.tokenizer.apply_chat_template(
+            req.messages, add_generation_prompt=not cfm,
+            continue_final_message=cfm,
+        )
         return await _serve_generation(request, req, prompt, is_chat=True)
 
     async def completions(request: web.Request) -> web.StreamResponse:
@@ -788,8 +796,13 @@ def create_engine_app(
                 # in case the failure happened mid-stream (the sequence
                 # must not keep decoding for a dead client).
                 await engine.abort(rid)
+                # Stable machine-readable code: an in-band error frame is
+                # an engine-*reported* failure (deliberate), which the
+                # router's stream journal must never resume — unlike a
+                # transport death, which it may.
                 err = {"error": {"message": str(e),
-                                 "type": "invalid_request_error"}}
+                                 "type": "invalid_request_error",
+                                 "code": "engine_rejected"}}
                 await resp.write(f"data: {json.dumps(err)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()

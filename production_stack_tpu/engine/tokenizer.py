@@ -26,14 +26,27 @@ class Tokenizer(Protocol):
     def decode(self, ids: Sequence[int]) -> str: ...
 
     def apply_chat_template(
-        self, messages: List[ChatMessage], add_generation_prompt: bool = True
+        self,
+        messages: List[ChatMessage],
+        add_generation_prompt: bool = True,
+        continue_final_message: bool = False,
     ) -> str: ...
 
 
 def _fallback_chat_template(
-    messages: List[ChatMessage], add_generation_prompt: bool
+    messages: List[ChatMessage],
+    add_generation_prompt: bool,
+    continue_final_message: bool = False,
 ) -> str:
     parts = [f"<|{m.role}|>\n{m.text()}\n" for m in messages]
+    if continue_final_message:
+        # Leave the final message's turn OPEN (no terminator, no new
+        # generation prompt) so the model continues it mid-sentence —
+        # the contract stream resumption relies on: the continuation is
+        # the suffix of the final assistant message, not a fresh turn.
+        if parts:
+            parts[-1] = parts[-1][:-1]
+        return "".join(parts)
     if add_generation_prompt:
         parts.append("<|assistant|>\n")
     return "".join(parts)
@@ -72,9 +85,14 @@ class ByteTokenizer:
         return ia + [258] + ib, [0] * (len(ia) + 1) + [1] * len(ib)
 
     def apply_chat_template(
-        self, messages: List[ChatMessage], add_generation_prompt: bool = True
+        self,
+        messages: List[ChatMessage],
+        add_generation_prompt: bool = True,
+        continue_final_message: bool = False,
     ) -> str:
-        return _fallback_chat_template(messages, add_generation_prompt)
+        return _fallback_chat_template(
+            messages, add_generation_prompt, continue_final_message
+        )
 
 
 class HFTokenizer:
@@ -113,15 +131,39 @@ class HFTokenizer:
         return ids, types
 
     def apply_chat_template(
-        self, messages: List[ChatMessage], add_generation_prompt: bool = True
+        self,
+        messages: List[ChatMessage],
+        add_generation_prompt: bool = True,
+        continue_final_message: bool = False,
     ) -> str:
         dicts = [{"role": m.role, "content": m.text()} for m in messages]
+        kwargs = {"tokenize": False,
+                  "add_generation_prompt": add_generation_prompt}
+        if continue_final_message:
+            # Older transformers silently swallow unknown kwargs into
+            # **tokenizer_kwargs — which would render the final turn
+            # CLOSED with no error. Verify real support; degrade loudly
+            # to the manual template (open turn guaranteed) otherwise.
+            import inspect
+
+            params = inspect.signature(
+                self._tok.apply_chat_template
+            ).parameters
+            if "continue_final_message" not in params:
+                logger.warning(
+                    "tokenizer lacks continue_final_message; rendering "
+                    "the continuation with the fallback chat template"
+                )
+                return _fallback_chat_template(
+                    messages, add_generation_prompt, continue_final_message
+                )
+            kwargs["continue_final_message"] = True
         try:
-            return self._tok.apply_chat_template(
-                dicts, tokenize=False, add_generation_prompt=add_generation_prompt
-            )
+            return self._tok.apply_chat_template(dicts, **kwargs)
         except Exception:
-            return _fallback_chat_template(messages, add_generation_prompt)
+            return _fallback_chat_template(
+                messages, add_generation_prompt, continue_final_message
+            )
 
 
 def get_tokenizer(spec: Optional[str], vocab_size: int = 512) -> Tokenizer:

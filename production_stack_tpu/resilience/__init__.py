@@ -14,6 +14,9 @@ router, so the protections live here natively:
   before the first streamed byte reaches the client).
 - :mod:`deadline` — end-to-end deadline/budget propagation
   (``X-PST-Deadline-Ms``) and the tail-latency hedging policy.
+- :mod:`stream_resume` — SSE journaling + transparent mid-stream
+  failover: a stream broken by engine death is continued on another
+  engine and spliced seamlessly into the client response.
 - :mod:`metrics` — the ``pst_resilience_*`` / ``pst_deadline_*`` /
   ``pst_hedge_*`` Prometheus surface.
 
@@ -36,18 +39,20 @@ from .deadline import (
     parse_deadline,
 )
 from .retry import RetryPolicy
+from .stream_resume import StreamResumePolicy
 
 _breaker_registry: Optional[CircuitBreakerRegistry] = None
 _admission_controller: Optional[AdmissionController] = None
 _retry_policy: Optional[RetryPolicy] = None
 _hedge_policy: Optional[HedgePolicy] = None
+_stream_resume_policy: Optional[StreamResumePolicy] = None
 _default_deadline_ms: float = 0.0
 
 
 def initialize_resilience(args) -> None:
     """Create the resilience singletons from parsed router args."""
     global _breaker_registry, _admission_controller, _retry_policy
-    global _hedge_policy, _default_deadline_ms
+    global _hedge_policy, _stream_resume_policy, _default_deadline_ms
     _breaker_registry = CircuitBreakerRegistry(
         failure_threshold=getattr(args, "breaker_failure_threshold", 5),
         recovery_time=getattr(args, "breaker_recovery_time", 10.0),
@@ -74,6 +79,10 @@ def initialize_resilience(args) -> None:
             getattr(args, "hedge_max_outstanding_ratio", 0.25)
         ),
     )
+    _stream_resume_policy = StreamResumePolicy(
+        enabled=bool(getattr(args, "stream_resume", False)),
+        max_legs=int(getattr(args, "stream_resume_max_legs", 2) or 2),
+    )
 
 
 def get_breaker_registry() -> Optional[CircuitBreakerRegistry]:
@@ -92,19 +101,24 @@ def get_hedge_policy() -> Optional[HedgePolicy]:
     return _hedge_policy
 
 
+def get_stream_resume_policy() -> Optional[StreamResumePolicy]:
+    return _stream_resume_policy
+
+
 def get_default_deadline_ms() -> float:
     return _default_deadline_ms
 
 
 def teardown_resilience() -> None:
     global _breaker_registry, _admission_controller, _retry_policy
-    global _hedge_policy, _default_deadline_ms
+    global _hedge_policy, _stream_resume_policy, _default_deadline_ms
     if _admission_controller is not None:
         _admission_controller.close()
     _breaker_registry = None
     _admission_controller = None
     _retry_policy = None
     _hedge_policy = None
+    _stream_resume_policy = None
     _default_deadline_ms = 0.0
 
 
@@ -118,11 +132,13 @@ __all__ = [
     "Deadline",
     "HedgePolicy",
     "RetryPolicy",
+    "StreamResumePolicy",
     "initialize_resilience",
     "get_breaker_registry",
     "get_admission_controller",
     "get_retry_policy",
     "get_hedge_policy",
+    "get_stream_resume_policy",
     "get_default_deadline_ms",
     "parse_deadline",
     "teardown_resilience",

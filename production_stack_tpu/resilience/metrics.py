@@ -79,3 +79,27 @@ hedges_suppressed_total = Counter(
     "(capacity | breaker | budget | no_candidate)",
     ["reason"],
 )
+
+# -- stream resumption (docs/resilience.md "Stream resumption") --------------
+
+stream_resume_attempts_total = Counter(
+    "pst_stream_resume_attempts_total",
+    "Continuation legs issued after a mid-stream upstream death",
+)
+stream_resume_success_total = Counter(
+    "pst_stream_resume_success_total",
+    "Broken streams completed transparently (resumed on another engine "
+    "or finished locally from the journal)",
+)
+stream_resume_failures_total = Counter(
+    "pst_stream_resume_failures_total",
+    "Broken streams where resume was attempted but the stream was still "
+    "truncated (no candidate, legs exhausted, or budget too small)",
+)
+stream_truncated_total = Counter(
+    "pst_stream_truncated_total",
+    "Streams truncated mid-generation and terminated with a visible "
+    "in-band error event, by reason "
+    "(disabled | ineligible | engine_error | resume_failed)",
+    ["reason"],
+)

@@ -1,0 +1,528 @@
+"""Transparent mid-stream failover: stream journaling + resumption.
+
+The proxy's committed-stream rule (never retry after the first streamed
+byte) is right for *replays* — a replay would duplicate already-delivered
+tokens — but it turns every mid-stream engine death into a silently
+truncated generation that looks complete to the client. This module gives
+the router a third option: *continue* the generation on another engine.
+
+The pieces, wired into ``proxy_and_stream``
+(:mod:`..router.services.request_service`):
+
+- :class:`SSEParser` — incremental ``data:`` frame reassembly. Upstream
+  TCP chunks do not respect SSE frame boundaries, so the proxy forwards
+  only *complete* events; a partial frame in flight when the engine dies
+  is discarded instead of corrupting the client's framing.
+- :class:`StreamJournal` — per-request accumulation of what the client
+  has actually been sent: the chunk identity (``id``/``created``/
+  ``model``), the concatenated delta text, a delta-chunk token count,
+  ``finish_reason``/``usage``/``[DONE]``, and whether the engine reported
+  an in-band error frame (engine-reported errors are deliberate — never
+  resumed; only *transport* death is).
+- :func:`build_continuation` — the resume request: original prompt +
+  generated-so-far as the new prompt (chat: an appended assistant
+  message), ``max_tokens`` reduced by tokens already delivered, ``echo``
+  dropped and ``stream_options`` normalized so the continuation always
+  reports usage the router can splice.
+- continuation splicing (``feed_continuation``) — rewrites every
+  continuation chunk to the original leg's ``id``/``created``/``model``,
+  drops duplicate role-delta frames and any re-emitted overlap of
+  already-delivered text, merges cross-leg ``usage`` so the client sees
+  what one unbroken generation would have reported, and forwards exactly
+  one ``data: [DONE]``.
+
+Exclusions (fall back to visible truncation, never silent): ``n > 1`` /
+``best_of > 1`` (choice indices would interleave across legs),
+``logprobs`` (token offsets cannot be spliced), tool/function streaming
+(partial tool-call arguments cannot be re-prompted), and ``echo``
+(the continuation would re-echo the combined prompt).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+DONE_FRAME = b"data: [DONE]\n\n"
+
+_GENERATION_ENDPOINTS = ("/v1/completions", "/v1/chat/completions")
+
+
+class StreamResumePolicy:
+    """Router-level knobs for stream resumption (``--stream-resume``,
+    ``--stream-resume-max-legs``)."""
+
+    def __init__(self, enabled: bool = False, max_legs: int = 2):
+        self.enabled = enabled
+        self.max_legs = max(1, int(max_legs))
+
+
+class SSEEvent:
+    """One complete server-sent event. ``raw`` preserves the exact bytes
+    received (frame delimiter included) so pass-through legs stay
+    byte-identical to an unproxied stream."""
+
+    __slots__ = ("raw", "data", "json", "is_done")
+
+    def __init__(self, raw: bytes, data: Optional[str]):
+        self.raw = raw
+        self.data = data
+        self.is_done = data is not None and data.strip() == "[DONE]"
+        self.json: Optional[dict] = None
+        if data is not None and not self.is_done:
+            try:
+                parsed = json.loads(data)
+                if isinstance(parsed, dict):
+                    self.json = parsed
+            except ValueError:
+                pass
+
+
+class SSEParser:
+    """Incremental SSE frame splitter: feed() arbitrary byte chunks, get
+    complete events back; a trailing partial frame stays buffered."""
+
+    def __init__(self):
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> List[SSEEvent]:
+        self._buf += chunk
+        events = []
+        while True:
+            # Spec-legal delimiters: blank line as LF-LF or CRLF-CRLF
+            # (the byte sequences cannot overlap); take whichever comes
+            # first so mixed upstreams still stream incrementally.
+            i_lf = self._buf.find(b"\n\n")
+            i_crlf = self._buf.find(b"\r\n\r\n")
+            if i_crlf >= 0 and (i_lf < 0 or i_crlf < i_lf):
+                idx, dlen = i_crlf, 4
+            elif i_lf >= 0:
+                idx, dlen = i_lf, 2
+            else:
+                break
+            raw = self._buf[: idx + dlen]
+            self._buf = self._buf[idx + dlen:]
+            events.append(SSEEvent(raw, self._data_payload(raw)))
+        return events
+
+    def flush_raw(self) -> bytes:
+        """Whatever partial frame is still buffered (forwarded verbatim on
+        clean stream end, discarded on a mid-stream death)."""
+        out, self._buf = self._buf, b""
+        return out
+
+    @staticmethod
+    def _data_payload(raw: bytes) -> Optional[str]:
+        parts = []
+        for line in raw.split(b"\n"):
+            line = line.rstrip(b"\r")
+            if line.startswith(b"data:"):
+                parts.append(line[5:].lstrip(b" ").decode("utf-8", "replace"))
+        return "\n".join(parts) if parts else None
+
+
+def resume_eligible(endpoint: str, request_json: Optional[dict]) -> bool:
+    """Whether a broken stream of this request may be resumed. Sampling
+    temperature does not matter (a continuation is a fresh sample of the
+    *suffix*), but anything whose client-visible shape cannot be spliced
+    across legs is excluded."""
+    request_json = request_json or {}
+    if endpoint not in _GENERATION_ENDPOINTS:
+        return False
+    if not request_json.get("stream"):
+        return False
+    try:
+        if int(request_json.get("n") or 1) > 1:
+            return False
+        if int(request_json.get("best_of") or 1) > 1:
+            return False
+    except (TypeError, ValueError):
+        return False
+    if request_json.get("logprobs") or request_json.get("top_logprobs"):
+        return False
+    if request_json.get("echo"):
+        return False
+    for key in ("tools", "tool_choice", "functions", "function_call"):
+        if request_json.get(key):
+            return False
+    if not isinstance(request_json.get("max_tokens"), int):
+        # Without an explicit token budget the continuation leg would get
+        # a fresh engine-default budget, so a resumed stream could run
+        # (legs+1)× longer than any unbroken run.
+        return False
+    if endpoint == "/v1/chat/completions":
+        if not isinstance(request_json.get("messages"), list):
+            return False
+        if request_json.get("continue_final_message"):
+            # The client's own final assistant turn is already open; a
+            # continuation would close it and open a second one, changing
+            # the rendered context mid-generation.
+            return False
+    elif not isinstance(request_json.get("prompt", ""), str):
+        # Batched prompt lists stream interleaved choice indices.
+        return False
+    return True
+
+
+def build_continuation(
+    request_json: dict, journal: "StreamJournal", endpoint: str
+) -> dict:
+    """The continuation request for the next leg: the generated-so-far
+    text becomes part of the prompt, the token budget shrinks by what was
+    already delivered, and the body is normalized so the new leg streams a
+    usage the router can splice (``echo`` off, ``include_usage`` on —
+    the journal strips the usage frame again if the client never asked)."""
+    cont = dict(request_json)
+    if endpoint == "/v1/chat/completions":
+        messages = list(cont.get("messages") or [])
+        if journal.text:
+            messages.append({"role": "assistant", "content": journal.text})
+            # The engine must render the final assistant turn OPEN and
+            # continue it (no fresh generation prompt) — otherwise the
+            # chat template would start a second, unrelated answer.
+            cont["continue_final_message"] = True
+        cont["messages"] = messages
+    else:
+        cont["prompt"] = str(cont.get("prompt", "")) + journal.text
+    remaining = journal.remaining_tokens()
+    if remaining is not None:
+        cont["max_tokens"] = max(int(remaining), 1)
+    cont["stream"] = True
+    cont["stream_options"] = {"include_usage": True}
+    cont.pop("echo", None)
+    # A continuation is a fresh prefill on a different engine: any
+    # disagg KV-transfer coordinates from the original leg are stale.
+    cont.pop("kv_transfer_params", None)
+    return cont
+
+
+class StreamJournal:
+    """What the client has been sent so far, plus the splicing state for
+    continuation legs. One journal per committed stream."""
+
+    def __init__(
+        self,
+        is_chat: bool,
+        request_json: Optional[dict] = None,
+        eligible: bool = False,
+        record_text: bool = True,
+    ):
+        self.is_chat = is_chat
+        self.request_json = request_json or {}
+        self.eligible = eligible
+        # Text is only needed to BUILD a continuation: when resume is off
+        # or the request ineligible, skip accumulation so N concurrent
+        # long streams never pile their full outputs up in router memory
+        # (identity + token count still serve the visible-truncation tail).
+        self.record_text = record_text
+        self._parser = SSEParser()
+        # Identity of the original leg, stamped onto continuation chunks.
+        self.id: Optional[str] = None
+        self.created: Optional[int] = None
+        self.model: Optional[str] = None
+        self.object: Optional[str] = None
+        # Accounting. Text is kept as parts and joined lazily (once per
+        # continuation leg) — per-chunk string concat would be O(n²) over
+        # the stream length on the proxy hot path.
+        self._text_parts: List[str] = []
+        self.delivered_tokens = 0  # content-bearing delta chunks ≈ tokens
+        self.finish_reason: Optional[str] = None
+        self.usage: Optional[dict] = None
+        self.saw_done = False
+        self.saw_error = False
+        self.saw_role_delta = False
+        self.legs = 0  # continuation legs attempted
+        # Per-continuation-leg splice state.
+        self._overlap = ""
+        self._pending: List[tuple] = []  # held-back possible-echo frames
+        self._tokens_at_leg_start = 0
+
+    @property
+    def text(self) -> str:
+        return "".join(self._text_parts)
+
+    # -- eligibility / budget ----------------------------------------------
+
+    def resumable(self) -> bool:
+        """Whether a *resume* may be attempted for this broken stream: the
+        request shape must be spliceable, the stream must not have ended
+        ([DONE]), and the engine must not have reported an in-band error
+        (a deliberate rejection — replaying it elsewhere would retry work
+        the engine refused on purpose)."""
+        return self.eligible and not self.saw_done and not self.saw_error
+
+    def remaining_tokens(self) -> Optional[int]:
+        max_tokens = self.request_json.get("max_tokens")
+        if isinstance(max_tokens, int):
+            return max_tokens - self.delivered_tokens
+        return None
+
+    def client_wants_usage(self) -> bool:
+        opts = self.request_json.get("stream_options") or {}
+        return bool(isinstance(opts, dict) and opts.get("include_usage"))
+
+    # -- leg 1: pass-through with observation --------------------------------
+
+    def feed(self, chunk: bytes) -> bytes:
+        """Leg-1 path: observe every complete event and return its exact
+        original bytes for forwarding (byte-identical pass-through)."""
+        out = []
+        for ev in self._parser.feed(chunk):
+            self._observe(ev)
+            out.append(ev.raw)
+        return b"".join(out)
+
+    def flush_raw(self) -> bytes:
+        return self._parser.flush_raw()
+
+    def _observe(self, ev: SSEEvent) -> None:
+        if ev.is_done:
+            self.saw_done = True
+            return
+        obj = ev.json
+        if obj is None:
+            return
+        if "error" in obj:
+            self.saw_error = True
+            return
+        if self.id is None and obj.get("id"):
+            self.id = obj.get("id")
+            self.created = obj.get("created")
+            self.model = obj.get("model")
+            self.object = obj.get("object")
+        delta_text, finish, delta = self._choice_fields(obj)
+        if delta and "role" in delta:
+            self.saw_role_delta = True
+        if delta_text:
+            if self.record_text:
+                self._text_parts.append(delta_text)
+            self.delivered_tokens += 1
+        if finish:
+            self.finish_reason = finish
+        if obj.get("usage"):
+            self.usage = obj["usage"]
+
+    def _choice_fields(self, obj: dict):
+        """(delta_text, finish_reason, chat_delta) of choice 0."""
+        choices = obj.get("choices") or []
+        if not choices or not isinstance(choices[0], dict):
+            return None, None, None
+        choice = choices[0]
+        if self.is_chat:
+            delta = choice.get("delta") or {}
+            return delta.get("content"), choice.get("finish_reason"), delta
+        return choice.get("text"), choice.get("finish_reason"), None
+
+    # -- continuation legs: rewrite + splice ---------------------------------
+
+    def start_continuation(self) -> None:
+        """Reset per-leg splice state for a fresh upstream SSE stream."""
+        self._parser = SSEParser()
+        self._overlap = self.text
+        self._pending = []
+        self._tokens_at_leg_start = self.delivered_tokens
+
+    def feed_continuation(self, chunk: bytes) -> bytes:
+        out = []
+        for ev in self._parser.feed(chunk):
+            rewritten = self._continuation_event(ev)
+            if rewritten:
+                out.append(rewritten)
+        return b"".join(out)
+
+    def _continuation_event(self, ev: SSEEvent) -> Optional[bytes]:
+        if ev.is_done:
+            out = self._flush_pending()
+            if self.saw_done:
+                return out or None
+            self.saw_done = True
+            return out + DONE_FRAME
+        obj = ev.json
+        if obj is None:
+            return self._flush_pending() + ev.raw
+        if "error" in obj:
+            # Engine-reported error on the continuation leg: forward it
+            # (visible, never silently dropped) and stop resuming.
+            self.saw_error = True
+            return self._flush_pending() + ev.raw
+        delta_text, finish, delta = self._choice_fields(obj)
+        # Re-emitted prefix (an engine that echoes despite the normalized
+        # continuation): deltas matching the delivered text are HELD BACK,
+        # not dropped — only a replay of the entire prefix is discarded as
+        # an echo. The moment the leg diverges, the held-back frames were
+        # legitimate suffix tokens (the generation merely re-sampled the
+        # same opening words) and are flushed to the client intact.
+        if delta_text and self._overlap:
+            if self._overlap.startswith(delta_text):
+                self._pending.append((obj, delta_text, finish, delta))
+                self._overlap = self._overlap[len(delta_text):]
+                if not self._overlap:
+                    # Full-prefix re-emission confirmed: an echo — drop it.
+                    self._pending = []
+                return None
+            if delta_text.startswith(self._overlap):
+                # The delta spans the END of the echoed prefix (fresh
+                # legs chunk differently): held-back frames + this
+                # delta's head reproduce the full delivered text — echo
+                # confirmed. Drop the echo, forward only the new suffix.
+                suffix = delta_text[len(self._overlap):]
+                self._pending = []
+                self._overlap = ""
+                obj = self._replace_delta_text(obj, suffix)
+                _, finish, delta = self._choice_fields(obj)
+                return self._emit(obj, suffix, finish, delta)
+            return self._flush_pending() + (
+                self._emit(obj, delta_text, finish, delta) or b""
+            ) or None
+        if self._pending:
+            # Non-delta frame (finish/usage/role) ends the overlap window.
+            return self._flush_pending() + (
+                self._emit(obj, delta_text, finish, delta) or b""
+            ) or None
+        return self._emit(obj, delta_text, finish, delta)
+
+    def _replace_delta_text(self, obj: dict, new_text: str) -> dict:
+        obj = dict(obj)
+        choices = [dict(c) for c in (obj.get("choices") or [])]
+        if choices:
+            if self.is_chat:
+                delta = dict(choices[0].get("delta") or {})
+                delta["content"] = new_text
+                choices[0]["delta"] = delta
+            else:
+                choices[0]["text"] = new_text
+        obj["choices"] = choices
+        return obj
+
+    def _flush_pending(self) -> bytes:
+        """The leg diverged (or ended) before re-emitting the whole
+        delivered prefix: the held-back deltas were real output."""
+        pending, self._pending = self._pending, []
+        self._overlap = ""
+        out = b""
+        for obj, delta_text, finish, delta in pending:
+            out += self._emit(obj, delta_text, finish, delta) or b""
+        return out
+
+    def _emit(self, obj, delta_text, finish, delta) -> Optional[bytes]:
+        """Rewrite one continuation frame to the original leg's identity
+        and account for it. Returns None for frames with nothing left to
+        forward."""
+        # Duplicate role-announcement frame (chat legs each open with one).
+        if (
+            self.is_chat
+            and delta is not None
+            and "role" in delta
+            and not delta.get("content")
+            and not finish
+            and not obj.get("usage")
+            and self.saw_role_delta
+        ):
+            return None
+        obj = dict(obj)
+        if self.id is not None:
+            obj["id"] = self.id
+        if self.created is not None:
+            obj["created"] = self.created
+        if self.model is not None:
+            obj["model"] = self.model
+        if obj.get("usage"):
+            merged = self._merge_usage(obj["usage"])
+            self.usage = merged
+            if self.client_wants_usage():
+                obj["usage"] = merged
+            else:
+                # The continuation forced include_usage for the router's
+                # own accounting; the client never asked for it.
+                obj.pop("usage", None)
+                if not obj.get("choices"):
+                    return None  # usage-only frame: nothing left to send
+        if delta is not None and "role" in delta:
+            self.saw_role_delta = True
+        if delta_text:
+            self._text_parts.append(delta_text)
+            self.delivered_tokens += 1
+        if finish:
+            self.finish_reason = finish
+        return f"data: {json.dumps(obj)}\n\n".encode()
+
+    def _merge_usage(self, leg_usage: dict) -> dict:
+        """Client-visible usage of one unbroken generation: completion
+        tokens accumulate across legs; the continuation's prompt includes
+        the delivered prefix, so subtracting it recovers the original
+        prompt size."""
+        prev = self._tokens_at_leg_start
+        completion = int(leg_usage.get("completion_tokens") or 0) + prev
+        prompt = max(int(leg_usage.get("prompt_tokens") or 0) - prev, 0)
+        return {
+            "prompt_tokens": prompt,
+            "completion_tokens": completion,
+            "total_tokens": prompt + completion,
+        }
+
+    # -- terminal frames -----------------------------------------------------
+
+    def _closing_chunk(self, finish_reason: str) -> bytes:
+        if self.is_chat:
+            choice = {"index": 0, "delta": {}, "finish_reason": finish_reason}
+            obj_type = self.object or "chat.completion.chunk"
+        else:
+            choice = {"index": 0, "text": "", "finish_reason": finish_reason}
+            obj_type = self.object or "text_completion"
+        obj = {
+            "id": self.id or "",
+            "object": obj_type,
+            "created": self.created if self.created is not None else int(time.time()),
+            "model": self.model or self.request_json.get("model", ""),
+            "choices": [choice],
+        }
+        return f"data: {json.dumps(obj)}\n\n".encode()
+
+    def synthesize_tail(self) -> bytes:
+        """Locally finish a stream whose generation is already complete
+        (the engine died *after* the last token but before the terminal
+        framing): a closing ``finish_reason`` chunk if none was delivered,
+        then the single ``[DONE]``. No continuation leg needed.
+
+        Known limit: engines in this stack embed ``usage`` in the final
+        finish-bearing delta, so a delivered generation has its usage. An
+        engine that ships usage as a *separate* trailing frame and dies
+        exactly between finish and usage leaves an ``include_usage``
+        client without one — the router cannot tokenize the prompt to
+        reconstruct it."""
+        out = b""
+        if self.finish_reason is None and not self.saw_error:
+            out += self._closing_chunk("length")
+            self.finish_reason = "length"
+        if not self.saw_done:
+            out += DONE_FRAME
+            self.saw_done = True
+        return out
+
+    def truncation_tail(
+        self, message: str = "upstream engine failed mid-stream; "
+                             "response truncated"
+    ) -> bytes:
+        """Visible truncation: a terminal in-band error event plus
+        ``[DONE]`` so clients can tell a broken generation from a complete
+        one (an engine-reported error frame already on the wire is not
+        duplicated)."""
+        out = b""
+        if not self.saw_error and not self.saw_done:
+            err = {
+                "error": {
+                    "message": message,
+                    "type": "upstream_error",
+                    "code": "stream_truncated",
+                }
+            }
+            out += f"data: {json.dumps(err)}\n\n".encode()
+        if not self.saw_done:
+            out += DONE_FRAME
+            self.saw_done = True
+        return out

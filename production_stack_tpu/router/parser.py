@@ -121,6 +121,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap outstanding hedges at this fraction of "
                         "outstanding primaries (floor 1)")
 
+    # Stream resumption (docs/resilience.md "Stream resumption")
+    p.add_argument("--stream-resume", action="store_true", default=False,
+                   help="resume SSE streams broken by engine death on "
+                        "another engine (journaled continuation) instead "
+                        "of truncating")
+    p.add_argument("--stream-resume-max-legs", type=int, default=2,
+                   help="max continuation legs per streamed request")
+
     # Observability (docs/observability.md): in-process request tracing
     # with per-stage latency decomposition. Always SDK-free; spans mirror
     # to OpenTelemetry only when OTEL_EXPORTER_OTLP_ENDPOINT + SDK exist.
@@ -221,6 +229,8 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--hedge-max-outstanding-ratio must be >= 0")
     if not (0.0 < args.hedge_quantile < 1.0):
         raise ValueError("--hedge-quantile must be in (0, 1)")
+    if args.stream_resume_max_legs < 1:
+        raise ValueError("--stream-resume-max-legs must be >= 1")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "disaggregated_prefill":

@@ -14,8 +14,14 @@ Resilience (no reference counterpart — the reference defers this to Envoy):
 - every routing decision goes through ``route_with_resilience`` (circuit
   breakers + drain state consulted before the policy picks);
 - ``proxy_and_stream`` retries with backoff and fails over to the
-  next-best healthy engine on connect errors / 5xx, but NEVER after the
-  first upstream byte has been streamed to the client;
+  next-best healthy engine on connect errors / 5xx, but NEVER *replays*
+  after the first upstream byte has been streamed to the client;
+- a committed SSE stream broken by engine death is *resumed* instead:
+  the journal (``resilience/stream_resume.py``) re-issues the generation
+  suffix on another engine and splices it seamlessly into the client
+  stream (``--stream-resume``); when resume is off, ineligible, or
+  exhausted, the truncation is made visible with an in-band error event
+  + ``[DONE]`` rather than a silent cut;
 - client disconnects mid-stream abort the upstream engine request instead
   of leaking a decoding sequence;
 - per-request outcomes feed the breakers and ``pst_resilience_*`` metrics.
@@ -55,6 +61,7 @@ from ...resilience import (
     get_default_deadline_ms,
     get_hedge_policy,
     get_retry_policy,
+    get_stream_resume_policy,
 )
 from ...resilience import metrics as res_metrics
 from ...resilience.breaker import BreakerState
@@ -64,6 +71,11 @@ from ...resilience.deadline import (
     min_attempt_budget,
     parse_deadline,
     with_deadline_header,
+)
+from ...resilience.stream_resume import (
+    StreamJournal,
+    build_continuation,
+    resume_eligible,
 )
 from ..routing.logic import (
     DisaggregatedPrefillRouter,
@@ -203,9 +215,12 @@ async def proxy_and_stream(
 
     Failure handling: a connect error or 5xx *before the first streamed
     byte* re-routes to the next-best healthy engine (with backoff). Once a
-    byte has reached the client the stream is committed — a mid-stream
-    upstream death truncates, and a mid-stream client disconnect aborts
-    the upstream request.
+    byte has reached the client the stream is committed — it must never be
+    *replayed*. A mid-stream upstream death on a journaled SSE stream is
+    *resumed* instead (continuation on another engine, spliced into the
+    client stream); when resume is off, ineligible, or exhausted the
+    truncation is terminated visibly (in-band error event + ``[DONE]``).
+    A mid-stream client disconnect aborts the upstream request.
 
     Deadline handling: every attempt forwards the *remaining* budget via
     ``X-PST-Deadline-Ms``; a retry is only attempted if the budget still
@@ -278,6 +293,7 @@ async def proxy_and_stream(
         )
         collected = bytearray()
         response: Optional[web.StreamResponse] = None
+        journal: Optional[StreamJournal] = None
         failure_noted = False  # at most one breaker/stats failure per attempt
         completed = False  # ... and at most one completion per attempt
 
@@ -374,6 +390,32 @@ async def proxy_and_stream(
                         for k, v in debug_headers.items():
                             response.headers[k] = v
                     await response.prepare(request)
+                    if (
+                        streaming
+                        and ok
+                        and upstream.status == 200
+                        and endpoint in ("/v1/completions", "/v1/chat/completions")
+                        and "text/event-stream"
+                        in (upstream.headers.get("Content-Type") or "")
+                    ):
+                        # Journaled stream: forward only complete SSE
+                        # events (a partial frame in flight when the
+                        # engine dies must not corrupt client framing)
+                        # while accumulating the resume state. Text is
+                        # only recorded when a resume could actually use
+                        # it — never buffer N long streams for nothing.
+                        resume_policy = get_stream_resume_policy()
+                        eligible = resume_eligible(endpoint, parsed)
+                        journal = StreamJournal(
+                            endpoint.endswith("/chat/completions"),
+                            request_json=parsed,
+                            eligible=eligible,
+                            record_text=(
+                                eligible
+                                and resume_policy is not None
+                                and resume_policy.enabled
+                            ),
+                        )
                     first_byte = True
                     async for chunk in upstream.content.iter_any():
                         # First call records TTFT; subsequent calls record ITL.
@@ -381,9 +423,21 @@ async def proxy_and_stream(
                         if first_byte:
                             attempt_span.add_event("first_byte")
                             first_byte = False
+                        if journal is not None:
+                            chunk = journal.feed(chunk)
+                            if not chunk:
+                                continue
                         if collect:
                             collected.extend(chunk)
                         await response.write(chunk)
+                    if journal is not None:
+                        # Clean stream end: forward any buffered tail
+                        # verbatim (well-formed SSE leaves none).
+                        tail = journal.flush_raw()
+                        if tail:
+                            if collect:
+                                collected.extend(tail)
+                            await response.write(tail)
                     _complete()
                     if ok:
                         _note_success(url)
@@ -436,14 +490,25 @@ async def proxy_and_stream(
             if response is not None and response.prepared:
                 if not failure_noted:
                     _note_failure(url, request_id, span=attempt_span)
-                # Bytes already reached the client: the stream is committed.
-                # Truncate rather than retry (a replay would duplicate
+                # Bytes already reached the client: the stream is committed
+                # and must never be replayed (a replay would duplicate
                 # already-delivered tokens).
                 logger.error(
                     "backend %s died mid-stream for %s: %s", url, request_id, e
                 )
                 attempt_span.set_attribute("outcome", "midstream_death")
                 attempt_span.end()
+                if journal is not None:
+                    # Journaled SSE stream: resume the generation on
+                    # another engine (continuation of the suffix — not a
+                    # replay) or terminate the truncation visibly.
+                    outcome = await _resume_or_truncate(
+                        request, response, journal, endpoint, request_id,
+                        failover, tried, deadline, trace, collect, collected,
+                    )
+                    if outcome == "completed":
+                        break  # run the post-response hooks below
+                    return response
                 with contextlib.suppress(Exception):
                     await response.write_eof()
                 return response
@@ -490,20 +555,256 @@ async def proxy_and_stream(
             url = next_url
             tried.add(url)
             continue
+        break  # attempt finished cleanly: run the post-response hooks
 
+    if collect:
+        content = bytes(collected)
+        if cacheable:
+            try:
+                await semantic_store(request, content)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("semantic cache store failed: %s", e)
+        if callback is not None:
+            try:
+                await callback.call_post_request(request, content)
+            except Exception as e:  # noqa: BLE001
+                logger.error("post_request callback failed: %s", e)
+    return response
+
+
+async def _resume_or_truncate(
+    request: web.Request,
+    response: web.StreamResponse,
+    journal: StreamJournal,
+    endpoint: str,
+    request_id: str,
+    failover: Optional[FailoverFn],
+    tried: set,
+    deadline: Optional[Deadline],
+    trace,
+    collect: bool,
+    collected: bytearray,
+) -> str:
+    """A journaled stream just lost its upstream mid-generation: resume it
+    on another engine when allowed, otherwise terminate the truncation
+    *visibly* (in-band error event + ``[DONE]`` — never a silent cut).
+    Returns ``completed`` | ``truncated`` | ``client_gone``."""
+    policy = get_stream_resume_policy()
+    enabled = policy is not None and policy.enabled
+    if journal.saw_done:
+        # The terminal [DONE] already reached the client — the transport
+        # died between it and EOF. The stream is complete, not truncated;
+        # nothing was resumed either, so no counter moves.
+        with contextlib.suppress(Exception):
+            await response.write_eof()
+        return "completed"
+    outcome = None
+    if enabled and journal.resumable():
+        outcome = await _resume_stream(
+            request, response, journal, endpoint, request_id,
+            failover, tried, deadline, trace, collect, collected,
+        )
+    if outcome == "completed":
+        res_metrics.stream_resume_success_total.inc()
+        with contextlib.suppress(Exception):
+            await response.write_eof()
+        return "completed"
+    if outcome == "client_gone":
+        return "client_gone"
+    if journal.saw_error:
+        # Engine-reported in-band error (original leg or a continuation):
+        # the client saw it — deliberate rejection, not a resume failure.
+        reason = "engine_error"
+    elif outcome == "failed":
+        res_metrics.stream_resume_failures_total.inc()
+        reason = "resume_failed"
+    elif not enabled:
+        reason = "disabled"
+    else:
+        reason = "ineligible"
+    res_metrics.stream_truncated_total.labels(reason=reason).inc()
+    trace.add_event("stream_truncated", reason=reason,
+                    delivered_tokens=journal.delivered_tokens)
+    logger.error(
+        "stream %s truncated after %d tokens (%s)",
+        request_id, journal.delivered_tokens, reason,
+    )
+    tail = journal.truncation_tail()
+    with contextlib.suppress(Exception):
+        if tail:
+            if collect:
+                collected.extend(tail)
+            await response.write(tail)
+        await response.write_eof()
+    return "truncated"
+
+
+async def _resume_stream(
+    request: web.Request,
+    response: web.StreamResponse,
+    journal: StreamJournal,
+    endpoint: str,
+    request_id: str,
+    failover: Optional[FailoverFn],
+    tried: set,
+    deadline: Optional[Deadline],
+    trace,
+    collect: bool,
+    collected: bytearray,
+) -> str:
+    """Issue continuation legs until the stream completes or the budget
+    (legs, deadline, candidates) runs out. Each leg goes to the next-best
+    healthy engine via the same breaker-consulting routing as a failover —
+    with the prefix-aware policy the continuation lands where the KV for
+    the shared prefix is warm. Returns ``completed`` | ``failed`` |
+    ``client_gone``."""
+    policy = get_stream_resume_policy()
+    retry = get_retry_policy()
+    monitor = get_request_stats_monitor()
+    session: aiohttp.ClientSession = request.app["client_session"]
+
+    async def _write(data: bytes) -> None:
         if collect:
-            content = bytes(collected)
-            if cacheable:
+            collected.extend(data)
+        await response.write(data)
+
+    while True:
+        if journal.saw_error:
+            # An engine-reported in-band error frame is on the wire (this
+            # leg or a previous one): a deliberate rejection — never keep
+            # resuming past it.
+            return "failed"
+        if journal.saw_done:
+            return "completed"
+        remaining_tokens = journal.remaining_tokens()
+        if journal.finish_reason is not None or (
+            remaining_tokens is not None and remaining_tokens <= 0
+        ):
+            # Generation already complete — the engine died between the
+            # last token and the terminal framing. Finish locally from the
+            # journal; no continuation leg needed.
+            try:
+                await _write(journal.synthesize_tail())
+            except (ConnectionResetError, ConnectionError):
+                res_metrics.client_disconnects_total.inc()
+                return "client_gone"
+            return "completed"
+        if journal.legs >= policy.max_legs:
+            logger.warning(
+                "stream %s: resume legs exhausted (%d)",
+                request_id, journal.legs,
+            )
+            return "failed"
+        if _deadline_blocks_attempt(deadline):
+            # A continuation the budget cannot cover (connect + one token)
+            # is doomed work — same gate as a retry.
+            res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
+            return "failed"
+        next_url = await failover(tried) if failover is not None else None
+        if next_url is None:
+            return "failed"
+        journal.legs += 1
+        leg = journal.legs
+        tried.add(next_url)
+        res_metrics.stream_resume_attempts_total.inc()
+        cont_body = json.dumps(
+            build_continuation(journal.request_json, journal, endpoint)
+        ).encode()
+        span = trace.span(
+            "stream_resume",
+            attributes={"server": next_url, "leg": leg, "endpoint": endpoint,
+                        "delivered_tokens": journal.delivered_tokens},
+        )
+        rid = f"{request_id}-resume{leg}"
+        fwd = _trace_headers(
+            with_deadline_header(_forwardable(request.headers), deadline),
+            request_id, span,
+        )
+        remaining_s = deadline.remaining_s() if deadline is not None else None
+        connect_t = (retry.connect_timeout or None) if retry else None
+        if connect_t is not None and remaining_s is not None:
+            connect_t = min(connect_t, max(remaining_s, 0.001))
+        timeout = aiohttp.ClientTimeout(
+            total=None,  # streams run as long as the generation does
+            connect=connect_t,
+            sock_read=(retry.read_timeout or None) if retry else None,
+        )
+        logger.warning(
+            "resuming stream %s on %s (leg %d, %d tokens delivered)",
+            request_id, next_url, leg, journal.delivered_tokens,
+        )
+        monitor.on_new_request(next_url, rid, time.time())
+        try:
+            async with session.post(
+                next_url + endpoint, data=cont_body, headers=fwd,
+                timeout=timeout,
+            ) as upstream:
+                if upstream.status != 200 or "text/event-stream" not in (
+                    upstream.headers.get("Content-Type") or ""
+                ):
+                    monitor.on_request_complete(next_url, rid, time.time())
+                    if (
+                        upstream.status == 503
+                        and "X-PST-Draining" in upstream.headers
+                    ):
+                        get_service_discovery().set_draining(next_url, True)
+                        span.set_attribute("outcome", "draining")
+                    else:
+                        _note_failure(next_url, rid, span=span)
+                        span.set_attribute("outcome", "error")
+                    span.set_attribute("http.status_code", upstream.status)
+                    span.end()
+                    continue
+                journal.start_continuation()
                 try:
-                    await semantic_store(request, content)
-                except Exception as e:  # noqa: BLE001
-                    logger.debug("semantic cache store failed: %s", e)
-            if callback is not None:
-                try:
-                    await callback.call_post_request(request, content)
-                except Exception as e:  # noqa: BLE001
-                    logger.error("post_request callback failed: %s", e)
-        return response
+                    async for chunk in upstream.content.iter_any():
+                        monitor.on_request_response(next_url, rid, time.time())
+                        out = journal.feed_continuation(chunk)
+                        if out:
+                            await _write(out)
+                except (ConnectionResetError, ConnectionError):
+                    # Client went away mid-continuation: same obligations
+                    # as the primary leg — abort upstream, count it.
+                    res_metrics.client_disconnects_total.inc()
+                    monitor.on_request_complete(next_url, rid, time.time())
+                    upstream.close()
+                    span.set_attribute("outcome", "client_disconnect")
+                    span.end()
+                    return "client_gone"
+                except asyncio.CancelledError:
+                    if request.transport is None or request.transport.is_closing():
+                        res_metrics.client_disconnects_total.inc()
+                    monitor.on_request_complete(next_url, rid, time.time())
+                    upstream.close()
+                    span.set_attribute("outcome", "cancelled")
+                    span.end()
+                    raise
+                monitor.on_request_complete(next_url, rid, time.time())
+                if journal.saw_error:
+                    # The leg streamed an engine-reported error (now
+                    # visible to the client): a deliberate rejection, not
+                    # engine ill-health and not a transparent resume.
+                    span.set_attribute("outcome", "engine_error")
+                    span.end()
+                    return "failed"
+                if journal.saw_done:
+                    _note_success(next_url)
+                    span.set_attribute("outcome", "ok")
+                    span.end()
+                    return "completed"
+                # Upstream EOF without [DONE]: this leg died too — feed
+                # its breaker and loop for another leg if budget remains.
+                _note_failure(next_url, rid, span=span)
+                span.set_attribute("outcome", "midstream_death")
+                span.end()
+                continue
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            monitor.on_request_complete(next_url, rid, time.time())
+            _note_failure(next_url, rid, span=span)
+            span.set_attribute("error", str(e))
+            span.set_attribute("outcome", "midstream_death")
+            span.end()
+            continue
 
 
 # Endpoints that are always hedge-eligible (no streaming mode exists).

@@ -16,6 +16,7 @@ import argparse
 import asyncio
 import json
 import random
+import re
 import time
 import uuid
 from typing import List, Optional
@@ -43,8 +44,11 @@ class FakeEngineState:
         # Fault injection (resilience tests): POST /admin/fail arms one of
         #   error — respond fail_status (default 500) immediately
         #   hang  — accept the request and never answer
-        #   midstream — stream a few chunks, then die (tests the
-        #               never-retry-after-first-byte rule)
+        #   midstream — stream fail_after_chunks delta chunks, then drop
+        #               the connection (tests the never-replay-after-
+        #               first-byte rule and stream resumption; 0 = die
+        #               before any delta, >= max_tokens = die after the
+        #               last delta but before [DONE])
         #   slow  — inject fail_delay (+ up to fail_jitter) seconds of
         #           latency before answering, honoring the propagated
         #           X-PST-Deadline-Ms budget: when the injected delay would
@@ -58,6 +62,9 @@ class FakeEngineState:
         self.fail_count = -1
         self.fail_delay = 0.5
         self.fail_jitter = 0.0
+        # Delta chunks delivered before a `midstream` death (default 3,
+        # the legacy hardcoded behavior).
+        self.fail_after_chunks = 3
         self.num_faulted = 0
         # Graceful drain: new generations 503, in-flight ones finish.
         self.draining = False
@@ -80,6 +87,22 @@ class FakeEngineState:
                 self.fail_mode = None
         self.num_faulted += 1
         return mode
+
+
+def _prompt_text(body: dict) -> str:
+    """Flatten the request prompt (chat messages or completions prompt)
+    into one text blob — the fake model's whole world view."""
+    if "messages" in body:
+        parts = []
+        for m in body.get("messages") or []:
+            c = m.get("content", "")
+            if isinstance(c, str):
+                parts.append(c)
+        return "\n".join(parts)
+    prompt = body.get("prompt", "")
+    if isinstance(prompt, list):
+        return "\n".join(str(p) for p in prompt)
+    return str(prompt)
 
 
 def _models_payload(state: FakeEngineState) -> dict:
@@ -213,6 +236,25 @@ def create_fake_engine_app(
         state.prefix_queries += 1
         req_id = f"fake-{uuid.uuid4().hex[:12]}"
         token_interval = 1.0 / state.speed if state.speed > 0 else 0.0
+        # Deterministic *continuation* semantics: the fake model's output
+        # is "tokN tokN+1 ..." where N counts the tokNs already present in
+        # the prompt — so a resume request carrying generated-so-far text
+        # continues exactly where an unbroken run would have, like a
+        # temperature-0 model continuing its own output.
+        prompt_text = _prompt_text(body)
+        tok_start = len(re.findall(r"tok\d+", prompt_text))
+        # The fake "tokenizer": every generated tokN is one token (even
+        # when a continuation glued it to the prompt tail without a
+        # space), every other whitespace word is one token — so a
+        # continuation request's prompt_tokens equals the original
+        # prompt's plus the tokens already generated.
+        prompt_tokens = max(
+            tok_start + len(re.sub(r"tok\d+", " ", prompt_text).split()), 1
+        )
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage")
+        )
+        created = int(time.time())
         try:
             # Mirror the real engine's stage decomposition so mixed-workload
             # e2e tests see engine-side pst_stage_duration_seconds labels.
@@ -231,16 +273,24 @@ def create_fake_engine_app(
                     resp.headers[k] = v
                 await resp.prepare(request)
                 for i in range(n_tokens):
+                    if die_midstream and i >= state.fail_after_chunks:
+                        # Drop the connection at the exact chunk boundary
+                        # (0 = before any delta reaches the wire).
+                        request.transport.close()
+                        return resp
+                    final = i == n_tokens - 1
+                    finish = "length" if final else None
                     if is_chat:
                         chunk = {
                             "id": req_id,
                             "object": "chat.completion.chunk",
+                            "created": created,
                             "model": state.model,
                             "choices": [
                                 {
                                     "index": 0,
-                                    "delta": {"content": f"tok{i} "},
-                                    "finish_reason": None,
+                                    "delta": {"content": f"tok{tok_start + i} "},
+                                    "finish_reason": finish,
                                 }
                             ],
                         }
@@ -248,18 +298,27 @@ def create_fake_engine_app(
                         chunk = {
                             "id": req_id,
                             "object": "text_completion",
+                            "created": created,
                             "model": state.model,
                             "choices": [
-                                {"index": 0, "text": f"tok{i} ", "finish_reason": None}
+                                {"index": 0, "text": f"tok{tok_start + i} ",
+                                 "finish_reason": finish}
                             ],
                         }
+                    if final and include_usage:
+                        chunk["usage"] = {
+                            "prompt_tokens": prompt_tokens,
+                            "completion_tokens": n_tokens,
+                            "total_tokens": prompt_tokens + n_tokens,
+                        }
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    if die_midstream and i >= 2:
-                        # Drop the connection with the stream half-sent.
-                        request.transport.close()
-                        return resp
                     if token_interval:
                         await asyncio.sleep(token_interval)
+                if die_midstream:
+                    # fail_after_chunks >= max_tokens: death after the last
+                    # delta but before the terminal [DONE].
+                    request.transport.close()
+                    return resp
                 await resp.write(b"data: [DONE]\n\n")
                 observe_stage("engine", "decode", time.monotonic() - t_decode)
                 await resp.write_eof()
@@ -267,11 +326,17 @@ def create_fake_engine_app(
             else:
                 if token_interval:
                     await asyncio.sleep(token_interval * n_tokens)
-                text = " ".join(f"tok{i}" for i in range(n_tokens))
+                text = " ".join(f"tok{tok_start + i}" for i in range(n_tokens))
+                usage = {
+                    "prompt_tokens": prompt_tokens,
+                    "completion_tokens": n_tokens,
+                    "total_tokens": prompt_tokens + n_tokens,
+                }
                 if is_chat:
                     payload = {
                         "id": req_id,
                         "object": "chat.completion",
+                        "created": created,
                         "model": state.model,
                         "choices": [
                             {
@@ -280,25 +345,18 @@ def create_fake_engine_app(
                                 "finish_reason": "length",
                             }
                         ],
-                        "usage": {
-                            "prompt_tokens": 10,
-                            "completion_tokens": n_tokens,
-                            "total_tokens": 10 + n_tokens,
-                        },
+                        "usage": usage,
                     }
                 else:
                     payload = {
                         "id": req_id,
                         "object": "text_completion",
+                        "created": created,
                         "model": state.model,
                         "choices": [
                             {"index": 0, "text": text, "finish_reason": "length"}
                         ],
-                        "usage": {
-                            "prompt_tokens": 10,
-                            "completion_tokens": n_tokens,
-                            "total_tokens": 10 + n_tokens,
-                        },
+                        "usage": usage,
                     }
                 observe_stage("engine", "decode", time.monotonic() - t_decode)
                 return web.json_response(
@@ -348,9 +406,14 @@ def create_fake_engine_app(
 
     async def admin_fail(request: web.Request) -> web.Response:
         """Arm fault injection: {"mode": "error"|"hang"|"midstream"|"slow",
-        "status": 500, "count": -1, "delay": 0.5, "jitter": 0}. ``slow``
-        injects ``delay`` (+ uniform jitter up to ``jitter``) seconds of
-        latency per generation, honoring a propagated deadline with 504."""
+        "status": 500, "count": -1, "delay": 0.5, "jitter": 0,
+        "fail_after_chunks": 3}. ``slow`` injects ``delay`` (+ uniform
+        jitter up to ``jitter``) seconds of latency per generation,
+        honoring a propagated deadline with 504. ``midstream`` drops the
+        connection after exactly ``fail_after_chunks`` streamed delta
+        chunks (0 = before any delta; >= max_tokens = after the last delta
+        but before ``[DONE]``) — deterministic chunk boundaries for stream
+        resumption tests."""
         body = await request.json() if request.can_read_body else {}
         mode = body.get("mode", "error")
         if mode not in ("error", "hang", "midstream", "slow"):
@@ -360,6 +423,7 @@ def create_fake_engine_app(
         state.fail_count = int(body.get("count", -1))
         state.fail_delay = float(body.get("delay", 0.5))
         state.fail_jitter = float(body.get("jitter", 0.0))
+        state.fail_after_chunks = int(body.get("fail_after_chunks", 3))
         return web.json_response({"status": "armed", "mode": mode})
 
     async def admin_heal(request: web.Request) -> web.Response:

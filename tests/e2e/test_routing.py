@@ -72,14 +72,15 @@ def metric_value(metrics_text: str, name: str, label: str = "") -> float:
 class Fleet:
     """N fake engines + one router process (static discovery)."""
 
-    def __init__(self, policy: str, router_args=None, labels=None):
+    def __init__(self, policy: str, router_args=None, labels=None,
+                 speed=2000):
         self.procs = []
         env = dict(os.environ, PYTHONPATH=REPO)
         self.engine_ports = [free_port() for _ in range(N_ENGINES)]
         for i, port in enumerate(self.engine_ports):
             self.procs.append(subprocess.Popen(
                 [sys.executable, "-m", "production_stack_tpu.testing.fake_engine",
-                 "--port", str(port), "--model", MODEL, "--speed", "2000",
+                 "--port", str(port), "--model", MODEL, "--speed", str(speed),
                  "--name", f"engine-{i}"],
                 env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             ))
@@ -391,6 +392,69 @@ def leg_chaos():
         assert metric_value(metrics, "pst_hedge_won_total") >= 1
     print("PASS chaos (engine killed mid-run, 40/40 served; slow engine "
           f"mid-run, 20/20 hedged, worst {worst * 1000:.0f}ms)", dict(served))
+
+    # Phase 3: engine SIGKILLed mid-STREAM under load with resume on.
+    # Every client must still receive a complete, dedup'd stream — the
+    # concatenated delta text of an unfaulted run, exactly one [DONE], no
+    # in-band truncation error — with broken streams resumed on a
+    # surviving engine under the same trace id (stream_resume span).
+    n_tokens = 45
+    expected = "".join(f"tok{i} " for i in range(n_tokens))
+    with Fleet("roundrobin", speed=150,
+               router_args=["--proxy-retries", "2",
+                            "--retry-backoff", "0.01",
+                            "--breaker-failure-threshold", "2",
+                            "--breaker-recovery-time", "60",
+                            "--stream-resume",
+                            "--stream-resume-max-legs", "2"]) as f:
+        def stream_one(i):
+            req = urllib.request.Request(
+                f"{f.url}/v1/completions",
+                data=json.dumps({"model": MODEL, "prompt": f"st{i}",
+                                 "max_tokens": n_tokens,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read().decode()
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=9) as ex:
+            futures = [ex.submit(stream_one, i) for i in range(9)]
+            # ~45 tokens at 150 tok/s ≈ 0.3s per stream: the kill lands
+            # while round-robin has streams mid-flight on engine-0.
+            time.sleep(0.1)
+            f.procs[0].kill()
+            stream_results = [fut.result() for fut in futures]
+        for status, body in stream_results:
+            assert status == 200
+            assert body.count("data: [DONE]") == 1, body[-200:]
+            assert "stream_truncated" not in body, body[-300:]
+            text = "".join(
+                json.loads(line[6:])["choices"][0].get("text") or ""
+                for line in body.split("\n\n")
+                if line.startswith("data: ") and "[DONE]" not in line
+            )
+            assert text == expected, f"stream {text[:60]!r}... not seamless"
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        resumed = metric_value(metrics, "pst_stream_resume_success_total")
+        assert resumed >= 1, "no stream was resumed despite the mid-run kill"
+        # One trace id across both legs: a resumed request's timeline holds
+        # its primary proxy_attempt AND the stream_resume leg.
+        with urllib.request.urlopen(
+            f"{f.url}/debug/requests?limit=100", timeout=5
+        ) as r:
+            timelines = json.loads(r.read())["requests"]
+        spliced = [
+            tl for tl in timelines
+            if any(sp["name"] == "stream_resume" for sp in tl["spans"])
+        ]
+        assert spliced, "no stream_resume span recorded"
+        assert any(
+            sp["name"] == "proxy_attempt" for sp in spliced[0]["spans"]
+        )
+    print(f"PASS chaos streams (9/9 seamless under mid-stream kill, "
+          f"{resumed:.0f} resumed)")
 
 
 LEGS = {

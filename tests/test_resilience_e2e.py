@@ -496,9 +496,11 @@ async def test_client_disconnect_aborts_upstream_request():
             assert disconnects >= 1
 
 
-async def test_no_retry_after_first_streamed_byte():
-    """An engine dying mid-stream must truncate, not replay, the stream
-    (a retry would duplicate already-delivered tokens)."""
+async def test_no_replay_after_first_streamed_byte():
+    """An engine dying mid-stream with resume OFF must not replay the
+    stream (a replay would duplicate already-delivered tokens) — and the
+    truncation must be *visible*: an in-band SSE error event + exactly one
+    [DONE] instead of a silent cut, counted in pst_stream_truncated_total."""
     async with Cluster(speed=100.0) as c:
         async with aiohttp.ClientSession() as s:
             # Arm exactly one midstream death; the engines that serve the
@@ -517,12 +519,20 @@ async def test_no_retry_after_first_streamed_byte():
                 assert resp.status == 200
                 payload = await resp.content.read()
             seen = payload.decode(errors="replace")
-            # Stream is truncated (no [DONE]) and nothing was replayed:
-            # tok0 appears exactly once across the whole body.
+            # Nothing was replayed: tok0 appears exactly once — and the
+            # truncation is terminal and visible, not a silent cut.
             assert seen.count("tok0 ") == 1
-            assert "data: [DONE]" not in seen
+            assert '"code": "stream_truncated"' in seen
+            assert seen.count("data: [DONE]") == 1
             text = await _router_metrics(s, c.router_url)
             assert "pst_resilience_upstream_failures_total" in text
+            truncated = [
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("pst_stream_truncated_total{")
+                and 'reason="disabled"' in line
+            ]
+            assert truncated and truncated[0] >= 1
 
 
 async def test_kv_controller_instances_expire_without_lookups():

@@ -1,0 +1,728 @@
+"""Transparent mid-stream failover (docs/resilience.md "Stream resumption").
+
+Unit ring: the SSE frame parser, the stream journal's accounting, resume
+eligibility, continuation-request building, and the continuation splice
+(identity rewrite, overlap dedupe, cross-leg usage merge, single [DONE]).
+
+E2E ring: real router app + in-process fake engines armed with
+deterministic mid-stream faults (``fail_after_chunks``). Covers the
+acceptance scenario: an engine dying mid-generation yields a seamless
+client stream whose concatenated delta text equals an unfaulted run's
+output — one [DONE], unbroken chunk identity, correct usage, one trace id
+with the resume leg visible as a ``stream_resume`` span — and with resume
+off/ineligible/exhausted the truncation is visible (in-band error event +
+[DONE]) instead of a silent cut.
+"""
+
+import json
+import time
+
+import aiohttp
+import pytest
+
+from production_stack_tpu.resilience import get_hedge_policy
+from production_stack_tpu.resilience.stream_resume import (
+    DONE_FRAME,
+    SSEParser,
+    StreamJournal,
+    StreamResumePolicy,
+    build_continuation,
+    resume_eligible,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    get_request_stats_monitor,
+)
+
+from .router_utils import reset_router_singletons
+from .test_resilience_e2e import MODEL, RESILIENCE_ARGS, Cluster, _router_metrics
+
+RESUME_ARGS = RESILIENCE_ARGS + ["--stream-resume", "--stream-resume-max-legs", "2"]
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# SSE parser
+# ---------------------------------------------------------------------------
+
+
+def _frame(obj) -> bytes:
+    return f"data: {json.dumps(obj)}\n\n".encode()
+
+
+def _chat_chunk(content=None, finish=None, role=None, usage=None,
+                id="orig-1", created=111, model="m"):
+    delta = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    obj = {
+        "id": id, "object": "chat.completion.chunk", "created": created,
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+    }
+    if usage is not None:
+        obj["usage"] = usage
+    return obj
+
+
+def _cmpl_chunk(text=None, finish=None, usage=None, id="orig-1",
+                created=111, model="m"):
+    obj = {
+        "id": id, "object": "text_completion", "created": created,
+        "model": model,
+        "choices": [{"index": 0, "text": text or "", "finish_reason": finish}],
+    }
+    if usage is not None:
+        obj["usage"] = usage
+    return obj
+
+
+def test_sse_parser_reassembles_split_frames():
+    p = SSEParser()
+    raw = _frame({"a": 1}) + _frame({"b": 2}) + DONE_FRAME
+    events = []
+    # Feed one byte at a time: frames must come out whole and byte-exact.
+    for i in range(len(raw)):
+        events.extend(p.feed(raw[i:i + 1]))
+    assert len(events) == 3
+    assert b"".join(e.raw for e in events) == raw
+    assert events[0].json == {"a": 1}
+    assert events[1].json == {"b": 2}
+    assert events[2].is_done
+    assert p.flush_raw() == b""
+
+
+def test_sse_parser_handles_crlf_delimiters():
+    p = SSEParser()
+    raw = b'data: {"a": 1}\r\n\r\ndata: {"b": 2}\n\ndata: [DONE]\r\n\r\n'
+    events = p.feed(raw)
+    assert [e.json for e in events[:2]] == [{"a": 1}, {"b": 2}]
+    assert events[2].is_done
+    assert b"".join(e.raw for e in events) == raw  # byte-exact passthrough
+    # Incremental CRLF frames come out as they complete, not at EOF.
+    p2 = SSEParser()
+    assert p2.feed(b'data: {"x": 1}\r\n') == []
+    assert [e.json for e in p2.feed(b"\r\n")] == [{"x": 1}]
+
+
+def test_sse_parser_buffers_partial_tail():
+    p = SSEParser()
+    assert p.feed(b'data: {"x"') == []
+    assert p.flush_raw() == b'data: {"x"'
+    # A discarded partial frame never resurfaces.
+    assert p.feed(b"") == []
+
+
+def test_journal_accumulates_chat_stream():
+    j = StreamJournal(is_chat=True, request_json={"stream": True,
+                                                  "max_tokens": 5})
+    out = j.feed(_frame(_chat_chunk(role="assistant")))
+    out += j.feed(_frame(_chat_chunk(content="tok0 ")))
+    out += j.feed(_frame(_chat_chunk(content="tok1 ")))
+    assert j.id == "orig-1" and j.created == 111 and j.model == "m"
+    assert j.text == "tok0 tok1 "
+    assert j.delivered_tokens == 2
+    assert j.remaining_tokens() == 3
+    assert j.saw_role_delta and not j.saw_done
+    # Pass-through is byte-identical.
+    assert out == (_frame(_chat_chunk(role="assistant"))
+                   + _frame(_chat_chunk(content="tok0 "))
+                   + _frame(_chat_chunk(content="tok1 ")))
+
+
+def test_journal_records_finish_usage_and_done():
+    j = StreamJournal(is_chat=False, request_json={"stream": True})
+    usage = {"prompt_tokens": 2, "completion_tokens": 3, "total_tokens": 5}
+    j.feed(_frame(_cmpl_chunk(text="a", finish="length", usage=usage)))
+    assert j.finish_reason == "length"
+    assert j.usage == usage
+    j.feed(DONE_FRAME)
+    assert j.saw_done
+    assert not j.resumable()  # complete streams are never resumed
+
+
+def test_journal_engine_error_frame_blocks_resume():
+    j = StreamJournal(is_chat=False, request_json={"stream": True},
+                      eligible=True)
+    assert j.resumable()
+    j.feed(_frame({"error": {"message": "boom", "type": "internal_error",
+                             "code": "engine_rejected"}}))
+    assert j.saw_error
+    assert not j.resumable()  # engine-reported, not transport death
+
+
+def test_resume_eligibility_matrix():
+    ok = {"stream": True, "prompt": "x", "max_tokens": 8}
+    chat_ok = {"stream": True, "messages": [], "max_tokens": 8}
+    assert resume_eligible("/v1/completions", ok)
+    assert resume_eligible("/v1/chat/completions", chat_ok)
+    assert not resume_eligible("/v1/completions",
+                               {"prompt": "x", "max_tokens": 8})  # no stream
+    assert not resume_eligible("/v1/embeddings", ok)
+    assert not resume_eligible("/v1/completions", {**ok, "n": 2})
+    assert not resume_eligible("/v1/completions", {**ok, "best_of": 4})
+    assert not resume_eligible("/v1/completions", {**ok, "logprobs": 1})
+    assert not resume_eligible("/v1/completions", {**ok, "echo": True})
+    assert not resume_eligible("/v1/completions", {**ok, "prompt": ["a", "b"]})
+    # No explicit max_tokens → a continuation leg would get a fresh
+    # engine-default budget; excluded.
+    assert not resume_eligible("/v1/completions",
+                               {"stream": True, "prompt": "x"})
+    # The client's own final assistant turn is already open: a resume
+    # would change the rendered context mid-generation; excluded.
+    assert not resume_eligible(
+        "/v1/chat/completions", {**chat_ok, "continue_final_message": True},
+    )
+    assert not resume_eligible(
+        "/v1/chat/completions",
+        {**chat_ok, "tools": [{"type": "function"}]},
+    )
+    assert not resume_eligible(
+        "/v1/chat/completions", {**chat_ok, "top_logprobs": 5},
+    )
+    # temperature > 0 is fine: a continuation is a fresh sample of the suffix
+    assert resume_eligible("/v1/completions", {**ok, "temperature": 0.9})
+
+
+def test_build_continuation_completions():
+    req = {"model": "m", "prompt": "hello", "max_tokens": 8, "stream": True,
+           "echo": False, "kv_transfer_params": {"request_id": "r"},
+           "temperature": 0.7}
+    j = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j.feed(_frame(_cmpl_chunk(text="tok0 ")) + _frame(_cmpl_chunk(text="tok1 ")))
+    cont = build_continuation(req, j, "/v1/completions")
+    assert cont["prompt"] == "hellotok0 tok1 "
+    assert cont["max_tokens"] == 6
+    assert cont["stream"] is True
+    assert cont["stream_options"] == {"include_usage": True}
+    assert "echo" not in cont and "kv_transfer_params" not in cont
+    assert cont["temperature"] == 0.7  # sampling params ride along
+    assert req["prompt"] == "hello"  # original body untouched
+
+
+def test_build_continuation_chat_appends_assistant_prefix():
+    req = {"model": "m", "stream": True, "max_tokens": 4,
+           "messages": [{"role": "user", "content": "hi"}]}
+    j = StreamJournal(is_chat=True, request_json=req, eligible=True)
+    j.feed(_frame(_chat_chunk(content="tok0 ")))
+    cont = build_continuation(req, j, "/v1/chat/completions")
+    assert cont["messages"] == [
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "tok0 "},
+    ]
+    # The engine must CONTINUE the appended assistant turn, not open a
+    # fresh one (chat templates add a generation prompt otherwise).
+    assert cont["continue_final_message"] is True
+    assert cont["max_tokens"] == 3
+    assert len(req["messages"]) == 1  # original body untouched
+
+
+def test_continuation_rewrites_identity_and_forwards_one_done():
+    req = {"stream": True, "model": "m",
+           "stream_options": {"include_usage": True}}
+    j = StreamJournal(is_chat=True, request_json=req, eligible=True)
+    j.feed(_frame(_chat_chunk(role="assistant"))
+           + _frame(_chat_chunk(content="tok0 ")))
+    j.start_continuation()
+    # The continuation leg arrives under its own id/created and opens with
+    # its own role frame: identity is rewritten, the role dupe dropped.
+    leg2 = (_frame(_chat_chunk(role="assistant", id="leg2", created=999))
+            + _frame(_chat_chunk(content="tok1 ", id="leg2", created=999))
+            + _frame(_chat_chunk(content="", finish="length", id="leg2",
+                                 created=999,
+                                 usage={"prompt_tokens": 3,
+                                        "completion_tokens": 1,
+                                        "total_tokens": 4}))
+            + DONE_FRAME + DONE_FRAME)
+    out = j.feed_continuation(leg2).decode()
+    frames = [json.loads(line[6:]) for line in out.strip().split("\n\n")
+              if line.startswith("data: ") and "[DONE]" not in line]
+    assert all(f["id"] == "orig-1" and f["created"] == 111 for f in frames)
+    assert out.count("data: [DONE]") == 1  # duplicate DONE suppressed
+    assert "role" not in out  # duplicate role announcement dropped
+    assert j.text == "tok0 tok1 "
+    assert j.finish_reason == "length"
+    # Cross-leg usage: one unbroken generation's numbers.
+    assert frames[-1]["usage"] == {
+        "prompt_tokens": 2, "completion_tokens": 2, "total_tokens": 4,
+    }
+
+
+def test_continuation_strips_usage_the_client_never_asked_for():
+    req = {"stream": True}  # no stream_options
+    j = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j.feed(_frame(_cmpl_chunk(text="tok0 ")))
+    j.start_continuation()
+    usage_only = {"id": "leg2", "object": "text_completion", "created": 9,
+                  "model": "m", "choices": [],
+                  "usage": {"prompt_tokens": 2, "completion_tokens": 1,
+                            "total_tokens": 3}}
+    out = j.feed_continuation(
+        _frame(_cmpl_chunk(text="tok1 ", id="leg2")) + _frame(usage_only)
+        + DONE_FRAME
+    ).decode()
+    assert "usage" not in out  # forced include_usage stays router-internal
+    assert out.count("data: [DONE]") == 1
+    assert j.usage["completion_tokens"] == 2  # still journaled for accounting
+
+
+def test_continuation_dedupes_reemitted_overlap():
+    req = {"stream": True}
+    j = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j.feed(_frame(_cmpl_chunk(text="tok0 ")) + _frame(_cmpl_chunk(text="tok1 ")))
+    j.start_continuation()
+    # An echo-style engine replays the delivered prefix before new text.
+    out = j.feed_continuation(
+        _frame(_cmpl_chunk(text="tok0 ", id="leg2"))
+        + _frame(_cmpl_chunk(text="tok1 ", id="leg2"))
+        + _frame(_cmpl_chunk(text="tok2 ", id="leg2"))
+        + DONE_FRAME
+    ).decode()
+    assert "tok0" not in out and "tok1" not in out
+    assert "tok2" in out
+    assert j.text == "tok0 tok1 tok2 "
+    assert j.delivered_tokens == 3
+
+
+def test_continuation_overlap_divergence_loses_no_tokens():
+    """A suffix that merely STARTS like the delivered prefix is real
+    output: held-back frames must flush intact the moment the leg
+    diverges — never be silently dropped."""
+    req = {"stream": True}
+    j = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j.feed(_frame(_cmpl_chunk(text="red ")) + _frame(_cmpl_chunk(text="green ")))
+    j.start_continuation()
+    # Continuation legitimately re-samples "red " as its first suffix
+    # token, then diverges ("blue " != "green ").
+    out = j.feed_continuation(
+        _frame(_cmpl_chunk(text="red ", id="leg2"))
+        + _frame(_cmpl_chunk(text="blue ", id="leg2"))
+        + DONE_FRAME
+    ).decode()
+    assert out.count("red ") == 1  # flushed, not dropped
+    assert "blue " in out
+    assert j.text == "red green red blue "
+    assert j.delivered_tokens == 4
+    # ... and an overlap window ended by the stream's end flushes too.
+    j2 = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j2.feed(_frame(_cmpl_chunk(text="red ")) + _frame(_cmpl_chunk(text="green ")))
+    j2.start_continuation()
+    out2 = j2.feed_continuation(
+        _frame(_cmpl_chunk(text="red ", id="leg2")) + DONE_FRAME
+    ).decode()
+    assert out2.count("red ") == 1
+    assert out2.count("data: [DONE]") == 1
+
+
+def test_continuation_overlap_spanning_delta_not_duplicated():
+    """A fresh leg chunks differently: an echo delta that spans the end
+    of the delivered prefix must forward only the new suffix — neither
+    duplicating the held-back echo nor the prefix inside the delta."""
+    req = {"stream": True}
+    j = StreamJournal(is_chat=False, request_json=req, eligible=True)
+    j.feed(_frame(_cmpl_chunk(text="ab")) + _frame(_cmpl_chunk(text="c")))
+    j.start_continuation()
+    out = j.feed_continuation(
+        _frame(_cmpl_chunk(text="ab", id="leg2"))     # echo, held back
+        + _frame(_cmpl_chunk(text="cdef", id="leg2"))  # spans prefix end
+        + DONE_FRAME
+    ).decode()
+    frames = [json.loads(line[6:]) for line in out.strip().split("\n\n")
+              if line.startswith("data: ") and "[DONE]" not in line]
+    texts = [f["choices"][0]["text"] for f in frames]
+    assert texts == ["def"]  # echo dropped, only the new suffix forwarded
+    assert j.text == "abcdef"
+
+
+def test_journal_skips_text_accumulation_when_resume_cannot_use_it():
+    j = StreamJournal(is_chat=False, request_json={"stream": True},
+                      eligible=False, record_text=False)
+    j.feed(_frame(_cmpl_chunk(text="tok0 ")) + _frame(_cmpl_chunk(text="tok1 ")))
+    assert j.text == ""  # no per-stream buffering without a resume to feed
+    assert j.delivered_tokens == 2  # truncation accounting still works
+    assert j.id == "orig-1"
+
+
+def test_chat_template_continue_final_message():
+    """Engine-side contract the chat continuation relies on: the rendered
+    prompt leaves the final assistant turn OPEN instead of adding a fresh
+    generation prompt."""
+    from production_stack_tpu.engine.tokenizer import ByteTokenizer
+    from production_stack_tpu.protocols import ChatMessage
+
+    tok = ByteTokenizer()
+    msgs = [ChatMessage(role="user", content="hi"),
+            ChatMessage(role="assistant", content="The answer")]
+    cont = tok.apply_chat_template(
+        msgs, add_generation_prompt=False, continue_final_message=True
+    )
+    assert cont.endswith("<|assistant|>\nThe answer")  # open turn
+    fresh = tok.apply_chat_template(msgs)
+    assert fresh.endswith("The answer\n<|assistant|>\n")  # new turn
+
+
+def test_synthesize_and_truncation_tails():
+    j = StreamJournal(is_chat=True, request_json={"stream": True, "model": "m"})
+    j.feed(_frame(_chat_chunk(content="tok0 ")))
+    tail = j.synthesize_tail().decode()
+    # A closing finish_reason chunk (none was delivered) + one [DONE].
+    assert '"finish_reason": "length"' in tail
+    assert tail.count("data: [DONE]") == 1
+    assert j.saw_done
+    j2 = StreamJournal(is_chat=False, request_json={"stream": True})
+    trunc = j2.truncation_tail().decode()
+    assert '"code": "stream_truncated"' in trunc
+    assert trunc.count("data: [DONE]") == 1
+    # An engine-reported error frame already on the wire is not duplicated.
+    j3 = StreamJournal(is_chat=False, request_json={"stream": True})
+    j3.feed(_frame({"error": {"message": "x", "code": "engine_rejected"}}))
+    trunc3 = j3.truncation_tail().decode()
+    assert "stream_truncated" not in trunc3
+    assert trunc3.count("data: [DONE]") == 1
+
+
+def test_policy_floors_max_legs():
+    assert StreamResumePolicy(enabled=True, max_legs=0).max_legs == 1
+
+
+# ---------------------------------------------------------------------------
+# E2E: real router app + fake engines with deterministic mid-stream faults
+# ---------------------------------------------------------------------------
+
+
+async def _arm(session, engine_url, **kw):
+    async with session.post(f"{engine_url}/admin/fail",
+                            json={"mode": "midstream", "count": 1, **kw}) as r:
+        assert r.status == 200
+
+
+async def _next_rr_victim(session, c) -> int:
+    """Index of the engine the NEXT request will round-robin to, so the
+    fault lands exactly on the request under test."""
+    async with session.post(
+        f"{c.router_url}/v1/completions",
+        json={"model": MODEL, "prompt": "probe", "max_tokens": 1},
+    ) as resp:
+        assert resp.status == 200
+        by = resp.headers.get("X-Served-By")
+        await resp.read()
+    last = int(by.rsplit("-", 1)[1])
+    order = sorted(range(3), key=lambda j: c.engine_urls[j])
+    return order[(order.index(last) + 1) % 3]
+
+
+def _parse_sse(payload: bytes):
+    """(json frames, done count) of a raw SSE body."""
+    frames, done = [], 0
+    for part in payload.decode().split("\n\n"):
+        part = part.strip()
+        if not part.startswith("data: "):
+            continue
+        data = part[6:]
+        if data.strip() == "[DONE]":
+            done += 1
+        else:
+            frames.append(json.loads(data))
+    return frames, done
+
+
+def _delta_text(frames, is_chat):
+    out = ""
+    for f in frames:
+        for choice in f.get("choices") or []:
+            if is_chat:
+                out += (choice.get("delta") or {}).get("content") or ""
+            else:
+                out += choice.get("text") or ""
+    return out
+
+
+async def _stream(session, url, endpoint, body):
+    async with session.post(f"{url}{endpoint}", json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        payload = await resp.content.read()
+        return resp.headers, payload
+
+
+async def _metric(session, url, name, label=""):
+    text = await _router_metrics(session, url)
+    for line in text.splitlines():
+        if line.startswith(name) and (not label or label in line):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+_RESUME_METRICS = [
+    ("pst_stream_resume_attempts_total", ""),
+    ("pst_stream_resume_success_total", ""),
+    ("pst_stream_resume_failures_total", ""),
+    ("pst_stream_truncated_total", 'reason="disabled"'),
+    ("pst_stream_truncated_total", 'reason="ineligible"'),
+    ("pst_stream_truncated_total", 'reason="resume_failed"'),
+]
+
+
+async def _snapshot(session, url):
+    """Prometheus counters on the default registry survive across tests in
+    one process — assert deltas against this, not absolutes."""
+    return {
+        (name, label): await _metric(session, url, name, label)
+        for name, label in _RESUME_METRICS
+    }
+
+
+async def _delta(session, url, base, name, label=""):
+    return await _metric(session, url, name, label) - base[(name, label)]
+
+
+async def test_stream_resumes_seamlessly_across_engine_death():
+    """Acceptance: a mid-stream death is invisible to the client — the
+    concatenated delta text equals an unfaulted run's, with one [DONE],
+    unbroken chunk identity, correct usage, the resume leg as a
+    stream_resume span on the same trace, and the success counter bumped."""
+    body = {"model": MODEL, "prompt": "resume me", "max_tokens": 8,
+            "stream": True, "stream_options": {"include_usage": True}}
+    expected_text = "".join(f"tok{i} " for i in range(8))
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            # Unfaulted reference run.
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            frames, done = _parse_sse(payload)
+            assert _delta_text(frames, is_chat=False) == expected_text
+            assert done == 1
+            unfaulted_usage = [f["usage"] for f in frames if f.get("usage")][0]
+            unfaulted_finish = [
+                ch.get("finish_reason") for f in frames
+                for ch in f["choices"] if ch.get("finish_reason")
+            ][0]
+
+            # Fault run: the serving engine dies after 3 delta chunks.
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=3)
+            headers, payload = await _stream(
+                s, c.router_url, "/v1/completions", body
+            )
+            assert headers.get("X-Served-By") == f"engine-{victim}"
+            frames, done = _parse_sse(payload)
+            assert _delta_text(frames, is_chat=False) == expected_text
+            assert done == 1
+            # Chunk identity is the original leg's across both legs.
+            assert len({f["id"] for f in frames}) == 1
+            assert len({f["created"] for f in frames}) == 1
+            # usage and finish_reason match the unfaulted run exactly.
+            assert [f["usage"] for f in frames if f.get("usage")][0] \
+                == unfaulted_usage
+            assert [
+                ch.get("finish_reason") for f in frames
+                for ch in f["choices"] if ch.get("finish_reason")
+            ][0] == unfaulted_finish
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_success_total"
+            ) == 1
+            for reason in ("disabled", "ineligible", "resume_failed"):
+                assert await _delta(
+                    s, c.router_url, base, "pst_stream_truncated_total",
+                    f'reason="{reason}"',
+                ) == 0
+
+            # One trace id across both legs; the resume leg is its own
+            # stream_resume span on the same timeline.
+            rid = headers.get("X-Request-Id")
+            async with s.get(
+                f"{c.router_url}/debug/requests", params={"request_id": rid}
+            ) as resp:
+                timelines = (await resp.json())["requests"]
+            assert len(timelines) == 1
+            names = [sp["name"] for sp in timelines[0]["spans"]]
+            assert "proxy_attempt" in names and "stream_resume" in names
+            # Both engines saw the same trace id on the wire.
+            traces = {
+                t["traceparent"].split("-")[1]
+                for i in range(3)
+                for t in c.engine_state(i).traces_seen
+                if t["traceparent"] and t["request_id"] == rid
+            }
+            assert len(traces) == 1
+
+
+async def test_chat_stream_resumes_seamlessly():
+    body = {"model": MODEL, "stream": True, "max_tokens": 6,
+            "messages": [{"role": "user", "content": "hello there"}],
+            "stream_options": {"include_usage": True}}
+    expected_text = "".join(f"tok{i} " for i in range(6))
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=2)
+            _, payload = await _stream(
+                s, c.router_url, "/v1/chat/completions", body
+            )
+            frames, done = _parse_sse(payload)
+            assert _delta_text(frames, is_chat=True) == expected_text
+            assert done == 1
+            assert len({f["id"] for f in frames}) == 1
+            usage = [f["usage"] for f in frames if f.get("usage")][0]
+            # "hello there" = 2 prompt words; 6 generated tokens.
+            assert usage == {"prompt_tokens": 2, "completion_tokens": 6,
+                             "total_tokens": 8}
+
+
+async def test_death_before_first_delta_resumes():
+    """fail_after_chunks=0: the engine commits the response (headers) and
+    dies before any delta — the continuation regenerates from scratch."""
+    body = {"model": MODEL, "prompt": "early", "max_tokens": 5,
+            "stream": True}
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=0)
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            frames, done = _parse_sse(payload)
+            assert _delta_text(frames, is_chat=False) \
+                == "".join(f"tok{i} " for i in range(5))
+            assert done == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_success_total"
+            ) == 1
+
+
+async def test_death_after_last_delta_finishes_locally():
+    """fail_after_chunks >= max_tokens: every token (and the finish_reason
+    riding the last chunk) was delivered; the router finishes the stream
+    from the journal — [DONE] only, no continuation request."""
+    body = {"model": MODEL, "prompt": "late", "max_tokens": 4, "stream": True,
+            "stream_options": {"include_usage": True}}
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=4)
+            before = [len(c.engine_state(i).requests_seen) for i in range(3)]
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            frames, done = _parse_sse(payload)
+            assert _delta_text(frames, is_chat=False) \
+                == "".join(f"tok{i} " for i in range(4))
+            assert done == 1
+            usage = [f["usage"] for f in frames if f.get("usage")][0]
+            assert usage["completion_tokens"] == 4
+            # No continuation leg was issued — exactly one generation ran.
+            after = [len(c.engine_state(i).requests_seen) for i in range(3)]
+            assert sum(after) - sum(before) == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_success_total"
+            ) == 1
+
+
+async def test_ineligible_stream_truncates_visibly():
+    """logprobs streams cannot be spliced: resume stays off for them and
+    the truncation is visible (error event + [DONE], counter bumped)."""
+    body = {"model": MODEL, "prompt": "lp", "max_tokens": 8, "stream": True,
+            "logprobs": 1}
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=2)
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            seen = payload.decode()
+            assert '"code": "stream_truncated"' in seen
+            assert seen.count("data: [DONE]") == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_truncated_total",
+                'reason="ineligible"',
+            ) == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_attempts_total"
+            ) == 0
+
+
+async def test_resume_exhaustion_truncates_visibly():
+    """Every engine dies mid-stream and the leg budget runs out: the
+    client still gets a terminal error event + one [DONE], with no token
+    ever duplicated across the partial legs."""
+    body = {"model": MODEL, "prompt": "doom", "max_tokens": 12, "stream": True}
+    extra = RESILIENCE_ARGS + ["--stream-resume", "--stream-resume-max-legs", "1"]
+    async with Cluster(extra_args=extra) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            for url in c.engine_urls:
+                await _arm(s, url, fail_after_chunks=3)
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            frames, done = _parse_sse(payload)
+            seen = payload.decode()
+            assert done == 1
+            assert '"code": "stream_truncated"' in seen
+            # Both legs' partial output is present exactly once each.
+            text = _delta_text(frames, is_chat=False)
+            assert text == "".join(f"tok{i} " for i in range(6))
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_failures_total"
+            ) == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_truncated_total",
+                'reason="resume_failed"',
+            ) == 1
+
+
+async def test_tight_deadline_blocks_resume():
+    """A resume the remaining budget cannot cover (connect floor + one
+    token) is not attempted — the stream truncates visibly instead of
+    burning a doomed continuation."""
+    body = {"model": MODEL, "prompt": "tight", "max_tokens": 8, "stream": True}
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            base = await _snapshot(s, c.router_url)
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=2)
+            # 600ms budget < the 30s connect-timeout floor at resume time.
+            async with s.post(
+                f"{c.router_url}/v1/completions", json=body,
+                headers={"X-PST-Deadline-Ms": "600"},
+            ) as resp:
+                assert resp.status == 200
+                payload = await resp.content.read()
+            seen = payload.decode()
+            assert '"code": "stream_truncated"' in seen
+            assert seen.count("data: [DONE]") == 1
+            assert await _delta(
+                s, c.router_url, base, "pst_stream_resume_attempts_total"
+            ) == 0
+
+
+async def test_cross_leg_accounting_no_double_count():
+    """The dead leg's partial tokens must not double-count: the resume leg
+    runs under its own request id in the stats monitor (each leg completes
+    exactly once, nothing leaks in prefill/decoding), and the hedge
+    outstanding-ratio bookkeeping never sees streamed legs at all."""
+    body = {"model": MODEL, "prompt": "acct", "max_tokens": 8, "stream": True}
+    async with Cluster(extra_args=RESUME_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            victim = await _next_rr_victim(s, c)
+            await _arm(s, c.engine_urls[victim], fail_after_chunks=3)
+            _, payload = await _stream(s, c.router_url, "/v1/completions", body)
+            _, done = _parse_sse(payload)
+            assert done == 1
+            monitor = get_request_stats_monitor()
+            stats = monitor.get_request_stats(time.time())
+            # No leg is still accounted as in flight anywhere.
+            for st in stats.values():
+                assert st.in_prefill_requests == 0
+                assert st.in_decoding_requests == 0
+            # Streamed legs never touch the hedge outstanding bookkeeping.
+            hedge = get_hedge_policy()
+            assert hedge.outstanding_primaries == 0
+            assert hedge.outstanding_hedges == 0
+            # The fake engines together saw exactly 2 generation requests
+            # for this stream (probe + dead leg + resume leg = 3 total).
+            assert sum(
+                len(c.engine_state(i).requests_seen) for i in range(3)
+            ) == 3
