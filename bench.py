@@ -67,16 +67,58 @@ def child_env() -> dict:
     return env
 
 
+def read_partial(path: str) -> dict:
+    """Best-effort read of an incrementally-written partial result file
+    (bench_engine.write_partial); {} when absent or unparseable."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def run_engine_phase() -> dict:
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "benchmarks", "bench_engine.py")],
-        stdout=subprocess.PIPE,
-        text=True,
-        env=child_env(),
-        timeout=int(os.environ.get("PST_BENCH_ENGINE_TIMEOUT", "4200")),
+    """Run the engine benchmark subprocess.
+
+    The child checkpoints its cumulative result to $PST_BENCH_ENGINE_OUT
+    after every qps point and phase, so a timeout (BENCH_r05: rc=124 with
+    nothing parseable) or crash degrades to the partial result instead of
+    losing the whole run — recompile-heavy sweeps stay attributable.
+    """
+    partial_path = os.environ.get(
+        "PST_BENCH_ENGINE_OUT", "/tmp/pst_bench_engine_partial.json"
     )
+    env = child_env()
+    env["PST_BENCH_ENGINE_OUT"] = partial_path
+    try:
+        os.remove(partial_path)  # never serve a previous run's partial
+    except OSError:
+        pass
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks", "bench_engine.py")],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=env,
+            timeout=int(os.environ.get("PST_BENCH_ENGINE_TIMEOUT", "4200")),
+        )
+    except subprocess.TimeoutExpired:
+        partial = read_partial(partial_path)
+        if partial:
+            log("engine phase timed out; continuing with its partial result")
+            partial["partial"] = True
+            partial["error"] = "engine phase timed out"
+            return partial
+        raise
     lines = proc.stdout.strip().splitlines()
     if proc.returncode != 0 or not lines:
+        partial = read_partial(partial_path)
+        if partial:
+            log(f"engine phase failed (rc={proc.returncode}); "
+                "continuing with its partial result")
+            partial["partial"] = True
+            partial["error"] = f"engine phase rc={proc.returncode}"
+            return partial
         raise RuntimeError(
             f"engine benchmark phase failed (rc={proc.returncode}); "
             "its stderr is above"
@@ -416,6 +458,44 @@ def probe_backend() -> str:
     return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "cpu"
 
 
+def emit(out: dict) -> None:
+    """Emit the (cumulative) result: one JSON line on stdout per phase —
+    the LAST stdout line is always a complete, parseable JSON object, so
+    a harness that kills this process mid-run still parses every phase
+    that finished — plus an atomic copy at $PST_BENCH_OUT when set."""
+    print(json.dumps(out), flush=True)
+    path = os.environ.get("PST_BENCH_OUT")
+    if not path:
+        return
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        log(f"could not write {path}: {e}")
+
+
+def assemble(engine_res: dict, stack, fleet) -> dict:
+    flag = engine_res.get("flagship", {})
+    p50 = flag.get("p50_ttft_ms")
+    return {
+        "metric": "p50_ttft_warm",
+        "value": p50,
+        "unit": "ms",
+        "vs_baseline": (
+            round(TTFT_TARGET_S * 1e3 / p50, 3) if p50 else None
+        ),
+        "backend": engine_res.get("backend", "unknown"),
+        "rpc_floor_ms": engine_res.get("rpc_floor_ms"),
+        **{k: v for k, v in flag.items() if k != "p50_ttft_ms"},
+        "concurrency_8users": engine_res.get("concurrency_8users"),
+        "llama_1b": engine_res.get("llama_1b"),
+        "stack": stack,
+        "fleet": fleet,
+    }
+
+
 def main() -> None:
     if os.environ.get("PST_BENCH_SKIP_ENGINE") == "1":  # stack-only debug
         engine_res = {"backend": probe_backend()}
@@ -423,6 +503,7 @@ def main() -> None:
         engine_res = run_engine_phase()
     backend = engine_res.get("backend", "unknown")
     on_tpu = backend == "tpu"
+    emit(assemble(engine_res, None, None))
 
     stack = None
     if os.environ.get("PST_BENCH_SKIP_STACK") != "1":
@@ -431,6 +512,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — stack numbers are additive
             log(f"stack phase failed: {e}")
             stack = {"error": str(e)}
+        emit(assemble(engine_res, stack, None))
 
     fleet = None
     if os.environ.get("PST_BENCH_SKIP_FLEET") != "1":
@@ -440,24 +522,7 @@ def main() -> None:
             log(f"fleet phase failed: {e}")
             fleet = {"error": str(e)}
 
-    flag = engine_res.get("flagship", {})
-    p50 = flag.get("p50_ttft_ms")
-    out = {
-        "metric": "p50_ttft_warm",
-        "value": p50,
-        "unit": "ms",
-        "vs_baseline": (
-            round(TTFT_TARGET_S * 1e3 / p50, 3) if p50 else None
-        ),
-        "backend": backend,
-        "rpc_floor_ms": engine_res.get("rpc_floor_ms"),
-        **{k: v for k, v in flag.items() if k != "p50_ttft_ms"},
-        "concurrency_8users": engine_res.get("concurrency_8users"),
-        "llama_1b": engine_res.get("llama_1b"),
-        "stack": stack,
-        "fleet": fleet,
-    }
-    print(json.dumps(out), flush=True)
+    emit(assemble(engine_res, stack, fleet))
 
 
 if __name__ == "__main__":

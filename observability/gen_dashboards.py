@@ -1,15 +1,23 @@
 #!/usr/bin/env python3
-"""Generate the Grafana dashboards (run: python gen_dashboards.py).
+"""Generate the Grafana dashboards + Prometheus rules (run this file).
 
 Reference role: observability/vllm-dashboard.json (20 fleet panels) and the
-LMCache dashboard configmap. Panels are generated so metric names stay in
-sync with the code in one place.
+LMCache dashboard configmap. Panels AND the SLO recording/alerting rules
+(prometheus-rules.yaml) are generated so metric names stay in sync with
+the code in one place — CI diffs the committed artifacts against this
+generator's output.
 """
 
 import json
 import os
 
 DS = {"type": "prometheus", "uid": "${datasource}"}
+
+# TTFT SLO objective the burn-rate rules alert on: 99% of generation
+# requests see first token within the configured target (--slo-ttft-ms,
+# default the 200 ms north star). Error budget = 1 - objective.
+SLO_OBJECTIVE = 0.99
+SLO_ERROR_BUDGET = round(1.0 - SLO_OBJECTIVE, 6)
 
 
 def panel(title, exprs, x, y, w=8, h=7, unit="short", kind="timeseries"):
@@ -229,6 +237,71 @@ def fleet_dashboard():
          'clamp_min(sum(rate(pst_stage_duration_seconds_count[2m])) '
          'by (stage), 1e-9)', "{{stage}}"),
     ], 16, 61, unit="s"))
+    # Row 10 — TPU engine telemetry (docs/observability.md "Engine
+    # telemetry"): compiles, step durations, throughput/MFU, KV pressure,
+    # padding waste, startup decomposition.
+    p.append(panel("XLA compiles per second (by step kind)", [
+        ('sum(rate(pst_engine_compile_total[5m])) by (kind)', "{{kind}}"),
+    ], 0, 68))
+    p.append(panel("Compile time p90 (first call per shape bucket)", [
+        ('histogram_quantile(0.9, sum(rate(pst_engine_compile_seconds_bucket'
+         '[10m])) by (le, kind))', "{{kind}}"),
+    ], 8, 68, unit="s"))
+    p.append(panel("Device step duration p90 by kind", [
+        ('histogram_quantile(0.9, sum(rate('
+         'pst_engine_step_duration_seconds_bucket[2m])) by (le, kind))',
+         "{{kind}}"),
+    ], 16, 68, unit="s"))
+    p.append(panel("Engine tokens/s (device view) + MFU", [
+        ('sum(pst_engine_tokens_per_second) by (kind)', "{{kind}} tok/s"),
+        ('pst_engine_mfu * 100', "MFU %"),
+    ], 0, 75))
+    p.append(panel("Batch fill ratio (padding waste; 1.0 = none)", [
+        ('sum(rate(pst_engine_batch_fill_ratio_sum[2m])) by (kind) / '
+         'clamp_min(sum(rate(pst_engine_batch_fill_ratio_count[2m])) '
+         'by (kind), 1e-9)', "{{kind}}"),
+    ], 8, 75, unit="percentunit"))
+    p.append(panel("KV page occupancy vs high watermark", [
+        ('pst_engine_kv_page_occupancy', "occupancy"),
+        ('pst_engine_kv_page_high_watermark', "high watermark"),
+    ], 16, 75, unit="percentunit"))
+    p.append(panel("Engine startup decomposition (s)", [
+        ('pst_engine_startup_seconds', "{{phase}}"),
+    ], 0, 82, unit="s"))
+    p.append(panel("Preemptions / swaps per second (engine view)", [
+        ('sum(rate(pst_engine_preemptions_total[2m]))', "preemptions /s"),
+        ('sum(rate(pst_engine_swap_out_total[2m]))', "swap-out /s"),
+        ('sum(rate(pst_engine_swap_in_total[2m]))', "swap-in /s"),
+    ], 8, 82))
+    p.append(stat("Compiles (1h)",
+                  'sum(increase(pst_engine_compile_total[1h])) or vector(0)',
+                  16, 82))
+    p.append(stat("MFU", 'pst_engine_mfu', 20, 82, unit="percentunit"))
+    # Row 11 — SLO (docs/observability.md "SLOs & alerting"): attainment
+    # ratios, multi-window burn rates, canary probes. The recorded series
+    # come from observability/prometheus-rules.yaml (same generator).
+    p.append(panel("TTFT SLO attainment (good / total)", [
+        ('1 - pst:slo_ttft_error:ratio_rate5m', "5m"),
+        ('1 - pst:slo_ttft_error:ratio_rate1h', "1h"),
+        ('1 - pst:slo_ttft_error:ratio_rate3d', "3d"),
+        (str(SLO_OBJECTIVE), f"objective ({SLO_OBJECTIVE})"),
+    ], 0, 89, unit="percentunit"))
+    p.append(panel("SLO burn rate (error ratio / budget)", [
+        (f'pst:slo_ttft_error:ratio_rate1h / {SLO_ERROR_BUDGET}', "1h"),
+        (f'pst:slo_ttft_error:ratio_rate6h / {SLO_ERROR_BUDGET}', "6h"),
+        (f'pst:slo_ttft_error:ratio_rate3d / {SLO_ERROR_BUDGET}', "3d"),
+        ('14.4', "page threshold (14.4x)"),
+        ('1', "ticket threshold (1x)"),
+    ], 8, 89))
+    p.append(panel("Canary TTFT per engine", [
+        ('pst_canary_ttft_seconds', "{{engine}}"),
+    ], 16, 89, unit="s"))
+    p.append(stat("SLO requests /s",
+                  'sum(rate(pst_slo_requests_total[5m])) or vector(0)',
+                  0, 96))
+    p.append(stat("Canary failures /10m",
+                  'sum(increase(pst_canary_failures_total[10m])) or vector(0)',
+                  4, 96))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 
@@ -256,6 +329,167 @@ def tiering_dashboard():
     return dashboard("pst-kv-tiering", "production-stack-tpu / KV Tiering", p)
 
 
+def _slo_error_expr(window):
+    # (requests - within) / requests, NOT 1 - within/requests: with zero
+    # traffic both rates are 0 and this form reads 0/1e-9 = 0 error — an
+    # idle fleet must never page (the 1-minus form reads error = 1 there).
+    return (
+        f"(sum(rate(pst_slo_requests_total[{window}])) - "
+        f"sum(rate(pst_slo_ttft_within_target_total[{window}]))) / "
+        f"clamp_min(sum(rate(pst_slo_requests_total[{window}])), 1e-9)"
+    )
+
+
+def prometheus_rules():
+    """Recording rules + multi-window multi-burn-rate alerts for the TTFT
+    SLO (the standard SRE-workbook shape: page when the 1h AND 5m burn
+    rates both exceed 14.4x the error budget — budget gone in ~2 days;
+    ticket when the 3d AND 6h burn rates exceed 1x — budget gone in 30d),
+    plus engine-health alerts over the pst_engine_* telemetry."""
+    windows = ["5m", "30m", "1h", "6h", "3d"]
+    recording = [
+        {
+            "record": f"pst:slo_ttft_error:ratio_rate{w}",
+            "expr": _slo_error_expr(w),
+        }
+        for w in windows
+    ]
+    page_thresh = round(14.4 * SLO_ERROR_BUDGET, 6)
+    ticket_thresh = round(1.0 * SLO_ERROR_BUDGET, 6)
+    alerts = [
+        {
+            "alert": "PstTtftSloBurnRatePage",
+            "expr": (
+                f"pst:slo_ttft_error:ratio_rate1h > {page_thresh} "
+                f"and pst:slo_ttft_error:ratio_rate5m > {page_thresh}"
+            ),
+            "for": "2m",
+            "labels": {"severity": "page", "slo": "ttft"},
+            "annotations": {
+                "summary": "TTFT SLO burning at >=14.4x (budget gone in ~2 days)",
+                "description": (
+                    "The fleet is missing the TTFT target fast enough to "
+                    "exhaust the 30-day error budget within ~2 days "
+                    f"(objective {SLO_OBJECTIVE}, 1h AND 5m windows). "
+                    "Check the Latency breakdown and TPU engine dashboard "
+                    "rows: recompiles (pst_engine_compile_total) and KV "
+                    "pressure (pst_engine_kv_page_occupancy) are the usual "
+                    "suspects."
+                ),
+            },
+        },
+        {
+            "alert": "PstTtftSloBurnRateTicket",
+            "expr": (
+                f"pst:slo_ttft_error:ratio_rate3d > {ticket_thresh} "
+                f"and pst:slo_ttft_error:ratio_rate6h > {ticket_thresh}"
+            ),
+            "for": "1h",
+            "labels": {"severity": "ticket", "slo": "ttft"},
+            "annotations": {
+                "summary": "TTFT SLO burning at >=1x (budget gone in 30 days)",
+                "description": (
+                    "Slow, sustained burn: at this rate the 30-day TTFT "
+                    "error budget will be fully spent (3d AND 6h windows). "
+                    "File and investigate; no page."
+                ),
+            },
+        },
+        {
+            "alert": "PstEngineRecompileOnLiveTraffic",
+            # Per-instance, uptime-gated: cold-start compiles during the
+            # first 15 minutes of an engine's life are the expected warmup
+            # set — a rolling deploy must not raise standing tickets.
+            "expr": (
+                "sum by (instance) "
+                "(increase(pst_engine_compile_total[15m])) > 0 "
+                "and on (instance) sum by (instance) "
+                "(vllm:num_requests_running) > 0 "
+                "and on (instance) "
+                "((time() - pst_engine_start_time_seconds) > 900)"
+            ),
+            "for": "0m",
+            "labels": {"severity": "ticket", "component": "engine"},
+            "annotations": {
+                "summary": "XLA recompile landed while requests were live",
+                "description": (
+                    "A compiled-shape-bucket miss hit a serving engine "
+                    "(BENCH_r05's 120 s p99 was one of these). The victim "
+                    "request's timeline carries a `compile` span event; "
+                    "widen --min-decode-bucket or pre-warm the offending "
+                    "bucket (kind/shape_bucket labels name it)."
+                ),
+            },
+        },
+        {
+            "alert": "PstCanaryTtftHigh",
+            "expr": "pst_canary_ttft_seconds > 1",
+            "for": "5m",
+            "labels": {"severity": "ticket", "component": "router"},
+            "annotations": {
+                "summary": "Canary TTFT above 1s on {{ $labels.engine }}",
+                "description": (
+                    "The synthetic 1-token probe is slow on this engine "
+                    "even without user load — cold path, pending compile, "
+                    "or host contention."
+                ),
+            },
+        },
+        {
+            "alert": "PstCanaryFailing",
+            "expr": "sum(increase(pst_canary_failures_total[10m])) by (engine) > 3",
+            "for": "0m",
+            "labels": {"severity": "page", "component": "router"},
+            "annotations": {
+                "summary": "Canary probes failing on {{ $labels.engine }}",
+                "description": (
+                    "More than 3 failed probes in 10 minutes: the engine "
+                    "is unreachable or erroring. The router's breaker "
+                    "should already be open; verify capacity."
+                ),
+            },
+        },
+    ]
+    return {
+        "groups": [
+            {"name": "pst-slo-recording", "interval": "30s",
+             "rules": recording},
+            {"name": "pst-slo-alerts", "rules": alerts},
+        ]
+    }
+
+
+def _dump_rules_yaml(rules: dict) -> str:
+    """Hand-rolled YAML so the generator stays dependency-free (PyYAML is
+    a router dependency, not necessarily a tooling one) and the output is
+    byte-stable for the CI drift check."""
+    def q(s):
+        return '"' + str(s).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+    lines = [
+        "# Generated by observability/gen_dashboards.py — do not edit by",
+        "# hand (CI diffs this file against the generator output).",
+        "groups:",
+    ]
+    for group in rules["groups"]:
+        lines.append(f"  - name: {group['name']}")
+        if "interval" in group:
+            lines.append(f"    interval: {group['interval']}")
+        lines.append("    rules:")
+        for rule in group["rules"]:
+            head = "record" if "record" in rule else "alert"
+            lines.append(f"      - {head}: {rule[head]}")
+            lines.append(f"        expr: {q(rule['expr'])}")
+            if "for" in rule:
+                lines.append(f"        for: {rule['for']}")
+            for section in ("labels", "annotations"):
+                if section in rule:
+                    lines.append(f"        {section}:")
+                    for k, v in rule[section].items():
+                        lines.append(f"          {k}: {q(v)}")
+    return "\n".join(lines) + "\n"
+
+
 if __name__ == "__main__":
     here = os.path.dirname(os.path.abspath(__file__))
     for name, dash in [
@@ -265,3 +499,6 @@ if __name__ == "__main__":
         with open(os.path.join(here, name), "w") as f:
             json.dump(dash, f, indent=2)
         print("wrote", name)
+    with open(os.path.join(here, "prometheus-rules.yaml"), "w") as f:
+        f.write(_dump_rules_yaml(prometheus_rules()))
+    print("wrote prometheus-rules.yaml")

@@ -20,6 +20,7 @@ import numpy as np
 from ..kvcache.hashing import CHUNK_TOKENS
 from ..logging_utils import init_logger
 from ..models.registry import get_model_config
+from ..obs.engine_telemetry import ENGINE_TELEMETRY
 from .config import EngineConfig
 from .kv_manager import BlockAllocator
 from .runner import ModelRunner
@@ -51,15 +52,23 @@ class RequestOutput:
     # One entry per new token when SamplingParams.logprobs is set:
     # {"token_id", "logprob", "top": [(token_id, logprob), ...]}.
     logprobs: Optional[List[dict]] = None
+    # XLA compiles the step that produced this output absorbed
+    # ({"kind", "shape_bucket", "seconds"}): the HTTP layer attaches them
+    # as `compile` span events so a recompile shows up inside the victim
+    # request's timeline (docs/observability.md "Engine telemetry").
+    compile_events: Optional[List[dict]] = None
 
 
 class LLMEngine:
     def __init__(self, cfg: EngineConfig, mesh=None):
+        t_init = time.perf_counter()
         self.cfg = cfg
         self.model_cfg = get_model_config(cfg.model)
         tok_spec = cfg.tokenizer or (cfg.model if os.path.isdir(cfg.model) else None)
         self.tokenizer = get_tokenizer(tok_spec, self.model_cfg.vocab_size)
+        t_runner = time.perf_counter()
         self.runner = ModelRunner(cfg, self.model_cfg, mesh)
+        t_runner_s = time.perf_counter() - t_runner
         if cfg.cpu_offload_blocks > 0 or cfg.remote_kv_url:
             from .cache_tiering import RemoteKVClient, TieredAllocator
 
@@ -132,6 +141,8 @@ class LLMEngine:
         # counter for deep bursts actually executed.
         self._last_arrival = 0.0
         self.adaptive_deep_bursts_total = 0
+        # Compile events awaiting an output-emitting step (see step()).
+        self._pending_compile_events: List[dict] = []
         self._seqs: Dict[str, Sequence] = {}
         # Incremental detokenizer state per request:
         # emitted text + [prefix_offset, read_offset) decode window.
@@ -143,6 +154,13 @@ class LLMEngine:
         self.num_preempted_total = 0
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
+        # Startup decomposition, phase 3: everything around the runner —
+        # tokenizer, allocator, swapper, scheduler, LoRA manager
+        # (pst_engine_startup_seconds{phase="warmup"}; the runner records
+        # load and shard itself).
+        ENGINE_TELEMETRY.record_startup_phase(
+            "warmup", time.perf_counter() - t_init - t_runner_s
+        )
 
     @property
     def model_name(self) -> str:
@@ -315,6 +333,24 @@ class LLMEngine:
         return cap
 
     def step(self) -> List[RequestOutput]:
+        outputs = self._step_impl()
+        # A compile that landed inside this step delayed every request the
+        # step served: attach the events so the HTTP layer can surface them
+        # on the victim requests' traces. Compiles in output-less steps
+        # (intermediate prefill chunks dispatch without emitting) are held
+        # for the next emitting step — the same requests were waiting on
+        # them.
+        events = self._pending_compile_events + ENGINE_TELEMETRY.drain_compile_events()
+        if outputs:
+            if events:
+                for out in outputs:
+                    out.compile_events = list(events)
+            self._pending_compile_events = []
+        else:
+            self._pending_compile_events = events[-8:]  # bounded
+        return outputs
+
+    def _step_impl(self) -> List[RequestOutput]:
         outputs: List[RequestOutput] = []
         hint = self._decode_depth_hint()
         if self.runner.burst_in_flight:

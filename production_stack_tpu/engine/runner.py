@@ -28,6 +28,7 @@ from jax.numpy import asarray as jnp_asarray
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..logging_utils import init_logger
+from ..obs.engine_telemetry import ENGINE_TELEMETRY, next_runner_scope
 from ..models.llama import (
     QUANT4_SUFFIX,
     QUANT_LAYER_KEYS,
@@ -111,6 +112,11 @@ class ModelRunner:
         model_cfg: Optional[LlamaConfig] = None,
         mesh=None,
     ):
+        t_init = time.perf_counter()
+        # Distinct per-runner telemetry scope: jit caches are per-runner, so
+        # a fresh runner's first dispatches are real compiles even when an
+        # earlier runner in this process saw identical bucket shapes.
+        self._tel_scope = next_runner_scope()
         self.cfg = cfg
         self.model_cfg = model_cfg or get_model_config(cfg.model)
         self.model = Llama(self.model_cfg)
@@ -197,6 +203,14 @@ class ModelRunner:
         param_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
         logger.info(
             "params ready: %.2f GiB total, %.1fs", param_bytes / 2**30, time.time() - t0
+        )
+        # Startup decomposition, phase 1: parameter materialization
+        # (pst_engine_startup_seconds{phase="load"}).
+        t_load_end = time.perf_counter()
+        ENGINE_TELEMETRY.record_startup_phase("load", t_load_end - t_init)
+        ENGINE_TELEMETRY.set_model_info(
+            self.param_count,
+            device_kind=getattr(jax.local_devices()[0], "device_kind", None),
         )
 
         self.num_blocks = resolve_num_kv_blocks(
@@ -376,6 +390,11 @@ class ModelRunner:
         # otherwise interleave broadcasts, diverging the followers' XLA
         # program order from the primary's (collective deadlock).
         self._device_lock = threading.RLock()
+        # Startup decomposition, phase 2: device placement + KV-cache
+        # allocation + jit wiring (pst_engine_startup_seconds{phase="shard"}).
+        ENGINE_TELEMETRY.record_startup_phase(
+            "shard", time.perf_counter() - t_load_end
+        )
 
     # ------------------------------------------------------------------
     # Streamed param materialization (quantized presets)
@@ -623,10 +642,18 @@ class ModelRunner:
         toks = np.zeros((1, T), np.int32)
         toks[0, : len(token_ids)] = token_ids
         length = np.array([len(token_ids)], np.int32)
+        key = (self._tel_scope, "encode", T)
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("encode", (toks, length))
-            return self._dispatch_encode(toks, length)
+            out = self._dispatch_encode(toks, length)
+        ENGINE_TELEMETRY.record_dispatch(
+            "encode", key, time.perf_counter() - t0,
+            batch_bucket=f"t{T}", tokens=len(token_ids),
+            fill_ratio=len(token_ids) / max(T, 1),
+        )
+        return out
 
     def _dispatch_encode(self, toks: np.ndarray, length: np.ndarray) -> np.ndarray:
         if not hasattr(self, "_encode_fn"):
@@ -665,13 +692,30 @@ class ModelRunner:
         full sampling machinery (static fast path in ops/sampling.py)."""
         return all(s.sampling.greedy for s in seqs)
 
+    def _tel_key(
+        self, kind: str, batch: Dict[str, np.ndarray], extras: tuple = ()
+    ) -> tuple:
+        """Shape-bucket signature for compile detection: the padded array
+        shapes plus the static jit flags are exactly what keys the XLA
+        executable cache, so a fresh signature means a fresh compile."""
+        shapes = tuple(sorted((k, np.shape(v)) for k, v in batch.items()))
+        return (self._tel_scope, kind, shapes, extras)
+
     def execute_decode(self, seqs: List[Sequence]) -> np.ndarray:
         """One decode step per sequence. Returns packed sample rows
         [len(seqs), 1 or PACKED_WIDTH] (token [+ logprobs]; ops/sampling.py)."""
         batch = self._decode_batch(seqs)
-        return self._run(
-            batch, self._want_lp(seqs), self._all_greedy(seqs)
-        )[: len(seqs)]
+        want_lp, greedy = self._want_lp(seqs), self._all_greedy(seqs)
+        key = self._tel_key("decode", batch, (want_lp, greedy))
+        Bb = batch["kv_lens"].shape[0]
+        t0 = time.perf_counter()
+        rows = self._run(batch, want_lp, greedy)
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0,
+            batch_bucket=f"b{Bb}", tokens=len(seqs),
+            fill_ratio=len(seqs) / Bb,
+        )
+        return rows[: len(seqs)]
 
     def execute_decode_multi(self, seqs: List[Sequence], n_steps: int) -> np.ndarray:
         """Decode burst: ``n_steps`` tokens per sequence in one device call.
@@ -690,14 +734,21 @@ class ModelRunner:
             )
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
+        key = self._tel_key("decode", batch, (n_steps, want_lp, greedy))
+        Bb = batch["kv_lens"].shape[0]
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
                     "multi_step", (batch, n_steps, want_lp, greedy)
                 )
-            return self._dispatch_multi_step(
-                batch, n_steps, want_lp, greedy
-            )[: len(seqs)]
+            rows = self._dispatch_multi_step(batch, n_steps, want_lp, greedy)
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0,
+            batch_bucket=f"b{Bb}xn{n_steps}", tokens=len(seqs) * n_steps,
+            fill_ratio=len(seqs) / Bb,
+        )
+        return rows[: len(seqs)]
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """ONE device_put for the whole batch tree. Separate puts cost a
@@ -746,12 +797,25 @@ class ModelRunner:
             )
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
+        key = self._tel_key("decode", batch, (n_steps, want_lp, greedy))
+        Bb = batch["kv_lens"].shape[0]
+        bucket = f"b{Bb}xn{n_steps}"
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
                     "burst_start", (batch, n_steps, want_lp, greedy)
                 )
             self._dispatch_burst_start(batch, n_steps, want_lp, greedy)
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0,
+            batch_bucket=bucket, tokens=len(seqs) * n_steps,
+            fill_ratio=len(seqs) / Bb,
+        )
+        # Continuations re-dispatch the same executable: keep the signature
+        # so their step timings land in the same bucket without re-counting
+        # a compile.
+        self._burst_tel = (key, bucket, Bb, n_steps)
 
     def _dispatch_burst_start(
         self,
@@ -802,10 +866,21 @@ class ModelRunner:
         for i, s in enumerate(members):
             tables[i] = self._table_row(s, Wb)
             kv_lens[i] = 0 if s.is_finished else max(s.num_tokens, 1)
+        alive = sum(1 for s in members if not s.is_finished)
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("burst_cont", (tables, kv_lens))
-            return self._dispatch_burst_continue(tables, kv_lens)
+            rows = self._dispatch_burst_continue(tables, kv_lens)
+        tel = getattr(self, "_burst_tel", None)
+        if tel is not None:
+            key, bucket, rows_b, n = tel
+            ENGINE_TELEMETRY.record_dispatch(
+                "decode", key, time.perf_counter() - t0,
+                batch_bucket=bucket, tokens=alive * n,
+                fill_ratio=alive / max(rows_b, 1),
+            )
+        return rows
 
     def _dispatch_burst_continue(
         self, tables: np.ndarray, kv_lens: np.ndarray
@@ -854,11 +929,19 @@ class ModelRunner:
         """
         B, K = drafts.shape
         batch = self._spec_batch(seqs, drafts)
+        key = self._tel_key("spec_verify", batch, (K,))
+        Bb = batch["kv_lens"].shape[0]
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("spec_verify", batch)
             ids, sampled0 = self._dispatch_spec_verify(batch)
-            return ids[: len(seqs)], sampled0[: len(seqs)]
+        ENGINE_TELEMETRY.record_dispatch(
+            "spec_verify", key, time.perf_counter() - t0,
+            batch_bucket=f"b{Bb}xk{K}", tokens=len(seqs) * (K + 1),
+            fill_ratio=len(seqs) / Bb,
+        )
+        return ids[: len(seqs)], sampled0[: len(seqs)]
 
     def _spec_batch(
         self, seqs: List[Sequence], drafts: np.ndarray
@@ -979,15 +1062,25 @@ class ModelRunner:
         packed = _fetch(packed)
         return packed[:, :-1], packed[:, -1]
 
+    def _prefill_tel(
+        self, items: List[PrefillItem], batch: Dict[str, np.ndarray],
+        extras: tuple,
+    ) -> tuple:
+        """(shape key, bucket label, real tokens, fill ratio) for one
+        prefill step's telemetry."""
+        Bb, Tb = batch["tokens"].shape
+        real = sum(it.end - it.start for it in items)
+        return (
+            self._tel_key("prefill", batch, extras),
+            f"b{Bb}xt{Tb}",
+            real,
+            real / max(Bb * Tb, 1),
+        )
+
     def execute_prefill(self, item: PrefillItem) -> int:
         """Process one prefill chunk; returns the sampled token id (only
         meaningful when the chunk completes the prompt)."""
-        batch = self._prefill_batch([item])
-        return int(
-            self._run(
-                batch, self._want_lp([item.seq]), self._all_greedy([item.seq])
-            )[0, 0]
-        )
+        return int(self.execute_prefill_batch([item])[0, 0])
 
     def execute_prefill_batch(self, items: List[PrefillItem]) -> np.ndarray:
         """Prefill several chunks in one device call (rows padded to a
@@ -995,9 +1088,17 @@ class ModelRunner:
         [len(items), 1 or PACKED_WIDTH] (token [+ logprobs])."""
         seqs = [i.seq for i in items]
         batch = self._prefill_batch(items)
-        return self._run(
-            batch, self._want_lp(seqs), self._all_greedy(seqs)
-        )[: len(items)]
+        want_lp, greedy = self._want_lp(seqs), self._all_greedy(seqs)
+        key, bucket, real, fill = self._prefill_tel(
+            items, batch, (want_lp, greedy)
+        )
+        t0 = time.perf_counter()
+        rows = self._run(batch, want_lp, greedy)
+        ENGINE_TELEMETRY.record_dispatch(
+            "prefill", key, time.perf_counter() - t0,
+            batch_bucket=bucket, tokens=real, fill_ratio=fill,
+        )
+        return rows[: len(items)]
 
     def execute_prefill_batch_nofetch(self, items: List[PrefillItem]) -> None:
         """Dispatch a prefill step WITHOUT fetching its sampled tokens.
@@ -1010,10 +1111,18 @@ class ModelRunner:
         donated cache, so correctness is unaffected; the next fetching step
         transitively waits for all queued work."""
         batch = self._prefill_batch(items)
+        # nofetch steps compile as (want_lp=False, greedy=True) — the same
+        # executable a fetching greedy step uses.
+        key, bucket, real, fill = self._prefill_tel(items, batch, (False, True))
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("step_nofetch", batch)
             self._dispatch_step_nofetch(batch)
+        ENGINE_TELEMETRY.record_dispatch(
+            "prefill", key, time.perf_counter() - t0,
+            batch_bucket=bucket, tokens=real, fill_ratio=fill,
+        )
 
     def _dispatch_step_nofetch(self, batch: Dict[str, np.ndarray]) -> None:
         # greedy=True: nobody reads an intermediate chunk's sample, so the
@@ -1031,6 +1140,10 @@ class ModelRunner:
         batch = self._prefill_batch(items)
         want_lp = self._want_lp([i.seq for i in items])
         greedy = self._all_greedy([i.seq for i in items])
+        key, bucket, real, fill = self._prefill_tel(
+            items, batch, (want_lp, greedy)
+        )
+        t0 = time.perf_counter()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("step", (batch, want_lp, greedy))
@@ -1038,6 +1151,10 @@ class ModelRunner:
             toks, self.kv_cache = self._step(
                 self.params, self.kv_cache, dev, want_lp, greedy
             )
+        ENGINE_TELEMETRY.record_dispatch(
+            "prefill", key, time.perf_counter() - t0,
+            batch_bucket=bucket, tokens=real, fill_ratio=fill,
+        )
         try:
             toks.copy_to_host_async()
         except Exception:  # pragma: no cover

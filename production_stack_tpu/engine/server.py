@@ -38,7 +38,13 @@ from prometheus_client import (
 
 from .. import __version__
 from ..logging_utils import init_logger
-from ..obs import SpanRecorder, debug_requests_response, render_obs_metrics
+from ..obs import (
+    ENGINE_TELEMETRY,
+    SpanRecorder,
+    debug_requests_response,
+    render_engine_telemetry,
+    render_obs_metrics,
+)
 from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
 from ..protocols import (
     ChatCompletionRequest,
@@ -387,6 +393,8 @@ def create_engine_app(
     cross_encoder=None,
     tracing: bool = True,
     debug_requests_buffer: int = 256,
+    profiling: bool = False,
+    profile_dir: str = "/tmp/pst_profiles",
 ) -> web.Application:
     # Everything except unauthenticated probe/scrape endpoints is guarded
     # when --api-key is set (/sleep in particular is destructive). Enforced
@@ -476,6 +484,16 @@ def create_engine_app(
             trace.record_span("prefill", prefill_time, end_mono=end_prefill)
         if decode_time is not None:
             trace.record_span("decode", decode_time, end_mono=now)
+
+    def _attach_compile_events(request: web.Request, events) -> None:
+        """Surface the XLA compiles a step absorbed as `compile` span
+        events on the victim request's trace: the BENCH_r05 120 s p99 was
+        a mid-run recompile no timeline could attribute."""
+        trace = request.get("trace")
+        if trace is None or not events:
+            return
+        for ev in events:
+            trace.add_event("compile", **ev)
 
     def _lora_names() -> List[str]:
         mgr = engine.engine.lora_manager
@@ -747,6 +765,8 @@ def create_engine_app(
                 async for out in gen:
                     n_out = out.num_output_tokens
                     last_out = out
+                    if out.compile_events:
+                        _attach_compile_events(request, out.compile_events)
                     if out.num_output_tokens == 1 and out.ttft is not None:
                         metrics.ttft.observe(out.ttft)
                     lp_obj = None
@@ -838,6 +858,7 @@ def create_engine_app(
             request, result["queue_time"], result["prefill_time"],
             result["decode_time"],
         )
+        _attach_compile_events(request, result.get("compile_events"))
         usage = {
             "prompt_tokens": len(ids),
             "completion_tokens": len(result["token_ids"]),
@@ -862,6 +883,7 @@ def create_engine_app(
         text_parts: List[str] = []
         token_ids: List[int] = []
         lp_entries: List[dict] = []
+        compile_events: List[dict] = []
         finish_reason = None
         queue_time = prefill_time = decode_time = None
         async for out in gen:
@@ -871,6 +893,8 @@ def create_engine_app(
             token_ids.extend(out.new_token_ids)
             if out.logprobs:
                 lp_entries.extend(out.logprobs)
+            if out.compile_events:
+                compile_events.extend(out.compile_events)
             finish_reason = out.finish_reason or finish_reason
             queue_time = out.queue_time if out.queue_time is not None else queue_time
             prefill_time = (
@@ -883,7 +907,7 @@ def create_engine_app(
             "text": "".join(text_parts), "token_ids": token_ids,
             "logprobs": lp_entries, "finish_reason": finish_reason,
             "queue_time": queue_time, "prefill_time": prefill_time,
-            "decode_time": decode_time,
+            "decode_time": decode_time, "compile_events": compile_events,
         }
 
     def _build_choice(req, result, index, is_chat, echo, prompt_ids) -> dict:
@@ -961,6 +985,7 @@ def create_engine_app(
             request, results[0]["queue_time"], results[0]["prefill_time"],
             results[0]["decode_time"],
         )
+        _attach_compile_events(request, results[0].get("compile_events"))
         # OpenAI bills EVERY best_of candidate in completion_tokens.
         sampled_tokens = sum(len(r["token_ids"]) for r in results)
         if rank:
@@ -1153,13 +1178,79 @@ def create_engine_app(
         )
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
-        metrics.refresh(engine.engine.stats())
+        stats = engine.engine.stats()
+        metrics.refresh(stats)
+        # KV occupancy / high watermark + preemption/swap counters for the
+        # pst_engine_* surface refresh from the same stats snapshot.
+        ENGINE_TELEMETRY.refresh_from_stats(stats)
         # pst_stage_duration_seconds lives in the shared observability
-        # registry (docs/observability.md) — append it to the engine's own.
+        # registry and pst_engine_* in the engine-telemetry registry
+        # (docs/observability.md) — append both to the engine's own.
         return web.Response(
-            body=generate_latest(metrics.registry) + render_obs_metrics(),
+            body=generate_latest(metrics.registry)
+            + render_obs_metrics()
+            + render_engine_telemetry(),
             content_type="text/plain",
         )
+
+    # On-demand profiling state: one capture at a time (jax.profiler is a
+    # process-global singleton — a second start_trace would raise).
+    profile_lock = asyncio.Lock()
+
+    async def debug_profile(request: web.Request) -> web.Response:
+        """Capture a ``jax.profiler`` trace for N ms into a directory
+        (``--profile-dir``; TensorBoard-loadable). Guarded twice: the
+        ``--profiling`` flag must be on, and when an API key is configured
+        the endpoint requires it like the work endpoints. On CPU backends
+        this is a graceful no-op — there is no device timeline worth the
+        capture overhead."""
+        if not profiling:
+            return _error(
+                "profiling is disabled (start the engine with --profiling)",
+                403, "permission_error",
+            )
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001 — empty/garbage body = defaults
+                body = {}
+        if not isinstance(body, dict):  # e.g. a bare JSON list
+            body = {}
+        try:
+            duration_ms = float(
+                body.get("duration_ms")
+                or request.query.get("duration_ms", 1000)
+            )
+        except (TypeError, ValueError):
+            return _error("duration_ms must be a number")
+        duration_ms = min(max(duration_ms, 10.0), 60_000.0)
+        out_dir = str(body.get("dir") or profile_dir)
+
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return web.json_response({
+                "status": "skipped",
+                "reason": "no accelerator backend (cpu) — nothing to profile",
+                "duration_ms": duration_ms,
+            })
+        if profile_lock.locked():
+            return _error("a profile capture is already running", 409,
+                          "conflict_error")
+        async with profile_lock:
+            import os
+
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            try:
+                await asyncio.sleep(duration_ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        logger.info("profile captured: %.0f ms -> %s", duration_ms, out_dir)
+        return web.json_response({
+            "status": "ok", "dir": out_dir, "duration_ms": duration_ms,
+        })
 
     async def debug_requests(request: web.Request) -> web.Response:
         """Engine-side timeline ring buffer (same shape as the router's
@@ -1258,6 +1349,7 @@ def create_engine_app(
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
@@ -1367,6 +1459,19 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--debug-requests-buffer", type=int, default=256,
                    help="completed request timelines kept for "
                         "GET /debug/requests (0 disables the endpoint)")
+    # On-demand jax.profiler capture (docs/observability.md "Profiling").
+    p.add_argument("--profiling", dest="profiling", action="store_true",
+                   default=False,
+                   help="enable POST /debug/profile (on-demand jax.profiler "
+                        "trace capture; no-op on CPU backends)")
+    p.add_argument("--profile-dir", default="/tmp/pst_profiles",
+                   help="directory POST /debug/profile writes traces to")
+    # Startup-phase decomposition (pst_engine_startup_seconds{phase}).
+    p.add_argument("--startup-phases", dest="startup_phases",
+                   action="store_true", default=True)
+    p.add_argument("--no-startup-phases", dest="startup_phases",
+                   action="store_false",
+                   help="do not export pst_engine_startup_seconds")
     return p.parse_args(argv)
 
 
@@ -1461,6 +1566,9 @@ def main(argv=None) -> None:
 
     args = parse_engine_args(argv)
     cfg = engine_config_from_args(args)
+    # Must be set before the engine constructs: the runner records the
+    # load/shard phases during __init__.
+    ENGINE_TELEMETRY.startup_enabled = args.startup_phases
 
     # Optional error reporting + tracing (no-ops without the SDKs; OTel
     # activates via the standard OTEL_* env contract the chart wires in).
@@ -1498,6 +1606,8 @@ def main(argv=None) -> None:
         engine, api_key=args.api_key, cross_encoder=cross_encoder,
         tracing=args.tracing,
         debug_requests_buffer=args.debug_requests_buffer,
+        profiling=args.profiling,
+        profile_dir=args.profile_dir,
     )
 
     async def on_startup(app):

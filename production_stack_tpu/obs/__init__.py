@@ -16,6 +16,13 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .engine_telemetry import (
+    ENGINE_TELEMETRY,
+    ENGINE_TELEMETRY_REGISTRY,
+    EngineTelemetry,
+    next_runner_scope,
+    render_engine_telemetry,
+)
 from .http import debug_requests_response
 from .metrics import OBS_REGISTRY, observe_stage, render_obs_metrics
 from .tracing import (
@@ -54,6 +61,9 @@ def teardown_request_tracing() -> None:
 
 
 __all__ = [
+    "ENGINE_TELEMETRY",
+    "ENGINE_TELEMETRY_REGISTRY",
+    "EngineTelemetry",
     "NOOP_SPAN",
     "NOOP_TRACE",
     "OBS_REGISTRY",
@@ -68,8 +78,10 @@ __all__ = [
     "initialize_request_tracing",
     "new_span_id",
     "new_trace_id",
+    "next_runner_scope",
     "observe_stage",
     "parse_traceparent",
+    "render_engine_telemetry",
     "render_obs_metrics",
     "teardown_request_tracing",
 ]

@@ -1,0 +1,339 @@
+"""TPU-engine telemetry: compiles, step durations, MFU, KV pressure.
+
+The metrics PR 3 could not give the engine: everything here is fed from
+the *device-dispatch* layer (``engine/runner.py``) and the scheduler, so a
+mid-run XLA recompile, a padding-wasteful batch, or a slow startup phase
+becomes a Prometheus series instead of a mystery p99 outlier (BENCH_r05's
+120 s TTFT was exactly such a recompile, invisible to every existing
+metric).
+
+Compile detection is the first-call-per-bucket heuristic the static-shape
+design makes sound: the runner pads every step into a small set of bucket
+shapes and ``jax.jit`` caches one executable per bucket, so the FIRST
+dispatch of a (kind, bucket, static-flags) signature is the one that pays
+tracing + XLA compilation — its wall time is recorded as the compile cost
+and the event is queued so the engine can attach it to the victim
+request's trace (a recompile shows up *inside* the request timeline that
+absorbed it).
+
+Like :data:`..obs.metrics.OBS_REGISTRY`, everything lives in a dedicated
+registry appended to the engine's ``/metrics`` — the router never double
+registers it, and the fake engine can serve the same names as plain text.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+ENGINE_TELEMETRY_REGISTRY = CollectorRegistry()
+
+# Compile times span "re-trace only" (~100 ms) to multi-minute 8B builds.
+_COMPILE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                    120.0, 300.0)
+# Step times span sub-ms CPU toys to 100 s cold 20k prefills.
+_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 120.0)
+_FILL_BUCKETS = (0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+compile_total = Counter(
+    "pst_engine_compile",
+    "XLA compilations observed at jitted dispatch (first call per shape "
+    "bucket), by step kind and padded shape bucket",
+    ["kind", "shape_bucket"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+compile_seconds = Histogram(
+    "pst_engine_compile_seconds",
+    "Wall time of compile-bearing dispatches (trace + XLA build + first "
+    "execution), by step kind",
+    ["kind"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+    buckets=_COMPILE_BUCKETS,
+)
+step_duration = Histogram(
+    "pst_engine_step_duration_seconds",
+    "Device step wall time (dispatch to fetch), by step kind and padded "
+    "batch bucket; compile-bearing first calls excluded",
+    ["kind", "batch_bucket"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+    buckets=_STEP_BUCKETS,
+)
+batch_fill_ratio = Histogram(
+    "pst_engine_batch_fill_ratio",
+    "Useful fraction of each padded device step (real rows*tokens over "
+    "padded rows*tokens) — 1.0 means zero padding waste",
+    ["kind"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+    buckets=_FILL_BUCKETS,
+)
+tokens_per_second = Gauge(
+    "pst_engine_tokens_per_second",
+    "Engine token throughput over a short sliding window, by step kind",
+    ["kind"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+mfu_gauge = Gauge(
+    "pst_engine_mfu",
+    "Model-FLOPs utilization estimate: 2 * params * tokens/s over the "
+    "accelerator's peak FLOPs",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+kv_page_occupancy = Gauge(
+    "pst_engine_kv_page_occupancy",
+    "Fraction of HBM KV pages in use",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+kv_page_high_watermark = Gauge(
+    "pst_engine_kv_page_high_watermark",
+    "Highest KV page occupancy fraction observed since engine start",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+preemptions_total = Counter(
+    "pst_engine_preemptions",
+    "Scheduler recompute preemptions (out of KV pages)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+swap_out_total = Counter(
+    "pst_engine_swap_out",
+    "Sequences swapped out by the scheduler (KV parked host-side)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+swap_in_total = Counter(
+    "pst_engine_swap_in",
+    "Sequences swapped back in by the scheduler (KV resumed)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+start_time_seconds = Gauge(
+    "pst_engine_start_time_seconds",
+    "Wall-clock time the engine's runner initialized (the alert rules "
+    "gate recompile alerts on uptime so cold-start compiles never page)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+startup_seconds = Gauge(
+    "pst_engine_startup_seconds",
+    "Engine startup decomposition: load (param materialization), shard "
+    "(device placement + KV alloc + jit wiring), warmup (tokenizer, "
+    "allocator, scheduler)",
+    ["phase"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+
+# Peak FLOPs per chip for the MFU denominator (public specs, bf16 MXU).
+_PEAK_FLOPS_BY_DEVICE_KIND = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+_DEFAULT_PEAK_FLOPS = 197e12
+
+# Fresh runners must re-count compiles even when an earlier runner in the
+# same process already compiled identical bucket shapes (jit caches are
+# per-runner): each ModelRunner takes a distinct scope id into its keys.
+_runner_scope = itertools.count()
+
+
+def next_runner_scope() -> int:
+    return next(_runner_scope)
+
+
+class EngineTelemetry:
+    """Process-wide sink the runner/scheduler/server feed.
+
+    Thread-safe: dispatches run on the engine step thread and executor
+    threads while ``/metrics`` refreshes from the asyncio loop.
+    """
+
+    _TOKEN_WINDOW_S = 10.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen_shapes: set = set()
+        self._pending_compile_events: List[dict] = []
+        self._compiles = 0
+        # (monotonic, kind, tokens) samples for the throughput window.
+        self._tok_samples: "deque[Tuple[float, str, int]]" = deque()
+        # Kinds that ever reported tokens: their gauges must drop to 0
+        # when the window empties instead of freezing at the last burst.
+        self._tok_kinds: set = set()
+        self._counter_last: Dict[str, float] = {}
+        self._kv_hwm = 0.0
+        self.param_count = 0
+        self.peak_flops = _DEFAULT_PEAK_FLOPS
+        # --no-startup-phases: the gauges stay at 0 (helm
+        # servingEngineSpec.observability.startupPhases).
+        self.startup_enabled = True
+
+    # -- model / startup ------------------------------------------------
+
+    def set_model_info(
+        self, param_count: int, device_kind: Optional[str] = None,
+        peak_flops: Optional[float] = None,
+    ) -> None:
+        self.param_count = int(param_count)
+        self.peak_flops = peak_flops or _PEAK_FLOPS_BY_DEVICE_KIND.get(
+            device_kind or "", _DEFAULT_PEAK_FLOPS
+        )
+        start_time_seconds.set(time.time())
+
+    def record_startup_phase(self, phase: str, seconds: float) -> None:
+        if not self.startup_enabled:
+            return
+        startup_seconds.labels(phase=phase).set(max(seconds, 0.0))
+
+    # -- dispatch-level telemetry ---------------------------------------
+
+    def record_dispatch(
+        self,
+        kind: str,
+        shape_key: tuple,
+        seconds: float,
+        *,
+        batch_bucket: str,
+        tokens: int = 0,
+        fill_ratio: Optional[float] = None,
+    ) -> bool:
+        """Record one device dispatch; returns True when this was the
+        first call for its shape bucket (i.e. it paid a compile)."""
+        seconds = max(seconds, 0.0)
+        with self._lock:
+            compiled = shape_key not in self._seen_shapes
+            if compiled:
+                self._seen_shapes.add(shape_key)
+                self._compiles += 1
+                self._pending_compile_events.append({
+                    "kind": kind,
+                    "shape_bucket": batch_bucket,
+                    "seconds": round(seconds, 3),
+                })
+            if tokens > 0:
+                now = time.monotonic()
+                self._tok_samples.append((now, kind, tokens))
+                self._refresh_throughput_locked(now)
+        if compiled:
+            compile_total.labels(kind=kind, shape_bucket=batch_bucket).inc()
+            compile_seconds.labels(kind=kind).observe(seconds)
+        else:
+            # Compile-bearing calls are excluded from the step histogram so
+            # its percentiles describe steady-state steps, not XLA builds.
+            step_duration.labels(
+                kind=kind, batch_bucket=batch_bucket
+            ).observe(seconds)
+        if fill_ratio is not None:
+            batch_fill_ratio.labels(kind=kind).observe(
+                min(max(fill_ratio, 0.0), 1.0)
+            )
+        return compiled
+
+    def _refresh_throughput_locked(self, now: float) -> None:
+        cutoff = now - self._TOKEN_WINDOW_S
+        while self._tok_samples and self._tok_samples[0][0] < cutoff:
+            self._tok_samples.popleft()
+        per_kind: Dict[str, int] = {}
+        total = 0
+        for _, kind, toks in self._tok_samples:
+            self._tok_kinds.add(kind)
+            per_kind[kind] = per_kind.get(kind, 0) + toks
+            total += toks
+        span = (
+            max(now - self._tok_samples[0][0], 0.5)
+            if self._tok_samples else 1.0
+        )
+        # Kinds with no samples left in the window read 0, not their last
+        # burst's value — an idle engine must look idle.
+        for kind in self._tok_kinds:
+            tokens_per_second.labels(kind=kind).set(
+                per_kind.get(kind, 0) / span
+            )
+        if self.param_count and self.peak_flops:
+            mfu_gauge.set(
+                2.0 * self.param_count * (total / span) / self.peak_flops
+            )
+
+    # -- compile events → request traces --------------------------------
+
+    def drain_compile_events(self) -> List[dict]:
+        """Compile events recorded since the last drain (the engine
+        attaches them to the step's in-flight request traces)."""
+        with self._lock:
+            events, self._pending_compile_events = (
+                self._pending_compile_events, []
+            )
+        return events
+
+    def compile_count(self) -> int:
+        """Total compiles observed since process start (bench.py snapshots
+        this around each qps point to flag recompile-polluted sweeps)."""
+        with self._lock:
+            return self._compiles
+
+    # -- scheduler / KV refresh (from LLMEngine.stats()) ----------------
+
+    def _counter_to(self, counter, key: str, total: float) -> None:
+        last = self._counter_last.get(key, 0.0)
+        if total > last:
+            counter.inc(total - last)
+            self._counter_last[key] = total
+        elif total < last:  # in-process reset: re-baseline
+            if total > 0:
+                counter.inc(total)
+            self._counter_last[key] = total
+
+    def refresh_from_stats(self, stats: dict) -> None:
+        occ = float(stats.get("kv_cache_usage_perc", 0.0))
+        kv_page_occupancy.set(occ)
+        with self._lock:
+            # /metrics scrapes keep the throughput window honest even when
+            # no dispatch has run since the last burst.
+            self._refresh_throughput_locked(time.monotonic())
+            self._kv_hwm = max(self._kv_hwm, occ)
+            hwm = self._kv_hwm
+        kv_page_high_watermark.set(hwm)
+        self._counter_to(
+            preemptions_total, "preempt",
+            float(stats.get("num_preemptions_total", 0.0)),
+        )
+        self._counter_to(
+            swap_out_total, "swap_out",
+            float(stats.get("kv_swap_out_total", 0.0)),
+        )
+        self._counter_to(
+            swap_in_total, "swap_in",
+            float(stats.get("kv_swap_in_total", 0.0)),
+        )
+
+    # -- tests ----------------------------------------------------------
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._seen_shapes.clear()
+            self._pending_compile_events.clear()
+            self._compiles = 0
+            self._tok_samples.clear()
+            self._tok_kinds.clear()
+            self._counter_last.clear()
+            self._kv_hwm = 0.0
+            self.startup_enabled = True
+
+
+ENGINE_TELEMETRY = EngineTelemetry()
+
+
+def render_engine_telemetry() -> bytes:
+    """Prometheus exposition of the engine telemetry registry — appended
+    to the engine's ``/metrics`` next to ``render_obs_metrics()``."""
+    return generate_latest(ENGINE_TELEMETRY_REGISTRY)

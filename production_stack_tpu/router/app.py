@@ -56,7 +56,13 @@ from .stats.request_stats import (
     get_request_stats_monitor,
     initialize_request_stats_monitor,
 )
+from .services import metrics_service
 from .services.callbacks import configure_custom_callbacks
+from .services.canary import (
+    get_canary_prober,
+    initialize_canary_prober,
+    teardown_canary_prober,
+)
 from .services.rewriter import initialize_request_rewriter
 from .experimental.feature_gates import (
     PII_DETECTION,
@@ -300,6 +306,16 @@ def initialize_all(app: web.Application, args) -> None:
         enabled=getattr(args, "tracing", True),
         buffer=getattr(args, "debug_requests_buffer", 256),
     )
+    # SLO counters (pst_slo_*) measure against this TTFT target; the canary
+    # prober starts with the event loop in on_startup.
+    metrics_service.configure_slo(getattr(args, "slo_ttft_ms", 0.0))
+    initialize_canary_prober(
+        getattr(args, "canary_interval", 0.0),
+        timeout=getattr(args, "canary_timeout", 5.0),
+        # The fleet shares one key (helm apiKeySecret): probes must
+        # authenticate to engines like real proxied traffic does.
+        api_key=getattr(args, "api_key", None),
+    )
     initialize_request_rewriter(args.request_rewriter)
     configure_custom_callbacks(args.callbacks)
     initialize_feature_gates(args.feature_gates)
@@ -349,6 +365,9 @@ def create_app(args) -> web.Application:
         )
         await get_service_discovery().start()
         await get_engine_stats_scraper().start()
+        prober = get_canary_prober()
+        if prober is not None:
+            await prober.start()
         if args.log_stats:
             app["log_stats_task"] = asyncio.create_task(
                 _log_stats_loop(app, args.log_stats_interval)
@@ -375,6 +394,10 @@ def create_app(args) -> web.Application:
         watcher = app.get("dynamic_config_watcher")
         if watcher is not None:
             watcher.close()
+        prober = get_canary_prober()
+        if prober is not None:
+            await prober.close()
+        teardown_canary_prober()
         get_engine_stats_scraper().close()
         teardown_service_discovery()
         try:  # routers holding a long-lived client (kvaware) close it here

@@ -142,6 +142,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="completed request timelines kept for "
                         "GET /debug/requests (0 disables the endpoint)")
 
+    # SLO + canary layer (docs/observability.md "SLOs & alerting"):
+    # pst_slo_* counters against the TTFT target, and a per-engine
+    # synthetic-probe TTFT gauge the burn-rate alert rules read.
+    p.add_argument("--slo-ttft-ms", type=float, default=200.0,
+                   help="TTFT objective for pst_slo_ttft_within_target / "
+                        "pst_slo_requests counters (0 disables SLO "
+                        "accounting; default = the 200 ms north star)")
+    p.add_argument("--canary-interval", type=float, default=0.0,
+                   help="seconds between canary probes per engine "
+                        "(pst_canary_ttft_seconds; 0 = off)")
+    p.add_argument("--canary-timeout", type=float, default=5.0,
+                   help="per-probe timeout; a timed-out canary counts as "
+                        "a failure")
+
     # Stats / metrics
     p.add_argument("--engine-stats-interval", type=float, default=15.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -225,6 +239,12 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--default-deadline-ms must be >= 0")
     if args.debug_requests_buffer < 0:
         raise ValueError("--debug-requests-buffer must be >= 0")
+    if args.slo_ttft_ms < 0:
+        raise ValueError("--slo-ttft-ms must be >= 0")
+    if args.canary_interval < 0:
+        raise ValueError("--canary-interval must be >= 0")
+    if args.canary_timeout <= 0:
+        raise ValueError("--canary-timeout must be > 0")
     if args.hedge_max_outstanding_ratio < 0:
         raise ValueError("--hedge-max-outstanding-ratio must be >= 0")
     if not (0.0 < args.hedge_quantile < 1.0):

@@ -1,0 +1,167 @@
+"""Canary prober: synthetic per-engine TTFT, feeding the SLO/health view.
+
+A lightweight asyncio task (``--canary-interval``, 0 = off) sends one tiny
+streamed completion (``max_tokens=1``) to every discovered engine each
+interval and measures the time to the first SSE byte — the same signal a
+real request's TTFT rides, but emitted even when the engine is idle, so a
+cold decode path, a pending recompile, or a half-dead engine shows up in
+``pst_canary_ttft_seconds{engine}`` *before* a user request pays for it.
+
+Probe outcomes feed the existing breaker/health view: a successful probe
+records breaker success (it IS a live probe — exactly what a half-open
+breaker wants), a hard failure (connect error / 5xx) records breaker
+failure and increments ``pst_canary_failures_total``. Deliberate drain
+rejections and sleeping engines are skipped, not failed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import aiohttp
+
+from ...logging_utils import init_logger
+from ...resilience import get_breaker_registry
+from ..service_discovery import get_service_discovery
+from . import metrics_service as gauges
+
+logger = init_logger(__name__)
+
+# Marks probe traffic so engines/operators can tell it from user load.
+CANARY_HEADER = "X-PST-Canary"
+
+
+class CanaryProber:
+    def __init__(
+        self,
+        interval: float,
+        timeout: float = 5.0,
+        prompt: str = "ping",
+        api_key: Optional[str] = None,
+    ):
+        self.interval = interval
+        self.timeout = timeout
+        self.prompt = prompt
+        # The fleet shares one api key (helm apiKeySecret wires the same
+        # secret into engines and router): probes must authenticate like
+        # real traffic or every probe on a protected fleet would 401.
+        self.api_key = api_key
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        # Probes completed / failed (tests + /health introspection).
+        self.probes_total = 0
+        self.failures_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval > 0
+
+    async def start(self) -> None:
+        if not self.enabled or self._task is not None:
+            return
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout)
+        )
+        self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                endpoints = get_service_discovery().get_endpoint_info()
+                await asyncio.gather(
+                    *(self._probe_one(ep) for ep in endpoints)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — probing is best-effort
+                logger.debug("canary sweep failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    async def _probe_one(self, ep) -> None:
+        if getattr(ep, "sleep", False) or getattr(ep, "draining", False):
+            return
+        model = ep.model_names[0] if ep.model_names else ""
+        body = {
+            "model": model,
+            "prompt": self.prompt,
+            "max_tokens": 1,
+            "temperature": 0.0,
+            "stream": True,
+        }
+        registry = get_breaker_registry()
+        headers = {CANARY_HEADER: "1"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        t0 = time.monotonic()
+        try:
+            async with self._session.post(
+                f"{ep.url}/v1/completions",
+                json=body,
+                headers=headers,
+            ) as resp:
+                if resp.status == 503 and "X-PST-Draining" in resp.headers:
+                    return  # deliberate drain rejection: not a failure
+                if resp.status >= 400:
+                    # Any error is a failed probe (a 401/404 error body's
+                    # latency is NOT a TTFT sample), but only 5xx feeds
+                    # the breaker: a misconfigured probe (bad key, model
+                    # name mismatch) must never close an OPEN breaker via
+                    # record_success nor open a healthy engine's breaker.
+                    self.failures_total += 1
+                    gauges.canary_failures_total.labels(engine=ep.url).inc()
+                    if registry is not None and resp.status >= 500:
+                        registry.record_failure(ep.url)
+                    logger.debug(
+                        "canary probe got %d from %s", resp.status, ep.url
+                    )
+                    return
+                # Time-to-first-byte is the probe's TTFT; drain the rest so
+                # the connection returns to the pool cleanly.
+                ttft = None
+                async for _ in resp.content.iter_any():
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+            gauges.canary_ttft_seconds.labels(engine=ep.url).set(ttft)
+            self.probes_total += 1
+            if registry is not None:
+                registry.record_success(ep.url)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a dead engine is the signal
+            self.failures_total += 1
+            gauges.canary_failures_total.labels(engine=ep.url).inc()
+            if registry is not None:
+                registry.record_failure(ep.url)
+            logger.debug("canary probe failed for %s: %s", ep.url, e)
+
+
+_canary_prober: Optional[CanaryProber] = None
+
+
+def initialize_canary_prober(
+    interval: float, timeout: float = 5.0, api_key: Optional[str] = None
+) -> CanaryProber:
+    global _canary_prober
+    _canary_prober = CanaryProber(interval, timeout=timeout, api_key=api_key)
+    return _canary_prober
+
+
+def get_canary_prober() -> Optional[CanaryProber]:
+    return _canary_prober
+
+
+def teardown_canary_prober() -> None:
+    global _canary_prober
+    _canary_prober = None

@@ -4,9 +4,17 @@ Capability parity with the reference's
 ``src/vllm_router/services/metrics_service/__init__.py:1-47``. Gauge names
 keep the ``vllm:`` prefix so the reference Grafana dashboards
 (observability/) work against this stack unchanged.
+
+Also home of the fleet SLO surface (docs/observability.md "SLOs &
+alerting"): ``pst_slo_*`` counters turn the BASELINE TTFT target into a
+machine-checked ratio the generated ``observability/prometheus-rules.yaml``
+burn-rate alerts page on, and ``pst_canary_*`` carries the canary
+prober's per-engine synthetic TTFT.
 """
 
-from prometheus_client import Gauge
+from typing import Optional
+
+from prometheus_client import Counter, Gauge
 
 num_requests_running = Gauge(
     "vllm:num_requests_running", "Number of running requests", ["server"]
@@ -51,3 +59,64 @@ num_requests_swapped = Gauge(
 router_cpu_percent = Gauge("pst_router:cpu_percent", "Router process CPU percent")
 router_memory_mb = Gauge("pst_router:memory_mb", "Router process RSS (MB)")
 router_disk_percent = Gauge("pst_router:disk_percent", "Router disk usage percent")
+
+# ---------------------------------------------------------------------------
+# Fleet SLO surface (docs/observability.md "SLOs & alerting")
+# ---------------------------------------------------------------------------
+
+slo_requests_total = Counter(
+    "pst_slo_requests",
+    "Generation requests counted against the TTFT SLO (first upstream "
+    "byte observed, or terminal upstream failure)",
+    ["model"],
+)
+slo_ttft_within_target_total = Counter(
+    "pst_slo_ttft_within_target",
+    "Generation requests whose router-observed TTFT met the configured "
+    "target (--slo-ttft-ms)",
+    ["model"],
+)
+canary_ttft_seconds = Gauge(
+    "pst_canary_ttft_seconds",
+    "Latest canary-probe TTFT per engine (synthetic 1-token completion)",
+    ["engine"],
+)
+canary_failures_total = Counter(
+    "pst_canary_failures",
+    "Canary probes that failed outright (connect error or 5xx)",
+    ["engine"],
+)
+
+# Configured at router bootstrap (--slo-ttft-ms; 0 disables the counters).
+_slo_ttft_target_s: Optional[float] = None
+
+
+def configure_slo(ttft_target_ms: float) -> None:
+    global _slo_ttft_target_s
+    _slo_ttft_target_s = (
+        ttft_target_ms / 1000.0 if ttft_target_ms and ttft_target_ms > 0
+        else None
+    )
+
+
+def slo_ttft_target_s() -> Optional[float]:
+    return _slo_ttft_target_s
+
+
+def observe_slo_ttft(model: Optional[str], seconds: float) -> None:
+    """One request reached its first upstream byte: count it, and count it
+    as within-target when the router-observed TTFT met the objective."""
+    if _slo_ttft_target_s is None:
+        return
+    m = str(model) if model else "unknown"
+    slo_requests_total.labels(model=m).inc()
+    if seconds <= _slo_ttft_target_s:
+        slo_ttft_within_target_total.labels(model=m).inc()
+
+
+def observe_slo_failure(model: Optional[str]) -> None:
+    """A request failed before producing a first byte (exhausted failover,
+    upstream 5xx): it consumed error budget without a TTFT sample."""
+    if _slo_ttft_target_s is None:
+        return
+    slo_requests_total.labels(model=str(model) if model else "unknown").inc()

@@ -86,6 +86,7 @@ from ..service_discovery import get_service_discovery
 from ..stats.engine_stats import get_engine_stats_scraper
 from ..stats.request_stats import get_request_stats_monitor
 from .callbacks import get_custom_callback_handler
+from .metrics_service import observe_slo_failure, observe_slo_ttft
 from .rewriter import get_request_rewriter
 
 logger = init_logger(__name__)
@@ -252,6 +253,15 @@ async def proxy_and_stream(
     tried = {url}
     attempt = 0
     streaming = bool(parsed.get("stream"))
+
+    # SLO accounting (docs/observability.md "SLOs & alerting"): the
+    # router-observed TTFT — proxy entry to the first upstream byte of the
+    # winning attempt, retries and backoff included, because that is what
+    # the client experienced. Counted once per request.
+    slo_eligible = endpoint in ("/v1/completions", "/v1/chat/completions")
+    slo_model = parsed.get("model") if isinstance(parsed, dict) else None
+    slo_t0 = time.monotonic()
+    slo_done = False
 
     completed = False
 
@@ -423,6 +433,17 @@ async def proxy_and_stream(
                         if first_byte:
                             attempt_span.add_event("first_byte")
                             first_byte = False
+                            if slo_eligible and not slo_done:
+                                # A first byte of an error body is not a
+                                # first token: it burns error budget.
+                                slo_done = True
+                                if ok and upstream.status < 400:
+                                    observe_slo_ttft(
+                                        slo_model,
+                                        time.monotonic() - slo_t0,
+                                    )
+                                else:
+                                    observe_slo_failure(slo_model)
                         if journal is not None:
                             chunk = journal.feed(chunk)
                             if not chunk:
@@ -541,6 +562,11 @@ async def proxy_and_stream(
                 logger.error("backend %s failed for %s: %s", url, request_id, e)
                 attempt_span.set_attribute("outcome", "error")
                 attempt_span.end()
+                if slo_eligible and not slo_done:
+                    # Exhausted failover with zero bytes delivered: the
+                    # request burns error budget (no TTFT sample exists).
+                    slo_done = True
+                    observe_slo_failure(slo_model)
                 return _error_response(502, f"backend error: {e}", "bad_gateway")
             logger.warning(
                 "backend %s unreachable for %s (%s); failing over to %s",

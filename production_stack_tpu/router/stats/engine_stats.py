@@ -29,7 +29,17 @@ _METRIC_FIELDS = {
     "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
     "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
     "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+    # Engine telemetry (docs/observability.md "Engine telemetry").
+    "pst_engine_compile_total": "engine_compiles_total",
+    "pst_engine_mfu": "engine_mfu",
+    "pst_engine_kv_page_occupancy": "engine_kv_page_occupancy",
+    "pst_engine_kv_page_high_watermark": "engine_kv_page_high_watermark",
 }
+
+# Labeled counters summed over their label sets (pst_engine_compile_total
+# has one sample per {kind, shape_bucket}); everything else is a single
+# sample and the last value wins.
+_SUMMED_FIELDS = {"engine_compiles_total"}
 
 
 @dataclass
@@ -40,21 +50,47 @@ class EngineStats:
     gpu_prefix_cache_hits_total: int = 0
     gpu_prefix_cache_queries_total: int = 0
     gpu_cache_usage_perc: float = 0.0
+    engine_compiles_total: int = 0
+    engine_mfu: float = 0.0
+    engine_kv_page_occupancy: float = 0.0
+    engine_kv_page_high_watermark: float = 0.0
 
     @staticmethod
     def from_scrape(text: str) -> "EngineStats":
+        """Parse an engine's ``/metrics`` body into a snapshot.
+
+        NEVER raises: a partially-written scrape (engine restarting
+        mid-response) or a malformed line must degrade to whatever parsed
+        before the damage, not kill the scrape sweep — a fleet-wide stats
+        blackout because one engine emitted garbage would be worse than
+        the garbage.
+        """
         values: Dict[str, float] = {}
-        for family in text_string_to_metric_families(text):
-            for sample in family.samples:
-                field = _METRIC_FIELDS.get(sample.name)
-                if field is not None:
-                    values[field] = sample.value
+        try:
+            for family in text_string_to_metric_families(text):
+                for sample in family.samples:
+                    field = _METRIC_FIELDS.get(sample.name)
+                    if field is None:
+                        continue
+                    try:
+                        v = float(sample.value)
+                    except (TypeError, ValueError):
+                        continue
+                    if field in _SUMMED_FIELDS:
+                        values[field] = values.get(field, 0.0) + v
+                    else:
+                        values[field] = v
+        except Exception as e:  # noqa: BLE001 — keep what parsed so far
+            logger.debug("partial engine scrape parse: %s", e)
         stats = EngineStats()
         for field, value in values.items():
-            if field.startswith("num_") or field.endswith("_total"):
-                setattr(stats, field, int(value))
-            else:
-                setattr(stats, field, float(value))
+            try:
+                if field.startswith("num_") or field.endswith("_total"):
+                    setattr(stats, field, int(value))
+                else:
+                    setattr(stats, field, float(value))
+            except (TypeError, ValueError, OverflowError):
+                continue  # one bad sample never poisons the snapshot
         return stats
 
     # Back-compat alias with the reference's classmethod name.

@@ -387,6 +387,44 @@ def create_fake_engine_app(
                 f"vllm:gpu_prefix_cache_queries_total {state.prefix_queries}",
                 "# TYPE vllm:gpu_cache_usage_perc gauge",
                 f"vllm:gpu_cache_usage_perc {min(1.0, state.num_running * 0.1)}",
+                # Engine telemetry (docs/observability.md "Engine
+                # telemetry"): deterministic values so router-side SLO /
+                # scraper e2e tests run hermetically against the fake.
+                "# TYPE pst_engine_compile counter",
+                'pst_engine_compile_total{kind="prefill",shape_bucket="b1xt128"} 3',
+                'pst_engine_compile_total{kind="decode",shape_bucket="b4"} 2',
+                "# TYPE pst_engine_compile_seconds histogram",
+                'pst_engine_compile_seconds_bucket{kind="prefill",le="+Inf"} 3',
+                'pst_engine_compile_seconds_sum{kind="prefill"} 4.5',
+                'pst_engine_compile_seconds_count{kind="prefill"} 3',
+                "# TYPE pst_engine_step_duration_seconds histogram",
+                'pst_engine_step_duration_seconds_bucket{kind="decode",batch_bucket="b4",le="+Inf"} 10',
+                'pst_engine_step_duration_seconds_sum{kind="decode",batch_bucket="b4"} 0.5',
+                'pst_engine_step_duration_seconds_count{kind="decode",batch_bucket="b4"} 10',
+                "# TYPE pst_engine_batch_fill_ratio histogram",
+                'pst_engine_batch_fill_ratio_bucket{kind="decode",le="+Inf"} 10',
+                'pst_engine_batch_fill_ratio_sum{kind="decode"} 7.5',
+                'pst_engine_batch_fill_ratio_count{kind="decode"} 10',
+                "# TYPE pst_engine_tokens_per_second gauge",
+                'pst_engine_tokens_per_second{kind="decode"} 1234.0',
+                "# TYPE pst_engine_mfu gauge",
+                "pst_engine_mfu 0.31",
+                "# TYPE pst_engine_kv_page_occupancy gauge",
+                f"pst_engine_kv_page_occupancy {min(1.0, state.num_running * 0.1)}",
+                "# TYPE pst_engine_kv_page_high_watermark gauge",
+                "pst_engine_kv_page_high_watermark 0.55",
+                "# TYPE pst_engine_preemptions counter",
+                "pst_engine_preemptions_total 1",
+                "# TYPE pst_engine_swap_out counter",
+                "pst_engine_swap_out_total 2",
+                "# TYPE pst_engine_swap_in counter",
+                "pst_engine_swap_in_total 2",
+                "# TYPE pst_engine_start_time_seconds gauge",
+                "pst_engine_start_time_seconds 1700000000.0",
+                "# TYPE pst_engine_startup_seconds gauge",
+                'pst_engine_startup_seconds{phase="load"} 120.0',
+                'pst_engine_startup_seconds{phase="shard"} 15.0',
+                'pst_engine_startup_seconds{phase="warmup"} 5.0',
                 "",
             ]
         )
@@ -394,6 +432,35 @@ def create_fake_engine_app(
         # rides the shared observability registry.
         text += render_obs_metrics().decode()
         return web.Response(text=text, content_type="text/plain")
+
+    async def debug_profile(request: web.Request) -> web.Response:
+        """Same surface as the real engine's POST /debug/profile, always
+        the graceful CPU no-op (a fake engine has no device timeline)."""
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001
+                body = {}
+        if not isinstance(body, dict):  # e.g. a bare JSON list
+            body = {}
+        try:
+            duration_ms = float(
+                body.get("duration_ms")
+                or request.query.get("duration_ms", 1000)
+            )
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "duration_ms must be a number",
+                           "type": "invalid_request_error", "code": 400}},
+                status=400,
+            )
+        return web.json_response({
+            "status": "skipped",
+            "reason": "no accelerator backend (fake engine) — nothing to "
+                      "profile",
+            "duration_ms": duration_ms,
+        })
 
     async def health(request: web.Request) -> web.Response:
         if state.fail_mode == "error":
@@ -514,6 +581,7 @@ def create_fake_engine_app(
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/health", health)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)

@@ -6,6 +6,8 @@ import uuid
 from production_stack_tpu.obs import teardown_request_tracing
 from production_stack_tpu.resilience import teardown_resilience
 from production_stack_tpu.router.routing.logic import teardown_routing_logic
+from production_stack_tpu.router.services.canary import teardown_canary_prober
+from production_stack_tpu.router.services.metrics_service import configure_slo
 from production_stack_tpu.router.service_discovery import (
     EndpointInfo,
     ModelInfo,
@@ -19,6 +21,8 @@ def reset_router_singletons():
     teardown_resilience()
     teardown_request_tracing()
     teardown_routing_logic()
+    teardown_canary_prober()
+    configure_slo(0.0)
     try:
         teardown_service_discovery()
     except Exception:

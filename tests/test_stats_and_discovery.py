@@ -52,6 +52,62 @@ def test_engine_stats_from_scrape():
     assert abs(stats.gpu_cache_usage_perc - 0.42) < 1e-9
 
 
+def test_engine_stats_parses_engine_telemetry_names():
+    """The pst_engine_* surface (docs/observability.md "Engine
+    telemetry"): labeled compile counters SUM over their label sets."""
+    text = "\n".join(
+        [
+            "# TYPE pst_engine_compile counter",
+            'pst_engine_compile_total{kind="prefill",shape_bucket="b1xt64"} 3',
+            'pst_engine_compile_total{kind="decode",shape_bucket="b8"} 4',
+            "# TYPE pst_engine_mfu gauge",
+            "pst_engine_mfu 0.27",
+            "# TYPE pst_engine_kv_page_occupancy gauge",
+            "pst_engine_kv_page_occupancy 0.8",
+            "# TYPE pst_engine_kv_page_high_watermark gauge",
+            "pst_engine_kv_page_high_watermark 0.93",
+            "",
+        ]
+    )
+    stats = EngineStats.from_scrape(text)
+    assert stats.engine_compiles_total == 7
+    assert abs(stats.engine_mfu - 0.27) < 1e-9
+    assert abs(stats.engine_kv_page_occupancy - 0.8) < 1e-9
+    assert abs(stats.engine_kv_page_high_watermark - 0.93) < 1e-9
+
+
+@pytest.mark.parametrize("text", [
+    "",                                         # empty scrape
+    "complete garbage {{{ not prometheus",      # unparseable outright
+    "vllm:num_requests_running not_a_number",   # malformed value
+    # Truncated mid-line: an engine dying mid-response.
+    "# TYPE vllm:num_requests_running gauge\n"
+    "vllm:num_requests_running 3\n"
+    'pst_engine_compile_total{kind="pre',
+    # Unknown metrics only.
+    "# TYPE something_else counter\nsomething_else_total 9\n",
+])
+def test_engine_stats_never_raises_on_partial_scrape(text):
+    stats = EngineStats.from_scrape(text)
+    assert isinstance(stats, EngineStats)
+
+
+def test_engine_stats_partial_scrape_keeps_parsed_prefix():
+    """Damage PAST the good lines must not discard what already parsed —
+    the scrape sweep keeps serving stale-free values for the live part."""
+    text = (
+        "# TYPE vllm:num_requests_running gauge\n"
+        "vllm:num_requests_running 5\n"
+        "# TYPE vllm:gpu_cache_usage_perc gauge\n"
+        "vllm:gpu_cache_usage_perc 0.5\n"
+        "# TYPE broken gauge\n"
+        "broken this-is-not-a-number\n"
+    )
+    stats = EngineStats.from_scrape(text)
+    assert stats.num_running_requests == 5
+    assert abs(stats.gpu_cache_usage_perc - 0.5) < 1e-9
+
+
 def test_request_stats_lifecycle():
     mon = RequestStatsMonitor(sliding_window_size=60.0)
     url = "http://e0"
