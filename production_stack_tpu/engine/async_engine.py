@@ -51,6 +51,13 @@ class AsyncLLMEngine:
         # Step-loop health for the composite /health check.
         self.last_step_time = time.time()
         self.step_error: Optional[str] = None
+        # Warmup precompilation gate (engine/precompile.py): the step
+        # thread compiles the shape-bucket lattice before its first step;
+        # /ready reports 503 and router discovery keeps the engine
+        # unroutable until this flips. Requests submitted meanwhile queue
+        # in the mailboxes — /health stays green (liveness != readiness).
+        self._warming = cfg.warmup != "off"
+        self.warmup_error: Optional[str] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -72,6 +79,23 @@ class AsyncLLMEngine:
             self.step_error is None
             and self._thread is not None
             and self._thread.is_alive()
+        )
+
+    @property
+    def warming(self) -> bool:
+        """True while the startup precompile pass is still running."""
+        return self._warming
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (the /ready contract): healthy, warmed, awake, and
+        accepting work. Distinct from liveness — a warming, sleeping, or
+        draining engine is alive but must receive no new traffic."""
+        return (
+            self.is_healthy()
+            and not self._warming
+            and not self._sleeping
+            and not self._draining
         )
 
     # -- sleep / wake -----------------------------------------------------
@@ -228,6 +252,20 @@ class AsyncLLMEngine:
 
     def _run(self) -> None:
         logger.info("engine step loop started")
+        if self._warming:
+            # Precompile on the step thread: the asyncio loop keeps
+            # serving /health and /ready while the lattice compiles, and
+            # no device step can interleave with a warmup dispatch.
+            try:
+                self.engine.precompile()
+            except Exception as e:  # noqa: BLE001 — serve anyway: the
+                # lattice shapes that did compile are warm, the rest
+                # compile on demand (the pre-warmup behavior); readiness
+                # still flips so the pod is not wedged forever.
+                logger.exception("warmup precompile failed")
+                self.warmup_error = str(e)
+            self._warming = False
+            self._work.set()
         while not self._stop:
             self._drain_mailboxes()
             if self._sleeping or not self.engine.has_work():

@@ -146,6 +146,23 @@ class EngineConfig:
     # admission, drop expired queued sequences before they consume a
     # prefill step, and stop decoding expired running sequences.
     deadline_shedding: bool = True
+    # Ahead-of-time shape-bucket precompilation (engine/precompile.py;
+    # docs/engine.md "Warmup & precompilation"). "full" compiles the whole
+    # padded shape-bucket lattice before /ready flips; "lazy" compiles only
+    # the core set the first requests hit; "off" skips warmup (compile on
+    # demand — the pre-PR-6 behavior, and the embedded/test default; the
+    # helm chart deploys engines with "full").
+    warmup: str = "off"  # off | lazy | full
+    # Cap on buckets compiled at warmup (0 = the entire lattice). Buckets
+    # are walked most-likely-first, so a small budget still covers the
+    # common traffic shapes; the coverage gauge reports what was skipped.
+    warmup_bucket_budget: int = 0
+    # Persistent JAX compilation cache root (vLLM VLLM_CACHE_ROOT
+    # analogue). Executables land in a subdirectory keyed on model + mesh
+    # + dtype + code version, so a warm restart (or a rolling-deploy
+    # replacement pod on a PVC/hostPath mount) deserializes them instead
+    # of paying the 46-138 s XLA cold start again. None = no persistence.
+    compile_cache_dir: Optional[str] = None
 
 
 # Known per-chip HBM for backends whose memory_stats() is empty (the tunnel-

@@ -64,6 +64,12 @@ class LLMEngine:
         t_init = time.perf_counter()
         self.cfg = cfg
         self.model_cfg = get_model_config(cfg.model)
+        if cfg.compile_cache_dir:
+            # Before the runner wires any jit: executables compiled earlier
+            # are never written back to the persistent cache.
+            from .precompile import configure_compile_cache
+
+            configure_compile_cache(cfg, self.model_cfg)
         tok_spec = cfg.tokenizer or (cfg.model if os.path.isdir(cfg.model) else None)
         self.tokenizer = get_tokenizer(tok_spec, self.model_cfg.vocab_size)
         t_runner = time.perf_counter()
@@ -143,6 +149,9 @@ class LLMEngine:
         self.adaptive_deep_bursts_total = 0
         # Compile events awaiting an output-emitting step (see step()).
         self._pending_compile_events: List[dict] = []
+        # Precompile summary (engine/precompile.py): populated by
+        # precompile(); the server's /ready payload surfaces it.
+        self.warmup_summary: Optional[dict] = None
         self._seqs: Dict[str, Sequence] = {}
         # Incremental detokenizer state per request:
         # emitted text + [prefix_offset, read_offset) decode window.
@@ -165,6 +174,32 @@ class LLMEngine:
     @property
     def model_name(self) -> str:
         return self.cfg.served_model_name or self.model_cfg.name
+
+    # ------------------------------------------------------------------
+    # Warmup precompilation (docs/engine.md "Warmup & precompilation")
+    # ------------------------------------------------------------------
+
+    def precompile(
+        self, mode: Optional[str] = None, bucket_budget: Optional[int] = None
+    ) -> dict:
+        """Compile the padded shape-bucket lattice ahead of traffic.
+
+        Runs on whatever thread calls it (the async engine's step thread,
+        so HTTP probes stay responsive); records
+        ``pst_engine_startup_seconds{phase="precompile"}`` and the
+        coverage gauge, and returns the summary the server's ``/ready``
+        payload exposes."""
+        from .precompile import Precompiler
+
+        t0 = time.perf_counter()
+        summary = Precompiler(
+            self.runner, self.cfg, mode=mode, bucket_budget=bucket_budget
+        ).run()
+        ENGINE_TELEMETRY.record_startup_phase(
+            "precompile", time.perf_counter() - t0
+        )
+        self.warmup_summary = summary
+        return summary
 
     # ------------------------------------------------------------------
     # Requests

@@ -1187,6 +1187,152 @@ class ModelRunner:
         return _fetch(toks)
 
     # ------------------------------------------------------------------
+    # Warmup precompilation (engine/precompile.py drives this)
+    # ------------------------------------------------------------------
+
+    def _warmup_sampling_arrays(self, B: int) -> Dict[str, np.ndarray]:
+        """The sampling-array tree every live batch carries, all-neutral.
+        Shapes and dtypes must match ``_sampling_arrays`` exactly — they
+        are part of both the jit trace and the telemetry shape key."""
+        out: Dict[str, np.ndarray] = {
+            "temps": np.zeros(B, np.float32),
+            "top_ps": np.ones(B, np.float32),
+            "top_ks": np.zeros(B, np.int32),
+            "min_ps": np.zeros(B, np.float32),
+            "seeds": np.zeros(B, np.uint32),
+        }
+        if self.cfg.enable_lora:
+            out["lora_idx"] = np.zeros(B, np.int32)
+            out["lora_scale"] = np.zeros(B, np.float32)
+        return out
+
+    def warmup_bucket(self, bucket) -> None:
+        """Compile one lattice bucket with an all-padding dummy batch.
+
+        Every row carries ``kv_len = 0`` and writes to the drop slot, so
+        the dispatch touches no real KV state; the shapes and static jit
+        flags are exactly what live traffic produces, so both jax.jit's
+        executable cache AND the telemetry shape registry treat the
+        bucket as already-seen when a real batch arrives — a warmed shape
+        can never count as a live-traffic compile again."""
+        kind = bucket.kind
+        if kind == "decode":
+            self._warmup_decode(bucket)
+        elif kind == "decode_burst":
+            self._warmup_decode_burst(bucket)
+        elif kind == "prefill":
+            self._warmup_prefill(bucket)
+        elif kind == "spec_verify":
+            self._warmup_spec_verify(bucket)
+        elif kind == "encode":
+            self._warmup_encode(bucket)
+        else:
+            raise ValueError(f"unknown warmup bucket kind {kind!r}")
+
+    def _record_warmup(self, kind: str, key: tuple, seconds: float,
+                       label: str) -> None:
+        # tokens=0: warmup moves no real tokens, so the throughput window
+        # and MFU stay honest; the compile itself is counted (it is one).
+        ENGINE_TELEMETRY.record_dispatch(
+            kind, key, seconds, batch_bucket=label, tokens=0
+        )
+
+    def _warmup_decode(self, bucket) -> None:
+        Bb, Wb = bucket.rows, bucket.width
+        batch = {
+            "tokens": np.zeros((Bb, 1), np.int32),
+            "positions": np.zeros((Bb, 1), np.int32),
+            "block_tables": np.zeros((Bb, Wb), np.int32),
+            "kv_lens": np.zeros(Bb, np.int32),
+            "write_idx": np.full((Bb, 1), self._drop_slot, np.int32),
+            "last_idx": np.zeros(Bb, np.int32),
+        }
+        batch.update(self._warmup_sampling_arrays(Bb))
+        key = self._tel_key("decode", batch, (bucket.want_lp, bucket.greedy))
+        t0 = time.perf_counter()
+        self._run(batch, bucket.want_lp, bucket.greedy)
+        self._record_warmup(
+            "decode", key, time.perf_counter() - t0, bucket.label
+        )
+
+    def _warmup_decode_burst(self, bucket) -> None:
+        Bb, Wb, n = bucket.rows, bucket.width, bucket.n_steps
+        batch = {
+            "tokens": np.zeros(Bb, np.int32),
+            "positions": np.zeros(Bb, np.int32),
+            "block_tables": np.zeros((Bb, Wb), np.int32),
+            "kv_lens": np.zeros(Bb, np.int32),
+        }
+        batch.update(self._warmup_sampling_arrays(Bb))
+        key = self._tel_key(
+            "decode", batch, (n, bucket.want_lp, bucket.greedy)
+        )
+        t0 = time.perf_counter()
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce(
+                    "multi_step", (batch, n, bucket.want_lp, bucket.greedy)
+                )
+            self._dispatch_multi_step(batch, n, bucket.want_lp, bucket.greedy)
+        self._record_warmup(
+            "decode", key, time.perf_counter() - t0, bucket.label
+        )
+
+    def _warmup_prefill(self, bucket) -> None:
+        Bb, Tb, Wb = bucket.rows, bucket.tokens, bucket.width
+        batch = {
+            "tokens": np.zeros((Bb, Tb), np.int32),
+            "positions": np.zeros((Bb, Tb), np.int32),
+            "write_idx": np.full((Bb, Tb), self._drop_slot, np.int32),
+            "block_tables": np.zeros((Bb, Wb), np.int32),
+            "kv_lens": np.zeros(Bb, np.int32),
+            "last_idx": np.zeros(Bb, np.int32),
+        }
+        batch.update(self._warmup_sampling_arrays(Bb))
+        key = self._tel_key("prefill", batch, (bucket.want_lp, bucket.greedy))
+        t0 = time.perf_counter()
+        self._run(batch, bucket.want_lp, bucket.greedy)
+        self._record_warmup(
+            "prefill", key, time.perf_counter() - t0, bucket.label
+        )
+
+    def _warmup_spec_verify(self, bucket) -> None:
+        Bb, K, Wb = bucket.rows, bucket.tokens, bucket.width
+        T = K + 1
+        batch = {
+            "tokens": np.zeros((Bb, T), np.int32),
+            "positions": np.zeros((Bb, T), np.int32),
+            "write_idx": np.full((Bb, T), self._drop_slot, np.int32),
+            "block_tables": np.zeros((Bb, Wb), np.int32),
+            "kv_lens": np.zeros(Bb, np.int32),
+            "last_idx": np.zeros(Bb, np.int32),
+        }
+        batch.update(self._warmup_sampling_arrays(Bb))
+        key = self._tel_key("spec_verify", batch, (K,))
+        t0 = time.perf_counter()
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("spec_verify", batch)
+            self._dispatch_spec_verify(batch)
+        self._record_warmup(
+            "spec_verify", key, time.perf_counter() - t0, bucket.label
+        )
+
+    def _warmup_encode(self, bucket) -> None:
+        T = bucket.tokens
+        toks = np.zeros((1, T), np.int32)
+        length = np.array([1], np.int32)  # 1, not 0: mean-pool divides by it
+        key = (self._tel_scope, "encode", T)
+        t0 = time.perf_counter()
+        with self._device_lock:
+            if self.publisher is not None:
+                self.publisher.announce("encode", (toks, length))
+            self._dispatch_encode(toks, length)
+        self._record_warmup(
+            "encode", key, time.perf_counter() - t0, bucket.label
+        )
+
+    # ------------------------------------------------------------------
     # Batch construction (host side, numpy)
     # ------------------------------------------------------------------
 

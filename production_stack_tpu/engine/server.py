@@ -79,6 +79,16 @@ def _drain_error():
                   headers={"X-PST-Draining": "1"})
 
 
+def _warming_error():
+    # Same contract as the drain marker, for the startup precompile pass:
+    # accepting the request would queue it behind the 46-138 s XLA lattice
+    # compile (exactly the cold-engine TTFT warmup exists to prevent), so
+    # reject with a marker the router reconciles from live traffic — it
+    # marks the endpoint warming and fails over without a breaker penalty.
+    return _error("engine is warming up (precompiling)", 503,
+                  "service_unavailable", headers={"X-PST-Warming": "1"})
+
+
 def _deadline_error():
     # Instant 504 for work whose router-propagated budget is already gone:
     # cheaper to shed at HTTP admission than to let the scheduler drop it.
@@ -403,7 +413,8 @@ def create_engine_app(
     # per-request metadata (request ids, backend URLs, error strings) —
     # when an api key is configured it is guarded like the work endpoints.
     _OPEN_PATHS = {
-        "/health", "/metrics", "/version", "/is_sleeping", "/is_draining",
+        "/health", "/ready", "/metrics", "/version", "/is_sleeping",
+        "/is_draining",
     }
 
     # Paths that get a root span + timeline entry (the work the router
@@ -551,6 +562,8 @@ def create_engine_app(
             return _error("engine is sleeping", 503, "service_unavailable")
         if engine.draining:
             return _drain_error()
+        if engine.warming:
+            return _warming_error()
         # continue_final_message (vLLM parity, pydantic extra="allow"):
         # render the final message's turn OPEN so generation continues it
         # instead of starting a fresh assistant turn — what the router's
@@ -571,6 +584,8 @@ def create_engine_app(
             return _error("engine is sleeping", 503, "service_unavailable")
         if engine.draining:
             return _drain_error()
+        if engine.warming:
+            return _warming_error()
         prompt = req.prompt
         # Normalize the four OpenAI prompt forms: str, [str, ...],
         # [int, ...] (one tokenized prompt), [[int, ...], ...] (a batch).
@@ -1030,6 +1045,8 @@ def create_engine_app(
             # Same admission gate as the generation endpoints: encode work
             # accepted after /drain would race the preStop SIGTERM.
             return _drain_error()
+        if engine.warming:
+            return _warming_error()
         err, _ = _request_deadline(request)
         if err is not None:
             return err
@@ -1093,6 +1110,8 @@ def create_engine_app(
     async def rerank(request: web.Request) -> web.Response:
         if engine.draining:
             return _drain_error()
+        if engine.warming:
+            return _warming_error()
         err, _ = _request_deadline(request)
         if err is not None:
             return err
@@ -1118,6 +1137,8 @@ def create_engine_app(
     async def score(request: web.Request) -> web.Response:
         if engine.draining:
             return _drain_error()
+        if engine.warming:
+            return _warming_error()
         err, _ = _request_deadline(request)
         if err is not None:
             return err
@@ -1168,13 +1189,42 @@ def create_engine_app(
 
     async def health(request: web.Request) -> web.Response:
         if engine.is_healthy():
-            # Draining is still healthy (the pod must stay alive to finish
-            # in-flight work) — the status string tells K8s dashboards and
-            # humans apart from a routable engine.
-            status = "draining" if engine.draining else "ok"
+            # Draining and warming are still healthy (liveness: the pod
+            # must not be restarted mid-drain or mid-precompile) — the
+            # status string tells K8s dashboards and humans apart from a
+            # routable engine.
+            status = (
+                "draining" if engine.draining
+                else "warming" if engine.warming
+                else "ok"
+            )
             return web.json_response({"status": status})
         return web.json_response(
             {"status": "unhealthy", "error": engine.step_error}, status=503
+        )
+
+    async def ready(request: web.Request) -> web.Response:
+        """Readiness (the K8s readinessProbe target and router discovery's
+        warming probe): 200 only once the startup precompile pass has
+        finished and the engine accepts work. Distinct from /health —
+        a warming engine is alive but must receive no traffic, or its
+        first requests absorb XLA compiles (the BENCH_r05 120 s p99)."""
+        warmup = dict(engine.engine.warmup_summary or {})
+        warmup["mode"] = engine.engine.cfg.warmup
+        if engine.warmup_error:
+            warmup["error"] = engine.warmup_error
+        if engine.ready:
+            return web.json_response({"ready": True, "warmup": warmup})
+        # Reason mirrors AsyncLLMEngine.ready's conjuncts, in severity
+        # order.
+        reason = (
+            "unhealthy" if not engine.is_healthy()
+            else "warming" if engine.warming
+            else "sleeping" if engine.sleeping
+            else "draining"
+        )
+        return web.json_response(
+            {"ready": False, "reason": reason, "warmup": warmup}, status=503
         )
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
@@ -1347,6 +1397,7 @@ def create_engine_app(
     app.router.add_post("/tokenize", tokenize)
     app.router.add_post("/detokenize", detokenize)
     app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_post("/debug/profile", debug_profile)
@@ -1472,6 +1523,23 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-startup-phases", dest="startup_phases",
                    action="store_false",
                    help="do not export pst_engine_startup_seconds")
+    # Ahead-of-time precompilation + persistent compile cache
+    # (docs/engine.md "Warmup & precompilation"). The helm chart deploys
+    # with --warmup full; bare CLI runs default to off so dev loops and
+    # embedded use stay instant.
+    p.add_argument("--warmup", default="off",
+                   choices=["off", "lazy", "full"],
+                   help="shape-bucket precompilation before /ready flips: "
+                        "full = entire lattice, lazy = the core set the "
+                        "first requests hit, off = compile on demand")
+    p.add_argument("--warmup-bucket-budget", type=int, default=0,
+                   help="cap warmup to this many lattice buckets, "
+                        "most-likely-first (0 = whole lattice)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent JAX compilation cache root; compiled "
+                        "executables land in a subdirectory keyed on "
+                        "model+mesh+dtype+code version so warm restarts "
+                        "skip XLA entirely")
     return p.parse_args(argv)
 
 
@@ -1519,6 +1587,9 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         engine_url=args.engine_url,
         kv_role=args.kv_role,
         deadline_shedding=args.deadline_shedding,
+        warmup=args.warmup,
+        warmup_bucket_budget=args.warmup_bucket_budget,
+        compile_cache_dir=args.compile_cache_dir,
     )
 
 

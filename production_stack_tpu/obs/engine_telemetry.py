@@ -125,8 +125,35 @@ startup_seconds = Gauge(
     "pst_engine_startup_seconds",
     "Engine startup decomposition: load (param materialization), shard "
     "(device placement + KV alloc + jit wiring), warmup (tokenizer, "
-    "allocator, scheduler)",
+    "allocator, scheduler), precompile (ahead-of-time shape-bucket "
+    "lattice compilation)",
     ["phase"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+warmup_coverage = Gauge(
+    "pst_engine_warmup_coverage",
+    "Warmup precompile coverage: shape buckets compiled over buckets in "
+    "the enumerated lattice (1.0 = every padded shape live traffic can "
+    "produce is already compiled)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+warmup_buckets = Gauge(
+    "pst_engine_warmup_buckets",
+    "Warmup lattice size, by state: total (enumerated) vs compiled "
+    "(dispatched at warmup)",
+    ["state"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+compile_cache_hits = Counter(
+    "pst_engine_compile_cache_hits",
+    "Persistent JAX compilation-cache hits (executable deserialized "
+    "instead of rebuilt by XLA)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+compile_cache_misses = Counter(
+    "pst_engine_compile_cache_misses",
+    "Persistent JAX compilation-cache misses (fresh XLA build, entry "
+    "written for the next restart)",
     registry=ENGINE_TELEMETRY_REGISTRY,
 )
 
@@ -172,6 +199,10 @@ class EngineTelemetry:
         self._tok_kinds: set = set()
         self._counter_last: Dict[str, float] = {}
         self._kv_hwm = 0.0
+        # Persistent compilation-cache accounting (fed by the jax
+        # monitoring listener precompile.configure_compile_cache installs).
+        self._cache_hits = 0
+        self._cache_misses = 0
         self.param_count = 0
         self.peak_flops = _DEFAULT_PEAK_FLOPS
         # --no-startup-phases: the gauges stay at 0 (helm
@@ -194,6 +225,31 @@ class EngineTelemetry:
         if not self.startup_enabled:
             return
         startup_seconds.labels(phase=phase).set(max(seconds, 0.0))
+
+    # -- warmup / persistent compile cache -------------------------------
+
+    def set_warmup_coverage(self, compiled: int, total: int) -> None:
+        """Buckets-compiled over buckets-in-lattice (the /ready story in
+        one gauge; updated as the precompiler walks the lattice)."""
+        warmup_buckets.labels(state="total").set(max(total, 0))
+        warmup_buckets.labels(state="compiled").set(max(compiled, 0))
+        warmup_coverage.set(compiled / total if total > 0 else 0.0)
+
+    def record_cache_event(self, hit: bool) -> None:
+        """One persistent-compilation-cache lookup outcome (from the jax
+        monitoring listener)."""
+        with self._lock:
+            if hit:
+                self._cache_hits += 1
+            else:
+                self._cache_misses += 1
+        (compile_cache_hits if hit else compile_cache_misses).inc()
+
+    def cache_stats(self) -> "Tuple[int, int]":
+        """(hits, misses) observed since process start — bench and the
+        warm-restart e2e assert zero fresh misses on a warm restart."""
+        with self._lock:
+            return self._cache_hits, self._cache_misses
 
     # -- dispatch-level telemetry ---------------------------------------
 
@@ -327,6 +383,8 @@ class EngineTelemetry:
             self._tok_kinds.clear()
             self._counter_last.clear()
             self._kv_hwm = 0.0
+            self._cache_hits = 0
+            self._cache_misses = 0
             self.startup_enabled = True
 
 

@@ -49,6 +49,11 @@ client_disconnects_total = Counter(
 draining_engines = Gauge(
     "pst_resilience_draining_engines", "Engines currently draining"
 )
+warming_engines = Gauge(
+    "pst_resilience_warming_engines",
+    "Engines currently warming (startup precompile pass running; "
+    "unroutable until /ready flips)",
+)
 
 # -- deadlines & hedging (docs/resilience.md "Deadlines & hedging") ---------
 

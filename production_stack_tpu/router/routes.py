@@ -165,6 +165,7 @@ async def engines(request: web.Request) -> web.Response:
                 "model_label": ep.model_label,
                 "sleep": ep.sleep,
                 "draining": ep.draining,
+                "warming": ep.warming,
                 "breaker": registry.state(ep.url).value if registry else None,
                 "pod_name": ep.pod_name,
                 "namespace": ep.namespace,
@@ -227,6 +228,9 @@ async def metrics(request: web.Request) -> web.Response:
         res_gauges.queue_depth.set(controller.queue_len())
     res_gauges.draining_engines.set(
         sum(1 for ep in endpoints if ep.draining)
+    )
+    res_gauges.warming_engines.set(
+        sum(1 for ep in endpoints if ep.warming)
     )
     # Router-process resource usage.
     proc = psutil.Process()

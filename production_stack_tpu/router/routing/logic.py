@@ -134,18 +134,25 @@ def filter_routable(
     apply_breakers: bool = True,
 ) -> List[EndpointInfo]:
     """Drop endpoints routing must not pick right now: explicitly excluded
-    URLs (already tried this request), draining engines, and engines whose
-    circuit breaker is refusing traffic.
+    URLs (already tried this request), draining or warming engines, and
+    engines whose circuit breaker is refusing traffic.
 
     The breaker filter fails open (see ``apply_breaker_filter``); explicit
-    excludes and draining stay hard filters. ``apply_breakers=False`` skips
-    the breaker pass for routers that scope it per pool themselves (disagg
-    P/D) — filtering the merged list would defeat fail-open for a pool
-    that is entirely refused while the other pool keeps the list non-empty.
+    excludes, draining, and warming stay hard filters — routing a request
+    to a warming engine lands it behind the precompile pass, exactly the
+    cold-engine TTFT a rolling deploy must never produce.
+    ``apply_breakers=False`` skips the breaker pass for routers that scope
+    it per pool themselves (disagg P/D) — filtering the merged list would
+    defeat fail-open for a pool that is entirely refused while the other
+    pool keeps the list non-empty.
     """
     if exclude:
         endpoints = [e for e in endpoints if e.url not in exclude]
-    endpoints = [e for e in endpoints if not getattr(e, "draining", False)]
+    endpoints = [
+        e for e in endpoints
+        if not getattr(e, "draining", False)
+        and not getattr(e, "warming", False)
+    ]
     if not apply_breakers:
         return endpoints
     return apply_breaker_filter(endpoints)

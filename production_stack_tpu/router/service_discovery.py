@@ -36,6 +36,37 @@ class ServiceDiscoveryType(enum.Enum):
     K8S = "k8s"
 
 
+def warming_from_ready(status: int, body) -> bool:
+    """Interpret one engine ``/ready`` response (the single source for
+    both discovery modes): warming iff it is a 503 whose JSON body says
+    ``reason == "warming"``. 200 (ready), 404 (pre-warmup engine without
+    the endpoint), and unparseable bodies are not-warming — a draining or
+    unhealthy 503 is handled by its own probes."""
+    if status in (200, 404) or not isinstance(body, dict):
+        return False
+    return body.get("reason") == "warming"
+
+
+async def probe_warming(
+    session: aiohttp.ClientSession, base_url: str, timeout: float = 5.0
+) -> Optional[bool]:
+    """One GET /ready against an engine, interpreted by
+    ``warming_from_ready``. Tri-state: True/False, or None when the probe
+    itself failed (timeout / connect error) — callers keep the last-known
+    state rather than flapping a warming engine back to routable."""
+    try:
+        async with session.get(
+            f"{base_url}/ready", timeout=aiohttp.ClientTimeout(total=timeout)
+        ) as resp:
+            try:
+                body = await resp.json()
+            except Exception:  # noqa: BLE001 — non-JSON 5xx
+                body = None
+            return warming_from_ready(resp.status, body)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 @dataclass
 class ModelInfo:
     """A model (base or LoRA adapter) served by an endpoint."""
@@ -79,6 +110,11 @@ class EndpointInfo:
     # no new ones — routing must treat it as unroutable (resilience
     # subsystem; no reference counterpart).
     draining: bool = False
+    # Warmup precompilation in progress (engine /ready reports 503 with
+    # reason "warming"): the engine is alive but routing traffic to it
+    # would land requests behind the XLA compile storm — unroutable the
+    # same way draining is, until /ready flips.
+    warming: bool = False
     pod_name: Optional[str] = None
     service_name: Optional[str] = None
     namespace: Optional[str] = None
@@ -118,6 +154,10 @@ class ServiceDiscovery(ABC):
         Router-initiated drain (the /drain fan-out) calls this so routing
         reacts at once; the periodic probes / watch events still reconcile
         drains initiated directly against an engine."""
+
+    def set_warming(self, url: str, warming: bool) -> None:
+        """Mark/unmark an endpoint as warming (precompiling) immediately —
+        the probes / watch events reconcile against the engine's /ready."""
 
     async def start(self) -> None:
         """Begin background watch/health tasks (called from app startup)."""
@@ -186,6 +226,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.decode_model_labels = decode_model_labels
         self._unhealthy: set = set()
         self._draining: set = set()  # urls reporting is_draining
+        self._warming: set = set()  # urls whose /ready reports warming
         self._task: Optional[asyncio.Task] = None
 
     @staticmethod
@@ -220,6 +261,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 return False  # endpoint absent = not draining
         except Exception:  # noqa: BLE001
             return None
+
+    async def _probe_warming(
+        self, session: aiohttp.ClientSession, url: str
+    ) -> Optional[bool]:
+        """Shared /ready probe; tri-state like the drain probe."""
+        return await probe_warming(session, url)
 
     @staticmethod
     def _feed_breaker(url: str, ok: bool) -> None:
@@ -264,6 +311,17 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 # Draining is deliberate, not a failure: the endpoint is
                 # unroutable but its breaker is left alone.
                 return None
+            warm_state = await self._probe_warming(session, url)
+            if warm_state is True:
+                self._warming.add(url)
+            elif warm_state is False:
+                self._warming.discard(url)
+            if url in self._warming:
+                # Warming is deliberate too: skip the generation probe (it
+                # would queue behind the compile pass, time out, and feed
+                # the breaker a spurious failure) — the endpoint is simply
+                # unroutable until /ready flips.
+                return None
             ok = await self._probe(session, url, model, mtype)
             self._feed_breaker(url, ok)
             if not ok:
@@ -294,9 +352,10 @@ class StaticServiceDiscovery(ServiceDiscovery):
     async def _drain_reconcile_loop(self) -> None:
         """Runs only when the full health loop is off: re-probe engines the
         router has marked draining (via the /drain fan-out or a tagged
-        drain 503) so one that undrains or restarts behind the router's
-        back becomes routable again without an operator /undrain. Only
-        marked engines are probed — the loop is idle while nothing drains."""
+        drain 503) or warming (via set_warming) so one that undrains,
+        restarts, or finishes precompiling behind the router's back
+        becomes routable again without an operator /undrain. Only marked
+        engines are probed — the loop is idle while nothing drains."""
         async with aiohttp.ClientSession() as session:
             while True:
                 await asyncio.sleep(self.health_check_interval)
@@ -305,6 +364,10 @@ class StaticServiceDiscovery(ServiceDiscovery):
                         if await self._probe_draining(session, url) is False:
                             logger.info("engine %s no longer draining", url)
                             self._draining.discard(url)
+                    for url in list(self._warming):
+                        if await self._probe_warming(session, url) is False:
+                            logger.info("engine %s finished warming", url)
+                            self._warming.discard(url)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001 — keep reconciling
@@ -331,6 +394,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
         else:
             self._draining.discard(url)
 
+    def set_warming(self, url: str, warming: bool) -> None:
+        if warming:
+            self._warming.add(url)
+        else:
+            self._warming.discard(url)
+
     def get_endpoint_info(self) -> List[EndpointInfo]:
         infos = []
         for i, (url, model) in enumerate(zip(self.urls, self.models)):
@@ -346,6 +415,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     model_label=label,
                     sleep=False,
                     draining=url in self._draining,
+                    warming=url in self._warming,
                     model_info={model: ModelInfo(id=model)},
                 )
             )
@@ -408,6 +478,11 @@ class _K8sWatcherBase(ServiceDiscovery):
             if info.url == url:
                 info.draining = draining
 
+    def set_warming(self, url: str, warming: bool) -> None:
+        for info in self.available_engines.values():
+            if info.url == url:
+                info.warming = warming
+
     async def start(self) -> None:
         if self._task is None:
             self._task = asyncio.create_task(self._watch_loop())
@@ -453,6 +528,17 @@ class _K8sWatcherBase(ServiceDiscovery):
         collapsing probe failure to False would flap a draining engine
         back to routable on any watch-event refetch that times out."""
         flag = await self._fetch_flag(base_url, "/is_draining", "is_draining")
+        return last_known if flag is None else flag
+
+    async def _fetch_warming_status(
+        self, base_url: str, last_known: bool = False
+    ) -> bool:
+        """Warming from the engine's /ready (shared ``probe_warming``). A
+        failed probe keeps the last-known state — flapping a warming
+        engine to routable on one timed-out refetch would feed its
+        compile storm live traffic."""
+        async with aiohttp.ClientSession() as session:
+            flag = await probe_warming(session, base_url)
         return last_known if flag is None else flag
 
     async def _watch_loop(self) -> None:
@@ -512,9 +598,10 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
             logger.debug("engine %s not serving /v1/models yet: %s", name, e)
             return
         prev = self.available_engines.get(name)
-        sleep, draining = await asyncio.gather(
+        sleep, draining, warming = await asyncio.gather(
             self._fetch_sleep_status(url),
             self._fetch_drain_status(url, prev.draining if prev else False),
+            self._fetch_warming_status(url, prev.warming if prev else False),
         )
         labels = meta.get("labels", {}) or {}
         info = EndpointInfo(
@@ -525,6 +612,7 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
             model_label=labels.get("model", labels.get("app", "default")),
             sleep=sleep,
             draining=draining,
+            warming=warming,
             pod_name=name,
             namespace=self.namespace,
             model_info=model_info,
@@ -571,9 +659,10 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
             logger.debug("service %s not ready: %s", name, e)
             return
         prev = self.available_engines.get(name)
-        sleep, draining = await asyncio.gather(
+        sleep, draining, warming = await asyncio.gather(
             self._fetch_sleep_status(url),
             self._fetch_drain_status(url, prev.draining if prev else False),
+            self._fetch_warming_status(url, prev.warming if prev else False),
         )
         labels = meta.get("labels", {}) or {}
         info = EndpointInfo(
@@ -584,6 +673,7 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
             model_label=labels.get("model", labels.get("app", "default")),
             sleep=sleep,
             draining=draining,
+            warming=warming,
             service_name=name,
             namespace=self.namespace,
             model_info=model_info,

@@ -11,7 +11,8 @@ Probe outcomes feed the existing breaker/health view: a successful probe
 records breaker success (it IS a live probe — exactly what a half-open
 breaker wants), a hard failure (connect error / 5xx) records breaker
 failure and increments ``pst_canary_failures_total``. Deliberate drain
-rejections and sleeping engines are skipped, not failed.
+rejections, sleeping engines, and warming (precompiling) engines are
+skipped, not failed.
 """
 
 from __future__ import annotations
@@ -88,7 +89,14 @@ class CanaryProber:
             await asyncio.sleep(self.interval)
 
     async def _probe_one(self, ep) -> None:
-        if getattr(ep, "sleep", False) or getattr(ep, "draining", False):
+        if (
+            getattr(ep, "sleep", False)
+            or getattr(ep, "draining", False)
+            or getattr(ep, "warming", False)
+        ):
+            # Warming engines are skipped, not failed: a probe would queue
+            # behind the precompile pass and feed the breaker a spurious
+            # failure for a deliberate state.
             return
         model = ep.model_names[0] if ep.model_names else ""
         body = {

@@ -351,6 +351,12 @@ async def proxy_and_stream(
                         # POSTing the engine directly) becomes unroutable
                         # even when no health-probe loop is running.
                         get_service_discovery().set_draining(url, True)
+                    elif upstream.status == 503 and "X-PST-Warming" in upstream.headers:
+                        # Warming (startup precompile) rejection — same
+                        # rule: mark the endpoint unroutable from live
+                        # traffic (the /ready probes clear it once the
+                        # pass finishes), spare the breaker, fail over.
+                        get_service_discovery().set_warming(url, True)
                     else:
                         _note_failure(url, request_id, span=attempt_span)
                         failure_noted = True
@@ -775,6 +781,12 @@ async def _resume_stream(
                     ):
                         get_service_discovery().set_draining(next_url, True)
                         span.set_attribute("outcome", "draining")
+                    elif (
+                        upstream.status == 503
+                        and "X-PST-Warming" in upstream.headers
+                    ):
+                        get_service_discovery().set_warming(next_url, True)
+                        span.set_attribute("outcome", "warming")
                     else:
                         _note_failure(next_url, rid, span=span)
                         span.set_attribute("outcome", "error")
@@ -920,6 +932,9 @@ async def _buffered_attempt(
     if status == 503 and "X-PST-Draining" in headers:
         get_service_discovery().set_draining(url, True)
         span.set_attribute("outcome", "draining")
+    elif status == 503 and "X-PST-Warming" in headers:
+        get_service_discovery().set_warming(url, True)
+        span.set_attribute("outcome", "warming")
     elif status == 504 and DEADLINE_EXCEEDED_HEADER in headers:
         span.set_attribute("outcome", "deadline_shed")
         trace.add_event("deadline_shed", stage="engine", server=url)
@@ -1413,18 +1428,20 @@ async def route_disaggregated_prefill_request(
         monitor.on_new_request(prefill_url, f"{request_id}-prefill", t_prefill_start)
         error: Optional[str] = None
         draining = False
+        warming = False
         try:
             async with session.post(
                 prefill_url + endpoint, json=prefill_json,
                 headers=fwd_headers, timeout=attempt_timeout,
             ) as resp:
                 draining = resp.status == 503 and "X-PST-Draining" in resp.headers
-                if not draining:
+                warming = resp.status == 503 and "X-PST-Warming" in resp.headers
+                if not draining and not warming:
                     resp.raise_for_status()
                     await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             error = str(e)
-        if error is None and not draining:
+        if error is None and not draining and not warming:
             monitor.on_request_response(prefill_url, f"{request_id}-prefill", time.time())
             monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
             _note_success(prefill_url)
@@ -1443,6 +1460,10 @@ async def route_disaggregated_prefill_request(
             # proxy_and_stream): reconcile discovery, spare the breaker.
             get_service_discovery().set_draining(prefill_url, True)
             prefill_span.set_attribute("outcome", "draining")
+        elif warming:
+            # Warming precompile pass — same rule: unroutable, no breaker.
+            get_service_discovery().set_warming(prefill_url, True)
+            prefill_span.set_attribute("outcome", "warming")
         elif deadline is not None and deadline.expired():
             # Budget exhausted mid-prefill: a deadline shed, not a failure.
             prefill_span.set_attribute("outcome", "deadline_shed")

@@ -6,8 +6,9 @@ configurable rate, tracks running-request count) extended to the full
 surface the router depends on (SURVEY.md §4 "pattern to replicate"):
 ``/v1/models``, ``/v1/chat/completions``, ``/v1/completions`` (streaming
 and non-streaming), ``/metrics`` with ``vllm:``-style gauges,
-``/is_sleeping`` + ``/sleep`` + ``/wake_up``, ``/health``, LoRA
-load/unload endpoints, and ``/tokenize``.
+``/is_sleeping`` + ``/sleep`` + ``/wake_up``, ``/health``, ``/ready``
+(simulated warmup precompilation: ``--ready-delay`` + a warm-restart
+cache-dir marker), LoRA load/unload endpoints, and ``/tokenize``.
 """
 
 from __future__ import annotations
@@ -15,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import random
 import re
 import time
@@ -27,6 +29,14 @@ from ..logging_utils import init_logger
 from ..obs import observe_stage, render_obs_metrics
 
 logger = init_logger(__name__)
+
+# Simulated lattice size: what the warmup metrics (coverage, cache
+# hits/misses) count against. Arbitrary but deterministic.
+FAKE_WARMUP_BUCKETS = 12
+
+# Fraction of the cold ready delay a warm restart pays (the persistent
+# cache skips XLA but tracing/deserialization still cost something).
+_WARM_RESTART_FRACTION = 0.2
 
 
 class FakeEngineState:
@@ -75,6 +85,63 @@ class FakeEngineState:
         # order — lets e2e tests assert one trace id spans every leg
         # (primary, retries, hedges) across engines.
         self.traces_seen: List[dict] = []
+        # Simulated warmup precompilation (the real engine's /ready
+        # contract): the engine reports warming for ``ready_delay``
+        # seconds after start. With a ``warmup_cache_dir``, a marker file
+        # left by a previous instance makes this a WARM restart — the
+        # delay shrinks to a fraction and the deterministic cache
+        # counters flip from all-misses to all-hits, so router-discovery
+        # and restart e2e tests run the full story without a TPU.
+        self.ready_delay = 0.0
+        self.warmup_cache_dir: Optional[str] = None
+        self.warm_start = False
+        self.warmup_started = time.monotonic()
+        self._marker_written = False
+
+    def configure_warmup(
+        self, ready_delay: float, cache_dir: Optional[str] = None
+    ) -> None:
+        self.ready_delay = max(float(ready_delay), 0.0)
+        self.warmup_cache_dir = cache_dir
+        self.warm_start = bool(
+            cache_dir and os.path.exists(os.path.join(cache_dir, "warm"))
+        )
+        self.warmup_started = time.monotonic()
+        self._marker_written = False
+
+    @property
+    def effective_ready_delay(self) -> float:
+        return self.ready_delay * (
+            _WARM_RESTART_FRACTION if self.warm_start else 1.0
+        )
+
+    @property
+    def warming(self) -> bool:
+        warming = (
+            time.monotonic() - self.warmup_started
+            < self.effective_ready_delay
+        )
+        if not warming and self.warmup_cache_dir and not self._marker_written:
+            # Ready (first observation): persist the cache marker once so
+            # the next instance with this cache dir restarts warm (the
+            # PVC/hostPath analogue).
+            self._marker_written = True
+            try:
+                os.makedirs(self.warmup_cache_dir, exist_ok=True)
+                with open(
+                    os.path.join(self.warmup_cache_dir, "warm"), "w"
+                ) as f:
+                    f.write(self.model)
+            except OSError:  # pragma: no cover — read-only fixture dirs
+                pass
+        return warming
+
+    @property
+    def warmup_coverage(self) -> float:
+        if self.effective_ready_delay <= 0:
+            return 1.0
+        elapsed = time.monotonic() - self.warmup_started
+        return min(elapsed / self.effective_ready_delay, 1.0)
 
     def take_fault(self) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None."""
@@ -135,11 +202,14 @@ def create_fake_engine_app(
     speed: float = 500.0,
     ttft: float = 0.0,
     name: str = "",
+    ready_delay: float = 0.0,
+    warmup_cache_dir: Optional[str] = None,
 ) -> web.Application:
     state = FakeEngineState(model, speed)
     # Instance identity for routing-distribution e2e assertions: surfaces in
     # the X-Served-By header of every generation response.
     state.name = name or f"fake-{uuid.uuid4().hex[:6]}"
+    state.configure_warmup(ready_delay, warmup_cache_dir)
     app = web.Application()
     app["state"] = state
 
@@ -200,6 +270,16 @@ def create_fake_engine_app(
                            "type": "service_unavailable", "code": 503}},
                 status=503,
                 headers={"X-PST-Draining": "1", **echo},
+            )
+        if state.warming:
+            # Same tagged-503 contract as the real engine's warming gate:
+            # the router marks the endpoint warming and fails over without
+            # feeding the breaker.
+            return web.json_response(
+                {"error": {"message": "engine is warming up (precompiling)",
+                           "type": "service_unavailable", "code": 503}},
+                status=503,
+                headers={"X-PST-Warming": "1", **echo},
             )
         fault = state.take_fault()
         if fault == "slow":
@@ -425,6 +505,26 @@ def create_fake_engine_app(
                 'pst_engine_startup_seconds{phase="load"} 120.0',
                 'pst_engine_startup_seconds{phase="shard"} 15.0',
                 'pst_engine_startup_seconds{phase="warmup"} 5.0',
+                # Simulated precompile warmup (docs/engine.md "Warmup &
+                # precompilation"): phase time tracks the effective ready
+                # delay (warm restarts report a strictly smaller value),
+                # coverage climbs 0→1 during the delay, and the cache
+                # counters are all-misses cold / all-hits warm.
+                'pst_engine_startup_seconds{phase="precompile"} '
+                f"{state.effective_ready_delay:.3f}",
+                "# TYPE pst_engine_warmup_coverage gauge",
+                f"pst_engine_warmup_coverage {state.warmup_coverage:.4f}",
+                "# TYPE pst_engine_warmup_buckets gauge",
+                'pst_engine_warmup_buckets{state="total"} '
+                f"{FAKE_WARMUP_BUCKETS}",
+                'pst_engine_warmup_buckets{state="compiled"} '
+                f"{int(round(state.warmup_coverage * FAKE_WARMUP_BUCKETS))}",
+                "# TYPE pst_engine_compile_cache_hits counter",
+                "pst_engine_compile_cache_hits_total "
+                f"{FAKE_WARMUP_BUCKETS if state.warm_start else 0}",
+                "# TYPE pst_engine_compile_cache_misses counter",
+                "pst_engine_compile_cache_misses_total "
+                f"{0 if state.warm_start else FAKE_WARMUP_BUCKETS}",
                 "",
             ]
         )
@@ -465,8 +565,58 @@ def create_fake_engine_app(
     async def health(request: web.Request) -> web.Response:
         if state.fail_mode == "error":
             return web.json_response({"status": "failing"}, status=500)
-        status = "draining" if state.draining else "ok"
+        status = (
+            "draining" if state.draining
+            else "warming" if state.warming
+            else "ok"
+        )
         return web.json_response({"status": status})
+
+    async def ready(request: web.Request) -> web.Response:
+        """Same contract as the real engine's /ready: 200 once the
+        (simulated) precompile pass finished, 503 + reason otherwise."""
+        warmup = {
+            "mode": "full" if state.ready_delay else "off",
+            "buckets_total": FAKE_WARMUP_BUCKETS,
+            "buckets_compiled": int(
+                round(state.warmup_coverage * FAKE_WARMUP_BUCKETS)
+            ),
+            "coverage": round(state.warmup_coverage, 4),
+            "seconds": round(state.effective_ready_delay, 3),
+            "warm_start": state.warm_start,
+        }
+        if state.fail_mode == "error":
+            reason = "unhealthy"
+        elif state.warming:
+            reason = "warming"
+        elif state.draining:
+            reason = "draining"
+        else:
+            return web.json_response({"ready": True, "warmup": warmup})
+        return web.json_response(
+            {"ready": False, "reason": reason, "warmup": warmup}, status=503
+        )
+
+    async def admin_warmup(request: web.Request) -> web.Response:
+        """Re-enter (or reconfigure) the simulated warmup: {"ready_delay":
+        seconds, "cache_dir": path|null, "reset_cache": bool}. Lets
+        discovery/routing tests flip an engine to warming mid-run without
+        restarting the app."""
+        body = await request.json() if request.can_read_body else {}
+        cache_dir = body.get("cache_dir", state.warmup_cache_dir)
+        if body.get("reset_cache") and cache_dir:
+            try:
+                os.remove(os.path.join(cache_dir, "warm"))
+            except OSError:
+                pass
+        state.configure_warmup(
+            float(body.get("ready_delay", state.ready_delay)), cache_dir
+        )
+        return web.json_response({
+            "status": "warming" if state.warming else "ready",
+            "warm_start": state.warm_start,
+            "effective_ready_delay": state.effective_ready_delay,
+        })
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": state.sleeping})
@@ -583,11 +733,13 @@ def create_fake_engine_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
     app.router.add_post("/admin/fail", admin_fail)
     app.router.add_post("/admin/heal", admin_heal)
+    app.router.add_post("/admin/warmup", admin_warmup)
     app.router.add_post("/drain", drain)
     app.router.add_post("/undrain", undrain)
     app.router.add_get("/is_draining", is_draining)
@@ -605,8 +757,18 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--speed", type=float, default=500.0, help="tokens/sec")
     p.add_argument("--ttft", type=float, default=0.0, help="artificial TTFT (s)")
     p.add_argument("--name", default="", help="instance id (X-Served-By header)")
+    p.add_argument("--ready-delay", type=float, default=0.0,
+                   help="simulated warmup: /ready reports warming for this "
+                        "many seconds after start")
+    p.add_argument("--warmup-cache-dir", default=None,
+                   help="simulated persistent compile cache: a marker left "
+                        "by a previous instance makes this start warm "
+                        "(shorter ready delay, all cache hits)")
     args = p.parse_args(argv)
-    app = create_fake_engine_app(args.model, args.speed, args.ttft, args.name)
+    app = create_fake_engine_app(
+        args.model, args.speed, args.ttft, args.name,
+        ready_delay=args.ready_delay, warmup_cache_dir=args.warmup_cache_dir,
+    )
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 
 

@@ -46,6 +46,56 @@ def test_values_files_validate_against_schema(values_file):
     jsonschema.validate(_load_values(HELM_DIR / values_file), schema)
 
 
+def test_engine_template_readiness_probe_targets_ready():
+    """The engine deployment's readinessProbe must hit /ready (warmup
+    gated), while startup/liveness stay on /health — a warming engine is
+    alive but must leave the Service until precompilation finishes."""
+    text = (HELM_DIR / "templates" / "deployment-engine.yaml").read_text()
+    assert "readinessProbe" in text
+    assert "path: /ready" in text
+    # Liveness must NOT move to /ready: a long precompile would get the
+    # pod killed mid-warmup.
+    liveness = text.split("livenessProbe", 1)[1].split("readinessProbe")[0]
+    assert "/health" in liveness
+
+
+def test_engine_template_wires_warmup_flags_and_cache_volume():
+    text = (HELM_DIR / "templates" / "deployment-engine.yaml").read_text()
+    assert '"--warmup"' in text
+    assert '"--warmup-bucket-budget"' in text
+    assert '"--compile-cache-dir"' in text
+    # Cache volume supports both persistence shapes.
+    assert "compile-cache" in text
+    assert "cachePVC" in text.replace("$warmup.cachePVC", "cachePVC")
+    assert "hostPath" in text
+    # A cacheDir with no backing mount must fail the render loudly, not
+    # silently write the "persistent" cache to the container overlay FS.
+    assert 'fail "servingEngineSpec.warmup.cacheDir is set but neither' in text
+
+
+def test_values_schema_covers_warmup():
+    with open(HELM_DIR / "values.schema.json") as f:
+        schema = json.load(f)
+    warmup = schema["properties"]["servingEngineSpec"]["properties"]["warmup"]
+    props = warmup["properties"]
+    assert set(props) == {
+        "mode", "bucketBudget", "cacheDir", "cachePVC", "cacheHostPath"
+    }
+    assert props["mode"]["enum"] == ["full", "lazy", "off"]
+
+    import jsonschema
+
+    # Defaults ship warmup on.
+    values = _load_values(HELM_DIR / "values.yaml")
+    assert values["servingEngineSpec"]["warmup"]["mode"] == "full"
+    # An invalid mode must be rejected, not silently templated.
+    bad = dict(values)
+    bad["servingEngineSpec"] = dict(values["servingEngineSpec"])
+    bad["servingEngineSpec"]["warmup"] = {"mode": "sometimes"}
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+
+
 def test_templates_have_balanced_go_template_delimiters():
     for tpl in sorted((HELM_DIR / "templates").glob("*")):
         text = tpl.read_text()

@@ -438,6 +438,40 @@ async def test_engine_initiated_drain_reconciles_via_traffic():
             )
 
 
+async def test_engine_warming_reconciles_via_traffic():
+    """An engine mid-precompile (warming) while the router runs no health
+    probes: the proxy recognizes the X-PST-Warming-tagged 503, fails the
+    request over, marks the endpoint warming in discovery, and leaves its
+    breaker and failure stats untouched — a rolling deploy's cold engine
+    never absorbs live traffic or breaker penalties."""
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Flip engine 0 into a (simulated) warmup behind the router's
+            # back — the restarted-pod shape.
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/warmup",
+                json={"ready_delay": 30.0},
+            ) as resp:
+                assert resp.status == 200
+            for i in range(6):
+                status, by, _ = await _completion(s, c.router_url, prompt=f"w{i}")
+                assert status == 200
+                assert by != "engine-0"
+            async with s.get(f"{c.router_url}/engines") as resp:
+                info = {e["url"]: e for e in await resp.json()}
+            assert info[c.engine_urls[0]]["warming"] is True
+            # Warming rejections are deliberate, not failures: breaker
+            # closed, no upstream-failure series, and the canary/metrics
+            # surface counts the engine as warming.
+            assert info[c.engine_urls[0]]["breaker"] == "closed"
+            text = await _router_metrics(s, c.router_url)
+            assert (
+                f'pst_resilience_upstream_failures_total{{server="{c.engine_urls[0]}"}}'
+                not in text
+            )
+            assert "pst_resilience_warming_engines 1.0" in text
+
+
 async def test_admin_endpoints_require_router_api_key():
     """With --api-key set, mutating admin endpoints (/drain, /undrain) are
     guarded like /v1 — an unauthenticated client must not be able to drain

@@ -150,6 +150,69 @@ def test_static_discovery_length_mismatch():
         StaticServiceDiscovery(urls=["http://a"], models=["m1", "m2"])
 
 
+def test_static_discovery_warming_flag():
+    """set_warming flips the endpoint's warming flag (reconciled by the
+    /ready probes, exactly like draining)."""
+    sd = StaticServiceDiscovery(
+        urls=["http://e0", "http://e1"], models=["llama", "llama"]
+    )
+    sd.set_warming("http://e1", True)
+    infos = {e.url: e for e in sd.get_endpoint_info()}
+    assert infos["http://e0"].warming is False
+    assert infos["http://e1"].warming is True
+    sd.set_warming("http://e1", False)
+    assert all(not e.warming for e in sd.get_endpoint_info())
+
+
+def test_warming_from_ready_interpretation():
+    from production_stack_tpu.router.service_discovery import (
+        warming_from_ready,
+    )
+
+    assert warming_from_ready(503, {"ready": False, "reason": "warming"})
+    assert not warming_from_ready(200, {"ready": True})
+    assert not warming_from_ready(404, None)  # pre-warmup engine
+    assert not warming_from_ready(503, None)  # non-JSON 5xx
+    assert not warming_from_ready(503, {"reason": "draining"})
+
+
+def test_filter_routable_excludes_warming():
+    from production_stack_tpu.router.routing.logic import filter_routable
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+
+    def ep(url, **kw):
+        return EndpointInfo(
+            url=url, model_names=["m"], Id=url, added_timestamp=0.0,
+            model_label="default", **kw,
+        )
+
+    eps = [
+        ep("http://ok"),
+        ep("http://warming", warming=True),
+        ep("http://draining", draining=True),
+    ]
+    routable = filter_routable(eps, apply_breakers=False)
+    assert [e.url for e in routable] == ["http://ok"]
+
+
+def test_canary_skips_warming_engines(event_loop):
+    """A warming engine must be skipped, not probed: a probe would queue
+    behind the precompile pass and feed the breaker a spurious failure."""
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+    from production_stack_tpu.router.services.canary import CanaryProber
+
+    prober = CanaryProber(interval=1.0)
+    warming_ep = EndpointInfo(
+        url="http://nowhere.invalid:1", model_names=["m"], Id="x",
+        added_timestamp=0.0, model_label="default", warming=True,
+    )
+    # _probe_one returns before touching the (absent) client session —
+    # probing a warming engine would raise here.
+    event_loop.run_until_complete(prober._probe_one(warming_ep))
+    assert prober.probes_total == 0
+    assert prober.failures_total == 0
+
+
 def test_hashtrie(event_loop):
     trie = HashTrie(chunk_size=4)
     event_loop.run_until_complete(trie.insert("abcdefgh", "e1"))
