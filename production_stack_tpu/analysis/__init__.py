@@ -1,0 +1,19 @@
+"""pstlint: project-invariant static analysis for production-stack-tpu.
+
+Generic linters know Python; they do not know that this codebase promises
+"no blocking call ever parks the router's event loop", "every jitted
+dispatch is reachable from the warmup lattice", "every hop carries the
+deadline/trace headers", "every ``pst_*`` metric is declared in the
+registry", and "shared router state has exactly one writer surface".
+Those invariants were bought by PRs 1-6 and are enforced at runtime only
+where a test happens to exercise them; this package enforces them at
+diff time, across every code path, with plain ``ast`` (no third-party
+dependencies, so the CI lint ring needs nothing installed).
+
+CLI: ``python -m production_stack_tpu.analysis.pstlint <paths...>`` or the
+``pst-lint`` entry point. See docs/static-analysis.md for the check
+catalogue, the suppression syntax (a reason is mandatory), and the
+``owned-by`` / ``jit-family`` annotation grammar.
+"""
+
+from .core import Finding, Project, SourceFile, load_project  # noqa: F401

@@ -1,0 +1,31 @@
+"""Check registry: one module per project invariant.
+
+Each check module exposes ``CHECK_ID`` (the name used in suppression
+comments and ``--checks``), ``DESCRIPTION`` (one line for ``--list-checks``)
+and ``run(project) -> list[Finding]``.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Dict
+
+from . import (
+    async_blocking,
+    hop_contract,
+    lock_discipline,
+    metric_registry,
+    recompile_risk,
+)
+
+ALL_CHECKS = (
+    async_blocking,
+    recompile_risk,
+    hop_contract,
+    metric_registry,
+    lock_discipline,
+)
+
+CHECKS_BY_ID: Dict[str, types.ModuleType] = {
+    c.CHECK_ID: c for c in ALL_CHECKS
+}

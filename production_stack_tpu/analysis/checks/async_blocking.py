@@ -1,0 +1,144 @@
+"""async-blocking: no synchronous blocking calls on async paths.
+
+The router, resilience layer, observability surface and KV control plane
+are one asyncio event loop. A single ``time.sleep``, synchronous
+``requests``/``urllib`` call, ``subprocess`` invocation or plain-``open``
+file read inside an ``async def`` stalls EVERY in-flight request for its
+duration — the class of bug that turns a 5 ms p50 router into a 2 s p99
+router with nothing in a profile to show for it. Runtime tests only catch
+the blocking calls they happen to drive; this check covers every
+``async def`` body in the tree.
+
+Two rules:
+
+1. Inside any ``async def`` body (nested synchronous ``def``/``lambda``
+   bodies are excluded — they run wherever they are called), flag calls
+   to the known blocking surface: ``time.sleep``, the ``requests``
+   module, ``urllib.request.urlopen``, ``subprocess.*``, ``os.system`` /
+   ``os.popen`` / ``os.wait*``, builtin ``open``, and the pathlib
+   read/write quartet (``read_text``/``write_text``/``read_bytes``/
+   ``write_bytes``).
+2. ``time.sleep`` anywhere — async or sync — inside the event-loop
+   packages (``router/``, ``resilience/``, ``obs/``, ``kvserver/``,
+   ``engine/``): sync helpers in these packages are routinely called
+   from coroutines, so a hard sleep needs an explicit justification
+   (e.g. the runner's device-poll on its dedicated step thread carries a
+   suppression naming that thread).
+
+Suppress with ``# pstlint: disable=async-blocking(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, FunctionStack, Project, SourceFile, dotted_name
+
+CHECK_ID = "async-blocking"
+DESCRIPTION = (
+    "blocking calls (time.sleep / sync HTTP / sync file IO / subprocess) "
+    "on async paths"
+)
+
+# Packages whose sync code also may not hard-sleep (rule 2).
+_LOOP_PACKAGES = ("router", "resilience", "obs", "kvserver", "engine")
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep blocks the event loop — use await asyncio.sleep",
+    "urllib.request.urlopen": "sync urllib blocks the event loop — use the "
+    "shared aiohttp session",
+    "os.system": "os.system blocks the event loop — use asyncio.create_subprocess_*",
+    "os.popen": "os.popen blocks the event loop — use asyncio.create_subprocess_*",
+    "os.wait": "os.wait blocks the event loop",
+    "os.waitpid": "os.waitpid blocks the event loop",
+    "socket.create_connection": "sync socket connect blocks the event loop",
+}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output", "Popen"}
+_PATHLIB_IO = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+def _requests_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the ``requests`` module by imports."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "requests":
+                    aliases.add(a.asname or "requests")
+    return aliases
+
+
+class _Visitor(FunctionStack):
+    def __init__(self, src: SourceFile, loop_package: bool) -> None:
+        super().__init__()
+        self.src = src
+        self.loop_package = loop_package
+        self.requests_aliases = (
+            _requests_aliases(src.tree) if src.tree else set()
+        )
+        self.findings: List[Finding] = []
+
+    # A nested sync def inside an async def pops the async context: calls
+    # in its body execute wherever the closure runs. FunctionStack already
+    # pushes it, and ``in_async_def`` looks only at the innermost frame.
+
+    def _report(self, node: ast.Call, why: str) -> None:
+        self.findings.append(Finding(
+            CHECK_ID, self.src.rel, node.lineno, node.col_offset, why
+        ))
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name is not None:
+            if name in _BLOCKING_DOTTED:
+                return _BLOCKING_DOTTED[name]
+            head = name.split(".")[0]
+            if head in self.requests_aliases and "." in name:
+                return (
+                    "sync 'requests' call blocks the event loop — use the "
+                    "shared aiohttp session"
+                )
+            if head == "subprocess" and name.split(".")[-1] in _SUBPROCESS_FUNCS:
+                return (
+                    "sync subprocess call blocks the event loop — use "
+                    "asyncio.create_subprocess_*"
+                )
+            if name == "open":
+                return (
+                    "builtin open() blocks the event loop — use aiofiles "
+                    "or a thread executor"
+                )
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _PATHLIB_IO:
+            return (
+                "sync file IO (.%s) blocks the event loop — use aiofiles "
+                "or a thread executor" % node.func.attr
+            )
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if self.in_async_def:
+            why = self._blocking_reason(node)
+            if why is not None:
+                self._report(node, why)
+        elif self.loop_package and name == "time.sleep":
+            self._report(node, (
+                "time.sleep in an event-loop package: sync helpers here "
+                "are called from coroutines — if this sleep runs on a "
+                "dedicated thread, say so in a suppression"
+            ))
+        self.generic_visit(node)
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        segs = src.rel.replace("\\", "/").split("/")
+        loop_package = any(p in segs for p in _LOOP_PACKAGES)
+        v = _Visitor(src, loop_package)
+        v.visit(src.tree)
+        findings.extend(v.findings)
+    return findings

@@ -1,0 +1,191 @@
+"""hop-contract: every router hop carries the propagation headers, every
+error response carries X-Request-Id.
+
+PRs 2-3 made three headers load-bearing on every router->engine hop:
+``X-PST-Deadline-Ms`` (budget shedding), ``traceparent`` (one W3C trace
+across retries/hedges/resume legs) and ``X-Request-Id`` (log/timeline
+join key). An outbound request built by hand silently drops all three —
+the engine still answers, nothing fails, and the request simply vanishes
+from traces and stops honoring its deadline. Same story for error
+responses: PR 3's contract is that every shed/error response names the
+request id so a client can quote it back at support.
+
+Two rules:
+
+1. **Outbound headers** (files under ``router/``): any HTTP verb call on
+   an aiohttp client session (``session.get/post/put/patch/delete/request``,
+   or any receiver ending in ``session``/``sess``) must pass ``headers=``
+   derived from a sanctioned builder — ``hop_headers`` (router/hop.py) or
+   its request_service wrapper ``_trace_headers`` — either called inline
+   or via a name assigned from one. Control-plane loops that originate
+   traffic (canary probes, stats scrapes, discovery probes, k8s watches)
+   carry file-level suppressions naming why no request context exists.
+2. **Error responses** (files under ``router/``, ``obs/``,
+   ``resilience/``): a ``web.json_response(...)`` / ``web.Response(...)``
+   with a literal ``status=`` >= 400 must include ``X-Request-Id`` in its
+   ``headers=`` — inline dict with the literal key, a name assigned from
+   one, or a call to a sanctioned error-header builder
+   (``error_headers`` / ``_error_headers``).
+
+Suppress with ``# pstlint: disable=hop-contract(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import (
+    Finding,
+    FunctionStack,
+    Project,
+    SourceFile,
+    assignments_in,
+    dotted_name,
+    keyword_arg,
+    literal_str,
+)
+
+CHECK_ID = "hop-contract"
+DESCRIPTION = (
+    "outbound router hops must propagate deadline/trace/request-id "
+    "headers; error responses must carry X-Request-Id"
+)
+
+_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "request", "head"}
+_SANCTIONED_HEADER_BUILDERS = {"hop_headers", "_trace_headers"}
+_SANCTIONED_ERROR_BUILDERS = {"error_headers", "_error_headers"}
+_REQUEST_ID_HEADER = "X-Request-Id"
+
+
+def _is_session_receiver(recv: ast.AST) -> bool:
+    """Heuristic: the receiver of a verb call is an HTTP client session.
+
+    Matches names/attributes whose final component ends with ``session``
+    or equals ``sess`` (the repo's naming convention for aiohttp client
+    sessions), plus the ``aiohttp`` module itself."""
+    name = dotted_name(recv)
+    if name is None:
+        return False
+    last = name.split(".")[-1].lower()
+    return (
+        last.endswith("session") or last == "sess" or name == "aiohttp"
+    )
+
+
+def _builder_call(node: ast.AST, sanctioned: set) -> bool:
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name is not None and name.split(".")[-1] in sanctioned:
+            return True
+    return False
+
+
+class _Visitor(FunctionStack):
+    def __init__(self, src: SourceFile, check_hops: bool,
+                 check_errors: bool) -> None:
+        super().__init__()
+        self.src = src
+        self.check_hops = check_hops
+        self.check_errors = check_errors
+        self.findings: List[Finding] = []
+
+    def _resolve(self, node: ast.AST) -> ast.AST:
+        """One level of name->RHS resolution, searching the enclosing
+        functions innermost-first (closures routinely capture headers
+        built in the outer handler)."""
+        if isinstance(node, ast.Name):
+            for func in reversed(self.func_stack):
+                rhs = assignments_in(func).get(node.id)
+                if rhs is not None:
+                    return rhs
+        return node
+
+    # -- rule 1: outbound hops --------------------------------------------
+
+    def _check_hop(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in _HTTP_VERBS:
+            return
+        if not _is_session_receiver(node.func.value):
+            return
+        headers = keyword_arg(node, "headers")
+        if headers is not None:
+            resolved = self._resolve(headers)
+            if _builder_call(resolved, _SANCTIONED_HEADER_BUILDERS):
+                return
+            # hop_headers(...) piped through a further dict call or
+            # conditional is out of reach for one-level resolution; the
+            # site then needs a suppression explaining itself.
+        self.findings.append(Finding(
+            CHECK_ID, self.src.rel, node.lineno, node.col_offset,
+            "outbound %s.%s() does not pass headers built by "
+            "hop_headers()/_trace_headers() — the deadline/trace/request-id "
+            "contract (PRs 2-3) is dropped on this hop"
+            % (dotted_name(node.func.value) or "session", node.func.attr),
+        ))
+
+    # -- rule 2: error responses ------------------------------------------
+
+    def _error_status(self, node: ast.Call) -> Optional[int]:
+        status = keyword_arg(node, "status")
+        if isinstance(status, ast.Constant) and isinstance(status.value, int):
+            return status.value if status.value >= 400 else None
+        return None
+
+    def _headers_carry_request_id(self, node: ast.AST) -> bool:
+        node = self._resolve(node)
+        if _builder_call(node, _SANCTIONED_ERROR_BUILDERS):
+            return True
+        if _builder_call(node, _SANCTIONED_HEADER_BUILDERS):
+            return True
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                ks = literal_str(key) if key is not None else None
+                if ks is not None and ks.lower() == _REQUEST_ID_HEADER.lower():
+                    return True
+        return False
+
+    def _check_error_response(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name is None:
+            return
+        last = name.split(".")[-1]
+        if last not in ("json_response", "Response", "HTTPException"):
+            return
+        status = self._error_status(node)
+        if status is None:
+            return
+        headers = keyword_arg(node, "headers")
+        if headers is not None and self._headers_carry_request_id(headers):
+            return
+        self.findings.append(Finding(
+            CHECK_ID, self.src.rel, node.lineno, node.col_offset,
+            "error response (status=%d) does not carry %s — clients and "
+            "log correlation lose the request id on exactly the paths "
+            "that need it (PR 3 contract)" % (status, _REQUEST_ID_HEADER),
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.check_hops:
+            self._check_hop(node)
+        if self.check_errors:
+            self._check_error_response(node)
+        self.generic_visit(node)
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        segs = src.rel.replace("\\", "/").split("/")
+        check_hops = "router" in segs
+        check_errors = any(p in segs for p in ("router", "obs", "resilience"))
+        if not (check_hops or check_errors):
+            continue
+        v = _Visitor(src, check_hops, check_errors)
+        v.visit(src.tree)
+        findings.extend(v.findings)
+    return findings

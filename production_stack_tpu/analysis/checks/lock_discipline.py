@@ -1,0 +1,273 @@
+"""lock-discipline: declared shared state is only mutated by its owner.
+
+The router holds all routing state in one process today — breaker
+registry, stats windows, stream journals, prefix hashtrie — and stays
+correct because asyncio gives it one thread and each structure has ONE
+writer surface (a lock, or a single writer task/method family). ROADMAP
+item 5 (router data-plane scale-out) is exactly the refactor where a
+second writer slips in: a new code path mutates ``engine_stats`` off the
+scrape loop, or touches trie nodes without the node lock, and nothing
+fails until replicas disagree under load. This check makes the ownership
+machine-readable and enforced.
+
+Grammar (on the state's declaration line, or the line above):
+
+- ``# pstlint: owned-by=lock:<attr>`` — mutations of this attribute on a
+  receiver ``r`` must sit inside ``with r.<attr-of-lock>`` /
+  ``async with r.<lock>`` (textual receiver match), or inside a function
+  annotated ``# pstlint: holds=r.<lock>``.
+- ``# pstlint: owned-by=task:<fn>[,<fn>...]`` — mutations are legal only
+  inside the named functions/methods (``*`` suffix globs allowed, e.g.
+  ``task:on_request_*``) plus the object's own ``__init__`` (mutations of
+  ``self.<attr>`` — a different receiver's state mutated from an
+  unrelated ``__init__`` is a second writer like any other).
+
+A "mutation" is: rebinding the attribute, item assignment/deletion on
+it, augmented assignment, or calling a mutating method (``append``,
+``add``, ``pop``, ``update``, ``clear``, ...) on it. Matching is by
+attribute name within the declaring file — aliasing through locals or
+cross-module mutation is out of reach by design (documented in
+docs/static-analysis.md); the point is to catch the easy-to-write,
+hard-to-debug direct second writer.
+
+Suppress with ``# pstlint: disable=lock-discipline(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, SourceFile
+
+CHECK_ID = "lock-discipline"
+DESCRIPTION = (
+    "mutations of owned-by annotated shared state outside the owning "
+    "lock or single-writer task"
+)
+
+_MUTATORS = {
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "clear",
+    "update", "insert", "remove", "extend", "extendleft", "setdefault",
+    "discard", "sort", "reverse",
+}
+
+
+class _Owned:
+    def __init__(self, attr: str, kind: str, spec: str, line: int,
+                 is_global: bool) -> None:
+        self.attr = attr
+        self.kind = kind  # "lock" | "task"
+        self.spec = spec
+        self.line = line
+        # Declared as a module-level bare name (vs an instance/class
+        # attribute): only then does a bare-name write count as a
+        # mutation — otherwise locals that happen to share the attribute
+        # name would false-positive.
+        self.is_global = is_global
+
+
+def _collect_owned(src: SourceFile) -> Dict[str, _Owned]:
+    """attr-name -> ownership, from annotated declarations."""
+    owned: Dict[str, _Owned] = {}
+    if src.tree is None:
+        return owned
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = src.annotation_at(node.lineno, "owned-by")
+            if value is None:
+                continue
+            kind, _, spec = value.partition(":")
+            kind = kind.strip()
+            spec = spec.strip()
+            if kind not in ("lock", "task") or not spec:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                attr: Optional[str] = None
+                is_global = False
+                if isinstance(tgt, ast.Attribute):
+                    attr = tgt.attr
+                elif isinstance(tgt, ast.Name):
+                    attr = tgt.id
+                    is_global = True
+                if attr:
+                    owned[attr] = _Owned(attr, kind, spec, node.lineno,
+                                         is_global)
+    return owned
+
+
+def _mutated_target(node: ast.AST) -> Optional[Tuple[str, str, ast.AST]]:
+    """(attr, receiver_text, site) when ``node`` mutates ``recv.attr`` or
+    a bare annotated global. receiver_text is '' for bare names."""
+    def from_expr(expr: ast.AST) -> Optional[Tuple[str, str, ast.AST]]:
+        # recv.attr  /  recv.attr[...]  (unwrap one subscript level)
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Attribute):
+            try:
+                recv = ast.unparse(expr.value)
+            except Exception:  # pragma: no cover — exotic receiver
+                return None
+            return expr.attr, recv, expr
+        if isinstance(expr, ast.Name):
+            return expr.id, "", expr
+        return None
+
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            hit = from_expr(tgt)
+            if hit:
+                return hit
+    elif isinstance(node, ast.AugAssign):
+        return from_expr(node.target)
+    elif isinstance(node, ast.Delete):
+        for tgt in node.targets:
+            hit = from_expr(tgt)
+            if hit:
+                return hit
+    elif isinstance(node, ast.Call):
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            return from_expr(node.func.value)
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, owned: Dict[str, _Owned]) -> None:
+        self.src = src
+        self.owned = owned
+        self.findings: List[Finding] = []
+        self.func_stack: List[ast.AST] = []
+        self.with_stack: List[str] = []
+
+    # -- context tracking --------------------------------------------------
+
+    def _visit_func(self, node: ast.AST) -> None:
+        self.func_stack.append(node)
+        saved = self.with_stack
+        self.with_stack = []  # with-blocks do not span function boundaries
+        self.generic_visit(node)
+        self.with_stack = saved
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _visit_with(self, node: ast.AST) -> None:
+        ctxs = []
+        for item in node.items:
+            try:
+                ctxs.append(ast.unparse(item.context_expr))
+            except Exception:  # pragma: no cover
+                pass
+        self.with_stack.extend(ctxs)
+        self.generic_visit(node)
+        del self.with_stack[len(self.with_stack) - len(ctxs):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # -- the rule ----------------------------------------------------------
+
+    def _enclosing_name(self) -> Optional[str]:
+        if not self.func_stack:
+            return None
+        fn = self.func_stack[-1]
+        return getattr(fn, "name", None)
+
+    def _holds(self, wanted: str) -> bool:
+        if wanted in self.with_stack:
+            return True
+        for fn in self.func_stack:
+            line = getattr(fn, "lineno", None)
+            if line is None:
+                continue
+            held = self.src.annotation_at(line, "holds")
+            if held is not None and held.strip() == wanted:
+                return True
+        return False
+
+    def _check(self, node: ast.AST) -> None:
+        hit = _mutated_target(node)
+        if hit is None:
+            return
+        attr, recv, site = hit
+        owner = self.owned.get(attr)
+        if owner is None:
+            return
+        if not recv and not owner.is_global:
+            # Bare-name write, but the state is an attribute: this is a
+            # local variable that shares the name, not the shared state.
+            return
+        fn_name = self._enclosing_name()
+        if fn_name == "__init__" and recv == "self":
+            # Construction of the object's OWN state in its __init__ is
+            # the legal first write. A different receiver (some other
+            # object's owned state mutated from an unrelated __init__) is
+            # a second writer like any other and falls through.
+            return
+        if fn_name is None and not recv:
+            # The module-level declaration/rebind of an annotated global
+            # is its first write; attribute mutations at module level
+            # still get checked below.
+            return
+        if owner.kind == "task":
+            allowed = [p.strip() for p in owner.spec.split(",") if p.strip()]
+            if fn_name is not None and any(
+                fnmatch.fnmatchcase(fn_name, pat) for pat in allowed
+            ):
+                return
+            self.findings.append(Finding(
+                CHECK_ID, self.src.rel, site.lineno, site.col_offset,
+                "%r is owned by writer task/method(s) %s (declared line "
+                "%d) but is mutated here in %r — a second writer surface "
+                "breaks the single-writer contract ROADMAP item 5 scales "
+                "out on" % (attr, owner.spec, owner.line,
+                            fn_name or "<module level>"),
+            ))
+        else:  # lock
+            wanted = "%s.%s" % (recv, owner.spec) if recv else owner.spec
+            if self._holds(wanted):
+                return
+            self.findings.append(Finding(
+                CHECK_ID, self.src.rel, site.lineno, site.col_offset,
+                "%r is owned by lock %r (declared line %d) but is mutated "
+                "here outside 'with %s' (and no enclosing '# pstlint: "
+                "holds=%s')" % (attr, owner.spec, owner.line, wanted, wanted),
+            ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        owned = _collect_owned(src)
+        if not owned:
+            continue
+        v = _Visitor(src, owned)
+        v.visit(src.tree)
+        findings.extend(v.findings)
+    return findings

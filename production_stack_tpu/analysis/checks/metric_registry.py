@@ -1,0 +1,167 @@
+"""metric-registry: every pst metric is declared once, documented once.
+
+``pst_*`` metric names are a public contract — dashboards, recording
+rules, burn-rate alerts, bench assertions and operators' PromQL all key
+on them. ``production_stack_tpu/obs/metric_registry.py`` is the single
+declaration point; this check enforces the triangle:
+
+1. **code -> registry**: every ``Counter("pst...")`` / ``Gauge`` /
+   ``Histogram`` constructor in the tree must match a declared
+   :class:`MetricSpec` (name AND kind — a counter redeclared as a gauge
+   changes its exposition name and silently breaks every consumer).
+2. **registry -> code**: a declared metric no constructor registers is
+   stale — dashboards would chart a series that never exists.
+3. **registry -> docs**: every declared metric's exposition name must
+   appear in ``docs/observability.md`` (family wildcards like
+   ``pst_resilience_*`` cover their prefix, as before).
+
+The registry module is parsed by AST, not imported, so the check runs on
+a bare checkout even if the package does not import.
+
+Suppress with ``# pstlint: disable=metric-registry(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, SourceFile, dotted_name, literal_str
+
+CHECK_ID = "metric-registry"
+DESCRIPTION = (
+    "pst metric constructors must match obs/metric_registry.py; "
+    "declarations must be live and documented"
+)
+
+_REGISTRY_REL = "obs/metric_registry.py"
+_DOC_REL = "docs/observability.md"
+_CONSTRUCTORS = {"Counter": "counter", "Gauge": "gauge", "Histogram": "histogram"}
+_WILDCARD_RE = re.compile(r"(pst[\w:]*)\*")
+
+
+def declared_specs(src: SourceFile) -> Dict[str, Tuple[str, int]]:
+    """name -> (kind, line) parsed from MetricSpec(...) literals."""
+    out: Dict[str, Tuple[str, int]] = {}
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if (dotted_name(node.func) or "").split(".")[-1] != "MetricSpec":
+            continue
+        args = list(node.args)
+        name = literal_str(args[0]) if args else None
+        kind: Optional[str] = None
+        if len(args) >= 2:
+            # Second positional is the kind: either a string literal or
+            # one of the COUNTER/GAUGE/HISTOGRAM module constants.
+            kind = literal_str(args[1]) or {
+                "COUNTER": "counter", "GAUGE": "gauge", "HISTOGRAM": "histogram",
+            }.get(dotted_name(args[1]) or "")
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name = literal_str(kw.value)
+            elif kw.arg == "kind":
+                kind = literal_str(kw.value)
+        if name:
+            out[name] = (kind or "?", node.lineno)
+    return out
+
+
+def constructed_metrics(
+    project: Project,
+) -> List[Tuple[str, str, SourceFile, int, int]]:
+    """(name, kind, file, line, col) for every pst-prefixed constructor."""
+    out = []
+    for src in project.files:
+        if src.tree is None or src.rel.endswith(_REGISTRY_REL):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = (dotted_name(node.func) or "").split(".")[-1]
+            kind = _CONSTRUCTORS.get(ctor)
+            if kind is None or not node.args:
+                continue
+            name = literal_str(node.args[0])
+            if name is None or not name.startswith("pst"):
+                continue
+            out.append((name, kind, src, node.lineno, node.col_offset))
+    return out
+
+
+def _exposition(name: str, kind: str) -> str:
+    if kind == "counter" and not name.endswith("_total"):
+        return name + "_total"
+    return name
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    # A subset lint (changed-files workflows) still resolves the registry
+    # from the repo root; the reverse (stale) and docs checks below only
+    # run when the registry's tree was actually scanned, because "no
+    # constructor in scope" is meaningless on a partial file set.
+    registry_in_scan = bool(project.find(_REGISTRY_REL))
+    registry = project.resolve(_REGISTRY_REL)
+    constructed = constructed_metrics(project)
+    if registry is None:
+        if constructed:
+            name, _, src, line, col = constructed[0]
+            findings.append(Finding(
+                CHECK_ID, src.rel, line, col,
+                "pst metrics are constructed but no %s exists to declare "
+                "them" % _REGISTRY_REL,
+            ))
+        return findings
+    declared = declared_specs(registry)
+
+    seen_names = set()
+    for name, kind, src, line, col in constructed:
+        seen_names.add(name)
+        spec = declared.get(name)
+        if spec is None:
+            findings.append(Finding(
+                CHECK_ID, src.rel, line, col,
+                "metric %r is not declared in %s — add a MetricSpec so "
+                "dashboards/rules/docs have one source of truth"
+                % (name, registry.rel),
+            ))
+        elif spec[0] != kind:
+            findings.append(Finding(
+                CHECK_ID, src.rel, line, col,
+                "metric %r is constructed as a %s but declared as a %s in "
+                "%s — kind decides the exposition name (_total suffix), "
+                "so every consumer breaks" % (name, kind, spec[0], registry.rel),
+            ))
+
+    for name, (kind, line) in sorted(declared.items()):
+        if registry_in_scan and name not in seen_names:
+            findings.append(Finding(
+                CHECK_ID, registry.rel, line, 0,
+                "declared metric %r has no Counter/Gauge/Histogram "
+                "constructor anywhere in the scanned tree — stale "
+                "declaration (or the constructor moved out of scan scope)"
+                % name,
+            ))
+
+    # Docs coverage (absorbs the old scripts/check_metric_docs.py scan).
+    doc_path = project.root / _DOC_REL
+    if registry_in_scan and doc_path.exists():
+        doc_text = doc_path.read_text(encoding="utf-8")
+        prefixes = [p for p in _WILDCARD_RE.findall(doc_text) if len(p) > 4]
+        for name, (kind, line) in sorted(declared.items()):
+            expo = _exposition(name, kind)
+            if name in doc_text or expo in doc_text:
+                continue
+            if any(name.startswith(p) for p in prefixes):
+                continue
+            findings.append(Finding(
+                CHECK_ID, registry.rel, line, 0,
+                "declared metric %r is not documented in %s (nor covered "
+                "by a family wildcard) — the docs are the operator "
+                "contract" % (expo, _DOC_REL),
+            ))
+    return findings

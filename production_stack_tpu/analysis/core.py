@@ -1,0 +1,390 @@
+"""pstlint core: source model, suppression grammar, finding plumbing.
+
+Design constraints:
+
+- **stdlib only.** The lint ring must run on a bare checkout (CI installs
+  nothing for it) and the analyzer is imported by the test suite, so
+  everything here is ``ast`` + ``tokenize``.
+- **Suppressions carry a reason.** ``# pstlint: disable=<check>(<reason>)``
+  — a reasonless disable is itself a finding (``bad-suppression``), and a
+  disable that never suppresses anything is flagged too
+  (``unused-suppression``) so stale escapes rot away instead of
+  accumulating.
+- **Annotations are comments.** ``# pstlint: owned-by=...`` /
+  ``jit-family=...`` / ``holds=...`` attach machine-readable contracts to
+  declarations without imports or decorators (the annotated modules must
+  stay importable with the analyzer absent).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Checks that the framework itself emits (not registered check modules).
+BAD_SUPPRESSION = "bad-suppression"
+UNUSED_SUPPRESSION = "unused-suppression"
+SYNTAX_ERROR = "syntax-error"
+
+_DIRECTIVE_RE = re.compile(r"#\s*pstlint:\s*(?P<body>.*)$")
+_DISABLE_RE = re.compile(
+    r"disable(?P<scope>-file)?="
+    r"(?P<check>[A-Za-z0-9_-]+)"
+    r"(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?"
+)
+# Annotation directives: key=value where value runs to end-of-comment
+# (values may contain commas, colons and spaces; never a second '=').
+_ANNOTATION_RE = re.compile(
+    r"(?P<key>owned-by|jit-family|holds)=(?P<value>[^=]+?)\s*$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    check: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None  # the suppression's reason, when suppressed
+
+    def format(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return "%s:%d:%d: [%s] %s%s" % (
+            self.path, self.line, self.col, self.check, self.message, tag
+        )
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    check: str
+    line: int  # line the directive comment sits on
+    reason: str
+    file_wide: bool
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed module: AST + the pstlint comment directives in it."""
+
+    def __init__(self, path: Path, rel: str, text: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:
+            self.syntax_error = e
+        # line -> raw directive body (only comment lines bearing the tag).
+        self.directives: Dict[int, str] = {}
+        self.suppressions: List[Suppression] = []
+        self.bad_directives: List[Tuple[int, str]] = []
+        # line -> {key: value} for annotation directives.
+        self.annotations: Dict[int, Dict[str, str]] = {}
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [
+                (t.start[0], t.string)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []
+        for line, comment in comments:
+            m = _DIRECTIVE_RE.search(comment)
+            if not m:
+                continue
+            body = m.group("body").strip()
+            self.directives[line] = body
+            matched = False
+            for dm in _DISABLE_RE.finditer(body):
+                matched = True
+                reason = (dm.group("reason") or "").strip()
+                if not reason:
+                    self.bad_directives.append((
+                        line,
+                        "suppression of %r carries no reason — use "
+                        "disable=%s(<why this is safe>)"
+                        % (dm.group("check"), dm.group("check")),
+                    ))
+                    continue
+                self.suppressions.append(Suppression(
+                    check=dm.group("check"),
+                    line=line,
+                    reason=reason,
+                    file_wide=dm.group("scope") == "-file",
+                ))
+            for am in _ANNOTATION_RE.finditer(body):
+                matched = True
+                self.annotations.setdefault(line, {})[am.group("key")] = (
+                    am.group("value").strip()
+                )
+            if not matched:
+                self.bad_directives.append((
+                    line, "unrecognized pstlint directive: %r" % body
+                ))
+
+    # -- annotation lookup -------------------------------------------------
+
+    def annotation_at(self, line: int, key: str) -> Optional[str]:
+        """Annotation value attached to ``line``: on the line itself or on
+        a directive comment on the immediately preceding line."""
+        for cand in (line, line - 1):
+            ann = self.annotations.get(cand)
+            if ann and key in ann:
+                return ann[key]
+        return None
+
+    # -- suppression matching ----------------------------------------------
+
+    def suppression_for(self, check: str, line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.check != check:
+                continue
+            if s.file_wide or s.line in (line, line - 1):
+                return s
+        return None
+
+
+class Project:
+    """The file set under analysis plus the repo root for path resolution
+    (cross-file checks need to find e.g. ``engine/precompile.py`` and
+    ``docs/observability.md`` relative to it)."""
+
+    def __init__(self, files: Sequence[SourceFile], root: Path) -> None:
+        self.files = list(files)
+        self.root = root
+        # Cross-file anchors loaded by resolve() that were NOT part of the
+        # requested scan. Their suppressions/annotations apply to findings
+        # attributed to them, but they are excluded from the framework
+        # scans (syntax/bad-suppression/unused-suppression) — a subset
+        # lint must not start reporting on files nobody asked about.
+        self.auxiliary: Dict[str, SourceFile] = {}
+
+    def find(self, *suffixes: str) -> List[SourceFile]:
+        """Files whose relative path ends with any of ``suffixes`` (posix
+        separators)."""
+        out = []
+        for f in self.files:
+            rel = f.rel.replace("\\", "/")
+            if any(rel.endswith(s) for s in suffixes):
+                out.append(f)
+        return out
+
+    def resolve(self, suffix: str) -> Optional[SourceFile]:
+        """The file ending in ``suffix``: from the scanned set if present,
+        else loaded from disk under ``root``. Cross-file checks use this so
+        a subset lint (e.g. ``pst-lint production_stack_tpu/router/``) sees
+        the same registry/lattice anchors a full-tree lint does instead of
+        reporting them missing."""
+        hits = self.find(suffix)
+        if hits:
+            return hits[0]
+        for rel, cached in self.auxiliary.items():
+            if rel.replace("\\", "/").endswith(suffix):
+                return cached
+        basename = suffix.split("/")[-1]
+        for cand in sorted(self.root.rglob(basename)):
+            if any(part.startswith(".") for part in cand.parts):
+                continue
+            try:
+                rel = str(cand.relative_to(self.root))
+            except ValueError:  # pragma: no cover — symlink escape
+                continue
+            if rel.replace("\\", "/").endswith(suffix):
+                src = SourceFile(cand, rel, cand.read_text(encoding="utf-8"))
+                self.auxiliary[rel] = src
+                return src
+        return None
+
+    def in_dir(self, *parts: str) -> List[SourceFile]:
+        """Files whose relative path contains any of ``parts`` as a path
+        segment (e.g. ``router`` matches ``production_stack_tpu/router/...``)."""
+        out = []
+        for f in self.files:
+            segs = f.rel.replace("\\", "/").split("/")
+            if any(p in segs for p in parts):
+                out.append(f)
+        return out
+
+
+def iter_py_files(paths: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(sorted(
+                f for f in path.rglob("*.py")
+                if not any(part.startswith(".") for part in f.parts)
+            ))
+        elif path.suffix == ".py":
+            out.append(path)
+    # De-dup while preserving order (overlapping roots on the CLI).
+    seen = set()
+    uniq = []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def load_project(paths: Sequence[str], root: Optional[Path] = None) -> Project:
+    root = root or Path.cwd()
+    files = []
+    for f in iter_py_files(paths):
+        try:
+            rel = str(f.resolve().relative_to(root.resolve()))
+        except ValueError:
+            rel = str(f)
+        files.append(SourceFile(f, rel, f.read_text(encoding="utf-8")))
+    return Project(files, root)
+
+
+def apply_suppressions(
+    project: Project, findings: List[Finding], report_unused: bool = True
+) -> List[Finding]:
+    """Mark suppressed findings, then append the framework findings:
+    syntax errors, reasonless suppressions, and (optionally) suppressions
+    that never fired."""
+    # Auxiliary (resolve()-loaded) files participate in suppression
+    # matching — a finding attributed to an anchor honors the anchor's
+    # own disable= comments — but scanned files win on rel collisions.
+    by_rel = dict(project.auxiliary)
+    by_rel.update({f.rel: f for f in project.files})
+    for finding in findings:
+        src = by_rel.get(finding.path)
+        if src is None:
+            continue
+        sup = src.suppression_for(finding.check, finding.line)
+        if sup is not None:
+            sup.used = True
+            finding.suppressed = True
+            finding.reason = sup.reason
+    out = list(findings)
+    for src in project.files:
+        if src.syntax_error is not None:
+            out.append(Finding(
+                SYNTAX_ERROR, src.rel, src.syntax_error.lineno or 1, 0,
+                "file does not parse: %s" % src.syntax_error.msg,
+            ))
+        for line, msg in src.bad_directives:
+            out.append(Finding(BAD_SUPPRESSION, src.rel, line, 0, msg))
+        if report_unused:
+            for sup in src.suppressions:
+                if not sup.used:
+                    out.append(Finding(
+                        UNUSED_SUPPRESSION, src.rel, sup.line, 0,
+                        "suppression of %r never matched a finding — "
+                        "remove it (stale escapes hide future regressions)"
+                        % sup.check,
+                    ))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers used by several checks
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return None if base is None else base + "." + node.attr
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class FunctionStack(ast.NodeVisitor):
+    """Visitor base that tracks the enclosing (async) function chain."""
+
+    def __init__(self) -> None:
+        self.func_stack: List[ast.AST] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda body runs wherever the closure is called (executor,
+        # callback), not in the enclosing coroutine — same exclusion as a
+        # nested sync def.
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    @property
+    def current_function(self) -> Optional[ast.AST]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def in_async_def(self) -> bool:
+        return isinstance(self.current_function, ast.AsyncFunctionDef)
+
+
+def assignments_in(func: ast.AST) -> Dict[str, ast.AST]:
+    """name -> RHS expression for simple assignments inside ``func``
+    (including tuple unpacks, where every target name maps to the shared
+    RHS). Last assignment wins — a deliberate, documented approximation:
+    pstlint resolves one level of straight-line dataflow, not full SSA."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out[el.id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out[node.target.id] = node.value
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            if isinstance(node.optional_vars, ast.Name):
+                out[node.optional_vars.id] = node.context_expr
+    return out

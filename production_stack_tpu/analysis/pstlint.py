@@ -1,0 +1,151 @@
+"""pstlint CLI.
+
+Usage::
+
+    python -m production_stack_tpu.analysis.pstlint production_stack_tpu/ scripts/
+    pst-lint --format json production_stack_tpu/
+    pst-lint --checks async-blocking,hop-contract production_stack_tpu/router/
+
+Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
+findings, 2 = usage error. ``--format json`` emits a machine-readable
+report (list of finding objects + summary) for CI annotation tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .checks import ALL_CHECKS, CHECKS_BY_ID
+from .core import Finding, apply_suppressions, iter_py_files, load_project
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pst-lint",
+        description="Project-invariant static analyzer for "
+        "production-stack-tpu (see docs/static-analysis.md).",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--checks",
+        help="comma-separated subset of checks to run (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--root",
+        help="repo root for docs/registry resolution (default: cwd)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print findings silenced by disable= comments",
+    )
+    parser.add_argument(
+        "--no-unused", action="store_true",
+        help="do not flag suppressions that never fired (use when "
+        "linting a subset of checks or files)",
+    )
+    parser.add_argument(
+        "--list-checks", action="store_true", help="list checks and exit"
+    )
+    return parser
+
+
+def run_checks(
+    paths: Sequence[str],
+    checks: Optional[Sequence[str]] = None,
+    root: Optional[Path] = None,
+    report_unused: bool = True,
+) -> List[Finding]:
+    """Programmatic entry point (the test suite uses this)."""
+    project = load_project(paths, root=root)
+    selected = ALL_CHECKS if checks is None else [
+        CHECKS_BY_ID[c] for c in checks
+    ]
+    findings: List[Finding] = []
+    for check in selected:
+        findings.extend(check.run(project))
+    # Unused-suppression detection is only sound when every check ran:
+    # a hop-contract suppression is not stale just because only
+    # async-blocking was selected.
+    report_unused = report_unused and checks is None
+    return apply_suppressions(project, findings, report_unused=report_unused)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for check in ALL_CHECKS:
+            print("%-16s %s" % (check.CHECK_ID, check.DESCRIPTION))
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("pst-lint: error: no paths given", file=sys.stderr)
+        return 2
+
+    checks: Optional[List[str]] = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+        unknown = [c for c in checks if c not in CHECKS_BY_ID]
+        if unknown:
+            print(
+                "pst-lint: error: unknown check(s): %s (see --list-checks)"
+                % ", ".join(unknown),
+                file=sys.stderr,
+            )
+            return 2
+
+    # A misspelled or renamed path must be a loud error, not a vacuous
+    # green run — exit 0 on an empty file set would silently switch the
+    # whole invariant ring off.
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(
+            "pst-lint: error: path(s) do not exist: %s" % ", ".join(missing),
+            file=sys.stderr,
+        )
+        return 2
+    if not iter_py_files(args.paths):
+        print(
+            "pst-lint: error: no Python files found under: %s"
+            % ", ".join(args.paths),
+            file=sys.stderr,
+        )
+        return 2
+
+    root = Path(args.root) if args.root else None
+    findings = run_checks(
+        args.paths, checks=checks, root=root,
+        report_unused=not args.no_unused,
+    )
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.fmt == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "summary": {
+                "active": len(active),
+                "suppressed": len(suppressed),
+            },
+        }, indent=2))
+    else:
+        shown = findings if args.show_suppressed else active
+        for f in shown:
+            print(f.format())
+        print(
+            "pst-lint: %d finding(s), %d suppressed"
+            % (len(active), len(suppressed))
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ class CrossEncoder:
             self.params = self.model.init_params(jax.random.PRNGKey(0))
             tok_spec = None
         self.tokenizer = get_tokenizer(tok_spec, self.cfg.vocab_size)
+        # pstlint: disable=recompile-risk(cross-encoder rerank compiles once per padded pair-batch at first use; rerank is not on the TTFT-critical lattice and the one-time cost is accepted)
         self._fn = jax.jit(self.model.forward)
         self._lock = threading.Lock()  # one scoring dispatch at a time
 

@@ -92,6 +92,7 @@ def _fetch(arr) -> np.ndarray:
     except Exception:  # pragma: no cover — backends without async copy
         return np.asarray(jax.device_get(arr))
     while not arr.is_ready():
+        # pstlint: disable=async-blocking(0.3 ms device-readiness poll on the engine's dedicated step thread, never on an event loop; see the docstring above for the measured alternatives)
         time.sleep(0.0003)
     return np.asarray(jax.device_get(arr))
 
@@ -298,6 +299,7 @@ class ModelRunner:
         # Sampled tokens come back replicated: on a multi-host mesh the
         # primary must be able to device_get them (only addressable shards
         # are fetchable), and an all-gather of [B] int32 is free.
+        # pstlint: jit-family=decode,prefill
         self._step = jax.jit(
             step,
             static_argnums=(3, 4),
@@ -371,6 +373,7 @@ class ModelRunner:
             # [n, B, W] -> [B, n, W]
             return packed.transpose(1, 0, 2), tokens, positions, seed_off, kv_cache
 
+        # pstlint: jit-family=decode_burst
         self._multi_step = jax.jit(
             multi_step,
             static_argnums=(6, 7, 8),
@@ -471,6 +474,7 @@ class ModelRunner:
                 else None
             )
             if qaxis is None:
+                # pstlint: disable=recompile-risk(parameter materialization runs once at startup inside the load phase, before /ready — it can never be a live-traffic compile)
                 into[name] = jax.jit(
                     functools.partial(init_leaf, name, sds.shape, sds.dtype),
                     out_shardings=NamedSharding(
@@ -488,6 +492,7 @@ class ModelRunner:
 
             qname = name + (QUANT4_SUFFIX if int4 else QUANT_SUFFIX)
             q_sds, s_sds = jax.eval_shape(init_q, key)
+            # pstlint: disable=recompile-risk(weight quantization runs once at startup inside the load phase, before /ready — it can never be a live-traffic compile)
             q, s = jax.jit(
                 init_q,
                 out_shardings=(
@@ -524,6 +529,7 @@ class ModelRunner:
 
     def _dispatch_download_page(self, blk: int):
         if not hasattr(self, "_page_get"):
+            # pstlint: disable=recompile-risk(KV page download is a fixed-shape maintenance op — one compile per engine lifetime at first swap-out, off the TTFT path)
             self._page_get = jax.jit(
                 lambda c, i: c[:, i], out_shardings=self._repl
             )
@@ -543,6 +549,7 @@ class ModelRunner:
 
     def _dispatch_upload_page(self, blk: int, k_np, v_np) -> None:
         if not hasattr(self, "_page_set"):
+            # pstlint: disable=recompile-risk(KV page upload is a fixed-shape maintenance op — one compile per engine lifetime at first swap-in, off the TTFT path)
             self._page_set = jax.jit(
                 lambda c, i, x: c.at[:, i].set(x), donate_argnums=(0,)
             )
@@ -571,6 +578,7 @@ class ModelRunner:
 
     def _dispatch_install_adapter(self, slot: int, arrays: Dict[str, Any]) -> None:
         if not hasattr(self, "_slot_set"):
+            # pstlint: disable=recompile-risk(LoRA bank install is a fixed-shape admin op paid on adapter load, not on live decode)
             self._slot_set = jax.jit(
                 lambda bank, s, x: bank.at[:, s].set(x), donate_argnums=(0,)
             )
@@ -591,6 +599,7 @@ class ModelRunner:
 
     def _dispatch_uninstall_adapter(self, slot: int) -> None:
         if not hasattr(self, "_slot_zero"):
+            # pstlint: disable=recompile-risk(LoRA bank zeroing is a fixed-shape admin op paid on adapter unload, not on live decode)
             self._slot_zero = jax.jit(
                 lambda bank, s: bank.at[:, s].set(0.0), donate_argnums=(0,)
             )
@@ -670,6 +679,7 @@ class ModelRunner:
                     moe_impl=moe_impl, mesh=mesh,
                 )
 
+            # pstlint: jit-family=encode
             self._encode_fn = jax.jit(enc, out_shardings=self._repl)
         out = self._encode_fn(
             self.params,
@@ -875,6 +885,7 @@ class ModelRunner:
         tel = getattr(self, "_burst_tel", None)
         if tel is not None:
             key, bucket, rows_b, n = tel
+            # pstlint: disable=recompile-risk(key and bucket are carried verbatim from burst_start's registered _tel_key via _burst_tel — a continuation re-dispatches the same executable, so the shape identity cannot drift)
             ENGINE_TELEMETRY.record_dispatch(
                 "decode", key, time.perf_counter() - t0,
                 batch_bucket=bucket, tokens=alive * n,
@@ -1051,6 +1062,7 @@ class ModelRunner:
             cache_sh = NamedSharding(
                 self.mesh, Llama.cache_pspec(pipeline=pp > 1)
             )
+            # pstlint: jit-family=spec_verify
             self._spec_step = jax.jit(
                 spec_step,
                 donate_argnums=(1,),

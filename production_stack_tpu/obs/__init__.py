@@ -33,6 +33,7 @@ from .tracing import (
     RequestTrace,
     Span,
     SpanRecorder,
+    error_headers,
     format_traceparent,
     new_span_id,
     new_trace_id,
@@ -73,6 +74,7 @@ __all__ = [
     "Span",
     "SpanRecorder",
     "debug_requests_response",
+    "error_headers",
     "format_traceparent",
     "get_request_tracer",
     "initialize_request_tracing",

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from aiohttp import web
 
-from .tracing import SpanRecorder
+from .tracing import SpanRecorder, error_headers
 
 
 def debug_requests_response(
@@ -27,6 +27,7 @@ def debug_requests_response(
                                   "(--no-tracing or --debug-requests-buffer 0)",
                        "type": "not_found_error", "code": 404}},
             status=404,
+            headers=error_headers(request),
         )
     try:
         limit = int(request.query.get("limit", "50"))

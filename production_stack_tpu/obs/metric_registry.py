@@ -1,0 +1,110 @@
+"""The single source of truth for every ``pst`` metric name.
+
+Dashboards (observability/gen_dashboards.py), alert rules
+(prometheus-rules.yaml), docs/observability.md and operators' PromQL all
+key on these names; before this module they were re-listed in each
+consumer and drift was caught (at best) by a regex scan. Now: code that
+constructs a ``pst``-prefixed Counter/Gauge/Histogram must have a
+matching :class:`MetricSpec` here — the ``metric-registry`` pstlint
+check enforces both directions (undeclared constructor -> finding; stale
+declaration -> finding) plus docs coverage, and
+``scripts/check_metric_docs.py`` is a thin CI shim over the same logic.
+
+Kept importable with zero third-party dependencies (no prometheus_client
+import) so the analyzer and scripts can consume it on a bare checkout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One declared metric family.
+
+    ``name`` is the constructor name (what ``Counter(...)`` receives —
+    prometheus_client appends ``_total`` to counters at exposition);
+    ``module`` is the declaring module, for doc pointers.
+    """
+
+    name: str
+    kind: str
+    module: str
+
+    @property
+    def exposition_name(self) -> str:
+        if self.kind == COUNTER and not self.name.endswith("_total"):
+            return self.name + "_total"
+        return self.name
+
+
+# Declaration order groups by owning module (matches the metric rows in
+# docs/observability.md).
+REGISTRY: Tuple[MetricSpec, ...] = (
+    # --- obs/metrics.py: shared stage-latency decomposition -------------
+    MetricSpec("pst_stage_duration_seconds", HISTOGRAM, "obs/metrics.py"),
+    # --- obs/engine_telemetry.py: TPU engine device layer ---------------
+    MetricSpec("pst_engine_compile", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_compile_seconds", HISTOGRAM, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_step_duration_seconds", HISTOGRAM, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_batch_fill_ratio", HISTOGRAM, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_tokens_per_second", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_mfu", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_kv_page_occupancy", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_kv_page_high_watermark", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_preemptions", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_swap_out", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_swap_in", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_start_time_seconds", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_startup_seconds", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_warmup_coverage", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_warmup_buckets", GAUGE, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_compile_cache_hits", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_compile_cache_misses", COUNTER, "obs/engine_telemetry.py"),
+    # --- resilience/metrics.py: breakers, deadlines, hedges, resume -----
+    MetricSpec("pst_resilience_breaker_state", GAUGE, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_breaker_transitions_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_retries_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_failovers_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_upstream_failures_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_admitted_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_sheds_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_queue_depth", GAUGE, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_client_disconnects_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_draining_engines", GAUGE, "resilience/metrics.py"),
+    MetricSpec("pst_resilience_warming_engines", GAUGE, "resilience/metrics.py"),
+    MetricSpec("pst_deadline_budget_ms", HISTOGRAM, "resilience/metrics.py"),
+    MetricSpec("pst_deadline_sheds_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_hedge_fired_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_hedge_won_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_hedge_cancelled_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_hedge_suppressed_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_stream_resume_attempts_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_stream_resume_success_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_stream_resume_failures_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_stream_truncated_total", COUNTER, "resilience/metrics.py"),
+    # --- router/services/metrics_service.py: router process + SLO -------
+    MetricSpec("pst_router:cpu_percent", GAUGE, "router/services/metrics_service.py"),
+    MetricSpec("pst_router:memory_mb", GAUGE, "router/services/metrics_service.py"),
+    MetricSpec("pst_router:disk_percent", GAUGE, "router/services/metrics_service.py"),
+    MetricSpec("pst_slo_requests", COUNTER, "router/services/metrics_service.py"),
+    MetricSpec("pst_slo_ttft_within_target", COUNTER, "router/services/metrics_service.py"),
+    MetricSpec("pst_canary_ttft_seconds", GAUGE, "router/services/metrics_service.py"),
+    MetricSpec("pst_canary_failures", COUNTER, "router/services/metrics_service.py"),
+)
+
+BY_NAME: Dict[str, MetricSpec] = {s.name: s for s in REGISTRY}
+
+
+def declared_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in REGISTRY)
+
+
+def exposition_names() -> Tuple[str, ...]:
+    return tuple(s.exposition_name for s in REGISTRY)

@@ -43,6 +43,38 @@ _MAX_SPANS_PER_TRACE = 128
 _MAX_EVENTS_PER_SPAN = 32
 
 
+def error_headers(source=None, extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Headers for an error response: ``X-Request-Id`` plus ``extra``.
+
+    The sanctioned builder the ``hop-contract`` pstlint check recognizes
+    (docs/static-analysis.md): every 4xx/5xx constructed in router/obs/
+    resilience code passes its headers through here so the request id
+    survives even on paths that bypass the tracing middleware's
+    ``setdefault`` (e.g. responses prepared inside streaming handlers).
+
+    ``source`` may be the request id string, anything with a mapping
+    ``.get`` (an ``aiohttp.web.Request`` — reads the id the tracing
+    middleware stored), or None. With no id resolvable the header is
+    omitted so the middleware's setdefault (which knows the real id)
+    fills it rather than this helper inventing a second one.
+    """
+    headers: Dict[str, str] = dict(extra) if extra else {}
+    request_id: Optional[str] = None
+    if isinstance(source, str):
+        request_id = source
+    elif source is not None:
+        getter = getattr(source, "get", None)
+        if getter is not None:
+            request_id = getter("request_id")
+        if not request_id:
+            req_headers = getattr(source, "headers", None)
+            if req_headers is not None:
+                request_id = req_headers.get(REQUEST_ID_HEADER)
+    if request_id:
+        headers.setdefault(REQUEST_ID_HEADER, request_id)
+    return headers
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex
 

@@ -172,6 +172,10 @@ class CircuitBreakerRegistry:
         self.failure_threshold = failure_threshold
         self.recovery_time = recovery_time
         self.half_open_probes = half_open_probes
+        # Single-writer surface: creation in get(), removal in evict()
+        # — everything else only reads (or mutates breaker OBJECTS, whose
+        # state machine is its own single surface via record_*).
+        # pstlint: owned-by=task:get,evict
         self._breakers: Dict[str, CircuitBreaker] = {}
 
     def get(self, url: str) -> CircuitBreaker:

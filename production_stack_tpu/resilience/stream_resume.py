@@ -229,17 +229,35 @@ class StreamJournal:
         # Accounting. Text is kept as parts and joined lazily (once per
         # continuation leg) — per-chunk string concat would be O(n²) over
         # the stream length on the proxy hot path.
+        # Resume-critical accumulation state. Single-writer surface:
+        # only the journal's own frame machinery below may mutate the
+        # annotated fields (enforced by the lock-discipline pstlint
+        # check) — proxy code reads them and drives feed()/
+        # start_continuation(); `legs` alone is proxy-written (see note).
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self._text_parts: List[str] = []
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.delivered_tokens = 0  # content-bearing delta chunks ≈ tokens
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.finish_reason: Optional[str] = None
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.usage: Optional[dict] = None
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.saw_done = False
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.saw_error = False
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.saw_role_delta = False
+        # NOT annotated: legs is deliberately incremented by the proxy's
+        # resume loop (request_service) when it launches a continuation —
+        # a cross-module writer the same-file check cannot see.
         self.legs = 0  # continuation legs attempted
         # Per-continuation-leg splice state.
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self._overlap = ""
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self._pending: List[tuple] = []  # held-back possible-echo frames
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self._tokens_at_leg_start = 0
 
     @property

@@ -19,6 +19,7 @@ from aiohttp import web
 from ..logging_utils import init_logger
 from ..obs import (
     NOOP_TRACE,
+    error_headers,
     get_request_tracer,
     initialize_request_tracing,
     teardown_request_tracing,
@@ -212,7 +213,10 @@ async def admission_middleware(request: web.Request, handler):
                             }
                         },
                         status=504,
-                        headers={DEADLINE_EXCEEDED_HEADER: "1"},
+                        headers=error_headers(
+                            request,
+                            extra={DEADLINE_EXCEEDED_HEADER: "1"},
+                        ),
                     )
                 span.set_attribute("outcome", "shed")
                 span.add_event("admission_shed", reason=decision.reason)
@@ -230,7 +234,10 @@ async def admission_middleware(request: web.Request, handler):
                         }
                     },
                     status=429,
-                    headers={"Retry-After": decision.retry_after_header},
+                    headers=error_headers(
+                        request,
+                        extra={"Retry-After": decision.retry_after_header},
+                    ),
                 )
         span.set_attribute("outcome", "admitted")
         span.end()
@@ -258,6 +265,7 @@ async def api_key_middleware(request: web.Request, handler):
             return web.json_response(
                 {"error": {"message": "invalid API key", "type": "authentication_error"}},
                 status=401,
+                headers=error_headers(request),
             )
     return await handler(request)
 

@@ -105,11 +105,19 @@ class DynamicConfigWatcher:
         self._task = asyncio.get_event_loop().create_task(self._watch())
         self.current_config: Optional[DynamicRouterConfig] = None
 
+    def _read_bytes(self) -> bytes:
+        with open(self.path, "rb") as f:
+            return f.read()
+
     async def _watch(self) -> None:
         while True:
             try:
-                with open(self.path, "rb") as f:
-                    content = f.read()
+                # Config files live on slow volumes (ConfigMap mounts, NFS)
+                # often enough that a sync read in the poll loop would
+                # stall live proxying — hence the executor hop.
+                content = await asyncio.get_running_loop().run_in_executor(
+                    None, self._read_bytes
+                )
                 digest = hashlib.sha256(content).hexdigest()
                 if digest != self._last_hash:
                     if self._last_hash is not None:

@@ -23,6 +23,7 @@ from aiohttp import web
 from prometheus_client import Counter, REGISTRY
 
 from ...logging_utils import init_logger
+from ...obs import error_headers
 
 logger = init_logger(__name__)
 
@@ -200,6 +201,10 @@ def install_pii_check(app: web.Application, args) -> None:
                 }
             },
             status=400,
+            # No live request object here (the check sees parsed JSON
+            # only): the builder returns {} and the tracing middleware's
+            # setdefault stamps the real id on the way out.
+            headers=error_headers(None),
         )
 
     app["pii_check"] = check

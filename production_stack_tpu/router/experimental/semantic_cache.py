@@ -95,6 +95,7 @@ class EngineEmbedder:
             if not model or (self._pinned and self._pinned not in models):
                 continue
             try:
+                # pstlint: disable=hop-contract(cache-fill embeddings are router-internal traffic keyed by text and shared across clients; stamping one client's request id would mis-attribute every later cache hit)
                 async with session.post(
                     f"{ep.url.rstrip('/')}/v1/embeddings",
                     json={"model": model, "input": [text[:8192]]},

@@ -1,0 +1,63 @@
+"""The sanctioned builder for outbound hop headers.
+
+Every HTTP request the router sends toward an engine (or any service
+participating in a request's story — the KV controller, a disagg prefill
+leg, an admin fan-out) must carry the propagation trio from PRs 2-3:
+
+- ``X-Request-Id`` — the log/timeline/stats join key;
+- ``traceparent`` — the W3C trace context, naming the current span as
+  parent so retries/hedges/resume legs render as one tree;
+- ``X-PST-Deadline-Ms`` — the *remaining* budget, recomputed per attempt.
+
+:func:`hop_headers` is the one place that knows how to assemble them;
+the ``hop-contract`` pstlint check (docs/static-analysis.md) flags any
+outbound session call in ``router/`` whose ``headers=`` does not derive
+from it (or from ``request_service._trace_headers``, its span-aware
+wrapper). Control-plane traffic with no request context (canary probes,
+metric scrapes, discovery probes, k8s watches) is exempted by file-level
+suppressions at its call sites, with reasons.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+from ..obs import REQUEST_ID_HEADER, TRACEPARENT_HEADER
+from ..resilience.deadline import DEADLINE_HEADER, Deadline, with_deadline_header
+
+
+def hop_headers(
+    base: Optional[Mapping[str, str]] = None,
+    *,
+    request_id: Optional[str] = None,
+    span=None,
+    deadline: Optional[Deadline] = None,
+    from_headers: Optional[Mapping[str, str]] = None,
+) -> Dict[str, str]:
+    """Assemble outbound hop headers.
+
+    ``base`` seeds the result (e.g. forwardable client headers).
+    ``from_headers`` copies whichever of the trio an inbound mapping
+    already carries — the relay form, for hops that forward someone
+    else's context (KV-controller lookups during routing). Explicit
+    ``request_id``/``span``/``deadline`` win over both: they describe
+    *this* hop (the span becomes the parent, the deadline re-shrinks).
+    """
+    headers: Dict[str, str] = dict(base) if base else {}
+    if from_headers is not None:
+        # The full trio relays — including the (as-of-receipt) remaining
+        # budget, so a relay hop can shed an already-expired request. An
+        # explicit deadline= below re-shrinks it for this hop.
+        for name in (REQUEST_ID_HEADER, TRACEPARENT_HEADER, DEADLINE_HEADER):
+            value = from_headers.get(name)
+            if value is not None:
+                headers.setdefault(name, value)
+    if request_id:
+        headers[REQUEST_ID_HEADER] = request_id
+    if span is not None:
+        traceparent = span.traceparent()
+        if traceparent:
+            headers[TRACEPARENT_HEADER] = traceparent
+    if deadline is not None:
+        headers = with_deadline_header(headers, deadline)
+    return headers

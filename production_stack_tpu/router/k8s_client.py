@@ -7,6 +7,7 @@ standard in-cluster mount, aiohttp for the HTTP layer, and the K8s
 ``watch=true`` chunked-JSON stream protocol.
 """
 
+# pstlint: disable-file=hop-contract(Kubernetes API list/watch calls are not engine hops; the deadline/trace propagation contract does not apply to the apiserver)
 from __future__ import annotations
 
 import json

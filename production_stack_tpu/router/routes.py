@@ -18,6 +18,7 @@ from .. import __version__
 from ..logging_utils import init_logger
 from ..obs import (
     debug_requests_response,
+    error_headers,
     get_request_tracer,
     render_obs_metrics,
 )
@@ -138,11 +139,14 @@ async def health(request: web.Request) -> web.Response:
         return web.json_response(
             {"status": "unhealthy", "reason": "service discovery watcher died"},
             status=503,
+            headers=error_headers(request),
         )
     scraper = get_engine_stats_scraper()
     if not scraper.get_health():
         return web.json_response(
-            {"status": "unhealthy", "reason": "engine stats scraper died"}, status=503
+            {"status": "unhealthy", "reason": "engine stats scraper died"},
+            status=503,
+            headers=error_headers(request),
         )
     return web.json_response({"status": "healthy"})
 
@@ -258,6 +262,7 @@ async def debug_requests(request: web.Request) -> web.Response:
             {"error": {"message": "request tracing is not initialized",
                        "type": "not_found_error", "code": 404}},
             status=404,
+            headers=error_headers(request),
         )
     return debug_requests_response(recorder, request)
 

@@ -20,7 +20,11 @@ class _Node:
     __slots__ = ("children", "endpoints", "lock", "last_access")
 
     def __init__(self) -> None:
+        # Mutations require the owning node's asyncio lock (the
+        # lock-discipline pstlint check enforces 'with <node>.lock').
+        # pstlint: owned-by=lock:lock
         self.children: Dict[int, "_Node"] = {}
+        # pstlint: owned-by=lock:lock
         self.endpoints: Set[str] = set()
         self.lock = asyncio.Lock()
         self.last_access = time.monotonic()
@@ -81,14 +85,20 @@ class HashTrie:
         return matched_chars, best
 
     async def remove_endpoint(self, endpoint: str) -> None:
-        """Drop a disappeared endpoint from the whole trie."""
+        """Drop a disappeared endpoint from the whole trie.
 
-        def walk(node: _Node) -> None:
-            node.endpoints.discard(endpoint)
-            for child in node.children.values():
-                walk(child)
+        Takes each node's lock for its own mutation (one lock held at a
+        time, same discipline as insert) — an insert interleaving at the
+        same node must never observe a half-applied discard."""
 
-        walk(self.root)
+        async def walk(node: _Node) -> None:
+            async with node.lock:
+                node.endpoints.discard(endpoint)
+                children = list(node.children.values())
+            for child in children:
+                await walk(child)
+
+        await walk(self.root)
 
     def _prune(self) -> None:
         """Drop the least-recently-accessed top-level subtree (approx. LRU)."""
@@ -100,5 +110,6 @@ class HashTrie:
             return 1 + sum(count(c) for c in node.children.values())
 
         removed = count(self.root.children[oldest])
+        # pstlint: disable=lock-discipline(_prune runs synchronously — no awaits — from insert, which already holds the insertion node's lock; taking root.lock here would deadlock when that node IS root, and asyncio's single thread makes the subtree drop atomic as-is)
         del self.root.children[oldest]
         self._node_count -= removed

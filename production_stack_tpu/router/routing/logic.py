@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import xxhash
 
 from ...logging_utils import init_logger
+from ..hop import hop_headers
 from ...utils import SingletonABCMeta
 from ..service_discovery import EndpointInfo
 from .hashtrie import HashTrie
@@ -317,8 +318,15 @@ class KvawareRouter(RoutingInterface):
             await self._session.close()
         self._session = None
 
-    async def _lookup(self, model: str, token_ids: List[int]) -> Dict[str, int]:
-        """Controller lookup: chunk-hash the prefix, return url->matched tokens."""
+    async def _lookup(
+        self, model: str, token_ids: List[int],
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, int]:
+        """Controller lookup: chunk-hash the prefix, return url->matched
+        tokens. The lookup happens while routing a live request, so the
+        request's id/trace context rides along (relay form of the hop
+        contract) — a slow controller shows up inside that request's
+        timeline instead of as unattributed routing latency."""
         from ...kvcache.hashing import chunk_hashes
 
         hashes = chunk_hashes(token_ids)
@@ -328,6 +336,7 @@ class KvawareRouter(RoutingInterface):
         async with session.post(
             f"{self.controller_url}/lookup",
             json={"model": model, "hashes": hashes},
+            headers=hop_headers(from_headers=headers or {}),
         ) as resp:
             resp.raise_for_status()
             data = await resp.json()
@@ -340,7 +349,7 @@ class KvawareRouter(RoutingInterface):
         try:
             tokenizer = self._get_tokenizer(model)
             token_ids = tokenizer.encode(text)
-            matches = await self._lookup(model, token_ids)
+            matches = await self._lookup(model, token_ids, headers)
         except Exception as e:  # noqa: BLE001 — controller down → fallback
             logger.debug("kvaware lookup failed, falling back: %s", e)
             matches = {}

@@ -12,6 +12,7 @@ Redesign notes (not a translation):
   :mod:`production_stack_tpu.router.k8s_client`.
 """
 
+# pstlint: disable-file=hop-contract(discovery health/ready/drain/model probes are control-plane traffic on the reconcile loops; no client request context exists to propagate)
 from __future__ import annotations
 
 import asyncio

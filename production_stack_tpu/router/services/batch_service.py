@@ -27,6 +27,8 @@ import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
+from ...obs import error_headers
+from ..hop import hop_headers
 from ..service_discovery import get_service_discovery
 
 logger = init_logger(__name__)
@@ -194,12 +196,17 @@ class LocalBatchProcessor:
                 backend = self._pick_backend(item.get("body", {}).get("model"))
                 if backend is None:
                     raise RuntimeError("no backend available for model")
+                # Batch lines execute detached from any live client
+                # request: each line gets its own id so engine logs and
+                # /debug/requests timelines are joinable per line.
+                line_id = f"batch_req_{uuid.uuid4().hex[:12]}"
                 async with session.post(
-                    backend + url, json=item.get("body", {})
+                    backend + url, json=item.get("body", {}),
+                    headers=hop_headers(request_id=line_id),
                 ) as resp:
                     payload = await resp.json()
                     record = {
-                        "id": f"batch_req_{uuid.uuid4().hex[:12]}",
+                        "id": line_id,
                         "custom_id": item.get("custom_id"),
                         "response": {"status_code": resp.status, "body": payload},
                         "error": None,
@@ -269,7 +276,7 @@ def install_batch_api(app: web.Application, args) -> None:
             if field not in body:
                 return web.json_response(
                     {"error": {"message": f"missing {field}", "code": 400}},
-                    status=400,
+                    status=400, headers=error_headers(request),
                 )
         batch = await processor.create_batch(
             body["input_file_id"], body["endpoint"],
@@ -287,7 +294,8 @@ def install_batch_api(app: web.Application, args) -> None:
         batch = await processor.get_batch(request.match_info["batch_id"])
         if batch is None:
             return web.json_response(
-                {"error": {"message": "batch not found", "code": 404}}, status=404
+                {"error": {"message": "batch not found", "code": 404}},
+                status=404, headers=error_headers(request),
             )
         return web.json_response(batch)
 
@@ -295,7 +303,8 @@ def install_batch_api(app: web.Application, args) -> None:
         batch = await processor.cancel_batch(request.match_info["batch_id"])
         if batch is None:
             return web.json_response(
-                {"error": {"message": "batch not found", "code": 404}}, status=404
+                {"error": {"message": "batch not found", "code": 404}},
+                status=404, headers=error_headers(request),
             )
         return web.json_response(batch)
 

@@ -15,6 +15,7 @@ rejections, sleeping engines, and warming (precompiling) engines are
 skipped, not failed.
 """
 
+# pstlint: disable-file=hop-contract(canary probes ORIGINATE synthetic traffic — there is no client request whose deadline/trace/request-id could be propagated; probes are marked X-PST-Canary instead)
 from __future__ import annotations
 
 import asyncio

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional
 import aiofiles
 from aiohttp import web
 
+from ...obs import error_headers
+
 from ...logging_utils import init_logger
 
 logger = init_logger(__name__)
@@ -172,7 +174,8 @@ def install_files_api(app: web.Application, args) -> None:
                 info = await storage.save_file(filename, purpose, reader=field)
         if info is None:
             return web.json_response(
-                {"error": {"message": "missing file field", "code": 400}}, status=400
+                {"error": {"message": "missing file field", "code": 400}},
+                status=400, headers=error_headers(request),
             )
         if info.purpose != purpose:
             # Multipart field order is arbitrary: the purpose may arrive
@@ -187,19 +190,21 @@ def install_files_api(app: web.Application, args) -> None:
             {"object": "list", "data": [f.to_dict() for f in files]}
         )
 
-    def _bad_id(e: ValueError) -> web.Response:
+    def _bad_id(e: ValueError, request: web.Request) -> web.Response:
         return web.json_response(
-            {"error": {"message": str(e), "code": 400}}, status=400
+            {"error": {"message": str(e), "code": 400}},
+            status=400, headers=error_headers(request),
         )
 
     async def get(request: web.Request) -> web.Response:
         try:
             info = await storage.get_file(request.match_info["file_id"])
         except ValueError as e:
-            return _bad_id(e)
+            return _bad_id(e, request)
         if info is None:
             return web.json_response(
-                {"error": {"message": "file not found", "code": 404}}, status=404
+                {"error": {"message": "file not found", "code": 404}},
+                status=404, headers=error_headers(request),
             )
         return web.json_response(info.to_dict())
 
@@ -207,10 +212,11 @@ def install_files_api(app: web.Application, args) -> None:
         try:
             data = await storage.get_file_content(request.match_info["file_id"])
         except ValueError as e:
-            return _bad_id(e)
+            return _bad_id(e, request)
         if data is None:
             return web.json_response(
-                {"error": {"message": "file not found", "code": 404}}, status=404
+                {"error": {"message": "file not found", "code": 404}},
+                status=404, headers=error_headers(request),
             )
         return web.Response(body=data, content_type="application/octet-stream")
 
@@ -218,7 +224,7 @@ def install_files_api(app: web.Application, args) -> None:
         try:
             ok = await storage.delete_file(request.match_info["file_id"])
         except ValueError as e:
-            return _bad_id(e)
+            return _bad_id(e, request)
         return web.json_response(
             {"id": request.match_info["file_id"], "object": "file", "deleted": ok}
         )

@@ -55,7 +55,8 @@ import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
-from ...obs import NOOP_TRACE, REQUEST_ID_HEADER, TRACEPARENT_HEADER
+from ...obs import NOOP_TRACE, REQUEST_ID_HEADER, TRACEPARENT_HEADER, error_headers
+from ..hop import hop_headers
 from ...resilience import (
     get_breaker_registry,
     get_default_deadline_ms,
@@ -111,21 +112,25 @@ def _trace_headers(headers: dict, request_id: str, span) -> dict:
     timelines join on one id even with tracing off), plus a W3C
     ``traceparent`` naming ``span`` as the parent when tracing is active.
     With tracing off the client's own traceparent (if any) passes through
-    untouched — the router stays a transparent trace hop."""
-    headers[REQUEST_ID_HEADER] = request_id
-    tp = span.traceparent() if span is not None else None
-    if tp:
-        headers[TRACEPARENT_HEADER] = tp
-    return headers
+    untouched — the router stays a transparent trace hop. Thin span-aware
+    wrapper over the sanctioned :func:`..hop.hop_headers` builder."""
+    return hop_headers(headers, request_id=request_id, span=span)
 
 
-def _error_response(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
+def _error_response(
+    status: int, message: str, etype: str = "invalid_request_error",
+    request_id: Optional[str] = None,
+) -> web.Response:
     return web.json_response(
-        {"error": {"message": message, "type": etype, "code": status}}, status=status
+        {"error": {"message": message, "type": etype, "code": status}},
+        status=status,
+        headers=error_headers(request_id),
     )
 
 
-def _deadline_response(message: str, stage: str, trace=None) -> web.Response:
+def _deadline_response(
+    message: str, stage: str, trace=None, request_id: Optional[str] = None
+) -> web.Response:
     """504 for an exhausted budget, tagged so clients (and the tests) can
     tell a deadline shed apart from a generic upstream timeout. Counts the
     shed by stage (and as a span event on the trace); never feeds the
@@ -136,7 +141,7 @@ def _deadline_response(message: str, stage: str, trace=None) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "deadline_exceeded", "code": 504}},
         status=504,
-        headers={DEADLINE_EXCEEDED_HEADER: "1"},
+        headers=error_headers(request_id, extra={DEADLINE_EXCEEDED_HEADER: "1"}),
     )
 
 
@@ -271,7 +276,7 @@ async def proxy_and_stream(
             # never forward work that is already expired.
             return _deadline_response(
                 "deadline exceeded before upstream attempt", "router_proxy",
-                trace=trace,
+                trace=trace, request_id=request_id,
             )
         attempt_span = trace.span(
             "proxy_attempt",
@@ -552,7 +557,7 @@ async def proxy_and_stream(
                 attempt_span.end()
                 return _deadline_response(
                     "deadline exceeded during upstream attempt", "router_proxy",
-                    trace=trace,
+                    trace=trace, request_id=request_id,
                 )
             if not failure_noted:
                 _note_failure(url, request_id, span=attempt_span)
@@ -573,7 +578,8 @@ async def proxy_and_stream(
                     # request burns error budget (no TTFT sample exists).
                     slo_done = True
                     observe_slo_failure(slo_model)
-                return _error_response(502, f"backend error: {e}", "bad_gateway")
+                return _error_response(502, f"backend error: {e}", "bad_gateway",
+                                       request_id=request_id)
             logger.warning(
                 "backend %s unreachable for %s (%s); failing over to %s",
                 url, request_id, e, next_url,
@@ -993,20 +999,20 @@ async def proxy_with_hedge(
         ):
             # The engine shed the budget deliberately: pass through, never
             # replay work whose budget is gone downstream.
-            return _hedge_failure_response(failed_result)
+            return _hedge_failure_response(failed_result, request_id)
         if policy is not None and not policy.should_retry(0):
-            return _hedge_failure_response(failed_result)
+            return _hedge_failure_response(failed_result, request_id)
         if deadline is not None and deadline.expired():
             return _deadline_response(
                 "deadline exceeded after upstream failure", "router_proxy",
-                trace=request.get("trace"),
+                trace=request.get("trace"), request_id=request_id,
             )
         if _deadline_blocks_attempt(deadline):
             res_metrics.deadline_sheds_total.labels(stage="router_retry").inc()
-            return _hedge_failure_response(failed_result)
+            return _hedge_failure_response(failed_result, request_id)
         alt = await failover(tried)
         if alt is None:
-            return _hedge_failure_response(failed_result)
+            return _hedge_failure_response(failed_result, request_id)
         res_metrics.retries_total.labels(server=backend_url).inc()
         res_metrics.failovers_total.inc()
         tried.add(alt)
@@ -1019,9 +1025,10 @@ async def proxy_with_hedge(
             if deadline is not None and deadline.expired():
                 return _deadline_response(
                     "deadline exceeded during failover attempt", "router_proxy",
-                    trace=request.get("trace"),
+                    trace=request.get("trace"), request_id=request_id,
                 )
-            return _error_response(502, f"backend error: {e}", "bad_gateway")
+            return _error_response(502, f"backend error: {e}", "bad_gateway",
+                                       request_id=request_id)
         return await _hedge_respond(request, endpoint, request_id, r)
 
     try:
@@ -1071,6 +1078,7 @@ async def proxy_with_hedge(
                     return _deadline_response(
                         "deadline exceeded during upstream attempt",
                         "router_proxy", trace=request.get("trace"),
+                        request_id=request_id,
                     )
                 return await _one_failover(None)
             if result[0] >= 500:
@@ -1117,7 +1125,7 @@ async def proxy_with_hedge(
             if deadline is not None and deadline.expired():
                 return _deadline_response(
                     "deadline exceeded (primary and hedge)", "router_proxy",
-                    trace=request.get("trace"),
+                    trace=request.get("trace"), request_id=request_id,
                 )
             last = _attempt_result(primary) or (
                 _attempt_result(hedge_task) if hedge_task.done() else None
@@ -1141,7 +1149,9 @@ async def proxy_with_hedge(
                 t.cancel()
 
 
-def _hedge_failure_response(result) -> web.Response:
+def _hedge_failure_response(
+    result, request_id: Optional[str] = None
+) -> web.Response:
     """Both attempts failed: pass the last 5xx through unchanged — headers
     included, so tagged sheds (X-PST-Deadline-Exceeded) survive — same rule
     as proxy_and_stream with nowhere left to go; else a generic 502."""
@@ -1151,7 +1161,8 @@ def _hedge_failure_response(result) -> web.Response:
         for k, v in headers.items():
             resp.headers[k] = v
         return resp
-    return _error_response(502, "all upstream attempts failed", "bad_gateway")
+    return _error_response(502, "all upstream attempts failed", "bad_gateway",
+                           request_id=request_id)
 
 
 async def _hedge_respond(
@@ -1214,13 +1225,14 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         # Cheapest shed point: nothing has been parsed, routed, or sent.
         return _deadline_response(
             "deadline exceeded before routing", "router_admission",
-            trace=trace,
+            trace=trace, request_id=request_id,
         )
     body = await request.read()
     try:
         request_json = json.loads(body) if body else {}
     except json.JSONDecodeError:
-        return _error_response(400, "invalid JSON in request body")
+        return _error_response(400, "invalid JSON in request body",
+                               request_id=request_id)
     request["parsed_json"] = request_json  # for post-response hooks
 
     callback = get_custom_callback_handler()
@@ -1285,6 +1297,7 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
             404,
             f"model {requested_model!r} not found on any live engine",
             "not_found_error",
+            request_id=request_id,
         )
 
     if pinned_id:
@@ -1306,7 +1319,6 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
 
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
-    headers = dict(request.headers)
     # The routing decision is its own stage: which engine, picked by which
     # policy, from how many live candidates.
     routing_span = trace.span(
@@ -1317,6 +1329,12 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
             "model": requested_model,
         },
     )
+    # Routing-time hops (the KV controller /lookup) relay these headers:
+    # the ROUTER-assigned request id and the routing span must be on them
+    # — clients usually send neither X-Request-Id nor traceparent.
+    headers = hop_headers(
+        dict(request.headers), request_id=request_id, span=routing_span
+    )
     try:
         backend_url = await route_with_resilience(
             router, candidates, engine_stats, request_stats, headers, request_json
@@ -1324,7 +1342,8 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     except ValueError as e:
         routing_span.set_attribute("outcome", "no_backend")
         routing_span.end()
-        return _error_response(503, f"no backend available: {e}", "service_unavailable")
+        return _error_response(503, f"no backend available: {e}",
+                               "service_unavailable", request_id=request_id)
     routing_span.set_attribute("engine", backend_url)
     routing_span.set_attribute("outcome", "routed")
     routing_span.end()
@@ -1366,8 +1385,11 @@ async def route_disaggregated_prefill_request(
     monitor = get_request_stats_monitor()
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats(time.time())
-    headers = dict(request.headers)
     trace = request.get("trace") or NOOP_TRACE
+    # Same relay contract as route_general_request: routing-time hops see
+    # the router-assigned id (the per-pool routing spans parent their own
+    # outbound attempts below).
+    headers = hop_headers(dict(request.headers), request_id=request_id)
 
     original_max_tokens = request_json.get("max_tokens")
     original_stream = request_json.get("stream", False)
@@ -1390,7 +1412,8 @@ async def route_disaggregated_prefill_request(
     except ValueError as e:
         routing_span.set_attribute("outcome", "no_backend")
         routing_span.end()
-        return _error_response(503, f"no prefill backend: {e}", "service_unavailable")
+        return _error_response(503, f"no prefill backend: {e}",
+                               "service_unavailable", request_id=request_id)
     routing_span.set_attribute("engine", prefill_url)
     routing_span.end()
 
@@ -1403,7 +1426,7 @@ async def route_disaggregated_prefill_request(
         if deadline is not None and deadline.expired():
             return _deadline_response(
                 "deadline exceeded before prefill attempt", "router_proxy",
-                trace=trace,
+                trace=trace, request_id=request_id,
             )
         prefill_span = trace.span(
             "disagg_prefill", attributes={"server": prefill_url}
@@ -1470,7 +1493,7 @@ async def route_disaggregated_prefill_request(
             prefill_span.end()
             return _deadline_response(
                 "deadline exceeded during prefill", "router_proxy",
-                trace=trace,
+                trace=trace, request_id=request_id,
             )
         else:
             _note_failure(prefill_url, request_id, span=prefill_span)
@@ -1487,6 +1510,7 @@ async def route_disaggregated_prefill_request(
                 502,
                 f"prefill failed: {error or 'engine draining'}",
                 "bad_gateway",
+                request_id=request_id,
             )
         logger.warning(
             "prefill engine %s failed for %s (%s); failing over to %s",
@@ -1516,7 +1540,8 @@ async def route_disaggregated_prefill_request(
     except ValueError as e:
         routing_span.set_attribute("outcome", "no_backend")
         routing_span.end()
-        return _error_response(503, f"no decode backend: {e}", "service_unavailable")
+        return _error_response(503, f"no decode backend: {e}",
+                               "service_unavailable", request_id=request_id)
     routing_span.set_attribute("engine", decode_url)
     routing_span.end()
     return await proxy_and_stream(
@@ -1557,9 +1582,15 @@ async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.R
     label = request.query.get("model")
     targets = [e for e in endpoints if label is None or e.model_label == label or label in e.model_names]
     if not targets:
-        return _error_response(404, f"no engines matching {label!r}", "not_found_error")
+        return _error_response(404, f"no engines matching {label!r}",
+                               "not_found_error",
+                               request_id=request.get("request_id"))
     session: aiohttp.ClientSession = request.app["client_session"]
-    headers = _forwardable(request.headers)  # pass admin credentials through
+    # Admin credentials pass through; the hop trio rides along so engine
+    # logs join the admin action to the request that triggered it.
+    headers = _trace_headers(
+        _forwardable(request.headers), request.get("request_id") or "", None
+    )
 
     async def call(ep):
         if action == "is_sleeping":
@@ -1591,12 +1622,16 @@ async def route_drain_request(request: web.Request, action: str) -> web.Response
         and (url_filter is None or e.url == url_filter)
     ]
     if not targets:
-        return _error_response(404, "no engines matching filter", "not_found_error")
+        return _error_response(404, "no engines matching filter",
+                               "not_found_error",
+                               request_id=request.get("request_id"))
     session: aiohttp.ClientSession = request.app["client_session"]
     # Forward the caller's headers (Authorization in particular): engines
     # behind --api-key guard /drain, and the router holds no engine
-    # credentials of its own.
-    headers = _forwardable(request.headers)
+    # credentials of its own. The hop trio rides along.
+    headers = _trace_headers(
+        _forwardable(request.headers), request.get("request_id") or "", None
+    )
 
     async def call(ep):
         if action == "is_draining":

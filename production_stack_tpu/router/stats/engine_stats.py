@@ -7,6 +7,7 @@ scraper is an asyncio task (not a daemon thread) and parses the same
 dashboards keep working unchanged.
 """
 
+# pstlint: disable-file=hop-contract(metrics scrapes are control-plane pulls on their own timer; no originating client request exists to propagate headers from)
 from __future__ import annotations
 
 import asyncio
@@ -104,6 +105,9 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         if scrape_interval is None:
             raise ValueError("EngineStatsScraper needs a scrape_interval")
         self.scrape_interval = scrape_interval
+        # Written only by the scrape task (_scrape_one fills, _loop
+        # drops stale urls); readers get a copy via get_engine_stats().
+        # pstlint: owned-by=task:_scrape_one,_loop
         self.engine_stats: Dict[str, EngineStats] = {}
         self._task: Optional[asyncio.Task] = None
         self._initialized = True

@@ -76,20 +76,40 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         if sliding_window_size is None:
             raise ValueError("RequestStatsMonitor needs sliding_window_size")
         self.window = sliding_window_size
+        # The proxy layer's lifecycle callbacks (on_new_request /
+        # on_request_response / on_request_complete / on_request_swapped /
+        # on_request_failed) are the ONLY writers of the tables below,
+        # plus evict_url on engine departure and the _mon window factory.
+        # get_request_stats and /metrics only read. The lock-discipline
+        # pstlint check enforces the single-writer surface.
+        # pstlint: owned-by=task:on_*,evict_url,_mon
         self.qps_monitors: Dict[str, MovingAverageMonitor] = {}
+        # pstlint: owned-by=task:on_*,evict_url,_mon
         self.ttft_monitors: Dict[str, MovingAverageMonitor] = {}
+        # pstlint: owned-by=task:on_*,evict_url,_mon
         self.latency_monitors: Dict[str, MovingAverageMonitor] = {}
+        # pstlint: owned-by=task:on_*,evict_url,_mon
         self.decoding_length_monitors: Dict[str, MovingAverageMonitor] = {}
+        # pstlint: owned-by=task:on_*,evict_url,_mon
         self.itl_monitors: Dict[str, MovingAverageMonitor] = {}
         # (engine_url, request_id) -> timestamps
+        # pstlint: owned-by=task:on_*,evict_url
         self.request_start: Dict[Tuple[str, str], float] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.first_token_time: Dict[Tuple[str, str], float] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.last_token_time: Dict[Tuple[str, str], float] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.token_counts: Dict[Tuple[str, str], int] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.in_prefill: Dict[str, int] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.in_decoding: Dict[str, int] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.finished: Dict[str, int] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.swapped: Dict[str, int] = {}
+        # pstlint: owned-by=task:on_*,evict_url
         self.failed: Dict[str, int] = {}
         self.first_query_time: Optional[float] = None
         self._initialized = True

@@ -1,72 +1,45 @@
 #!/usr/bin/env python3
-"""Metric-name lint: every ``pst`` metric registered in code must be
-documented in docs/observability.md.
+"""Metric-name lint: registry-driven CI shim.
 
-The observability docs are a contract (dashboards, alert rules, and
-operators' PromQL all read from them); a metric that exists in code but
-not in the doc is invisible to everyone who needs it. Run by the
-pre-commit CI workflow; exits non-zero listing the undocumented names.
-
-A family wildcard in the doc (e.g. ``pst_resilience_*``) covers every
-metric sharing that prefix; counters match with or without Prometheus's
-implicit ``_total`` suffix.
+Historically this script carried its own regex scan and its own copy of
+the documentation-matching rules; both now live in ONE place — the
+``metric-registry`` check of :mod:`production_stack_tpu.analysis`
+(pstlint), driven by the declarations in
+``production_stack_tpu/obs/metric_registry.py``. This shim keeps the CI
+entry point (pre-commit workflow) and the exit-code contract stable:
+non-zero listing every violation — an undeclared constructor, a stale
+declaration, or a declared metric missing from docs/observability.md.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
-DOC = ROOT / "docs" / "observability.md"
-CODE_DIRS = [ROOT / "production_stack_tpu"]
+sys.path.insert(0, str(ROOT))
 
-# Counter("pst_x", ...) / Gauge(...) / Histogram(...) — the constructor
-# kind decides whether exposition appends _total.
-_METRIC_RE = re.compile(
-    r"\b(Counter|Gauge|Histogram)\(\s*[\'\"](pst[^\'\"]+)[\'\"]", re.S
-)
-_WILDCARD_RE = re.compile(r"(pst[\w:]*)\*")
-
-
-def registered_metrics() -> list:
-    """(name, kind) for every pst-prefixed metric constructor in code."""
-    out = []
-    for base in CODE_DIRS:
-        for py in sorted(base.rglob("*.py")):
-            text = py.read_text()
-            for kind, name in _METRIC_RE.findall(text):
-                out.append((name, kind, py.relative_to(ROOT)))
-    return out
-
-
-def undocumented(doc_text: str) -> list:
-    # Bare "pst_*" (the name-family overview bullet) must not whitelist
-    # every metric — only family wildcards with a real stem count.
-    prefixes = [p for p in _WILDCARD_RE.findall(doc_text) if len(p) > 4]
-    missing = []
-    for name, kind, path in registered_metrics():
-        exposition = name
-        if kind == "Counter" and not name.endswith("_total"):
-            exposition = name + "_total"
-        if name in doc_text or exposition in doc_text:
-            continue
-        if any(name.startswith(p) for p in prefixes):
-            continue
-        missing.append((exposition, str(path)))
-    return missing
+from production_stack_tpu.analysis.pstlint import run_checks  # noqa: E402
 
 
 def main() -> int:
-    doc_text = DOC.read_text()
-    missing = undocumented(doc_text)
-    if missing:
-        for name, path in missing:
-            print(f"UNDOCUMENTED metric {name!r} (registered in {path}) "
-                  f"— add it to docs/observability.md")
+    findings = run_checks(
+        [str(ROOT / "production_stack_tpu"), str(ROOT / "scripts")],
+        checks=["metric-registry"],
+        root=ROOT,
+    )
+    # Framework findings (bad-suppression etc.) elsewhere in the tree
+    # belong to the dedicated pstlint CI job; this step owns ONLY the
+    # metric contract.
+    active = [
+        f for f in findings
+        if not f.suppressed and f.check == "metric-registry"
+    ]
+    for f in active:
+        print(f.format())
+    if active:
         return 1
-    print(f"ok: all {len(registered_metrics())} pst metrics documented")
+    print("ok: metric registry, code, and docs agree")
     return 0
 
 

@@ -1,0 +1,20 @@
+"""Firing cases for async-blocking (scoped: router/ path segment)."""
+
+import subprocess
+import time
+import urllib.request
+
+import requests
+
+
+async def handler():
+    time.sleep(1.0)  # rule 1: sleep in async def
+    requests.get("http://x")  # rule 1: sync HTTP
+    urllib.request.urlopen("http://x")  # rule 1: sync urllib
+    subprocess.run(["ls"])  # rule 1: subprocess
+    with open("/tmp/f") as f:  # rule 1: sync file IO
+        return f.read()
+
+
+def sync_helper():
+    time.sleep(0.5)  # rule 2: hard sleep in an event-loop package

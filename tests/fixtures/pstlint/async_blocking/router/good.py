@@ -1,0 +1,22 @@
+"""Clean cases for async-blocking."""
+
+import asyncio
+import time
+
+
+async def handler():
+    await asyncio.sleep(1.0)  # async sleep is fine
+    loop = asyncio.get_running_loop()
+    # Passing `open` as a reference into an executor is the sanctioned
+    # way to do file IO from a coroutine.
+    return await loop.run_in_executor(None, _read)
+
+
+def _read():
+    with open("/tmp/f") as f:  # sync IO in a sync helper: fine (rule 1)
+        return f.read()
+
+
+def poller():
+    # pstlint: disable=async-blocking(dedicated poll thread, never the event loop)
+    time.sleep(0.001)

@@ -1,0 +1,23 @@
+"""Firing cases for hop-contract (scoped: router/ path segment)."""
+
+from aiohttp import web
+
+
+async def proxy(request, session, url, body):
+    # No headers= at all: the hop drops deadline/trace/request-id.
+    async with session.post(url, data=body) as resp:
+        return await resp.read()
+
+
+async def proxy_handbuilt(request, session, url, body):
+    # headers= built by hand, not by the sanctioned builder.
+    headers = {"X-Custom": "1"}
+    async with session.post(url, data=body, headers=headers) as resp:
+        return await resp.read()
+
+
+def shed():
+    # Error response without X-Request-Id.
+    return web.json_response(
+        {"error": {"message": "shed", "code": 429}}, status=429
+    )

@@ -1,0 +1,46 @@
+"""Clean cases for hop-contract."""
+
+from aiohttp import web
+
+
+def hop_headers(base=None, **kw):  # stand-in for router/hop.py's builder
+    return dict(base or {})
+
+
+def error_headers(source=None, extra=None):  # stand-in for obs builder
+    return dict(extra or {})
+
+
+async def proxy(request, session, url, body, request_id, span):
+    fwd = hop_headers({}, request_id=request_id, span=span)
+    async with session.post(url, data=body, headers=fwd) as resp:
+        return await resp.read()
+
+
+async def proxy_inline(request, session, url, body, request_id):
+    async with session.post(
+        url, data=body, headers=hop_headers(request_id=request_id)
+    ) as resp:
+        return await resp.read()
+
+
+def shed(request_id):
+    return web.json_response(
+        {"error": {"message": "shed", "code": 429}},
+        status=429,
+        headers=error_headers(request_id),
+    )
+
+
+def shed_inline_dict(request_id):
+    return web.json_response(
+        {"error": {"message": "shed", "code": 503}},
+        status=503,
+        headers={"X-Request-Id": request_id},
+    )
+
+
+async def probe(session, url):
+    # pstlint: disable=hop-contract(control-plane probe with no client request context)
+    async with session.get(url) as resp:
+        return resp.status

@@ -1,0 +1,35 @@
+"""Firing cases for lock-discipline."""
+
+import asyncio
+
+
+class Registry:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        # pstlint: owned-by=lock:_lock
+        self.table = {}
+        # pstlint: owned-by=task:writer_loop
+        self.window = []
+
+    async def unlocked_write(self, k, v):
+        self.table[k] = v  # mutation outside 'with self._lock'
+
+    async def unlocked_mutator(self, k):
+        self.table.pop(k, None)  # mutating method outside the lock
+
+    def rogue_writer(self, x):
+        self.window.append(x)  # not the declared writer task
+
+    def writer_loop(self, x):
+        self.window.append(x)  # legal — but the ones above are not
+
+
+class Helper:
+    def __init__(self, registry: Registry):
+        # A DIFFERENT object's owned state mutated from an unrelated
+        # __init__ is a second writer, not construction.
+        registry.table.clear()
+
+
+REG = Registry()
+REG.window.append("module-level write")  # module level is not a writer task

@@ -1,0 +1,43 @@
+"""Clean cases for lock-discipline."""
+
+import asyncio
+
+
+class Registry:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        # pstlint: owned-by=lock:_lock
+        self.table = {}
+        # pstlint: owned-by=task:writer_loop,on_*
+        self.window = []
+
+    async def locked_write(self, k, v):
+        async with self._lock:
+            self.table[k] = v
+            self.table.pop("stale", None)
+
+    # pstlint: holds=self._lock
+    def _locked_helper(self, k):
+        # Caller guarantees the lock; the annotation records the contract.
+        del self.table[k]
+
+    def writer_loop(self, x):
+        self.window.append(x)
+
+    def on_event(self, x):
+        self.window.append(x)  # matches the on_* glob
+
+    def reader(self):
+        return len(self.window)  # reads are always fine
+
+
+class Node:
+    def __init__(self):
+        self.lock = asyncio.Lock()
+        # pstlint: owned-by=lock:lock
+        self.endpoints = set()
+
+
+async def per_node(node, endpoint):
+    async with node.lock:
+        node.endpoints.add(endpoint)  # receiver-matched lock

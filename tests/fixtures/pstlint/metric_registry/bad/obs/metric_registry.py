@@ -1,0 +1,17 @@
+"""Mini registry fixture with one stale entry and one kind mismatch."""
+
+COUNTER = "counter"
+GAUGE = "gauge"
+
+
+class MetricSpec:
+    def __init__(self, name, kind, module):
+        self.name = name
+
+
+REGISTRY = (
+    # Declared gauge, constructed as Counter in metrics.py -> mismatch.
+    MetricSpec("pst_fixture_requests", GAUGE, "obs/metrics.py"),
+    # Declared but never constructed -> stale.
+    MetricSpec("pst_fixture_ghost", COUNTER, "obs/metrics.py"),
+)

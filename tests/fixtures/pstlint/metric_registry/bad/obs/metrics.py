@@ -1,0 +1,6 @@
+"""Constructors violating the fixture registry."""
+
+from prometheus_client import Counter, Gauge
+
+requests_total = Counter("pst_fixture_requests", "kind mismatch vs registry")
+undeclared = Gauge("pst_fixture_undeclared", "not in the registry at all")

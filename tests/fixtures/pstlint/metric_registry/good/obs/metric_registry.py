@@ -1,0 +1,15 @@
+"""Mini registry fixture."""
+
+COUNTER = "counter"
+GAUGE = "gauge"
+
+
+class MetricSpec:
+    def __init__(self, name, kind, module):
+        self.name = name
+
+
+REGISTRY = (
+    MetricSpec("pst_fixture_requests", COUNTER, "obs/metrics.py"),
+    MetricSpec("pst_fixture_depth", GAUGE, "obs/metrics.py"),
+)

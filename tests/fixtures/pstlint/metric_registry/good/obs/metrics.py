@@ -1,0 +1,6 @@
+"""Constructors matching the fixture registry."""
+
+from prometheus_client import Counter, Gauge
+
+requests_total = Counter("pst_fixture_requests", "requests")
+depth = Gauge("pst_fixture_depth", "queue depth")

@@ -1,0 +1,16 @@
+"""Lattice enumeration that silently dropped the prefill family."""
+
+
+class Bucket:
+    def __init__(self, kind, rows=0, tokens=0):
+        self.kind = kind
+
+
+def enumerate_lattice(cfg):
+    buckets = []
+    for r in (1, 2, 4):
+        buckets.append(Bucket("decode", rows=r))
+        buckets.append(Bucket("decode_burst", rows=r))
+    # prefill family missing: live prefill traffic compiles after /ready.
+    buckets.append(Bucket("encode", tokens=128))
+    return buckets

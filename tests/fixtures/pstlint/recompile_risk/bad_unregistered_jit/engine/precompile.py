@@ -1,0 +1,19 @@
+"""Mini lattice enumeration (mirrors the real precompile.py shape)."""
+
+
+class Bucket:
+    def __init__(self, kind, rows=0, tokens=0):
+        self.kind = kind
+        self.rows = rows
+        self.tokens = tokens
+
+
+def enumerate_lattice(cfg):
+    buckets = []
+    for r in (1, 2, 4):
+        buckets.append(Bucket("decode", rows=r))
+        buckets.append(Bucket("decode_burst", rows=r))
+    for r, c in ((1, 128), (2, 64)):
+        buckets.append(Bucket("prefill", rows=r, tokens=c))
+    buckets.append(Bucket("encode", tokens=128))
+    return buckets

@@ -1,0 +1,39 @@
+"""A new jit site with no jit-family annotation, and a dispatch whose
+shape key bypasses the registered helpers."""
+
+import time
+
+import jax
+
+ENGINE_TELEMETRY = None
+
+
+class Runner:
+    def __init__(self):
+        # Unannotated jit site: warmup does not know this executable.
+        self._rogue = jax.jit(lambda p, b: b)
+        self._tel_scope = "r0"
+
+    def _tel_key(self, kind, batch, extras=()):
+        return (self._tel_scope, kind, tuple(sorted(batch)), extras)
+
+    def execute_rogue(self, batch):
+        # Hand-rolled shape key: live traffic and warmup would disagree.
+        key = ("rogue", len(batch))
+        B = len(batch)
+        t0 = time.perf_counter()
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0, batch_bucket=f"b{B}"
+        )
+
+    def _warmup_decode(self, bucket):
+        pass
+
+    def _warmup_decode_burst(self, bucket):
+        pass
+
+    def _warmup_prefill(self, bucket):
+        pass
+
+    def _warmup_encode(self, bucket):
+        pass

@@ -1,0 +1,76 @@
+"""Mini runner: registered shape keys, annotated jit sites, warmup drivers."""
+
+import time
+
+import jax
+
+ENGINE_TELEMETRY = None  # parsed, never executed
+
+
+class Runner:
+    def __init__(self):
+        # pstlint: jit-family=decode,prefill
+        self._step = jax.jit(lambda p, b: b)
+        # pstlint: jit-family=decode_burst
+        self._multi_step = jax.jit(lambda p, b, n: b)
+        # pstlint: jit-family=encode
+        self._encode = jax.jit(lambda p, t: t)
+        self._tel_scope = "r0"
+
+    def _tel_key(self, kind, batch, extras=()):
+        return (self._tel_scope, kind, tuple(sorted(batch)), extras)
+
+    def _record_warmup(self, kind, key, seconds, label):
+        ENGINE_TELEMETRY.record_dispatch(
+            kind, key, seconds, batch_bucket=label, tokens=0
+        )
+
+    def execute_decode(self, batch):
+        key = self._tel_key("decode", batch)
+        B = len(batch)
+        t0 = time.perf_counter()
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0, batch_bucket=f"b{B}"
+        )
+
+    def execute_decode_multi(self, batch, n):
+        key = self._tel_key("decode", batch, (n,))
+        B = len(batch)
+        t0 = time.perf_counter()
+        ENGINE_TELEMETRY.record_dispatch(
+            "decode", key, time.perf_counter() - t0,
+            batch_bucket=f"b{B}xn{n}",
+        )
+
+    def execute_prefill(self, batch):
+        key = self._tel_key("prefill", batch)
+        B, C = len(batch), 128
+        t0 = time.perf_counter()
+        ENGINE_TELEMETRY.record_dispatch(
+            "prefill", key, time.perf_counter() - t0,
+            batch_bucket=f"b{B}xt{C}",
+        )
+
+    def encode(self, toks):
+        key = (self._tel_scope, "encode", len(toks))
+        T = len(toks)
+        t0 = time.perf_counter()
+        ENGINE_TELEMETRY.record_dispatch(
+            "encode", key, time.perf_counter() - t0, batch_bucket=f"t{T}"
+        )
+
+    def _warmup_decode(self, bucket):
+        key = self._tel_key("decode", {})
+        self._record_warmup("decode", key, 0.0, bucket.label)
+
+    def _warmup_decode_burst(self, bucket):
+        key = self._tel_key("decode", {}, (2,))
+        self._record_warmup("decode", key, 0.0, bucket.label)
+
+    def _warmup_prefill(self, bucket):
+        key = self._tel_key("prefill", {})
+        self._record_warmup("prefill", key, 0.0, bucket.label)
+
+    def _warmup_encode(self, bucket):
+        key = self._tel_key("encode", {})
+        self._record_warmup("encode", key, 0.0, bucket.label)

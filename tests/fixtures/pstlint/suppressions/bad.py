@@ -1,0 +1,12 @@
+"""Suppression-machinery fixtures: reasonless + stale disables."""
+
+import time
+
+
+async def handler():
+    time.sleep(1.0)  # pstlint: disable=async-blocking
+
+
+def clean_function():
+    # pstlint: disable=hop-contract(nothing here ever fires this check)
+    return 1

@@ -1,0 +1,342 @@
+"""pstlint: the analyzer's own test suite.
+
+Three rings:
+
+1. Fixture ring — every check fires on its known-bad snippet and stays
+   quiet on its known-good one (tests/fixtures/pstlint/).
+2. Live-tree ring — the real tree is lint-clean, every suppression
+   carries a reason, and the acceptance mutations (delete a bucket
+   family from precompile.py's enumeration / add an unregistered jit
+   site) flip the recompile-risk check to failing.
+3. CLI ring — exit codes and the JSON report format.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "pstlint"
+
+sys.path.insert(0, str(REPO))
+
+from production_stack_tpu.analysis.pstlint import run_checks  # noqa: E402
+
+pytestmark = pytest.mark.fast
+
+
+def lint(path: pathlib.Path, check: str = None, unused: bool = False):
+    checks = [check] if check else None
+    findings = run_checks(
+        [str(path)], checks=checks, root=path, report_unused=unused
+    )
+    return [f for f in findings if not f.suppressed]
+
+
+def lint_with_root(path: pathlib.Path, root: pathlib.Path, check: str):
+    findings = run_checks([str(path)], checks=[check], root=root)
+    return [f for f in findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# 1. Fixture ring
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncBlocking:
+    def test_fires_on_bad(self):
+        active = lint(FIXTURES / "async_blocking", "async-blocking")
+        msgs = [f.message for f in active]
+        assert len(active) >= 6, msgs
+        assert all(f.path.endswith("bad.py") for f in active)
+        joined = "\n".join(msgs)
+        for needle in ("time.sleep", "requests", "urllib", "subprocess",
+                       "open()"):
+            assert needle in joined
+
+    def test_clean_on_good(self):
+        active = lint(FIXTURES / "async_blocking", "async-blocking")
+        assert not [f for f in active if f.path.endswith("good.py")]
+
+    def test_sync_sleep_rule_scoped_to_loop_packages(self):
+        active = lint(FIXTURES / "async_blocking", "async-blocking")
+        sync_hits = [f for f in active if f.line == 20]  # sync_helper()
+        assert len(sync_hits) == 1
+
+
+class TestHopContract:
+    def test_fires_on_bad(self):
+        active = lint(FIXTURES / "hop_contract", "hop-contract")
+        assert all(f.path.endswith("bad.py") for f in active)
+        hops = [f for f in active if "outbound" in f.message]
+        errors = [f for f in active if "error response" in f.message]
+        assert len(hops) == 2
+        assert len(errors) == 1
+
+    def test_clean_on_good(self):
+        active = lint(FIXTURES / "hop_contract", "hop-contract")
+        assert not [f for f in active if f.path.endswith("good.py")]
+
+
+class TestRecompileRisk:
+    def test_clean_on_good(self):
+        assert lint(FIXTURES / "recompile_risk" / "good",
+                    "recompile-risk") == []
+
+    def test_missing_family_fires(self):
+        active = lint(FIXTURES / "recompile_risk" / "bad_missing_family",
+                      "recompile-risk")
+        assert any("'prefill'" in f.message for f in active), \
+            [f.message for f in active]
+
+    def test_unregistered_jit_and_key_fire(self):
+        active = lint(FIXTURES / "recompile_risk" / "bad_unregistered_jit",
+                      "recompile-risk")
+        assert any("jit-family" in f.message for f in active)
+        assert any("shape key" in f.message for f in active)
+
+
+class TestMetricRegistry:
+    def test_clean_on_good(self):
+        assert lint(FIXTURES / "metric_registry" / "good",
+                    "metric-registry") == []
+
+    def test_bad_fires_all_three_ways(self):
+        active = lint(FIXTURES / "metric_registry" / "bad",
+                      "metric-registry")
+        joined = "\n".join(f.message for f in active)
+        assert "pst_fixture_undeclared" in joined  # code -> registry
+        assert "pst_fixture_ghost" in joined       # registry -> code
+        assert "constructed as a counter but declared as a gauge" in joined
+
+
+class TestLockDiscipline:
+    def test_fires_on_bad(self):
+        active = lint(FIXTURES / "lock_discipline", "lock-discipline")
+        assert all(f.path.endswith("bad.py") for f in active)
+        joined = "\n".join(f.message for f in active)
+        assert "outside 'with self._lock'" in joined
+        assert "second writer surface" in joined
+        # two unlocked table writes + rogue_writer + a foreign __init__
+        # clearing another object's state + a module-level write
+        assert len(active) == 5
+
+    def test_clean_on_good(self):
+        active = lint(FIXTURES / "lock_discipline", "lock-discipline")
+        assert not [f for f in active if f.path.endswith("good.py")]
+
+
+class TestSuppressionMachinery:
+    def test_reasonless_disable_is_flagged_and_inert(self):
+        findings = run_checks(
+            [str(FIXTURES / "suppressions")],
+            root=FIXTURES / "suppressions",
+        )
+        active = [f for f in findings if not f.suppressed]
+        checks = {f.check for f in active}
+        assert "bad-suppression" in checks
+        # The reasonless disable must NOT silence the finding it targeted.
+        assert "async-blocking" in checks
+
+    def test_unused_suppression_is_flagged(self):
+        findings = run_checks(
+            [str(FIXTURES / "suppressions")],
+            root=FIXTURES / "suppressions",
+        )
+        unused = [f for f in findings if f.check == "unused-suppression"]
+        assert len(unused) == 1
+        assert "hop-contract" in unused[0].message
+
+
+# ---------------------------------------------------------------------------
+# 2. Live-tree ring
+# ---------------------------------------------------------------------------
+
+LIVE_PATHS = [str(REPO / "production_stack_tpu"), str(REPO / "scripts")]
+
+
+class TestLiveTree:
+    def test_tree_is_lint_clean(self):
+        findings = run_checks(LIVE_PATHS, root=REPO)
+        active = [f for f in findings if not f.suppressed]
+        assert active == [], "\n" + "\n".join(f.format() for f in active)
+
+    def test_every_suppression_carries_a_reason(self):
+        findings = run_checks(LIVE_PATHS, root=REPO)
+        # bad-suppression findings are unsuppressible; clean tree == all
+        # reasons present. Belt and braces: recheck the parsed model.
+        from production_stack_tpu.analysis import load_project
+
+        project = load_project(LIVE_PATHS, root=REPO)
+        for src in project.files:
+            assert not src.bad_directives, (src.rel, src.bad_directives)
+            for sup in src.suppressions:
+                assert sup.reason.strip(), (src.rel, sup.line)
+        suppressed = [f for f in findings if f.suppressed]
+        assert suppressed, "expected the documented suppressions to exist"
+
+    def test_known_suppressions_present(self):
+        """The issue-mandated suppression: runner.py's device poll."""
+        findings = run_checks(LIVE_PATHS, root=REPO)
+        polls = [
+            f for f in findings
+            if f.suppressed and f.check == "async-blocking"
+            and f.path.endswith("engine/runner.py")
+        ]
+        assert len(polls) == 1
+        assert "step thread" in polls[0].reason
+
+    @pytest.mark.parametrize(
+        "family", ["decode", "decode_burst", "prefill", "spec_verify", "encode"]
+    )
+    def test_deleting_bucket_family_fails_lint(self, family, tmp_path):
+        """Acceptance: deleting any bucket family from precompile.py's
+        enumeration makes recompile-risk fail."""
+        engine = tmp_path / "engine"
+        engine.mkdir()
+        pre = (REPO / "production_stack_tpu/engine/precompile.py").read_text()
+        assert '"%s"' % family in pre
+        pre = pre.replace('"%s"' % family, '"%s_disabled"' % family)
+        (engine / "precompile.py").write_text(pre)
+        shutil.copy(
+            REPO / "production_stack_tpu/engine/runner.py",
+            engine / "runner.py",
+        )
+        active = lint(tmp_path, "recompile-risk")
+        assert any(
+            "'%s'" % family in f.message for f in active
+        ), "deleting %s must fail lint: %s" % (
+            family, [f.message for f in active],
+        )
+
+    def test_adding_unregistered_jit_site_fails_lint(self, tmp_path):
+        engine = tmp_path / "engine"
+        engine.mkdir()
+        shutil.copy(
+            REPO / "production_stack_tpu/engine/precompile.py",
+            engine / "precompile.py",
+        )
+        runner = (REPO / "production_stack_tpu/engine/runner.py").read_text()
+        runner += "\n\n_ROGUE_JIT = jax.jit(lambda x: x)\n"
+        (engine / "runner.py").write_text(runner)
+        active = lint(tmp_path, "recompile-risk")
+        assert any("jit-family" in f.message for f in active)
+
+    def test_subset_lint_resolves_cross_file_anchors(self, tmp_path):
+        """Linting a subtree must not report the registry/lattice as
+        missing — anchors resolve from the repo root (reviewer finding:
+        changed-files-only lint workflows)."""
+        active = lint_with_root(
+            REPO / "production_stack_tpu" / "router", REPO, "metric-registry"
+        )
+        assert active == [], [f.message for f in active]
+        active = lint_with_root(
+            REPO / "production_stack_tpu" / "engine" / "runner.py",
+            REPO, "recompile-risk",
+        )
+        assert active == [], [f.message for f in active]
+
+    def test_single_file_lint_honors_anchor_suppressions(self):
+        """Linting one engine file must not surface findings that the
+        resolved anchor (runner.py) suppresses in its own text — and must
+        not emit unused-suppression noise for files nobody asked about."""
+        findings = run_checks(
+            [str(REPO / "production_stack_tpu/engine/cross_encoder.py")],
+            root=REPO,
+        )
+        active = [f for f in findings if not f.suppressed]
+        assert active == [], "\n".join(f.format() for f in active)
+
+    def test_lambda_bodies_are_not_async_context(self, tmp_path):
+        """The executor-offload idiom (a lambda passed to
+        run_in_executor) must not fire async-blocking."""
+        mod = tmp_path / "router" / "m.py"
+        mod.parent.mkdir()
+        mod.write_text(
+            "import asyncio\n"
+            "async def f(path):\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    return await loop.run_in_executor(\n"
+            "        None, lambda: open(path).read()\n"
+            "    )\n"
+        )
+        active = lint(tmp_path, "async-blocking")
+        assert active == [], [f.message for f in active]
+
+    def test_real_lattice_families_complete(self):
+        """The real enumeration registers exactly the five families."""
+        from production_stack_tpu.analysis.checks.recompile_risk import (
+            lattice_families,
+        )
+        from production_stack_tpu.analysis import load_project
+
+        project = load_project(
+            [str(REPO / "production_stack_tpu" / "engine")], root=REPO
+        )
+        pre = project.find("engine/precompile.py")[0]
+        families, _ = lattice_families(pre)
+        assert families == {
+            "decode", "decode_burst", "prefill", "spec_verify", "encode"
+        }
+
+
+# ---------------------------------------------------------------------------
+# 3. CLI ring
+# ---------------------------------------------------------------------------
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "production_stack_tpu.analysis.pstlint",
+         *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+class TestCLI:
+    def test_clean_tree_exits_zero(self):
+        proc = run_cli("production_stack_tpu/", "scripts/")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stdout
+
+    def test_bad_fixture_exits_one_with_json(self):
+        proc = run_cli(
+            "--format", "json", "--no-unused",
+            "--root", str(FIXTURES / "lock_discipline"),
+            str(FIXTURES / "lock_discipline"),
+        )
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert report["summary"]["active"] >= 3
+        checks = {f["check"] for f in report["findings"]}
+        assert "lock-discipline" in checks
+
+    def test_list_checks(self):
+        proc = run_cli("--list-checks")
+        assert proc.returncode == 0
+        for check in ("async-blocking", "recompile-risk", "hop-contract",
+                      "metric-registry", "lock-discipline"):
+            assert check in proc.stdout
+
+    def test_unknown_check_usage_error(self):
+        proc = run_cli("--checks", "nope", "production_stack_tpu/")
+        assert proc.returncode == 2
+
+    def test_check_metric_docs_shim(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "check_metric_docs.py")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "registry" in proc.stdout
+
+    def test_nonexistent_path_is_a_loud_error(self):
+        proc = run_cli("production_stack_tp/")  # typo'd directory
+        assert proc.returncode == 2
+        assert "do not exist" in proc.stderr

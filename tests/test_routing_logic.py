@@ -151,3 +151,25 @@ def test_initialize_and_get(event_loop):
     teardown_routing_logic()
     initialize_routing_logic(RoutingLogic.SESSION_BASED, session_key="s")
     assert isinstance(get_routing_logic(), SessionRouter)
+
+
+def test_hop_headers_relays_full_trio():
+    """router/hop.py: the relay form copies id + traceparent + deadline
+    (a relay hop must be able to shed an already-expired budget)."""
+    from production_stack_tpu.router.hop import hop_headers
+
+    inbound = {
+        "X-Request-Id": "rid-1",
+        "traceparent": "00-" + "a" * 32 + "-" + "b" * 16 + "-01",
+        "X-PST-Deadline-Ms": "250",
+        "Authorization": "Bearer secret",  # NOT part of the relay trio
+    }
+    out = hop_headers(from_headers=inbound)
+    assert out["X-Request-Id"] == "rid-1"
+    assert out["traceparent"].startswith("00-")
+    assert out["X-PST-Deadline-Ms"] == "250"
+    assert "Authorization" not in out
+    # Explicit request_id wins over the relayed one.
+    assert hop_headers(from_headers=inbound, request_id="rid-2")[
+        "X-Request-Id"
+    ] == "rid-2"
