@@ -15,4 +15,4 @@ standalone TPU-first system:
 - ``helm/``, ``csrc/operator``     — deployment + control plane.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -107,6 +107,17 @@ class EngineConfig:
     # ~35% decode throughput for ~60% worse p50 TTFT, so it is off by
     # default and meant for throughput-oriented (batch) serving.
     async_decode: bool = False
+    # Overlapped decode pipeline (docs/engine.md "Overlapped decode
+    # pipeline"): the arrival-gated form of pipelining. As soon as burst
+    # N's token ids are fetched, burst N+1 is dispatched and burst N's host
+    # bookkeeping (detokenization, stop scans, stream frames, stats,
+    # scheduler accounting) runs WHILE N+1 executes — but a pipeline only
+    # STARTS when the same three arrival-safety rules as adaptive
+    # deepening hold (waiting queue empty, min-running floor met, arrival
+    # stream quiet), so live-traffic TTFT never queues behind an in-flight
+    # burst it didn't already have. Saturated decode gets async_decode's
+    # throughput; paced traffic keeps the synchronous loop's latency.
+    overlap_decode: bool = True
     enforce_eager: bool = False  # reserved; XLA always compiles
     seed: int = 0
     # KV tiering (LMCache-analogue knobs; SURVEY.md §2.4).

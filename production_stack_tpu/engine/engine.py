@@ -107,8 +107,19 @@ class LLMEngine:
                 max_model_len=cfg.max_model_len,
                 num_decode_steps=cfg.num_decode_steps,
                 # The in-flight continuation writes one burst past the host
-                # view, so its pages must already exist at dispatch time.
-                decode_lookahead=2 if cfg.async_decode else 1,
+                # view, so its pages must already exist at dispatch time —
+                # for unconditional pipelining (async_decode) AND for the
+                # arrival-gated overlap (which can engage on any pass).
+                # Spec engines never pipeline (_pipeline_ok defers to
+                # speculation), so they keep the tighter reservation.
+                decode_lookahead=(
+                    2
+                    if (
+                        cfg.async_decode
+                        or (cfg.overlap_decode and not cfg.speculative_ngram)
+                    )
+                    else 1
+                ),
                 spec_tokens=0 if cfg.async_decode else cfg.speculative_ngram,
                 swap_quantum=cfg.swap_quantum_tokens,
                 deadline_shedding=cfg.deadline_shedding,
@@ -143,10 +154,11 @@ class LLMEngine:
             self.lora_manager = None
         # Unloaded-adapter slots awaiting their last in-flight sequence.
         self._retiring_slots: set = set()
-        # Last request arrival (adaptive burst-depth gate) + observability
-        # counter for deep bursts actually executed.
+        # Last request arrival (adaptive burst-depth + overlap gates) +
+        # observability counters for deep/pipelined bursts actually executed.
         self._last_arrival = 0.0
         self.adaptive_deep_bursts_total = 0
+        self.pipelined_bursts_total = 0
         # Compile events awaiting an output-emitting step (see step()).
         self._pending_compile_events: List[dict] = []
         # Precompile summary (engine/precompile.py): populated by
@@ -351,6 +363,24 @@ class LLMEngine:
     # Stepping
     # ------------------------------------------------------------------
 
+    def _arrival_safe(self) -> bool:
+        """The three arrival-safety rules shared by adaptive deepening and
+        overlap engagement (proposals/adaptive-decode-bursts.md): PAST
+        observations only — (1) the waiting queue is empty, (2) at least
+        ``adaptive_decode_min_running`` sequences run (closed-loop traffic:
+        a full running set means no client has a request left to send),
+        (3) no arrival for ``adaptive_decode_quiet_s``. While arrivals
+        flow, every gate-dependent optimization stays off and each arrival
+        sees a fresh scheduling decision."""
+        if self.scheduler.num_waiting:
+            return False
+        if self.scheduler.num_running < self.cfg.adaptive_decode_min_running:
+            return False
+        return (
+            time.time() - self._last_arrival
+            >= self.cfg.adaptive_decode_quiet_s
+        )
+
     def _decode_depth_hint(self) -> Optional[int]:
         """Adaptive burst depth: deepen only when the arrival stream has
         been quiet (PAST arrivals only — a live request stream keeps bursts
@@ -359,11 +389,7 @@ class LLMEngine:
         cap = self.cfg.adaptive_decode_steps
         if not cap or cap <= self.cfg.num_decode_steps:
             return None
-        if self.scheduler.num_waiting:
-            return None
-        if self.scheduler.num_running < self.cfg.adaptive_decode_min_running:
-            return None
-        if time.time() - self._last_arrival < self.cfg.adaptive_decode_quiet_s:
+        if not self._arrival_safe():
             return None
         return cap
 
@@ -394,6 +420,7 @@ class LLMEngine:
             self.num_preempted_total += len(sched.preempted)
             outputs += self._finish_expired(sched.expired)
             if self._can_continue_burst(sched):
+                self.pipelined_bursts_total += 1
                 if self._burst_n > self.cfg.num_decode_steps:
                     self.adaptive_deep_bursts_total += 1
                 rows = self.runner.burst_continue(self._burst_seqs)
@@ -443,18 +470,22 @@ class LLMEngine:
             else:
                 self.runner.execute_prefill_batch_nofetch(sched.prefills)
                 outputs += self._process_prefill_rows(sched.prefills, None)
+        elif (
+            drafts := self._spec_drafts(sched.decodes, sched.n_decode_steps)
+        ) is not None:
+            # Speculation first: when it engages it beats a burst on tokens
+            # per round trip, and the pipeline below picks up whenever the
+            # drafts dry out.
+            outputs += self._spec_step(sched.decodes, drafts)
         elif self._pipeline_ok(sched):
             # First burst of a pipeline: dispatch only; its tokens surface
             # on the NEXT step, overlapped with the following burst.
             self._burst_seqs = list(sched.decodes)
             self._burst_n = sched.n_decode_steps
+            self.pipelined_bursts_total += 1
             if sched.n_decode_steps > self.cfg.num_decode_steps:
                 self.adaptive_deep_bursts_total += 1
             self.runner.burst_start(sched.decodes, sched.n_decode_steps)
-        elif (
-            drafts := self._spec_drafts(sched.decodes, sched.n_decode_steps)
-        ) is not None:
-            outputs += self._spec_step(sched.decodes, drafts)
         else:
             if (
                 hint is not None
@@ -623,16 +654,26 @@ class LLMEngine:
     # -- pipelined decode internals ------------------------------------
 
     def _pipeline_ok(self, sched) -> bool:
-        return (
-            self.cfg.async_decode
-            and bool(sched.decodes)
-            # Penalties need per-token host-updated count arrays; guided
-            # masks are rebuilt per token too.
-            and not any(
-                s.sampling.has_penalties or s.sampling.guided_choice
-                for s in sched.decodes
-            )
-        )
+        """May this pass start a pipelined burst? ``async_decode`` pipelines
+        unconditionally (batch serving); ``overlap_decode`` — the default —
+        engages only when the three arrival-safety rules certify that no
+        arrival can be delayed (`_arrival_safe`), so live-traffic TTFT
+        never pays for the overlap. Guided rows are excluded (their
+        allowed-token mask is rebuilt per token host-side); penalty rows
+        ride — their state lives in multi_step's scan carry."""
+        if not sched.decodes:
+            return False
+        if any(s.sampling.guided_choice for s in sched.decodes):
+            return False
+        if self.cfg.async_decode:
+            return True
+        # Speculation and overlap are alternative round-trip amortizers;
+        # when n-gram speculation is configured it wins outright (more
+        # tokens per trip for greedy rows) and overlap stays out of its
+        # way — deterministically, not by racing the quiet timer.
+        if self.cfg.speculative_ngram:
+            return False
+        return self.cfg.overlap_decode and self._arrival_safe()
 
     def _can_continue_burst(self, sched) -> bool:
         """The in-flight burst may chain iff nothing about the step shape
@@ -897,6 +938,8 @@ class LLMEngine:
             out["adaptive_deep_bursts_total"] = float(
                 self.adaptive_deep_bursts_total
             )
+        if self.cfg.async_decode or self.cfg.overlap_decode:
+            out["pipelined_bursts_total"] = float(self.pipelined_bursts_total)
         # Tiering KPIs (present when the LMCache-analogue layer is on).
         for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):
             if hasattr(self.allocator, attr):

@@ -59,6 +59,11 @@ class Bucket:
     n_steps: int = 0  # burst depth (decode_burst)
     want_lp: bool = False
     greedy: bool = True
+    # Penalty-bearing multi-step variant (decode_burst only): the dense
+    # [rows, V] penalty_seen/counts state keeps these shapes derivable
+    # from config alone, so — unlike the pow2-length id arrays of the
+    # single-step path — they ARE enumerable and warmed.
+    penalized: bool = False
 
     @property
     def label(self) -> str:
@@ -74,11 +79,12 @@ class Bucket:
         return f"t{self.tokens}"
 
     def sort_key(self) -> tuple:
-        # Greedy-no-logprobs first (the overwhelmingly common flag set),
-        # then ascending size so coverage climbs fastest per second.
+        # Greedy-no-logprobs-unpenalized first (the overwhelmingly common
+        # flag set), then ascending size so coverage climbs fastest per
+        # second.
         return (
             _KIND_RANK[self.kind],
-            (self.want_lp, not self.greedy),
+            (self.want_lp, not self.greedy, self.penalized),
             self.rows,
             self.n_steps,
             self.tokens,
@@ -145,17 +151,25 @@ def encode_buckets(cfg: EngineConfig) -> List[int]:
 
 def burst_depths(cfg: EngineConfig) -> List[int]:
     """Burst depths the engine dispatches at steady state: the configured
-    depth and the adaptive deep depth. (The per-sequence clamp near
-    max_model_len can shrink n through arbitrary values on the last few
-    tokens of a context-limit sequence — that long tail is deliberately
-    NOT enumerated; it is one compile per engine lifetime at worst.)"""
-    return sorted(
-        {
-            n
-            for n in (cfg.num_decode_steps, cfg.adaptive_decode_steps)
-            if n and n > 1
-        }
-    )
+    depth and the adaptive deep depth — plus, when a pipelining mode is on
+    (``async_decode`` or the default arrival-gated ``overlap_decode``),
+    the configured depth even at 1: the pipeline runs the multi-step
+    executable (``b{B}xn{n}``) at whatever depth the scheduler emits, so
+    a depth-1 engine overlaps through ``b{B}xn1`` shapes. (The
+    per-sequence clamp near max_model_len can shrink n through arbitrary
+    values on the last few tokens of a context-limit sequence — that long
+    tail is deliberately NOT enumerated; it is one compile per engine
+    lifetime at worst.)"""
+    depths = {
+        n
+        for n in (cfg.num_decode_steps, cfg.adaptive_decode_steps)
+        if n and n > 1
+    }
+    # Mirrors LLMEngine._pipeline_ok: overlap defers to configured n-gram
+    # speculation, so spec engines never dispatch the depth-1 variant.
+    if cfg.async_decode or (cfg.overlap_decode and not cfg.speculative_ngram):
+        depths.add(max(cfg.num_decode_steps, 1))
+    return sorted(depths)
 
 
 # The (want_lp, greedy) static-flag sets warmed by default. Logprob
@@ -180,12 +194,18 @@ def enumerate_lattice(cfg: EngineConfig) -> List[Bucket]:
         for n in burst_depths(cfg):
             for r in rows:
                 for w in widths:
-                    buckets.append(
-                        Bucket(
-                            "decode_burst", rows=r, width=w, n_steps=n,
-                            want_lp=lp, greedy=greedy,
+                    for pen in (False, True):
+                        # Penalized variants are real burst executables
+                        # now (scheduler no longer clamps penalty rows to
+                        # n=1): their dense [rows, V] state is config-
+                        # derivable, so the first penalized request after
+                        # warmup must not be a live compile.
+                        buckets.append(
+                            Bucket(
+                                "decode_burst", rows=r, width=w, n_steps=n,
+                                want_lp=lp, greedy=greedy, penalized=pen,
+                            )
                         )
-                    )
         for rb, cb in prefill_shape_buckets(cfg):
             for w in widths:
                 buckets.append(
@@ -230,6 +250,7 @@ def lazy_core(lattice: List[Bucket], cfg: EngineConfig) -> List[Bucket]:
         for b in lattice
         if b.greedy
         and not b.want_lp
+        and not b.penalized
         and (
             (b.kind in ("decode", "decode_burst") and b.rows == min_r
              and b.width == min_w)

@@ -46,6 +46,7 @@ from ..ops.sampling import (
     apply_allowed_mask,
     apply_logit_bias,
     apply_penalties,
+    apply_penalties_counts,
     sample_tokens_packed,
 )
 from ..parallel.mesh import MeshConfig, build_mesh
@@ -202,6 +203,9 @@ class ModelRunner:
         leaves = jax.tree.leaves(self.params)
         self.param_count = sum(x.size for x in leaves)
         param_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+        # Total weight bytes as resident (post-quantization): the decode
+        # roofline's per-step weight-read term (benchmarks/bench_engine.py).
+        self.param_bytes = param_bytes
         logger.info(
             "params ready: %.2f GiB total, %.1fs", param_bytes / 2**30, time.time() - t0
         )
@@ -311,7 +315,8 @@ class ModelRunner:
         drop_slot = self.num_blocks * bs
 
         def multi_step(params, kv_cache, batch, tokens, positions, seed_off,
-                       n_steps: int, want_lp: bool, greedy: bool):
+                       pen_counts, n_steps: int, want_lp: bool, greedy: bool,
+                       with_pen: bool):
             """Decode ``n_steps`` tokens per sequence in one compiled call.
 
             The inter-token dependency (sampled token feeds the next forward)
@@ -321,12 +326,19 @@ class ModelRunner:
             and are returned advanced, so a FOLLOW-UP burst can chain from
             the previous burst's device outputs with zero host round trips —
             the basis of pipelined decode (one burst always in flight, its
-            fetch overlapped with the next burst's execution)."""
+            fetch overlapped with the next burst's execution).
+
+            ``pen_counts`` ([B, V] output-token occurrence counts, or a
+            [1, 1] placeholder when ``with_pen`` is False) rides the scan
+            carry and is returned advanced: each sampled token increments
+            its own count ON DEVICE, so penalty/repetition rows decode at
+            full burst depth — and a pipelined continuation chains the
+            counts without ever rebuilding them host-side."""
             tables = batch["block_tables"]
             active = batch["kv_lens"] > 0  # padding rows never write
 
             def body(carry, i):
-                kv_cache, tokens, positions, so = carry
+                kv_cache, tokens, positions, so, counts = carry
                 blk = jnp.take_along_axis(
                     tables, (positions // bs)[:, None], axis=1
                 )[:, 0]
@@ -349,6 +361,15 @@ class ModelRunner:
                     pp_size=pp,
                     mesh=mesh_for_pp,
                 )
+                if with_pen:
+                    logits = apply_penalties_counts(
+                        logits,
+                        batch["penalty_seen"],
+                        counts,
+                        batch["presence"],
+                        batch["frequency"],
+                        batch["repetition"],
+                    )
                 if "bias_ids" in batch:
                     logits = apply_logit_bias(
                         logits, batch["bias_ids"], batch["bias_vals"]
@@ -364,26 +385,40 @@ class ModelRunner:
                     greedy_only=greedy,
                 )
                 nxt = packed[:, 0].astype(jnp.int32)
-                return (kv_cache, nxt, positions + 1, so + 1), packed
+                if with_pen:
+                    counts = counts.at[
+                        jnp.arange(counts.shape[0], dtype=jnp.int32), nxt
+                    ].add(active.astype(jnp.float32))
+                return (kv_cache, nxt, positions + 1, so + 1, counts), packed
 
-            carry = (kv_cache, tokens, positions, seed_off)
-            (kv_cache, tokens, positions, seed_off), packed = jax.lax.scan(
-                body, carry, jnp.arange(n_steps), length=n_steps
+            carry = (kv_cache, tokens, positions, seed_off, pen_counts)
+            (kv_cache, tokens, positions, seed_off, pen_counts), packed = (
+                jax.lax.scan(body, carry, jnp.arange(n_steps), length=n_steps)
             )
             # [n, B, W] -> [B, n, W]
-            return packed.transpose(1, 0, 2), tokens, positions, seed_off, kv_cache
+            return (
+                packed.transpose(1, 0, 2), tokens, positions, seed_off,
+                pen_counts, kv_cache,
+            )
 
         # pstlint: jit-family=decode_burst
         self._multi_step = jax.jit(
             multi_step,
-            static_argnums=(6, 7, 8),
+            static_argnums=(7, 8, 9, 10),
             donate_argnums=(1,),
             out_shardings=(
-                self._repl, self._repl, self._repl, self._repl, cache_sh
+                self._repl, self._repl, self._repl, self._repl, self._repl,
+                cache_sh,
             ),
         )
         # Pipelined-burst state: device handles of the burst in flight.
         self._burst = None
+        # Host-gap accounting: perf_counter stamp of the moment the last
+        # decode step's tokens became host-visible with the device idle
+        # (pst_engine_host_gap_seconds measures from here to the next
+        # decode dispatch — the serial host bookkeeping on the critical
+        # path that the overlapped pipeline exists to hide).
+        self._host_gap_t0: Optional[float] = None
         # Multi-host control plane (None on single-host): installed by the
         # server when jax.process_count() > 1; every device dispatch below
         # announces first so followers issue the identical XLA call.
@@ -653,6 +688,7 @@ class ModelRunner:
         length = np.array([len(token_ids)], np.int32)
         key = (self._tel_scope, "encode", T)
         t0 = time.perf_counter()
+        self._host_gap_cancel()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("encode", (toks, length))
@@ -711,6 +747,27 @@ class ModelRunner:
         shapes = tuple(sorted((k, np.shape(v)) for k, v in batch.items()))
         return (self._tel_scope, kind, shapes, extras)
 
+    # -- host-gap accounting (pst_engine_host_gap_seconds) ---------------
+
+    def _host_gap_mark(self, bucket: str, t_dispatch: float) -> None:
+        """Close the open host gap at a decode dispatch: the wall between
+        the previous decode step's completion and this dispatch is pure
+        serial host bookkeeping (batch build, detok, stop scans, scheduler
+        accounting) that idled the device."""
+        t0, self._host_gap_t0 = self._host_gap_t0, None
+        if t0 is not None:
+            ENGINE_TELEMETRY.record_host_gap(bucket, t_dispatch - t0)
+
+    def _host_gap_arm(self) -> None:
+        """A decode step's tokens just became host-visible with no further
+        device work queued: the host gap starts now."""
+        self._host_gap_t0 = time.perf_counter()
+
+    def _host_gap_cancel(self) -> None:
+        """A non-decode dispatch (prefill/spec/encode) intervened: the
+        decode→decode gap is no longer host bookkeeping — drop it."""
+        self._host_gap_t0 = None
+
     def execute_decode(self, seqs: List[Sequence]) -> np.ndarray:
         """One decode step per sequence. Returns packed sample rows
         [len(seqs), 1 or PACKED_WIDTH] (token [+ logprobs]; ops/sampling.py)."""
@@ -719,7 +776,9 @@ class ModelRunner:
         key = self._tel_key("decode", batch, (want_lp, greedy))
         Bb = batch["kv_lens"].shape[0]
         t0 = time.perf_counter()
+        self._host_gap_mark(f"b{Bb}", t0)
         rows = self._run(batch, want_lp, greedy)
+        self._host_gap_arm()
         ENGINE_TELEMETRY.record_dispatch(
             "decode", key, time.perf_counter() - t0,
             batch_bucket=f"b{Bb}", tokens=len(seqs),
@@ -742,23 +801,63 @@ class ModelRunner:
             raise RuntimeError(
                 "guided-choice rows reached a multi-step decode burst"
             )
+        counts = self._penalty_counts_for(seqs, batch)
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
         key = self._tel_key("decode", batch, (n_steps, want_lp, greedy))
         Bb = batch["kv_lens"].shape[0]
         t0 = time.perf_counter()
+        self._host_gap_mark(f"b{Bb}xn{n_steps}", t0)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
-                    "multi_step", (batch, n_steps, want_lp, greedy)
+                    "multi_step", (batch, counts, n_steps, want_lp, greedy)
                 )
-            rows = self._dispatch_multi_step(batch, n_steps, want_lp, greedy)
+            rows = self._dispatch_multi_step(
+                batch, counts, n_steps, want_lp, greedy
+            )
+        self._host_gap_arm()
         ENGINE_TELEMETRY.record_dispatch(
             "decode", key, time.perf_counter() - t0,
             batch_bucket=f"b{Bb}xn{n_steps}", tokens=len(seqs) * n_steps,
             fill_ratio=len(seqs) / Bb,
         )
         return rows[: len(seqs)]
+
+    def _penalty_counts_for(
+        self, seqs: List[Sequence], batch: Dict[str, np.ndarray]
+    ) -> np.ndarray:
+        """Dense penalty state for a multi-step batch, replacing the
+        token-id arrays ``_sampling_arrays`` builds for the single-step
+        path: ``penalty_seen`` [Bb, V] bool (prompt occurrence — constant
+        over the whole burst/pipeline) goes INTO the batch, and the
+        returned [Bb, V] float32 output-token counts ride ``multi_step``'s
+        scan carry. Dense state keeps the executable's trace signature
+        independent of prompt/output lengths (one penalized variant per
+        bucket, not one per pow2 length). Returns the [1, 1] placeholder
+        when no row is penalized."""
+        if not any(s.sampling.has_penalties for s in seqs):
+            # The id-array penalty fields are only built when a row is
+            # penalized; nothing to strip.
+            return np.zeros((1, 1), np.float32)
+        Bb = batch["kv_lens"].shape[0]
+        V = self.model_cfg.vocab_size
+        seen = np.zeros((Bb, V), bool)
+        counts = np.zeros((Bb, V), np.float32)
+        for i, s in enumerate(seqs):
+            ids = np.asarray(s.prompt_token_ids, np.int64)
+            seen[i, ids[(ids >= 0) & (ids < V)]] = True
+            if s.output_token_ids:
+                out = np.asarray(s.output_token_ids, np.int64)
+                uniq, cnt = np.unique(
+                    out[(out >= 0) & (out < V)], return_counts=True
+                )
+                counts[i, uniq] = cnt
+        # Replace the pow2-length id arrays with the dense form.
+        batch.pop("penalty_prompt", None)
+        batch.pop("penalty_output", None)
+        batch["penalty_seen"] = seen
+        return counts
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """ONE device_put for the whole batch tree. Separate puts cost a
@@ -771,17 +870,20 @@ class ModelRunner:
     def _dispatch_multi_step(
         self,
         batch: Dict[str, np.ndarray],
+        counts: np.ndarray,
         n_steps: int,
         want_lp: bool = False,
         greedy: bool = False,
     ) -> np.ndarray:
         dev = self._put_batch(batch)
         seed0 = jax.device_put(np.zeros((), np.uint32), self._repl)
+        cdev = jax.device_put(counts, self._repl)
         tokens = dev.pop("tokens")
         positions = dev.pop("positions")
-        toks, _, _, _, self.kv_cache = self._multi_step(
+        with_pen = "penalty_seen" in batch
+        toks, _, _, _, _, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, dev, tokens, positions, seed0,
-            n_steps, want_lp, greedy,
+            cdev, n_steps, want_lp, greedy, with_pen,
         )
         return _fetch(toks)
 
@@ -805,18 +907,20 @@ class ModelRunner:
             raise RuntimeError(
                 "guided-choice rows reached a pipelined decode burst"
             )
+        counts = self._penalty_counts_for(seqs, batch)
         want_lp = self._want_lp(seqs)
         greedy = self._all_greedy(seqs)
         key = self._tel_key("decode", batch, (n_steps, want_lp, greedy))
         Bb = batch["kv_lens"].shape[0]
         bucket = f"b{Bb}xn{n_steps}"
         t0 = time.perf_counter()
+        self._host_gap_mark(bucket, t0)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
-                    "burst_start", (batch, n_steps, want_lp, greedy)
+                    "burst_start", (batch, counts, n_steps, want_lp, greedy)
                 )
-            self._dispatch_burst_start(batch, n_steps, want_lp, greedy)
+            self._dispatch_burst_start(batch, counts, n_steps, want_lp, greedy)
         ENGINE_TELEMETRY.record_dispatch(
             "decode", key, time.perf_counter() - t0,
             batch_bucket=bucket, tokens=len(seqs) * n_steps,
@@ -830,17 +934,20 @@ class ModelRunner:
     def _dispatch_burst_start(
         self,
         batch: Dict[str, np.ndarray],
+        counts: np.ndarray,
         n_steps: int,
         want_lp: bool = False,
         greedy: bool = False,
     ) -> None:
         dev = self._put_batch(batch)
         seed = jax.device_put(np.zeros((), np.uint32), self._repl)
+        cdev = jax.device_put(counts, self._repl)
         tokens = dev.pop("tokens")
         positions = dev.pop("positions")
-        toks, tokens, positions, seed, self.kv_cache = self._multi_step(
+        with_pen = "penalty_seen" in batch
+        toks, tokens, positions, seed, cdev, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, dev, tokens, positions, seed,
-            n_steps, want_lp, greedy,
+            cdev, n_steps, want_lp, greedy, with_pen,
         )
         try:  # start the host copy NOW; the eventual fetch finds it resident
             toks.copy_to_host_async()
@@ -848,7 +955,8 @@ class ModelRunner:
             pass
         self._burst = {
             "batch": dev, "tokens": tokens, "positions": positions,
-            "seed": seed, "toks": toks, "n": n_steps, "want_lp": want_lp,
+            "seed": seed, "counts": cdev, "with_pen": with_pen,
+            "toks": toks, "n": n_steps, "want_lp": want_lp,
             "greedy": greedy,
         }
 
@@ -884,6 +992,13 @@ class ModelRunner:
             rows = self._dispatch_burst_continue(tables, kv_lens)
         tel = getattr(self, "_burst_tel", None)
         if tel is not None:
+            # The continuation was dispatched BEFORE the previous burst's
+            # tokens were even read: the device runs the two back-to-back,
+            # so the host gap on this step is — by construction — zero.
+            # Recording it keeps the histogram's percentiles honest about
+            # what the pipeline removed (not silently absent at steady
+            # state).
+            ENGINE_TELEMETRY.record_host_gap(tel[1], 0.0)
             key, bucket, rows_b, n = tel
             # pstlint: disable=recompile-risk(key and bucket are carried verbatim from burst_start's registered _tel_key via _burst_tel — a continuation re-dispatches the same executable, so the shape identity cannot drift)
             ENGINE_TELEMETRY.record_dispatch(
@@ -901,16 +1016,19 @@ class ModelRunner:
         st["batch"].update(
             self._put_batch({"block_tables": tables, "kv_lens": kv_lens})
         )
-        toks, tokens, positions, seed, self.kv_cache = self._multi_step(
+        toks, tokens, positions, seed, counts, self.kv_cache = self._multi_step(
             self.params, self.kv_cache, st["batch"], st["tokens"],
-            st["positions"], st["seed"], st["n"], st["want_lp"],
-            st.get("greedy", False),
+            st["positions"], st["seed"], st["counts"], st["n"],
+            st["want_lp"], st.get("greedy", False), st.get("with_pen", False),
         )
         try:  # start the host copy NOW; the eventual fetch finds it resident
             toks.copy_to_host_async()
         except Exception:  # pragma: no cover
             pass
-        st.update(tokens=tokens, positions=positions, seed=seed, toks=toks)
+        st.update(
+            tokens=tokens, positions=positions, seed=seed, counts=counts,
+            toks=toks,
+        )
         return _fetch(prev)
 
     def burst_drain(self) -> np.ndarray:
@@ -920,7 +1038,13 @@ class ModelRunner:
         # No device op, so no multihost announce: followers hold no pending
         # fetch (they never read tokens) and their next announced dispatch
         # keeps program order identical.
-        return _fetch(st["toks"])
+        rows = _fetch(st["toks"])
+        # Drains are transitions (an arrival or shape change broke the
+        # pipeline) and a prefill may already be queued behind this fetch —
+        # the wall from here to the next decode dispatch is not steady-state
+        # host bookkeeping, so the gap clock does not run across it.
+        self._host_gap_cancel()
+        return rows
 
     def execute_spec_verify(
         self, seqs: List[Sequence], drafts: np.ndarray
@@ -943,6 +1067,7 @@ class ModelRunner:
         key = self._tel_key("spec_verify", batch, (K,))
         Bb = batch["kv_lens"].shape[0]
         t0 = time.perf_counter()
+        self._host_gap_cancel()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("spec_verify", batch)
@@ -1105,6 +1230,7 @@ class ModelRunner:
             items, batch, (want_lp, greedy)
         )
         t0 = time.perf_counter()
+        self._host_gap_cancel()
         rows = self._run(batch, want_lp, greedy)
         ENGINE_TELEMETRY.record_dispatch(
             "prefill", key, time.perf_counter() - t0,
@@ -1127,6 +1253,7 @@ class ModelRunner:
         # executable a fetching greedy step uses.
         key, bucket, real, fill = self._prefill_tel(items, batch, (False, True))
         t0 = time.perf_counter()
+        self._host_gap_cancel()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("step_nofetch", batch)
@@ -1156,6 +1283,7 @@ class ModelRunner:
             items, batch, (want_lp, greedy)
         )
         t0 = time.perf_counter()
+        self._host_gap_cancel()
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce("step", (batch, want_lp, greedy))
@@ -1276,6 +1404,17 @@ class ModelRunner:
             "kv_lens": np.zeros(Bb, np.int32),
         }
         batch.update(self._warmup_sampling_arrays(Bb))
+        if getattr(bucket, "penalized", False):
+            # The dense penalty form _penalty_counts_for builds for live
+            # penalized bursts: all-neutral state, exact same shapes.
+            V = self.model_cfg.vocab_size
+            batch["penalty_seen"] = np.zeros((Bb, V), bool)
+            batch["presence"] = np.zeros(Bb, np.float32)
+            batch["frequency"] = np.zeros(Bb, np.float32)
+            batch["repetition"] = np.ones(Bb, np.float32)
+            counts = np.zeros((Bb, V), np.float32)
+        else:
+            counts = np.zeros((1, 1), np.float32)
         key = self._tel_key(
             "decode", batch, (n, bucket.want_lp, bucket.greedy)
         )
@@ -1283,9 +1422,12 @@ class ModelRunner:
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
-                    "multi_step", (batch, n, bucket.want_lp, bucket.greedy)
+                    "multi_step",
+                    (batch, counts, n, bucket.want_lp, bucket.greedy),
                 )
-            self._dispatch_multi_step(batch, n, bucket.want_lp, bucket.greedy)
+            self._dispatch_multi_step(
+                batch, counts, n, bucket.want_lp, bucket.greedy
+            )
         self._record_warmup(
             "decode", key, time.perf_counter() - t0, bucket.label
         )

@@ -280,9 +280,11 @@ class Scheduler:
         n = max(self._n_decode_hint or self.config.num_decode_steps, 1)
         for seq in self.running:
             n = min(n, max(self.config.max_model_len - seq.num_tokens, 1))
-            if seq.sampling.has_penalties or seq.sampling.guided_choice:
-                # Penalties need per-token count updates host-side; guided
-                # decoding needs its allowed-token mask rebuilt per token.
+            if seq.sampling.guided_choice:
+                # Guided decoding needs its allowed-token mask rebuilt per
+                # token host-side. (Penalty rows ride bursts at full depth:
+                # the occurrence counts live in multi_step's scan carry —
+                # ops/sampling.py apply_penalties_counts.)
                 n = 1
         look = max(self.config.decode_lookahead, 1)
         for seq in list(self.running):

@@ -173,6 +173,11 @@ class EngineMetrics:
             "pst:adaptive_deep_bursts",
             "decode bursts executed at the adaptive deep depth",
         )
+        self.pipelined_bursts = counter(
+            "pst:pipelined_bursts",
+            "decode bursts dispatched as part of an overlapped pipeline "
+            "(one burst in flight, host bookkeeping off the critical path)",
+        )
         # Deadline shedding by stage (docs/resilience.md): admission counts
         # at the HTTP layer; queued/running refresh from scheduler stats.
         self.deadline_shed_admission = counter(
@@ -259,6 +264,10 @@ class EngineMetrics:
         self._counter_to(
             self.adaptive_deep, "deep",
             stats.get("adaptive_deep_bursts_total", 0),
+        )
+        self._counter_to(
+            self.pipelined_bursts, "pipelined",
+            stats.get("pipelined_bursts_total", 0),
         )
         self._counter_to(
             self.deadline_shed_queued, "dl_queued",
@@ -1467,6 +1476,17 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--adaptive-decode-quiet-s", type=float, default=0.5)
     p.add_argument("--adaptive-decode-min-running", type=int, default=0)
     p.add_argument("--min-decode-bucket", type=int, default=1)
+    # Overlapped decode pipeline (docs/engine.md "Overlapped decode
+    # pipeline"): burst N+1 dispatches as soon as burst N's tokens are
+    # fetched, N's host bookkeeping overlaps N+1's execution; engages only
+    # under the adaptive-deepening arrival-safety gates so TTFT is
+    # unaffected.
+    p.add_argument("--overlap-decode", dest="overlap_decode",
+                   action="store_true", default=True)
+    p.add_argument("--no-overlap-decode", dest="overlap_decode",
+                   action="store_false",
+                   help="disable the arrival-gated overlapped decode "
+                        "pipeline (synchronous hot loop)")
     # Speculative decoding (n-gram prompt lookup; 0 = off).
     p.add_argument("--speculative-ngram", type=int, default=0,
                    help="max draft tokens per step via n-gram prompt lookup")
@@ -1573,6 +1593,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         adaptive_decode_steps=args.adaptive_decode_steps,
         adaptive_decode_quiet_s=args.adaptive_decode_quiet_s,
         adaptive_decode_min_running=args.adaptive_decode_min_running,
+        overlap_decode=args.overlap_decode,
         min_decode_bucket=args.min_decode_bucket,
         speculative_ngram=args.speculative_ngram,
         ngram_min=args.ngram_min,

@@ -70,6 +70,20 @@ step_duration = Histogram(
     registry=ENGINE_TELEMETRY_REGISTRY,
     buckets=_STEP_BUCKETS,
 )
+# Host gaps span "pipelined, zero by construction" to ~100 ms of serial
+# bookkeeping between bursts on a busy host.
+_HOST_GAP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25)
+host_gap_seconds = Histogram(
+    "pst_engine_host_gap_seconds",
+    "Serial host wall between a decode step's device completion and the "
+    "next decode dispatch (batch build, detokenization, stop scans, "
+    "scheduler accounting on the critical path), by padded batch bucket; "
+    "pipelined continuations record 0 — the device never idled",
+    ["batch_bucket"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+    buckets=_HOST_GAP_BUCKETS,
+)
 batch_fill_ratio = Histogram(
     "pst_engine_batch_fill_ratio",
     "Useful fraction of each padded device step (real rows*tokens over "
@@ -203,6 +217,10 @@ class EngineTelemetry:
         # monitoring listener precompile.configure_compile_cache installs).
         self._cache_hits = 0
         self._cache_misses = 0
+        # Bounded raw host-gap samples per batch bucket: Prometheus
+        # histograms cannot answer "p50 at batch 8" locally, but the bench
+        # and scripts/tpu_decode_profile.py --host-gap must.
+        self._host_gap: Dict[str, "deque[float]"] = {}
         self.param_count = 0
         self.peak_flops = _DEFAULT_PEAK_FLOPS
         # --no-startup-phases: the gauges stay at 0 (helm
@@ -295,6 +313,50 @@ class EngineTelemetry:
             )
         return compiled
 
+    _HOST_GAP_SAMPLE_CAP = 1024  # per bucket; enough for a stable p50
+
+    def record_host_gap(self, batch_bucket: str, seconds: float) -> None:
+        """One decode-loop host gap (engine/runner.py host-gap accounting):
+        the serial host wall between a decode step's completion and the
+        next decode dispatch. Pipelined continuations record 0.0 — the
+        continuation was dispatched before the previous burst's tokens
+        were read, so the device ran the two back-to-back."""
+        seconds = max(seconds, 0.0)
+        with self._lock:
+            dq = self._host_gap.get(batch_bucket)
+            if dq is None:
+                dq = self._host_gap[batch_bucket] = deque(
+                    maxlen=self._HOST_GAP_SAMPLE_CAP
+                )
+            dq.append(seconds)
+        host_gap_seconds.labels(batch_bucket=batch_bucket).observe(seconds)
+
+    def reset_host_gap(self) -> None:
+        """Drop retained host-gap samples (NOT the Prometheus histogram —
+        that stays cumulative). The bench calls this per phase so one
+        model's summary never mixes a previous engine's samples that
+        landed in the same batch bucket."""
+        with self._lock:
+            self._host_gap.clear()
+
+    def host_gap_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-bucket {count, p50, mean} over the retained sample window —
+        what the bench's roofline block and the --host-gap profiling mode
+        report (the acceptance bar: p50 < 10% of the decode-step p50)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            buckets = {k: list(v) for k, v in self._host_gap.items()}
+        for bucket, samples in sorted(buckets.items()):
+            if not samples:
+                continue
+            ordered = sorted(samples)
+            out[bucket] = {
+                "count": float(len(ordered)),
+                "p50": float(ordered[len(ordered) // 2]),
+                "mean": float(sum(ordered) / len(ordered)),
+            }
+        return out
+
     def _refresh_throughput_locked(self, now: float) -> None:
         cutoff = now - self._TOKEN_WINDOW_S
         while self._tok_samples and self._tok_samples[0][0] < cutoff:
@@ -385,6 +447,7 @@ class EngineTelemetry:
             self._kv_hwm = 0.0
             self._cache_hits = 0
             self._cache_misses = 0
+            self._host_gap.clear()
             self.startup_enabled = True
 
 

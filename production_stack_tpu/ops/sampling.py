@@ -145,6 +145,29 @@ def apply_allowed_mask(
     return jnp.where(mask, logits, _NEG)
 
 
+def apply_penalties_counts(
+    logits: jax.Array,  # [B, V] float32
+    prompt_seen: jax.Array,  # [B, V] bool
+    out_counts: jax.Array,  # [B, V] float32 (output-token occurrence counts)
+    presence: jax.Array,  # [B]
+    frequency: jax.Array,  # [B]
+    repetition: jax.Array,  # [B]
+) -> jax.Array:
+    """Penalty math over *dense* per-vocab state. This is the form a
+    decode-burst scan can carry: ``out_counts`` updates on-device after
+    every sampled token (``multi_step``'s scan carry in engine/runner.py),
+    so penalty/repetition rows ride multi-step bursts instead of forcing
+    the whole batch to n=1 single-step dispatches."""
+    seen = prompt_seen | (out_counts > 0)
+    rep = repetition[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    )
+    logits = logits - frequency[:, None] * out_counts
+    logits = logits - presence[:, None] * (out_counts > 0).astype(jnp.float32)
+    return logits
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V] float32
     prompt_tokens: jax.Array,  # [B, Pp] int32, pad = V (dropped)
@@ -154,7 +177,9 @@ def apply_penalties(
     repetition: jax.Array,  # [B]
 ) -> jax.Array:
     """vLLM-convention penalties: repetition over prompt+output occurrence;
-    presence/frequency over output counts."""
+    presence/frequency over output counts. Token-id-array form used by the
+    single-step path; scatters into the dense state and delegates to
+    :func:`apply_penalties_counts` so the two paths cannot drift."""
     B, V = logits.shape
     rows = jnp.arange(B, dtype=jnp.int32)[:, None]
     out_counts = (
@@ -167,11 +192,6 @@ def apply_penalties(
         .at[rows, prompt_tokens]
         .set(True, mode="drop")
     )
-    seen = prompt_seen | (out_counts > 0)
-    rep = repetition[:, None]
-    logits = jnp.where(
-        seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    return apply_penalties_counts(
+        logits, prompt_seen, out_counts, presence, frequency, repetition
     )
-    logits = logits - frequency[:, None] * out_counts
-    logits = logits - presence[:, None] * (out_counts > 0).astype(jnp.float32)
-    return logits

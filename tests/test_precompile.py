@@ -58,6 +58,17 @@ def _gauge(name: str, **labels) -> float:
     return value
 
 
+def _kind_compiles(kind: str) -> float:
+    """Sum of pst_engine_compile_total over all shape buckets of ``kind``."""
+    total = 0.0
+    for metric in ENGINE_TELEMETRY_REGISTRY.collect():
+        if metric.name == "pst_engine_compile":
+            for s in metric.samples:
+                if s.name.endswith("_total") and s.labels.get("kind") == kind:
+                    total += s.value
+    return total
+
+
 # ----------------------------------------------------------------------
 # Lattice enumeration (pure config)
 # ----------------------------------------------------------------------
@@ -75,6 +86,14 @@ def test_lattice_enumerates_expected_buckets():
     assert ("decode", "b1") in labels and ("decode", "b2") in labels
     assert ("decode_burst", "b1xn2") in labels
     assert ("decode_burst", "b2xn2") in labels
+    # Penalized burst variants are enumerated (scheduler no longer clamps
+    # penalty rows to n=1, so their executable must be warmable).
+    assert any(
+        b.kind == "decode_burst" and b.penalized for b in lattice
+    )
+    assert not any(
+        b.kind != "decode_burst" and b.penalized for b in lattice
+    )
     assert ("prefill", "b1xt8") in labels and ("prefill", "b2xt4") in labels
     assert ("encode", "t64") in labels
     # No spec shapes without speculative_ngram.
@@ -92,8 +111,23 @@ def test_lattice_respects_min_decode_bucket_and_spec():
     assert any(
         b.kind == "spec_verify" and b.label == "b2xk2" for b in lattice
     )
-    # num_decode_steps=1 → no burst shapes.
+    # Spec engines: overlap defers to speculation (engine._pipeline_ok),
+    # so no depth-1 burst shapes are promised for them.
     assert not any(b.kind == "decode_burst" for b in lattice)
+    # Default overlap_decode (no spec) pipelines through the multi-step
+    # executable even at depth 1: b{B}xn1 must be enumerated or the first
+    # pipelined burst would be a live-traffic compile.
+    ov = EngineConfig(**dict(TINY, min_decode_bucket=2, num_decode_steps=1))
+    assert any(
+        b.kind == "decode_burst" and b.label == "b2xn1"
+        for b in enumerate_lattice(ov)
+    )
+    # With every pipelining mode off, num_decode_steps=1 → no burst shapes.
+    off = EngineConfig(**dict(TINY, num_decode_steps=1,
+                              overlap_decode=False))
+    assert not any(
+        b.kind == "decode_burst" for b in enumerate_lattice(off)
+    )
 
 
 def test_prefill_pairs_respect_token_budget():
@@ -205,6 +239,24 @@ def test_full_warmup_then_zero_compiles_on_spanning_traffic():
     assert ENGINE_TELEMETRY.compile_count() == c0, (
         "live traffic after a full warmup must not compile anything"
     )
+
+    # 4) Penalized row: its DECODE bursts ride the warmed with_pen variant
+    #    (dense [B, V] penalty state — zero decode compiles). Its prefill
+    #    is the documented exception: single-step/prefill penalty shapes
+    #    carry pow2-length id arrays and are deliberately not warmed
+    #    (docs/engine.md) — exactly one attributed compile.
+    c_decode = _kind_compiles("decode")
+    engine.add_request(
+        "r6", prompt_token_ids=list(range(4, 11)),
+        sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                repetition_penalty=1.3,
+                                presence_penalty=0.5),
+    )
+    _drain(engine)
+    assert _kind_compiles("decode") == c_decode, (
+        "penalized burst variant was not covered by warmup"
+    )
+    assert ENGINE_TELEMETRY.compile_count() <= c0 + 1
 
 
 def test_full_warmup_covers_spec_verify():

@@ -98,8 +98,9 @@ def test_infeasible_prompt_rejected_at_add():
 
 def test_decode_depth_hint_overrides_and_clamps():
     """Adaptive burst depth (engine hint): schedule(n_decode=) deepens the
-    burst; per-sequence clamps (max_model_len margin, guided/penalty rows)
-    still apply over the hint."""
+    burst; per-sequence clamps (max_model_len margin, guided rows) still
+    apply over the hint. Penalty rows ride at full depth — their state
+    lives in multi_step's scan carry now."""
     sched, alloc = _sched(num_blocks=32, bs=4, num_decode_steps=2)
     a = Sequence("a", [1, 2, 3, 4, 5], SamplingParams(max_tokens=64))
     sched.add(a)
@@ -115,6 +116,12 @@ def test_decode_depth_hint_overrides_and_clamps():
     # The hint does not stick: the next pass reverts to the config depth.
     out = sched.schedule()
     assert out.n_decode_steps == 2
+
+    # Penalty rows keep the full depth (counts ride the scan carry).
+    a.sampling = SamplingParams(max_tokens=64, repetition_penalty=1.2,
+                                presence_penalty=0.5)
+    out = sched.schedule(n_decode=16)
+    assert out.n_decode_steps == 16
 
     # Guided rows force n=1 regardless of hint.
     a.sampling = SamplingParams(max_tokens=64, guided_choice=(("x", (9,)),))
