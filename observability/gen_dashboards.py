@@ -302,6 +302,27 @@ def fleet_dashboard():
     p.append(stat("Canary failures /10m",
                   'sum(increase(pst_canary_failures_total[10m])) or vector(0)',
                   4, 96))
+    # Row 12 — Router HA / replication (docs/router-ha.md): membership,
+    # sync health, fleet admission shares, journal takeovers. Flat at
+    # single replica; the interesting traces appear the moment
+    # routerSpec.replicaCount > 1.
+    p.append(panel("Router replicas: membership + admission share", [
+        ('min(pst_router_replica_peers)', "live replicas (min view)"),
+        ('sum(pst_router_replica_admission_share)',
+         "sum of admission shares (should be ~1)"),
+    ], 0, 100))
+    p.append(panel("State-sync exchanges by outcome", [
+        ('sum(rate(pst_router_replica_sync_total[2m])) by (outcome)',
+         "{{outcome}} /s"),
+        ('histogram_quantile(0.9, sum(rate('
+         'pst_router_replica_sync_seconds_bucket[5m])) by (le))',
+         "exchange p90 (s)"),
+    ], 8, 100))
+    p.append(panel("Journal checkpoints + takeovers", [
+        ('sum(pst_router_replica_journals) by (kind)', "{{kind}} journals"),
+        ('sum(rate(pst_router_replica_takeovers_total[5m])) by (outcome)',
+         "takeover {{outcome}} /s"),
+    ], 16, 100))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

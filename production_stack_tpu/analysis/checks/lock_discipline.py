@@ -31,6 +31,19 @@ docs/static-analysis.md); the point is to catch the easy-to-write,
 hard-to-debug direct second writer.
 
 Suppress with ``# pstlint: disable=lock-discipline(<reason>)``.
+
+Backend discipline (router HA, ROADMAP item 5 landed): on the
+routing-state surfaces — ``resilience/``, ``router/routing/``,
+``router/stats/``, ``router/state/`` and ``router/service_discovery.py``
+— every *mutable container* attribute assigned in an ``__init__`` must
+declare its writer surface with ``owned-by=lock:…`` / ``owned-by=task:…``,
+or declare that the state is coordinated through the
+:class:`~production_stack_tpu.router.state.StateBackend` with
+``owned-by=backend:<surface>`` (no same-file mutation checking then —
+the backend owns the merge semantics). Undeclared mutable state on these
+surfaces is exactly how a second replica-divergent writer slips in after
+the scale-out refactor, so it fails CI at the declaration, not in an
+incident.
 """
 
 from __future__ import annotations
@@ -259,11 +272,106 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# ---------------------------------------------------------------------------
+# Backend discipline: new mutable state on routing-state surfaces must
+# declare its writer (owned-by=lock:/task:) or its replication contract
+# (owned-by=backend:...). Scope = the state ROADMAP item 5 replicated.
+# ---------------------------------------------------------------------------
+
+_BACKEND_SCOPE_DIRS = (
+    "resilience/", "router/routing/", "router/stats/", "router/state/",
+)
+
+_MUTABLE_CONSTRUCTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter", "bytearray",
+}
+
+
+def _in_backend_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if rel.endswith("router/service_discovery.py"):
+        return True
+    return any(d in rel for d in _BACKEND_SCOPE_DIRS)
+
+
+def _mutable_initializer(value: ast.AST) -> Optional[str]:
+    """Name of the mutable container this expression constructs, if any."""
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(value, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(value, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(value, ast.Call) and not value.args and not value.keywords:
+        name = None
+        if isinstance(value.func, ast.Name):
+            name = value.func.id
+        elif isinstance(value.func, ast.Attribute):
+            name = value.func.attr
+        if name in _MUTABLE_CONSTRUCTORS:
+            return name
+    return None
+
+
+def _check_backend_discipline(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if src.tree is None:
+        return findings
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next(
+            (
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name == "__init__"
+            ),
+            None,
+        )
+        if init is None:
+            continue
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            kind = _mutable_initializer(value)
+            if kind is None:
+                continue
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                if src.annotation_at(node.lineno, "owned-by") is not None:
+                    continue
+                findings.append(Finding(
+                    CHECK_ID, src.rel, node.lineno, node.col_offset,
+                    "mutable state %r (%s) on a routing-state surface "
+                    "(class %s) declares no writer: annotate "
+                    "'# pstlint: owned-by=lock:<attr>' / "
+                    "'owned-by=task:<fns>' for single-writer local state, "
+                    "or 'owned-by=backend:<surface>' when the state is "
+                    "replicated/coordinated through the router "
+                    "StateBackend — undeclared state is how replica-"
+                    "divergent second writers slip in"
+                    % (tgt.attr, kind, cls.name),
+                ))
+    return findings
+
+
 def run(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for src in project.files:
         if src.tree is None:
             continue
+        if _in_backend_scope(src.rel):
+            findings.extend(_check_backend_discipline(src))
         owned = _collect_owned(src)
         if not owned:
             continue

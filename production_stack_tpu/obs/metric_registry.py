@@ -90,6 +90,13 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_stream_resume_success_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_resume_failures_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_truncated_total", COUNTER, "resilience/metrics.py"),
+    # --- router/state/metrics.py: router HA / replication ----------------
+    MetricSpec("pst_router_replica_peers", GAUGE, "router/state/metrics.py"),
+    MetricSpec("pst_router_replica_sync", COUNTER, "router/state/metrics.py"),
+    MetricSpec("pst_router_replica_sync_seconds", HISTOGRAM, "router/state/metrics.py"),
+    MetricSpec("pst_router_replica_admission_share", GAUGE, "router/state/metrics.py"),
+    MetricSpec("pst_router_replica_journals", GAUGE, "router/state/metrics.py"),
+    MetricSpec("pst_router_replica_takeovers", COUNTER, "router/state/metrics.py"),
     # --- router/services/metrics_service.py: router process + SLO -------
     MetricSpec("pst_router:cpu_percent", GAUGE, "router/services/metrics_service.py"),
     MetricSpec("pst_router:memory_mb", GAUGE, "router/services/metrics_service.py"),

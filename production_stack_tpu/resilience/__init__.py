@@ -53,16 +53,26 @@ def initialize_resilience(args) -> None:
     """Create the resilience singletons from parsed router args."""
     global _breaker_registry, _admission_controller, _retry_policy
     global _hedge_policy, _stream_resume_policy, _default_deadline_ms
+    # Router HA: breakers and admission coordinate across replicas through
+    # the state backend (None / in-memory = exact single-replica behavior).
+    from ..router.state import PROVIDER_BREAKERS, get_state_backend
+
+    backend = get_state_backend()
     _breaker_registry = CircuitBreakerRegistry(
         failure_threshold=getattr(args, "breaker_failure_threshold", 5),
         recovery_time=getattr(args, "breaker_recovery_time", 10.0),
         half_open_probes=getattr(args, "breaker_half_open_probes", 1),
+        state_backend=backend,
     )
+    if backend is not None:
+        registry = _breaker_registry
+        backend.register_provider(PROVIDER_BREAKERS, registry.snapshot)
     _admission_controller = AdmissionController(
         rate=getattr(args, "admission_rate", 0.0),
         burst=getattr(args, "admission_burst", 0),
         max_queue=getattr(args, "admission_queue_size", 128),
         queue_timeout=getattr(args, "admission_queue_timeout", 5.0),
+        state_backend=backend,
     )
     _retry_policy = RetryPolicy(
         max_attempts=getattr(args, "proxy_retries", 2) + 1,

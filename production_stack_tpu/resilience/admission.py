@@ -104,16 +104,45 @@ class AdmissionController:
         burst: int = 0,
         max_queue: int = 128,
         queue_timeout: float = 5.0,
+        state_backend=None,
     ):
+        # ``rate``/``burst`` are FLEET-WIDE limits. With a shared state
+        # backend each replica admits only its membership share
+        # (rate/n live replicas — rate splitting), so N replicas enforce
+        # the same global limit one replica would, and a replica death
+        # shifts — never multiplies — the fleet's effective limit: the
+        # survivors' shares grow only when the dead peer ages out of the
+        # membership view. Without a backend the share is 1.0 and the
+        # controller behaves exactly as before.
         self.rate = rate
         self.enabled = rate > 0
         self.max_queue = max(0, max_queue)
         self.queue_timeout = queue_timeout
+        self.state_backend = state_backend
+        self._capacity = float(max(1, burst or math.ceil(rate))) if rate > 0 else 1.0
+        self._share = 1.0
         self.bucket = TokenBucket(rate, burst or math.ceil(rate)) if self.enabled else None
+        # pstlint: owned-by=task:admit,_dispatch_loop,close
         self._heap: List[_Waiter] = []
         self._seq = 0
         self._dispatcher: Optional[asyncio.Task] = None
         self._wakeup: Optional[asyncio.Event] = None
+
+    def _apply_share(self) -> None:
+        """Pull the current membership share and rescale the local bucket
+        (rate AND burst capacity — a replica death must not leave the
+        fleet with 2× the configured burst)."""
+        backend = self.state_backend
+        if backend is None or not getattr(backend, "shared", False):
+            return
+        share = backend.admission_share()
+        if share == self._share or self.bucket is None:
+            return
+        self._share = share
+        self.bucket.rate = max(self.rate * share, 1e-9)
+        new_capacity = max(self._capacity * share, 1.0)
+        self.bucket.tokens = min(self.bucket.tokens, new_capacity)
+        self.bucket.capacity = new_capacity
 
     # -- internals --------------------------------------------------------
 
@@ -174,6 +203,7 @@ class AdmissionController:
         if not self.enabled:
             metrics.admitted_total.inc()
             return _ADMIT
+        self._apply_share()
         now = time.monotonic()
         if deadline is not None and deadline.expired():
             return self._shed("expired", 0.0)

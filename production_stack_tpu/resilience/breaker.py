@@ -64,6 +64,7 @@ class CircuitBreaker:
         # HALF_OPEN probe reservations (timestamps). Entries expire after
         # recovery_time so an allows() answer that never became a request
         # (routing filtered this engine out) cannot wedge the breaker.
+        # pstlint: owned-by=task:allows,_free_probe_slot,_transition
         self._probes: List[float] = []
 
     def _transition(self, state: BreakerState, now: float) -> None:
@@ -168,10 +169,16 @@ class CircuitBreakerRegistry:
         failure_threshold: int = 5,
         recovery_time: float = 10.0,
         half_open_probes: int = 1,
+        state_backend=None,
     ):
         self.failure_threshold = failure_threshold
         self.recovery_time = recovery_time
         self.half_open_probes = half_open_probes
+        # Replication (router HA): peers' breaker snapshots arrive via the
+        # state backend; a breaker OPEN on any live replica blocks routing
+        # here too, so a failing engine is fenced fleet-wide after one
+        # replica's failure budget instead of once per replica.
+        self.state_backend = state_backend
         # Single-writer surface: creation in get(), removal in evict()
         # — everything else only reads (or mutates breaker OBJECTS, whose
         # state machine is its own single surface via record_*).
@@ -193,8 +200,19 @@ class CircuitBreakerRegistry:
             )
         return b
 
+    def _remote_open(self, url: str) -> bool:
+        """Whether any LIVE peer replica reports this engine's breaker
+        OPEN (the state backend only surfaces live peers, so a dead
+        replica's stale verdict cannot fence an engine forever)."""
+        backend = self.state_backend
+        if backend is None or not getattr(backend, "shared", False):
+            return False
+        return backend.remote_breaker_state(url) == "open"
+
     def allows(self, url: str, now: Optional[float] = None) -> bool:
-        return self.get(url).allows(now)
+        # Remote check first: a fleet-fenced engine must not consume a
+        # half-open probe reservation it can never use.
+        return not self._remote_open(url) and self.get(url).allows(now)
 
     def state(self, url: str) -> BreakerState:
         return self.get(url).current_state()
@@ -206,7 +224,7 @@ class CircuitBreakerRegistry:
         self.get(url).record_failure(now)
 
     def would_allow(self, url: str, now: Optional[float] = None) -> bool:
-        return self.get(url).would_allow(now)
+        return not self._remote_open(url) and self.get(url).would_allow(now)
 
     def filter_available(
         self, urls: List[str], now: Optional[float] = None

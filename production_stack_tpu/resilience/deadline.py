@@ -105,6 +105,7 @@ class LatencyTracker:
 
     def __init__(self, window: int = 256):
         self.window = max(8, window)
+        # pstlint: owned-by=task:observe
         self._samples: List[float] = []
         self._idx = 0
 

@@ -234,24 +234,28 @@ class StreamJournal:
         # annotated fields (enforced by the lock-discipline pstlint
         # check) — proxy code reads them and drives feed()/
         # start_continuation(); `legs` alone is proxy-written (see note).
-        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail,from_snapshot
         self._text_parts: List[str] = []
-        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail,from_snapshot
         self.delivered_tokens = 0  # content-bearing delta chunks ≈ tokens
-        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail,from_snapshot
         self.finish_reason: Optional[str] = None
-        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail,from_snapshot
         self.usage: Optional[dict] = None
         # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.saw_done = False
         # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self.saw_error = False
-        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
+        # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail,from_snapshot
         self.saw_role_delta = False
         # NOT annotated: legs is deliberately incremented by the proxy's
         # resume loop (request_service) when it launches a continuation —
         # a cross-module writer the same-file check cannot see.
         self.legs = 0  # continuation legs attempted
+        # Delivered-token count at the last replicated checkpoint (None =
+        # never checkpointed); maintained by the proxy's checkpoint helper
+        # (request_service), same cross-module-writer note as ``legs``.
+        self.checkpointed_tokens: Optional[int] = None
         # Per-continuation-leg splice state.
         # pstlint: owned-by=task:_observe,_continuation_event,_flush_pending,_emit,start_continuation,synthesize_tail,truncation_tail
         self._overlap = ""
@@ -263,6 +267,54 @@ class StreamJournal:
     @property
     def text(self) -> str:
         return "".join(self._text_parts)
+
+    # -- replica takeover (docs/router-ha.md) --------------------------------
+
+    def to_snapshot(self) -> dict:
+        """The JSON-safe checkpoint a router replica gossips to peers so a
+        survivor can resume this stream after the owner dies: original-leg
+        identity, delivered text/token count, and the continuation budget.
+        Per-leg splice state is deliberately excluded — a takeover always
+        begins a fresh continuation leg via ``start_continuation``."""
+        return {
+            "is_chat": self.is_chat,
+            "request_json": self.request_json,
+            "id": self.id,
+            "created": self.created,
+            "model": self.model,
+            "object": self.object,
+            "text": self.text,
+            "delivered_tokens": self.delivered_tokens,
+            "finish_reason": self.finish_reason,
+            "usage": self.usage,
+            "legs": self.legs,
+            "saw_role_delta": self.saw_role_delta,
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "StreamJournal":
+        """Rebuild a journal from a peer's checkpoint on the surviving
+        replica. The result is resume-ready (eligible, text recorded): the
+        survivor issues continuation legs exactly as the owner would have."""
+        journal = cls(
+            bool(snap.get("is_chat")),
+            request_json=snap.get("request_json") or {},
+            eligible=True,
+            record_text=True,
+        )
+        journal.id = snap.get("id")
+        journal.created = snap.get("created")
+        journal.model = snap.get("model")
+        journal.object = snap.get("object")
+        text = snap.get("text") or ""
+        if text:
+            journal._text_parts = [text]
+        journal.delivered_tokens = int(snap.get("delivered_tokens") or 0)
+        journal.finish_reason = snap.get("finish_reason")
+        journal.usage = snap.get("usage")
+        journal.legs = int(snap.get("legs") or 0)
+        journal.saw_role_delta = bool(snap.get("saw_role_delta"))
+        return journal
 
     # -- eligibility / budget ----------------------------------------------
 

@@ -52,10 +52,19 @@ from .service_discovery import (
     initialize_service_discovery,
     teardown_service_discovery,
 )
+from .state import (
+    PROVIDER_ENDPOINTS,
+    PROVIDER_REQUEST_STATS,
+    get_state_backend,
+    initialize_state_backend,
+    teardown_state_backend,
+)
 from .stats.engine_stats import get_engine_stats_scraper, initialize_engine_stats_scraper
 from .stats.request_stats import (
+    bind_request_stats_monitor,
     get_request_stats_monitor,
     initialize_request_stats_monitor,
+    unbind_request_stats_monitor,
 )
 from .services import metrics_service
 from .services.callbacks import configure_custom_callbacks
@@ -155,6 +164,50 @@ async def tracing_middleware(request: web.Request, handler):
 
 
 @web.middleware
+async def state_middleware(request: web.Request, handler):
+    """Bind this app's injected singletons into request context and gate
+    router-level drain.
+
+    The request-stats monitor is an app-factory dependency (no longer a
+    process singleton): binding it per request lets two router apps share
+    one process — multi-replica tests — without stats bleed, while every
+    downstream call site keeps using ``get_request_stats_monitor()``.
+
+    Router drain (``POST /router/drain``, rolling restarts): new
+    admission-path work is refused with 503 + ``X-PST-Router-Draining``
+    while in-flight requests run to completion; ``/ready`` flips 503 so
+    the load balancer stops sending traffic here.
+    """
+    monitor = request.app.get("request_stats_monitor")
+    token = (
+        bind_request_stats_monitor(monitor) if monitor is not None else None
+    )
+    try:
+        if (
+            request.app.get("router_draining")
+            and request.method == "POST"
+            and request.path in _ADMISSION_PATHS
+        ):
+            return web.json_response(
+                {
+                    "error": {
+                        "message": "router replica is draining",
+                        "type": "service_unavailable",
+                        "code": 503,
+                    }
+                },
+                status=503,
+                headers=error_headers(
+                    request, extra={"X-PST-Router-Draining": "1"}
+                ),
+            )
+        return await handler(request)
+    finally:
+        if token is not None:
+            unbind_request_stats_monitor(token)
+
+
+@web.middleware
 async def admission_middleware(request: web.Request, handler):
     """Token-bucket + bounded-priority-queue admission ahead of routing.
 
@@ -251,7 +304,8 @@ async def admission_middleware(request: web.Request, handler):
 # guarded too — per-request timelines (ids, backend URLs, error strings)
 # are not aggregate telemetry.
 _GUARDED_ADMIN_PATHS = {"/drain", "/undrain", "/sleep", "/wake_up",
-                        "/debug/requests"}
+                        "/debug/requests", "/router/drain",
+                        "/router/undrain", "/_state/gossip"}
 
 
 @web.middleware
@@ -272,6 +326,11 @@ async def api_key_middleware(request: web.Request, handler):
 
 def initialize_all(app: web.Application, args) -> None:
     """Create all router singletons from parsed args (pre-event-loop)."""
+    # The state backend comes up FIRST: resilience (fleet-wide admission,
+    # breaker replication) and routing (shared endpoint view) consult it
+    # at initialization time. In-memory default = single-replica behavior.
+    backend = initialize_state_backend(args)
+    app["state_backend"] = backend
     if args.service_discovery == "static":
         initialize_service_discovery(
             ServiceDiscoveryType.STATIC,
@@ -299,7 +358,16 @@ def initialize_all(app: web.Application, args) -> None:
         )
 
     initialize_engine_stats_scraper(args.engine_stats_interval)
-    initialize_request_stats_monitor(args.request_stats_window)
+    # The monitor is an app-injected dependency (state_middleware binds it
+    # per request); initialize_* also sets the module default so
+    # background loops and single-app processes resolve the same instance.
+    monitor = initialize_request_stats_monitor(args.request_stats_window)
+    app["request_stats_monitor"] = monitor
+    backend.register_provider(PROVIDER_REQUEST_STATS, monitor.sync_snapshot)
+    backend.register_provider(
+        PROVIDER_ENDPOINTS,
+        lambda: get_service_discovery().get_endpoint_urls(),
+    )
     initialize_routing_logic(
         RoutingLogic(args.routing_logic),
         session_key=args.session_key,
@@ -360,7 +428,12 @@ def create_app(args) -> web.Application:
     init_otel("pst-router")
 
     app = web.Application(
-        middlewares=[tracing_middleware, api_key_middleware, admission_middleware],
+        middlewares=[
+            tracing_middleware,
+            state_middleware,
+            api_key_middleware,
+            admission_middleware,
+        ],
         client_max_size=64 * 2**20,
     )
     initialize_all(app, args)
@@ -373,6 +446,12 @@ def create_app(args) -> web.Application:
         )
         await get_service_discovery().start()
         await get_engine_stats_scraper().start()
+        # App-scoped, not the module global: with several router apps in
+        # one process each must start (and later close) ITS OWN backend,
+        # not whichever app initialized last.
+        backend = app.get("state_backend")
+        if backend is not None:
+            await backend.start(app)
         prober = get_canary_prober()
         if prober is not None:
             await prober.start()
@@ -417,6 +496,13 @@ def create_app(args) -> web.Application:
             pass
         teardown_routing_logic()
         teardown_resilience()
+        backend = app.get("state_backend")
+        if backend is not None:
+            await backend.close()
+        if get_state_backend() is backend:
+            # Only the app that owns the global clears it — a second app's
+            # cleanup must not null a still-serving replica's backend.
+            teardown_state_backend()
         teardown_request_tracing()
         for key in ("client_session", "prefill_client", "decode_client"):
             session = app.get(key)

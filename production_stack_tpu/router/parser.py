@@ -156,6 +156,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-probe timeout; a timed-out canary counts as "
                         "a failure")
 
+    # Router HA / replicated state (docs/router-ha.md): N router replicas
+    # behave as one when they share routing state over the gossip backend.
+    p.add_argument("--state-backend", choices=["memory", "gossip"],
+                   default="memory",
+                   help="routing-state backend: 'memory' (single replica, "
+                        "the default) or 'gossip' (replicate breakers, "
+                        "admission shares, stats, endpoint view, prefix "
+                        "inserts and stream journals over HTTP between "
+                        "router replicas)")
+    p.add_argument("--state-peers", default=None,
+                   help="comma-separated peer router base URLs "
+                        "(http://host:port) or a re-resolved DNS spec "
+                        "(dns://headless-service:port) for the gossip "
+                        "backend")
+    p.add_argument("--state-sync-interval", type=float, default=0.5,
+                   help="seconds between gossip exchanges with each peer")
+    p.add_argument("--state-peer-timeout", type=float, default=3.0,
+                   help="seconds without a successful exchange before a "
+                        "peer replica is considered dead (its admission "
+                        "share is reclaimed and its journaled streams "
+                        "become claimable)")
+    p.add_argument("--state-replica-id", default=None,
+                   help="stable replica identity for gossip (default: "
+                        "random per process)")
+
     # Stats / metrics
     p.add_argument("--engine-stats-interval", type=float, default=15.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -251,6 +276,12 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--hedge-quantile must be in (0, 1)")
     if args.stream_resume_max_legs < 1:
         raise ValueError("--stream-resume-max-legs must be >= 1")
+    if args.state_sync_interval <= 0:
+        raise ValueError("--state-sync-interval must be > 0")
+    if args.state_peer_timeout <= 0:
+        raise ValueError("--state-peer-timeout must be > 0")
+    if args.state_peers and args.state_backend != "gossip":
+        raise ValueError("--state-peers requires --state-backend gossip")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "disaggregated_prefill":

@@ -26,6 +26,7 @@ from ..resilience import get_admission_controller, get_breaker_registry
 from ..resilience import metrics as res_gauges
 from ..resilience.breaker import STATE_VALUE
 from .service_discovery import get_service_discovery
+from .state import GOSSIP_PATH, get_state_backend
 from .services import metrics_service as gauges
 from .services.request_service import (
     route_drain_request,
@@ -151,6 +152,82 @@ async def health(request: web.Request) -> web.Response:
     return web.json_response({"status": "healthy"})
 
 
+@routes.get("/ready")
+async def ready(request: web.Request) -> web.Response:
+    """Readiness, distinct from liveness (the engine's warming≠unhealthy
+    split, applied to the router): 503 while this replica's state view is
+    not yet synced with its peers or while the replica is draining, so
+    the load balancer withholds traffic without the pod being restarted.
+    ``/health`` stays the liveness signal."""
+    backend = get_state_backend()
+    if request.app.get("router_draining"):
+        return web.json_response(
+            {"status": "draining", "reason": "draining"},
+            status=503,
+            headers=error_headers(
+                request, extra={"X-PST-Router-Draining": "1"}
+            ),
+        )
+    if backend is not None and not backend.synced():
+        return web.json_response(
+            {"status": "syncing", "reason": "state_sync",
+             "state": backend.describe()},
+            status=503,
+            headers=error_headers(request),
+        )
+    payload = {"status": "ready"}
+    if backend is not None:
+        payload["state"] = backend.describe()
+    return web.json_response(payload)
+
+
+@routes.post("/router/drain")
+async def router_drain(request: web.Request) -> web.Response:
+    """Drain THIS router replica (rolling restarts): /ready flips 503 so
+    the LB pulls it, new admission-path work is refused with
+    ``X-PST-Router-Draining``, in-flight requests finish, and journal
+    checkpoints are pushed to the surviving replicas immediately. The
+    engine-fleet drain fan-out stays on ``POST /drain``."""
+    request.app["router_draining"] = True
+    backend = get_state_backend()
+    if backend is not None:
+        await backend.sync_now()
+    return web.json_response({"status": "draining"})
+
+
+@routes.post("/router/undrain")
+async def router_undrain(request: web.Request) -> web.Response:
+    request.app["router_draining"] = False
+    return web.json_response({"status": "ok"})
+
+
+@routes.post(GOSSIP_PATH)
+async def state_gossip(request: web.Request) -> web.Response:
+    """Replica-to-replica state-sync exchange (docs/router-ha.md): merge
+    the caller's digest, answer with ours. 404 with the in-memory backend
+    — a single replica has no peers and must not pretend otherwise."""
+    # Resolve the app-scoped backend first so two in-process router apps
+    # (multi-replica tests) exchange against their own state.
+    backend = request.app.get("state_backend") or get_state_backend()
+    if backend is None or not backend.shared:
+        return web.json_response(
+            {"error": {"message": "state replication is not enabled",
+                       "type": "not_found_error", "code": 404}},
+            status=404,
+            headers=error_headers(request),
+        )
+    try:
+        digest = await request.json()
+    except ValueError:
+        return web.json_response(
+            {"error": {"message": "invalid digest", "code": 400,
+                       "type": "invalid_request_error"}},
+            status=400,
+            headers=error_headers(request),
+        )
+    return web.json_response(backend.exchange(digest))
+
+
 @routes.get("/engines")
 async def engines(request: web.Request) -> web.Response:
     """Current engine pool with live engine- and request-level stats."""
@@ -189,7 +266,11 @@ async def metrics(request: web.Request) -> web.Response:
     """
     endpoints = get_service_discovery().get_endpoint_info()
     engine_stats = get_engine_stats_scraper().get_engine_stats()
-    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    # LOCAL view only: each replica exports its own traffic; summing the
+    # fleet-merged view across replicas would double-count in Prometheus.
+    request_stats = get_request_stats_monitor().get_request_stats(
+        time.time(), fleet=False
+    )
     for ep in endpoints:
         url = ep.url
         es = engine_stats.get(url)
@@ -230,6 +311,15 @@ async def metrics(request: web.Request) -> web.Response:
     controller = get_admission_controller()
     if controller is not None and controller.enabled:
         res_gauges.queue_depth.set(controller.queue_len())
+    # Replication gauges: the gossip loop refreshes them every round; the
+    # in-memory backend has no loop, so scrape time keeps them truthful
+    # (1 replica, full admission share).
+    backend = get_state_backend()
+    if backend is not None:
+        from .state import metrics as state_gauges
+
+        state_gauges.replica_peers.set(backend.live_replica_count())
+        state_gauges.admission_share.set(backend.admission_share())
     res_gauges.draining_engines.set(
         sum(1 for ep in endpoints if ep.draining)
     )

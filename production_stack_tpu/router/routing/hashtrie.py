@@ -41,10 +41,26 @@ class HashTrie:
         for i in range(0, len(text), self.chunk_size):
             yield xxhash.xxh64_intdigest(text[i : i + self.chunk_size])
 
+    def hash_path(self, text: str, max_chunks: int = 64) -> list:
+        """The chunk-hash path for ``text`` (bounded) — the replication
+        unit router replicas gossip instead of raw prompt text: peers can
+        merge routing knowledge without ever exchanging prompt content."""
+        out = []
+        for h in self._chunks(text):
+            out.append(h)
+            if len(out) >= max_chunks:
+                break
+        return out
+
     async def insert(self, text: str, endpoint: str) -> None:
         """Record that ``endpoint`` has served (and likely cached) ``text``."""
+        await self.insert_hashes(list(self._chunks(text)), endpoint)
+
+    async def insert_hashes(self, hashes, endpoint: str) -> None:
+        """Insert by precomputed chunk-hash path (local inserts and
+        replicated inserts from peer routers share this walk)."""
         node = self.root
-        for h in self._chunks(text):
+        for h in hashes:
             async with node.lock:
                 node.endpoints.add(endpoint)
                 child = node.children.get(h)

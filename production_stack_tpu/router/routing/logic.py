@@ -86,8 +86,11 @@ class ConsistentHashRing:
 
     def __init__(self, vnodes: int = 160):
         self.vnodes = vnodes
+        # pstlint: owned-by=task:update,_rebuild
         self._nodes: set = set()
+        # pstlint: owned-by=task:update,_rebuild
         self._ring: List[Tuple[int, str]] = []
+        # pstlint: owned-by=task:update,_rebuild
         self._hashes: List[int] = []
 
     def _rebuild(self) -> None:
@@ -111,6 +114,56 @@ class ConsistentHashRing:
         h = xxhash.xxh64_intdigest(key)
         idx = bisect.bisect(self._hashes, h) % len(self._ring)
         return self._ring[idx][1]
+
+    def get_node_bounded(
+        self,
+        key: str,
+        loads: Dict[str, float],
+        c: float = 2.0,
+        allowed: Optional[set] = None,
+    ) -> Optional[str]:
+        """Consistent hashing with bounded loads (Mirrokni et al.): walk
+        the ring clockwise from ``key``'s position and take the first
+        node whose current load is under ``c ×`` the mean load, falling
+        back to the first eligible node when everything is saturated.
+        Replicated routers use this over the *shared* endpoint view +
+        fleet-wide stats, so every replica computes the same (key → node)
+        map AND a hot-spotted node sheds to the same successor on every
+        replica.
+
+        ``allowed`` constrains the pick to THIS replica's routable
+        candidates (model match, not draining/sleeping, breaker-admitted)
+        while the ring still hashes over the shared fleet view: replicas
+        whose candidate sets agree pick identically, and a replica whose
+        discovery lags simply walks to the nearest node it can actually
+        route to — it never picks an engine it must not use."""
+        if not self._ring:
+            return None
+        candidates = (
+            self._nodes if allowed is None else self._nodes & set(allowed)
+        )
+        if not candidates:
+            return None
+        mean = sum(loads.get(n, 0.0) for n in candidates) / len(candidates)
+        bound = c * max(mean, 1.0)
+        h = xxhash.xxh64_intdigest(key)
+        start = bisect.bisect(self._hashes, h) % len(self._ring)
+        first_eligible: Optional[str] = None
+        seen: set = set()
+        for i in range(len(self._ring)):
+            node = self._ring[(start + i) % len(self._ring)][1]
+            if node in seen:
+                continue
+            seen.add(node)
+            if node not in candidates:
+                continue
+            if first_eligible is None:
+                first_eligible = node
+            if loads.get(node, 0.0) < bound:
+                return node
+            if len(seen) == len(self._nodes):
+                break
+        return first_eligible
 
 
 def apply_breaker_filter(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
@@ -220,6 +273,7 @@ class RoundRobinRouter(RoutingInterface):
         if getattr(self, "_initialized", False):
             return
         self.req_id = 0
+        # pstlint: owned-by=task:route_request
         self._sorted: List[EndpointInfo] = []
         self._last_hash: Optional[int] = None
         self._initialized = True
@@ -256,7 +310,34 @@ class SessionRouter(RoutingInterface):
 
     async def route_request(self, endpoints, engine_stats, request_stats, headers, request_json=None) -> str:
         session_id = _header(headers, self.session_key)
-        self.ring.update([e.url for e in endpoints])
+        local_urls = [e.url for e in endpoints]
+        from ..state import get_state_backend
+
+        backend = get_state_backend()
+        if backend is not None and backend.shared:
+            # Replicated routers hash over the UNION of every live
+            # replica's endpoint view: replicas whose discovery views
+            # momentarily diverge still map a session to the same engine
+            # — and bounded loads shed a hot-spotted engine to the same
+            # ring successor on every replica (fleet-wide stats). The
+            # PICK stays constrained to this request's filtered candidate
+            # list (``allowed``): the shared view only stabilizes ring
+            # positions, it must never route around the model/drain/
+            # breaker filters routing already applied.
+            self.ring.update(backend.merged_endpoint_urls(local_urls))
+            if session_id is not None:
+                loads = {
+                    url: max(getattr(rs, "qps", 0.0), 0.0)
+                    for url, rs in request_stats.items()
+                }
+                url = self.ring.get_node_bounded(
+                    session_id, loads, allowed=set(local_urls)
+                )
+                if url is None:
+                    raise ValueError("no endpoints available")
+                return url
+            return _lowest_qps_url(endpoints, request_stats)
+        self.ring.update(local_urls)
         if session_id is None:
             return _lowest_qps_url(endpoints, request_stats)
         url = self.ring.get_node(session_id)
@@ -384,6 +465,15 @@ class PrefixAwareRouter(RoutingInterface):
         request_json = request_json or {}
         prompt = extract_prompt_text(request_json)
         available = {e.url for e in endpoints}
+        from ..state import get_state_backend
+
+        backend = get_state_backend()
+        if backend is not None and backend.shared:
+            # Apply peers' replicated insertions (chunk-hash paths, never
+            # raw prompt text) before matching, so a session that bounced
+            # replicas still finds the engine holding its warm prefix.
+            for path, ep in backend.drain_prefix_inserts():
+                await self.hashtrie.insert_hashes(path, ep)
         _, matched = await self.hashtrie.longest_prefix_match(prompt, available)
         candidates = matched or available
         # Tie-break on live engine queue depth (falls back to random).
@@ -399,6 +489,10 @@ class PrefixAwareRouter(RoutingInterface):
         best = [u for u in candidates if load(u) == min_load]
         selected = random.choice(best)
         await self.hashtrie.insert(prompt, selected)
+        if backend is not None and backend.shared:
+            backend.publish_prefix_insert(
+                self.hashtrie.hash_path(prompt), selected
+            )
         return selected
 
 

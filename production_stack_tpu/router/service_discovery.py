@@ -169,6 +169,19 @@ class ServiceDiscovery(ABC):
     def get_model_labels(self) -> List[str]:
         return sorted({e.model_label for e in self.get_endpoint_info() if e.model_label})
 
+    def get_endpoint_urls(self) -> List[str]:
+        """This replica's ROUTABLE endpoint URL view — what the state
+        backend gossips to peer routers so the fleet hashes over one
+        shared endpoint set even while discovery views momentarily
+        diverge. Draining/warming/sleeping engines are excluded: a peer
+        must never learn an endpoint it would have filtered locally."""
+        return sorted(
+            e.url for e in self.get_endpoint_info()
+            if not getattr(e, "draining", False)
+            and not getattr(e, "warming", False)
+            and not getattr(e, "sleep", False)
+        )
+
     async def initialize_client_sessions(
         self,
         prefill_model_labels: Optional[List[str]],
@@ -219,14 +232,18 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.aliases = aliases or {}
         self.model_labels = model_labels
         self.model_types = model_types
+        # pstlint: owned-by=task:__init__
         self.engine_ids = [str(uuid.uuid4()) for _ in urls]
         self.added_timestamp = time.time()
         self.enable_health_checks = static_backend_health_checks
         self.health_check_interval = health_check_interval
         self.prefill_model_labels = prefill_model_labels
         self.decode_model_labels = decode_model_labels
+        # pstlint: owned-by=task:_health_loop
         self._unhealthy: set = set()
+        # pstlint: owned-by=task:_health_loop,check_backend,_drain_reconcile_loop,set_draining
         self._draining: set = set()  # urls reporting is_draining
+        # pstlint: owned-by=task:_health_loop,check_backend,_drain_reconcile_loop,set_warming
         self._warming: set = set()  # urls whose /ready reports warming
         self._task: Optional[asyncio.Task] = None
 
@@ -444,6 +461,7 @@ class _K8sWatcherBase(ServiceDiscovery):
         self.prefill_model_labels = prefill_model_labels
         self.decode_model_labels = decode_model_labels
         self.k8s = K8sClient()
+        # pstlint: owned-by=task:_on_pod_event,_on_service_event
         self.available_engines: Dict[str, EndpointInfo] = {}
         self._lock = asyncio.Lock()
         self._task: Optional[asyncio.Task] = None
@@ -698,9 +716,19 @@ def _create(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
 
 
 def initialize_service_discovery(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
+    """Create (or replace) the process-wide discovery instance.
+
+    Replacement instead of a hard error: the app factory owns the
+    lifecycle, and multi-replica tests build several router apps in one
+    process (each against the same backend set) — the last-created app's
+    view wins, which is correct for same-fleet replicas. A previous
+    instance is closed so its watch/health tasks do not leak."""
     global _global_service_discovery
     if _global_service_discovery is not None:
-        raise ValueError("service discovery already initialized")
+        logger.warning(
+            "service discovery re-initialized; replacing the previous instance"
+        )
+        _global_service_discovery.close()
     _global_service_discovery = _create(sd_type, *args, **kwargs)
     return _global_service_discovery
 

@@ -84,6 +84,8 @@ from ..routing.logic import (
     route_with_resilience,
 )
 from ..service_discovery import get_service_discovery
+from ..state import get_state_backend
+from ..state import metrics as state_metrics
 from ..stats.engine_stats import get_engine_stats_scraper
 from ..stats.request_stats import get_request_stats_monitor
 from .callbacks import get_custom_callback_handler
@@ -171,6 +173,55 @@ def _note_failure(url: str, request_id: str = "", span=None) -> None:
                 # Breaker movement is part of the request's story: record
                 # it on the span that observed the failure.
                 span.add_event("breaker_state", server=url, state=state.value)
+
+
+# Content chunks between journal checkpoints on replicated routers: small
+# enough that a takeover rarely loses more than a few tokens of splice
+# budget, large enough that checkpointing stays off the per-chunk path.
+_CHECKPOINT_EVERY = 8
+
+
+def _shared_state_backend():
+    """The state backend, only when it actually replicates (None for the
+    in-memory default — journal checkpointing is pure overhead there:
+    a single replica's death loses the process anyway)."""
+    backend = get_state_backend()
+    if backend is None or not backend.shared:
+        return None
+    return backend
+
+
+def _maybe_checkpoint_journal(
+    journal: Optional[StreamJournal], request_id: str
+) -> None:
+    """Checkpoint a resumable journal to the replicated backend every
+    ``_CHECKPOINT_EVERY`` delivered content chunks, so a surviving replica
+    can splice a continuation if this replica dies mid-stream."""
+    if journal is None or not (journal.eligible and journal.record_text):
+        return
+    backend = _shared_state_backend()
+    if backend is None:
+        return
+    if (
+        journal.checkpointed_tokens is None
+        or journal.delivered_tokens - journal.checkpointed_tokens
+        >= _CHECKPOINT_EVERY
+    ):
+        journal.checkpointed_tokens = journal.delivered_tokens
+        backend.checkpoint_journal(request_id, journal.to_snapshot())
+
+
+def _drop_checkpoint(
+    journal: Optional[StreamJournal], request_id: str
+) -> None:
+    """The stream reached a terminal state on THIS replica: retire its
+    checkpoint fleet-wide so no survivor ever resumes a finished stream."""
+    if journal is None or journal.checkpointed_tokens is None:
+        return
+    backend = _shared_state_backend()
+    if backend is not None:
+        backend.drop_journal(request_id)
+        journal.checkpointed_tokens = None
 
 
 def make_failover(candidates, headers: dict, request_json: Optional[dict]) -> FailoverFn:
@@ -457,6 +508,7 @@ async def proxy_and_stream(
                                     observe_slo_failure(slo_model)
                         if journal is not None:
                             chunk = journal.feed(chunk)
+                            _maybe_checkpoint_journal(journal, request_id)
                             if not chunk:
                                 continue
                         if collect:
@@ -493,6 +545,12 @@ async def proxy_and_stream(
                     res_metrics.client_disconnects_total.inc()
                     _complete()
                     upstream.close()
+                    # The consumer is gone for good: no survivor should
+                    # ever resume this stream. (Deliberately NOT dropped
+                    # on CancelledError below — a rolling-restart SIGTERM
+                    # cancels handlers, and that checkpoint is exactly
+                    # what the surviving replica resumes from.)
+                    _drop_checkpoint(journal, request_id)
                     attempt_span.set_attribute("outcome", "client_disconnect")
                     attempt_span.end()
                     logger.info(
@@ -538,6 +596,9 @@ async def proxy_and_stream(
                         request, response, journal, endpoint, request_id,
                         failover, tried, deadline, trace, collect, collected,
                     )
+                    # Whatever the outcome, the stream reached a terminal
+                    # state HERE — no survivor may resume it.
+                    _drop_checkpoint(journal, request_id)
                     if outcome == "completed":
                         break  # run the post-response hooks below
                     return response
@@ -595,6 +656,7 @@ async def proxy_and_stream(
             continue
         break  # attempt finished cleanly: run the post-response hooks
 
+    _drop_checkpoint(journal, request_id)
     if collect:
         content = bytes(collected)
         if cacheable:
@@ -849,6 +911,81 @@ async def _resume_stream(
             span.set_attribute("outcome", "midstream_death")
             span.end()
             continue
+
+
+async def _takeover_stream(
+    request: web.Request,
+    endpoint: str,
+    claimed: dict,
+    request_id: str,
+    candidates: list,
+    deadline: Optional[Deadline],
+    request_json: dict,
+) -> web.StreamResponse:
+    """Resume a dead replica's journaled stream on THIS replica.
+
+    The claimed checkpoint rebuilds the journal (original chunk identity +
+    delivered text/token budget) and the standard continuation machinery
+    streams the *suffix* to the reconnecting client: duplicate-free,
+    original ``id``/``created``, exactly one ``[DONE]``. A stale or
+    unusable checkpoint answers with the visible ``stream_truncated``
+    contract — the client learns its stream is unrecoverable instead of
+    silently receiving a fresh, unrelated generation under the old id.
+    """
+    trace = request.get("trace") or NOOP_TRACE
+    is_chat = endpoint.endswith("/chat/completions")
+    response = web.StreamResponse(status=200)
+    response.headers["Content-Type"] = "text/event-stream"
+    response.headers["Cache-Control"] = "no-cache"
+    response.headers["X-Request-Id"] = request_id
+    response.headers["X-PST-Stream-Takeover"] = "1"
+    await response.prepare(request)
+
+    snap = claimed.get("snap")
+    if claimed.get("stale") or not isinstance(snap, dict):
+        state_metrics.takeovers_total.labels(outcome="stale").inc()
+        res_metrics.stream_truncated_total.labels(reason="takeover_stale").inc()
+        trace.add_event("stream_takeover", outcome="stale")
+        logger.warning(
+            "stream %s: owner replica died but its checkpoint is stale; "
+            "terminating visibly", request_id,
+        )
+        journal = StreamJournal(is_chat, request_json=request_json)
+        with contextlib.suppress(Exception):
+            await response.write(journal.truncation_tail(
+                "owning router replica died and the stream checkpoint is "
+                "stale; response truncated"
+            ))
+            await response.write_eof()
+        return response
+
+    journal = StreamJournal.from_snapshot(snap)
+    trace.add_event(
+        "stream_takeover",
+        delivered_tokens=journal.delivered_tokens, legs=journal.legs,
+    )
+    logger.warning(
+        "taking over stream %s from dead replica (%d tokens delivered)",
+        request_id, journal.delivered_tokens,
+    )
+    headers = hop_headers(dict(request.headers), request_id=request_id)
+    failover = make_failover(candidates, headers, journal.request_json)
+    outcome = await _resume_stream(
+        request, response, journal, endpoint, request_id,
+        failover, set(), deadline, trace, False, bytearray(),
+    )
+    if outcome == "completed":
+        state_metrics.takeovers_total.labels(outcome="resumed").inc()
+        res_metrics.stream_resume_success_total.inc()
+    elif outcome != "client_gone":
+        state_metrics.takeovers_total.labels(outcome="failed").inc()
+        res_metrics.stream_resume_failures_total.inc()
+        res_metrics.stream_truncated_total.labels(reason="resume_failed").inc()
+        with contextlib.suppress(Exception):
+            await response.write(journal.truncation_tail())
+    with contextlib.suppress(Exception):
+        await response.write_eof()
+    return response
 
 
 # Endpoints that are always hedge-eligible (no streaming mode exists).
@@ -1299,6 +1436,29 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
             "not_found_error",
             request_id=request_id,
         )
+
+    # Router HA takeover (docs/router-ha.md): a client whose streaming
+    # request died with its owning replica retries it — same body, same
+    # X-Request-Id — through the load balancer and lands here. If a live
+    # journal checkpoint for that id is claimable (its owner is DEAD),
+    # this replica resumes the stream from the checkpoint: the reply
+    # carries only the un-delivered suffix, spliced under the original
+    # chunk identity by PR 4's continuation machinery. A stale checkpoint
+    # terminates visibly (``stream_truncated``) instead of guessing.
+    if (
+        not pinned_id
+        and not is_disagg
+        and endpoint in ("/v1/completions", "/v1/chat/completions")
+        and request_json.get("stream")
+    ):
+        ha_backend = _shared_state_backend()
+        if ha_backend is not None:
+            claimed = ha_backend.claim_remote_journal(request_id)
+            if claimed is not None:
+                return await _takeover_stream(
+                    request, endpoint, claimed, request_id, candidates,
+                    deadline, request_json,
+                )
 
     if pinned_id:
         # An explicit pin is a debug escape hatch: bypass the routing policy

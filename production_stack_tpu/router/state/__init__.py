@@ -1,0 +1,73 @@
+"""Router state package: the replicated-state layer behind router HA.
+
+- :mod:`base` — the :class:`StateBackend` interface; its defaults ARE the
+  single-replica semantics.
+- :mod:`memory` — the default in-memory backend (zero behavior change).
+- :mod:`gossip` — gossip-over-HTTP replication so N router replicas
+  behave as one (docs/router-ha.md).
+- :mod:`metrics` — the ``pst_router_replica_*`` Prometheus surface.
+
+Lifecycle mirrors the other router singletons (initialize/get/teardown).
+``get_state_backend()`` returns ``None`` before initialization so every
+consumer degrades to pre-HA behavior — the same contract as the
+resilience accessors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...utils import parse_comma_separated
+from .base import (
+    PROVIDER_BREAKERS,
+    PROVIDER_ENDPOINTS,
+    PROVIDER_REQUEST_STATS,
+    StateBackend,
+)
+from .gossip import GOSSIP_PATH, GossipStateBackend
+from .memory import InMemoryStateBackend
+
+_state_backend: Optional[StateBackend] = None
+
+
+def initialize_state_backend(args) -> StateBackend:
+    """Create the backend from parsed router args (pre-event-loop; the
+    gossip loop starts with ``await backend.start()`` in on_startup)."""
+    global _state_backend
+    kind = getattr(args, "state_backend", "memory") or "memory"
+    if kind == "gossip":
+        _state_backend = GossipStateBackend(
+            peers=parse_comma_separated(getattr(args, "state_peers", None)),
+            replica_id=getattr(args, "state_replica_id", None) or None,
+            sync_interval=getattr(args, "state_sync_interval", 0.5),
+            peer_timeout=getattr(args, "state_peer_timeout", 3.0),
+            api_key=getattr(args, "api_key", None),
+        )
+    else:
+        _state_backend = InMemoryStateBackend(
+            replica_id=getattr(args, "state_replica_id", None) or None
+        )
+    return _state_backend
+
+
+def get_state_backend() -> Optional[StateBackend]:
+    return _state_backend
+
+
+def teardown_state_backend() -> None:
+    global _state_backend
+    _state_backend = None
+
+
+__all__ = [
+    "GOSSIP_PATH",
+    "GossipStateBackend",
+    "InMemoryStateBackend",
+    "PROVIDER_BREAKERS",
+    "PROVIDER_ENDPOINTS",
+    "PROVIDER_REQUEST_STATS",
+    "StateBackend",
+    "get_state_backend",
+    "initialize_state_backend",
+    "teardown_state_backend",
+]

@@ -1,0 +1,165 @@
+"""StateBackend: the router's replicated-state interface.
+
+Every piece of router-side mutable state that must be *coherent across
+replicas* — fleet-wide admission counts, circuit-breaker verdicts, the
+request-stats windows routing reads, the shared endpoint view the
+consistent-hash ring is built over, prefix-trie insertions, and
+stream-resume journal checkpoints — flows through this interface. The
+base class IS the single-replica (in-memory) implementation: every
+coordination primitive degenerates to "just me", which preserves the
+pre-HA router behavior byte for byte. :class:`~.gossip.GossipStateBackend`
+overrides the coordination points so N replicas behave as one router
+(docs/router-ha.md has the consistency model and the failure matrix).
+
+Design note — why domain-level methods instead of a raw key/value store:
+the replicated structures have *different* merge semantics (admission
+wants rate splitting, breakers want freshest-state-wins per engine,
+stats want additive merge, journals want owner-death claim-once). A KV
+facade would push those semantics into every consumer; this interface
+keeps each consumer's call site one line and the merge policy in one
+place per structure.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# Provider keys the sync layer pulls local snapshots from each round.
+PROVIDER_REQUEST_STATS = "request_stats"
+PROVIDER_ENDPOINTS = "endpoints"
+PROVIDER_BREAKERS = "breakers"
+
+
+class StateBackend:
+    """Single-replica (in-memory) backend; also the interface contract.
+
+    ``shared`` is the capability flag consumers branch on: ``False``
+    means every method is a local no-op/identity and the router runs
+    exactly as it did before this interface existed.
+    """
+
+    name = "memory"
+    shared = False
+
+    def __init__(self, replica_id: Optional[str] = None) -> None:
+        self._replica_id = replica_id or uuid.uuid4().hex[:12]
+        # Snapshot providers the sync layer reads; registration happens at
+        # app bootstrap (initialize_all) and is read-only afterwards.
+        # pstlint: owned-by=task:register_provider
+        self._providers: Dict[str, Callable[[], Any]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, app: Any = None) -> None:
+        """Begin syncing (gossip loop); no-op for the in-memory backend."""
+
+    async def close(self) -> None:
+        """Stop syncing and release network resources."""
+
+    def synced(self) -> bool:
+        """Whether this replica's state view is good enough to serve —
+        the router ``/ready`` contract (503 ``state_sync`` until True).
+        A single replica is trivially synced."""
+        return True
+
+    async def sync_now(self) -> None:
+        """Force one immediate sync round (used by router drain so
+        journal checkpoints reach the survivors before shutdown)."""
+
+    # -- membership --------------------------------------------------------
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    def live_replica_count(self) -> int:
+        """Replicas currently participating (self included)."""
+        return 1
+
+    def admission_share(self) -> float:
+        """Fraction of the *global* admission rate this replica may admit.
+
+        Rate splitting: each live replica admits ``global_rate / n``, so
+        the fleet-wide admit rate equals the configured limit regardless
+        of replica count, and a replica death never doubles the fleet's
+        effective limit (the survivors' shares grow only after the dead
+        peer ages out of the membership view)."""
+        return 1.0
+
+    # -- providers (local snapshots the sync layer gossips out) ------------
+
+    def register_provider(self, key: str, fn: Callable[[], Any]) -> None:
+        self._providers[key] = fn
+
+    def _provide(self, key: str, default: Any) -> Any:
+        fn = self._providers.get(key)
+        if fn is None:
+            return default
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — a sync round must never die on a provider
+            return default
+
+    # -- circuit breakers --------------------------------------------------
+
+    def remote_breaker_state(self, url: str) -> Optional[str]:
+        """The most severe breaker state any *live peer* reports for
+        ``url`` ("open" blocks routing fleet-wide), or None when no peer
+        has an opinion. Single replica: no peers, no opinion."""
+        return None
+
+    # -- request stats -----------------------------------------------------
+
+    def peer_request_stats(self) -> Dict[str, Dict[str, dict]]:
+        """replica-id -> {engine-url -> compact stats dict} for live
+        peers; the monitor merges these additively into its local view."""
+        return {}
+
+    # -- endpoint view -----------------------------------------------------
+
+    def merged_endpoint_urls(self, local: Sequence[str]) -> List[str]:
+        """The fleet-wide endpoint URL set (union over live replicas) the
+        consistent-hash ring is built from, so replicas whose discovery
+        views momentarily diverge still hash sessions identically."""
+        return list(local)
+
+    # -- prefix trie -------------------------------------------------------
+
+    def publish_prefix_insert(self, path: Sequence[int], endpoint: str) -> None:
+        """Record a prefix-trie insertion (chunk-hash path -> endpoint)
+        for replication to peers."""
+
+    def drain_prefix_inserts(self) -> List[Tuple[List[int], str]]:
+        """Remote insertions accumulated since the last drain, to be
+        applied to the local trie."""
+        return []
+
+    # -- stream-resume journals --------------------------------------------
+
+    def checkpoint_journal(self, request_id: str, snapshot: dict) -> None:
+        """Checkpoint an in-flight stream's journal so a surviving
+        replica can resume it if this replica dies mid-stream."""
+
+    def drop_journal(self, request_id: str) -> None:
+        """The stream ended (completed, truncated, or client gone):
+        retire its checkpoint everywhere."""
+
+    def claim_remote_journal(self, request_id: str) -> Optional[dict]:
+        """Claim the journal checkpoint for ``request_id`` if its owning
+        replica is DEAD (claim-once: the checkpoint is retired so two
+        survivors cannot both resume it). Returns ``{"snap": {...}}``
+        for a usable checkpoint, ``{"stale": True}`` when a checkpoint
+        existed but can no longer be spliced (too old), and ``None``
+        when there is nothing to take over (no checkpoint, or the owner
+        is still alive and streaming it)."""
+        return None
+
+    # -- introspection (/ready, /engines, tests) ---------------------------
+
+    def describe(self) -> dict:
+        return {
+            "backend": self.name,
+            "replica": self.replica_id(),
+            "replicas": self.live_replica_count(),
+            "synced": self.synced(),
+        }

@@ -1,0 +1,501 @@
+"""Gossip-over-HTTP replicated state backend: N router replicas as one.
+
+Why gossip-over-HTTP and not a Redis-protocol store (the decision
+ISSUE/docs require): the router already speaks HTTP on an asyncio loop,
+so replication rides the existing server + client machinery with ZERO
+new dependencies, no extra stateful pod in the helm chart, no Redis
+failover story (which would just move the SPOF), and full testability
+in-process (two backends in one event loop) and in CI (two router
+subprocesses). The price is eventual consistency with a bounded
+staleness of ~one sync interval — acceptable for every structure routed
+through the backend, because each was *chosen* to tolerate it (rate
+splitting, freshest-breaker-wins, additive stats, claim-once journals).
+docs/router-ha.md spells out the consistency model per structure.
+
+Protocol: every ``--state-sync-interval`` seconds each replica POSTs its
+digest to every peer's ``POST /_state/gossip`` and merges the digest the
+peer answers with — a symmetric anti-entropy exchange, so one round
+converges both directions even if only one side can dial the other.
+Peers are configured as explicit URLs (``http://host:port``) or a DNS
+name re-resolved every round (``dns://name:port`` — the k8s headless
+service path, so scale-out needs no config change). A replica that
+reaches its own address recognizes itself by replica id and skips it.
+
+Membership is implicit: a peer is *live* while its last exchange is
+younger than ``--state-peer-timeout``; a SIGKILLed replica ages out and
+the survivors' admission shares and journal-takeover rights adjust on
+the next round. There is no leader and no quorum — any subset of
+replicas keeps serving (availability over strict consistency; the
+routing data plane must never block on coordination).
+"""
+
+# pstlint: disable-file=hop-contract(state-sync exchanges are replica-to-replica control plane: there is no client request whose id/trace/deadline could be relayed; exchanges are identified by replica id instead)
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+import aiohttp
+
+from ...logging_utils import init_logger
+from .base import (
+    PROVIDER_BREAKERS,
+    PROVIDER_ENDPOINTS,
+    PROVIDER_REQUEST_STATS,
+    StateBackend,
+)
+from . import metrics
+
+logger = init_logger(__name__)
+
+GOSSIP_PATH = "/_state/gossip"
+
+# Bounded replication queues/tables: a router must stay O(fleet), never
+# O(traffic history).
+MAX_PREFIX_OUT = 512
+MAX_PREFIX_IN = 2048
+MAX_JOURNALS = 256
+
+
+class _Peer:
+    """Last-known state of one remote replica, keyed by replica id."""
+
+    __slots__ = ("seen", "endpoints", "stats", "breakers")
+
+    def __init__(self) -> None:
+        self.seen = 0.0  # monotonic receipt time of the last digest
+        # pstlint: owned-by=task:_apply
+        self.endpoints: set = set()
+        # pstlint: owned-by=task:_apply
+        self.stats: Dict[str, dict] = {}
+        # pstlint: owned-by=task:_apply
+        self.breakers: Dict[str, str] = {}
+
+
+class _Target:
+    """Exchange bookkeeping for one resolved peer address."""
+
+    __slots__ = ("attempted", "succeeded", "is_self")
+
+    def __init__(self) -> None:
+        self.attempted = False
+        self.succeeded = False
+        self.is_self = False
+
+
+class _Journal:
+    __slots__ = ("owner", "snap", "ts", "seen")
+
+    def __init__(self, owner: str, snap: dict, ts: float, seen: float) -> None:
+        self.owner = owner
+        self.snap = snap
+        self.ts = ts      # owner wall clock at checkpoint (informational)
+        self.seen = seen  # LOCAL monotonic time: staleness never trusts peer clocks
+
+
+class GossipStateBackend(StateBackend):
+    name = "gossip"
+    shared = True
+
+    def __init__(
+        self,
+        peers: Sequence[str],
+        replica_id: Optional[str] = None,
+        sync_interval: float = 0.5,
+        peer_timeout: float = 3.0,
+        ready_grace: Optional[float] = None,
+        journal_ttl: float = 60.0,
+        api_key: Optional[str] = None,
+    ) -> None:
+        super().__init__(replica_id=replica_id)
+        # pstlint: owned-by=task:__init__
+        self.peer_specs = [p.strip() for p in peers if p and p.strip()]
+        self.sync_interval = max(sync_interval, 0.05)
+        self.peer_timeout = max(peer_timeout, self.sync_interval * 2)
+        # How long a fresh replica may wait for unreachable peers before
+        # declaring itself ready anyway (a lone survivor must come up).
+        self.ready_grace = (
+            ready_grace if ready_grace is not None
+            else max(self.peer_timeout * 2, 5.0)
+        )
+        self.journal_ttl = journal_ttl
+        self.api_key = api_key
+
+        # Single-writer surfaces (asyncio single-thread; the lock-discipline
+        # check keeps it that way as this package grows).
+        # pstlint: owned-by=task:_apply,_prune
+        self._peers: Dict[str, _Peer] = {}
+        # pstlint: owned-by=task:_sync_with,_targets_for
+        self._targets: Dict[str, _Target] = {}
+        # pstlint: owned-by=task:checkpoint_journal,drop_journal,claim_remote_journal,_apply,_prune
+        self._journals: Dict[str, _Journal] = {}
+        # pstlint: owned-by=task:drop_journal,claim_remote_journal,_prune
+        self._drops: Deque[Tuple[str, float]] = deque(maxlen=1024)
+        # pstlint: owned-by=task:publish_prefix_insert
+        self._prefix_out: Deque[Tuple[int, List[int], str]] = deque(
+            maxlen=MAX_PREFIX_OUT
+        )
+        # pstlint: owned-by=task:_apply,drain_prefix_inserts
+        self._prefix_in: Deque[Tuple[List[int], str]] = deque(maxlen=MAX_PREFIX_IN)
+        # pstlint: owned-by=task:_apply,_prune
+        self._applied_seq: Dict[str, int] = {}
+        self._prefix_seq = 0
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._task: Optional[asyncio.Task] = None
+        self._started: Optional[float] = None
+        self._synced = not self.peer_specs  # no peers -> trivially synced
+        self._rounds = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, app: Any = None) -> None:
+        if self._task is not None:
+            return
+        self._started = time.monotonic()
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=max(self.sync_interval * 4, 2.0))
+        )
+        self._task = asyncio.create_task(self._loop())
+        logger.info(
+            "gossip state backend up: replica=%s peers=%s interval=%.2fs "
+            "peer_timeout=%.2fs",
+            self.replica_id(), self.peer_specs, self.sync_interval,
+            self.peer_timeout,
+        )
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def synced(self) -> bool:
+        if self._synced:
+            return True
+        now = time.monotonic()
+        if self._rounds > 0 and any(
+            t.succeeded or t.is_self for t in self._targets.values()
+        ):
+            # At least one full round ran and some peer answered: the
+            # fleet view is as good as it gets this interval.
+            self._synced = True
+        elif self._started is not None and now - self._started > self.ready_grace:
+            # Peers unreachable past the grace window: a lone survivor
+            # (or first replica of a rollout) must serve, not 503 forever.
+            logger.warning(
+                "state sync: no peer reachable after %.1fs; serving with "
+                "local view only", self.ready_grace,
+            )
+            self._synced = True
+        return self._synced
+
+    async def sync_now(self) -> None:
+        await self._sync_round()
+
+    # -- membership --------------------------------------------------------
+
+    def _live_peers(self, now: Optional[float] = None) -> Dict[str, _Peer]:
+        now = now if now is not None else time.monotonic()
+        return {
+            rid: p for rid, p in self._peers.items()
+            if now - p.seen <= self.peer_timeout
+        }
+
+    def live_replica_count(self) -> int:
+        return 1 + len(self._live_peers())
+
+    def admission_share(self) -> float:
+        return 1.0 / self.live_replica_count()
+
+    # -- structure views ---------------------------------------------------
+
+    def remote_breaker_state(self, url: str) -> Optional[str]:
+        worst: Optional[str] = None
+        for peer in self._live_peers().values():
+            state = peer.breakers.get(url)
+            if state == "open":
+                return "open"
+            if state is not None:
+                worst = state
+        return worst
+
+    def peer_request_stats(self) -> Dict[str, Dict[str, dict]]:
+        return {rid: p.stats for rid, p in self._live_peers().items()}
+
+    def merged_endpoint_urls(self, local: Sequence[str]) -> List[str]:
+        merged = set(local)
+        for peer in self._live_peers().values():
+            merged |= peer.endpoints
+        return sorted(merged)
+
+    def publish_prefix_insert(self, path: Sequence[int], endpoint: str) -> None:
+        self._prefix_seq += 1
+        self._prefix_out.append((self._prefix_seq, list(path), endpoint))
+
+    def drain_prefix_inserts(self) -> List[Tuple[List[int], str]]:
+        out = list(self._prefix_in)
+        self._prefix_in.clear()
+        return out
+
+    # -- journals ----------------------------------------------------------
+
+    def checkpoint_journal(self, request_id: str, snapshot: dict) -> None:
+        now = time.monotonic()
+        entry = self._journals.get(request_id)
+        if entry is None and self._local_journal_count() >= MAX_JOURNALS:
+            return  # bounded: beyond the cap new streams lose HA, not service
+        if entry is not None and entry.owner == self.replica_id():
+            entry.snap = snapshot
+            entry.ts = time.time()
+            entry.seen = now
+            return
+        self._journals[request_id] = _Journal(
+            self.replica_id(), snapshot, time.time(), now
+        )
+
+    def drop_journal(self, request_id: str) -> None:
+        self._journals.pop(request_id, None)
+        # Gossip the drop even without a local copy: a peer may hold one.
+        self._drops.append((request_id, time.monotonic()))
+
+    def claim_remote_journal(self, request_id: str) -> Optional[dict]:
+        entry = self._journals.get(request_id)
+        if entry is None or entry.owner == self.replica_id():
+            return None
+        owner = self._peers.get(entry.owner)
+        if owner is not None and time.monotonic() - owner.seen <= self.peer_timeout:
+            return None  # owner alive: it is still streaming this request
+        # Claim-once: retire the checkpoint locally and fleet-wide so two
+        # survivors cannot both splice the same suffix.
+        self._journals.pop(request_id, None)
+        self._drops.append((request_id, time.monotonic()))
+        if time.monotonic() - entry.seen > self.journal_ttl:
+            return {"stale": True}
+        return {"snap": entry.snap}
+
+    def _local_journal_count(self) -> int:
+        me = self.replica_id()
+        return sum(1 for j in self._journals.values() if j.owner == me)
+
+    # -- the exchange ------------------------------------------------------
+
+    def digest(self) -> dict:
+        """This replica's gossip payload (also the server-side reply)."""
+        me = self.replica_id()
+        return {
+            "replica": me,
+            "ts": time.time(),
+            "endpoints": list(self._provide(PROVIDER_ENDPOINTS, [])),
+            "stats": self._provide(PROVIDER_REQUEST_STATS, {}),
+            "breakers": self._provide(PROVIDER_BREAKERS, {}),
+            "prefix": [
+                [seq, path, ep] for seq, path, ep in list(self._prefix_out)
+            ],
+            "journals": {
+                rid: {"snap": j.snap, "ts": j.ts}
+                for rid, j in self._journals.items()
+                if j.owner == me
+            },
+            "drops": [rid for rid, _ in list(self._drops)],
+        }
+
+    def exchange(self, peer_digest: dict) -> dict:
+        """Server side of one exchange: merge theirs, answer with ours."""
+        self._apply(peer_digest)
+        return self.digest()
+
+    def _apply(self, digest: dict) -> bool:
+        """Merge a peer digest; False when the digest is our own echo."""
+        rid = digest.get("replica")
+        if not isinstance(rid, str) or not rid or rid == self.replica_id():
+            return False
+        now = time.monotonic()
+        peer = self._peers.get(rid)
+        if peer is None:
+            peer = _Peer()
+            self._peers[rid] = peer
+            logger.info("state sync: discovered replica %s", rid)
+        peer.seen = now
+        peer.endpoints = set(digest.get("endpoints") or [])
+        stats = digest.get("stats")
+        peer.stats = stats if isinstance(stats, dict) else {}
+        breakers = digest.get("breakers")
+        peer.breakers = breakers if isinstance(breakers, dict) else {}
+        # Prefix insertions: apply only sequence numbers we have not seen
+        # from this replica (the out-queue is a sliding window, so digests
+        # re-carry recent entries every round).
+        last = self._applied_seq.get(rid, 0)
+        newest = last
+        for item in digest.get("prefix") or []:
+            try:
+                seq, path, ep = int(item[0]), list(item[1]), str(item[2])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if seq > last:
+                self._prefix_in.append(([int(h) for h in path], ep))
+                newest = max(newest, seq)
+        self._applied_seq[rid] = newest
+        # Journal checkpoints: freshest per request id wins; drops beat
+        # checkpoints (a completed stream must never be resurrected).
+        dropped = set(digest.get("drops") or [])
+        for drid in dropped:
+            self._journals.pop(drid, None)
+        for jrid, entry in (digest.get("journals") or {}).items():
+            if jrid in dropped or not isinstance(entry, dict):
+                continue
+            snap = entry.get("snap")
+            if not isinstance(snap, dict):
+                continue
+            ts = float(entry.get("ts") or 0.0)
+            known = self._journals.get(jrid)
+            if known is None or (known.owner == rid and ts >= known.ts):
+                self._journals[jrid] = _Journal(rid, snap, ts, now)
+        return True
+
+    # -- sync loop ---------------------------------------------------------
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self._sync_round()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — syncing is best-effort
+                logger.error("state sync round failed: %s", e)
+            await asyncio.sleep(self.sync_interval)
+
+    async def _resolve_peers(self) -> List[Tuple[str, str]]:
+        """Resolve peer specs to ``(label, base_url)`` pairs. ``label`` is
+        the CONFIGURED spec (bounded set — the metrics label; resolved pod
+        IPs churn on every rollout and would grow Prometheus cardinality
+        without bound). ``dns://name:port`` resolves fresh every round
+        (k8s headless service), explicit URLs pass through."""
+        out: List[Tuple[str, str]] = []
+        seen: set = set()
+        loop = asyncio.get_running_loop()
+        for spec in self.peer_specs:
+            if spec.startswith("dns://"):
+                parsed = urlparse(spec)
+                host, port = parsed.hostname, parsed.port or 80
+                try:
+                    infos = await loop.getaddrinfo(host, port)
+                except OSError as e:
+                    logger.debug("peer DNS resolve failed for %s: %s", spec, e)
+                    continue
+                for info in infos:
+                    addr = info[4][0]
+                    # IPv6 addresses need brackets in URLs.
+                    hostpart = f"[{addr}]" if ":" in addr else addr
+                    url = f"http://{hostpart}:{port}"
+                    if url not in seen:
+                        seen.add(url)
+                        out.append((spec, url))
+            else:
+                url = spec.rstrip("/")
+                if url not in seen:
+                    seen.add(url)
+                    out.append((spec, url))
+        return out
+
+    def _targets_for(self, addrs: List[str]) -> Dict[str, _Target]:
+        for addr in addrs:
+            if addr not in self._targets:
+                self._targets[addr] = _Target()
+        return {a: self._targets[a] for a in addrs}
+
+    async def _sync_round(self) -> None:
+        if self._session is None:
+            return
+        resolved = await self._resolve_peers()
+        targets = self._targets_for([url for _, url in resolved])
+        # One digest per ROUND, not per peer: with journal checkpoints in
+        # it, rebuilding+re-encoding per peer would be the expensive part.
+        digest = self.digest()
+        for label, addr in resolved:
+            target = targets[addr]
+            if target.is_self:
+                continue
+            await self._sync_with(label, addr, target, digest)
+        self._prune()
+        self._update_gauges()
+        self._rounds += 1
+
+    async def _sync_with(
+        self, label: str, addr: str, target: _Target, digest: dict
+    ) -> None:
+        target.attempted = True
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        t0 = time.monotonic()
+        try:
+            async with self._session.post(
+                addr + GOSSIP_PATH, json=digest, headers=headers
+            ) as resp:
+                resp.raise_for_status()
+                peer_digest = await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a dead peer is the signal
+            metrics.sync_total.labels(peer=label, outcome="error").inc()
+            logger.debug("state sync with %s failed: %s", addr, e)
+            return
+        metrics.sync_seconds.observe(time.monotonic() - t0)
+        if not self._apply(peer_digest):
+            if peer_digest.get("replica") == self.replica_id():
+                # DNS handed us our own address (headless service lists
+                # every pod): remember and stop dialing ourselves.
+                target.is_self = True
+                metrics.sync_total.labels(peer=label, outcome="self").inc()
+                return
+            metrics.sync_total.labels(peer=label, outcome="invalid").inc()
+            return
+        target.succeeded = True
+        metrics.sync_total.labels(peer=label, outcome="ok").inc()
+
+    def _prune(self) -> None:
+        now = time.monotonic()
+        # Journals past TTL are unusable for splicing — retire them.
+        for rid in [
+            r for r, j in self._journals.items()
+            if now - j.seen > self.journal_ttl * 2
+        ]:
+            self._journals.pop(rid, None)
+        while self._drops and now - self._drops[0][1] > 30.0:
+            self._drops.popleft()
+        # Peers dead for a long time (10x timeout) are forgotten entirely
+        # so a churned fleet does not grow the table without bound.
+        for rid in [
+            r for r, p in self._peers.items()
+            if now - p.seen > self.peer_timeout * 10
+        ]:
+            self._peers.pop(rid, None)
+            self._applied_seq.pop(rid, None)
+
+    def _update_gauges(self) -> None:
+        me = self.replica_id()
+        local = sum(1 for j in self._journals.values() if j.owner == me)
+        metrics.replica_peers.set(self.live_replica_count())
+        metrics.admission_share.set(self.admission_share())
+        metrics.journals.labels(kind="local").set(local)
+        metrics.journals.labels(kind="remote").set(len(self._journals) - local)
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self) -> dict:
+        base = super().describe()
+        base.update({
+            "peers": {
+                rid: round(time.monotonic() - p.seen, 2)
+                for rid, p in self._peers.items()
+            },
+            "admission_share": self.admission_share(),
+            "journals": len(self._journals),
+        })
+        return base

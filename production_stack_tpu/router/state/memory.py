@@ -1,0 +1,16 @@
+"""The default single-replica backend: the base class, under its own name.
+
+Kept as a distinct class (rather than using :class:`~.base.StateBackend`
+directly) so logs, ``/ready`` payloads, and tests name the configured
+backend explicitly, and so future local-only optimizations have a home
+that is unmistakably not the interface definition.
+"""
+
+from __future__ import annotations
+
+from .base import StateBackend
+
+
+class InMemoryStateBackend(StateBackend):
+    name = "memory"
+    shared = False

@@ -1,0 +1,41 @@
+"""Prometheus surface for router replication (``pst_router_replica_*``).
+
+Declared in ``obs/metric_registry.py`` and documented in
+docs/observability.md ("Router HA / replication" rows); the
+``metric-registry`` pstlint check enforces the triangle.
+"""
+
+from prometheus_client import Counter, Gauge, Histogram
+
+replica_peers = Gauge(
+    "pst_router_replica_peers",
+    "Live router replicas in the shared-state membership view (self "
+    "included; 1 = single replica or every peer dead)",
+)
+sync_total = Counter(
+    "pst_router_replica_sync",
+    "State-sync (gossip) exchanges attempted, by peer address and outcome",
+    ["peer", "outcome"],
+)
+sync_seconds = Histogram(
+    "pst_router_replica_sync_seconds",
+    "Wall time of one state-sync exchange with one peer",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
+admission_share = Gauge(
+    "pst_router_replica_admission_share",
+    "Fraction of the global admission rate this replica currently admits "
+    "(1/live-replicas under rate splitting)",
+)
+journals = Gauge(
+    "pst_router_replica_journals",
+    "Stream-resume journal checkpoints held, by kind (local = owned by "
+    "this replica, remote = checkpointed here for takeover)",
+    ["kind"],
+)
+takeovers_total = Counter(
+    "pst_router_replica_takeovers",
+    "Journaled streams claimed from a dead replica, by outcome (resumed = "
+    "continuation spliced, stale = checkpoint unusable, visible truncation)",
+    ["outcome"],
+)

@@ -4,16 +4,29 @@ Capability parity with the reference's ``src/vllm_router/stats/request_stats.py`
 (RequestStats :34-55, MovingAverageMonitor :58-103, RequestStatsMonitor
 :106-306): requests move prefill → decode → finished, with sliding-window
 averages per engine.
+
+Ownership (router HA): the monitor is a plain class — no ``SingletonMeta``
+— created by the app factory and *injected* per app (``create_app`` binds
+it into request context via middleware), so multi-replica tests can run
+two routers in one process without state bleed. ``get_request_stats_monitor``
+resolves the context-bound monitor first and falls back to the
+module-level default the last ``initialize_request_stats_monitor`` set,
+which keeps every existing call site (and single-router deployments)
+working unchanged.
+
+Replication: ``get_request_stats`` merges live peers' snapshots from the
+:class:`~..state.StateBackend` (additive counts, summed QPS) so routing
+decisions see *fleet-wide* load; with the in-memory backend the merge is
+the identity and behavior is byte-for-byte the single-replica one.
 """
 
 from __future__ import annotations
 
+import contextvars
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, Optional, Tuple
-
-from ...utils import SingletonMeta
 
 
 @dataclass
@@ -36,6 +49,7 @@ class MovingAverageMonitor:
 
     def __init__(self, window: float):
         self.window = window
+        # pstlint: owned-by=task:update,_evict
         self._items: Deque[Tuple[float, float]] = deque()
         self._sum = 0.0
 
@@ -67,12 +81,10 @@ class MovingAverageMonitor:
         return len(self._items)
 
 
-class RequestStatsMonitor(metaclass=SingletonMeta):
+class RequestStatsMonitor:
     """Tracks request lifecycle events reported by the proxy layer."""
 
     def __init__(self, sliding_window_size: Optional[float] = None):
-        if getattr(self, "_initialized", False):
-            return
         if sliding_window_size is None:
             raise ValueError("RequestStatsMonitor needs sliding_window_size")
         self.window = sliding_window_size
@@ -112,7 +124,14 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         # pstlint: owned-by=task:on_*,evict_url
         self.failed: Dict[str, int] = {}
         self.first_query_time: Optional[float] = None
-        self._initialized = True
+
+    @classmethod
+    def destroy(cls) -> None:
+        """Drop the module-level default (test/reconfiguration hook; the
+        name survives from the SingletonMeta era so existing teardown
+        helpers keep working)."""
+        global _default_monitor
+        _default_monitor = None
 
     def _mon(self, table: Dict[str, MovingAverageMonitor], url: str) -> MovingAverageMonitor:
         if url not in table:
@@ -184,8 +203,9 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         ):
             table.pop(engine_url, None)
 
-    def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
-        now = current_time if current_time is not None else time.time()
+    def _local_request_stats(self, now: float) -> Dict[str, RequestStats]:
+        """This replica's own view (no peer merge) — what the gossip
+        snapshot provider publishes and the merge builds on."""
         urls = (
             set(self.qps_monitors)
             | set(self.in_prefill)
@@ -219,10 +239,91 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
             )
         return out
 
+    def sync_snapshot(self) -> Dict[str, dict]:
+        """Compact per-engine snapshot the state backend gossips to peers
+        (only what fleet-wide routing actually consumes)."""
+        now = time.time()
+        return {
+            url: {
+                "qps": rs.qps,
+                "ttft": rs.ttft,
+                "in_prefill": rs.in_prefill_requests,
+                "in_decoding": rs.in_decoding_requests,
+                "finished": rs.finished_requests,
+                "failed": rs.failed_requests,
+            }
+            for url, rs in self._local_request_stats(now).items()
+        }
+
+    def get_request_stats(
+        self, current_time: Optional[float] = None, fleet: bool = True
+    ) -> Dict[str, RequestStats]:
+        """Per-engine stats. With a shared state backend and ``fleet=True``
+        (the default — what routing wants), live peers' snapshots merge in
+        additively; ``fleet=False`` keeps the view local (the /metrics
+        exposition, where each replica must export only its own traffic or
+        Prometheus sums would double-count)."""
+        now = current_time if current_time is not None else time.time()
+        out = self._local_request_stats(now)
+        if not fleet:
+            return out
+        from ..state import get_state_backend
+
+        backend = get_state_backend()
+        if backend is None or not backend.shared:
+            return out
+        for snap in backend.peer_request_stats().values():
+            if not isinstance(snap, dict):
+                continue
+            for url, d in snap.items():
+                if not isinstance(d, dict):
+                    continue
+                rs = out.get(url)
+                if rs is None:
+                    rs = RequestStats()
+                    out[url] = rs
+                rs.qps += float(d.get("qps") or 0.0)
+                rs.in_prefill_requests += int(d.get("in_prefill") or 0)
+                rs.in_decoding_requests += int(d.get("in_decoding") or 0)
+                rs.finished_requests += int(d.get("finished") or 0)
+                rs.failed_requests += int(d.get("failed") or 0)
+                if rs.ttft < 0:
+                    rs.ttft = float(d.get("ttft") if d.get("ttft") is not None else -1.0)
+        return out
+
+
+# Context binding: ``create_app`` injects its own monitor for the request
+# tasks it serves; the module default covers single-app processes and
+# background loops. (A contextvar, not an app lookup, so the deep call
+# graph under proxy_and_stream needs no monitor threading.)
+_bound_monitor: contextvars.ContextVar[Optional[RequestStatsMonitor]] = (
+    contextvars.ContextVar("pst_request_stats_monitor", default=None)
+)
+_default_monitor: Optional[RequestStatsMonitor] = None
+
 
 def initialize_request_stats_monitor(sliding_window_size: float) -> RequestStatsMonitor:
-    return RequestStatsMonitor(sliding_window_size)
+    global _default_monitor
+    _default_monitor = RequestStatsMonitor(sliding_window_size)
+    return _default_monitor
+
+
+def bind_request_stats_monitor(
+    monitor: RequestStatsMonitor,
+) -> contextvars.Token:
+    """Bind ``monitor`` for the current context (one request's task tree);
+    returns the token for ``unbind_request_stats_monitor``."""
+    return _bound_monitor.set(monitor)
+
+
+def unbind_request_stats_monitor(token: contextvars.Token) -> None:
+    _bound_monitor.reset(token)
 
 
 def get_request_stats_monitor() -> RequestStatsMonitor:
-    return RequestStatsMonitor()
+    monitor = _bound_monitor.get()
+    if monitor is not None:
+        return monitor
+    if _default_monitor is None:
+        raise ValueError("RequestStatsMonitor needs sliding_window_size")
+    return _default_monitor

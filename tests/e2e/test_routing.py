@@ -457,6 +457,265 @@ def leg_chaos():
           f"{resumed:.0f} resumed)")
 
 
+class Fleet2:
+    """N fake engines + TWO router replicas sharing state over the gossip
+    backend (docs/router-ha.md) — the router-kill chaos topology."""
+
+    def __init__(self, router_args=None, speed=2000, n_engines=2):
+        self.procs = []
+        self.router_procs = []
+        env = dict(os.environ, PYTHONPATH=REPO)
+        self.engine_ports = [free_port() for _ in range(n_engines)]
+        for i, port in enumerate(self.engine_ports):
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", MODEL, "--speed", str(speed),
+                 "--name", f"engine-{i}"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+        for port in self.engine_ports:
+            wait_http(f"http://127.0.0.1:{port}/health")
+
+        backends = ",".join(f"http://127.0.0.1:{p}" for p in self.engine_ports)
+        self.router_ports = [free_port(), free_port()]
+        for i, port in enumerate(self.router_ports):
+            peer = self.router_ports[1 - i]
+            args = [
+                sys.executable, "-m", "production_stack_tpu.router.app",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--service-discovery", "static",
+                "--static-backends", backends,
+                "--static-models", ",".join([MODEL] * n_engines),
+                "--routing-logic", "roundrobin",
+                "--engine-stats-interval", "1",
+                "--state-backend", "gossip",
+                "--state-peers", f"http://127.0.0.1:{peer}",
+                "--state-sync-interval", "0.2",
+                "--state-peer-timeout", "1.0",
+                "--state-replica-id", f"replica-{i}",
+            ] + (router_args or [])
+            proc = subprocess.Popen(
+                args, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            self.procs.append(proc)
+            self.router_procs.append(proc)
+        self.urls = [f"http://127.0.0.1:{p}" for p in self.router_ports]
+        for url in self.urls:
+            wait_http(f"{url}/health")
+            wait_http(f"{url}/ready")  # 503 until the replicas synced
+
+    def kill_router(self, idx: int) -> None:
+        self.router_procs[idx].kill()  # SIGKILL: no drain, no goodbye
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _stream_collect(url: str, payload: dict, request_id: str):
+    """Stream a completion, returning (tok_numbers, body, died) — on a
+    mid-stream transport death keep what was delivered (the client-side
+    view a takeover must complete)."""
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": request_id},
+        method="POST",
+    )
+    chunks, died, headers = [], False, {}
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            headers = dict(resp.headers)
+            while True:
+                chunk = resp.read(256)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+    except Exception:
+        died = True
+    body = b"".join(chunks).decode(errors="replace")
+    toks = sorted(
+        int(m) for m in
+        __import__("re").findall(r"tok(\d+) ", body)
+    )
+    return toks, body, died, headers
+
+
+def leg_router_kill():
+    """Router HA chaos: SIGKILL one of two gossip-coordinated router
+    replicas mid-load. In-flight non-streaming requests retry on the
+    survivor with zero losses, journaled streams resume from the gossiped
+    checkpoint (or terminate visibly), and the fleet-wide admission limit
+    never doubles after the kill."""
+    import concurrent.futures
+
+    n_tokens = 60
+    with Fleet2(speed=100,
+                router_args=["--proxy-retries", "2",
+                             "--retry-backoff", "0.01",
+                             "--breaker-failure-threshold", "3",
+                             "--stream-resume",
+                             "--stream-resume-max-legs", "2"]) as f:
+        url_a, url_b = f.urls
+
+        # Warm-up through BOTH replicas; both must be ready + serving.
+        for url in f.urls:
+            status, _, _ = post(f"{url}/v1/completions",
+                                {"model": MODEL, "prompt": "w",
+                                 "max_tokens": 2})
+            assert status == 200
+
+        # Mid-flight load through replica A only (the one that will die).
+        def one_with_retry(i):
+            """Non-streaming client contract: on transport failure, retry
+            the same request on the survivor. ZERO requests may be lost."""
+            body = {"model": MODEL, "prompt": f"rk{i}", "max_tokens": 4}
+            try:
+                status, _, _ = post(f"{url_a}/v1/completions", body)
+                if status == 200:
+                    return 200
+            except Exception:
+                pass
+            for _ in range(3):
+                try:
+                    status, _, _ = post(f"{url_b}/v1/completions", body)
+                    if status == 200:
+                        return 200
+                except Exception:
+                    time.sleep(0.2)
+            return 0
+
+        stream_ids = [f"rk-stream-{i}" for i in range(6)]
+        stream_payload = {"model": MODEL, "prompt": "rkstream",
+                         "max_tokens": n_tokens, "stream": True}
+        with concurrent.futures.ThreadPoolExecutor(max_workers=24) as ex:
+            stream_futs = [
+                ex.submit(_stream_collect, url_a, stream_payload, rid)
+                for rid in stream_ids
+            ]
+            time.sleep(0.1)
+            nonstream_futs = [ex.submit(one_with_retry, i) for i in range(16)]
+            # ~60 tokens at 100 tok/s = 0.6 s per stream; the kill lands
+            # mid-stream with ≥1 checkpoint gossiped (every 8 tokens,
+            # 0.2 s sync interval).
+            time.sleep(0.35)
+            f.kill_router(0)
+            nonstream = [fut.result() for fut in nonstream_futs]
+            streams = [fut.result() for fut in stream_futs]
+
+        # 1) Zero non-streaming requests lost: every one retried fine.
+        assert nonstream == [200] * 16, Counter(nonstream)
+
+        # 2) The survivor ages the dead peer out of membership.
+        time.sleep(1.5)
+        with urllib.request.urlopen(f"{url_b}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_router_replica_admission_share") == 1.0
+        assert metric_value(metrics, "pst_router_replica_peers") == 1.0
+
+        # 3) Broken streams retried on the survivor with the SAME
+        #    X-Request-Id resume from the gossiped checkpoint: the reply
+        #    is a suffix under the original identity; prefix ∪ suffix
+        #    covers the full generation with no gap.
+        resumed = 0
+        for rid, (prefix_toks, _, died, _) in zip(stream_ids, streams):
+            if not died:
+                # Stream finished before the kill reached it.
+                assert prefix_toks == list(range(n_tokens))
+                continue
+            suffix_toks, body, died2, headers = _stream_collect(
+                url_b, stream_payload, rid
+            )
+            assert not died2, f"retry of {rid} died too"
+            assert body.count("data: [DONE]") == 1, body[-200:]
+            if headers.get("X-PST-Stream-Takeover") == "1":
+                if "stream_truncated" in body:
+                    continue  # visible truncation: the allowed fallback
+                resumed += 1
+                # Suffix-only resume: starts at (or before) the first
+                # undelivered token — never from scratch — and runs to
+                # the end; combined coverage has no hole.
+                assert suffix_toks and suffix_toks[-1] == n_tokens - 1
+                assert suffix_toks[0] <= (
+                    (prefix_toks[-1] + 1) if prefix_toks else 0
+                )
+                covered = set(prefix_toks) | set(suffix_toks)
+                assert covered == set(range(n_tokens)), sorted(covered)[:5]
+            else:
+                # No claimable checkpoint: a fresh, complete generation
+                # (with [DONE]) is the non-HA contract — still no loss.
+                assert suffix_toks == list(range(n_tokens))
+        assert resumed >= 1, "no journaled stream resumed on the survivor"
+        with urllib.request.urlopen(f"{url_b}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        takeovers = metric_value(
+            metrics, "pst_router_replica_takeovers_total", 'outcome="resumed"'
+        )
+        assert takeovers >= 1, "takeover counter did not move"
+    print(f"PASS router-kill (16/16 non-streaming retried, "
+          f"{resumed} stream(s) resumed on survivor)")
+
+    # Fleet-wide admission: one token-bucket limit across both replicas —
+    # the flood admit rate stays ≤ 1.1× the single-replica limit before
+    # AND after the kill (no 2× burst when the survivor takes over).
+    rate, burst = 25.0, 10
+    with Fleet2(router_args=["--admission-rate", str(rate),
+                             "--admission-burst", str(burst),
+                             "--admission-queue-size", "0"]) as f:
+        import concurrent.futures
+
+        def flood(urls, n):
+            t0 = time.time()
+            def one(i):
+                try:
+                    status, _, _ = post(
+                        f"{urls[i % len(urls)]}/v1/completions",
+                        {"model": MODEL, "prompt": f"f{i}", "max_tokens": 1})
+                    return status
+                except Exception:
+                    return 0
+            with concurrent.futures.ThreadPoolExecutor(max_workers=24) as ex:
+                statuses = list(ex.map(one, range(n)))
+            return Counter(statuses), time.time() - t0
+
+        # Give the replicas a sync round so shares settle at 1/2.
+        time.sleep(0.6)
+        statuses, elapsed = flood(f.urls, 300)
+        admitted = statuses.get(200, 0)
+        expected = burst + rate * elapsed  # the SINGLE-replica envelope
+        assert statuses.get(429, 0) > 0, statuses  # the limit actually bit
+        assert admitted <= 1.1 * expected + 5, (
+            f"fleet admitted {admitted} > 1.1x single-replica envelope "
+            f"{expected:.0f} over {elapsed:.2f}s — admission is per-replica,"
+            f" not fleet-wide"
+        )
+
+        f.kill_router(0)
+        time.sleep(1.5)  # peer timeout: survivor reclaims the full rate
+        statuses2, elapsed2 = flood([f.urls[1]], 200)
+        admitted2 = statuses2.get(200, 0)
+        expected2 = burst + rate * elapsed2
+        assert admitted2 <= 1.1 * expected2 + 5, (
+            f"post-kill admitted {admitted2} > envelope {expected2:.0f}"
+        )
+        assert admitted2 >= 5, statuses2  # survivor still admits
+    print(f"PASS router-kill admission (fleet {admitted} ≤ 1.1x "
+          f"{expected:.0f}; post-kill {admitted2} ≤ 1.1x {expected2:.0f})")
+
+
 LEGS = {
     "roundrobin": leg_roundrobin,
     "session": leg_session,
@@ -465,6 +724,7 @@ LEGS = {
     "disaggregated_prefill": leg_disagg,
     "stress": leg_stress,
     "chaos": leg_chaos,
+    "router_kill": leg_router_kill,
     "deadline": leg_deadline,
 }
 

@@ -1,0 +1,24 @@
+"""Fixture: undeclared mutable state on a routing-state surface fires."""
+
+from collections import deque
+
+
+class RogueRoutingState:
+    def __init__(self):
+        self.table = {}                 # fires: dict, no owned-by
+        self.items: list = []           # fires: list AnnAssign
+        self.pending = deque()          # fires: deque() constructor
+        self.count = 0                  # quiet: immutable
+        self.name = "x"                 # quiet: immutable
+        local = {}                      # quiet: local, not self state
+        local["k"] = 1
+
+
+class LaterMutation:
+    def __init__(self):
+        self.ok = 0
+
+    def grow(self):
+        # Known limit (documented): only __init__ declarations are
+        # checked — this does not fire.
+        self.sneaky = {}

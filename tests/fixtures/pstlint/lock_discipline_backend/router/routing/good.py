@@ -1,0 +1,26 @@
+"""Fixture: every mutable routing-state attr declares its writer."""
+
+import threading
+from collections import deque
+
+
+class DeclaredRoutingState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # pstlint: owned-by=lock:_lock
+        self.table = {}
+        # pstlint: owned-by=task:push,drain
+        self.queue = deque()
+        # State replicated through the router StateBackend: merge
+        # semantics live there, not in same-file writers.
+        # pstlint: owned-by=backend:journal_checkpoints
+        self.journals = {}
+        self.count = 0
+
+    def push(self, item):
+        self.queue.append(item)
+
+    def drain(self):
+        out = list(self.queue)
+        self.queue.clear()
+        return out

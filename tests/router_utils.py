@@ -13,12 +13,14 @@ from production_stack_tpu.router.service_discovery import (
     ModelInfo,
     teardown_service_discovery,
 )
+from production_stack_tpu.router.state import teardown_state_backend
 from production_stack_tpu.router.stats.engine_stats import EngineStatsScraper
 from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
 
 
 def reset_router_singletons():
     teardown_resilience()
+    teardown_state_backend()
     teardown_request_tracing()
     teardown_routing_logic()
     teardown_canary_prober()

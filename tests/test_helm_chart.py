@@ -96,6 +96,61 @@ def test_values_schema_covers_warmup():
         jsonschema.validate(bad, schema)
 
 
+def test_router_replicas_gated_on_shared_state_backend():
+    """replicaCount > 1 with the in-memory backend must fail the render
+    loudly (divergent routing state), both at the schema layer and in the
+    template itself; with the gossip backend it must validate."""
+    import jsonschema
+
+    with open(HELM_DIR / "values.schema.json") as f:
+        schema = json.load(f)
+    values = _load_values(HELM_DIR / "values.yaml")
+
+    def with_router(**overrides):
+        v = dict(values)
+        v["routerSpec"] = {**values["routerSpec"], **overrides}
+        return v
+
+    # Schema: 2 replicas + memory backend rejected...
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(
+            with_router(replicaCount=2, stateBackend={"type": "memory"}),
+            schema,
+        )
+    # ... and 2 replicas + gossip accepted.
+    jsonschema.validate(
+        with_router(replicaCount=2, stateBackend={"type": "gossip"}), schema
+    )
+    # Defaults stay single-replica + memory (zero behavior change).
+    assert values["routerSpec"]["replicaCount"] == 1
+    assert values["routerSpec"]["stateBackend"]["type"] == "memory"
+
+    # Template: the same invariant enforced at render time for operators
+    # who bypass schema validation.
+    text = (HELM_DIR / "templates" / "deployment-router.yaml").read_text()
+    assert 'fail "routerSpec.replicaCount > 1 requires' in text
+    # Gossip wiring: peers via the headless service, stable replica ids.
+    assert "--state-peers" in text
+    assert "router-headless" in text
+    assert "publishNotReadyAddresses: true" in text
+    assert "$(POD_NAME)" in text
+
+
+def test_router_template_has_pdb_and_ready_probe():
+    text = (HELM_DIR / "templates" / "deployment-router.yaml").read_text()
+    assert "PodDisruptionBudget" in text
+    assert "minAvailable" in text
+    # Readiness must hit /ready (state-sync + drain gated); liveness and
+    # startup stay on /health — an unsynced replica is alive, not broken.
+    assert "readinessProbe" in text
+    ready_block = text.split("readinessProbe", 1)[1].split("startupProbe")[0]
+    assert "path: /ready" in ready_block
+    liveness = text.split("livenessProbe", 1)[1].split("readinessProbe")[0]
+    assert "/health" in liveness
+    # Rolling restarts drain the replica (journals pushed to survivors).
+    assert "/router/drain" in text
+
+
 def test_templates_have_balanced_go_template_delimiters():
     for tpl in sorted((HELM_DIR / "templates").glob("*")):
         text = tpl.read_text()

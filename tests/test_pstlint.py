@@ -131,6 +131,30 @@ class TestLockDiscipline:
         active = lint(FIXTURES / "lock_discipline", "lock-discipline")
         assert not [f for f in active if f.path.endswith("good.py")]
 
+    def test_backend_discipline_fires_on_undeclared_mutable_state(self):
+        active = lint(FIXTURES / "lock_discipline_backend", "lock-discipline")
+        assert all(f.path.endswith("bad.py") for f in active)
+        joined = "\n".join(f.message for f in active)
+        # The three undeclared containers, each named in a finding.
+        for attr in ("'table'", "'items'", "'pending'"):
+            assert attr in joined, joined
+        assert len(active) == 3, [f.message for f in active]
+        assert "StateBackend" in joined
+
+    def test_backend_discipline_accepts_all_owner_kinds(self):
+        active = lint(FIXTURES / "lock_discipline_backend", "lock-discipline")
+        # good.py declares lock:, task: and the new backend: kind — all
+        # accepted, and backend-owned state gets no same-file mutation
+        # checking (the backend owns the merge semantics).
+        assert not [f for f in active if f.path.endswith("good.py")]
+
+    def test_backend_discipline_scoped_to_routing_state_surfaces(self):
+        # The same undeclared-state pattern OUTSIDE the scope (plain
+        # lock_discipline fixture dir, no router/resilience path) is quiet:
+        # the backend rule must not tax unrelated code.
+        active = lint(FIXTURES / "lock_discipline", "lock-discipline")
+        assert not [f for f in active if "declares no writer" in f.message]
+
 
 class TestSuppressionMachinery:
     def test_reasonless_disable_is_flagged_and_inert(self):

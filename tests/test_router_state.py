@@ -1,0 +1,556 @@
+"""Router HA state layer: StateBackend contract, gossip replication,
+fleet-wide admission/breakers/stats, journal takeover, /ready + drain.
+
+Unit ring for docs/router-ha.md. The process-level router-kill chaos leg
+lives in tests/e2e/test_routing.py (``router_kill``); here everything
+runs in one process — which is exactly what killing the RequestStatsMonitor
+singleton (this PR's satellite) makes possible.
+"""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.resilience import (
+    get_breaker_registry,
+    initialize_resilience,
+)
+from production_stack_tpu.resilience.admission import AdmissionController
+from production_stack_tpu.resilience.breaker import CircuitBreakerRegistry
+from production_stack_tpu.resilience.stream_resume import StreamJournal
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.routing.logic import ConsistentHashRing
+from production_stack_tpu.router.state import (
+    GOSSIP_PATH,
+    GossipStateBackend,
+    InMemoryStateBackend,
+    get_state_backend,
+)
+from production_stack_tpu.router.state.gossip import _Journal
+from production_stack_tpu.router.stats.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+from .test_router_e2e import Cluster
+
+MODEL = "fake/model"
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+class _StubBackend(InMemoryStateBackend):
+    """Shared-capable stub with scripted answers (no network)."""
+
+    shared = True
+
+    def __init__(self, **answers):
+        super().__init__(replica_id="stub")
+        self.answers = answers
+
+    def admission_share(self):
+        return self.answers.get("admission_share", 1.0)
+
+    def remote_breaker_state(self, url):
+        return (self.answers.get("breakers") or {}).get(url)
+
+    def peer_request_stats(self):
+        return self.answers.get("peer_stats", {})
+
+    def merged_endpoint_urls(self, local):
+        return sorted(set(local) | set(self.answers.get("extra_urls", [])))
+
+
+# ---------------------------------------------------------------------------
+# Interface contract
+# ---------------------------------------------------------------------------
+
+
+def test_memory_backend_is_single_replica_identity():
+    b = InMemoryStateBackend()
+    assert b.shared is False
+    assert b.synced() is True
+    assert b.live_replica_count() == 1
+    assert b.admission_share() == 1.0
+    assert b.remote_breaker_state("http://e1") is None
+    assert b.peer_request_stats() == {}
+    assert b.merged_endpoint_urls(["http://e1"]) == ["http://e1"]
+    assert b.drain_prefix_inserts() == []
+    b.checkpoint_journal("r1", {"text": "x"})
+    assert b.claim_remote_journal("r1") is None  # never replicates
+    d = b.describe()
+    assert d["backend"] == "memory" and d["replicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Gossip merge semantics (no network: digests applied directly)
+# ---------------------------------------------------------------------------
+
+
+def _pair(**kw):
+    a = GossipStateBackend(peers=["http://b"], replica_id="ra", **kw)
+    b = GossipStateBackend(peers=["http://a"], replica_id="rb", **kw)
+    return a, b
+
+
+def test_gossip_membership_and_admission_share():
+    a, b = _pair(peer_timeout=1.0)
+    assert a.live_replica_count() == 1 and a.admission_share() == 1.0
+    assert a._apply(b.digest()) is True
+    assert a.live_replica_count() == 2 and a.admission_share() == 0.5
+    # Own echo (DNS handing back our own address) is rejected.
+    assert a._apply(a.digest()) is False
+    # The peer ages out after peer_timeout: share is reclaimed.
+    a._peers["rb"].seen -= 10.0
+    assert a.live_replica_count() == 1 and a.admission_share() == 1.0
+
+
+def test_gossip_merges_endpoints_stats_breakers():
+    a, b = _pair()
+    b.register_provider("endpoints", lambda: ["http://e2", "http://e1"])
+    b.register_provider(
+        "request_stats", lambda: {"http://e1": {"qps": 2.0, "in_prefill": 1}}
+    )
+    b.register_provider("breakers", lambda: {"http://e1": "open"})
+    a._apply(b.digest())
+    assert a.merged_endpoint_urls(["http://e3"]) == [
+        "http://e1", "http://e2", "http://e3",
+    ]
+    assert a.peer_request_stats()["rb"]["http://e1"]["qps"] == 2.0
+    assert a.remote_breaker_state("http://e1") == "open"
+    assert a.remote_breaker_state("http://e2") is None
+    # A dead peer's verdicts stop counting (no permanent fencing).
+    a._peers["rb"].seen -= 100.0
+    assert a.remote_breaker_state("http://e1") is None
+
+
+def test_gossip_prefix_inserts_replicate_once():
+    a, b = _pair()
+    a.publish_prefix_insert([11, 22], "http://e1")
+    a.publish_prefix_insert([33], "http://e2")
+    b._apply(a.digest())
+    assert b.drain_prefix_inserts() == [([11, 22], "http://e1"), ([33], "http://e2")]
+    # Digests re-carry the sliding window; seq tracking dedupes.
+    b._apply(a.digest())
+    assert b.drain_prefix_inserts() == []
+    a.publish_prefix_insert([44], "http://e1")
+    b._apply(a.digest())
+    assert b.drain_prefix_inserts() == [([44], "http://e1")]
+
+
+def test_gossip_journal_checkpoint_claim_once():
+    a, b = _pair(peer_timeout=1.0)
+    a.checkpoint_journal("req-1", {"text": "tok0 ", "delivered_tokens": 1})
+    b._apply(a.digest())
+    # Owner alive: not claimable.
+    assert b.claim_remote_journal("req-1") is None
+    # Owner never claims its own journal.
+    assert a.claim_remote_journal("req-1") is None
+    # Owner dies (ages out): claim once, then gone fleet-wide.
+    b._peers["ra"].seen -= 10.0
+    claimed = b.claim_remote_journal("req-1")
+    assert claimed == {"snap": {"text": "tok0 ", "delivered_tokens": 1}}
+    assert b.claim_remote_journal("req-1") is None
+    # The claim gossips a drop so a third replica cannot double-claim.
+    assert "req-1" in b.digest()["drops"]
+
+
+def test_gossip_journal_drop_beats_checkpoint():
+    a, b = _pair()
+    a.checkpoint_journal("req-2", {"text": "x"})
+    b._apply(a.digest())
+    a.drop_journal("req-2")
+    b._apply(a.digest())
+    b._peers["ra"].seen -= 100.0
+    assert b.claim_remote_journal("req-2") is None
+
+
+def test_gossip_stale_checkpoint_claims_as_stale():
+    a, b = _pair(peer_timeout=0.5, journal_ttl=1.0)
+    a.checkpoint_journal("req-3", {"text": "y"})
+    b._apply(a.digest())
+    b._peers["ra"].seen -= 100.0
+    b._journals["req-3"].seen -= 100.0
+    assert b.claim_remote_journal("req-3") == {"stale": True}
+
+
+def test_gossip_synced_gate():
+    b = GossipStateBackend(peers=["http://dead:1"], replica_id="solo",
+                           ready_grace=0.05)
+    assert b.synced() is False  # peers configured, none reached yet
+    b._started = time.monotonic() - 1.0
+    assert b.synced() is True  # grace elapsed: a lone survivor serves
+    none = GossipStateBackend(peers=[], replica_id="nopeers")
+    assert none.synced() is True
+
+
+# ---------------------------------------------------------------------------
+# Gossip over real HTTP (two backends, one event loop)
+# ---------------------------------------------------------------------------
+
+
+async def _gossip_site(backend):
+    app = web.Application()
+
+    async def handler(request):
+        return web.json_response(backend.exchange(await request.json()))
+
+    app.router.add_post(GOSSIP_PATH, handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_gossip_http_round_converges_and_detects_death():
+    ra = GossipStateBackend(peers=[], replica_id="ra",
+                            sync_interval=0.05, peer_timeout=0.4)
+    runner_a, url_a = await _gossip_site(ra)
+    rb = GossipStateBackend(peers=[url_a], replica_id="rb",
+                            sync_interval=0.05, peer_timeout=0.4)
+    try:
+        await rb.start()
+        await asyncio.sleep(0.3)
+        assert rb.synced() is True
+        assert rb.live_replica_count() == 2
+        assert ra.live_replica_count() == 2  # symmetric exchange
+        assert rb.admission_share() == 0.5
+        # Kill A's server: B must notice within the peer timeout.
+        await runner_a.cleanup()
+        await asyncio.sleep(0.8)
+        assert rb.live_replica_count() == 1
+        assert rb.admission_share() == 1.0
+    finally:
+        await rb.close()
+
+
+# ---------------------------------------------------------------------------
+# Consumer integration: admission, breakers, stats, ring
+# ---------------------------------------------------------------------------
+
+
+def test_admission_share_rescales_bucket():
+    ctl = AdmissionController(
+        rate=10.0, burst=4, state_backend=_StubBackend(admission_share=0.5)
+    )
+    ctl._apply_share()
+    assert ctl.bucket.rate == 5.0
+    assert ctl.bucket.capacity == 2.0
+    assert ctl.bucket.tokens <= 2.0
+    # Share back to 1.0 (peer died): full rate again.
+    ctl.state_backend.answers["admission_share"] = 1.0
+    ctl._apply_share()
+    assert ctl.bucket.rate == 10.0 and ctl.bucket.capacity == 4.0
+
+
+def test_admission_share_ignored_without_shared_backend():
+    ctl = AdmissionController(rate=10.0, burst=4,
+                              state_backend=InMemoryStateBackend())
+    ctl._apply_share()
+    assert ctl.bucket.rate == 10.0 and ctl.bucket.capacity == 4
+
+
+def test_breaker_remote_open_fences_fleetwide():
+    reg = CircuitBreakerRegistry(
+        state_backend=_StubBackend(breakers={"http://e1": "open"})
+    )
+    assert reg.would_allow("http://e1") is False
+    assert reg.allows("http://e1") is False
+    assert reg.would_allow("http://e2") is True
+    # Local-only filter still fails open when EVERYTHING is refused.
+    assert reg.filter_available(["http://e1"]) == ["http://e1"]
+    assert reg.filter_available(["http://e1", "http://e2"]) == ["http://e2"]
+    # half_open remotely does not fence (only open does).
+    reg2 = CircuitBreakerRegistry(
+        state_backend=_StubBackend(breakers={"http://e1": "half_open"})
+    )
+    assert reg2.would_allow("http://e1") is True
+
+
+def test_request_stats_fleet_merge(monkeypatch):
+    mon = RequestStatsMonitor(sliding_window_size=60.0)
+    now = time.time()
+    mon.on_new_request("http://e1", "r1", now)
+    stub = _StubBackend(peer_stats={
+        "peer": {
+            "http://e1": {"qps": 3.0, "in_prefill": 2, "finished": 7},
+            "http://e9": {"qps": 1.0, "in_prefill": 0, "finished": 1},
+        }
+    })
+    monkeypatch.setattr(
+        "production_stack_tpu.router.state._state_backend", stub
+    )
+    merged = mon.get_request_stats(now + 0.1)
+    assert merged["http://e1"].in_prefill_requests == 3  # 1 local + 2 peer
+    assert merged["http://e1"].finished_requests == 7
+    assert merged["http://e9"].qps == 1.0  # engine only a peer sees
+    local = mon.get_request_stats(now + 0.1, fleet=False)
+    assert local["http://e1"].in_prefill_requests == 1
+    assert "http://e9" not in local
+
+
+def test_bounded_load_ring_is_deterministic_and_sheds():
+    ring = ConsistentHashRing()
+    nodes = [f"http://e{i}" for i in range(4)]
+    ring.update(nodes)
+    key = "session-42"
+    primary = ring.get_node(key)
+    # Unloaded fleet: bounded pick == plain pick, on every "replica".
+    ring2 = ConsistentHashRing()
+    ring2.update(list(reversed(nodes)))
+    assert ring.get_node_bounded(key, {}) == primary
+    assert ring2.get_node_bounded(key, {}) == primary
+    # Hot-spot the primary: both replicas shed to the SAME successor.
+    loads = {primary: 100.0}
+    moved_1 = ring.get_node_bounded(key, loads)
+    moved_2 = ring2.get_node_bounded(key, loads)
+    assert moved_1 == moved_2 != primary
+    # Everyone saturated: fall back to the primary pick.
+    all_hot = {n: 100.0 for n in nodes}
+    assert ring.get_node_bounded(key, all_hot) == primary
+
+
+# ---------------------------------------------------------------------------
+# Full router apps in one process (the SingletonMeta kill, satellite)
+# ---------------------------------------------------------------------------
+
+
+async def _start_app(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+async def test_two_router_apps_no_request_stats_bleed():
+    """Two router replicas in ONE process: each app's injected monitor
+    records only its own traffic — impossible under the old SingletonMeta,
+    which is exactly why it had to die for multi-replica tests."""
+    engine_app = create_fake_engine_app(model=MODEL, speed=5000.0)
+    engine_runner, engine_port = await _start_app(engine_app)
+    engine_url = f"http://127.0.0.1:{engine_port}"
+    argv = [
+        "--service-discovery", "static",
+        "--static-backends", engine_url,
+        "--static-models", MODEL,
+        "--routing-logic", "roundrobin",
+    ]
+    app1 = create_app(parse_args(argv))
+    app2 = create_app(parse_args(argv))
+    runner1, port1 = await _start_app(app1)
+    runner2, port2 = await _start_app(app2)
+    try:
+        assert app1["request_stats_monitor"] is not app2["request_stats_monitor"]
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                async with s.post(
+                    f"http://127.0.0.1:{port1}/v1/completions",
+                    json={"model": MODEL, "prompt": f"p{i}", "max_tokens": 2},
+                ) as resp:
+                    assert resp.status == 200
+                    await resp.read()
+        stats1 = app1["request_stats_monitor"].get_request_stats(time.time())
+        stats2 = app2["request_stats_monitor"].get_request_stats(time.time())
+        assert stats1[engine_url].finished_requests == 3
+        assert stats2 == {}  # replica 2 saw nothing: no bleed
+    finally:
+        for runner in (runner2, runner1, engine_runner):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# /ready + router drain + takeover, against the real app
+# ---------------------------------------------------------------------------
+
+
+async def test_ready_and_router_drain_cycle():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{c.router_url}/ready") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["status"] == "ready"
+                assert body["state"]["backend"] == "memory"
+            async with s.post(f"{c.router_url}/router/drain") as r:
+                assert r.status == 200
+            async with s.get(f"{c.router_url}/ready") as r:
+                assert r.status == 503
+                assert (await r.json())["reason"] == "draining"
+                assert r.headers.get("X-PST-Router-Draining") == "1"
+            # New admission-path work is refused, visibly.
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "p", "max_tokens": 2},
+            ) as r:
+                assert r.status == 503
+                assert r.headers.get("X-PST-Router-Draining") == "1"
+                assert "X-Request-Id" in r.headers
+            # Liveness is unaffected: a draining replica is healthy.
+            async with s.get(f"{c.router_url}/health") as r:
+                assert r.status == 200
+            async with s.post(f"{c.router_url}/router/undrain") as r:
+                assert r.status == 200
+            async with s.get(f"{c.router_url}/ready") as r:
+                assert r.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": MODEL, "prompt": "p", "max_tokens": 2},
+            ) as r:
+                assert r.status == 200
+
+
+GOSSIP_ARGS = ["--state-backend", "gossip", "--stream-resume",
+               "--stream-resume-max-legs", "2"]
+
+
+def _journal_snap(rid_model=MODEL, delivered=3, max_tokens=8):
+    return {
+        "is_chat": False,
+        "request_json": {"model": rid_model, "prompt": "hello",
+                         "max_tokens": max_tokens, "stream": True},
+        "id": "cmpl-original", "created": 111, "model": rid_model,
+        "object": "text_completion",
+        "text": "".join(f"tok{i} " for i in range(delivered)),
+        "delivered_tokens": delivered, "finish_reason": None,
+        "usage": None, "legs": 0, "saw_role_delta": False,
+    }
+
+
+async def test_takeover_resumes_dead_replicas_stream():
+    """A streaming request retried with the same X-Request-Id after its
+    owning replica died is resumed from the gossiped checkpoint: the
+    client receives ONLY the missing suffix, spliced under the original
+    chunk identity, with exactly one [DONE]."""
+    async with Cluster(extra_args=GOSSIP_ARGS) as c:
+        backend = get_state_backend()
+        assert backend is not None and backend.shared
+        # A dead peer's checkpoint: owner unknown to the membership view
+        # == owner dead.
+        backend._journals["req-takeover"] = _Journal(
+            "dead-replica", _journal_snap(), time.time(), time.monotonic()
+        )
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json=_journal_snap()["request_json"],
+                headers={"X-Request-Id": "req-takeover"},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-PST-Stream-Takeover") == "1"
+                payload = (await resp.read()).decode()
+        assert payload.count("data: [DONE]") == 1
+        assert "stream_truncated" not in payload
+        texts, ids = [], set()
+        for line in payload.split("\n\n"):
+            if not line.startswith("data: ") or "[DONE]" in line:
+                continue
+            obj = json.loads(line[6:])
+            ids.add(obj.get("id"))
+            texts.append(obj["choices"][0].get("text") or "")
+        # Suffix only (tok3..tok7), under the ORIGINAL stream identity.
+        assert "".join(texts) == "tok3 tok4 tok5 tok6 tok7 "
+        assert ids == {"cmpl-original"}
+        # Claim-once: the checkpoint is gone.
+        assert backend.claim_remote_journal("req-takeover") is None
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{c.router_url}/metrics") as r:
+                metrics_text = await r.text()
+        assert 'pst_router_replica_takeovers_total{outcome="resumed"}' in (
+            metrics_text
+        )
+
+
+async def test_takeover_stale_checkpoint_truncates_visibly():
+    async with Cluster(extra_args=GOSSIP_ARGS) as c:
+        backend = get_state_backend()
+        entry = _Journal(
+            "dead-replica", _journal_snap(), time.time(),
+            time.monotonic() - backend.journal_ttl - 10,
+        )
+        backend._journals["req-stale"] = entry
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json=_journal_snap()["request_json"],
+                headers={"X-Request-Id": "req-stale"},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-PST-Stream-Takeover") == "1"
+                payload = (await resp.read()).decode()
+        # Visible truncation contract: in-band error + one [DONE], never a
+        # silent fresh generation under the old id.
+        assert "stream_truncated" in payload
+        assert payload.count("data: [DONE]") == 1
+
+
+async def test_gossip_endpoint_rejects_memory_backend():
+    async with Cluster() as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}{GOSSIP_PATH}", json={"replica": "x"}
+            ) as r:
+                assert r.status == 404
+
+
+async def test_gossip_endpoint_exchanges_digests():
+    async with Cluster(extra_args=GOSSIP_ARGS) as c:
+        async with aiohttp.ClientSession() as s:
+            peer_digest = {
+                "replica": "other", "ts": time.time(),
+                "endpoints": ["http://remote-engine"],
+                "stats": {}, "breakers": {}, "prefix": [],
+                "journals": {}, "drops": [],
+            }
+            async with s.post(
+                f"{c.router_url}{GOSSIP_PATH}", json=peer_digest
+            ) as r:
+                assert r.status == 200
+                mine = await r.json()
+        assert mine["replica"] == get_state_backend().replica_id()
+        # The router's own endpoint view rode along.
+        assert set(mine["endpoints"]) == set(c.engine_urls)
+        # And the peer is now live in the membership view.
+        assert get_state_backend().live_replica_count() == 2
+
+
+def test_parser_validates_state_flags():
+    base = ["--static-backends", "http://e:1", "--static-models", "m"]
+    args = parse_args(base + ["--state-backend", "gossip",
+                              "--state-peers", "http://p:1,dns://svc:80"])
+    assert args.state_backend == "gossip"
+    with pytest.raises(ValueError):
+        parse_args(base + ["--state-peers", "http://p:1"])  # memory backend
+    with pytest.raises(ValueError):
+        parse_args(base + ["--state-backend", "gossip",
+                           "--state-sync-interval", "0"])
+
+
+def test_initialize_resilience_wires_backend():
+    from production_stack_tpu.router import state as state_mod
+
+    argv = ["--static-backends", "http://e:1", "--static-models", "m",
+            "--state-backend", "gossip", "--admission-rate", "10"]
+    args = parse_args(argv)
+    backend = state_mod.initialize_state_backend(args)
+    initialize_resilience(args)
+    try:
+        reg = get_breaker_registry()
+        assert reg.state_backend is backend
+        # The breaker snapshot provider is registered for gossip rounds.
+        reg.get("http://e:1")
+        assert backend.digest()["breakers"] == {"http://e:1": "closed"}
+    finally:
+        reset_router_singletons()
