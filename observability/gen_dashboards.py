@@ -323,6 +323,26 @@ def fleet_dashboard():
         ('sum(rate(pst_router_replica_takeovers_total[5m])) by (outcome)',
          "takeover {{outcome}} /s"),
     ], 16, 100))
+    # Row 13 — Fleet routing (docs/router.md "Fleet routing"): the fused
+    # scoring policy's health. Score quantiles collapse when the fleet
+    # loses warm prefixes (churn) or KV headroom; spills/remaps show the
+    # bounded-load and session-eviction machinery actually working.
+    p.append(panel("Fleet routing: chosen-engine score (p50/p90)", [
+        ('histogram_quantile(0.5, sum(rate(pst_route_score_bucket[5m])) by (le))',
+         "score p50"),
+        ('histogram_quantile(0.9, sum(rate(pst_route_score_bucket[5m])) by (le))',
+         "score p90"),
+    ], 0, 107))
+    p.append(panel("Fleet routing: spills + session remaps", [
+        ('sum(rate(pst_route_spill_total[5m])) by (reason)',
+         "spill {{reason}} /s"),
+        ('sum(rate(pst_route_session_remap_total[5m])) by (reason)',
+         "remap {{reason}} /s"),
+    ], 8, 107))
+    p.append(panel("Fleet routing: kvserver lookups skipped", [
+        ('sum(rate(pst_route_lookup_skipped_total[5m])) by (reason)',
+         "skipped {{reason}} /s"),
+    ], 16, 107))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

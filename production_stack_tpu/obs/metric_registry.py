@@ -90,6 +90,11 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_stream_resume_success_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_resume_failures_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_truncated_total", COUNTER, "resilience/metrics.py"),
+    # --- router/routing/metrics.py: fleet routing ------------------------
+    MetricSpec("pst_route_score", HISTOGRAM, "router/routing/metrics.py"),
+    MetricSpec("pst_route_spill", COUNTER, "router/routing/metrics.py"),
+    MetricSpec("pst_route_session_remap", COUNTER, "router/routing/metrics.py"),
+    MetricSpec("pst_route_lookup_skipped", COUNTER, "router/routing/metrics.py"),
     # --- router/state/metrics.py: router HA / replication ----------------
     MetricSpec("pst_router_replica_peers", GAUGE, "router/state/metrics.py"),
     MetricSpec("pst_router_replica_sync", COUNTER, "router/state/metrics.py"),

@@ -53,16 +53,22 @@ from .service_discovery import (
     teardown_service_discovery,
 )
 from .state import (
+    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
     get_state_backend,
     initialize_state_backend,
     teardown_state_backend,
 )
-from .stats.engine_stats import get_engine_stats_scraper, initialize_engine_stats_scraper
+from .stats.engine_stats import (
+    EngineStatsScraper,
+    bind_engine_stats_scraper,
+    get_engine_stats_scraper,
+    initialize_engine_stats_scraper,
+    unbind_engine_stats_scraper,
+)
 from .stats.request_stats import (
     bind_request_stats_monitor,
-    get_request_stats_monitor,
     initialize_request_stats_monitor,
     unbind_request_stats_monitor,
 )
@@ -90,8 +96,11 @@ async def _log_stats_loop(app: web.Application, interval: float) -> None:
         await asyncio.sleep(interval)
         try:
             lines = ["", "=" * 60]
-            engine_stats = get_engine_stats_scraper().get_engine_stats()
-            request_stats = get_request_stats_monitor().get_request_stats(time.time())
+            # App-scoped, not the module default: the loop task runs
+            # outside any request context, and with several router apps
+            # in one process it must report ITS app's snapshot.
+            engine_stats = app["engine_stats_scraper"].get_engine_stats()
+            request_stats = app["request_stats_monitor"].get_request_stats(time.time())
             for ep in get_service_discovery().get_endpoint_info():
                 lines.append(f"Server: {ep.url} models={ep.model_names}")
                 es = engine_stats.get(ep.url)
@@ -182,6 +191,10 @@ async def state_middleware(request: web.Request, handler):
     token = (
         bind_request_stats_monitor(monitor) if monitor is not None else None
     )
+    scraper = request.app.get("engine_stats_scraper")
+    scraper_token = (
+        bind_engine_stats_scraper(scraper) if scraper is not None else None
+    )
     try:
         if (
             request.app.get("router_draining")
@@ -203,6 +216,8 @@ async def state_middleware(request: web.Request, handler):
             )
         return await handler(request)
     finally:
+        if scraper_token is not None:
+            unbind_engine_stats_scraper(scraper_token)
         if token is not None:
             unbind_request_stats_monitor(token)
 
@@ -357,10 +372,13 @@ def initialize_all(app: web.Application, args) -> None:
             decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
         )
 
-    initialize_engine_stats_scraper(args.engine_stats_interval)
-    # The monitor is an app-injected dependency (state_middleware binds it
-    # per request); initialize_* also sets the module default so
-    # background loops and single-app processes resolve the same instance.
+    # Scraper and monitor are app-injected dependencies (state_middleware
+    # binds both per request); initialize_* also sets the module default
+    # so background loops and single-app processes resolve the same
+    # instance.
+    app["engine_stats_scraper"] = initialize_engine_stats_scraper(
+        args.engine_stats_interval
+    )
     monitor = initialize_request_stats_monitor(args.request_stats_window)
     app["request_stats_monitor"] = monitor
     backend.register_provider(PROVIDER_REQUEST_STATS, monitor.sync_snapshot)
@@ -368,15 +386,29 @@ def initialize_all(app: web.Application, args) -> None:
         PROVIDER_ENDPOINTS,
         lambda: get_service_discovery().get_endpoint_urls(),
     )
-    initialize_routing_logic(
+    router = initialize_routing_logic(
         RoutingLogic(args.routing_logic),
         session_key=args.session_key,
         kv_aware_threshold=args.kv_aware_threshold,
         controller_url=args.cache_controller_url,
         tokenizer_name=args.tokenizer_name,
+        fleet_eviction_ratio=getattr(args, "fleet_eviction_ratio", 0.5),
+        fleet_load_factor=getattr(args, "fleet_load_factor", 2.0),
         prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
         decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
     )
+    # Fleet routing publishes its routed-in-flight loads to peer replicas
+    # (scoring's bounded-load view converges fleet-wide); policies without
+    # per-engine load state simply register nothing. THIS app's monitor is
+    # captured explicitly: the provider runs from the gossip loop, outside
+    # any request context, where the module default would be whichever app
+    # initialized last.
+    loads_provider = getattr(router, "local_loads_snapshot", None)
+    if loads_provider is not None:
+        backend.register_provider(
+            PROVIDER_ENDPOINT_LOADS,
+            lambda: loads_provider(monitor),
+        )
     initialize_resilience(args)
     initialize_request_tracing(
         enabled=getattr(args, "tracing", True),
@@ -445,7 +477,8 @@ def create_app(args) -> web.Application:
             connector=aiohttp.TCPConnector(limit=0),
         )
         await get_service_discovery().start()
-        await get_engine_stats_scraper().start()
+        # App-scoped (see on_cleanup): each app starts ITS OWN scraper.
+        await app["engine_stats_scraper"].start()
         # App-scoped, not the module global: with several router apps in
         # one process each must start (and later close) ITS OWN backend,
         # not whichever app initialized last.
@@ -485,7 +518,14 @@ def create_app(args) -> web.Application:
         if prober is not None:
             await prober.close()
         teardown_canary_prober()
-        get_engine_stats_scraper().close()
+        # Close the app's OWN scraper (not whichever app initialized the
+        # module default last); drop the default only if it is ours.
+        app["engine_stats_scraper"].close()
+        try:
+            if get_engine_stats_scraper() is app["engine_stats_scraper"]:
+                EngineStatsScraper.destroy()
+        except ValueError:
+            pass
         teardown_service_discovery()
         try:  # routers holding a long-lived client (kvaware) close it here
             router = get_routing_logic()

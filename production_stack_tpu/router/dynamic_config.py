@@ -41,6 +41,8 @@ class DynamicRouterConfig:
     routing_logic: Optional[str] = None
     session_key: Optional[str] = None
     kv_aware_threshold: Optional[int] = None
+    fleet_eviction_ratio: Optional[float] = None
+    fleet_load_factor: Optional[float] = None
     cache_controller_url: Optional[str] = None
     prefill_model_labels: Optional[str] = None
     decode_model_labels: Optional[str] = None
@@ -82,14 +84,34 @@ def reconfigure_all(config: DynamicRouterConfig, args, app) -> None:
             label_selector=merged.get("k8s_label_selector"),
             k8s_service_discovery_type=merged.get("k8s_service_discovery_type", "pod-ip"),
         )
-    reconfigure_routing_logic(
+    router = reconfigure_routing_logic(
         RoutingLogic(merged.get("routing_logic", "roundrobin")),
         session_key=merged.get("session_key"),
         kv_aware_threshold=merged.get("kv_aware_threshold"),
         controller_url=merged.get("cache_controller_url"),
+        fleet_eviction_ratio=merged.get("fleet_eviction_ratio"),
+        fleet_load_factor=merged.get("fleet_load_factor"),
         prefill_model_labels=parse_comma_separated(merged.get("prefill_model_labels")) or None,
         decode_model_labels=parse_comma_separated(merged.get("decode_model_labels")) or None,
     )
+    # Keep the state backend's endpoint-loads provider pointing at the
+    # CURRENT policy: a hot-switch to fleet must start publishing loads
+    # to peer replicas, and a switch away must stop gossiping the
+    # destroyed router's view.
+    from .state import PROVIDER_ENDPOINT_LOADS, get_state_backend
+
+    backend = get_state_backend()
+    if backend is not None:
+        loads_provider = getattr(router, "local_loads_snapshot", None)
+        monitor = app.get("request_stats_monitor") if app is not None else None
+        if loads_provider is None:
+            backend.register_provider(PROVIDER_ENDPOINT_LOADS, lambda: {})
+        else:
+            # Same app-scoped monitor capture as create_app: the provider
+            # runs in the gossip loop, outside any request context.
+            backend.register_provider(
+                PROVIDER_ENDPOINT_LOADS, lambda: loads_provider(monitor)
+            )
     logger.info("dynamic config applied: %s", config)
 
 

@@ -67,11 +67,25 @@ def build_parser() -> argparse.ArgumentParser:
     # Routing
     p.add_argument(
         "--routing-logic",
-        choices=["roundrobin", "session", "kvaware", "prefixaware", "disaggregated_prefill"],
+        choices=["roundrobin", "session", "kvaware", "prefixaware",
+                 "disaggregated_prefill", "fleet"],
         default="roundrobin",
     )
     p.add_argument("--session-key", default=None)
     p.add_argument("--kv-aware-threshold", type=int, default=2000)
+    # Fleet routing (docs/router.md "Fleet routing"): score = expected
+    # prefix-hit tokens × KV headroom × canary health, argmax under
+    # bounded loads; sessions pin until their engine's score decays.
+    p.add_argument("--fleet-eviction-ratio", type=float, default=0.5,
+                   help="a pinned session stays on its engine while that "
+                        "engine's score is at least this fraction of the "
+                        "best candidate's; below it the session remaps "
+                        "through the consistent-hash ring (fleet routing)")
+    p.add_argument("--fleet-load-factor", type=float, default=2.0,
+                   help="bounded-load factor c: fleet routing never picks "
+                        "an engine whose in-flight load exceeds c x the "
+                        "mean candidate load (spills to the next-best "
+                        "scorer instead)")
     p.add_argument("--cache-controller-url", default=None, help="KV cache controller base URL (kvaware routing)")
     p.add_argument("--tokenizer-name", default=None, help="tokenizer for kvaware prefix hashing (defaults to request model)")
     p.add_argument("--prefill-model-labels", default=None)
@@ -284,6 +298,10 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--state-peers requires --state-backend gossip")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
+    if not (0.0 < args.fleet_eviction_ratio <= 1.0):
+        raise ValueError("--fleet-eviction-ratio must be in (0, 1]")
+    if args.fleet_load_factor <= 1.0:
+        raise ValueError("--fleet-load-factor must be > 1")
     if args.routing_logic == "disaggregated_prefill":
         if not (args.prefill_model_labels and args.decode_model_labels):
             raise ValueError(

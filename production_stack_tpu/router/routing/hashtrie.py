@@ -100,6 +100,44 @@ class HashTrie:
             best = set(eps)
         return matched_chars, best
 
+    async def match_depths(
+        self,
+        text: str,
+        available: Optional[Set[str]] = None,
+        max_chunks: int = 64,
+    ) -> Dict[str, int]:
+        """Per-endpoint matched depth (chars) along ``text``'s chunk path.
+
+        Unlike :meth:`longest_prefix_match` (which only reports the
+        deepest node's endpoint set), this returns how deep EVERY
+        available endpoint matches — the per-engine expected-hit input
+        fleet scoring multiplies against KV headroom and canary health.
+        The walk stops where no available endpoint remains on the path,
+        same rule as ``longest_prefix_match``; bounded at ``max_chunks``
+        so scoring cost stays O(1) in prompt length.
+        """
+        node = self.root
+        depths: Dict[str, int] = {}
+        text_len = len(text)
+        for i, h in enumerate(self._chunks(text)):
+            if i >= max_chunks:
+                break
+            child = node.children.get(h)
+            if child is None:
+                break
+            eps = (
+                child.endpoints if available is None
+                else child.endpoints & available
+            )
+            if not eps:
+                break
+            matched = min((i + 1) * self.chunk_size, text_len)
+            for ep in eps:
+                depths[ep] = matched
+            node = child
+            node.last_access = time.monotonic()
+        return depths
+
     async def remove_endpoint(self, endpoint: str) -> None:
         """Drop a disappeared endpoint from the whole trie.
 

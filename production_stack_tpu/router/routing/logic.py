@@ -27,7 +27,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import xxhash
 
 from ...logging_utils import init_logger
-from ..hop import hop_headers
 from ...utils import SingletonABCMeta
 from ..service_discovery import EndpointInfo
 from .hashtrie import HashTrie
@@ -41,6 +40,7 @@ class RoutingLogic(enum.Enum):
     KVAWARE = "kvaware"
     PREFIXAWARE = "prefixaware"
     DISAGGREGATED_PREFILL = "disaggregated_prefill"
+    FLEET = "fleet"
 
 
 def extract_prompt_text(request_json: Dict[str, Any]) -> str:
@@ -164,6 +164,30 @@ class ConsistentHashRing:
             if len(seen) == len(self._nodes):
                 break
         return first_eligible
+
+
+# In-flight routing background tasks (trie evictions, reconfigure-time
+# client closes): asyncio keeps only weak task refs, so an unreferenced
+# eviction suspended on a node lock could be collected mid-walk and
+# leave the phantom engine the churn contract forbids.
+# pstlint: owned-by=task:_run_trie_eviction,reconfigure_routing_logic
+_EVICT_TASKS: set = set()
+
+
+def _run_trie_eviction(trie: HashTrie, url: str) -> None:
+    """Run ``trie.remove_endpoint(url)`` on the running loop (reference
+    held until done) or synchronously when no loop is running."""
+    import asyncio
+
+    coro = trie.remove_endpoint(url)
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:  # no loop (sync caller in tests/CLI)
+        asyncio.run(coro)
+        return
+    task = loop.create_task(coro)
+    _EVICT_TASKS.add(task)
+    task.add_done_callback(_EVICT_TASKS.discard)
 
 
 def apply_breaker_filter(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
@@ -365,72 +389,30 @@ class KvawareRouter(RoutingInterface):
     ):
         if getattr(self, "_initialized", False):
             return
+        from . import scoring
+
         self.controller_url = controller_url or "http://localhost:9000"
         self.session_key = session_key
         self.threshold = kv_aware_threshold
-        self.tokenizer_name = tokenizer_name
-        self._tokenizer = None
+        # Shared controller-lookup machinery (tokenize → chunk-hash →
+        # POST /lookup with hop-contract relay headers, one long-lived
+        # session): the same client fleet scoring uses.
+        self.lookup_client = scoring.KvLookupClient(
+            self.controller_url, tokenizer_name=tokenizer_name
+        )
         self._fallback_ring = ConsistentHashRing()
         self._rr = 0
-        self._session = None  # lazy long-lived ClientSession (hot path)
         self._initialized = True
 
-    def _get_tokenizer(self, model: str):
-        if self._tokenizer is None:
-            from ...engine.tokenizer import get_tokenizer
-
-            self._tokenizer = get_tokenizer(self.tokenizer_name or model)
-        return self._tokenizer
-
-    def _get_session(self):
-        """One long-lived ClientSession for controller lookups. Opening a
-        session (connector + cookie jar) per request is hot-path connection
-        churn — the reference reuses its shared client the same way."""
-        import aiohttp
-
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=2)
-            )
-        return self._session
-
     async def aclose(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
-        self._session = None
-
-    async def _lookup(
-        self, model: str, token_ids: List[int],
-        headers: Optional[Dict[str, str]] = None,
-    ) -> Dict[str, int]:
-        """Controller lookup: chunk-hash the prefix, return url->matched
-        tokens. The lookup happens while routing a live request, so the
-        request's id/trace context rides along (relay form of the hop
-        contract) — a slow controller shows up inside that request's
-        timeline instead of as unattributed routing latency."""
-        from ...kvcache.hashing import chunk_hashes
-
-        hashes = chunk_hashes(token_ids)
-        if not hashes:
-            return {}
-        session = self._get_session()
-        async with session.post(
-            f"{self.controller_url}/lookup",
-            json={"model": model, "hashes": hashes},
-            headers=hop_headers(from_headers=headers or {}),
-        ) as resp:
-            resp.raise_for_status()
-            data = await resp.json()
-        return {k: int(v) for k, v in (data.get("matches") or {}).items()}
+        await self.lookup_client.aclose()
 
     async def route_request(self, endpoints, engine_stats, request_stats, headers, request_json=None) -> str:
         request_json = request_json or {}
         model = request_json.get("model", "")
         text = extract_prompt_text(request_json)
         try:
-            tokenizer = self._get_tokenizer(model)
-            token_ids = tokenizer.encode(text)
-            matches = await self._lookup(model, token_ids, headers)
+            matches = await self.lookup_client.lookup(model, text, headers)
         except Exception as e:  # noqa: BLE001 — controller down → fallback
             logger.debug("kvaware lookup failed, falling back: %s", e)
             matches = {}
@@ -495,6 +477,297 @@ class PrefixAwareRouter(RoutingInterface):
             )
         return selected
 
+    def evict_endpoint(self, url: str) -> None:
+        """Same one-step churn contract as FleetRouter: a removed engine
+        leaves the trie immediately instead of lingering as a phantom
+        deepest match."""
+        _run_trie_eviction(self.hashtrie, url)
+
+
+class FleetRouter(RoutingInterface):
+    """Fused fleet routing: argmax of (expected prefix-hit tokens × KV
+    headroom × canary-TTFT health) under a bounded-load constraint.
+
+    One policy where the fleet previously had to choose between cache
+    affinity (``prefixaware``/``kvaware``, which hot-spot a popular
+    prefix onto one saturated engine) and load balance (``roundrobin``/
+    ``session``, which throw away the prefix-hit rate). Scoring math
+    lives in :mod:`.scoring`; this class orchestrates the decision:
+
+    - Hit estimates come from the LOCAL hashtrie (zero extra hops); the
+      kvserver ``/lookup`` is consulted only for prompts above the
+      kvaware token threshold that the trie cannot already prove hot —
+      below the threshold routing performs no network I/O at all.
+    - KV headroom and canary TTFT read the already-running scraper and
+      canary snapshots (no new blocking I/O per request).
+    - The best scorer is skipped when its load exceeds ``load_factor ×``
+      the mean candidate load (``pst_route_spill_total{reason}``) — the
+      same bound `ConsistentHashRing.get_node_bounded` applies, so the
+      score spill and the session-ring spill agree.
+    - A session header pins the session's engine until its score decays
+      below ``eviction_ratio ×`` the best score, it crosses the load
+      bound, or it leaves the candidate set (draining / breaker-open /
+      removed); the session then remaps THROUGH THE RING within that one
+      routing decision (``pst_route_session_remap_total{reason}``) and
+      the trie learns the new home on the same request.
+    - Under a shared state backend the trie merges peers' replicated
+      inserts, the ring hashes over the fleet-wide endpoint view, and
+      loads include every live peer's published routed-in-flight counts
+      (``peer_endpoint_loads``) so replicas spill identically.
+    - Discovery removing an engine calls :meth:`evict_endpoint`: trie,
+      session pins, and ring view drop it in one step (churn contract).
+    """
+
+    def __init__(
+        self,
+        session_key: Optional[str] = None,
+        controller_url: Optional[str] = None,
+        kv_aware_threshold: int = 2000,
+        tokenizer_name: Optional[str] = None,
+        eviction_ratio: float = 0.5,
+        load_factor: float = 2.0,
+    ):
+        if getattr(self, "_initialized", False):
+            return
+        from . import scoring
+
+        self.session_key = session_key
+        self.threshold = kv_aware_threshold
+        self.eviction_ratio = eviction_ratio
+        self.load_factor = load_factor
+        self.hashtrie = HashTrie()
+        # One depth bound for every trie touch (match, insert, replicated
+        # hash path): deep enough that the "local trie proves a hit above
+        # threshold" lookup skip can fire, and a hard cap so a 500KB
+        # prompt costs O(bound) trie nodes on the hot path — never O(len).
+        self._max_chunks = max(
+            64,
+            int(self.threshold * scoring.CHARS_PER_TOKEN
+                / self.hashtrie.chunk_size) + 1,
+        )
+        self.ring = ConsistentHashRing()
+        self.pins = scoring.SessionPins()
+        self.lookup_client = (
+            scoring.KvLookupClient(controller_url, tokenizer_name=tokenizer_name)
+            if controller_url else None
+        )
+        # Last computed scoring inputs, kept for the state backend's
+        # endpoint-loads provider (gossiped to peer replicas) and for
+        # introspection/tests. Single-writer: the routing decision path
+        # (plus churn eviction dropping a removed engine's entries).
+        # pstlint: owned-by=task:route_request,evict_endpoint
+        self._last_scores: Dict[str, float] = {}
+        # pstlint: owned-by=task:route_request,evict_endpoint
+        self._last_loads: Dict[str, float] = {}
+        self._initialized = True
+
+    async def aclose(self) -> None:
+        if self.lookup_client is not None:
+            await self.lookup_client.aclose()
+
+    # -- scoring inputs ----------------------------------------------------
+
+    def local_loads_snapshot(self, monitor=None) -> Dict[str, float]:
+        """This replica's own routed-in-flight count per engine — the
+        payload the state backend publishes to peer replicas so the
+        bounded-load view converges fleet-wide.
+
+        ``monitor`` pins the APP-SCOPED stats monitor: the provider runs
+        from the gossip backend's background task, where the per-request
+        contextvar is unbound and the module default would resolve to
+        whichever app initialized last (the multi-app bleed the scraper
+        de-singletonization fixes elsewhere in this PR). ``create_app``
+        registers the provider with its own monitor captured."""
+        if monitor is None:
+            from ..stats.request_stats import get_request_stats_monitor
+
+            try:
+                monitor = get_request_stats_monitor()
+            except ValueError:
+                # Monitor not initialized (unit harness / teardown race):
+                # publish NOTHING — republishing any merged view as "our
+                # own traffic" would double-count peers' loads.
+                return {}
+        stats = monitor.get_request_stats(fleet=False)
+        return {
+            url: float(rs.in_prefill_requests + rs.in_decoding_requests)
+            for url, rs in stats.items()
+        }
+
+    def _canary_ttfts(self) -> Dict[str, float]:
+        from ..services.canary import get_canary_prober
+
+        prober = get_canary_prober()
+        if prober is None:
+            return {}
+        return prober.ttft_view()
+
+    async def _hit_tokens(
+        self,
+        prompt: str,
+        urls: List[str],
+        model: str,
+        headers: Dict[str, str],
+    ) -> Dict[str, float]:
+        from . import metrics, scoring
+
+        depths = await self.hashtrie.match_depths(
+            prompt, set(urls), max_chunks=self._max_chunks
+        )
+        hit_tokens = {
+            u: depths.get(u, 0) / scoring.CHARS_PER_TOKEN for u in urls
+        }
+        best_local = max(hit_tokens.values(), default=0.0)
+        # The kvserver hop is gated THREE ways: a controller must be
+        # configured, the prompt must be above the kvaware threshold
+        # (short prompts can't hold threshold-many cached tokens — the
+        # hot path stays network-free), and the local trie must not
+        # already prove a hit that big.
+        if self.lookup_client is None:
+            metrics.lookup_skipped_total.labels(reason="disabled").inc()
+            return hit_tokens
+        if len(prompt) / scoring.CHARS_PER_TOKEN < self.threshold:
+            metrics.lookup_skipped_total.labels(
+                reason="below_threshold"
+            ).inc()
+            return hit_tokens
+        if best_local >= self.threshold:
+            metrics.lookup_skipped_total.labels(reason="local_hit").inc()
+            return hit_tokens
+        try:
+            matches = await self.lookup_client.lookup(model, prompt, headers)
+        except Exception as e:  # noqa: BLE001 — controller down → local view
+            logger.debug("fleet kvserver lookup failed, scoring locally: %s", e)
+            return hit_tokens
+        for url, tokens in matches.items():
+            if url in hit_tokens:
+                hit_tokens[url] = max(hit_tokens[url], tokens)
+        return hit_tokens
+
+    # -- the decision ------------------------------------------------------
+
+    async def route_request(self, endpoints, engine_stats, request_stats, headers, request_json=None) -> str:
+        from ..state import get_state_backend
+        from . import metrics, scoring
+
+        request_json = request_json or {}
+        prompt = extract_prompt_text(request_json)
+        model = request_json.get("model", "")
+        urls = [e.url for e in endpoints]
+        backend = get_state_backend()
+        shared = backend is not None and backend.shared
+        if shared:
+            # Apply peers' replicated trie insertions before matching and
+            # hash the session ring over the fleet-wide endpoint view —
+            # replicas whose discovery views momentarily diverge still
+            # map a session identically (the pick stays constrained to
+            # THIS request's filtered candidates).
+            for path, ep in backend.drain_prefix_inserts():
+                await self.hashtrie.insert_hashes(path, ep)
+            self.ring.update(backend.merged_endpoint_urls(urls))
+        else:
+            self.ring.update(urls)
+
+        hit_tokens = await self._hit_tokens(prompt, urls, model, headers)
+        peers_backend = backend if shared else None
+        try:
+            from ..stats.request_stats import get_request_stats_monitor
+
+            local_stats = get_request_stats_monitor().get_request_stats(
+                fleet=False
+            )
+        except ValueError:
+            # No resolvable monitor (unit harness / teardown race): the
+            # caller-passed stats are the FLEET-merged view, so peers are
+            # already in it — adding peer_endpoint_loads on top would
+            # double-count every peer's traffic.
+            local_stats = request_stats or {}
+            peers_backend = None
+        loads = scoring.fleet_loads(urls, local_stats, peers_backend)
+        scores = scoring.score_engines(
+            urls, hit_tokens, engine_stats or {}, self._canary_ttfts()
+        )
+        bound = scoring.load_bound(loads, urls, self.load_factor)
+        self._last_scores = dict(scores)
+        self._last_loads = dict(loads)
+
+        session_id = _header(headers, self.session_key)
+        if session_id is not None:
+            selected = self._route_session(
+                session_id, urls, scores, loads, bound
+            )
+        else:
+            selected, spill = scoring.pick_bounded(scores, loads, bound)
+            if spill is not None:
+                metrics.spill_total.labels(reason=spill).inc()
+        metrics.route_score.observe(max(scores.get(selected, 0.0), 0.0))
+        # Insert bounded at the same depth the match walk reads: chunks
+        # past _max_chunks would be pure write/lock cost no reader (local
+        # match or replicated hash path) ever consumes.
+        bounded = prompt[: self._max_chunks * self.hashtrie.chunk_size]
+        await self.hashtrie.insert(bounded, selected)
+        if shared:
+            backend.publish_prefix_insert(
+                self.hashtrie.hash_path(bounded, max_chunks=self._max_chunks),
+                selected,
+            )
+        return selected
+
+    def _route_session(
+        self,
+        session_id: str,
+        urls: List[str],
+        scores: Dict[str, float],
+        loads: Dict[str, float],
+        bound: float,
+    ) -> str:
+        from . import metrics, scoring
+
+        pinned = self.pins.get(session_id)
+        best_score = max(scores.values(), default=0.0)
+        if pinned is not None and pinned in scores:
+            decayed = scores[pinned] < self.eviction_ratio * best_score
+            overloaded = loads.get(pinned, 0.0) >= bound
+            if not decayed and not overloaded:
+                self.pins.pin(session_id, pinned)
+                return pinned
+            metrics.session_remap_total.labels(
+                reason="score_decay" if decayed else "overload"
+            ).inc()
+        elif pinned is not None:
+            # The pinned engine is no longer routable (draining, breaker
+            # open, removed by discovery): remap within THIS decision.
+            metrics.session_remap_total.labels(reason="unroutable").inc()
+        remapped = self.ring.get_node_bounded(
+            session_id, loads, c=self.load_factor, allowed=set(urls)
+        )
+        if remapped is None or remapped not in scores:
+            remapped, spill = scoring.pick_bounded(scores, loads, bound)
+            if spill is not None:
+                metrics.spill_total.labels(reason=spill).inc()
+        if pinned is not None and remapped == pinned:
+            # The ring handed the evicted session straight back (e.g. the
+            # whole fleet is saturated): take the best scorer instead so
+            # eviction always actually moves the session.
+            others = {u: s for u, s in scores.items() if u != pinned}
+            if others:
+                remapped, _ = scoring.pick_bounded(
+                    others, loads, bound
+                )
+        self.pins.pin(session_id, remapped)
+        return remapped
+
+    # -- churn -------------------------------------------------------------
+
+    def evict_endpoint(self, url: str) -> None:
+        """Discovery removed an engine: drop it from the trie, the
+        session-pin table, and the cached scoring views in one step, so
+        no routing decision after this call can still prefer it."""
+        self.pins.drop_endpoint(url)
+        self._last_scores.pop(url, None)
+        self._last_loads.pop(url, None)
+        _run_trie_eviction(self.hashtrie, url)
+
 
 class DisaggregatedPrefillRouter(RoutingInterface):
     """Split prefill and decode across disjoint engine pools by model label."""
@@ -543,7 +816,30 @@ _ROUTER_CLASSES = (
     KvawareRouter,
     PrefixAwareRouter,
     DisaggregatedPrefillRouter,
+    FleetRouter,
 )
+
+
+def evict_routing_endpoint(url: str) -> None:
+    """Discovery-driven churn, one step: when an engine leaves the fleet
+    (pod deleted, static backend failed its health probe), the active
+    routing policy drops it from its trie/session-pin/score state — and
+    the canary prober forgets its TTFT sample (a departed fast engine
+    must not anchor the relative-health baseline forever) — immediately,
+    the breaker/stats eviction's routing-side counterpart. No-op when
+    routing is uninitialized or the policy keeps no per-engine state."""
+    from ..services.canary import get_canary_prober
+
+    prober = get_canary_prober()
+    if prober is not None:
+        prober.evict(url)
+    try:
+        router = get_routing_logic()
+    except ValueError:
+        return
+    evict = getattr(router, "evict_endpoint", None)
+    if evict is not None:
+        evict(url)
 
 
 def initialize_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
@@ -564,10 +860,38 @@ def initialize_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingIn
         return DisaggregatedPrefillRouter(
             kwargs.get("prefill_model_labels"), kwargs.get("decode_model_labels")
         )
+    if routing_logic == RoutingLogic.FLEET:
+        return FleetRouter(
+            session_key=kwargs.get("session_key"),
+            controller_url=kwargs.get("controller_url"),
+            kv_aware_threshold=kwargs.get("kv_aware_threshold") or 2000,
+            tokenizer_name=kwargs.get("tokenizer_name"),
+            eviction_ratio=kwargs.get("fleet_eviction_ratio") or 0.5,
+            load_factor=kwargs.get("fleet_load_factor") or 2.0,
+        )
     raise ValueError(f"invalid routing logic {routing_logic}")
 
 
 def reconfigure_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
+    import asyncio
+
+    try:
+        old = get_routing_logic()
+    except ValueError:
+        old = None
+    # Routers holding a long-lived client session (kvaware, fleet) must
+    # release it on hot reload, not only at app shutdown — otherwise
+    # every dynamic-config apply leaks a connector.
+    aclose = getattr(old, "aclose", None)
+    if aclose is not None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            asyncio.run(aclose())
+        else:
+            task = loop.create_task(aclose())
+            _EVICT_TASKS.add(task)
+            task.add_done_callback(_EVICT_TASKS.discard)
     for cls in _ROUTER_CLASSES:
         cls.destroy()
     return initialize_routing_logic(routing_logic, **kwargs)

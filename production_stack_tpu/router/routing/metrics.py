@@ -1,0 +1,43 @@
+"""Prometheus surface for fleet routing (``pst_route_*``).
+
+Declared in ``obs/metric_registry.py`` and documented in
+docs/observability.md ("Fleet routing" rows); the ``metric-registry``
+pstlint check enforces the triangle.
+"""
+
+from prometheus_client import Counter, Histogram
+
+# Score units are expected prefix-hit tokens (damped by headroom and
+# canary health), so the buckets span "cold engine" (~the cold base) to
+# "whole long context cached".
+route_score = Histogram(
+    "pst_route_score",
+    "Fleet-routing score of the chosen engine per routing decision "
+    "(expected prefix-hit tokens × KV headroom × canary health)",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536),
+)
+spill_total = Counter(
+    "pst_route_spill",
+    "Routing decisions where the best-scoring engine was NOT picked, by "
+    "reason (load = best scorer above the bounded-load limit, spilled to "
+    "the next-best; saturated = every candidate above the limit, "
+    "fail-open to the best scorer)",
+    ["reason"],
+)
+session_remap_total = Counter(
+    "pst_route_session_remap",
+    "Sticky sessions remapped off their pinned engine, by reason "
+    "(unroutable = pin filtered out: draining/breaker-open/removed; "
+    "score_decay = pin's score fell below the eviction ratio; "
+    "overload = pin above the bounded-load limit)",
+    ["reason"],
+)
+lookup_skipped_total = Counter(
+    "pst_route_lookup_skipped",
+    "Routing decisions that did NOT consult the kvserver /lookup, by "
+    "reason (below_threshold = prompt under the kvaware token threshold "
+    "— the zero-extra-hop common case; local_hit = the local trie "
+    "already proves a hit above threshold; disabled = no controller "
+    "configured)",
+    ["reason"],
+)

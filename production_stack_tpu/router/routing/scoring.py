@@ -1,0 +1,264 @@
+"""Fleet-routing scoring: one policy over affinity × headroom × health.
+
+The score the :class:`~.logic.FleetRouter` maximizes per routing decision:
+
+    score(e) = (COLD_BASE_TOKENS + expected_hit_tokens(e))
+               × kv_headroom(e) × canary_health(e)
+
+- ``expected_hit_tokens`` comes from the LOCAL prefix hashtrie first
+  (``HashTrie.match_depths`` — zero extra hops on the hot path) with the
+  kvserver ``/lookup`` consulted only when the prompt is above the
+  kvaware token threshold AND the trie cannot already prove a hit that
+  big (:class:`KvLookupClient`). Below the threshold routing NEVER
+  touches the network — asserted by a test that routes with the kvserver
+  unreachable.
+- ``kv_headroom`` is ``1 − pst_engine_kv_page_occupancy`` from the
+  engine-stats scrape snapshot (floored, never zeroed: an engine at 100%
+  occupancy is strongly demoted but the argmax stays defined when the
+  whole fleet is full).
+- ``canary_health`` compares the engine's last canary TTFT against the
+  fleet's best (an engine twice as slow as the best scores half); engines
+  without a probe yet score 1.0 — innocent until probed.
+
+Both headroom and health read the already-running scrape/canary
+snapshots: scoring adds **no new blocking I/O per request**.
+
+Loads for the bounded-load constraint are this replica's own routed
+in-flight requests plus every live peer replica's published loads
+(``StateBackend.peer_endpoint_loads``) — each replica contributes
+exactly its own traffic, so the fleet view converges without double
+counting and every replica sheds a hot-spotted engine the same way.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Sequence
+
+import aiohttp
+
+from ...logging_utils import init_logger
+from ..hop import hop_headers
+
+logger = init_logger(__name__)
+
+# Chars-per-token estimate for the char-chunked trie's hit depths (the
+# trie stores text chunks, the score speaks tokens).
+CHARS_PER_TOKEN = 4.0
+# Baseline "cold" token mass: engines with zero cached prefix still
+# differentiate on headroom × health instead of all scoring 0.
+COLD_BASE_TOKENS = 64.0
+# Headroom floor — demote, never annihilate (see module docstring).
+MIN_HEADROOM = 0.05
+# Health floor: one terrible canary sample must not erase a huge cached
+# prefix entirely.
+MIN_HEALTH = 0.05
+
+
+def kv_headroom(engine_stats: Optional[Any]) -> float:
+    """Free KV fraction from a scraped :class:`EngineStats` snapshot."""
+    occ = 0.0
+    if engine_stats is not None:
+        occ = float(getattr(engine_stats, "engine_kv_page_occupancy", 0.0))
+        if occ <= 0.0:
+            # Engines predating pst_engine_kv_page_occupancy still export
+            # the vllm-compatible usage gauge.
+            occ = float(getattr(engine_stats, "gpu_cache_usage_perc", 0.0))
+    return max(1.0 - min(max(occ, 0.0), 1.0), MIN_HEADROOM)
+
+
+def canary_health(
+    url: str, canary_ttfts: Dict[str, float]
+) -> float:
+    """Relative canary-TTFT health in (0, 1]; 1.0 when unprobed."""
+    ttft = canary_ttfts.get(url, 0.0)
+    if ttft <= 0.0:
+        return 1.0
+    best = min((t for t in canary_ttfts.values() if t > 0.0), default=0.0)
+    if best <= 0.0:
+        return 1.0
+    return max(min(best / ttft, 1.0), MIN_HEALTH)
+
+
+def score_engines(
+    urls: Sequence[str],
+    hit_tokens: Dict[str, float],
+    engine_stats: Dict[str, Any],
+    canary_ttfts: Dict[str, float],
+) -> Dict[str, float]:
+    """The fused score per candidate engine (see module docstring)."""
+    return {
+        url: (
+            (COLD_BASE_TOKENS + max(hit_tokens.get(url, 0.0), 0.0))
+            * kv_headroom(engine_stats.get(url))
+            * canary_health(url, canary_ttfts)
+        )
+        for url in urls
+    }
+
+
+def load_bound(loads: Dict[str, float], urls: Sequence[str],
+               factor: float) -> float:
+    """Bounded-load limit: ``c × max(mean load, 1)`` — the same rule as
+    ``ConsistentHashRing.get_node_bounded``, so the argmax spill and the
+    session-ring spill shed a hot engine at the same threshold."""
+    if not urls:
+        return factor
+    mean = sum(loads.get(u, 0.0) for u in urls) / len(urls)
+    return factor * max(mean, 1.0)
+
+
+def pick_bounded(
+    scores: Dict[str, float],
+    loads: Dict[str, float],
+    bound: float,
+) -> tuple:
+    """Argmax over scores subject to the bounded-load constraint.
+
+    Returns ``(url, spill_reason)`` where spill_reason is ``None`` (best
+    scorer picked), ``"load"`` (best was over the limit, spilled to the
+    next-best under it), or ``"saturated"`` (every candidate over the
+    limit — fail open to the best scorer; starving the whole fleet would
+    be worse than the hot spot).
+
+    Exact score ties (a cold fleet: no cached prefixes, equal headroom,
+    no canary samples) break by lowest load, then RANDOMLY — a
+    lexicographic tiebreak would funnel every cold prompt onto one
+    engine and the trie would then cement each prefix there, the exact
+    hot-spotting this policy exists to prevent. Randomness only decides
+    between engines the score genuinely cannot distinguish, so replica
+    determinism is lost only where there is no affinity to protect.
+    """
+    order = sorted(
+        scores,
+        key=lambda u: (-scores[u], loads.get(u, 0.0), random.random()),
+    )
+    best = order[0]
+    for url in order:
+        if loads.get(url, 0.0) < bound:
+            return url, (None if url == best else "load")
+    return best, "saturated"
+
+
+def fleet_loads(
+    urls: Sequence[str],
+    local_stats: Dict[str, Any],
+    backend: Optional[Any],
+) -> Dict[str, float]:
+    """Per-engine routed-in-flight load, fleet-wide.
+
+    ``local_stats`` is THIS replica's own (non-merged) request-stats
+    view; live peers' published loads add in through the state backend's
+    ``peer_endpoint_loads`` surface. Each replica contributes exactly its
+    own routed requests — no double counting — and the sum converges
+    across replicas within one gossip round.
+    """
+    loads: Dict[str, float] = {}
+    for url in urls:
+        rs = local_stats.get(url)
+        loads[url] = float(
+            getattr(rs, "in_prefill_requests", 0)
+            + getattr(rs, "in_decoding_requests", 0)
+        ) if rs is not None else 0.0
+    if backend is not None and getattr(backend, "shared", False):
+        for snap in backend.peer_endpoint_loads().values():
+            if not isinstance(snap, dict):
+                continue
+            for url, value in snap.items():
+                if url in loads:
+                    try:
+                        loads[url] += float(value)
+                    except (TypeError, ValueError):
+                        continue
+    return loads
+
+
+class KvLookupClient:
+    """The kvserver ``/lookup`` leg of scoring (above-threshold only).
+
+    One long-lived ClientSession (hot-path connection reuse, same
+    rationale as ``KvawareRouter``), short timeout, and the request's
+    id/trace context relayed on the hop so a slow controller shows up in
+    that request's timeline instead of as unattributed routing latency.
+    """
+
+    def __init__(self, controller_url: str, timeout: float = 2.0,
+                 tokenizer_name: Optional[str] = None) -> None:
+        self.controller_url = controller_url.rstrip("/")
+        self.timeout = timeout
+        self.tokenizer_name = tokenizer_name
+        self._tokenizer = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def _get_tokenizer(self, model: str):
+        if self._tokenizer is None:
+            from ...engine.tokenizer import get_tokenizer
+
+            self._tokenizer = get_tokenizer(self.tokenizer_name or model)
+        return self._tokenizer
+
+    def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout)
+            )
+        return self._session
+
+    async def aclose(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+    async def lookup(
+        self, model: str, text: str,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, float]:
+        """url → matched token count from the controller; raises on any
+        failure (the caller degrades to the local estimate)."""
+        from ...kvcache.hashing import chunk_hashes
+
+        token_ids = self._get_tokenizer(model).encode(text)
+        hashes = chunk_hashes(token_ids)
+        if not hashes:
+            return {}
+        session = self._get_session()
+        async with session.post(
+            f"{self.controller_url}/lookup",
+            json={"model": model, "hashes": hashes},
+            headers=hop_headers(from_headers=headers or {}),
+        ) as resp:
+            resp.raise_for_status()
+            data = await resp.json()
+        return {
+            k: float(v) for k, v in (data.get("matches") or {}).items()
+        }
+
+
+class SessionPins:
+    """Bounded session → engine pin table (LRU on every re-pin, so a
+    long-lived active session is never evicted before idle newcomers)."""
+
+    def __init__(self, max_pins: int = 8192) -> None:
+        self.max_pins = max_pins
+        # pstlint: owned-by=task:pin,drop_endpoint
+        self._pins: "OrderedDict[str, str]" = OrderedDict()
+
+    def get(self, session_id: str) -> Optional[str]:
+        return self._pins.get(session_id)
+
+    def pin(self, session_id: str, url: str) -> None:
+        self._pins[session_id] = url
+        self._pins.move_to_end(session_id)
+        while len(self._pins) > self.max_pins:
+            self._pins.popitem(last=False)
+
+    def drop_endpoint(self, url: str) -> None:
+        """An engine left the fleet: forget every pin to it in one step
+        so the very next request per session remaps through the ring."""
+        stale = [sid for sid, u in self._pins.items() if u == url]
+        for sid in stale:
+            self._pins.pop(sid, None)
+
+    def __len__(self) -> int:
+        return len(self._pins)

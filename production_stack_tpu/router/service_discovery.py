@@ -241,6 +241,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.decode_model_labels = decode_model_labels
         # pstlint: owned-by=task:_health_loop
         self._unhealthy: set = set()
+        # Consecutive failed health cycles per URL: routing-state eviction
+        # (trie/pins/canary) waits for a SECOND consecutive failure — one
+        # transient probe blip only unroutes the engine for a cycle and
+        # must not wipe its warm-prefix knowledge.
+        # pstlint: owned-by=task:_health_loop
+        self._unhealthy_streaks: Dict[str, int] = {}
         # pstlint: owned-by=task:_health_loop,check_backend,_drain_reconcile_loop,set_draining
         self._draining: set = set()  # urls reporting is_draining
         # pstlint: owned-by=task:_health_loop,check_backend,_drain_reconcile_loop,set_warming
@@ -312,8 +318,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
             "static health loop started: %d backends, every %.1fs",
             len(self.urls), self.health_check_interval,
         )
-        async def check_backend(session, url, model, mtype) -> Optional[str]:
-            """One backend's probe pass; returns its endpoint hash when
+        async def check_backend(session, url, model, mtype) -> Optional[tuple]:
+            """One backend's probe pass; returns (endpoint hash, url) when
             unhealthy. _draining is mutated per URL (never
             snapshot-replaced): set_draining() may mark an engine
             mid-cycle, and an end-of-cycle overwrite would erase that mark
@@ -344,7 +350,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
             self._feed_breaker(url, ok)
             if not ok:
                 logger.warning("%s at %s failed health check", model, url)
-                return self._endpoint_hash(url, model)
+                return self._endpoint_hash(url, model), url
             return None
 
         async with aiohttp.ClientSession() as session:
@@ -359,7 +365,25 @@ class StaticServiceDiscovery(ServiceDiscovery):
                             self.urls, self.models, self.model_types
                         )
                     ))
-                    self._unhealthy = {h for h in results if h is not None}
+                    hits = [r for r in results if r is not None]
+                    self._unhealthy = {h for h, _ in hits}
+                    # Routing-state eviction (the fleet-routing churn
+                    # contract: trie/pins/canary dropped in one step) on
+                    # the SECOND consecutive failed cycle: an engine that
+                    # really left stays failed, while a single probe blip
+                    # only unroutes it for one cycle — its warm-prefix
+                    # knowledge survives the recovery.
+                    from .routing.logic import evict_routing_endpoint
+
+                    failed_urls = {url for _, url in hits}
+                    for url in list(self._unhealthy_streaks):
+                        if url not in failed_urls:
+                            self._unhealthy_streaks.pop(url)
+                    for url in failed_urls:
+                        streak = self._unhealthy_streaks.get(url, 0) + 1
+                        self._unhealthy_streaks[url] = streak
+                        if streak == 2:
+                            evict_routing_endpoint(url)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001 — one bad cycle must
@@ -473,9 +497,12 @@ class _K8sWatcherBase(ServiceDiscovery):
     @staticmethod
     def _evict_breaker(url: str) -> None:
         """An engine left the fleet for good: drop its breaker, metric
-        series, and per-engine request-stat aggregates, or pod churn grows
-        all of them without bound."""
+        series, per-engine request-stat aggregates, AND its routing state
+        (prefix trie, session pins, cached scores) in one step — churn
+        must never leave a phantom engine as some prompt's deepest trie
+        match or some session's pin."""
         from ..resilience import get_breaker_registry
+        from .routing.logic import evict_routing_endpoint
         from .stats.request_stats import get_request_stats_monitor
 
         registry = get_breaker_registry()
@@ -485,6 +512,7 @@ class _K8sWatcherBase(ServiceDiscovery):
             get_request_stats_monitor().evict_url(url)
         except ValueError:
             pass  # monitor not initialized (unit-test harness)
+        evict_routing_endpoint(url)
 
     def get_health(self) -> bool:
         return self._task is not None and not self._task.done()

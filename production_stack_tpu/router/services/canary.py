@@ -55,10 +55,30 @@ class CanaryProber:
         # Probes completed / failed (tests + /health introspection).
         self.probes_total = 0
         self.failures_total = 0
+        # Last observed canary TTFT per engine URL — the health input
+        # fleet routing multiplies into its score (a failed probe records
+        # the probe timeout: "as slow as we ever waited"). Readers get a
+        # copy via ttft_view(); engines that leave the fleet are dropped
+        # via evict() (a departed fast engine must not skew the
+        # fleet-best reference forever).
+        # pstlint: owned-by=task:_probe_one,evict
+        self.last_ttft: dict = {}
 
     @property
     def enabled(self) -> bool:
         return self.interval > 0
+
+    def ttft_view(self) -> dict:
+        """Copy of the last canary TTFT per engine (seconds). Engines the
+        prober has not reached yet are absent — scoring treats them as
+        healthy rather than punishing the unprobed."""
+        return dict(self.last_ttft)
+
+    def evict(self, url: str) -> None:
+        """An engine left the fleet: forget its sample, or pod churn
+        grows the table without bound and a departed fast engine skews
+        the relative-health baseline for every survivor."""
+        self.last_ttft.pop(url, None)
 
     async def start(self) -> None:
         if not self.enabled or self._task is not None:
@@ -143,6 +163,7 @@ class CanaryProber:
                 if ttft is None:
                     ttft = time.monotonic() - t0
             gauges.canary_ttft_seconds.labels(engine=ep.url).set(ttft)
+            self.last_ttft[ep.url] = ttft
             self.probes_total += 1
             if registry is not None:
                 registry.record_success(ep.url)
@@ -151,6 +172,9 @@ class CanaryProber:
         except Exception as e:  # noqa: BLE001 — a dead engine is the signal
             self.failures_total += 1
             gauges.canary_failures_total.labels(engine=ep.url).inc()
+            # Health input for fleet scoring: a probe that never answered
+            # is at least as slow as the timeout we waited.
+            self.last_ttft[ep.url] = self.timeout
             if registry is not None:
                 registry.record_failure(ep.url)
             logger.debug("canary probe failed for %s: %s", ep.url, e)

@@ -20,6 +20,7 @@ from typing import Optional
 from ...utils import parse_comma_separated
 from .base import (
     PROVIDER_BREAKERS,
+    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
     StateBackend,
@@ -64,6 +65,7 @@ __all__ = [
     "GossipStateBackend",
     "InMemoryStateBackend",
     "PROVIDER_BREAKERS",
+    "PROVIDER_ENDPOINT_LOADS",
     "PROVIDER_ENDPOINTS",
     "PROVIDER_REQUEST_STATS",
     "StateBackend",

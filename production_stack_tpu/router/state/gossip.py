@@ -44,6 +44,7 @@ import aiohttp
 from ...logging_utils import init_logger
 from .base import (
     PROVIDER_BREAKERS,
+    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
     StateBackend,
@@ -64,7 +65,7 @@ MAX_JOURNALS = 256
 class _Peer:
     """Last-known state of one remote replica, keyed by replica id."""
 
-    __slots__ = ("seen", "endpoints", "stats", "breakers")
+    __slots__ = ("seen", "endpoints", "stats", "breakers", "loads")
 
     def __init__(self) -> None:
         self.seen = 0.0  # monotonic receipt time of the last digest
@@ -74,6 +75,9 @@ class _Peer:
         self.stats: Dict[str, dict] = {}
         # pstlint: owned-by=task:_apply
         self.breakers: Dict[str, str] = {}
+        # Fleet-routing scoring input (routed-in-flight per engine).
+        # pstlint: owned-by=task:_apply
+        self.loads: Dict[str, float] = {}
 
 
 class _Target:
@@ -228,6 +232,9 @@ class GossipStateBackend(StateBackend):
     def peer_request_stats(self) -> Dict[str, Dict[str, dict]]:
         return {rid: p.stats for rid, p in self._live_peers().items()}
 
+    def peer_endpoint_loads(self) -> Dict[str, Dict[str, float]]:
+        return {rid: p.loads for rid, p in self._live_peers().items()}
+
     def merged_endpoint_urls(self, local: Sequence[str]) -> List[str]:
         merged = set(local)
         for peer in self._live_peers().values():
@@ -294,6 +301,7 @@ class GossipStateBackend(StateBackend):
             "endpoints": list(self._provide(PROVIDER_ENDPOINTS, [])),
             "stats": self._provide(PROVIDER_REQUEST_STATS, {}),
             "breakers": self._provide(PROVIDER_BREAKERS, {}),
+            "loads": self._provide(PROVIDER_ENDPOINT_LOADS, {}),
             "prefix": [
                 [seq, path, ep] for seq, path, ep in list(self._prefix_out)
             ],
@@ -327,6 +335,8 @@ class GossipStateBackend(StateBackend):
         peer.stats = stats if isinstance(stats, dict) else {}
         breakers = digest.get("breakers")
         peer.breakers = breakers if isinstance(breakers, dict) else {}
+        loads = digest.get("loads")
+        peer.loads = loads if isinstance(loads, dict) else {}
         # Prefix insertions: apply only sequence numbers we have not seen
         # from this replica (the out-queue is a sliding window, so digests
         # re-carry recent entries every round).

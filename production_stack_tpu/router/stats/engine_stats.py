@@ -5,12 +5,22 @@ Capability parity with the reference's ``src/vllm_router/stats/engine_stats.py``
 scraper is an asyncio task (not a daemon thread) and parses the same
 ``vllm:``-prefixed gauge names our TPU engine exports, so reference
 dashboards keep working unchanged.
+
+Ownership (router HA): the scraper is a plain class — no ``SingletonMeta``
+— created by the app factory and *injected* per app (``create_app`` binds
+it into request context via middleware), the same de-singletonization
+``RequestStatsMonitor`` got in the HA PR. Two router apps in one process
+(the multi-replica tests) each scrape into their OWN snapshot — zero
+engine-stats bleed — while every existing ``get_engine_stats_scraper()``
+call site keeps working via the context binding with a module-default
+fallback.
 """
 
 # pstlint: disable-file=hop-contract(metrics scrapes are control-plane pulls on their own timer; no originating client request exists to propagate headers from)
 from __future__ import annotations
 
 import asyncio
+import contextvars
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -18,7 +28,6 @@ import aiohttp
 from prometheus_client.parser import text_string_to_metric_families
 
 from ...logging_utils import init_logger
-from ...utils import SingletonMeta
 from ..service_discovery import get_service_discovery
 
 logger = init_logger(__name__)
@@ -98,10 +107,8 @@ class EngineStats:
     from_vllm_scrape = from_scrape
 
 
-class EngineStatsScraper(metaclass=SingletonMeta):
+class EngineStatsScraper:
     def __init__(self, scrape_interval: Optional[float] = None):
-        if getattr(self, "_initialized", False):
-            return
         if scrape_interval is None:
             raise ValueError("EngineStatsScraper needs a scrape_interval")
         self.scrape_interval = scrape_interval
@@ -110,7 +117,14 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         # pstlint: owned-by=task:_scrape_one,_loop
         self.engine_stats: Dict[str, EngineStats] = {}
         self._task: Optional[asyncio.Task] = None
-        self._initialized = True
+
+    @classmethod
+    def destroy(cls) -> None:
+        """Drop the module-level default (test/reconfiguration hook; the
+        name survives from the SingletonMeta era so existing teardown
+        helpers keep working)."""
+        global _default_scraper
+        _default_scraper = None
 
     async def _scrape_one(self, session: aiohttp.ClientSession, url: str) -> None:
         try:
@@ -153,9 +167,37 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             self._task = None
 
 
+# Context binding: ``create_app`` injects its own scraper for the request
+# tasks it serves; the module default covers single-app processes and
+# background loops (same contract as the request-stats monitor).
+_bound_scraper: contextvars.ContextVar[Optional[EngineStatsScraper]] = (
+    contextvars.ContextVar("pst_engine_stats_scraper", default=None)
+)
+_default_scraper: Optional[EngineStatsScraper] = None
+
+
 def initialize_engine_stats_scraper(scrape_interval: float) -> EngineStatsScraper:
-    return EngineStatsScraper(scrape_interval)
+    global _default_scraper
+    _default_scraper = EngineStatsScraper(scrape_interval)
+    return _default_scraper
+
+
+def bind_engine_stats_scraper(
+    scraper: EngineStatsScraper,
+) -> contextvars.Token:
+    """Bind ``scraper`` for the current context (one request's task tree);
+    returns the token for ``unbind_engine_stats_scraper``."""
+    return _bound_scraper.set(scraper)
+
+
+def unbind_engine_stats_scraper(token: contextvars.Token) -> None:
+    _bound_scraper.reset(token)
 
 
 def get_engine_stats_scraper() -> EngineStatsScraper:
-    return EngineStatsScraper()
+    scraper = _bound_scraper.get()
+    if scraper is not None:
+        return scraper
+    if _default_scraper is None:
+        raise ValueError("EngineStatsScraper needs a scrape_interval")
+    return _default_scraper

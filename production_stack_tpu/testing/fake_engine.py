@@ -21,8 +21,10 @@ import random
 import re
 import time
 import uuid
+from collections import OrderedDict
 from typing import List, Optional
 
+import xxhash
 from aiohttp import web
 
 from ..logging_utils import init_logger
@@ -38,16 +40,52 @@ FAKE_WARMUP_BUCKETS = 12
 # cache skips XLA but tracing/deserialization still cost something).
 _WARM_RESTART_FRACTION = 0.2
 
+# Simulated prefix-cache granularity: chars per KV chunk and the token
+# mass one chunk represents (~the real engine's block-size granularity;
+# the fake "tokenizer" is ~4 chars/token).
+KV_CHUNK_CHARS = 32
+KV_CHUNK_TOKENS = 8
+# Working KV a running sequence holds beyond its cached prefix (rough —
+# drives occupancy up under concurrency the way live decode state does).
+KV_RUNNING_TOKENS = 64
+
+
+def kv_chunk_hashes(text: str) -> List[int]:
+    """Prefix-committing chain hashes over fixed char windows: chunk i's
+    hash commits to everything before it, so a match on chunk i implies
+    the whole prefix matches — the same property the real chunk-hash
+    scheme (kvcache/hashing.py) has."""
+    out: List[int] = []
+    h = 0
+    for i in range(0, len(text), KV_CHUNK_CHARS):
+        h = xxhash.xxh64_intdigest(f"{h:x}:{text[i:i + KV_CHUNK_CHARS]}")
+        out.append(h)
+    return out
+
 
 class FakeEngineState:
-    def __init__(self, model: str, speed: float, max_tokens_default: int = 32):
+    def __init__(self, model: str, speed: float, max_tokens_default: int = 32,
+                 kv_capacity_tokens: int = 20000):
         self.model = model
         self.speed = speed  # tokens per second
         self.max_tokens_default = max_tokens_default
         self.num_running = 0
         self.num_waiting = 0
+        # Token-weighted prefix-cache accounting, fed by the simulated
+        # paged KV below (was: hardcoded zeros) — hit rate really reflects
+        # whether this engine served this conversation before.
         self.prefix_hits = 0
         self.prefix_queries = 0
+        # Simulated paged KV cache: chunk hash -> token mass, LRU order.
+        # Occupancy derives from what is actually cached + running, so
+        # routing tests exercise real headroom dynamics instead of
+        # min(1, num_running * 0.1).
+        self.kv_capacity_tokens = max(int(kv_capacity_tokens), 1)
+        self.kv_chunks: "OrderedDict[int, int]" = OrderedDict()
+        self.kv_tokens = 0
+        # /admin/fill_kv: reported-occupancy floor for headroom-spill
+        # tests that need an engine pinned "full" without traffic.
+        self.kv_fill_floor = 0.0
         self.sleeping = False
         self.lora_adapters: List[str] = []
         self.requests_seen: List[dict] = []
@@ -143,6 +181,41 @@ class FakeEngineState:
         elapsed = time.monotonic() - self.warmup_started
         return min(elapsed / self.effective_ready_delay, 1.0)
 
+    def account_prefix(self, prompt_text: str) -> int:
+        """One generation's prefix-cache pass: count token-weighted hits
+        against the simulated KV, then cache the prompt's chunks (LRU
+        eviction at capacity). Returns matched chunk count."""
+        hashes = kv_chunk_hashes(prompt_text)
+        matched = 0
+        for h in hashes:
+            if h in self.kv_chunks:
+                matched += 1
+                self.kv_chunks.move_to_end(h)
+            else:
+                break  # chain hashes: first miss ends the match
+        self.prefix_queries += len(hashes) * KV_CHUNK_TOKENS
+        self.prefix_hits += matched * KV_CHUNK_TOKENS
+        for h in hashes[matched:]:
+            # A chunk past the first miss can still be cached (partial
+            # LRU eviction left a hole): re-inserting it must not count
+            # its token mass twice, or occupancy ratchets upward forever.
+            if h not in self.kv_chunks:
+                self.kv_tokens += KV_CHUNK_TOKENS
+            self.kv_chunks[h] = KV_CHUNK_TOKENS
+            self.kv_chunks.move_to_end(h)
+        while self.kv_tokens > self.kv_capacity_tokens and self.kv_chunks:
+            _, tokens = self.kv_chunks.popitem(last=False)
+            self.kv_tokens -= tokens
+        return matched
+
+    @property
+    def kv_occupancy(self) -> float:
+        """Derived KV page occupancy: cached chunks + live decode state,
+        floored by the /admin/fill_kv override."""
+        live = self.kv_tokens + self.num_running * KV_RUNNING_TOKENS
+        derived = min(live / self.kv_capacity_tokens, 1.0)
+        return max(derived, min(max(self.kv_fill_floor, 0.0), 1.0))
+
     def take_fault(self) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None."""
         if self.fail_mode is None or self.fail_count == 0:
@@ -204,8 +277,9 @@ def create_fake_engine_app(
     name: str = "",
     ready_delay: float = 0.0,
     warmup_cache_dir: Optional[str] = None,
+    kv_capacity_tokens: int = 20000,
 ) -> web.Application:
-    state = FakeEngineState(model, speed)
+    state = FakeEngineState(model, speed, kv_capacity_tokens=kv_capacity_tokens)
     # Instance identity for routing-distribution e2e assertions: surfaces in
     # the X-Served-By header of every generation response.
     state.name = name or f"fake-{uuid.uuid4().hex[:6]}"
@@ -313,7 +387,6 @@ def create_fake_engine_app(
         stream = bool(body.get("stream", False))
         die_midstream = fault == "midstream"
         state.num_running += 1
-        state.prefix_queries += 1
         req_id = f"fake-{uuid.uuid4().hex[:12]}"
         token_interval = 1.0 / state.speed if state.speed > 0 else 0.0
         # Deterministic *continuation* semantics: the fake model's output
@@ -322,6 +395,7 @@ def create_fake_engine_app(
         # continues exactly where an unbroken run would have, like a
         # temperature-0 model continuing its own output.
         prompt_text = _prompt_text(body)
+        state.account_prefix(prompt_text)
         tok_start = len(re.findall(r"tok\d+", prompt_text))
         # The fake "tokenizer": every generated tokN is one token (even
         # when a continuation glued it to the prompt tail without a
@@ -466,7 +540,7 @@ def create_fake_engine_app(
                 "# TYPE vllm:gpu_prefix_cache_queries_total counter",
                 f"vllm:gpu_prefix_cache_queries_total {state.prefix_queries}",
                 "# TYPE vllm:gpu_cache_usage_perc gauge",
-                f"vllm:gpu_cache_usage_perc {min(1.0, state.num_running * 0.1)}",
+                f"vllm:gpu_cache_usage_perc {state.kv_occupancy:.4f}",
                 # Engine telemetry (docs/observability.md "Engine
                 # telemetry"): deterministic values so router-side SLO /
                 # scraper e2e tests run hermetically against the fake.
@@ -490,7 +564,7 @@ def create_fake_engine_app(
                 "# TYPE pst_engine_mfu gauge",
                 "pst_engine_mfu 0.31",
                 "# TYPE pst_engine_kv_page_occupancy gauge",
-                f"pst_engine_kv_page_occupancy {min(1.0, state.num_running * 0.1)}",
+                f"pst_engine_kv_page_occupancy {state.kv_occupancy:.4f}",
                 "# TYPE pst_engine_kv_page_high_watermark gauge",
                 "pst_engine_kv_page_high_watermark 0.55",
                 "# TYPE pst_engine_preemptions counter",
@@ -648,6 +722,39 @@ def create_fake_engine_app(
         state.fail_count = -1
         return web.json_response({"status": "healed", "faulted": state.num_faulted})
 
+    async def admin_fill_kv(request: web.Request) -> web.Response:
+        """Pin the reported KV occupancy for headroom-spill tests:
+        {"occupancy": 0.9} floors the derived occupancy at 0.9;
+        {"clear": true} drops the floor AND the simulated cache;
+        {"capacity_tokens": N} resizes the simulated KV."""
+        body = await request.json() if request.can_read_body else {}
+        if not isinstance(body, dict):
+            body = {}
+        if body.get("clear"):
+            state.kv_fill_floor = 0.0
+            state.kv_chunks.clear()
+            state.kv_tokens = 0
+        if "capacity_tokens" in body:
+            try:
+                state.kv_capacity_tokens = max(int(body["capacity_tokens"]), 1)
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "capacity_tokens must be an int"}, status=400
+                )
+        if "occupancy" in body:
+            try:
+                state.kv_fill_floor = float(body["occupancy"])
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "occupancy must be a number"}, status=400
+                )
+        return web.json_response({
+            "occupancy": state.kv_occupancy,
+            "fill_floor": state.kv_fill_floor,
+            "cached_tokens": state.kv_tokens,
+            "capacity_tokens": state.kv_capacity_tokens,
+        })
+
     async def drain(request: web.Request) -> web.Response:
         state.draining = True
         if request.query.get("wait"):
@@ -739,6 +846,7 @@ def create_fake_engine_app(
     app.router.add_post("/wake_up", wake_up)
     app.router.add_post("/admin/fail", admin_fail)
     app.router.add_post("/admin/heal", admin_heal)
+    app.router.add_post("/admin/fill_kv", admin_fill_kv)
     app.router.add_post("/admin/warmup", admin_warmup)
     app.router.add_post("/drain", drain)
     app.router.add_post("/undrain", undrain)
@@ -764,10 +872,15 @@ def main(argv: Optional[list] = None) -> None:
                    help="simulated persistent compile cache: a marker left "
                         "by a previous instance makes this start warm "
                         "(shorter ready delay, all cache hits)")
+    p.add_argument("--kv-capacity-tokens", type=int, default=20000,
+                   help="simulated KV capacity: occupancy and prefix-hit "
+                        "eviction derive from it (small values make "
+                        "cache-pressure effects visible in tests)")
     args = p.parse_args(argv)
     app = create_fake_engine_app(
         args.model, args.speed, args.ttft, args.name,
         ready_delay=args.ready_delay, warmup_cache_dir=args.warmup_cache_dir,
+        kv_capacity_tokens=args.kv_capacity_tokens,
     )
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 

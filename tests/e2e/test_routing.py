@@ -193,6 +193,115 @@ def leg_kvaware():
     print("PASS kvaware (controller-down fallback)", dict(served))
 
 
+def leg_fleet():
+    """Fleet routing e2e: prefix affinity holds, a drained engine's
+    sessions remap within one routing decision and stick to their new
+    warm home, an engine SIGKILLed mid-run is fenced with the fleet hit
+    rate recovering, and the pst_route_* metric family is live."""
+    with Fleet("fleet",
+               router_args=["--session-key", "x-session-id",
+                            "--engine-stats-interval", "1",
+                            "--proxy-retries", "2",
+                            "--retry-backoff", "0.01",
+                            "--breaker-failure-threshold", "2",
+                            "--breaker-recovery-time", "60"]) as f:
+        # Phase 1 — prefix affinity: distinct long prefixes each stick to
+        # one engine (the trie-scored argmax).
+        prefixes = {"A" * 400: set(), "B" * 400: set(), "C" * 400: set()}
+        for prefix, seen in prefixes.items():
+            for i in range(6):
+                status, by, _ = post(
+                    f"{f.url}/v1/completions",
+                    {"model": MODEL, "prompt": prefix + f" q{i}",
+                     "max_tokens": 2},
+                )
+                assert status == 200
+                seen.add(by)
+        for prefix, seen in prefixes.items():
+            assert len(seen) == 1, f"prefix bounced across {seen}"
+
+        # Phase 2 — session drain remap: pin a session, drain its engine,
+        # and the very next request lands elsewhere (one routing
+        # decision, transparent to the client), then STAYS there.
+        sid = {"x-session-id": "alice"}
+        status, pinned, _ = post(
+            f"{f.url}/v1/completions",
+            {"model": MODEL, "prompt": "alice says hi", "max_tokens": 2},
+            headers=sid,
+        )
+        assert status == 200
+        for i in range(3):
+            status, by, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"alice turn {i}",
+                 "max_tokens": 2}, headers=sid,
+            )
+            assert status == 200 and by == pinned, (by, pinned)
+        pinned_port = f.engine_ports[int(pinned.split("-")[1])]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{pinned_port}/drain", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        status, new_home, _ = post(
+            f"{f.url}/v1/completions",
+            {"model": MODEL, "prompt": "alice after drain", "max_tokens": 2},
+            headers=sid,
+        )
+        assert status == 200 and new_home != pinned, (new_home, pinned)
+        for i in range(4):
+            status, by, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"alice post-drain {i}",
+                 "max_tokens": 2}, headers=sid,
+            )
+            assert status == 200 and by == new_home, (by, new_home)
+
+        # Phase 3 — churn: park a warm prefix, SIGKILL its home engine
+        # mid-run. Requests keep succeeding, the corpse is never served
+        # again, and the prefix recovers its affinity (hit-rate recovery)
+        # on one survivor as the trie relearns.
+        victim_prefix = "V" * 400
+        status, victim, _ = post(
+            f"{f.url}/v1/completions",
+            {"model": MODEL, "prompt": victim_prefix + " q0",
+             "max_tokens": 2},
+        )
+        assert status == 200
+        for i in range(1, 4):
+            status, by, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": victim_prefix + f" q{i}",
+                 "max_tokens": 2},
+            )
+            assert status == 200 and by == victim, (by, victim)
+        f.procs[int(victim.split("-")[1])].kill()
+        served_after = Counter()
+        for i in range(20):
+            status, by, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": victim_prefix + f" post {i}",
+                 "max_tokens": 2},
+            )
+            assert status == 200
+            served_after[by] += 1
+        assert victim not in served_after, served_after
+        # Affinity recovery: once the breaker fenced the corpse, the
+        # prompt re-homed onto ONE survivor (the trie relearned).
+        top, top_count = served_after.most_common(1)[0]
+        assert top_count >= 15, served_after
+
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_route_score_count") > 0, \
+            "pst_route_score histogram never observed"
+        assert metric_value(metrics, "pst_route_session_remap_total",
+                            'reason="unroutable"') >= 1
+    print("PASS fleet (affinity, drain remap within one decision, "
+          f"churn recovery {dict(served_after)})")
+
+
 def leg_disagg():
     labels = ["prefill", "decode", "decode"]
     with Fleet("disaggregated_prefill", labels=labels,
@@ -721,6 +830,7 @@ LEGS = {
     "session": leg_session,
     "prefixaware": leg_prefixaware,
     "kvaware": leg_kvaware,
+    "fleet": leg_fleet,
     "disaggregated_prefill": leg_disagg,
     "stress": leg_stress,
     "chaos": leg_chaos,

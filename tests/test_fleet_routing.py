@@ -1,0 +1,588 @@
+"""Fleet routing: fused scoring (prefix affinity × KV headroom × canary
+health), bounded-load spill, sticky-session eviction/remap, churn
+eviction, the replicated endpoint-loads surface, the de-singletonized
+engine-stats scraper, and the fake engine's derived KV simulation.
+
+The process-level counterpart (real router binary, engine kill, drain
+remap) lives in tests/e2e/test_routing.py::leg_fleet.
+"""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router.routing import metrics as route_metrics
+from production_stack_tpu.router.routing import scoring
+from production_stack_tpu.router.routing.logic import (
+    FleetRouter,
+    RoutingLogic,
+    evict_routing_endpoint,
+    get_routing_logic,
+    initialize_routing_logic,
+    teardown_routing_logic,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    EngineStats,
+    EngineStatsScraper,
+    bind_engine_stats_scraper,
+    get_engine_stats_scraper,
+    initialize_engine_stats_scraper,
+    unbind_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import RequestStats
+
+from .router_utils import make_endpoint, reset_router_singletons
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+def _counter_value(counter, **labels) -> float:
+    return counter.labels(**labels)._value.get()
+
+
+def _run(loop, coro):
+    return loop.run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# Scoring + argmax
+# ---------------------------------------------------------------------------
+
+
+def test_warm_prefix_affinity_repeats_same_engine(event_loop):
+    router = FleetRouter()
+    eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+    body = {"model": "m", "prompt": "A" * 600}
+    first = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+    for _ in range(5):
+        assert _run(
+            event_loop, router.route_request(eps, {}, {}, {}, body)
+        ) == first
+
+
+def test_bounded_load_spills_off_warm_engine(event_loop):
+    router = FleetRouter(load_factor=2.0)
+    eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+    body = {"model": "m", "prompt": "B" * 600}
+    warm = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+    before = _counter_value(route_metrics.spill_total, reason="load")
+    stats = {e.url: RequestStats() for e in eps}
+    # Mean load 5 → bound 10; the warm engine sits at 20, over the bound.
+    stats[warm].in_prefill_requests = 20
+    spilled = _run(event_loop, router.route_request(eps, {}, stats, {}, body))
+    assert spilled != warm
+    assert _counter_value(route_metrics.spill_total, reason="load") > before
+    # Load gone → affinity wins again. The spill target ALSO served (and
+    # cached) the prompt, so both warm engines are now legitimate argmax
+    # picks — but no cold engine is.
+    stats[warm].in_prefill_requests = 0
+    assert _run(
+        event_loop, router.route_request(eps, {}, {}, {}, body)
+    ) in {warm, spilled}
+
+
+def test_kv_headroom_demotes_saturated_engine(event_loop):
+    router = FleetRouter()
+    eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+    body = {"model": "m", "prompt": "C" * 300}
+    warm = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+    # The warm engine reports ~full KV pages: headroom floors at 0.05 and
+    # a modest prefix hit cannot outscore a cold engine at 90% headroom.
+    engine_stats = {warm: EngineStats(engine_kv_page_occupancy=0.98)}
+    cold = _run(
+        event_loop, router.route_request(eps, engine_stats, {}, {}, body)
+    )
+    assert cold != warm
+
+
+def test_canary_health_demotes_slow_engine(event_loop):
+    from production_stack_tpu.router.services.canary import (
+        initialize_canary_prober,
+        teardown_canary_prober,
+    )
+
+    prober = initialize_canary_prober(30.0)
+    try:
+        router = FleetRouter()
+        eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+        body = {"model": "m", "prompt": "D" * 300}
+        warm = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+        # The warm engine's canary is 40× slower than the fleet's best.
+        for e in eps:
+            prober.last_ttft[e.url] = 0.05
+        prober.last_ttft[warm] = 2.0
+        assert _run(
+            event_loop, router.route_request(eps, {}, {}, {}, body)
+        ) != warm
+    finally:
+        teardown_canary_prober()
+
+
+def test_score_math_units():
+    # A 2000-token cached prefix on a half-full healthy engine beats a
+    # cold empty one; the same prefix on a saturated engine does not.
+    hit = {"a": 2000.0, "b": 0.0}
+    stats_half = {"a": EngineStats(engine_kv_page_occupancy=0.5)}
+    scores = scoring.score_engines(["a", "b"], hit, stats_half, {})
+    assert scores["a"] > scores["b"]
+    stats_full = {"a": EngineStats(engine_kv_page_occupancy=1.0)}
+    hit_small = {"a": 100.0, "b": 0.0}
+    scores = scoring.score_engines(["a", "b"], hit_small, stats_full, {})
+    assert scores["b"] > scores["a"]
+
+
+# ---------------------------------------------------------------------------
+# Sticky sessions: pin, decay eviction, unroutable remap
+# ---------------------------------------------------------------------------
+
+
+def test_session_pins_and_remaps_on_unroutable(event_loop):
+    router = FleetRouter(session_key="x-session-id")
+    eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+    h = {"x-session-id": "alice"}
+    first = _run(
+        event_loop,
+        router.route_request(eps, {}, {}, h, {"model": "m", "prompt": "hi"}),
+    )
+    for i in range(4):
+        assert _run(
+            event_loop,
+            router.route_request(
+                eps, {}, {}, h, {"model": "m", "prompt": f"turn {i}"}
+            ),
+        ) == first
+    before = _counter_value(route_metrics.session_remap_total,
+                            reason="unroutable")
+    # The pinned engine leaves the candidate set (draining/breaker-open):
+    # the session must remap within THIS decision, not after a timeout.
+    rest = [e for e in eps if e.url != first]
+    moved = _run(
+        event_loop,
+        router.route_request(
+            rest, {}, {}, h, {"model": "m", "prompt": "post-drain turn"}
+        ),
+    )
+    assert moved != first
+    assert _counter_value(
+        route_metrics.session_remap_total, reason="unroutable"
+    ) > before
+    # With the old engine back, the session stays on its new home (pin
+    # updated, trie learned the new engine's warm prefix).
+    assert _run(
+        event_loop,
+        router.route_request(
+            eps, {}, {}, h, {"model": "m", "prompt": "post-drain turn 2"}
+        ),
+    ) == moved
+
+
+def test_session_evicted_on_score_decay(event_loop):
+    router = FleetRouter(session_key="x-session-id", eviction_ratio=0.5)
+    eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+    h = {"x-session-id": "bob"}
+    first = _run(
+        event_loop,
+        router.route_request(eps, {}, {}, h, {"model": "m", "prompt": "hi"}),
+    )
+    before = _counter_value(route_metrics.session_remap_total,
+                            reason="score_decay")
+    # KV pressure crushes the pinned engine's score to the 0.05 floor —
+    # far below 0.5× the best cold candidate.
+    engine_stats = {first: EngineStats(engine_kv_page_occupancy=0.99)}
+    moved = _run(
+        event_loop,
+        router.route_request(
+            eps, engine_stats, {}, h, {"model": "m", "prompt": "hi again"}
+        ),
+    )
+    assert moved != first
+    assert _counter_value(
+        route_metrics.session_remap_total, reason="score_decay"
+    ) > before
+
+
+# ---------------------------------------------------------------------------
+# kvserver lookup gating: zero blocking I/O below the threshold
+# ---------------------------------------------------------------------------
+
+
+def test_no_lookup_below_threshold_with_kvserver_unreachable(event_loop):
+    # Controller points at a dead port; below the kvaware threshold the
+    # hot path must NEVER touch the network — the route stays instant.
+    router = FleetRouter(
+        controller_url="http://127.0.0.1:1", kv_aware_threshold=2000
+    )
+    called = []
+    router.lookup_client.lookup = lambda *a, **k: called.append(1)  # type: ignore[assignment]
+    eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+    before = _counter_value(route_metrics.lookup_skipped_total,
+                            reason="below_threshold")
+    t0 = time.monotonic()
+    url = _run(
+        event_loop,
+        router.route_request(
+            eps, {}, {}, {}, {"model": "m", "prompt": "short prompt"}
+        ),
+    )
+    assert url in {e.url for e in eps}
+    assert not called, "kvserver lookup attempted below the token threshold"
+    assert time.monotonic() - t0 < 0.5
+    assert _counter_value(
+        route_metrics.lookup_skipped_total, reason="below_threshold"
+    ) > before
+
+
+def test_lookup_failure_above_threshold_degrades_to_local(event_loop):
+    # Above the threshold the lookup IS attempted — and an unreachable
+    # controller degrades to the local trie estimate instead of failing
+    # the route.
+    router = FleetRouter(
+        controller_url="http://127.0.0.1:1", kv_aware_threshold=50
+    )
+    from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+    router.lookup_client._tokenizer = ByteTokenizer()
+    eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+    url = _run(
+        event_loop,
+        router.route_request(
+            eps, {}, {}, {}, {"model": "m", "prompt": "X" * 400}
+        ),
+    )
+    assert url in {e.url for e in eps}
+
+
+async def test_lookup_merges_controller_matches_above_threshold():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.tokenizer import ByteTokenizer
+    from production_stack_tpu.kvcache.hashing import chunk_hashes
+    from production_stack_tpu.kvserver.controller import create_controller_app
+
+    async with TestClient(TestServer(create_controller_app())) as client:
+        controller_url = str(client.make_url(""))
+        router = FleetRouter(
+            controller_url=controller_url, kv_aware_threshold=50
+        )
+        router.lookup_client._tokenizer = ByteTokenizer()
+        prompt = "Y" * 600
+        token_ids = ByteTokenizer().encode(prompt)
+        # The controller knows e2 holds this prompt's KV chunks.
+        resp = await client.post("/register", json={
+            "url": "http://e2", "model": "m",
+            "hashes": chunk_hashes(token_ids),
+        })
+        assert resp.status == 200
+        eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+        url = await router.route_request(
+            eps, {}, {}, {}, {"model": "m", "prompt": prompt}
+        )
+        assert url == "http://e2"
+        await router.aclose()
+        teardown_routing_logic()
+
+
+# ---------------------------------------------------------------------------
+# Churn: discovery removal evicts trie + pins in one step
+# ---------------------------------------------------------------------------
+
+
+def test_churn_evicts_trie_pins_and_scores_in_one_step(event_loop):
+    initialize_routing_logic(RoutingLogic.FLEET, session_key="x-session-id")
+    router = get_routing_logic()
+    assert isinstance(router, FleetRouter)
+    eps = [make_endpoint(f"http://e{i}") for i in range(3)]
+    body = {"model": "m", "prompt": "Z" * 600}
+    h = {"x-session-id": "carol"}
+    warm = _run(event_loop, router.route_request(eps, {}, {}, h, body))
+    assert router.pins.get("carol") == warm
+    assert _run(
+        event_loop, router.hashtrie.match_depths("Z" * 600, {warm})
+    )
+    # Discovery removes the engine: trie, pin table, and cached scoring
+    # views drop it synchronously (the eviction task runs on this loop).
+    evict_routing_endpoint(warm)
+    _run(event_loop, asyncio.sleep(0))
+    assert router.pins.get("carol") is None
+    assert not _run(
+        event_loop, router.hashtrie.match_depths("Z" * 600, {warm})
+    )
+    assert warm not in router._last_scores
+
+
+# ---------------------------------------------------------------------------
+# Replicated scoring inputs: the endpoint-loads state surface
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_digest_carries_endpoint_loads():
+    from production_stack_tpu.router.state import PROVIDER_ENDPOINT_LOADS
+    from production_stack_tpu.router.state.gossip import GossipStateBackend
+
+    a = GossipStateBackend(peers=[], replica_id="ra")
+    b = GossipStateBackend(peers=[], replica_id="rb")
+    a.register_provider(
+        PROVIDER_ENDPOINT_LOADS, lambda: {"http://e0": 3.0, "http://e1": 1.0}
+    )
+    digest = a.digest()
+    assert digest["loads"] == {"http://e0": 3.0, "http://e1": 1.0}
+    b.exchange(digest)
+    assert b.peer_endpoint_loads() == {
+        "ra": {"http://e0": 3.0, "http://e1": 1.0}
+    }
+
+
+def test_peer_loads_shift_bounded_load_pick(event_loop, monkeypatch):
+    """A peer replica's published load on the warm engine pushes it over
+    the bound even when THIS replica routed nothing to it — replicas
+    spill identically."""
+
+    class StubBackend:
+        shared = True
+
+        def peer_endpoint_loads(self):
+            return {"peer": {"http://e0": 40.0}}
+
+        def merged_endpoint_urls(self, local):
+            return list(local)
+
+        def drain_prefix_inserts(self):
+            return []
+
+        def publish_prefix_insert(self, path, ep):
+            pass
+
+    from production_stack_tpu.router import state as state_mod
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+
+    monkeypatch.setattr(state_mod, "_state_backend", StubBackend())
+    # A resolvable local monitor is required for peer loads to merge in:
+    # without one, routing treats the caller-passed stats as already
+    # fleet-merged and deliberately ignores peer_endpoint_loads.
+    initialize_request_stats_monitor(60.0)
+    router = FleetRouter(load_factor=2.0)
+    eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+    body = {"model": "m", "prompt": "W" * 600}
+    # Warm up e0 deliberately: insert its prefix directly.
+    _run(event_loop, router.hashtrie.insert("W" * 600, "http://e0"))
+    url = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+    assert url != "http://e0"
+
+
+def test_fleet_loads_sums_local_and_peers():
+    local = {"http://e0": RequestStats(in_prefill_requests=2,
+                                       in_decoding_requests=1)}
+
+    class Backend:
+        shared = True
+
+        def peer_endpoint_loads(self):
+            return {"p1": {"http://e0": 4.0, "http://gone": 9.0},
+                    "p2": "garbage"}
+
+    loads = scoring.fleet_loads(["http://e0", "http://e1"], local, Backend())
+    assert loads == {"http://e0": 7.0, "http://e1": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# EngineStatsScraper: SingletonMeta is dead
+# ---------------------------------------------------------------------------
+
+
+def test_engine_stats_scraper_instances_are_independent():
+    s1 = EngineStatsScraper(1.0)
+    s2 = EngineStatsScraper(2.0)
+    assert s1 is not s2
+    assert s2.scrape_interval == 2.0  # args no longer ignored on 2nd call
+    s1.engine_stats["http://e0"] = EngineStats(num_running_requests=5)
+    assert "http://e0" not in s2.engine_stats
+
+
+def test_engine_stats_scraper_binding_and_default():
+    with pytest.raises(ValueError):
+        get_engine_stats_scraper()
+    default = initialize_engine_stats_scraper(1.0)
+    assert get_engine_stats_scraper() is default
+    bound = EngineStatsScraper(3.0)
+    token = bind_engine_stats_scraper(bound)
+    try:
+        assert get_engine_stats_scraper() is bound
+    finally:
+        unbind_engine_stats_scraper(token)
+    assert get_engine_stats_scraper() is default
+    EngineStatsScraper.destroy()
+    with pytest.raises(ValueError):
+        get_engine_stats_scraper()
+
+
+async def test_two_router_apps_no_engine_stats_bleed():
+    """Two full router apps in one process: each scrapes into ITS OWN
+    snapshot (the EngineStatsScraper de-singletonization)."""
+    from production_stack_tpu.router.app import create_app
+    from production_stack_tpu.router.parser import parse_args
+    from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+    runners = []
+
+    async def serve(app):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        return site._server.sockets[0].getsockname()[1]
+
+    try:
+        eport = await serve(create_fake_engine_app(model="fake/model"))
+        apps = []
+        for _ in range(2):
+            args = parse_args([
+                "--service-discovery", "static",
+                "--static-backends", f"http://127.0.0.1:{eport}",
+                "--static-models", "fake/model",
+                "--routing-logic", "fleet",
+                "--engine-stats-interval", "0.1",
+            ])
+            app = create_app(args)
+            await serve(app)
+            apps.append(app)
+        await asyncio.sleep(0.4)  # both scrapers sweep at least once
+        s0 = apps[0]["engine_stats_scraper"]
+        s1 = apps[1]["engine_stats_scraper"]
+        assert s0 is not s1
+        assert f"http://127.0.0.1:{eport}" in s0.engine_stats
+        assert f"http://127.0.0.1:{eport}" in s1.engine_stats
+        # Mutating one app's snapshot never shows in the other.
+        s0.engine_stats.clear()
+        assert f"http://127.0.0.1:{eport}" in s1.engine_stats
+    finally:
+        for runner in reversed(runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# Fake engine: derived KV occupancy + prefix-hit simulation + fill knob
+# ---------------------------------------------------------------------------
+
+
+async def test_fake_engine_prefix_hits_and_occupancy_derive_from_state():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+    app = create_fake_engine_app(model="fake/model", speed=10000.0,
+                                 kv_capacity_tokens=2000)
+    async with TestClient(TestServer(app)) as client:
+        body = {"model": "fake/model", "prompt": "P" * 400, "max_tokens": 2}
+        r = await client.post("/v1/completions", json=body)
+        assert r.status == 200
+        m1 = await (await client.get("/metrics")).text()
+
+        def val(text, name):
+            for line in text.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            return -1.0
+
+        # First pass: all queries, no hits, occupancy grew off the cache.
+        assert val(m1, "vllm:gpu_prefix_cache_queries_total") > 0
+        assert val(m1, "vllm:gpu_prefix_cache_hits_total") == 0
+        occ1 = val(m1, "pst_engine_kv_page_occupancy")
+        assert 0.0 < occ1 < 1.0
+        # Same prompt again: the prefix hits.
+        r = await client.post("/v1/completions", json=body)
+        assert r.status == 200
+        m2 = await (await client.get("/metrics")).text()
+        assert val(m2, "vllm:gpu_prefix_cache_hits_total") > 0
+        assert val(m2, "vllm:gpu_prefix_cache_hit_rate") > 0.3
+        # The two exported occupancy gauges agree (both derived).
+        assert val(m2, "pst_engine_kv_page_occupancy") == pytest.approx(
+            val(m2, "vllm:gpu_cache_usage_perc")
+        )
+
+
+async def test_fake_engine_fill_kv_pins_occupancy():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+    app = create_fake_engine_app(model="fake/model")
+    async with TestClient(TestServer(app)) as client:
+        r = await client.post("/admin/fill_kv", json={"occupancy": 0.92})
+        assert r.status == 200
+        assert (await r.json())["occupancy"] >= 0.92
+        text = await (await client.get("/metrics")).text()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("pst_engine_kv_page_occupancy ")
+        )
+        assert float(line.split()[-1]) >= 0.92
+        r = await client.post("/admin/fill_kv", json={"clear": True})
+        assert (await r.json())["occupancy"] < 0.92
+
+
+async def test_fleet_router_spills_off_filled_fake_engine():
+    """End to end over the app harness: /admin/fill_kv pins one engine at
+    high occupancy; after a scrape sweep, fleet routing sends a warm
+    prompt elsewhere (the headroom-spill contract)."""
+    import aiohttp
+
+    from production_stack_tpu.router.app import create_app
+    from production_stack_tpu.router.parser import parse_args
+    from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+    runners = []
+
+    async def serve(app):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        return site._server.sockets[0].getsockname()[1]
+
+    try:
+        eports = [
+            await serve(create_fake_engine_app(model="fake/model",
+                                               speed=10000.0, name=f"f{i}"))
+            for i in range(3)
+        ]
+        urls = [f"http://127.0.0.1:{p}" for p in eports]
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * 3),
+            "--routing-logic", "fleet",
+            "--engine-stats-interval", "0.1",
+        ])
+        rport = await serve(create_app(args))
+        router_url = f"http://127.0.0.1:{rport}"
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "fake/model", "prompt": "Q" * 500,
+                    "max_tokens": 2}
+            async with s.post(f"{router_url}/v1/completions", json=body) as r:
+                assert r.status == 200
+                warm = r.headers["X-Served-By"]
+            warm_idx = int(warm[1:])  # name f{i}
+            # Pin the warm engine at 97% occupancy, wait out a scrape.
+            async with s.post(f"{urls[warm_idx]}/admin/fill_kv",
+                              json={"occupancy": 0.97}) as r:
+                assert r.status == 200
+            await asyncio.sleep(0.35)
+            async with s.post(f"{router_url}/v1/completions", json=body) as r:
+                assert r.status == 200
+                assert r.headers["X-Served-By"] != warm
+    finally:
+        for runner in reversed(runners):
+            await runner.cleanup()
+        reset_router_singletons()

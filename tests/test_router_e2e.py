@@ -107,7 +107,10 @@ async def test_roundrobin_proxy_and_stats():
                 for line in text.splitlines():
                     if line.startswith("vllm:gpu_prefix_cache_queries_total"):
                         counts.append(float(line.split()[-1]))
-            assert counts == [2.0, 2.0]
+            # Token-weighted queries (the fake engine's simulated KV):
+            # the same prompt everywhere, so an even request split shows
+            # as equal non-zero query mass on both engines.
+            assert counts[0] == counts[1] > 0
             # Router /metrics exposes per-server gauges after scrape.
             await asyncio.sleep(0.5)
             async with s.get(f"{c.router_url}/metrics") as resp:
