@@ -11,11 +11,15 @@ import types
 from typing import Dict
 
 from . import (
+    app_scope,
     async_blocking,
+    config_contract,
     hop_contract,
     lock_discipline,
+    lock_order,
     metric_registry,
     recompile_risk,
+    task_lifecycle,
 )
 
 ALL_CHECKS = (
@@ -24,6 +28,10 @@ ALL_CHECKS = (
     hop_contract,
     metric_registry,
     lock_discipline,
+    task_lifecycle,
+    lock_order,
+    app_scope,
+    config_contract,
 )
 
 CHECKS_BY_ID: Dict[str, types.ModuleType] = {

@@ -1,0 +1,137 @@
+"""app-scope: no module-level mutable state in ``router/``.
+
+ROADMAP item 5(b): the router's last module singletons made two router
+apps in one process *last-app-wins* — the second ``create_app`` silently
+repointed discovery/routing/stats lookups at its own instances. The
+refactor moved every such service into the context-bound app scope
+(:mod:`production_stack_tpu.router.appscope`, bound to the ``aiohttp``
+app by the factory, per request by the middleware, and per background
+loop via task context inheritance). This check is the enforcement half:
+the pattern cannot grow back.
+
+Inside ``router/`` (every module under that package), two shapes fail:
+
+1. **Module-level mutable container** — ``x = {}`` / ``[]`` / ``set()``
+   / ``deque()`` / ``defaultdict()`` / ... assigned to a module-level
+   name. Exemptions: ``UPPER_CASE`` names (read-only constants by
+   convention — the check trusts the convention, not the mutability) and
+   ``contextvars.ContextVar`` declarations (the sanctioned mechanism:
+   values are per context, so apps cannot bleed).
+2. **``global`` rebind** — any ``global X`` statement inside a function.
+   That is the last-app-wins singleton idiom itself (``initialize_*``
+   rebinding a module default); app-scoped services never need it.
+
+Fix direction, not suppression direction: store the instance in the app
+scope (``appscope.scoped_set``), inject it via the app factory
+(``app["..."]``), or — for replicated state — flow it through the router
+``StateBackend``. Suppress only with a reason naming why the state is
+genuinely process-scoped (``# pstlint: disable=app-scope(<why>)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Project, SourceFile
+
+CHECK_ID = "app-scope"
+DESCRIPTION = (
+    "module-level mutable state / global rebinds in router/ (app state "
+    "must be app-factory injected or flow through the StateBackend)"
+)
+
+# collections.Counter is deliberately absent: the name collides with the
+# prometheus_client Counter constructor, and Prometheus metric objects
+# ARE process-global by design (one exposition registry per process).
+_MUTABLE_CONSTRUCTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "bytearray",
+}
+_SANCTIONED_CONSTRUCTORS = {"ContextVar"}
+
+
+def _in_router(rel: str) -> bool:
+    return "router" in rel.replace("\\", "/").split("/")
+
+
+def _constructor_name(value: ast.AST) -> Optional[str]:
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(value, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(value, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(value, ast.Call):
+        f = value.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+    return None
+
+
+def _is_constant_name(name: str) -> bool:
+    stripped = name.lstrip("_")
+    return bool(stripped) and stripped == stripped.upper()
+
+
+def _check_module_level(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    assert src.tree is not None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        ctor = _constructor_name(value)
+        if ctor in _SANCTIONED_CONSTRUCTORS:
+            continue
+        if ctor not in _MUTABLE_CONSTRUCTORS:
+            continue
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if _is_constant_name(tgt.id):
+                continue
+            if tgt.id.startswith("__") and tgt.id.endswith("__"):
+                continue  # module protocol names (__all__, ...)
+            findings.append(Finding(
+                CHECK_ID, src.rel, node.lineno, node.col_offset,
+                "module-level mutable %s %r in router/: with two router "
+                "apps in one process this is shared (or last-app-wins) "
+                "state — move it into the app scope "
+                "(appscope.scoped_set/app[...]), flow it through the "
+                "StateBackend, or rename it UPPER_CASE if it is a "
+                "genuinely read-only constant" % (ctor, tgt.id),
+            ))
+    return findings
+
+
+def _check_global_rebinds(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    assert src.tree is not None
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Global):
+            findings.append(Finding(
+                CHECK_ID, src.rel, node.lineno, node.col_offset,
+                "'global %s' in router/: rebinding a module default is "
+                "the last-app-wins singleton idiom — the second app's "
+                "initialize_* silently repoints every ambient lookup. "
+                "Store the instance in the app scope instead "
+                "(appscope.scoped_set; see router/appscope.py)"
+                % ", ".join(node.names),
+            ))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if src.tree is None or not _in_router(src.rel):
+            continue
+        findings.extend(_check_module_level(src))
+        findings.extend(_check_global_rebinds(src))
+    return findings

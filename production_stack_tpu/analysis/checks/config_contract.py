@@ -1,0 +1,529 @@
+"""config-contract: flags, helm values, schema, templates and docs agree.
+
+The deployment surface of this project is five-layered: the router's
+argparse flags (``router/parser.py``), the engine's
+:class:`EngineConfig` fields, ``helm/values.yaml``, the values schema
+(``helm/values.schema.json``), the deployment templates that turn values
+into flags, and the docs flag tables. Before this check they drifted
+silently — a values knob the template never emitted was "configured"
+and ignored, a flag default changed without its values twin, a schema
+key outlived its knob. Each of those is a real user-facing bug.
+
+:mod:`production_stack_tpu.analysis.config_registry` is the single
+source of truth; this check proves it against every surface, both
+directions:
+
+- **parser <-> registry**: every router ``add_argument`` flag has a
+  :class:`ConfigSpec`; every spec's flag exists in the parser.
+- **helm-scoped flags**: the values path exists in values.yaml AND in
+  the schema, the template emits the flag, and the parser default equals
+  the values.yaml default (``default_differs`` documents deliberate
+  divergence — empty reason = drift).
+- **cli-only flags**: NOT emitted by any template (emission means the
+  flag silently grew a helm surface and must be reclassified).
+- **reverse helm sweep**: every ``routerSpec.*`` leaf in values.yaml and
+  in the schema is claimed by a spec or by ``ROUTER_HELM_NON_FLAG``;
+  schema keys must also exist in values.yaml (a schema-only key is a
+  ghost knob).
+- **engine**: every ``EngineConfig`` field has an
+  :class:`EngineFieldSpec` (and vice versa), declared flags exist in
+  ``engine/server.py``'s parser, helm-backed fields are in the schema
+  and emitted by the engine template, and values.yaml engineConfig
+  defaults match the dataclass defaults unless reasoned.
+- **docs**: every router flag's ``doc`` file mentions the flag.
+
+The registry is executed from the scanned tree (stdlib-only module), so
+fixtures can carry their own registry; helm/docs anchors resolve from
+the project root, so subset lints see the same contract a full lint
+does. Suppress with ``# pstlint: disable=config-contract(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import simpleyaml
+from ..core import Finding, Project, SourceFile
+
+CHECK_ID = "config-contract"
+DESCRIPTION = (
+    "router flags / EngineConfig fields <-> config_registry <-> helm "
+    "values/schema/templates <-> docs, both directions"
+)
+
+_REGISTRY_REL = "analysis/config_registry.py"
+_PARSER_REL = "router/parser.py"
+_ENGINE_CONFIG_REL = "engine/config.py"
+_ENGINE_SERVER_REL = "engine/server.py"
+_VALUES_REL = "helm/values.yaml"
+_SCHEMA_REL = "helm/values.schema.json"
+
+
+def _flag_re(flag: str) -> "re.Pattern[str]":
+    return re.compile(r"(?<![\w-])%s(?![\w-])" % re.escape(flag))
+
+
+def _emits(template_text: str, flag: str) -> bool:
+    return bool(_flag_re(flag).search(template_text))
+
+
+class _ParsedFlag:
+    def __init__(self, flag: str, default: Any, action: Optional[str],
+                 line: int) -> None:
+        self.flag = flag
+        self.default = default
+        self.action = action
+        self.line = line
+
+
+def parser_flags(src: SourceFile) -> Dict[str, _ParsedFlag]:
+    """flag -> (default, action, line) from ``add_argument`` calls."""
+    out: Dict[str, _ParsedFlag] = {}
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            continue
+        names = [
+            a.value for a in node.args
+            if isinstance(a, ast.Constant) and isinstance(a.value, str)
+        ]
+        if not names or not names[0].startswith("--"):
+            continue
+        default: Any = None
+        action: Optional[str] = None
+        for kw in node.keywords:
+            if kw.arg == "default":
+                try:
+                    default = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    default = None
+            elif kw.arg == "action" and isinstance(kw.value, ast.Constant):
+                action = str(kw.value.value)
+        if action == "store_true" and default is None:
+            default = False
+        out[names[0]] = _ParsedFlag(names[0], default, action, node.lineno)
+    return out
+
+
+def parser_option_strings(src: SourceFile) -> List[str]:
+    """Every option string (including aliases) across add_argument calls."""
+    out: List[str] = []
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            out.extend(
+                a.value for a in node.args
+                if isinstance(a, ast.Constant) and isinstance(a.value, str)
+            )
+    return out
+
+
+def engine_config_fields(src: SourceFile) -> Dict[str, Tuple[Any, int]]:
+    """field -> (default, line) from the EngineConfig dataclass body."""
+    out: Dict[str, Tuple[Any, int]] = {}
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "EngineConfig"):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                default: Any = None
+                if stmt.value is not None:
+                    try:
+                        default = ast.literal_eval(stmt.value)
+                    except (ValueError, SyntaxError):
+                        default = None
+                out[stmt.target.id] = (default, stmt.lineno)
+    return out
+
+
+def _exec_registry(src: SourceFile) -> Optional[Dict[str, Any]]:
+    """Execute the (stdlib-only) registry module from the scanned tree so
+    fixtures can carry their own registry. A real (temporary) module
+    entry is needed because ``@dataclass`` resolves string annotations
+    through ``sys.modules[cls.__module__]``."""
+    import sys
+    import types
+
+    mod_name = "pstlint_config_registry_under_lint"
+    module = types.ModuleType(mod_name)
+    sys.modules[mod_name] = module
+    try:
+        code = compile(src.text, src.rel, "exec")
+        exec(code, module.__dict__)  # noqa: S102 — our own registry module
+    except Exception:
+        return None
+    finally:
+        sys.modules.pop(mod_name, None)
+    return dict(module.__dict__)
+
+
+def _norm(value: Any) -> Any:
+    """Normalize for default comparison: None ≈ "" ≈ [], numbers by
+    value (5 == 5.0), everything else as-is."""
+    if value is None or value == "" or value == []:
+        return None
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)):
+        return float(value)
+    return value
+
+
+def _schema_has(schema: Any, path: str) -> bool:
+    cur = schema
+    for part in path.split("."):
+        take_first = part.endswith("[]")
+        key = part[:-2] if take_first else part
+        if not isinstance(cur, dict):
+            return False
+        props = cur.get("properties")
+        if not isinstance(props, dict) or key not in props:
+            return False
+        cur = props[key]
+        if take_first:
+            if not isinstance(cur, dict) or "items" not in cur:
+                return False
+            cur = cur["items"]
+    return True
+
+
+def _read_text(root: Path, rel: str) -> Optional[str]:
+    path = root / rel
+    if not path.exists():
+        return None
+    return path.read_text(encoding="utf-8")
+
+
+def _claimed(path: str, claimed_paths: Sequence[str],
+             allow_prefixes: Sequence[str]) -> bool:
+    for c in claimed_paths:
+        if path == c or path.startswith(c + "."):
+            return True
+    for p in allow_prefixes:
+        if path == p or path.startswith(p + "."):
+            return True
+    return False
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    parser_src = project.resolve(_PARSER_REL)
+    registry_src = project.resolve(_REGISTRY_REL)
+    if parser_src is None:
+        return findings  # nothing to check against in this tree
+    if registry_src is None:
+        findings.append(Finding(
+            CHECK_ID, parser_src.rel, 1, 0,
+            "router flags exist but no %s declares the configuration "
+            "contract" % _REGISTRY_REL,
+        ))
+        return findings
+    namespace = _exec_registry(registry_src)
+    if namespace is None:
+        findings.append(Finding(
+            CHECK_ID, registry_src.rel, 1, 0,
+            "config registry failed to execute — it must stay a "
+            "stdlib-only module the analyzer can load on a bare checkout",
+        ))
+        return findings
+    router_specs = list(namespace.get("ROUTER_FLAGS") or ())
+    engine_specs = list(namespace.get("ENGINE_FIELDS") or ())
+    non_flag = tuple(namespace.get("ROUTER_HELM_NON_FLAG") or ())
+
+    flags = parser_flags(parser_src)
+    by_flag = {s.flag: s for s in router_specs}
+
+    # -- parser <-> registry, both directions ------------------------------
+    for flag, parsed in sorted(flags.items()):
+        if flag not in by_flag:
+            findings.append(Finding(
+                CHECK_ID, parser_src.rel, parsed.line, 0,
+                "flag %r has no ConfigSpec in %s — declare it (helm-backed, "
+                "template-derived, or cli-only with a reason) so the helm/"
+                "schema/docs surfaces stay provably in sync" % (
+                    flag, registry_src.rel),
+            ))
+    for spec in router_specs:
+        if spec.flag not in flags:
+            findings.append(Finding(
+                CHECK_ID, registry_src.rel, 1, 0,
+                "ConfigSpec %r names a flag router/parser.py does not "
+                "define — stale declaration" % spec.flag,
+            ))
+
+    # -- helm anchors ------------------------------------------------------
+    values_text = _read_text(project.root, _VALUES_REL)
+    schema_text = _read_text(project.root, _SCHEMA_REL)
+    values: Any = None
+    schema: Any = None
+    if values_text is not None:
+        try:
+            values = simpleyaml.parse(values_text)
+        except simpleyaml.SimpleYamlError as e:
+            findings.append(Finding(
+                CHECK_ID, registry_src.rel, 1, 0,
+                "%s is outside the analyzer's YAML subset (%s) — simplify "
+                "it or extend analysis/simpleyaml.py" % (_VALUES_REL, e),
+            ))
+    if schema_text is not None:
+        try:
+            schema = json.loads(schema_text)
+        except ValueError:
+            findings.append(Finding(
+                CHECK_ID, registry_src.rel, 1, 0,
+                "%s is not valid JSON" % _SCHEMA_REL,
+            ))
+    templates: Dict[str, Optional[str]] = {}
+
+    def template_text(rel: Optional[str]) -> Optional[str]:
+        if rel is None:
+            return None
+        if rel not in templates:
+            templates[rel] = _read_text(project.root, rel)
+        return templates[rel]
+
+    docs: Dict[str, Optional[str]] = {}
+
+    def doc_text(rel: str) -> Optional[str]:
+        if rel not in docs:
+            docs[rel] = _read_text(project.root, rel)
+        return docs[rel]
+
+    all_template_text = ""
+    for rel in (
+        namespace.get("ROUTER_TEMPLATE"), namespace.get("ENGINE_TEMPLATE")
+    ):
+        text = template_text(rel if isinstance(rel, str) else None)
+        if text:
+            all_template_text += text
+
+    # -- per-spec surface checks ------------------------------------------
+    helm_scope = str(namespace.get("HELM", "helm"))
+    tpl_scope = str(namespace.get("TEMPLATE", "template"))
+    cli_scope = str(namespace.get("CLI_ONLY", "cli-only"))
+    claimed_router_paths = [
+        s.helm for s in router_specs if s.scope == helm_scope and s.helm
+    ]
+
+    for spec in router_specs:
+        parsed = flags.get(spec.flag)
+        if parsed is None:
+            continue  # already reported as stale
+        tpl = template_text(spec.template)
+        if spec.scope == helm_scope:
+            if not spec.helm:
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "helm-scoped spec %r declares no values path" % spec.flag,
+                ))
+                continue
+            if values is not None:
+                found, helm_default = simpleyaml.resolve(values, spec.helm)
+                if not found:
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "spec %r claims helm path %r but %s has no such "
+                        "key — users cannot set the knob the contract "
+                        "promises" % (spec.flag, spec.helm, _VALUES_REL),
+                    ))
+                elif not spec.default_differs and not spec.negation_of:
+                    if _norm(helm_default) != _norm(parsed.default):
+                        findings.append(Finding(
+                            CHECK_ID, parser_src.rel, parsed.line, 0,
+                            "default drift for %s: parser default %r != "
+                            "values.yaml %s default %r — change both "
+                            "together, or record the reason in the spec's "
+                            "default_differs" % (
+                                spec.flag, parsed.default, spec.helm,
+                                helm_default),
+                        ))
+            if schema is not None and not _schema_has(schema, spec.helm):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "spec %r: helm path %r is absent from %s — helm lint "
+                    "would reject the documented knob" % (
+                        spec.flag, spec.helm, _SCHEMA_REL),
+                ))
+            emit = getattr(spec, "emit", None) or spec.flag
+            if tpl is not None and not _emits(tpl, emit):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "spec %r: %s never emits %r — the values knob is "
+                    "configured and silently ignored by the pod" % (
+                        spec.flag, spec.template, emit),
+                ))
+        elif spec.scope == tpl_scope:
+            if tpl is not None and not _emits(tpl, spec.flag):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "template-scoped spec %r: %s never emits the flag" % (
+                        spec.flag, spec.template),
+                ))
+        elif spec.scope == cli_scope:
+            if all_template_text and _emits(all_template_text, spec.flag):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "cli-only spec %r IS emitted by a helm template — it "
+                    "grew a helm surface; reclassify it as helm/template "
+                    "scoped with the proper values path" % spec.flag,
+                ))
+        else:
+            findings.append(Finding(
+                CHECK_ID, registry_src.rel, 1, 0,
+                "spec %r has unknown scope %r" % (spec.flag, spec.scope),
+            ))
+        # Docs row (every scope): the doc file must mention the flag.
+        dtext = doc_text(spec.doc)
+        if dtext is not None and not _flag_re(spec.flag).search(dtext):
+            findings.append(Finding(
+                CHECK_ID, registry_src.rel, 1, 0,
+                "flag %s is not documented in %s (its declared doc "
+                "file) — the flag table is the operator contract" % (
+                    spec.flag, spec.doc),
+            ))
+
+    # -- reverse sweep: routerSpec values/schema leaves --------------------
+    if values is not None and isinstance(values, dict):
+        router_values = values.get("routerSpec")
+        for path in simpleyaml.leaf_paths(
+            router_values if isinstance(router_values, dict) else {},
+            "routerSpec",
+        ):
+            if not _claimed(path, claimed_router_paths, non_flag):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "values.yaml knob %r is claimed by no ConfigSpec and "
+                    "is not in ROUTER_HELM_NON_FLAG — a knob no flag "
+                    "consumes is configuration theater" % path,
+                ))
+    if schema is not None and values is not None:
+        props = schema.get("properties") if isinstance(schema, dict) else None
+        router_schema = (
+            props.get("routerSpec") if isinstance(props, dict) else None
+        )
+
+        def schema_leaves(node: Any, prefix: str) -> List[str]:
+            out: List[str] = []
+            if isinstance(node, dict) and isinstance(
+                node.get("properties"), dict
+            ):
+                for key, sub in node["properties"].items():
+                    out.extend(
+                        schema_leaves(sub, "%s.%s" % (prefix, key))
+                    )
+            else:
+                out.append(prefix)
+            return out
+
+        if isinstance(router_schema, dict):
+            for path in schema_leaves(router_schema, "routerSpec"):
+                if not _claimed(path, claimed_router_paths, non_flag):
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "schema key %r is claimed by no ConfigSpec and is "
+                        "not in ROUTER_HELM_NON_FLAG" % path,
+                    ))
+                    continue
+                found, _ = simpleyaml.resolve(values, path)
+                if not found:
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "schema key %r has no values.yaml counterpart — a "
+                        "schema-only key is a ghost knob (add the default "
+                        "to values.yaml or drop it from the schema)" % path,
+                    ))
+
+    # -- engine half -------------------------------------------------------
+    engine_cfg_src = project.resolve(_ENGINE_CONFIG_REL)
+    if engine_cfg_src is not None and engine_specs:
+        fields = engine_config_fields(engine_cfg_src)
+        by_field = {s.field: s for s in engine_specs}
+        for name, (default, line) in sorted(fields.items()):
+            if name not in by_field:
+                findings.append(Finding(
+                    CHECK_ID, engine_cfg_src.rel, line, 0,
+                    "EngineConfig field %r has no EngineFieldSpec in %s"
+                    % (name, registry_src.rel),
+                ))
+        engine_server_src = project.resolve(_ENGINE_SERVER_REL)
+        engine_options = (
+            parser_option_strings(engine_server_src)
+            if engine_server_src is not None else []
+        )
+        engine_tpl_rel = namespace.get("ENGINE_TEMPLATE")
+        engine_tpl = template_text(
+            engine_tpl_rel if isinstance(engine_tpl_rel, str) else None
+        )
+        for spec in engine_specs:
+            if spec.field not in fields:
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "EngineFieldSpec %r names a field EngineConfig does "
+                    "not define — stale declaration" % spec.field,
+                ))
+                continue
+            if (
+                spec.flag is not None
+                and engine_options
+                and spec.flag not in engine_options
+            ):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "EngineFieldSpec %r declares flag %r, which "
+                    "engine/server.py's parser does not define" % (
+                        spec.field, spec.flag),
+                ))
+            if spec.helm:
+                if schema is not None and not _schema_has(schema, spec.helm):
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "EngineFieldSpec %r: helm path %r absent from %s"
+                        % (spec.field, spec.helm, _SCHEMA_REL),
+                    ))
+                emit = spec.emit or spec.flag
+                if (
+                    engine_tpl is not None
+                    and emit is not None
+                    and not _emits(engine_tpl, emit)
+                ):
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "EngineFieldSpec %r: engine template never emits "
+                        "%r — the %r values knob is configured and "
+                        "silently ignored" % (spec.field, emit, spec.helm),
+                    ))
+                if values is not None and not spec.default_differs:
+                    found, helm_default = simpleyaml.resolve(values, spec.helm)
+                    if found and _norm(helm_default) != _norm(
+                        fields[spec.field][0]
+                    ):
+                        findings.append(Finding(
+                            CHECK_ID, engine_cfg_src.rel,
+                            fields[spec.field][1], 0,
+                            "default drift for EngineConfig.%s: dataclass "
+                            "default %r != values.yaml %s default %r — "
+                            "change both together or record "
+                            "default_differs" % (
+                                spec.field, fields[spec.field][0],
+                                spec.helm, helm_default),
+                        ))
+    return findings

@@ -1,0 +1,226 @@
+"""lock-order: no awaits while holding an annotated lock; no order cycles.
+
+Builds on the ``owned-by=lock:<attr>`` annotations lock-discipline
+introduced (PR 7/9): those name the locks that guard shared router/engine
+state. Two new rules ride the same grammar:
+
+1. **await-under-lock** — inside a ``with``/``async with`` region that
+   acquires an annotated lock, no ``await`` may appear (nested function
+   bodies excluded — they run elsewhere). For an ``asyncio`` lock this is
+   a latency/consistency hazard: the holder parks mid-critical-section
+   and every other task serializes behind a suspended coroutine (the
+   hashtrie walk rule — materialize, release, THEN await — exists
+   precisely to avoid this). For a *sync* ``threading`` lock acquired in
+   a coroutine it is worse: the lock is held across a suspension point on
+   the event-loop thread, and any other coroutine trying to take it
+   blocks the whole loop.
+2. **lock-order** — every *nesting* of one annotated lock's region inside
+   another's (same file or not) contributes a directed edge
+   ``outer -> inner`` to a tree-wide acquisition-order graph; a cycle in
+   that graph is an ABBA deadlock waiting for the right interleaving, and
+   fails the lint naming the cycle.
+
+Known limits (documented approximations): locks are identified by their
+*attribute name* tree-wide — two unrelated locks that share a name merge
+into one graph node (rename one), and hand-over-hand locking on a
+hierarchy of SAME-named locks (the hashtrie's per-node ``lock``) is
+deliberately exempt from the order graph (a self-edge is not an ABBA).
+Suppress with ``# pstlint: disable=lock-order(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from ..core import Finding, Project, SourceFile
+
+CHECK_ID = "lock-order"
+DESCRIPTION = (
+    "no await inside an annotated-lock region; lock-acquisition-order "
+    "graph must be acyclic"
+)
+
+
+def _lock_attrs(src: SourceFile) -> Set[str]:
+    """Lock attribute names declared by ``owned-by=lock:<attr>``
+    annotations in this file."""
+    out: Set[str] = set()
+    for ann in src.annotations.values():
+        value = ann.get("owned-by")
+        if value is None:
+            continue
+        kind, _, spec = value.partition(":")
+        if kind.strip() == "lock" and spec.strip():
+            out.add(spec.strip())
+    return out
+
+
+def _acquired_lock(item: ast.withitem, locks: Set[str]) -> Optional[str]:
+    """The annotated lock attr this with-item acquires, if any: matches
+    ``<recv>.<attr>`` and bare ``<attr>`` context expressions, including
+    ``<lock>.acquire_timeout()``-style wrapper calls on the lock."""
+    expr: ast.AST = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            # with self._lock.something(): the receiver is the lock.
+            if expr.attr not in locks:
+                expr = expr.value
+    if isinstance(expr, ast.Attribute) and expr.attr in locks:
+        return expr.attr
+    if isinstance(expr, ast.Name) and expr.id in locks:
+        return expr.id
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    """Tracks the stack of held annotated locks; records awaits under
+    them and nesting edges between them."""
+
+    def __init__(self, src: SourceFile, locks: Set[str]) -> None:
+        self.src = src
+        self.locks = locks
+        self.findings: List[Finding] = []
+        # (attr, is_async_with) innermost-last.
+        self.held: List[Tuple[str, bool]] = []
+        # outer -> {inner}, with one witness site per edge.
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    # -- scope handling ----------------------------------------------------
+
+    def _visit_func(self, node: ast.AST) -> None:
+        saved = self.held
+        self.held = []  # a nested def's body runs outside this region
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+    visit_Lambda = _visit_func
+
+    # -- with regions ------------------------------------------------------
+
+    def _visit_with(
+        self, node: Union[ast.With, ast.AsyncWith], is_async: bool
+    ) -> None:
+        # Runtime order: item 1's context expr evaluates BEFORE any lock
+        # of this statement is held, item 2's evaluates while item 1's
+        # lock IS held, and so on — so each context expr is visited with
+        # exactly the locks acquired so far on the held stack, then the
+        # item's own lock (if annotated) is pushed for the rest.
+        acquired: List[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            attr = _acquired_lock(item, self.locks)
+            if attr is None:
+                continue
+            for outer, _ in self.held:
+                if outer != attr:
+                    self.edges.setdefault(
+                        (outer, attr), (self.src.rel, node.lineno)
+                    )
+            acquired.append(attr)
+            self.held.append((attr, is_async))
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node, is_async=True)
+
+    # -- the await rule ----------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.held:
+            attr, is_async = self.held[-1]
+            if is_async:
+                msg = (
+                    "await while holding annotated asyncio lock %r: the "
+                    "critical section parks mid-flight and every waiter "
+                    "serializes behind a suspended coroutine — copy what "
+                    "you need, release, then await (hashtrie walk rule)"
+                    % attr
+                )
+            else:
+                msg = (
+                    "await while holding annotated SYNC lock %r: the "
+                    "thread lock stays held across a suspension point, so "
+                    "any coroutine contending for it blocks the entire "
+                    "event loop" % attr
+                )
+            self.findings.append(Finding(
+                CHECK_ID, self.src.rel, node.lineno, node.col_offset, msg
+            ))
+        self.generic_visit(node)
+
+
+def _find_cycle(
+    edges: Dict[Tuple[str, str], Tuple[str, int]]
+) -> Optional[List[str]]:
+    """First cycle in the order graph (DFS), as the node path, or None."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    path: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        path.append(n)
+        for m in sorted(graph[n]):
+            if color[m] == GRAY:
+                return path[path.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc is not None:
+                    return cyc
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    all_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for src in project.files:
+        if src.tree is None:
+            continue
+        locks = _lock_attrs(src)
+        if not locks:
+            continue
+        v = _Visitor(src, locks)
+        v.visit(src.tree)
+        findings.extend(v.findings)
+        for edge, site in v.edges.items():
+            all_edges.setdefault(edge, site)
+    cycle = _find_cycle(all_edges)
+    if cycle is not None:
+        # Attribute the finding to a witness edge on the cycle.
+        first_edge = (cycle[0], cycle[1])
+        rel, line = all_edges.get(
+            first_edge, next(iter(all_edges.values()))
+        )
+        findings.append(Finding(
+            CHECK_ID, rel, line, 0,
+            "lock-acquisition-order cycle: %s — two tasks taking these "
+            "locks in opposite orders deadlock under the right "
+            "interleaving; pick one global order and refactor the "
+            "acquisition against it" % " -> ".join(cycle),
+        ))
+    return findings

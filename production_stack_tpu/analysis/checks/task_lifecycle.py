@@ -1,0 +1,275 @@
+"""task-lifecycle: every spawned task is owned, observed, and cancellable.
+
+``asyncio`` holds only *weak* references to tasks: a fire-and-forget
+``asyncio.create_task(...)`` can be garbage-collected mid-await (the
+PR 10 review found trie-eviction walks collectable mid-walk), and a task
+whose exception nobody reads dies silently — the scrape/canary/gossip
+loop is simply gone until the metrics flatline. This check makes the
+lifecycle contract machine-checked at every ``create_task`` /
+``ensure_future`` site tree-wide.
+
+A site is compliant when ONE of the following holds:
+
+1. **Owned**: the site carries ``# pstlint: task-owner=<name>`` (on the
+   call's line or the line above) AND the enclosing function stores the
+   task under ``<name>`` (attribute ``self.<name> = ...``, subscript
+   ``app["<name>"] = ...``, or a registry call ``<name>.add(task)``) AND
+   the file contains a cancellation path for ``<name>`` (a ``.cancel()``
+   whose receiver resolves — through one level of local assignment or a
+   for-loop target — to an expression mentioning ``<name>``).
+2. **Awaited**: the task is bound to a local name that the enclosing
+   function actually consumes again — ``await``, ``asyncio.gather`` /
+   ``asyncio.wait`` / ``wait_for``, ``add_done_callback``, ``.result()``
+   — so its exception has an observer. (A local that is *never read
+   again* is fire-and-forget with extra steps.)
+3. **Suppressed** with a reason
+   (``# pstlint: disable=task-lifecycle(<why>)``).
+
+The sanctioned helper :func:`production_stack_tpu.obs.tasks.spawn_owned`
+satisfies the contract once, internally (strong registry reference +
+logging done-callback), so call sites using it contain no raw
+``create_task`` and need nothing.
+
+Known limits (documented approximation, same spirit as lock-discipline):
+name matching is textual within the declaring file; a cancellation path
+in a *different* module is invisible — move it or suppress with the
+location as the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, SourceFile
+
+CHECK_ID = "task-lifecycle"
+DESCRIPTION = (
+    "create_task/ensure_future sites must be owner-annotated (with a "
+    "cancellation path), awaited, or via obs.tasks.spawn_owned"
+)
+
+_SPAWN_NAMES = {"create_task", "ensure_future"}
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _SPAWN_NAMES
+    if isinstance(func, ast.Name):
+        return func.id in _SPAWN_NAMES
+    return False
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — exotic node
+        return ""
+
+
+def _scoped_walk(func: ast.AST) -> List[ast.AST]:
+    """Walk ``func``'s body without descending into nested function
+    scopes (a nested def's locals are not this function's)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _FuncInfo:
+    """Per-function facts needed to judge the spawn sites inside it."""
+
+    def __init__(self, func: ast.AST) -> None:
+        self.func = func
+        # Local name -> RHS expr (one level; for-loop targets map to the
+        # iterable) for cancel-receiver resolution.
+        self.assigns: Dict[str, ast.AST] = {}
+        # Names read (Load ctx) with their line numbers.
+        self.loads: List[Tuple[str, int]] = []
+        self.awaited_names: List[str] = []
+        for node in _scoped_walk(func):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.assigns[tgt.id] = node.value
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if isinstance(node.target, ast.Name):
+                    self.assigns[node.target.id] = node.iter
+            elif isinstance(node, ast.withitem):
+                if isinstance(node.optional_vars, ast.Name):
+                    self.assigns[node.optional_vars.id] = node.context_expr
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                self.loads.append((node.id, node.lineno))
+            elif isinstance(node, ast.Await):
+                inner = node.value
+                if isinstance(inner, ast.Name):
+                    self.awaited_names.append(inner.id)
+
+    def reads_after(self, name: str, line: int) -> bool:
+        return any(n == name and ln > line for n, ln in self.loads)
+
+
+def _owner_stored(func: ast.AST, owner: str) -> bool:
+    """Does the function store a task under ``owner``? (attribute /
+    subscript assignment target, or an ``<owner>.add/append(...)`` call)."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    if owner in _unparse(tgt):
+                        return True
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("add", "append")
+                and owner in _unparse(f.value)
+            ):
+                return True
+    return False
+
+
+def _file_cancels(src: SourceFile, owner: str) -> bool:
+    """Does any ``.cancel()`` in the file target ``owner`` (directly, or
+    through one level of local assignment / for-target resolution)?"""
+    if src.tree is None:
+        return False
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info: Optional[_FuncInfo] = None
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "cancel"
+            ):
+                continue
+            recv = node.func.value
+            text = _unparse(recv)
+            if owner in text:
+                return True
+            if isinstance(recv, ast.Name):
+                if info is None:
+                    info = _FuncInfo(fn)
+                resolved = info.assigns.get(recv.id)
+                if resolved is not None and owner in _unparse(resolved):
+                    return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self.func_stack: List[ast.AST] = []
+
+    def _visit_func(self, node: ast.AST) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+    visit_Lambda = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_spawn(node):
+            self._check_site(node)
+        self.generic_visit(node)
+
+    # -- the rule ----------------------------------------------------------
+
+    def _check_site(self, call: ast.Call) -> None:
+        owner = self.src.annotation_at(call.lineno, "task-owner")
+        func = self.func_stack[-1] if self.func_stack else None
+        if owner is not None:
+            owner = owner.strip()
+            stored = func is not None and _owner_stored(func, owner)
+            if not stored and self.src.tree is not None:
+                # Module-level spawn (rare) — search the whole module.
+                stored = _owner_stored(self.src.tree, owner)
+            if not stored:
+                self.findings.append(Finding(
+                    CHECK_ID, self.src.rel, call.lineno, call.col_offset,
+                    "task-owner=%r is declared but the task is never stored "
+                    "under %r here (assign to an attribute/key named %r or "
+                    "add() it to that registry) — a dangling annotation is "
+                    "an unowned task with paperwork" % (owner, owner, owner),
+                ))
+                return
+            if not _file_cancels(self.src, owner):
+                self.findings.append(Finding(
+                    CHECK_ID, self.src.rel, call.lineno, call.col_offset,
+                    "task stored under %r has no cancellation path in this "
+                    "file: no '.cancel()' ever targets it, so app shutdown "
+                    "leaks the task (add a close() that cancels it, or "
+                    "suppress with the out-of-file canceller as the reason)"
+                    % owner,
+                ))
+            return
+
+        # No annotation: the site must bind a local the function consumes.
+        parent = self._binding_name(call)
+        if parent is None:
+            self.findings.append(Finding(
+                CHECK_ID, self.src.rel, call.lineno, call.col_offset,
+                "fire-and-forget task: asyncio keeps only weak task refs "
+                "(GC can collect it mid-await) and its exception is never "
+                "observed — use obs.tasks.spawn_owned(), store it on an "
+                "annotated owner ('# pstlint: task-owner=<attr>' with a "
+                "cancellation path), or await/gather it",
+            ))
+            return
+        if func is None:
+            return  # module-level local binding: nothing to judge
+        info = _FuncInfo(func)
+        if parent in info.awaited_names or info.reads_after(
+            parent, call.lineno
+        ):
+            return
+        self.findings.append(Finding(
+            CHECK_ID, self.src.rel, call.lineno, call.col_offset,
+            "task bound to %r is never consumed again in this function "
+            "(no await/gather/wait/add_done_callback/read) — its exception "
+            "is unobserved and the reference dies with the frame; use "
+            "obs.tasks.spawn_owned() or actually await it" % parent,
+        ))
+
+    def _binding_name(self, call: ast.Call) -> Optional[str]:
+        """The local name the spawn's result is bound to, when the site is
+        a simple ``name = create_task(...)`` / ``name = ensure_future(...)``
+        (attribute/subscript targets require the task-owner annotation;
+        other expression positions count as unbound)."""
+        func = self.func_stack[-1] if self.func_stack else None
+        scope = func if func is not None else self.src.tree
+        if scope is None:
+            return None
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        return tgt.id
+                return None
+            if isinstance(node, ast.AnnAssign) and node.value is call:
+                if isinstance(node.target, ast.Name):
+                    return node.target.id
+                return None
+        return None
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        v = _Visitor(src)
+        v.visit(src.tree)
+        findings.extend(v.findings)
+    return findings

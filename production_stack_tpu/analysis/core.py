@@ -40,7 +40,7 @@ _DISABLE_RE = re.compile(
 # Annotation directives: key=value where value runs to end-of-comment
 # (values may contain commas, colons and spaces; never a second '=').
 _ANNOTATION_RE = re.compile(
-    r"(?P<key>owned-by|jit-family|holds)=(?P<value>[^=]+?)\s*$"
+    r"(?P<key>owned-by|jit-family|holds|task-owner)=(?P<value>[^=]+?)\s*$"
 )
 
 

@@ -4,11 +4,16 @@ Usage::
 
     python -m production_stack_tpu.analysis.pstlint production_stack_tpu/ scripts/
     pst-lint --format json production_stack_tpu/
+    pst-lint --format sarif production_stack_tpu/ > pstlint.sarif
     pst-lint --checks async-blocking,hop-contract production_stack_tpu/router/
 
 Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
 findings, 2 = usage error. ``--format json`` emits a machine-readable
-report (list of finding objects + summary) for CI annotation tooling.
+report (list of finding objects + summary) for CI annotation tooling;
+``--format sarif`` emits SARIF 2.1.0 so CI can upload findings as PR
+diff annotations (``github/codeql-action/upload-sarif``). Both formats
+are covered by a schema-stability test (tests/test_pstlint.py) — the
+key sets below are a consumed contract, not an implementation detail.
 """
 
 from __future__ import annotations
@@ -21,6 +26,75 @@ from typing import List, Optional, Sequence
 
 from .checks import ALL_CHECKS, CHECKS_BY_ID
 from .core import Finding, apply_suppressions, iter_py_files, load_project
+
+# SARIF 2.1.0 constants (the schema-stability test pins these).
+_SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings: Sequence[Finding]) -> dict:
+    """Render findings as one SARIF 2.1.0 run.
+
+    Suppressed findings are included with a ``suppressions`` entry (kind
+    ``inSource`` — the ``# pstlint: disable=...(reason)`` comment) so the
+    upload shows them as reviewed, not hidden.
+    """
+    rules = sorted({f.check for f in findings} | {c.CHECK_ID for c in ALL_CHECKS})
+    descriptions = {c.CHECK_ID: c.DESCRIPTION for c in ALL_CHECKS}
+    results = []
+    for f in findings:
+        result: dict = {
+            "ruleId": f.check,
+            "level": "note" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": max(f.col + 1, 1),
+                    },
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.reason or "",
+            }]
+        results.append(result)
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": _SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "pstlint",
+                    "informationUri": (
+                        "https://github.com/production-stack-tpu/"
+                        "production-stack-tpu/blob/main/docs/"
+                        "static-analysis.md"
+                    ),
+                    "rules": [
+                        {
+                            "id": rule,
+                            "shortDescription": {
+                                "text": descriptions.get(rule, rule)
+                            },
+                        }
+                        for rule in rules
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -35,7 +109,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated subset of checks to run (default: all)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt"
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt",
     )
     parser.add_argument(
         "--root",
@@ -136,6 +211,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "suppressed": len(suppressed),
             },
         }, indent=2))
+    elif args.fmt == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2))
     else:
         shown = findings if args.show_suppressed else active
         for f in shown:

@@ -1,0 +1,289 @@
+"""A deliberately small YAML-subset reader for the config-contract check.
+
+pstlint is stdlib-only (the CI lint ring installs nothing), but the
+config-contract check must read ``helm/values.yaml``. This module parses
+exactly the subset that file uses — nested mappings by indentation,
+scalars (quoted/unquoted strings, ints, floats, bools, null), block
+lists (``- `` items, scalar or mapping), and inline flow ``{...}`` /
+``[...]`` — and *fails loudly* on anything it does not understand, so a
+values.yaml grown past the subset surfaces as a lint error instead of a
+silently wrong parse. Anchors, tags, multi-document streams, and block
+scalars are out of scope on purpose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class SimpleYamlError(ValueError):
+    """values.yaml used syntax outside the supported subset."""
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``# ...`` comment (quote-aware)."""
+    out: List[str] = []
+    quote: Optional[str] = None
+    for i, ch in enumerate(line):
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            out.append(ch)
+            continue
+        if ch in "\"'":
+            quote = ch
+            out.append(ch)
+            continue
+        if ch == "#" and (i == 0 or line[i - 1] in " \t"):
+            break
+        out.append(ch)
+    return "".join(out).rstrip()
+
+
+def _lines(text: str) -> List[Tuple[int, str, int]]:
+    """(indent, content, lineno) for each non-empty, non-comment line."""
+    out: List[Tuple[int, str, int]] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        if "\t" in raw[: len(raw) - len(raw.lstrip())]:
+            raise SimpleYamlError("tab indentation at line %d" % lineno)
+        stripped = raw.lstrip(" ")
+        if not stripped or stripped.startswith("#"):
+            continue
+        content = _strip_comment(stripped)
+        if not content:
+            continue
+        out.append((len(raw) - len(stripped), content, lineno))
+    return out
+
+
+def _scalar(text: str, lineno: int) -> Any:
+    text = text.strip()
+    if text.startswith("{") or text.startswith("["):
+        return _flow(text, lineno)
+    if len(text) >= 2 and text[0] in "\"'" and text[-1] == text[0]:
+        return text[1:-1]
+    low = text.lower()
+    if low in ("null", "~", ""):
+        return None
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    if low in ("yes", "no", "on", "off"):
+        # YAML 1.1 booleans Helm WOULD honor but this subset deliberately
+        # rejects: silently returning the string would make the
+        # config-contract default comparison wrong, violating the
+        # fail-loudly contract. Quote the string or use true/false.
+        raise SimpleYamlError(
+            "YAML 1.1 boolean %r at line %d — use true/false (or quote "
+            "the string)" % (text, lineno)
+        )
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text
+
+
+def _split_flow(body: str, lineno: int) -> List[str]:
+    """Split a flow body on top-level commas (depth- and quote-aware)."""
+    parts: List[str] = []
+    depth = 0
+    quote: Optional[str] = None
+    cur: List[str] = []
+    for ch in body:
+        if quote is not None:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch in "{[":
+            depth += 1
+            cur.append(ch)
+        elif ch in "}]":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if quote is not None or depth != 0:
+        raise SimpleYamlError("unbalanced flow collection at line %d" % lineno)
+    if "".join(cur).strip():
+        parts.append("".join(cur))
+    return parts
+
+
+def _flow(text: str, lineno: int) -> Any:
+    text = text.strip()
+    if text.startswith("{"):
+        if not text.endswith("}"):
+            raise SimpleYamlError("unterminated flow mapping at line %d" % lineno)
+        out: Dict[str, Any] = {}
+        for part in _split_flow(text[1:-1], lineno):
+            if ":" not in part:
+                raise SimpleYamlError(
+                    "flow mapping entry without ':' at line %d" % lineno
+                )
+            key, _, value = part.partition(":")
+            out[_key(key, lineno)] = _scalar(value, lineno)
+        return out
+    if text.startswith("["):
+        if not text.endswith("]"):
+            raise SimpleYamlError("unterminated flow list at line %d" % lineno)
+        return [_scalar(p, lineno) for p in _split_flow(text[1:-1], lineno)]
+    raise SimpleYamlError("unsupported flow scalar at line %d" % lineno)
+
+
+def _key(text: str, lineno: int) -> str:
+    key = text.strip()
+    if len(key) >= 2 and key[0] in "\"'" and key[-1] == key[0]:
+        key = key[1:-1]
+    if not key:
+        raise SimpleYamlError("empty mapping key at line %d" % lineno)
+    return key
+
+
+def _split_key(content: str, lineno: int) -> Tuple[str, str]:
+    """Split ``key: rest`` at the first colon outside quotes."""
+    quote: Optional[str] = None
+    for i, ch in enumerate(content):
+        if quote is not None:
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+        elif ch == ":" and (i + 1 == len(content) or content[i + 1] in " \t"):
+            return content[:i], content[i + 1:]
+    raise SimpleYamlError("expected 'key: value' at line %d" % lineno)
+
+
+class _Parser:
+    def __init__(self, lines: List[Tuple[int, str, int]]) -> None:
+        self.lines = lines
+        self.pos = 0
+
+    def peek(self) -> Optional[Tuple[int, str, int]]:
+        return self.lines[self.pos] if self.pos < len(self.lines) else None
+
+    def parse_block(self, indent: int) -> Any:
+        head = self.peek()
+        assert head is not None
+        if head[1].startswith("- ") or head[1] == "-":
+            return self.parse_list(indent)
+        return self.parse_map(indent)
+
+    def parse_map(self, indent: int) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        while True:
+            cur = self.peek()
+            if cur is None or cur[0] < indent:
+                return out
+            line_indent, content, lineno = cur
+            if line_indent > indent:
+                raise SimpleYamlError("unexpected indent at line %d" % lineno)
+            if content.startswith("- "):
+                raise SimpleYamlError(
+                    "list item where mapping key expected at line %d" % lineno
+                )
+            key_text, rest = _split_key(content, lineno)
+            key = _key(key_text, lineno)
+            self.pos += 1
+            if rest.strip():
+                out[key] = _scalar(rest, lineno)
+                continue
+            nxt = self.peek()
+            if nxt is None or nxt[0] <= indent:
+                out[key] = None
+                continue
+            out[key] = self.parse_block(nxt[0])
+        return out
+
+    def parse_list(self, indent: int) -> List[Any]:
+        out: List[Any] = []
+        while True:
+            cur = self.peek()
+            if cur is None or cur[0] < indent:
+                return out
+            line_indent, content, lineno = cur
+            if line_indent > indent or not (
+                content.startswith("- ") or content == "-"
+            ):
+                raise SimpleYamlError(
+                    "expected '- ' list item at line %d" % lineno
+                )
+            body = content[2:].strip() if content.startswith("- ") else ""
+            if not body:
+                self.pos += 1
+                nxt = self.peek()
+                if nxt is None or nxt[0] <= indent:
+                    out.append(None)
+                else:
+                    out.append(self.parse_block(nxt[0]))
+                continue
+            if ":" in body and not body.startswith(("{", "[", '"', "'")):
+                # '- key: value' opens a mapping item whose further keys
+                # sit at indent+2 — rewrite the head line and reparse.
+                self.lines[self.pos] = (line_indent + 2, body, lineno)
+                out.append(self.parse_map(line_indent + 2))
+            else:
+                self.pos += 1
+                out.append(_scalar(body, lineno))
+        return out
+
+
+def parse(text: str) -> Any:
+    """Parse the YAML subset; raises :class:`SimpleYamlError` beyond it."""
+    lines = _lines(text)
+    if not lines:
+        return {}
+    parser = _Parser(lines)
+    result = parser.parse_block(lines[0][0])
+    leftover = parser.peek()
+    if leftover is not None:
+        raise SimpleYamlError(
+            "trailing content at line %d (indentation outside the "
+            "document root?)" % leftover[2]
+        )
+    return result
+
+
+def resolve(doc: Any, path: str) -> Tuple[bool, Any]:
+    """Resolve a dotted path like ``routerSpec.fleet.evictionRatio`` or
+    ``servingEngineSpec.modelSpec[].engineConfig.maxModelLen`` (``[]``
+    takes the first list element). Returns ``(found, value)``."""
+    cur = doc
+    for part in path.split("."):
+        take_first = part.endswith("[]")
+        key = part[:-2] if take_first else part
+        if not isinstance(cur, dict) or key not in cur:
+            return False, None
+        cur = cur[key]
+        if take_first:
+            if not isinstance(cur, list) or not cur:
+                return False, None
+            cur = cur[0]
+    return True, cur
+
+
+def leaf_paths(doc: Any, prefix: str = "") -> List[str]:
+    """Dotted paths of every leaf (non-mapping value) under ``doc``.
+    Lists are leaves (helm list knobs are consumed whole)."""
+    out: List[str] = []
+    if isinstance(doc, dict):
+        for key, value in doc.items():
+            sub = "%s.%s" % (prefix, key) if prefix else str(key)
+            if isinstance(value, dict) and value:
+                out.extend(leaf_paths(value, sub))
+            else:
+                out.append(sub)
+    return out

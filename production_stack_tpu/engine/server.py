@@ -45,6 +45,7 @@ from ..obs import (
     render_engine_telemetry,
     render_obs_metrics,
 )
+from ..obs.tasks import spawn_owned
 from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
 from ..protocols import (
     ChatCompletionRequest,
@@ -1706,10 +1707,11 @@ def main(argv=None) -> None:
         engine.start(asyncio.get_event_loop())
         if cfg.cache_controller_url:
             engine_url = cfg.engine_url or f"http://{args.host}:{args.port}"
-            app["controller_task"] = asyncio.create_task(
+            app["controller_task"] = spawn_owned(
                 controller_report_loop(
                     engine, cfg.cache_controller_url, engine_url, 10.0
-                )
+                ),
+                name="engine-controller-report",
             )
 
     async def on_cleanup(app):

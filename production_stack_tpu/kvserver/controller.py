@@ -32,6 +32,7 @@ from aiohttp import web
 
 from ..kvcache.hashing import CHUNK_TOKENS
 from ..logging_utils import init_logger
+from ..obs.tasks import spawn_owned
 
 logger = init_logger(__name__)
 
@@ -143,7 +144,7 @@ def create_controller_app(instance_ttl: float = 120.0) -> web.Application:
             state.expire()
 
     async def _start_expiry(app: web.Application) -> None:
-        app["expire_task"] = asyncio.create_task(_expire_loop(app))
+        app["expire_task"] = spawn_owned(_expire_loop(app), name="kv-controller-expiry")
 
     async def _stop_expiry(app: web.Application) -> None:
         task = app.get("expire_task")

@@ -1,0 +1,90 @@
+"""Owned background tasks: reference-held, exception-observed, cancellable.
+
+``asyncio`` keeps only *weak* references to tasks: a fire-and-forget
+``asyncio.create_task(...)`` whose result nobody stores can be garbage
+collected mid-await (the PR 10 review caught exactly this on the trie
+eviction walks), and a crashed loop task whose exception nobody reads
+dies silently — the scrape/canary/gossip loop is simply gone until an
+operator notices the metrics went flat.
+
+:func:`spawn_owned` is the sanctioned spawn point for background work:
+
+- the task is strongly referenced by a process-wide registry until it
+  finishes (no mid-walk GC),
+- a done-callback *observes* the task's outcome and logs any non-
+  cancellation exception with the task's name (a dead loop is loud),
+- the returned task is still the caller's to cancel — ``close()`` paths
+  keep working unchanged, and :func:`cancel_owned` sweeps whatever is
+  left at shutdown.
+
+The ``task-lifecycle`` pstlint check (docs/static-analysis.md) enforces
+the contract tree-wide: every ``create_task``/``ensure_future`` site must
+either go through this helper, store the task on an annotated owner
+(``# pstlint: task-owner=<attr>``) with a cancellation path, or be a
+local task whose result is actually awaited.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Coroutine, Optional, Set
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# Strong references for every spawn_owned task, process-wide. Tasks are
+# not app state (they die with the loop, not the app), so one registry
+# serves every router app in the process.
+# pstlint: owned-by=task:spawn_owned,_observe,cancel_owned
+_OWNED_TASKS: Set["asyncio.Task[Any]"] = set()
+
+
+def _observe(task: "asyncio.Task[Any]") -> None:
+    """Done-callback: release the strong reference and surface crashes."""
+    _OWNED_TASKS.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.error(
+            "background task %r died: %r", task.get_name(), exc
+        )
+
+
+def spawn_owned(
+    coro: Coroutine[Any, Any, Any], *, name: Optional[str] = None
+) -> "asyncio.Task[Any]":
+    """``create_task`` with a strong reference and exception observation.
+
+    Requires a running event loop (same contract as
+    ``asyncio.create_task``). The caller may keep the returned task for
+    its own cancellation path; the registry reference is dropped by the
+    done-callback either way.
+    """
+    loop = asyncio.get_running_loop()
+    # pstlint: task-owner=_OWNED_TASKS
+    task = loop.create_task(coro, name=name)
+    _OWNED_TASKS.add(task)
+    task.add_done_callback(_observe)
+    return task
+
+
+def owned_task_count() -> int:
+    """Live spawn_owned tasks (tests / diagnostics)."""
+    return sum(1 for t in _OWNED_TASKS if not t.done())
+
+
+def cancel_owned() -> int:
+    """Cancel every still-running owned task (process shutdown sweep).
+
+    Returns the number of tasks cancelled. Individual owners' ``close()``
+    paths normally cancel their own tasks first; this is the backstop so
+    nothing outlives the loop.
+    """
+    cancelled = 0
+    for task in list(_OWNED_TASKS):
+        if not task.done():
+            task.cancel()
+            cancelled += 1
+    return cancelled

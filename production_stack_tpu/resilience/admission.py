@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..logging_utils import init_logger
+from ..obs.tasks import spawn_owned
 from . import metrics
 from .deadline import Deadline
 
@@ -162,8 +163,8 @@ class AdmissionController:
     def _ensure_dispatcher(self) -> None:
         if self._dispatcher is None or self._dispatcher.done():
             self._wakeup = asyncio.Event()
-            self._dispatcher = asyncio.get_running_loop().create_task(
-                self._dispatch_loop()
+            self._dispatcher = spawn_owned(
+                self._dispatch_loop(), name="admission-dispatcher"
             )
 
     async def _dispatch_loop(self) -> None:

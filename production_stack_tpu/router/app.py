@@ -37,18 +37,18 @@ from ..resilience.deadline import (
     min_attempt_budget,
     parse_deadline,
 )
+from ..obs.tasks import spawn_owned
 from ..utils import parse_comma_separated, set_ulimit
+from . import appscope
 from .parser import parse_args
 from .routes import routes
 from .routing.logic import (
     RoutingLogic,
-    get_routing_logic,
     initialize_routing_logic,
     teardown_routing_logic,
 )
 from .service_discovery import (
     ServiceDiscoveryType,
-    get_service_discovery,
     initialize_service_discovery,
     teardown_service_discovery,
 )
@@ -56,14 +56,12 @@ from .state import (
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
-    get_state_backend,
     initialize_state_backend,
     teardown_state_backend,
 )
 from .stats.engine_stats import (
     EngineStatsScraper,
     bind_engine_stats_scraper,
-    get_engine_stats_scraper,
     initialize_engine_stats_scraper,
     unbind_engine_stats_scraper,
 )
@@ -75,7 +73,6 @@ from .stats.request_stats import (
 from .services import metrics_service
 from .services.callbacks import configure_custom_callbacks
 from .services.canary import (
-    get_canary_prober,
     initialize_canary_prober,
     teardown_canary_prober,
 )
@@ -101,7 +98,7 @@ async def _log_stats_loop(app: web.Application, interval: float) -> None:
             # in one process it must report ITS app's snapshot.
             engine_stats = app["engine_stats_scraper"].get_engine_stats()
             request_stats = app["request_stats_monitor"].get_request_stats(time.time())
-            for ep in get_service_discovery().get_endpoint_info():
+            for ep in app["service_discovery"].get_endpoint_info():
                 lines.append(f"Server: {ep.url} models={ep.model_names}")
                 es = engine_stats.get(ep.url)
                 if es:
@@ -187,6 +184,10 @@ async def state_middleware(request: web.Request, handler):
     while in-flight requests run to completion; ``/ready`` flips 503 so
     the load balancer stops sending traffic here.
     """
+    # The app IS the scope: every ambient lookup (discovery, routing
+    # logic, state backend, canary, gates, ...) under this request
+    # resolves the serving app's instances, never another replica's.
+    scope_token = appscope.bind_scope(request.app)
     monitor = request.app.get("request_stats_monitor")
     token = (
         bind_request_stats_monitor(monitor) if monitor is not None else None
@@ -220,6 +221,7 @@ async def state_middleware(request: web.Request, handler):
             unbind_engine_stats_scraper(scraper_token)
         if token is not None:
             unbind_request_stats_monitor(token)
+        appscope.unbind_scope(scope_token)
 
 
 @web.middleware
@@ -340,12 +342,17 @@ async def api_key_middleware(request: web.Request, handler):
 
 
 def initialize_all(app: web.Application, args) -> None:
-    """Create all router singletons from parsed args (pre-event-loop)."""
+    """Create all router services from parsed args (pre-event-loop).
+
+    The app itself is bound as the ambient scope first (``appscope``), so
+    every ``initialize_*`` below stores its instance ON THE APP — factory
+    injection and ambient lookup are the same storage, and a second app
+    initialized later cannot repoint this one's services."""
+    appscope.bind_scope(app)
     # The state backend comes up FIRST: resilience (fleet-wide admission,
     # breaker replication) and routing (shared endpoint view) consult it
     # at initialization time. In-memory default = single-replica behavior.
     backend = initialize_state_backend(args)
-    app["state_backend"] = backend
     if args.service_discovery == "static":
         initialize_service_discovery(
             ServiceDiscoveryType.STATIC,
@@ -382,9 +389,12 @@ def initialize_all(app: web.Application, args) -> None:
     monitor = initialize_request_stats_monitor(args.request_stats_window)
     app["request_stats_monitor"] = monitor
     backend.register_provider(PROVIDER_REQUEST_STATS, monitor.sync_snapshot)
+    # THIS app's discovery, resolved through the app at call time (the
+    # provider runs from the gossip loop, and a dynamic-config reload may
+    # have replaced the instance since registration).
     backend.register_provider(
         PROVIDER_ENDPOINTS,
-        lambda: get_service_discovery().get_endpoint_urls(),
+        lambda: app["service_discovery"].get_endpoint_urls(),
     )
     router = initialize_routing_logic(
         RoutingLogic(args.routing_logic),
@@ -472,25 +482,29 @@ def create_app(args) -> web.Application:
     app.add_routes(routes)
 
     async def on_startup(app: web.Application) -> None:
+        # Re-bind THIS app as the ambient scope: startup may run after
+        # another app's create_app() rebound the caller's context, and
+        # every background task spawned below inherits this binding
+        # (contextvars propagate through create_task) — so the loops of
+        # app 1 never resolve app 2's services.
+        appscope.bind_scope(app)
         app["client_session"] = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=None),
             connector=aiohttp.TCPConnector(limit=0),
         )
-        await get_service_discovery().start()
-        # App-scoped (see on_cleanup): each app starts ITS OWN scraper.
+        # App-scoped (see on_cleanup): each app starts ITS OWN services.
+        await app["service_discovery"].start()
         await app["engine_stats_scraper"].start()
-        # App-scoped, not the module global: with several router apps in
-        # one process each must start (and later close) ITS OWN backend,
-        # not whichever app initialized last.
         backend = app.get("state_backend")
         if backend is not None:
             await backend.start(app)
-        prober = get_canary_prober()
+        prober = app.get("canary_prober")
         if prober is not None:
             await prober.start()
         if args.log_stats:
-            app["log_stats_task"] = asyncio.create_task(
-                _log_stats_loop(app, args.log_stats_interval)
+            app["log_stats_task"] = spawn_owned(
+                _log_stats_loop(app, args.log_stats_interval),
+                name="router-log-stats",
             )
         if args.dynamic_config_json:
             from .dynamic_config import initialize_dynamic_config_watcher
@@ -504,45 +518,39 @@ def create_app(args) -> web.Application:
                 await proc.start()
 
     async def on_cleanup(app: web.Application) -> None:
-        for key in ("log_stats_task",):
-            task = app.get(key)
-            if task is not None:
-                task.cancel()
+        # Bind THIS app as the scope: cleanup may run from a context where
+        # another app was initialized later, and every teardown below must
+        # tear down OUR services, not the ambient context's.
+        appscope.bind_scope(app)
+        task = app.get("log_stats_task")
+        if task is not None:
+            task.cancel()
         proc = app.get("batch_processor")
         if proc is not None:
             await proc.close()
         watcher = app.get("dynamic_config_watcher")
         if watcher is not None:
             watcher.close()
-        prober = get_canary_prober()
+        prober = app.get("canary_prober")
         if prober is not None:
             await prober.close()
         teardown_canary_prober()
-        # Close the app's OWN scraper (not whichever app initialized the
-        # module default last); drop the default only if it is ours.
+        # Close the app's OWN scraper; with the app bound as scope the
+        # teardown clears exactly this app's entry.
         app["engine_stats_scraper"].close()
-        try:
-            if get_engine_stats_scraper() is app["engine_stats_scraper"]:
-                EngineStatsScraper.destroy()
-        except ValueError:
-            pass
+        EngineStatsScraper.destroy()
         teardown_service_discovery()
-        try:  # routers holding a long-lived client (kvaware) close it here
-            router = get_routing_logic()
-            aclose = getattr(router, "aclose", None)
-            if aclose is not None:
-                await aclose()
-        except ValueError:
-            pass
+        # Routers holding a long-lived client (kvaware, fleet) close it here.
+        router = app.get("routing_logic")
+        aclose = getattr(router, "aclose", None)
+        if aclose is not None:
+            await aclose()
         teardown_routing_logic()
         teardown_resilience()
         backend = app.get("state_backend")
         if backend is not None:
             await backend.close()
-        if get_state_backend() is backend:
-            # Only the app that owns the global clears it — a second app's
-            # cleanup must not null a still-serving replica's backend.
-            teardown_state_backend()
+        teardown_state_backend()
         teardown_request_tracing()
         for key in ("client_session", "prefill_client", "decode_client"):
             session = app.get(key)

@@ -1,0 +1,76 @@
+"""App-scoped service registry: the sanctioned home for router "singletons".
+
+Until router HA, every router service (discovery, routing logic, stats,
+canary, feature gates, ...) lived in a module-level global rebound by an
+``initialize_*`` function. With several router apps in one process (the
+multi-replica tests, and eventually in-process replica harnesses) that
+pattern is *last-app-wins*: the second ``create_app`` silently repoints
+every ambient lookup at its own instances and the first app routes with
+someone else's state.
+
+This module replaces those globals with ONE context-bound scope:
+
+- A *scope* is any mutable mapping. The app factory binds the
+  ``aiohttp.web.Application`` itself (it is a ``MutableMapping``), so
+  ``scoped_set("service_discovery", sd)`` and ``app["service_discovery"]``
+  are the same storage — app-factory injection and ambient lookup can
+  never disagree.
+- ``bind_scope`` is called at three points: ``initialize_all`` (so
+  bootstrap-time lookups resolve while the app is being wired),
+  ``on_startup`` (so background loops spawned there inherit THEIR app's
+  scope via ``contextvars`` task inheritance), and per request by the
+  state middleware (so handler code resolves the serving app's scope).
+- Bare callers with no bound scope (unit tests that call
+  ``initialize_service_discovery`` directly) get an implicit dict scope
+  for their context — the old module-global semantics, but per context
+  instead of per process.
+
+The ``app-scope`` pstlint check (docs/static-analysis.md) enforces the
+other half: new module-level mutable state or ``global`` rebinds in
+``router/`` fail CI, so the last-app-wins pattern cannot grow back.
+"""
+
+from __future__ import annotations
+
+from contextvars import ContextVar, Token
+from typing import Any, MutableMapping, Optional
+
+Scope = MutableMapping[str, Any]
+
+_scope: ContextVar[Optional[Scope]] = ContextVar("pst_app_scope", default=None)
+
+
+def bind_scope(scope: Scope) -> "Token[Optional[Scope]]":
+    """Bind ``scope`` (usually the aiohttp app) for the current context;
+    returns the token for :func:`unbind_scope`."""
+    return _scope.set(scope)
+
+
+def unbind_scope(token: "Token[Optional[Scope]]") -> None:
+    _scope.reset(token)
+
+
+def current_scope(create: bool = False) -> Optional[Scope]:
+    """The bound scope, or (with ``create=True``) a fresh implicit dict
+    scope bound to the current context when none exists yet."""
+    scope = _scope.get()
+    if scope is None and create:
+        scope = {}
+        _scope.set(scope)
+    return scope
+
+
+def scoped_set(key: str, value: Any) -> Any:
+    """Store ``value`` under ``key`` in the current scope (creating an
+    implicit scope for bare callers). Returns ``value``."""
+    scope = current_scope(create=True)
+    assert scope is not None
+    scope[key] = value
+    return value
+
+
+def scoped_get(key: str, default: Any = None) -> Any:
+    scope = _scope.get()
+    if scope is None:
+        return default
+    return scope.get(key, default)

@@ -124,6 +124,7 @@ class DynamicConfigWatcher:
         self.args = args
         self.app = app
         self._last_hash: Optional[str] = None
+        # pstlint: task-owner=_task
         self._task = asyncio.get_event_loop().create_task(self._watch())
         self.current_config: Optional[DynamicRouterConfig] = None
 
@@ -162,16 +163,22 @@ class DynamicConfigWatcher:
         self._task.cancel()
 
 
-_watcher: Optional[DynamicConfigWatcher] = None
+# App-scoped (router.appscope): the watcher belongs to the app whose
+# config file it polls.
+_SCOPE_KEY = "dynamic_config_watcher"
 
 
 def initialize_dynamic_config_watcher(
     path: str, interval: float, args, app
 ) -> DynamicConfigWatcher:
-    global _watcher
-    _watcher = DynamicConfigWatcher(path, interval, args, app)
-    return _watcher
+    from . import appscope
+
+    return appscope.scoped_set(
+        _SCOPE_KEY, DynamicConfigWatcher(path, interval, args, app)
+    )
 
 
 def get_dynamic_config_watcher() -> Optional[DynamicConfigWatcher]:
-    return _watcher
+    from . import appscope
+
+    return appscope.scoped_get(_SCOPE_KEY)

@@ -68,17 +68,20 @@ class FeatureGates:
         return self._enabled.get(name, False)
 
 
-_gates: Optional[FeatureGates] = None
+# App-scoped (router.appscope): gates are per app, not per process.
+_SCOPE_KEY = "feature_gates"
 
 
 def initialize_feature_gates(spec: Optional[str] = None) -> FeatureGates:
-    global _gates
-    _gates = FeatureGates(spec)
-    return _gates
+    from .. import appscope
+
+    return appscope.scoped_set(_SCOPE_KEY, FeatureGates(spec))
 
 
 def get_feature_gates() -> FeatureGates:
-    global _gates
-    if _gates is None:
-        _gates = FeatureGates()
-    return _gates
+    from .. import appscope
+
+    gates = appscope.scoped_get(_SCOPE_KEY)
+    if gates is None:
+        gates = appscope.scoped_set(_SCOPE_KEY, FeatureGates())
+    return gates

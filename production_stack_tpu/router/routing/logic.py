@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import xxhash
 
 from ...logging_utils import init_logger
-from ...utils import SingletonABCMeta
+from ...obs.tasks import spawn_owned
 from ..service_discovery import EndpointInfo
 from .hashtrie import HashTrie
 
@@ -41,6 +41,10 @@ class RoutingLogic(enum.Enum):
     PREFIXAWARE = "prefixaware"
     DISAGGREGATED_PREFILL = "disaggregated_prefill"
     FLEET = "fleet"
+
+
+# App-scope key the active policy lives under (router.appscope).
+_SCOPE_KEY = "routing_logic"
 
 
 def extract_prompt_text(request_json: Dict[str, Any]) -> str:
@@ -166,28 +170,21 @@ class ConsistentHashRing:
         return first_eligible
 
 
-# In-flight routing background tasks (trie evictions, reconfigure-time
-# client closes): asyncio keeps only weak task refs, so an unreferenced
-# eviction suspended on a node lock could be collected mid-walk and
-# leave the phantom engine the churn contract forbids.
-# pstlint: owned-by=task:_run_trie_eviction,reconfigure_routing_logic
-_EVICT_TASKS: set = set()
-
-
 def _run_trie_eviction(trie: HashTrie, url: str) -> None:
     """Run ``trie.remove_endpoint(url)`` on the running loop (reference
-    held until done) or synchronously when no loop is running."""
+    held by the owned-task registry until done — asyncio keeps only weak
+    task refs, and an unreferenced eviction suspended on a node lock
+    could be collected mid-walk, leaving the phantom engine the churn
+    contract forbids) or synchronously when no loop is running."""
     import asyncio
 
     coro = trie.remove_endpoint(url)
     try:
-        loop = asyncio.get_running_loop()
+        asyncio.get_running_loop()
     except RuntimeError:  # no loop (sync caller in tests/CLI)
         asyncio.run(coro)
         return
-    task = loop.create_task(coro)
-    _EVICT_TASKS.add(task)
-    task.add_done_callback(_EVICT_TASKS.discard)
+    spawn_owned(coro, name=f"trie-evict:{url}")
 
 
 def apply_breaker_filter(endpoints: List[EndpointInfo]) -> List[EndpointInfo]:
@@ -279,7 +276,12 @@ async def route_with_resilience(
     return url
 
 
-class RoutingInterface(ABC, metaclass=SingletonABCMeta):
+class RoutingInterface(ABC):
+    """A routing policy. Plain classes — no ``SingletonMeta`` — created
+    by ``initialize_routing_logic`` and resolved through the app scope
+    (``router.appscope``), so two router apps in one process each run
+    their OWN policy instance with zero shared state."""
+
     @abstractmethod
     async def route_request(
         self,
@@ -290,6 +292,15 @@ class RoutingInterface(ABC, metaclass=SingletonABCMeta):
         request_json: Optional[Dict[str, Any]] = None,
     ) -> str:
         """Pick the engine URL that should serve this request."""
+
+    @classmethod
+    def destroy(cls) -> None:
+        """Legacy SingletonMeta-era hook: drop the scoped policy when it
+        is an instance of this class (tests use it to force a rebuild)."""
+        from .. import appscope
+
+        if isinstance(appscope.scoped_get(_SCOPE_KEY), cls):
+            appscope.scoped_set(_SCOPE_KEY, None)
 
 
 class RoundRobinRouter(RoutingInterface):
@@ -810,16 +821,6 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         return url
 
 
-_ROUTER_CLASSES = (
-    SessionRouter,
-    RoundRobinRouter,
-    KvawareRouter,
-    PrefixAwareRouter,
-    DisaggregatedPrefillRouter,
-    FleetRouter,
-)
-
-
 def evict_routing_endpoint(url: str) -> None:
     """Discovery-driven churn, one step: when an engine leaves the fleet
     (pod deleted, static backend failed its health probe), the active
@@ -842,7 +843,7 @@ def evict_routing_endpoint(url: str) -> None:
         evict(url)
 
 
-def initialize_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
+def _build_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
     if routing_logic == RoutingLogic.ROUND_ROBIN:
         return RoundRobinRouter()
     if routing_logic == RoutingLogic.SESSION_BASED:
@@ -872,6 +873,15 @@ def initialize_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingIn
     raise ValueError(f"invalid routing logic {routing_logic}")
 
 
+def initialize_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
+    """Build the policy and install it in the current app scope."""
+    from .. import appscope
+
+    return appscope.scoped_set(
+        _SCOPE_KEY, _build_routing_logic(routing_logic, **kwargs)
+    )
+
+
 def reconfigure_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingInterface:
     import asyncio
 
@@ -885,25 +895,24 @@ def reconfigure_routing_logic(routing_logic: RoutingLogic, **kwargs) -> RoutingI
     aclose = getattr(old, "aclose", None)
     if aclose is not None:
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()
         except RuntimeError:
             asyncio.run(aclose())
         else:
-            task = loop.create_task(aclose())
-            _EVICT_TASKS.add(task)
-            task.add_done_callback(_EVICT_TASKS.discard)
-    for cls in _ROUTER_CLASSES:
-        cls.destroy()
+            spawn_owned(aclose(), name="routing-reconfigure-aclose")
     return initialize_routing_logic(routing_logic, **kwargs)
 
 
 def get_routing_logic() -> RoutingInterface:
-    for cls in _ROUTER_CLASSES:
-        if cls in SingletonABCMeta._instances:
-            return SingletonABCMeta._instances[cls]
-    raise ValueError("routing logic not initialized")
+    from .. import appscope
+
+    router = appscope.scoped_get(_SCOPE_KEY)
+    if router is None:
+        raise ValueError("routing logic not initialized")
+    return router
 
 
 def teardown_routing_logic() -> None:
-    for cls in _ROUTER_CLASSES:
-        cls.destroy()
+    from .. import appscope
+
+    appscope.scoped_set(_SCOPE_KEY, None)

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 import aiohttp
 
 from ..logging_utils import init_logger
+from ..obs.tasks import spawn_owned
 from ..utils import ModelType
 
 logger = init_logger(__name__)
@@ -417,9 +418,10 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     async def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.create_task(
+            self._task = spawn_owned(
                 self._health_loop() if self.enable_health_checks
-                else self._drain_reconcile_loop()
+                else self._drain_reconcile_loop(),
+                name="discovery-static-health",
             )
         await self.initialize_client_sessions(
             self.prefill_model_labels, self.decode_model_labels
@@ -485,7 +487,10 @@ class _K8sWatcherBase(ServiceDiscovery):
         self.prefill_model_labels = prefill_model_labels
         self.decode_model_labels = decode_model_labels
         self.k8s = K8sClient()
-        # pstlint: owned-by=task:_on_pod_event,_on_service_event
+        # Mutations hold the watcher's asyncio lock; the lock-order check
+        # additionally forbids awaits inside those regions (fetches are
+        # materialized BEFORE the lock, hashtrie-walk style).
+        # pstlint: owned-by=lock:_lock
         self.available_engines: Dict[str, EndpointInfo] = {}
         self._lock = asyncio.Lock()
         self._task: Optional[asyncio.Task] = None
@@ -532,7 +537,7 @@ class _K8sWatcherBase(ServiceDiscovery):
 
     async def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.create_task(self._watch_loop())
+            self._task = spawn_owned(self._watch_loop(), name="discovery-k8s-watch")
         await self.initialize_client_sessions(
             self.prefill_model_labels, self.decode_model_labels
         )
@@ -729,7 +734,13 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
             self.available_engines[name] = info
 
 
-_global_service_discovery: Optional[ServiceDiscovery] = None
+# App-scoped lifecycle (docs/router-ha.md, app-scope pstlint check): the
+# discovery instance lives in the current app scope — the aiohttp app
+# itself when the app factory bound it, an implicit per-context scope for
+# bare callers (unit tests). Two router apps in one process each resolve
+# their OWN discovery; there is no last-app-wins module global left to
+# bleed through.
+_SCOPE_KEY = "service_discovery"
 
 
 def _create(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
@@ -744,41 +755,47 @@ def _create(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
 
 
 def initialize_service_discovery(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
-    """Create (or replace) the process-wide discovery instance.
+    """Create (or replace) the current scope's discovery instance.
 
     Replacement instead of a hard error: the app factory owns the
-    lifecycle, and multi-replica tests build several router apps in one
-    process (each against the same backend set) — the last-created app's
-    view wins, which is correct for same-fleet replicas. A previous
-    instance is closed so its watch/health tasks do not leak."""
-    global _global_service_discovery
-    if _global_service_discovery is not None:
+    lifecycle, and unit tests re-initialize freely. A previous instance
+    in the SAME scope is closed so its watch/health tasks do not leak;
+    another app's instance (a different scope) is untouched."""
+    from . import appscope
+
+    prev = appscope.scoped_get(_SCOPE_KEY)
+    if prev is not None:
         logger.warning(
             "service discovery re-initialized; replacing the previous instance"
         )
-        _global_service_discovery.close()
-    _global_service_discovery = _create(sd_type, *args, **kwargs)
-    return _global_service_discovery
+        prev.close()
+    return appscope.scoped_set(_SCOPE_KEY, _create(sd_type, *args, **kwargs))
 
 
 def reconfigure_service_discovery(sd_type: ServiceDiscoveryType, *args, **kwargs) -> ServiceDiscovery:
-    global _global_service_discovery
-    if _global_service_discovery is None:
+    from . import appscope
+
+    old = appscope.scoped_get(_SCOPE_KEY)
+    if old is None:
         raise ValueError("service discovery not initialized")
     new = _create(sd_type, *args, **kwargs)
-    _global_service_discovery.close()
-    _global_service_discovery = new
-    return new
+    old.close()
+    return appscope.scoped_set(_SCOPE_KEY, new)
 
 
 def get_service_discovery() -> ServiceDiscovery:
-    if _global_service_discovery is None:
+    from . import appscope
+
+    sd = appscope.scoped_get(_SCOPE_KEY)
+    if sd is None:
         raise ValueError("service discovery not initialized")
-    return _global_service_discovery
+    return sd
 
 
 def teardown_service_discovery() -> None:
-    global _global_service_discovery
-    if _global_service_discovery is not None:
-        _global_service_discovery.close()
-    _global_service_discovery = None
+    from . import appscope
+
+    sd = appscope.scoped_get(_SCOPE_KEY)
+    if sd is not None:
+        sd.close()
+        appscope.scoped_set(_SCOPE_KEY, None)

@@ -140,6 +140,7 @@ class LocalBatchProcessor:
     # -- processing loop --------------------------------------------------
 
     async def start(self) -> None:
+        # pstlint: task-owner=_task
         self._task = asyncio.create_task(self._loop())
 
     async def close(self) -> None:

@@ -41,14 +41,16 @@ class CustomCallbackHandler:
             await result
 
 
-_handler: Optional[CustomCallbackHandler] = None
+# App-scoped (router.appscope): callbacks are per app, not per process.
+_SCOPE_KEY = "custom_callback_handler"
 
 
 def configure_custom_callbacks(spec: Optional[str]) -> Optional[CustomCallbackHandler]:
     """Load callbacks from ``path/to/file.py`` or ``dotted.module.name``."""
-    global _handler
+    from .. import appscope
+
     if not spec:
-        _handler = None
+        appscope.scoped_set(_SCOPE_KEY, None)
         return None
     if spec.endswith(".py"):
         modspec = importlib.util.spec_from_file_location("pst_custom_callbacks", spec)
@@ -57,10 +59,12 @@ def configure_custom_callbacks(spec: Optional[str]) -> Optional[CustomCallbackHa
         modspec.loader.exec_module(module)
     else:
         module = importlib.import_module(spec)
-    _handler = CustomCallbackHandler(module)
+    handler = appscope.scoped_set(_SCOPE_KEY, CustomCallbackHandler(module))
     logger.info("loaded custom callbacks from %s", spec)
-    return _handler
+    return handler
 
 
 def get_custom_callback_handler() -> Optional[CustomCallbackHandler]:
-    return _handler
+    from .. import appscope
+
+    return appscope.scoped_get(_SCOPE_KEY)

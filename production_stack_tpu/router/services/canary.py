@@ -25,6 +25,7 @@ from typing import Optional
 import aiohttp
 
 from ...logging_utils import init_logger
+from ...obs.tasks import spawn_owned
 from ...resilience import get_breaker_registry
 from ..service_discovery import get_service_discovery
 from . import metrics_service as gauges
@@ -86,7 +87,7 @@ class CanaryProber:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=self.timeout)
         )
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_owned(self._loop(), name="canary-prober")
 
     async def close(self) -> None:
         if self._task is not None:
@@ -180,21 +181,27 @@ class CanaryProber:
             logger.debug("canary probe failed for %s: %s", ep.url, e)
 
 
-_canary_prober: Optional[CanaryProber] = None
+# App-scoped (router.appscope): each router app runs its own prober.
+_SCOPE_KEY = "canary_prober"
 
 
 def initialize_canary_prober(
     interval: float, timeout: float = 5.0, api_key: Optional[str] = None
 ) -> CanaryProber:
-    global _canary_prober
-    _canary_prober = CanaryProber(interval, timeout=timeout, api_key=api_key)
-    return _canary_prober
+    from .. import appscope
+
+    return appscope.scoped_set(
+        _SCOPE_KEY, CanaryProber(interval, timeout=timeout, api_key=api_key)
+    )
 
 
 def get_canary_prober() -> Optional[CanaryProber]:
-    return _canary_prober
+    from .. import appscope
+
+    return appscope.scoped_get(_SCOPE_KEY)
 
 
 def teardown_canary_prober() -> None:
-    global _canary_prober
-    _canary_prober = None
+    from .. import appscope
+
+    appscope.scoped_set(_SCOPE_KEY, None)

@@ -88,35 +88,42 @@ canary_failures_total = Counter(
 )
 
 # Configured at router bootstrap (--slo-ttft-ms; 0 disables the counters).
-_slo_ttft_target_s: Optional[float] = None
+# App-scoped (router.appscope): two router apps in one process may run
+# different TTFT objectives without overwriting each other.
+_SLO_SCOPE_KEY = "slo_ttft_target_s"
 
 
 def configure_slo(ttft_target_ms: float) -> None:
-    global _slo_ttft_target_s
-    _slo_ttft_target_s = (
+    from .. import appscope
+
+    appscope.scoped_set(
+        _SLO_SCOPE_KEY,
         ttft_target_ms / 1000.0 if ttft_target_ms and ttft_target_ms > 0
-        else None
+        else None,
     )
 
 
 def slo_ttft_target_s() -> Optional[float]:
-    return _slo_ttft_target_s
+    from .. import appscope
+
+    return appscope.scoped_get(_SLO_SCOPE_KEY)
 
 
 def observe_slo_ttft(model: Optional[str], seconds: float) -> None:
     """One request reached its first upstream byte: count it, and count it
     as within-target when the router-observed TTFT met the objective."""
-    if _slo_ttft_target_s is None:
+    target = slo_ttft_target_s()
+    if target is None:
         return
     m = str(model) if model else "unknown"
     slo_requests_total.labels(model=m).inc()
-    if seconds <= _slo_ttft_target_s:
+    if seconds <= target:
         slo_ttft_within_target_total.labels(model=m).inc()
 
 
 def observe_slo_failure(model: Optional[str]) -> None:
     """A request failed before producing a first byte (exhausted failover,
     upstream 5xx): it consumed error budget without a TTFT sample."""
-    if _slo_ttft_target_s is None:
+    if slo_ttft_target_s() is None:
         return
     slo_requests_total.labels(model=str(model) if model else "unknown").inc()

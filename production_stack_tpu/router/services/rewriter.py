@@ -26,20 +26,22 @@ class NoopRequestRewriter(RequestRewriter):
         return request_body
 
 
-_rewriter: Optional[RequestRewriter] = None
+# App-scoped (router.appscope); absent scope entry degrades to noop.
+_SCOPE_KEY = "request_rewriter"
 
 
 def initialize_request_rewriter(rewriter_type: Optional[str] = None) -> RequestRewriter:
-    global _rewriter
+    from .. import appscope
+
     if rewriter_type in (None, "", "noop"):
-        _rewriter = NoopRequestRewriter()
-    else:
-        raise ValueError(f"unknown request rewriter type {rewriter_type!r}")
-    return _rewriter
+        return appscope.scoped_set(_SCOPE_KEY, NoopRequestRewriter())
+    raise ValueError(f"unknown request rewriter type {rewriter_type!r}")
 
 
 def get_request_rewriter() -> RequestRewriter:
-    global _rewriter
-    if _rewriter is None:
-        _rewriter = NoopRequestRewriter()
-    return _rewriter
+    from .. import appscope
+
+    rewriter = appscope.scoped_get(_SCOPE_KEY)
+    if rewriter is None:
+        rewriter = appscope.scoped_set(_SCOPE_KEY, NoopRequestRewriter())
+    return rewriter

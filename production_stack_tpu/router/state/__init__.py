@@ -28,16 +28,20 @@ from .base import (
 from .gossip import GOSSIP_PATH, GossipStateBackend
 from .memory import InMemoryStateBackend
 
-_state_backend: Optional[StateBackend] = None
+# App-scoped (router.appscope): each router app owns its backend; the
+# old module global was last-app-wins across replicas in one process.
+_SCOPE_KEY = "state_backend"
 
 
 def initialize_state_backend(args) -> StateBackend:
     """Create the backend from parsed router args (pre-event-loop; the
     gossip loop starts with ``await backend.start()`` in on_startup)."""
-    global _state_backend
+    from .. import appscope
+
     kind = getattr(args, "state_backend", "memory") or "memory"
+    backend: StateBackend
     if kind == "gossip":
-        _state_backend = GossipStateBackend(
+        backend = GossipStateBackend(
             peers=parse_comma_separated(getattr(args, "state_peers", None)),
             replica_id=getattr(args, "state_replica_id", None) or None,
             sync_interval=getattr(args, "state_sync_interval", 0.5),
@@ -45,19 +49,22 @@ def initialize_state_backend(args) -> StateBackend:
             api_key=getattr(args, "api_key", None),
         )
     else:
-        _state_backend = InMemoryStateBackend(
+        backend = InMemoryStateBackend(
             replica_id=getattr(args, "state_replica_id", None) or None
         )
-    return _state_backend
+    return appscope.scoped_set(_SCOPE_KEY, backend)
 
 
 def get_state_backend() -> Optional[StateBackend]:
-    return _state_backend
+    from .. import appscope
+
+    return appscope.scoped_get(_SCOPE_KEY)
 
 
 def teardown_state_backend() -> None:
-    global _state_backend
-    _state_backend = None
+    from .. import appscope
+
+    appscope.scoped_set(_SCOPE_KEY, None)
 
 
 __all__ = [

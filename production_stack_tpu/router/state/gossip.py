@@ -42,6 +42,7 @@ from urllib.parse import urlparse
 import aiohttp
 
 from ...logging_utils import init_logger
+from ...obs.tasks import spawn_owned
 from .base import (
     PROVIDER_BREAKERS,
     PROVIDER_ENDPOINT_LOADS,
@@ -163,7 +164,7 @@ class GossipStateBackend(StateBackend):
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=max(self.sync_interval * 4, 2.0))
         )
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_owned(self._loop(), name="gossip-state-sync")
         logger.info(
             "gossip state backend up: replica=%s peers=%s interval=%.2fs "
             "peer_timeout=%.2fs",

@@ -12,8 +12,9 @@ it into request context via middleware), the same de-singletonization
 ``RequestStatsMonitor`` got in the HA PR. Two router apps in one process
 (the multi-replica tests) each scrape into their OWN snapshot — zero
 engine-stats bleed — while every existing ``get_engine_stats_scraper()``
-call site keeps working via the context binding with a module-default
-fallback.
+call site keeps working via the per-request context binding with an
+app-scope fallback (``router.appscope``; the module-default global died
+with the app-scope pstlint check).
 """
 
 # pstlint: disable-file=hop-contract(metrics scrapes are control-plane pulls on their own timer; no originating client request exists to propagate headers from)
@@ -120,11 +121,12 @@ class EngineStatsScraper:
 
     @classmethod
     def destroy(cls) -> None:
-        """Drop the module-level default (test/reconfiguration hook; the
-        name survives from the SingletonMeta era so existing teardown
+        """Drop the current scope's scraper (test/reconfiguration hook;
+        the name survives from the SingletonMeta era so existing teardown
         helpers keep working)."""
-        global _default_scraper
-        _default_scraper = None
+        from .. import appscope
+
+        appscope.scoped_set(_SCOPE_KEY, None)
 
     async def _scrape_one(self, session: aiohttp.ClientSession, url: str) -> None:
         try:
@@ -153,6 +155,7 @@ class EngineStatsScraper:
 
     async def start(self) -> None:
         if self._task is None:
+            # pstlint: task-owner=_task
             self._task = asyncio.create_task(self._loop())
 
     def get_engine_stats(self) -> Dict[str, EngineStats]:
@@ -168,18 +171,19 @@ class EngineStatsScraper:
 
 
 # Context binding: ``create_app`` injects its own scraper for the request
-# tasks it serves; the module default covers single-app processes and
-# background loops (same contract as the request-stats monitor).
+# tasks it serves; the app scope (``router.appscope``) covers bootstrap
+# code and background loops — there is no module-level default left to
+# bleed between apps (same contract as the request-stats monitor).
 _bound_scraper: contextvars.ContextVar[Optional[EngineStatsScraper]] = (
     contextvars.ContextVar("pst_engine_stats_scraper", default=None)
 )
-_default_scraper: Optional[EngineStatsScraper] = None
+_SCOPE_KEY = "engine_stats_scraper"
 
 
 def initialize_engine_stats_scraper(scrape_interval: float) -> EngineStatsScraper:
-    global _default_scraper
-    _default_scraper = EngineStatsScraper(scrape_interval)
-    return _default_scraper
+    from .. import appscope
+
+    return appscope.scoped_set(_SCOPE_KEY, EngineStatsScraper(scrape_interval))
 
 
 def bind_engine_stats_scraper(
@@ -195,9 +199,12 @@ def unbind_engine_stats_scraper(token: contextvars.Token) -> None:
 
 
 def get_engine_stats_scraper() -> EngineStatsScraper:
+    from .. import appscope
+
     scraper = _bound_scraper.get()
     if scraper is not None:
         return scraper
-    if _default_scraper is None:
+    scraper = appscope.scoped_get(_SCOPE_KEY)
+    if scraper is None:
         raise ValueError("EngineStatsScraper needs a scrape_interval")
-    return _default_scraper
+    return scraper

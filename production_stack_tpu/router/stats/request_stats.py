@@ -9,10 +9,10 @@ Ownership (router HA): the monitor is a plain class — no ``SingletonMeta``
 — created by the app factory and *injected* per app (``create_app`` binds
 it into request context via middleware), so multi-replica tests can run
 two routers in one process without state bleed. ``get_request_stats_monitor``
-resolves the context-bound monitor first and falls back to the
-module-level default the last ``initialize_request_stats_monitor`` set,
-which keeps every existing call site (and single-router deployments)
-working unchanged.
+resolves the context-bound monitor first and falls back to the app scope
+(``router.appscope``) the enclosing app bound, which keeps every existing
+call site (and single-router deployments) working unchanged — with no
+module-level default left for a second app to overwrite.
 
 Replication: ``get_request_stats`` merges live peers' snapshots from the
 :class:`~..state.StateBackend` (additive counts, summed QPS) so routing
@@ -127,11 +127,12 @@ class RequestStatsMonitor:
 
     @classmethod
     def destroy(cls) -> None:
-        """Drop the module-level default (test/reconfiguration hook; the
-        name survives from the SingletonMeta era so existing teardown
+        """Drop the current scope's monitor (test/reconfiguration hook;
+        the name survives from the SingletonMeta era so existing teardown
         helpers keep working)."""
-        global _default_monitor
-        _default_monitor = None
+        from .. import appscope
+
+        appscope.scoped_set(_SCOPE_KEY, None)
 
     def _mon(self, table: Dict[str, MovingAverageMonitor], url: str) -> MovingAverageMonitor:
         if url not in table:
@@ -293,19 +294,22 @@ class RequestStatsMonitor:
 
 
 # Context binding: ``create_app`` injects its own monitor for the request
-# tasks it serves; the module default covers single-app processes and
-# background loops. (A contextvar, not an app lookup, so the deep call
-# graph under proxy_and_stream needs no monitor threading.)
+# tasks it serves; the app scope (``router.appscope``) covers bootstrap
+# code and background loops. (A contextvar, not explicit threading, so
+# the deep call graph under proxy_and_stream needs no monitor plumbing;
+# the module-default global died with the app-scope pstlint check.)
 _bound_monitor: contextvars.ContextVar[Optional[RequestStatsMonitor]] = (
     contextvars.ContextVar("pst_request_stats_monitor", default=None)
 )
-_default_monitor: Optional[RequestStatsMonitor] = None
+_SCOPE_KEY = "request_stats_monitor"
 
 
 def initialize_request_stats_monitor(sliding_window_size: float) -> RequestStatsMonitor:
-    global _default_monitor
-    _default_monitor = RequestStatsMonitor(sliding_window_size)
-    return _default_monitor
+    from .. import appscope
+
+    return appscope.scoped_set(
+        _SCOPE_KEY, RequestStatsMonitor(sliding_window_size)
+    )
 
 
 def bind_request_stats_monitor(
@@ -321,9 +325,12 @@ def unbind_request_stats_monitor(token: contextvars.Token) -> None:
 
 
 def get_request_stats_monitor() -> RequestStatsMonitor:
+    from .. import appscope
+
     monitor = _bound_monitor.get()
     if monitor is not None:
         return monitor
-    if _default_monitor is None:
+    monitor = appscope.scoped_get(_SCOPE_KEY)
+    if monitor is None:
         raise ValueError("RequestStatsMonitor needs sliding_window_size")
-    return _default_monitor
+    return monitor

@@ -1,8 +1,14 @@
-"""Shared small utilities (singletons, URL validation, parsing helpers).
+"""Shared small utilities (URL validation, parsing helpers).
 
 Capability parity with the reference router's ``src/vllm_router/utils.py``
-(SingletonMeta :17-30, ModelType :49-81, url validation :84-102, ulimit
-bump :106-121, alias/CSV parsing :124-147) — re-designed, not copied.
+(ModelType :49-81, url validation :84-102, ulimit bump :106-121,
+alias/CSV parsing :124-147) — re-designed, not copied.
+
+The reference's ``SingletonMeta`` lived here until the app-scope refactor
+(docs/static-analysis.md, ``app-scope`` check): process-wide singletons
+made two router apps in one process share state, so every former user
+(routing policies, stats monitor/scraper, discovery) is now a plain class
+resolved through :mod:`production_stack_tpu.router.appscope`.
 """
 
 from __future__ import annotations
@@ -11,32 +17,7 @@ import enum
 import ipaddress
 import re
 import resource
-import threading
-from abc import ABCMeta
-from typing import Any, Dict, List, Optional
-
-
-class SingletonMeta(type):
-    """Thread-safe singleton metaclass (one instance per class)."""
-
-    _instances: Dict[type, Any] = {}
-    _lock = threading.Lock()
-
-    def __call__(cls, *args, **kwargs):
-        if cls not in cls._instances:
-            with cls._lock:
-                if cls not in cls._instances:
-                    cls._instances[cls] = super().__call__(*args, **kwargs)
-        return cls._instances[cls]
-
-    def destroy(cls) -> None:
-        """Drop the cached instance (used by hot-reconfiguration)."""
-        with cls._lock:
-            cls._instances.pop(cls, None)
-
-
-class SingletonABCMeta(ABCMeta, SingletonMeta):
-    """Singleton metaclass usable with abc.ABC subclasses."""
+from typing import Dict, List, Optional
 
 
 class ModelType(enum.Enum):

@@ -1,0 +1,8 @@
+"""Outside router/: the app-scope rule must not tax unrelated code."""
+
+cache = {}
+queue = []
+
+
+def note(key):
+    cache[key] = True

@@ -1,0 +1,18 @@
+"""Known-bad app-scope fixture: module singletons in router/."""
+
+from typing import Optional
+
+_cache = {}
+pending_requests = []
+_seen = set()
+_discovery: Optional[object] = None
+
+
+def initialize_discovery(instance):
+    global _discovery
+    _discovery = instance
+    return _discovery
+
+
+def remember(url):
+    _cache[url] = True

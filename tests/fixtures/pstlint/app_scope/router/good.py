@@ -1,0 +1,25 @@
+"""Known-good app-scope fixture: the sanctioned module-level shapes."""
+
+from contextvars import ContextVar
+from typing import Any, Optional
+
+# The sanctioned mechanism: per-context binding, no cross-app bleed.
+_scope: ContextVar[Optional[dict]] = ContextVar("fixture_scope", default=None)
+
+# Read-only constants by convention (UPPER_CASE).
+KNOWN_MODES = {"static", "k8s"}
+_DEFAULT_HEADERS = {"X-Fixture": "1"}
+
+
+def scoped_set(key: str, value: Any) -> Any:
+    scope = _scope.get()
+    if scope is None:
+        scope = {}
+        _scope.set(scope)
+    scope[key] = value
+    return value
+
+
+def scoped_get(key: str) -> Any:
+    scope = _scope.get()
+    return None if scope is None else scope.get(key)

@@ -1,0 +1,16 @@
+"""Mini router parser for the config-contract fixture (bad).
+
+Violations staged here: ``--surprise`` has no ConfigSpec, and
+``--rate``'s default (2.5) disagrees with the values.yaml twin (7.5).
+"""
+
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="fixture-router")
+    p.add_argument("--rate", type=float, default=2.5)
+    p.add_argument("--mode", default="simple")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--surprise", default="boo")
+    return p

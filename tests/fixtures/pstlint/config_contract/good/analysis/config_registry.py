@@ -1,0 +1,38 @@
+"""Mini config registry for the config-contract fixture (good)."""
+
+import dataclasses
+from typing import Optional, Tuple
+
+HELM = "helm"
+TEMPLATE = "template"
+CLI_ONLY = "cli-only"
+ROUTER_TEMPLATE = "helm/templates/deployment-router.yaml"
+ENGINE_TEMPLATE = "helm/templates/deployment-engine.yaml"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigSpec:
+    flag: str
+    scope: str = HELM
+    helm: Optional[str] = None
+    template: Optional[str] = None
+    doc: str = "docs/router.md"
+    default_differs: str = ""
+    note: str = ""
+    negation_of: Optional[str] = None
+    emit: Optional[str] = None
+
+
+ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
+    ConfigSpec("--rate", HELM, helm="routerSpec.rate",
+               template=ROUTER_TEMPLATE),
+    ConfigSpec("--mode", HELM, helm="routerSpec.mode",
+               template=ROUTER_TEMPLATE),
+    ConfigSpec("--verbose", CLI_ONLY, note="debug knob; extraArgs"),
+)
+
+ENGINE_FIELDS: Tuple = ()
+
+ROUTER_HELM_NON_FLAG: Tuple[str, ...] = (
+    "routerSpec.replicaCount",
+)

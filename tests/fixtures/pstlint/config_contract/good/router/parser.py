@@ -1,0 +1,11 @@
+"""Mini router parser for the config-contract fixture (good)."""
+
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="fixture-router")
+    p.add_argument("--rate", type=float, default=2.5)
+    p.add_argument("--mode", default="simple")
+    p.add_argument("--verbose", action="store_true")
+    return p

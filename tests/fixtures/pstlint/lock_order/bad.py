@@ -1,0 +1,42 @@
+"""Known-bad lock-order fixture: awaits under locks and an ABBA cycle."""
+
+import asyncio
+import threading
+
+
+async def fetch(key):
+    return key
+
+
+class Table:
+    def __init__(self):
+        # pstlint: owned-by=lock:lock_a
+        self.rows = {}
+        self.lock_a = asyncio.Lock()
+        # pstlint: owned-by=lock:lock_b
+        self.cols = {}
+        self.lock_b = asyncio.Lock()
+        # pstlint: owned-by=lock:lock_sync
+        self.cells = {}
+        self.lock_sync = threading.Lock()
+
+    async def await_under_async_lock(self, key):
+        async with self.lock_a:
+            value = await fetch(key)
+            self.rows[key] = value
+
+    async def await_under_sync_lock(self, key):
+        with self.lock_sync:
+            self.cells[key] = await fetch(key)
+
+    async def a_then_b(self):
+        async with self.lock_a:
+            self.rows[1] = 1
+            async with self.lock_b:
+                self.cols[1] = 1
+
+    async def b_then_a(self):
+        async with self.lock_b:
+            self.cols[2] = 2
+            async with self.lock_a:
+                self.rows[2] = 2

@@ -1,0 +1,46 @@
+"""Known-good lock-order fixture: materialize-then-await, one order."""
+
+import asyncio
+
+
+async def fetch(key):
+    return key
+
+
+class Table:
+    def __init__(self):
+        # pstlint: owned-by=lock:lock_a
+        self.rows = {}
+        self.lock_a = asyncio.Lock()
+        # pstlint: owned-by=lock:lock_b
+        self.cols = {}
+        self.lock_b = asyncio.Lock()
+
+    async def fetch_then_lock(self, key):
+        # The await happens OUTSIDE the critical section.
+        value = await fetch(key)
+        async with self.lock_a:
+            self.rows[key] = value
+
+    async def copy_release_then_await(self):
+        async with self.lock_a:
+            snapshot = dict(self.rows)
+        await fetch(len(snapshot))
+
+    async def consistent_order_one(self):
+        async with self.lock_a:
+            self.rows[1] = 1
+            async with self.lock_b:
+                self.cols[1] = 1
+
+    async def consistent_order_two(self):
+        async with self.lock_a:
+            self.rows[2] = 2
+            async with self.lock_b:
+                self.cols[2] = 2
+
+    async def nested_callback_is_not_under_lock(self):
+        async with self.lock_a:
+            async def helper():
+                await fetch(1)  # runs wherever awaited, not in the region
+            self.rows[3] = helper

@@ -1,0 +1,32 @@
+"""Known-bad task-lifecycle fixture: every spawn shape the check rejects."""
+
+import asyncio
+
+
+class Scraper:
+    async def start_unannotated(self):
+        # Stored on an attribute but with no task-owner annotation.
+        self._task = asyncio.create_task(self._loop())
+
+    async def kick(self):
+        # Bare fire-and-forget: weak ref only, exception never observed.
+        asyncio.create_task(self._loop())
+
+    async def leak_local(self):
+        # Bound to a local that is never read again.
+        task = asyncio.create_task(self._loop())
+        return 1
+
+    async def annotated_without_cancel(self):
+        # Annotated and stored, but nothing in this file ever cancels it.
+        # pstlint: task-owner=_keeper
+        self._keeper = asyncio.create_task(self._loop())
+
+    async def annotated_wrong_store(self):
+        # Annotation names an owner the task is never stored under.
+        # pstlint: task-owner=_other
+        self._held = asyncio.create_task(self._loop())
+
+    async def _loop(self):
+        while True:
+            await asyncio.sleep(1)

@@ -1,0 +1,44 @@
+"""Known-good task-lifecycle fixture: every sanctioned spawn shape."""
+
+import asyncio
+
+
+class Scraper:
+    def __init__(self):
+        self._task = None
+        self._tasks = set()
+
+    async def start(self):
+        # Owner-annotated attribute with a cancellation path (close()).
+        # pstlint: task-owner=_task
+        self._task = asyncio.create_task(self._loop())
+
+    def close(self):
+        if self._task is not None:
+            self._task.cancel()
+
+    async def awaited(self):
+        task = asyncio.create_task(self._loop())
+        await task
+
+    async def gathered(self):
+        first = asyncio.ensure_future(self._loop())
+        second = asyncio.ensure_future(self._loop())
+        await asyncio.wait({first, second})
+
+    async def registry_add(self):
+        # Owner is a registry set; cancel_all() is the cancellation path.
+        # pstlint: task-owner=_tasks
+        task = asyncio.create_task(self._loop())
+        self._tasks.add(task)
+
+    def cancel_all(self):
+        for task in list(self._tasks):
+            task.cancel()
+
+    async def suppressed(self):
+        asyncio.create_task(self._loop())  # pstlint: disable=task-lifecycle(fixture: deliberately unowned to prove suppressions still need reasons)
+
+    async def _loop(self):
+        while True:
+            await asyncio.sleep(1)

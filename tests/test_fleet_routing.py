@@ -359,23 +359,26 @@ def test_peer_loads_shift_bounded_load_pick(event_loop, monkeypatch):
         def publish_prefix_insert(self, path, ep):
             pass
 
-    from production_stack_tpu.router import state as state_mod
+    from production_stack_tpu.router import appscope
     from production_stack_tpu.router.stats.request_stats import (
         initialize_request_stats_monitor,
     )
 
-    monkeypatch.setattr(state_mod, "_state_backend", StubBackend())
-    # A resolvable local monitor is required for peer loads to merge in:
-    # without one, routing treats the caller-passed stats as already
-    # fleet-merged and deliberately ignores peer_endpoint_loads.
-    initialize_request_stats_monitor(60.0)
-    router = FleetRouter(load_factor=2.0)
-    eps = [make_endpoint(f"http://e{i}") for i in range(4)]
-    body = {"model": "m", "prompt": "W" * 600}
-    # Warm up e0 deliberately: insert its prefix directly.
-    _run(event_loop, router.hashtrie.insert("W" * 600, "http://e0"))
-    url = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
-    assert url != "http://e0"
+    appscope.scoped_set("state_backend", StubBackend())
+    try:
+        # A resolvable local monitor is required for peer loads to merge in:
+        # without one, routing treats the caller-passed stats as already
+        # fleet-merged and deliberately ignores peer_endpoint_loads.
+        initialize_request_stats_monitor(60.0)
+        router = FleetRouter(load_factor=2.0)
+        eps = [make_endpoint(f"http://e{i}") for i in range(4)]
+        body = {"model": "m", "prompt": "W" * 600}
+        # Warm up e0 deliberately: insert its prefix directly.
+        _run(event_loop, router.hashtrie.insert("W" * 600, "http://e0"))
+        url = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+        assert url != "http://e0"
+    finally:
+        appscope.scoped_set("state_backend", None)
 
 
 def test_fleet_loads_sums_local_and_peers():

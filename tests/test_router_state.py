@@ -288,16 +288,19 @@ def test_request_stats_fleet_merge(monkeypatch):
             "http://e9": {"qps": 1.0, "in_prefill": 0, "finished": 1},
         }
     })
-    monkeypatch.setattr(
-        "production_stack_tpu.router.state._state_backend", stub
-    )
-    merged = mon.get_request_stats(now + 0.1)
-    assert merged["http://e1"].in_prefill_requests == 3  # 1 local + 2 peer
-    assert merged["http://e1"].finished_requests == 7
-    assert merged["http://e9"].qps == 1.0  # engine only a peer sees
-    local = mon.get_request_stats(now + 0.1, fleet=False)
-    assert local["http://e1"].in_prefill_requests == 1
-    assert "http://e9" not in local
+    from production_stack_tpu.router import appscope
+
+    appscope.scoped_set("state_backend", stub)
+    try:
+        merged = mon.get_request_stats(now + 0.1)
+        assert merged["http://e1"].in_prefill_requests == 3  # 1 local + 2 peer
+        assert merged["http://e1"].finished_requests == 7
+        assert merged["http://e9"].qps == 1.0  # engine only a peer sees
+        local = mon.get_request_stats(now + 0.1, fleet=False)
+        assert local["http://e1"].in_prefill_requests == 1
+        assert "http://e9" not in local
+    finally:
+        appscope.scoped_set("state_backend", None)
 
 
 def test_bounded_load_ring_is_deterministic_and_sheds():
@@ -367,6 +370,93 @@ async def test_two_router_apps_no_request_stats_bleed():
         assert stats2 == {}  # replica 2 saw nothing: no bleed
     finally:
         for runner in (runner2, runner1, engine_runner):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+async def test_two_router_apps_no_discovery_or_routing_bleed():
+    """PR 11 app-scope burn-down: discovery AND routing logic are
+    app-scoped too. Two router apps with different backends and policies
+    keep their own, and a runtime reconfiguration of one app (what the
+    dynamic-config watcher does, in that app's scope) leaves the other
+    app's instances untouched — the last-app-wins module singletons are
+    gone."""
+    from production_stack_tpu.router import appscope
+    from production_stack_tpu.router.routing.logic import (
+        RoundRobinRouter,
+        RoutingLogic,
+        SessionRouter,
+        reconfigure_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        ServiceDiscoveryType,
+        reconfigure_service_discovery,
+    )
+
+    engine1_runner, engine1_port = await _start_app(
+        create_fake_engine_app(model=MODEL, speed=5000.0)
+    )
+    engine2_runner, engine2_port = await _start_app(
+        create_fake_engine_app(model=MODEL, speed=5000.0)
+    )
+    url1 = f"http://127.0.0.1:{engine1_port}"
+    url2 = f"http://127.0.0.1:{engine2_port}"
+
+    def argv(url, *extra):
+        return ["--service-discovery", "static",
+                "--static-backends", url,
+                "--static-models", MODEL, *extra]
+
+    app1 = create_app(parse_args(argv(url1)))
+    app2 = create_app(parse_args(
+        argv(url2, "--routing-logic", "session",
+             "--session-key", "x-session-id")
+    ))
+    runner1, port1 = await _start_app(app1)
+    runner2, port2 = await _start_app(app2)
+    try:
+        # Injected instances are distinct and see only their own fleet.
+        assert app1["service_discovery"] is not app2["service_discovery"]
+        assert [e.url for e in app1["service_discovery"].get_endpoint_info()] == [url1]
+        assert [e.url for e in app2["service_discovery"].get_endpoint_info()] == [url2]
+        assert isinstance(app1["routing_logic"], RoundRobinRouter)
+        assert isinstance(app2["routing_logic"], SessionRouter)
+
+        # Each app routes to ITS backend (ambient lookups resolve the
+        # serving app's scope via the middleware binding).
+        async with aiohttp.ClientSession() as s:
+            for port in (port1, port2):
+                async with s.post(
+                    f"http://127.0.0.1:{port}/v1/completions",
+                    json={"model": MODEL, "prompt": "p", "max_tokens": 2},
+                ) as resp:
+                    assert resp.status == 200
+                    await resp.read()
+        stats1 = app1["request_stats_monitor"].get_request_stats(time.time())
+        stats2 = app2["request_stats_monitor"].get_request_stats(time.time())
+        assert list(stats1) == [url1]
+        assert list(stats2) == [url2]
+
+        # Runtime reconfiguration in app2's scope (the dynamic-config
+        # watcher path) must not leak into app1.
+        routing1 = app1["routing_logic"]
+        discovery1 = app1["service_discovery"]
+        token = appscope.bind_scope(app2)
+        try:
+            reconfigure_routing_logic(RoutingLogic.ROUND_ROBIN)
+            reconfigure_service_discovery(
+                ServiceDiscoveryType.STATIC,
+                urls=[url1], models=[MODEL],
+            )
+        finally:
+            appscope.unbind_scope(token)
+        assert isinstance(app2["routing_logic"], RoundRobinRouter)
+        assert [e.url for e in app2["service_discovery"].get_endpoint_info()] == [url1]
+        assert app1["routing_logic"] is routing1
+        assert app1["service_discovery"] is discovery1
+        assert [e.url for e in app1["service_discovery"].get_endpoint_info()] == [url1]
+    finally:
+        for runner in (runner2, runner1, engine1_runner, engine2_runner):
             await runner.cleanup()
         reset_router_singletons()
 

@@ -1,45 +1,26 @@
-"""Unit tests for shared utils (parity with reference test_utils/test_singleton)."""
+"""Unit tests for shared utils (parity with reference test_utils).
 
-import threading
+The reference's ``SingletonMeta`` tests died with the metaclass itself:
+process-wide singletons are banned by the ``app-scope`` pstlint check
+(two router apps in one process must share zero state) — the scope
+machinery that replaced it is covered by test_router_state.py's
+two-apps-no-bleed ring and tests/test_pstlint.py.
+"""
 
+import production_stack_tpu.utils as pst_utils
 from production_stack_tpu.utils import (
     ModelType,
-    SingletonMeta,
     parse_static_aliases,
     parse_static_urls,
     validate_url,
 )
 
 
-class _Single(metaclass=SingletonMeta):
-    def __init__(self):
-        self.value = 0
-
-
-def test_singleton_identity():
-    a = _Single()
-    b = _Single()
-    assert a is b
-    a.value = 7
-    assert b.value == 7
-    _Single.destroy()
-    c = _Single()
-    assert c is not a
-
-
-def test_singleton_thread_safety():
-    _Single.destroy()
-    seen = []
-
-    def make():
-        seen.append(_Single())
-
-    threads = [threading.Thread(target=make) for _ in range(16)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    assert len({id(s) for s in seen}) == 1
+def test_singleton_meta_is_gone():
+    """Regression guard: the last-app-wins singleton machinery must not
+    quietly come back (the app-scope check would also catch its users)."""
+    assert not hasattr(pst_utils, "SingletonMeta")
+    assert not hasattr(pst_utils, "SingletonABCMeta")
 
 
 def test_validate_url():
