@@ -595,6 +595,163 @@ def run_fleet_phase() -> dict:
     }
 
 
+def run_tenant_phase() -> dict:
+    """Tenant flood isolation (docs/multi-tenancy.md): the real router
+    with --tenant-isolation over two fake engines; a victim tenant paces
+    steady traffic while a flooder offers ~10x its admitted share. The
+    headline numbers are the victim's p50/p99 with and without the flood
+    and the isolation delta — the ≤10% guarantee BENCH rounds capture as
+    driver evidence (per-point, kill-surviving, like the fleet phase).
+    """
+    model = "fake/model"
+    env = dict(os.environ, PYTHONPATH=REPO)
+    base_port = 18400
+    eports = [base_port, base_port + 1]
+    rport = base_port + 2
+    for p in eports + [rport]:
+        ensure_port_free(p)
+    tenant_file = "/tmp/pst_bench_tenants.json"
+    with open(tenant_file, "w") as f:
+        json.dump({"tenants": {
+            "victim": {"weight": 1, "tier": "interactive"},
+            "flooder": {"weight": 1, "tier": "interactive"},
+        }}, f)
+    procs = []
+    try:
+        for i, p in enumerate(eports):
+            lg = f"/tmp/pst_tenant_engine_{p}.log"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "production_stack_tpu.testing.fake_engine",
+                 "--port", str(p), "--model", model,
+                 "--speed", "40", "--ttft", "0.02",
+                 "--name", f"tenant-{i}"],
+                stdout=open(lg, "w"), stderr=subprocess.STDOUT,
+                cwd=REPO, env=env,
+            ))
+            if not wait_http(f"http://127.0.0.1:{p}/health", 60,
+                             proc=procs[-1], log_path=lg):
+                raise RuntimeError(f"tenant fake engine :{p} not healthy")
+        rlog = "/tmp/pst_tenant_router.log"
+        router = subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.router.app",
+             "--port", str(rport),
+             "--service-discovery", "static",
+             "--static-backends",
+             ",".join(f"http://127.0.0.1:{p}" for p in eports),
+             "--static-models", ",".join([model] * len(eports)),
+             "--routing-logic", "roundrobin",
+             "--engine-stats-interval", "1",
+             "--tenant-isolation",
+             "--tenant-config", tenant_file,
+             "--admission-rate", "30",
+             "--admission-queue-timeout", "0.3"],
+            stdout=open(rlog, "w"), stderr=subprocess.STDOUT,
+            cwd=REPO, env=env,
+        )
+        procs.append(router)
+        if not wait_http(f"http://127.0.0.1:{rport}/health", 60,
+                         proc=router, log_path=rlog):
+            raise RuntimeError("tenant router not healthy")
+
+        import aiohttp
+
+        base = f"http://127.0.0.1:{rport}"
+
+        async def one(session, tenant, max_tokens=4):
+            t0 = time.monotonic()
+            async with session.post(
+                f"{base}/v1/completions",
+                json={"model": model, "prompt": f"{tenant} q",
+                      "max_tokens": max_tokens},
+                headers={"X-PST-Tenant": tenant},
+            ) as resp:
+                await resp.read()
+                return resp.status, time.monotonic() - t0
+
+        async def victim_phase(session, n=40, pace=0.05):
+            lat, shed = [], 0
+            for _ in range(n):
+                status, dt = await one(session, "victim")
+                if status == 200:
+                    lat.append(dt)
+                else:
+                    shed += 1
+                await asyncio.sleep(pace)
+            return lat, shed
+
+        async def drive() -> dict:
+            async with aiohttp.ClientSession() as session:
+                baseline, base_shed = await victim_phase(session)
+                stop = asyncio.Event()
+
+                async def flood():
+                    tasks = []
+                    while not stop.is_set():
+                        tasks.append(asyncio.create_task(
+                            one(session, "flooder", max_tokens=1)
+                        ))
+                        await asyncio.sleep(0.01)  # ~100 rps offered
+                    done = await asyncio.gather(
+                        *tasks, return_exceptions=True
+                    )
+                    return [d[0] for d in done if isinstance(d, tuple)]
+
+                flood_task = asyncio.create_task(flood())
+                await asyncio.sleep(0.3)
+                flooded, flood_shed = await victim_phase(session)
+                stop.set()
+                statuses = await flood_task
+                return {
+                    "baseline": baseline, "flooded": flooded,
+                    "victim_sheds": base_shed + flood_shed,
+                    "flood_offered": len(statuses),
+                    "flood_shed": sum(1 for s in statuses if s == 429),
+                }
+
+        res = asyncio.run(drive())
+
+        def pct(samples, q):
+            if not samples:
+                return None
+            ordered = sorted(samples)
+            return ordered[min(int(len(ordered) * q), len(ordered) - 1)]
+
+        base_p99 = pct(res["baseline"], 0.99)
+        flood_p99 = pct(res["flooded"], 0.99)
+        delta = (
+            (flood_p99 - base_p99) / base_p99
+            if base_p99 and flood_p99 else None
+        )
+        return {
+            "victim_p50_ms": round(pct(res["baseline"], 0.5) * 1e3, 1),
+            "victim_p99_ms": round(base_p99 * 1e3, 1),
+            "flood_victim_p50_ms": round(pct(res["flooded"], 0.5) * 1e3, 1),
+            "flood_victim_p99_ms": round(flood_p99 * 1e3, 1),
+            "p99_delta_frac": round(delta, 4) if delta is not None else None,
+            "victim_sheds": res["victim_sheds"],
+            "flood_offered": res["flood_offered"],
+            "flood_shed": res["flood_shed"],
+            "target_delta_frac": 0.10,
+            # The guarantee: victim p99 moved <= 10%, no victim sheds,
+            # and the flood really was over its share (mostly 429s).
+            "meets_target": bool(
+                delta is not None and delta <= 0.10
+                and res["victim_sheds"] == 0
+                and res["flood_shed"] > res["flood_offered"] * 0.5
+            ),
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def probe_backend() -> str:
     proc = subprocess.run(
         [sys.executable, "-c", "import jax; print(jax.default_backend())"],
@@ -621,7 +778,7 @@ def emit(out: dict) -> None:
         log(f"could not write {path}: {e}")
 
 
-def assemble(engine_res: dict, stack, fleet) -> dict:
+def assemble(engine_res: dict, stack, fleet, tenants=None) -> dict:
     flag = engine_res.get("flagship", {})
     p50 = flag.get("p50_ttft_ms")
     return {
@@ -647,6 +804,7 @@ def assemble(engine_res: dict, stack, fleet) -> dict:
         ),
         "stack": stack,
         "fleet": fleet,
+        "tenants": tenants,
     }
 
 
@@ -683,8 +841,17 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — fleet numbers are additive
             log(f"fleet phase failed: {e}")
             fleet = {"error": str(e)}
+        emit(assemble(engine_res, stack, fleet))
 
-    emit(assemble(engine_res, stack, fleet))
+    tenants = None
+    if os.environ.get("PST_BENCH_SKIP_TENANTS") != "1":
+        try:
+            tenants = run_tenant_phase()
+        except Exception as e:  # noqa: BLE001 — tenant numbers are additive
+            log(f"tenant phase failed: {e}")
+            tenants = {"error": str(e)}
+
+    emit(assemble(engine_res, stack, fleet, tenants))
     # Same fallback as assemble(): a truncated engine phase may carry only
     # per-phase pollution flags, never the run-level verdict — the exit
     # gate must not be laxer than the emitted JSON.

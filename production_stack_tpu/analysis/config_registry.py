@@ -151,6 +151,12 @@ ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
           "routerSpec.resilience.breakerRecoveryTime", doc=_RESILIENCE_DOC),
     _helm("--breaker-half-open-probes",
           "routerSpec.resilience.breakerHalfOpenProbes", doc=_RESILIENCE_DOC),
+    _helm("--tenant-isolation", "routerSpec.tenancy.enabled"),
+    _helm("--tenant-config", "routerSpec.tenancy.configFile"),
+    _helm("--tenant-default-weight", "routerSpec.tenancy.defaultWeight"),
+    _helm("--tenant-default-tier", "routerSpec.tenancy.defaultTier"),
+    _cli("--tenant-header", "identity-header rename is a gateway-"
+         "integration detail; extraArgs"),
     _helm("--default-deadline-ms", "routerSpec.resilience.defaultDeadlineMs",
           doc=_RESILIENCE_DOC),
     _helm("--hedge-enabled", "routerSpec.resilience.hedge.enabled",
@@ -377,6 +383,9 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
     EngineFieldSpec("deadline_shedding", "--deadline-shedding",
                     "servingEngineSpec.deadlineShedding",
                     emit="--no-deadline-shedding"),
+    EngineFieldSpec("tenant_fairness", "--tenant-fairness",
+                    "servingEngineSpec.tenantFairness",
+                    emit="--no-tenant-fairness"),
     EngineFieldSpec("warmup", "--warmup", "servingEngineSpec.warmup.mode",
                     default_differs="helm deploys warmed (full); bare CLI "
                     "and embedded runs default to off so dev loops stay "

@@ -165,6 +165,8 @@ class AsyncLLMEngine:
         request_id: Optional[str] = None,
         lora_name: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
+        tenant_class: Optional[str] = None,
     ) -> AsyncIterator[RequestOutput]:
         if self.step_error is not None:
             raise RuntimeError(f"engine is failed: {self.step_error}")
@@ -186,6 +188,8 @@ class AsyncLLMEngine:
                             arrival_time=time.monotonic(),
                             lora_name=lora_name,
                             deadline=deadline,
+                            tenant=tenant,
+                            tenant_class=tenant_class,
                         ),
                     )
                 )

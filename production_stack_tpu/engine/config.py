@@ -157,6 +157,14 @@ class EngineConfig:
     # admission, drop expired queued sequences before they consume a
     # prefill step, and stop decoding expired running sequences.
     deadline_shedding: bool = True
+    # Tenant-aware scheduling (docs/multi-tenancy.md): honor the
+    # router-stamped X-PST-Tenant / X-PST-Tenant-Class headers — the
+    # ready queue admits weighted-fair across tenants with strict tier
+    # priority (interactive before batch), and batch-tier sequences are
+    # preempted first (swap/shed) when an interactive tenant is waiting
+    # for pages. With every request untagged (or this off) scheduling is
+    # byte-for-byte the plain FIFO behavior.
+    tenant_fairness: bool = True
     # Ahead-of-time shape-bucket precompilation (engine/precompile.py;
     # docs/engine.md "Warmup & precompilation"). "full" compiles the whole
     # padded shape-bucket lattice before /ready flips; "lazy" compiles only

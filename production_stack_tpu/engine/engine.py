@@ -123,6 +123,7 @@ class LLMEngine:
                 spec_tokens=0 if cfg.async_decode else cfg.speculative_ngram,
                 swap_quantum=cfg.swap_quantum_tokens,
                 deadline_shedding=cfg.deadline_shedding,
+                tenant_fairness=cfg.tenant_fairness,
             ),
             self.allocator,
             swapper=self.swapper,
@@ -226,6 +227,8 @@ class LLMEngine:
         arrival_time: Optional[float] = None,
         lora_name: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
+        tenant_class: Optional[str] = None,
     ) -> Sequence:
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
@@ -253,6 +256,8 @@ class LLMEngine:
             lora_scale=lora_scale,
             cache_salt=salt,
             deadline=deadline if self.cfg.deadline_shedding else None,
+            tenant=tenant or "default",
+            tenant_class=tenant_class or "interactive",
         )
         self._last_arrival = time.time()
         self.scheduler.add(seq)
@@ -927,6 +932,13 @@ class LLMEngine:
                 self.scheduler.deadline_sheds_running
             ),
         }
+        if self.cfg.tenant_fairness:
+            ages = self.scheduler.queue_age_by_tier()
+            out["tenant_queue_age_interactive"] = ages["interactive"]
+            out["tenant_queue_age_batch"] = ages["batch"]
+            out["tenant_batch_preemptions_total"] = float(
+                self.scheduler.batch_preemptions
+            )
         if self.cfg.speculative_ngram:
             out["spec_decode_num_draft_tokens_total"] = float(
                 self.spec_proposed_total

@@ -49,6 +49,13 @@ class SchedulerConfig:
     # (Sequence.deadline, monotonic) expired — queued ones before they
     # consume a prefill step, running ones between decode steps.
     deadline_shedding: bool = True
+    # Tenant-aware scheduling (docs/multi-tenancy.md): admit the waiting
+    # queue weighted-fair across tenants with strict tier priority
+    # (interactive before batch) and preempt batch-tier sequences first
+    # — swap/shed — when an interactive tenant is waiting for pages.
+    # With homogeneous traffic (one tenant/tier) behavior is identical
+    # to plain FIFO.
+    tenant_fairness: bool = True
 
 
 @dataclasses.dataclass
@@ -109,6 +116,13 @@ class Scheduler:
         # Deadline-shed counters (engine stats → pst:deadline_shed_*).
         self.deadline_sheds_queued = 0  # shed before any prefill step
         self.deadline_sheds_running = 0  # shed between decode steps
+        # Tenant QoS (docs/multi-tenancy.md): DRR credit across tenant
+        # classes for waiting-queue admission order, and counters/ages
+        # the server exports as pst:tenant_* metrics.
+        from ..resilience.tenancy import DeficitScheduler
+
+        self._tenant_drr = DeficitScheduler()
+        self.batch_preemptions = 0  # batch seqs preempted for interactive
 
     # -- queue ops --------------------------------------------------------
 
@@ -356,6 +370,68 @@ class Scheduler:
             self.swapped.append(best)
             self._admit_blocked = None  # free pages changed
 
+    def queue_age_by_tier(self, now: Optional[float] = None) -> dict:
+        """Oldest waiting sequence's queue age per tier (seconds) — the
+        per-tenant starvation signal behind ``pst:tenant_queue_age_*``.
+        The flood-isolation contract is asserted on these: batch pressure
+        must never grow the interactive queue age."""
+        now = now if now is not None else time.monotonic()
+        ages = {"interactive": 0.0, "batch": 0.0}
+        # list(deque) is a single C-level copy (atomic under the GIL):
+        # this reader runs on the HTTP/stats thread while the step thread
+        # mutates the queues, and iterating the live deque would raise
+        # "deque mutated during iteration" mid-scrape.
+        for q in (list(self.waiting), list(self.swapped)):
+            for seq in q:
+                tier = "batch" if seq.tier_rank else "interactive"
+                ages[tier] = max(ages[tier], now - seq.arrival_time)
+        return ages
+
+    def _next_waiting_index(self) -> int:
+        """Which waiting sequence admits next. Plain FIFO (index 0) when
+        tenant fairness is off or the queue is homogeneous; otherwise the
+        best tier admits first (interactive strictly before batch) and
+        tenants within that tier take turns by deficit round robin —
+        stamp order is preserved *within* each (tier, tenant) class, so
+        no tenant's own requests ever reorder."""
+        if not self.config.tenant_fairness or len(self.waiting) < 2:
+            return 0
+        keys = {(s.tier_rank, s.tenant) for s in self.waiting}
+        if len(keys) == 1:
+            return 0
+        best_rank = min(rank for rank, _ in keys)
+        heads: dict = {}
+        for i, s in enumerate(self.waiting):
+            if s.tier_rank == best_rank and s.tenant not in heads:
+                heads[s.tenant] = i
+        pick = self._tenant_drr.pick({t: 1.0 for t in heads})
+        return heads.get(pick, 0)
+
+    def _preempt_batch_for(self, seq: Sequence, out: SchedulerOutput) -> bool:
+        """An interactive sequence is blocked on pages while batch-tier
+        work holds them: preempt ONE batch-tier running sequence
+        (swap-first — ``_preempt`` parks KV host-side when it can, sheds
+        to recompute otherwise) and report whether pages were freed.
+        Batch work is throughput-oriented by contract; trading its decode
+        progress for interactive TTFT is the whole point of the tiers."""
+        locked = getattr(self, "_locked", frozenset())
+        victim: Optional[Sequence] = None
+        for cand in reversed(self.running):  # youngest batch first
+            if cand.request_id in locked or cand.tier_rank != 1:
+                continue
+            victim = cand
+            break
+        if victim is None:
+            return False
+        self._preempt(victim, out)
+        self.batch_preemptions += 1
+        self._admit_blocked = None  # free pages changed
+        logger.info(
+            "preempting batch-tier request %s for waiting interactive %s",
+            victim.request_id, seq.request_id,
+        )
+        return True
+
     def _promised_pages(self) -> int:
         """Pages already-admitted sequences will still allocate to finish
         their prompts. Admission allocates nothing itself, so gating each
@@ -407,12 +483,17 @@ class Scheduler:
                 # the sequence recomputes from its longest surviving prefix.
                 self._insert_by_stamp(self.waiting, seq)
         while self.waiting and len(self.running) < self.config.max_num_seqs:
-            seq = self.waiting[0]
+            idx = self._next_waiting_index()
+            seq = self.waiting[idx]
             if self.swapped and (
                 getattr(self.swapped[0], "queue_stamp", 0) < seq.queue_stamp
+                and self.swapped[0].tier_rank <= seq.tier_rank
             ):
                 # A parked sequence is older but could not resume (page
-                # gate above): hold the line rather than jump it.
+                # gate above): hold the line rather than jump it. A
+                # waiting sequence of a STRICTLY better tier does jump a
+                # parked batch one — interactive admission must not queue
+                # behind preempted batch work.
                 break
             if self._admit_blocked == (
                 seq.request_id,
@@ -453,14 +534,27 @@ class Scheduler:
                     self.allocator.release_all(seq.block_ids)
                     seq.reset_for_recompute()
                     seq.status = SequenceStatus.WAITING
+                # Batch-tier preemption (docs/multi-tenancy.md): before
+                # declaring the pool full for a waiting INTERACTIVE
+                # sequence, evict one running batch-tier sequence
+                # (swap-first) and retry — batch work never starves
+                # interactive prefills on pages.
+                if (
+                    self.config.tenant_fairness
+                    and seq.tier_rank == 0
+                    and self._preempt_batch_for(seq, out)
+                ):
+                    promised = self._promised_pages()
+                    continue
                 self._admit_blocked = (
                     seq.request_id,
                     self.allocator.num_free,
                     self.config.max_prefill_tokens,
                 )
                 break
-            self.waiting.popleft()
+            del self.waiting[idx]
             self._admit_blocked = None
+            self._tenant_drr.charge(seq.tenant)
             seq.status = SequenceStatus.RUNNING
             seq.resume_marker = seq.num_tokens
             # Queue-wait end marker (first admission only: a preempted
@@ -503,6 +597,16 @@ class Scheduler:
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         locked = getattr(self, "_locked", frozenset())
+        if self.config.tenant_fairness:
+            # Batch-tier sequences are preemptible first: an interactive
+            # sequence only loses pages when no batch victim remains.
+            for seq in reversed(self.running):  # youngest batch first
+                if (
+                    seq is not exclude
+                    and seq.request_id not in locked
+                    and seq.tier_rank == 1
+                ):
+                    return seq
         for seq in reversed(self.running):  # youngest first (vLLM policy)
             if seq is not exclude and seq.request_id not in locked:
                 return seq

@@ -111,6 +111,8 @@ class Sequence:
         lora_scale: float = 0.0,
         cache_salt: int = 0,
         deadline: Optional[float] = None,
+        tenant: str = "default",
+        tenant_class: str = "interactive",
     ):
         self.request_id = request_id
         self.prompt_token_ids: List[int] = list(prompt_token_ids)
@@ -137,6 +139,13 @@ class Sequence:
         # latency budget; None = no deadline. The scheduler sheds expired
         # sequences before they consume device steps.
         self.deadline = deadline
+        # Tenant identity and tier, stamped by the router at admission
+        # (X-PST-Tenant / X-PST-Tenant-Class). The scheduler admits
+        # weighted-fair across tenants and preempts batch-tier work first.
+        self.tenant = tenant
+        self.tenant_class = (
+            tenant_class if tenant_class == "batch" else "interactive"
+        )
 
         # KV bookkeeping.
         self.block_ids: List[int] = []
@@ -155,6 +164,11 @@ class Sequence:
         self.queue_stamp = 0
 
     # -- lengths ----------------------------------------------------------
+
+    @property
+    def tier_rank(self) -> int:
+        """0 = interactive (served first), 1 = batch."""
+        return 1 if self.tenant_class == "batch" else 0
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -210,6 +210,22 @@ class EngineMetrics:
         self.swap_stash = gauge(
             "pst:kv_swap_stash_blocks", "host-DRAM stash occupancy (pages)"
         )
+        # Tenant QoS (docs/multi-tenancy.md): per-tier queue age is the
+        # starvation signal the flood-isolation guarantee asserts on, and
+        # batch preemptions count pages reclaimed for interactive work.
+        self.tenant_queue_age_interactive = gauge(
+            "pst:tenant_queue_age_interactive_seconds",
+            "oldest interactive-tier queued sequence's wait (seconds)",
+        )
+        self.tenant_queue_age_batch = gauge(
+            "pst:tenant_queue_age_batch_seconds",
+            "oldest batch-tier queued sequence's wait (seconds)",
+        )
+        self.tenant_batch_preemptions = counter(
+            "pst:tenant_batch_preemptions",
+            "batch-tier sequences preempted (swap/shed) so a waiting "
+            "interactive sequence could admit",
+        )
         self._counter_last: dict = {}
 
     def _counter_to(self, c, key: str, total: float) -> None:
@@ -277,6 +293,16 @@ class EngineMetrics:
         self._counter_to(
             self.deadline_shed_running, "dl_running",
             stats.get("deadline_sheds_running_total", 0),
+        )
+        self.tenant_queue_age_interactive.set(
+            stats.get("tenant_queue_age_interactive", 0.0)
+        )
+        self.tenant_queue_age_batch.set(
+            stats.get("tenant_queue_age_batch", 0.0)
+        )
+        self._counter_to(
+            self.tenant_batch_preemptions, "tenant_batch_preempt",
+            stats.get("tenant_batch_preemptions_total", 0),
         )
 
 
@@ -547,6 +573,18 @@ def create_engine_app(
             return _deadline_error(), None
         return None, d.expires_at
 
+    def _request_tenant(request: web.Request):
+        """``(tenant, tenant_class)`` from the router-stamped headers
+        (docs/multi-tenancy.md). The router overwrites client-sent values
+        at admission, so within a deployed stack these are trusted; an
+        engine reached directly treats the caller as the default
+        interactive tenant unless it self-declares."""
+        if not engine.engine.cfg.tenant_fairness:
+            return None, None
+        tenant = request.headers.get("X-PST-Tenant")
+        tier = request.headers.get("X-PST-Tenant-Class")
+        return tenant, tier
+
     # -- model listing -------------------------------------------------
 
     async def list_models(request: web.Request) -> web.Response:
@@ -631,6 +669,7 @@ def create_engine_app(
         created = int(time.time())
         rid = random_id("cmpl")
         start = time.time()
+        tenant, tenant_class = _request_tenant(request)
 
         async def one(prompt) -> dict:
             if isinstance(prompt, list):
@@ -649,7 +688,8 @@ def create_engine_app(
                 return {"error": str(e), "ids": ids}
             parts, n_out, finish = [], 0, None
             async for out in engine.generate(
-                prompt_token_ids=ids, sampling=sampling, deadline=deadline
+                prompt_token_ids=ids, sampling=sampling, deadline=deadline,
+                tenant=tenant, tenant_class=tenant_class,
             ):
                 parts.append(out.text_delta)
                 n_out = out.num_output_tokens
@@ -760,9 +800,11 @@ def create_engine_app(
                 echo, lora, best_of, deadline=deadline,
             )
 
+        tenant, tenant_class = _request_tenant(request)
         gen = engine.generate(
             prompt_token_ids=ids, sampling=sampling, request_id=rid,
             lora_name=lora, deadline=deadline,
+            tenant=tenant, tenant_class=tenant_class,
         )
 
         if req.stream:
@@ -979,6 +1021,8 @@ def create_engine_app(
             0 if rank and sampling.logprobs is None else sampling.logprobs
         )
 
+        tenant, tenant_class = _request_tenant(request)
+
         async def one(i: int) -> dict:
             sp = _dc.replace(
                 sampling,
@@ -988,6 +1032,7 @@ def create_engine_app(
             return await _collect(engine.generate(
                 prompt_token_ids=ids, sampling=sp, request_id=f"{rid}-{i}",
                 lora_name=lora, deadline=deadline,
+                tenant=tenant, tenant_class=tenant_class,
             ))
 
         try:
@@ -1522,6 +1567,13 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                    action="store_true", default=True)
     p.add_argument("--no-deadline-shedding", dest="deadline_shedding",
                    action="store_false")
+    # Tenant-aware scheduling (docs/multi-tenancy.md): honor the
+    # router-stamped X-PST-Tenant / X-PST-Tenant-Class headers in the
+    # ready queue (weighted-fair admission, batch preempted first).
+    p.add_argument("--tenant-fairness", dest="tenant_fairness",
+                   action="store_true", default=True)
+    p.add_argument("--no-tenant-fairness", dest="tenant_fairness",
+                   action="store_false")
     # Request tracing (docs/observability.md): engine-side spans for
     # admission / queue wait / prefill / decode, joined to the router's
     # trace via the propagated traceparent.
@@ -1609,6 +1661,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         engine_url=args.engine_url,
         kv_role=args.kv_role,
         deadline_shedding=args.deadline_shedding,
+        tenant_fairness=args.tenant_fairness,
         warmup=args.warmup,
         warmup_bucket_budget=args.warmup_bucket_budget,
         compile_cache_dir=args.compile_cache_dir,

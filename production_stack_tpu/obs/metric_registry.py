@@ -90,6 +90,12 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_stream_resume_success_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_resume_failures_total", COUNTER, "resilience/metrics.py"),
     MetricSpec("pst_stream_truncated_total", COUNTER, "resilience/metrics.py"),
+    # Multi-tenant QoS (docs/multi-tenancy.md): per-tenant admission,
+    # queue depth and usage metering.
+    MetricSpec("pst_tenant_admitted_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_tenant_sheds_total", COUNTER, "resilience/metrics.py"),
+    MetricSpec("pst_tenant_queue_depth", GAUGE, "resilience/metrics.py"),
+    MetricSpec("pst_tenant_usage_tokens_total", COUNTER, "resilience/metrics.py"),
     # --- router/routing/metrics.py: fleet routing ------------------------
     MetricSpec("pst_route_score", HISTOGRAM, "router/routing/metrics.py"),
     MetricSpec("pst_route_spill", COUNTER, "router/routing/metrics.py"),
@@ -108,6 +114,8 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_router:disk_percent", GAUGE, "router/services/metrics_service.py"),
     MetricSpec("pst_slo_requests", COUNTER, "router/services/metrics_service.py"),
     MetricSpec("pst_slo_ttft_within_target", COUNTER, "router/services/metrics_service.py"),
+    MetricSpec("pst_tenant_slo_requests", COUNTER, "router/services/metrics_service.py"),
+    MetricSpec("pst_tenant_slo_ttft_within_target", COUNTER, "router/services/metrics_service.py"),
     MetricSpec("pst_canary_ttft_seconds", GAUGE, "router/services/metrics_service.py"),
     MetricSpec("pst_canary_failures", COUNTER, "router/services/metrics_service.py"),
 )

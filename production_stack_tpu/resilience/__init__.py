@@ -40,6 +40,14 @@ from .deadline import (
 )
 from .retry import RetryPolicy
 from .stream_resume import StreamResumePolicy
+from .tenancy import (
+    TENANT_CLASS_HEADER,
+    TENANT_HEADER,
+    TIER_BATCH,
+    TIER_INTERACTIVE,
+    TenantConfig,
+    TenantSpec,
+)
 
 _breaker_registry: Optional[CircuitBreakerRegistry] = None
 _admission_controller: Optional[AdmissionController] = None
@@ -47,17 +55,36 @@ _retry_policy: Optional[RetryPolicy] = None
 _hedge_policy: Optional[HedgePolicy] = None
 _stream_resume_policy: Optional[StreamResumePolicy] = None
 _default_deadline_ms: float = 0.0
+_tenant_config: Optional[TenantConfig] = None
+
+
+def _build_tenant_config(args) -> Optional[TenantConfig]:
+    """TenantConfig from parsed router args (None = tenancy off: every
+    layer behaves exactly as before tenants existed)."""
+    if not getattr(args, "tenant_isolation", False):
+        return None
+    path = getattr(args, "tenant_config", None)
+    kwargs = dict(
+        default_weight=float(getattr(args, "tenant_default_weight", 1.0)),
+        default_tier=getattr(args, "tenant_default_tier", TIER_INTERACTIVE),
+        header=getattr(args, "tenant_header", TENANT_HEADER),
+    )
+    if path:
+        return TenantConfig.from_file(path, **kwargs)
+    return TenantConfig(**kwargs)
 
 
 def initialize_resilience(args) -> None:
     """Create the resilience singletons from parsed router args."""
     global _breaker_registry, _admission_controller, _retry_policy
     global _hedge_policy, _stream_resume_policy, _default_deadline_ms
+    global _tenant_config
     # Router HA: breakers and admission coordinate across replicas through
     # the state backend (None / in-memory = exact single-replica behavior).
     from ..router.state import PROVIDER_BREAKERS, get_state_backend
 
     backend = get_state_backend()
+    _tenant_config = _build_tenant_config(args)
     _breaker_registry = CircuitBreakerRegistry(
         failure_threshold=getattr(args, "breaker_failure_threshold", 5),
         recovery_time=getattr(args, "breaker_recovery_time", 10.0),
@@ -73,6 +100,7 @@ def initialize_resilience(args) -> None:
         max_queue=getattr(args, "admission_queue_size", 128),
         queue_timeout=getattr(args, "admission_queue_timeout", 5.0),
         state_backend=backend,
+        tenants=_tenant_config,
     )
     _retry_policy = RetryPolicy(
         max_attempts=getattr(args, "proxy_retries", 2) + 1,
@@ -119,9 +147,14 @@ def get_default_deadline_ms() -> float:
     return _default_deadline_ms
 
 
+def get_tenant_config() -> Optional[TenantConfig]:
+    return _tenant_config
+
+
 def teardown_resilience() -> None:
     global _breaker_registry, _admission_controller, _retry_policy
     global _hedge_policy, _stream_resume_policy, _default_deadline_ms
+    global _tenant_config
     if _admission_controller is not None:
         _admission_controller.close()
     _breaker_registry = None
@@ -130,6 +163,7 @@ def teardown_resilience() -> None:
     _hedge_policy = None
     _stream_resume_policy = None
     _default_deadline_ms = 0.0
+    _tenant_config = None
 
 
 __all__ = [
@@ -143,6 +177,12 @@ __all__ = [
     "HedgePolicy",
     "RetryPolicy",
     "StreamResumePolicy",
+    "TENANT_CLASS_HEADER",
+    "TENANT_HEADER",
+    "TIER_BATCH",
+    "TIER_INTERACTIVE",
+    "TenantConfig",
+    "TenantSpec",
     "initialize_resilience",
     "get_breaker_registry",
     "get_admission_controller",
@@ -150,6 +190,7 @@ __all__ = [
     "get_hedge_policy",
     "get_stream_resume_policy",
     "get_default_deadline_ms",
+    "get_tenant_config",
     "parse_deadline",
     "teardown_resilience",
 ]

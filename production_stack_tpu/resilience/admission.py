@@ -31,12 +31,13 @@ import heapq
 import math
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..logging_utils import init_logger
 from ..obs.tasks import spawn_owned
 from . import metrics
 from .deadline import Deadline
+from .tenancy import DEFAULT_TENANT, TenantConfig, TenantSpec, WeightedFairQueue
 
 logger = init_logger(__name__)
 
@@ -106,6 +107,7 @@ class AdmissionController:
         max_queue: int = 128,
         queue_timeout: float = 5.0,
         state_backend=None,
+        tenants: Optional[TenantConfig] = None,
     ):
         # ``rate``/``burst`` are FLEET-WIDE limits. With a shared state
         # backend each replica admits only its membership share
@@ -128,11 +130,25 @@ class AdmissionController:
         self._seq = 0
         self._dispatcher: Optional[asyncio.Task] = None
         self._wakeup: Optional[asyncio.Event] = None
+        # Multi-tenant mode (docs/multi-tenancy.md): the single shared
+        # bucket becomes per-tenant weighted buckets (each tenant's
+        # guaranteed refill is its weight share of the global rate, or
+        # its explicit absolute rate), and the priority heap becomes a
+        # weighted-fair (deficit round robin) queue with strict tier
+        # priority. With ``tenants=None`` nothing below exists and the
+        # controller behaves exactly as before.
+        self.tenants = tenants
+        # pstlint: owned-by=task:tenant_bucket,_apply_share
+        self._tenant_buckets: Dict[str, TokenBucket] = {}
+        self._wfq = WeightedFairQueue() if tenants is not None else None
 
     def _apply_share(self) -> None:
         """Pull the current membership share and rescale the local bucket
         (rate AND burst capacity — a replica death must not leave the
-        fleet with 2× the configured burst)."""
+        fleet with 2× the configured burst). Tenant buckets rescale the
+        same way: each tenant's *fleet-wide* guarantee splits across live
+        replicas, so two gossiping replicas together enforce exactly the
+        per-tenant limits one replica would."""
         backend = self.state_backend
         if backend is None or not getattr(backend, "shared", False):
             return
@@ -144,11 +160,62 @@ class AdmissionController:
         new_capacity = max(self._capacity * share, 1.0)
         self.bucket.tokens = min(self.bucket.tokens, new_capacity)
         self.bucket.capacity = new_capacity
+        for b in self._tenant_buckets.values():
+            self._rescale_bucket(b)
+
+    def _rescale_bucket(self, b: TokenBucket) -> None:
+        b.rate = max(b.base_rate * self._share, 1e-9)
+        cap = max(b.base_capacity * self._share, 1.0)
+        b.tokens = min(b.tokens, cap)
+        b.capacity = cap
+
+    def tenant_bucket(self, spec: TenantSpec) -> TokenBucket:
+        """The tenant's own refill bucket: its explicit absolute rate, or
+        its weight share of the global rate. Created lazily; bounded (an
+        ad-hoc tenant flood must cost O(cap) buckets, not O(names)).
+
+        AD-HOC tenants (names with no configured spec) all draw from the
+        DEFAULT tenant's bucket: the whole ad-hoc population shares one
+        default-weight slice of the global rate — otherwise rotating
+        invented names would mint a fresh full share per name and bypass
+        ``--admission-rate`` entirely. They still queue per name (DRR
+        fairness among them), but tokens come from the shared slice."""
+        if spec.name not in self.tenants.tenants:
+            spec = self.tenants.tenants[DEFAULT_TENANT]
+        b = self._tenant_buckets.get(spec.name)
+        if b is None:
+            rate = spec.rate
+            if rate <= 0:
+                rate = self.rate * spec.weight / max(
+                    self.tenants.weight_sum(), 1e-9
+                )
+            rate = max(rate, 1e-9)
+            burst = spec.burst or max(math.ceil(rate), 1)
+            b = TokenBucket(rate, burst)
+            b.base_rate = rate
+            b.base_capacity = float(b.capacity)
+            if self._share != 1.0:
+                self._rescale_bucket(b)
+            if len(self._tenant_buckets) >= 4096:
+                # Evict an idle (full) ad-hoc bucket; a full bucket holds
+                # no state worth keeping (recreation is identical).
+                for name, old in list(self._tenant_buckets.items()):
+                    if (
+                        name not in self.tenants.tenants
+                        and old.tokens >= old.capacity
+                    ):
+                        del self._tenant_buckets[name]
+                        break
+            self._tenant_buckets[spec.name] = b
+        return b
 
     # -- internals --------------------------------------------------------
 
     def queue_len(self) -> int:
-        return sum(1 for w in self._heap if not w.future.done())
+        n = sum(1 for w in self._heap if not w.future.done())
+        if self._wfq is not None:
+            n += len(self._wfq)
+        return n
 
     def _waiters_ahead(self, priority: int) -> int:
         """Waiters the dispatcher would serve before a new request at
@@ -163,9 +230,12 @@ class AdmissionController:
     def _ensure_dispatcher(self) -> None:
         if self._dispatcher is None or self._dispatcher.done():
             self._wakeup = asyncio.Event()
-            self._dispatcher = spawn_owned(
-                self._dispatch_loop(), name="admission-dispatcher"
+            loop = (
+                self._dispatch_tenants()
+                if self._wfq is not None
+                else self._dispatch_loop()
             )
+            self._dispatcher = spawn_owned(loop, name="admission-dispatcher")
 
     async def _dispatch_loop(self) -> None:
         """Grant refilled tokens to waiters, highest priority first."""
@@ -186,6 +256,68 @@ class AdmissionController:
                     waiter.future.set_result(True)
                 metrics.queue_depth.set(self.queue_len())
 
+    async def _dispatch_tenants(self) -> None:
+        """Tenant-mode dispatcher: grant each waiting tenant's own tokens
+        as they refill, serving tiers strictly (interactive first) and
+        tenants within a tier by deficit round robin. A tenant whose
+        bucket is dry is skipped without burning its DRR deficit — its
+        fairness debt survives until it can actually be served."""
+
+        def _ready(name: str) -> bool:
+            spec = self.tenants.spec_for(name)
+            return self.tenant_bucket(spec).time_until_tokens(1.0) <= 0.0
+
+        def _weight(name: str) -> float:
+            return self.tenants.spec_for(name).weight
+
+        while True:
+            while not len(self._wfq):
+                self._wakeup.clear()
+                await self._wakeup.wait()
+            self._wfq.discard(lambda f: f.done())  # timed-out waiters
+            if not len(self._wfq):
+                continue
+            # Serve everything currently servable (pop returns None when
+            # every waiting tenant's bucket is dry).
+            served = False
+            while True:
+                got = self._wfq.pop(ready=_ready, weight_of=_weight)
+                if got is None:
+                    break
+                name, fut = got
+                spec = self.tenants.spec_for(name)
+                self.tenant_bucket(spec).try_acquire()
+                if not fut.done():
+                    fut.set_result(True)
+                served = True
+                metrics.tenant_queue_depth.labels(tenant=spec.label).set(
+                    self._wfq.depth(name)
+                )
+            metrics.queue_depth.set(self.queue_len())
+            if served and len(self._wfq):
+                continue
+            # Sleep until the soonest waiting tenant can have a token —
+            # interruptibly, so a new arrival whose tenant already has
+            # tokens is granted immediately instead of waiting out a slow
+            # tenant's refill.
+            waiting = self._wfq.tenants_waiting()
+            if not waiting:
+                continue
+            delay = min(
+                self.tenant_bucket(
+                    self.tenants.spec_for(name)
+                ).time_until_tokens(1.0)
+                for _, name in waiting
+            )
+            if delay > 0:
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._wakeup.wait(), timeout=delay
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
     # -- public API -------------------------------------------------------
 
     async def admit(
@@ -193,6 +325,7 @@ class AdmissionController:
         priority: int = 0,
         deadline: Optional[Deadline] = None,
         min_budget: float = 0.0,
+        tenant: Optional[TenantSpec] = None,
     ) -> AdmissionDecision:
         """Admit, queue, or shed one request. Priority: higher served first.
 
@@ -200,11 +333,20 @@ class AdmissionController:
         the remaining budget; ``min_budget`` is the proxy's minimum viable
         attempt cost (connect-timeout floor) that the *dequeue* re-checks —
         a request granted its token with less budget than that left cannot
-        complete and is shed as ``expired`` instead of forwarded."""
+        complete and is shed as ``expired`` instead of forwarded.
+
+        With tenant isolation configured, ``tenant`` routes the request
+        through ITS OWN bucket and the weighted-fair queue instead of the
+        shared bucket/heap — one tenant exhausting its share queues and
+        sheds only its own traffic."""
         if not self.enabled:
             metrics.admitted_total.inc()
+            if tenant is not None:
+                metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
             return _ADMIT
         self._apply_share()
+        if self._wfq is not None and tenant is not None:
+            return await self._admit_tenant(tenant, deadline, min_budget)
         now = time.monotonic()
         if deadline is not None and deadline.expired():
             return self._shed("expired", 0.0)
@@ -261,8 +403,78 @@ class AdmissionController:
         metrics.admitted_total.inc()
         return _ADMIT
 
-    def _shed(self, reason: str, retry_after: float) -> AdmissionDecision:
+    async def _admit_tenant(
+        self,
+        tenant: TenantSpec,
+        deadline: Optional[Deadline],
+        min_budget: float,
+    ) -> AdmissionDecision:
+        """The tenant-isolated admission path: same shed taxonomy as the
+        legacy path (queue_full / deadline / timeout / expired), but every
+        estimate and every queue bound is computed against the tenant's
+        OWN bucket and OWN queue — a flooding neighbor changes nothing
+        here."""
+        now = time.monotonic()
+        if deadline is not None and deadline.expired():
+            return self._shed("expired", 0.0, tenant)
+        bucket = self.tenant_bucket(tenant)
+        if not self._wfq.has_waiters(tenant.name) and bucket.try_acquire(now):
+            metrics.admitted_total.inc()
+            metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
+            return _ADMIT
+        depth = self._wfq.depth(tenant.name)
+        if depth >= self.max_queue:
+            # The bound is PER TENANT: a flooder fills its own queue and
+            # sheds its own overflow; the victim's queue stays empty.
+            return self._shed(
+                "queue_full", bucket.time_until_tokens(depth + 1, now), tenant
+            )
+        wait_budget = self.queue_timeout
+        if deadline is not None:
+            wait_budget = min(wait_budget, max(deadline.remaining_s(), 0.0))
+        est = bucket.time_until_tokens(depth + 1, now)
+        if est > wait_budget:
+            return self._shed("deadline", est, tenant)
+        self._ensure_dispatcher()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._wfq.push(tenant.rank, tenant.name, fut)
+        metrics.tenant_queue_depth.labels(tenant=tenant.label).set(
+            self._wfq.depth(tenant.name)
+        )
+        metrics.queue_depth.set(self.queue_len())
+        self._wakeup.set()
+        try:
+            await asyncio.wait_for(fut, timeout=wait_budget)
+        except asyncio.TimeoutError:
+            metrics.tenant_queue_depth.labels(tenant=tenant.label).set(
+                self._wfq.depth(tenant.name)
+            )
+            metrics.queue_depth.set(self.queue_len())
+            if deadline is not None and (
+                deadline.expired() or deadline.remaining_s() < min_budget
+            ):
+                return self._shed("expired", 0.0, tenant)
+            return self._shed(
+                "timeout", bucket.time_until_tokens(1.0), tenant
+            )
+        if deadline is not None and deadline.remaining_s() < min_budget:
+            metrics.queue_depth.set(self.queue_len())
+            return self._shed("expired", 0.0, tenant)
+        metrics.admitted_total.inc()
+        metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
+        return _ADMIT
+
+    def _shed(
+        self,
+        reason: str,
+        retry_after: float,
+        tenant: Optional[TenantSpec] = None,
+    ) -> AdmissionDecision:
         metrics.sheds_total.labels(reason=reason).inc()
+        if tenant is not None:
+            metrics.tenant_sheds_total.labels(
+                tenant=tenant.label, reason=reason
+            ).inc()
         return AdmissionDecision(
             admitted=False, reason=reason, retry_after=max(retry_after, 0.001)
         )
@@ -275,3 +487,10 @@ class AdmissionController:
             if not w.future.done():
                 w.future.cancel()
         self._heap.clear()
+        if self._wfq is not None:
+            def _cancel(fut) -> bool:
+                if not fut.done():
+                    fut.cancel()
+                return True
+
+            self._wfq.discard(_cancel)

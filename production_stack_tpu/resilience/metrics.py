@@ -55,6 +55,32 @@ warming_engines = Gauge(
     "unroutable until /ready flips)",
 )
 
+# -- multi-tenant QoS (docs/multi-tenancy.md) -------------------------------
+
+tenant_admitted_total = Counter(
+    "pst_tenant_admitted_total",
+    "Requests admitted through tenant-aware admission control, per tenant",
+    ["tenant"],
+)
+tenant_sheds_total = Counter(
+    "pst_tenant_sheds_total",
+    "Requests shed by tenant-aware admission control, per tenant and "
+    "reason (queue_full | deadline | timeout | expired)",
+    ["tenant", "reason"],
+)
+tenant_queue_depth = Gauge(
+    "pst_tenant_queue_depth",
+    "Requests waiting in the weighted-fair admission queue, per tenant",
+    ["tenant"],
+)
+tenant_usage_tokens_total = Counter(
+    "pst_tenant_usage_tokens_total",
+    "Metered tokens per tenant for billing, by direction (in = prompt "
+    "tokens, out = completion tokens); exact when the upstream reported "
+    "usage, body-size estimate otherwise",
+    ["tenant", "direction"],
+)
+
 # -- deadlines & hedging (docs/resilience.md "Deadlines & hedging") ---------
 
 deadline_budget_ms = Histogram(

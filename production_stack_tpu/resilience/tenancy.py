@@ -1,0 +1,447 @@
+"""Multi-tenant QoS: tenant identity, priority tiers, weighted fair sharing.
+
+The north star is millions of users behind one fleet; without a tenant
+dimension every overload decision (token bucket, admission queue, engine
+ready queue, fleet scoring) is first-come-first-served, and one abusive
+client starves everyone (ROADMAP item 1 — the reference stack's router
+has no tenant concept at all, SURVEY §2). This module is the shared
+vocabulary every layer speaks:
+
+- **Identity** (:class:`TenantConfig`): a request's tenant is derived at
+  router admission from its API key (strongest — the caller proved who
+  they are) or the ``X-PST-Tenant`` header, falling back to the
+  ``default`` tenant. The router then *stamps* ``X-PST-Tenant`` and
+  ``X-PST-Tenant-Class`` on every upstream hop, so the engine scheduler
+  and fleet scoring see the same identity admission derived — clients
+  cannot self-assign a class.
+- **Tiers**: ``interactive`` > ``batch``. Interactive work is latency
+  SLO'd; batch work (the ``/v1/batches`` executor rides it) is
+  throughput-oriented, preemptible, and never allowed to starve
+  interactive prefills.
+- **Weighted fairness** (:class:`WeightedFairQueue`): deficit round
+  robin across tenants within a tier — each tenant's long-run service
+  share is proportional to its weight, with the classic DRR O(1) bound
+  (a tenant is never behind its ideal share by more than one quantum).
+- **Metering**: per-tenant admitted/shed/usage counters
+  (``pst_tenant_*``) for billing and the per-tenant SLO view.
+
+Kept importable from both the router (asyncio admission) and the engine
+(scheduler thread): no aiohttp, no prometheus at import time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, List, Mapping, Optional, Tuple
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# Priority tiers, best first. Everything unknown maps to interactive —
+# failing "up" can only waste capacity on an abuser, while failing "down"
+# would let a mislabeled interactive tenant be starved by design.
+TIER_INTERACTIVE = "interactive"
+TIER_BATCH = "batch"
+TIERS = (TIER_INTERACTIVE, TIER_BATCH)
+
+# Hop headers (stamped by the router at admission; the engine and fleet
+# scoring trust them only because the router overwrites what clients
+# sent — see app.py's admission middleware).
+TENANT_HEADER = "X-PST-Tenant"
+TENANT_CLASS_HEADER = "X-PST-Tenant-Class"
+
+DEFAULT_TENANT = "default"
+
+# Ad-hoc tenants (names seen on the wire with no configured spec) are
+# tracked in bounded LRU tables: a flood of unique tenant names must cost
+# O(cap), never O(traffic history).
+MAX_ADHOC_TENANTS = 1024
+
+
+def tier_rank(tier: Optional[str]) -> int:
+    """Scheduling rank of a tier (lower = served first)."""
+    return 1 if tier == TIER_BATCH else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's QoS contract.
+
+    ``rate``/``burst`` are absolute per-tenant admission limits (req/s);
+    0 means "derive my share of the global rate from my weight". A
+    ``deadline_ms`` > 0 assigns requests without an explicit
+    ``X-PST-Deadline-Ms`` this default budget.
+    """
+
+    name: str
+    weight: float = 1.0
+    tier: str = TIER_INTERACTIVE
+    rate: float = 0.0
+    burst: int = 0
+    deadline_ms: float = 0.0
+    api_keys: Tuple[str, ...] = ()
+    # True for ad-hoc (unconfigured) tenants: real for isolation (own
+    # queue), but collapsed to one "other" metric label — Prometheus
+    # label children are never evicted, so wire-controlled names must
+    # not become label values.
+    adhoc: bool = False
+
+    @property
+    def rank(self) -> int:
+        return tier_rank(self.tier)
+
+    @property
+    def label(self) -> str:
+        """The Prometheus label value for this tenant: configured names
+        verbatim, the whole ad-hoc population as ``other`` (bounded
+        cardinality whatever names the wire invents)."""
+        return "other" if self.adhoc else self.name
+
+
+def _coerce_spec(name: str, raw: Any) -> TenantSpec:
+    if not isinstance(raw, dict):
+        raw = {}
+    tier = str(raw.get("tier") or TIER_INTERACTIVE)
+    if tier not in TIERS:
+        logger.warning(
+            "tenant %r declares unknown tier %r; treating as interactive",
+            name, tier,
+        )
+        tier = TIER_INTERACTIVE
+    keys = raw.get("api_keys") or ()
+    if isinstance(keys, str):
+        keys = (keys,)
+    return TenantSpec(
+        name=name,
+        weight=max(float(raw.get("weight") or 1.0), 1e-6),
+        tier=tier,
+        rate=max(float(raw.get("rate") or 0.0), 0.0),
+        burst=max(int(raw.get("burst") or 0), 0),
+        deadline_ms=max(float(raw.get("deadline_ms") or 0.0), 0.0),
+        api_keys=tuple(str(k) for k in keys),
+    )
+
+
+class TenantConfig:
+    """The tenant table: configured specs + ad-hoc defaults.
+
+    Unknown tenant names resolve to an ad-hoc spec carrying the default
+    weight/tier — they are real tenants for isolation purposes (own
+    bucket, own queue) but share one default contract. The ad-hoc table
+    is LRU-bounded so hostile unique names cannot grow router memory.
+    """
+
+    def __init__(
+        self,
+        tenants: Optional[Dict[str, TenantSpec]] = None,
+        default_weight: float = 1.0,
+        default_tier: str = TIER_INTERACTIVE,
+        header: str = TENANT_HEADER,
+    ) -> None:
+        self.tenants: Dict[str, TenantSpec] = dict(tenants or {})
+        self.default_weight = max(default_weight, 1e-6)
+        self.default_tier = (
+            default_tier if default_tier in TIERS else TIER_INTERACTIVE
+        )
+        self.header = header or TENANT_HEADER
+        # pstlint: owned-by=task:__init__
+        self._by_key: Dict[str, TenantSpec] = {}
+        for spec in self.tenants.values():
+            for key in spec.api_keys:
+                self._by_key[key] = spec
+        # pstlint: owned-by=task:resolve,spec_for
+        self._adhoc: "OrderedDict[str, TenantSpec]" = OrderedDict()
+        if DEFAULT_TENANT not in self.tenants:
+            self.tenants[DEFAULT_TENANT] = TenantSpec(
+                DEFAULT_TENANT,
+                weight=self.default_weight,
+                tier=self.default_tier,
+            )
+
+    @classmethod
+    def from_file(
+        cls,
+        path: str,
+        default_weight: float = 1.0,
+        default_tier: str = TIER_INTERACTIVE,
+        header: str = TENANT_HEADER,
+    ) -> "TenantConfig":
+        """Load ``{"tenants": {name: {weight, tier, rate, burst,
+        deadline_ms, api_keys}}}`` from JSON or YAML."""
+        with open(path) as f:
+            text = f.read()
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            data = yaml.safe_load(text)
+        else:
+            data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError(f"tenant config {path} must be a mapping")
+        raw = data.get("tenants") or {}
+        if not isinstance(raw, dict):
+            raise ValueError(f"tenant config {path}: 'tenants' must map names to specs")
+        tenants = {
+            str(name): _coerce_spec(str(name), spec)
+            for name, spec in raw.items()
+        }
+        return cls(
+            tenants,
+            default_weight=default_weight,
+            default_tier=default_tier,
+            header=header,
+        )
+
+    # -- identity ----------------------------------------------------------
+
+    def spec_for(self, name: str) -> TenantSpec:
+        spec = self.tenants.get(name)
+        if spec is not None:
+            return spec
+        spec = self._adhoc.get(name)
+        if spec is None:
+            spec = TenantSpec(
+                name, weight=self.default_weight, tier=self.default_tier,
+                adhoc=True,
+            )
+            self._adhoc[name] = spec
+            while len(self._adhoc) > MAX_ADHOC_TENANTS:
+                self._adhoc.popitem(last=False)
+        else:
+            self._adhoc.move_to_end(name)
+        return spec
+
+    def resolve(
+        self,
+        headers: Mapping[str, str],
+        api_key: Optional[str] = None,
+    ) -> TenantSpec:
+        """Tenant for one request: API key beats header beats default.
+
+        The API key is authenticated identity; the header is client
+        self-declaration, honored only when no key maps the caller to a
+        configured tenant (useful behind a trusted gateway that already
+        authenticated the caller and stamped the header). A configured
+        tenant that declares ``api_keys`` can ONLY be claimed by one of
+        them — a bare header naming it is an impersonation attempt and
+        resolves to the default tenant instead of the protected
+        contract (and instead of billing usage to the victim).
+        """
+        if api_key:
+            spec = self._by_key.get(api_key)
+            if spec is not None:
+                return spec
+        name = headers.get(self.header) or headers.get(self.header.lower())
+        if name:
+            stripped = str(name).strip()[:128]
+            configured = self.tenants.get(stripped)
+            if configured is not None and configured.api_keys:
+                return self.tenants[DEFAULT_TENANT]
+            return self.spec_for(stripped)
+        return self.tenants[DEFAULT_TENANT]
+
+    def weight_sum(self) -> float:
+        """Total weight the global admission rate is shared across: every
+        configured tenant plus one default-weight share standing in for
+        the whole ad-hoc population (ad-hoc tenants split the default
+        share rather than each minting a full one — otherwise inventing
+        names would mint rate)."""
+        return sum(s.weight for s in self.tenants.values())
+
+    def describe(self) -> dict:
+        return {
+            "tenants": {
+                name: {
+                    "weight": s.weight, "tier": s.tier, "rate": s.rate,
+                    "deadline_ms": s.deadline_ms,
+                }
+                for name, s in self.tenants.items()
+            },
+            "default_weight": self.default_weight,
+            "default_tier": self.default_tier,
+        }
+
+
+class WeightedFairQueue:
+    """Deficit round robin across (tier, tenant) with strict tier priority.
+
+    Tiers are strictly ordered (every interactive waiter is considered
+    before any batch waiter — the starvation direction the SLO cares
+    about); *within* a tier tenants share by weight via DRR: each time a
+    tenant's turn comes its deficit grows by ``quantum × weight``, it is
+    served while the deficit covers the unit cost (1 per request), and
+    the classic DRR bound holds — a backlogged tenant's service lags its
+    ideal weighted share by at most one quantum's worth of requests.
+
+    ``pop(ready)`` takes a predicate ("does this tenant have an admission
+    token right now?") so per-tenant rate limiting composes: a tenant
+    with waiters but no token is skipped without burning its deficit.
+    """
+
+    def __init__(self, quantum: float = 1.0) -> None:
+        self.quantum = max(quantum, 1e-9)
+        # Per tier: active tenant ring + per-tenant FIFO and deficit.
+        # pstlint: owned-by=task:push,pop,discard,_retire
+        self._queues: Dict[Tuple[int, str], Deque[Any]] = {}
+        # pstlint: owned-by=task:push,pop,discard,_retire
+        self._ring: Dict[int, Deque[str]] = {}
+        # pstlint: owned-by=task:push,pop,discard,_retire
+        self._deficit: Dict[Tuple[int, str], float] = {}
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depth(self, tenant: str, rank: Optional[int] = None) -> int:
+        if rank is not None:
+            return len(self._queues.get((rank, tenant), ()))
+        return sum(
+            len(q) for (r, t), q in self._queues.items() if t == tenant
+        )
+
+    def has_waiters(self, tenant: str) -> bool:
+        return self.depth(tenant) > 0
+
+    def push(self, rank: int, tenant: str, item: Any) -> None:
+        key = (rank, tenant)
+        q = self._queues.get(key)
+        if q is None:
+            q = deque()
+            self._queues[key] = q
+            self._ring.setdefault(rank, deque()).append(tenant)
+            self._deficit.setdefault(key, 0.0)
+        q.append(item)
+
+    def _retire(self, rank: int, tenant: str) -> None:
+        """A tenant's queue drained: drop it from the ring and RESET its
+        deficit — an idle tenant must not bank credit while idle (DRR's
+        memoryless property; banking would let a tenant burst past its
+        share after a quiet period)."""
+        key = (rank, tenant)
+        self._queues.pop(key, None)
+        self._deficit.pop(key, None)
+        ring = self._ring.get(rank)
+        if ring is not None:
+            try:
+                ring.remove(tenant)
+            except ValueError:
+                pass
+            if not ring:
+                self._ring.pop(rank, None)
+
+    def pop(self, ready=None, weight_of=None) -> Optional[Tuple[str, Any]]:
+        """Serve one item: best tier first, DRR within the tier.
+
+        ``ready(tenant)`` gates service (default: always ready);
+        ``weight_of(tenant)`` supplies DRR weights (default 1.0).
+        Returns ``(tenant, item)`` or None when nothing is servable.
+        """
+        for rank in sorted(self._ring):
+            ring = self._ring[rank]
+            # One full DRR cycle at most: every active tenant gets at
+            # most one quantum top-up; if nobody is servable we stop
+            # rather than growing deficits without bound.
+            for _ in range(len(ring)):
+                tenant = ring[0]
+                key = (rank, tenant)
+                q = self._queues.get(key)
+                if not q:
+                    self._retire(rank, tenant)
+                    if not self._ring.get(rank):
+                        break
+                    continue
+                if ready is not None and not ready(tenant):
+                    # Skipped, credit retained: the fairness debt
+                    # survives until the tenant can actually be served.
+                    ring.rotate(-1)
+                    continue
+                # Classic DRR: top up only when depleted, then the
+                # tenant stays at the front spending its deficit — a
+                # weight-3 tenant serves 3 consecutive requests per
+                # turn, a weight-1 tenant one.
+                w = weight_of(tenant) if weight_of is not None else 1.0
+                if self._deficit[key] < 1.0:
+                    self._deficit[key] += self.quantum * max(w, 1e-6)
+                if self._deficit[key] >= 1.0:
+                    self._deficit[key] -= 1.0
+                    item = q.popleft()
+                    if not q:
+                        self._retire(rank, tenant)
+                    elif self._deficit[key] < 1.0:
+                        ring.rotate(-1)  # quantum spent: next tenant
+                    return tenant, item
+                ring.rotate(-1)  # fractional weight: bank and move on
+        return None
+
+    def discard(self, predicate) -> int:
+        """Drop items matching ``predicate(item)`` (timed-out waiters);
+        returns how many were removed."""
+        removed = 0
+        for (rank, tenant), q in list(self._queues.items()):
+            kept = deque(item for item in q if not predicate(item))
+            removed += len(q) - len(kept)
+            if kept:
+                self._queues[(rank, tenant)] = kept
+            else:
+                self._retire(rank, tenant)
+        return removed
+
+    def tenants_waiting(self) -> List[Tuple[int, str]]:
+        return [key for key, q in self._queues.items() if q]
+
+
+class DeficitScheduler:
+    """Engine-side DRR over tenant classes (no asyncio, no buckets): the
+    scheduler's ready-queue ordering. One instance per engine scheduler;
+    ``charge`` is called when a tenant's sequence is admitted, ``pick``
+    chooses which of the currently waiting (tier, tenant) classes admits
+    next. Weights arrive from the router via request headers — the engine
+    trusts the stamped weight class, defaulting to 1.0.
+    """
+
+    def __init__(self, quantum: float = 1.0) -> None:
+        self.quantum = max(quantum, 1e-9)
+        # pstlint: owned-by=task:pick,charge
+        self._credit: Dict[str, float] = {}
+
+    # Credit clamp: the DRR lag bound. Without it a tenant charged while
+    # running solo (no contested pick) would bank unbounded debt and be
+    # starved for O(history) admissions when a competitor appears.
+    CREDIT_BOUND = 4.0
+
+    def pick(
+        self, candidates: Dict[str, float]
+    ) -> Optional[str]:
+        """Choose among ``{tenant: weight}`` waiting classes: the tenant
+        with the highest deficit-per-weight debt is served next; deficits
+        grow by quantum × weight per pick so long-run admissions track
+        weights. Single candidate short-circuits (the common case)."""
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return next(iter(candidates))
+        for t, w in candidates.items():
+            self._credit[t] = min(
+                self._credit.get(t, 0.0) + self.quantum * max(w, 1e-6),
+                self.CREDIT_BOUND,
+            )
+        # Highest accumulated credit wins; ties break by name for
+        # determinism (tests), which is fair over time because the loser
+        # keeps its credit.
+        best = max(
+            candidates,
+            key=lambda t: (self._credit.get(t, 0.0), t),
+        )
+        return best
+
+    def charge(self, tenant: str) -> None:
+        self._credit[tenant] = max(
+            self._credit.get(tenant, 0.0) - 1.0, -self.CREDIT_BOUND
+        )
+        # Forget long-idle tenants opportunistically.
+        if len(self._credit) > MAX_ADHOC_TENANTS:
+            self._credit = {
+                t: d for t, d in self._credit.items() if abs(d) > 1e-9
+            }

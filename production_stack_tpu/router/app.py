@@ -28,6 +28,7 @@ from ..resilience import (
     get_admission_controller,
     get_default_deadline_ms,
     get_retry_policy,
+    get_tenant_config,
     initialize_resilience,
     teardown_resilience,
 )
@@ -53,6 +54,7 @@ from .service_discovery import (
     teardown_service_discovery,
 )
 from .state import (
+    PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
@@ -240,10 +242,31 @@ async def admission_middleware(request: web.Request, handler):
         trace = request.get("trace") or NOOP_TRACE
         # The admission stage: budget parse + token-bucket/queue wait.
         span = trace.span("admission")
+        # Tenant identity FIRST (docs/multi-tenancy.md): derived from the
+        # API key (authenticated) or the tenant header, before any
+        # overload decision — admission shares, deadline defaults, queue
+        # order, engine scheduling and fleet scoring all key on it. The
+        # resolved identity is re-stamped on every upstream hop, so a
+        # client can never self-assign a class the config didn't grant.
+        tenant = None
+        tenant_cfg = get_tenant_config()
+        if tenant_cfg is not None:
+            auth = request.headers.get("Authorization", "")
+            api_key = auth[7:] if auth.startswith("Bearer ") else None
+            tenant = tenant_cfg.resolve(request.headers, api_key)
+            request["tenant"] = tenant
+            span.set_attribute("tenant", tenant.name)
+            span.set_attribute("tenant_tier", tenant.tier)
         # Parse the budget once, here, for every downstream consumer
         # (admission, routing, proxy attempts) — the monotonic deadline is
         # anchored at arrival, so queue time counts against the budget.
-        deadline = parse_deadline(request.headers, get_default_deadline_ms())
+        # Tenant deadline defaults beat the global default: a batch
+        # tenant can run deadline-free while interactive tenants inherit
+        # a tight budget.
+        default_ms = get_default_deadline_ms()
+        if tenant is not None and tenant.deadline_ms > 0:
+            default_ms = tenant.deadline_ms
+        deadline = parse_deadline(request.headers, default_ms)
         if deadline is not None:
             request["deadline"] = deadline
             span.set_attribute(
@@ -262,6 +285,7 @@ async def admission_middleware(request: web.Request, handler):
                 priority,
                 deadline=deadline,
                 min_budget=min_attempt_budget(get_retry_policy()),
+                tenant=tenant,
             )
             if not decision.admitted:
                 if decision.reason == "expired":
@@ -427,13 +451,18 @@ def initialize_all(app: web.Application, args) -> None:
     # SLO counters (pst_slo_*) measure against this TTFT target; the canary
     # prober starts with the event loop in on_startup.
     metrics_service.configure_slo(getattr(args, "slo_ttft_ms", 0.0))
-    initialize_canary_prober(
+    prober = initialize_canary_prober(
         getattr(args, "canary_interval", 0.0),
         timeout=getattr(args, "canary_timeout", 5.0),
         # The fleet shares one key (helm apiKeySecret): probes must
         # authenticate to engines like real proxied traffic does.
         api_key=getattr(args, "api_key", None),
     )
+    # Canary health rides gossip (docs/router-ha.md): each replica
+    # publishes its own probe TTFTs so replicas whose probes diverge
+    # (one saw the failure, one didn't) still SCORE every engine the
+    # same way — fleet routing merges local + peer views pessimistically.
+    backend.register_provider(PROVIDER_CANARY_TTFT, prober.ttft_view)
     initialize_request_rewriter(args.request_rewriter)
     configure_custom_callbacks(args.callbacks)
     initialize_feature_gates(args.feature_gates)

@@ -119,6 +119,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-half-open-probes", type=int, default=1,
                    help="concurrent live probes allowed while half-open")
 
+    # Multi-tenant QoS (docs/multi-tenancy.md): tenant identity at
+    # admission (API key / X-PST-Tenant), per-tenant weighted token
+    # buckets + a weighted-fair (deficit round robin) admission queue
+    # over priority tiers (interactive > batch), per-tenant deadline
+    # defaults, and per-tenant usage metering.
+    p.add_argument("--tenant-isolation", action="store_true", default=False,
+                   help="derive a tenant per request and isolate overload "
+                        "decisions per tenant: weighted per-tenant "
+                        "admission buckets (shares of --admission-rate), "
+                        "deficit-round-robin queueing over priority tiers, "
+                        "tenant headers stamped on every engine hop, and "
+                        "pst_tenant_* metering")
+    p.add_argument("--tenant-config", default=None,
+                   help="JSON/YAML file mapping tenant names to QoS specs "
+                        "({tenants: {name: {weight, tier, rate, burst, "
+                        "deadline_ms, api_keys}}}); unknown tenants ride "
+                        "the default weight/tier")
+    p.add_argument("--tenant-default-weight", type=float, default=1.0,
+                   help="fair-share weight assigned to tenants without an "
+                        "explicit spec (the whole ad-hoc population shares "
+                        "one default-weight slice of --admission-rate)")
+    p.add_argument("--tenant-default-tier", default="interactive",
+                   choices=["interactive", "batch"],
+                   help="priority tier assigned to tenants without an "
+                        "explicit spec (interactive is strictly served "
+                        "before batch)")
+    p.add_argument("--tenant-header", default="X-PST-Tenant",
+                   help="header carrying the client-declared tenant name "
+                        "(API-key mapping from --tenant-config wins over "
+                        "it; the router re-stamps the canonical headers "
+                        "on every upstream hop)")
+
     # Deadlines & hedging (docs/resilience.md "Deadlines & hedging")
     p.add_argument("--default-deadline-ms", type=float, default=0.0,
                    help="latency budget assigned to requests without an "
@@ -276,6 +308,10 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--breaker-failure-threshold must be >= 1")
     if args.default_deadline_ms < 0:
         raise ValueError("--default-deadline-ms must be >= 0")
+    if args.tenant_config and not args.tenant_isolation:
+        raise ValueError("--tenant-config requires --tenant-isolation")
+    if args.tenant_default_weight <= 0:
+        raise ValueError("--tenant-default-weight must be > 0")
     if args.debug_requests_buffer < 0:
         raise ValueError("--debug-requests-buffer must be >= 0")
     if args.slo_ttft_ms < 0:

@@ -606,12 +606,30 @@ class FleetRouter(RoutingInterface):
         }
 
     def _canary_ttfts(self) -> Dict[str, float]:
+        """Local canary view merged with live peers' gossiped views,
+        pessimistically (max): after a failed probe on ANY replica every
+        replica scores that engine as slow, so replicated routers agree
+        instead of splitting traffic on who happened to see the failure.
+        Recovery converges the same way — each replica's next successful
+        probe lowers its own published sample."""
         from ..services.canary import get_canary_prober
+        from ..state import get_state_backend
 
         prober = get_canary_prober()
-        if prober is None:
-            return {}
-        return prober.ttft_view()
+        view = dict(prober.ttft_view()) if prober is not None else {}
+        backend = get_state_backend()
+        peer_views = getattr(backend, "peer_canary_ttfts", None)
+        if peer_views is not None and getattr(backend, "shared", False):
+            for peer_view in peer_views().values():
+                if not isinstance(peer_view, dict):
+                    continue
+                for url, ttft in peer_view.items():
+                    try:
+                        t = float(ttft)
+                    except (TypeError, ValueError):
+                        continue
+                    view[url] = max(view.get(url, 0.0), t)
+        return view
 
     async def _hit_tokens(
         self,
@@ -702,13 +720,23 @@ class FleetRouter(RoutingInterface):
         self._last_scores = dict(scores)
         self._last_loads = dict(loads)
 
+        # Tenant class (docs/multi-tenancy.md): batch-tier requests may
+        # not pin past the bounded-load rule (saturation sends them to
+        # the least-loaded engine, not the affinity argmax) and their
+        # session pins are the first evicted under pin-table pressure —
+        # a batch flood cannot displace interactive affinity.
+        from ...resilience.tenancy import TENANT_CLASS_HEADER, TIER_BATCH
+
+        batch_tier = _header(headers, TENANT_CLASS_HEADER) == TIER_BATCH
         session_id = _header(headers, self.session_key)
         if session_id is not None:
             selected = self._route_session(
-                session_id, urls, scores, loads, bound
+                session_id, urls, scores, loads, bound, batch_tier
             )
         else:
-            selected, spill = scoring.pick_bounded(scores, loads, bound)
+            selected, spill = scoring.pick_bounded(
+                scores, loads, bound, batch_tier=batch_tier
+            )
             if spill is not None:
                 metrics.spill_total.labels(reason=spill).inc()
         metrics.route_score.observe(max(scores.get(selected, 0.0), 0.0))
@@ -731,6 +759,7 @@ class FleetRouter(RoutingInterface):
         scores: Dict[str, float],
         loads: Dict[str, float],
         bound: float,
+        batch_tier: bool = False,
     ) -> str:
         from . import metrics, scoring
 
@@ -740,7 +769,7 @@ class FleetRouter(RoutingInterface):
             decayed = scores[pinned] < self.eviction_ratio * best_score
             overloaded = loads.get(pinned, 0.0) >= bound
             if not decayed and not overloaded:
-                self.pins.pin(session_id, pinned)
+                self.pins.pin(session_id, pinned, batch_tier=batch_tier)
                 return pinned
             metrics.session_remap_total.labels(
                 reason="score_decay" if decayed else "overload"
@@ -753,7 +782,9 @@ class FleetRouter(RoutingInterface):
             session_id, loads, c=self.load_factor, allowed=set(urls)
         )
         if remapped is None or remapped not in scores:
-            remapped, spill = scoring.pick_bounded(scores, loads, bound)
+            remapped, spill = scoring.pick_bounded(
+                scores, loads, bound, batch_tier=batch_tier
+            )
             if spill is not None:
                 metrics.spill_total.labels(reason=spill).inc()
         if pinned is not None and remapped == pinned:
@@ -763,9 +794,9 @@ class FleetRouter(RoutingInterface):
             others = {u: s for u, s in scores.items() if u != pinned}
             if others:
                 remapped, _ = scoring.pick_bounded(
-                    others, loads, bound
+                    others, loads, bound, batch_tier=batch_tier
                 )
-        self.pins.pin(session_id, remapped)
+        self.pins.pin(session_id, remapped, batch_tier=batch_tier)
         return remapped
 
     # -- churn -------------------------------------------------------------

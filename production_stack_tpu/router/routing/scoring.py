@@ -113,6 +113,7 @@ def pick_bounded(
     scores: Dict[str, float],
     loads: Dict[str, float],
     bound: float,
+    batch_tier: bool = False,
 ) -> tuple:
     """Argmax over scores subject to the bounded-load constraint.
 
@@ -121,6 +122,12 @@ def pick_bounded(
     next-best under it), or ``"saturated"`` (every candidate over the
     limit — fail open to the best scorer; starving the whole fleet would
     be worse than the hot spot).
+
+    ``batch_tier`` (docs/multi-tenancy.md): batch-class work may never
+    pin itself past the bounded-load rule — on saturation it takes the
+    LEAST-LOADED candidate instead of the best scorer, so a batch flood
+    spreads across the fleet's slack rather than piling affinity-first
+    onto the engine interactive traffic is hot on.
 
     Exact score ties (a cold fleet: no cached prefixes, equal headroom,
     no canary samples) break by lowest load, then RANDOMLY — a
@@ -138,6 +145,9 @@ def pick_bounded(
     for url in order:
         if loads.get(url, 0.0) < bound:
             return url, (None if url == best else "load")
+    if batch_tier:
+        coldest = min(order, key=lambda u: loads.get(u, 0.0))
+        return coldest, "saturated"
     return best, "saturated"
 
 
@@ -237,26 +247,47 @@ class KvLookupClient:
 
 class SessionPins:
     """Bounded session → engine pin table (LRU on every re-pin, so a
-    long-lived active session is never evicted before idle newcomers)."""
+    long-lived active session is never evicted before idle newcomers).
+
+    Tenant-class aware (docs/multi-tenancy.md): each pin records the
+    tier that created it, and capacity eviction pops **batch-tier pins
+    first** (LRU within the tier) — a batch flood churning thousands of
+    fresh session ids can evict only its own class's pins, never an
+    interactive tenant's warm affinity."""
 
     def __init__(self, max_pins: int = 8192) -> None:
         self.max_pins = max_pins
         # pstlint: owned-by=task:pin,drop_endpoint
-        self._pins: "OrderedDict[str, str]" = OrderedDict()
+        self._pins: "OrderedDict[str, tuple]" = OrderedDict()
 
     def get(self, session_id: str) -> Optional[str]:
-        return self._pins.get(session_id)
+        entry = self._pins.get(session_id)
+        return entry[0] if entry is not None else None
 
-    def pin(self, session_id: str, url: str) -> None:
-        self._pins[session_id] = url
+    def pin(self, session_id: str, url: str, batch_tier: bool = False) -> None:
+        prev = self._pins.get(session_id)
+        if prev is not None and not prev[1]:
+            # A pin's tier never downgrades: one batch-stamped request on
+            # an interactive session (e.g. a batch line reusing its id)
+            # must not make the session's warm affinity first-evicted.
+            batch_tier = False
+        self._pins[session_id] = (url, bool(batch_tier))
         self._pins.move_to_end(session_id)
         while len(self._pins) > self.max_pins:
-            self._pins.popitem(last=False)
+            victim = None
+            for sid, (_, is_batch) in self._pins.items():  # LRU order
+                if is_batch:
+                    victim = sid
+                    break
+            if victim is None:  # no batch pin left: evict plain LRU
+                self._pins.popitem(last=False)
+            else:
+                self._pins.pop(victim, None)
 
     def drop_endpoint(self, url: str) -> None:
         """An engine left the fleet: forget every pin to it in one step
         so the very next request per session remaps through the ring."""
-        stale = [sid for sid, u in self._pins.items() if u == url]
+        stale = [sid for sid, (u, _) in self._pins.items() if u == url]
         for sid in stale:
             self._pins.pop(sid, None)
 

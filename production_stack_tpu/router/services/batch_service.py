@@ -199,11 +199,21 @@ class LocalBatchProcessor:
                     raise RuntimeError("no backend available for model")
                 # Batch lines execute detached from any live client
                 # request: each line gets its own id so engine logs and
-                # /debug/requests timelines are joinable per line.
+                # /debug/requests timelines are joinable per line. They
+                # ride the BATCH tier (docs/multi-tenancy.md) under the
+                # creating tenant's identity: the engine scheduler admits
+                # them weighted-fair behind interactive work and preempts
+                # them first under page pressure — the /v1/batches
+                # executor IS the lowest QoS tier.
                 line_id = f"batch_req_{uuid.uuid4().hex[:12]}"
+                line_headers = hop_headers(request_id=line_id)
+                line_headers["X-PST-Tenant"] = (
+                    batch.get("metadata", {}).get("pst_tenant") or "default"
+                )
+                line_headers["X-PST-Tenant-Class"] = "batch"
                 async with session.post(
                     backend + url, json=item.get("body", {}),
-                    headers=hop_headers(request_id=line_id),
+                    headers=line_headers,
                 ) as resp:
                     payload = await resp.json()
                     record = {
@@ -279,9 +289,25 @@ def install_batch_api(app: web.Application, args) -> None:
                     {"error": {"message": f"missing {field}", "code": 400}},
                     status=400, headers=error_headers(request),
                 )
+        metadata = dict(body.get("metadata") or {})
+        # Record the creating tenant so the executor's lines bill to (and
+        # are scheduled as) that tenant at the batch tier. /v1/batches is
+        # not an admission path, so the identity is resolved here with
+        # the same precedence (API key > header > default).
+        tenant = request.get("tenant")
+        if tenant is None:
+            from ...resilience import get_tenant_config
+
+            tenant_cfg = get_tenant_config()
+            if tenant_cfg is not None:
+                auth = request.headers.get("Authorization", "")
+                key = auth[7:] if auth.startswith("Bearer ") else None
+                tenant = tenant_cfg.resolve(request.headers, key)
+        if tenant is not None:
+            metadata.setdefault("pst_tenant", tenant.name)
         batch = await processor.create_batch(
             body["input_file_id"], body["endpoint"],
-            body.get("completion_window", "24h"), body.get("metadata"),
+            body.get("completion_window", "24h"), metadata,
         )
         return web.json_response(batch)
 

@@ -76,6 +76,18 @@ slo_ttft_within_target_total = Counter(
     "target (--slo-ttft-ms)",
     ["model"],
 )
+tenant_slo_requests_total = Counter(
+    "pst_tenant_slo_requests",
+    "Generation requests counted against the TTFT SLO, per tenant "
+    "(tenant isolation on; same semantics as pst_slo_requests)",
+    ["tenant"],
+)
+tenant_slo_ttft_within_target_total = Counter(
+    "pst_tenant_slo_ttft_within_target",
+    "Generation requests whose router-observed TTFT met the configured "
+    "target, per tenant — the per-tenant SLO attainment numerator",
+    ["tenant"],
+)
 canary_ttft_seconds = Gauge(
     "pst_canary_ttft_seconds",
     "Latest canary-probe TTFT per engine (synthetic 1-token completion)",
@@ -109,21 +121,34 @@ def slo_ttft_target_s() -> Optional[float]:
     return appscope.scoped_get(_SLO_SCOPE_KEY)
 
 
-def observe_slo_ttft(model: Optional[str], seconds: float) -> None:
+def observe_slo_ttft(
+    model: Optional[str], seconds: float, tenant: Optional[str] = None
+) -> None:
     """One request reached its first upstream byte: count it, and count it
-    as within-target when the router-observed TTFT met the objective."""
+    as within-target when the router-observed TTFT met the objective.
+    With tenant isolation on, ``tenant`` feeds the per-tenant SLO view
+    (``pst_tenant_slo_*``) beside the per-model one."""
     target = slo_ttft_target_s()
     if target is None:
         return
     m = str(model) if model else "unknown"
     slo_requests_total.labels(model=m).inc()
-    if seconds <= target:
+    within = seconds <= target
+    if within:
         slo_ttft_within_target_total.labels(model=m).inc()
+    if tenant:
+        tenant_slo_requests_total.labels(tenant=tenant).inc()
+        if within:
+            tenant_slo_ttft_within_target_total.labels(tenant=tenant).inc()
 
 
-def observe_slo_failure(model: Optional[str]) -> None:
+def observe_slo_failure(
+    model: Optional[str], tenant: Optional[str] = None
+) -> None:
     """A request failed before producing a first byte (exhausted failover,
     upstream 5xx): it consumed error budget without a TTFT sample."""
     if slo_ttft_target_s() is None:
         return
     slo_requests_total.labels(model=str(model) if model else "unknown").inc()
+    if tenant:
+        tenant_slo_requests_total.labels(tenant=tenant).inc()

@@ -109,6 +109,60 @@ def _forwardable(headers) -> dict:
     return {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
 
 
+def _tenant_headers(request: web.Request) -> dict:
+    """The canonical tenant stamp for upstream hops: the ROUTER-resolved
+    identity and tier (docs/multi-tenancy.md), overwriting whatever the
+    client sent — the engine scheduler and fleet scoring must never trust
+    a self-assigned class. Empty when tenancy is off (headers then pass
+    through untouched, the pre-tenancy behavior)."""
+    tenant = request.get("tenant")
+    if tenant is None:
+        return {}
+    from ...resilience import TENANT_CLASS_HEADER, TENANT_HEADER
+
+    return {TENANT_HEADER: tenant.name, TENANT_CLASS_HEADER: tenant.tier}
+
+
+def _meter_tenant_usage(
+    tenant, body: bytes, journal, collected: Optional[bytes], streaming: bool
+) -> None:
+    """Per-tenant token metering (billing): exact from the upstream's
+    reported ``usage`` when available (journaled SSE accumulates it;
+    non-streamed generations are buffered and parsed), falling back to a
+    body-size estimate for prompt tokens and the journal's delivered
+    chunk count for completion tokens. Metered once per request, on the
+    path that reached a terminal state in this proxy call."""
+    usage = None
+    if journal is not None and isinstance(journal.usage, dict):
+        usage = journal.usage
+    elif not streaming and collected:
+        try:
+            parsed = json.loads(collected)
+            if isinstance(parsed, dict) and isinstance(
+                parsed.get("usage"), dict
+            ):
+                usage = parsed["usage"]
+        except (ValueError, UnicodeDecodeError):
+            usage = None
+    tokens_in = 0.0
+    tokens_out = 0.0
+    if usage is not None:
+        tokens_in = float(usage.get("prompt_tokens") or 0)
+        tokens_out = float(usage.get("completion_tokens") or 0)
+    if tokens_in <= 0:
+        tokens_in = len(body) / 4.0  # chars-per-token estimate
+    if tokens_out <= 0 and journal is not None:
+        tokens_out = float(getattr(journal, "delivered_tokens", 0) or 0)
+    if tokens_in > 0:
+        res_metrics.tenant_usage_tokens_total.labels(
+            tenant=tenant.label, direction="in"
+        ).inc(tokens_in)
+    if tokens_out > 0:
+        res_metrics.tenant_usage_tokens_total.labels(
+            tenant=tenant.label, direction="out"
+        ).inc(tokens_out)
+
+
 def _trace_headers(headers: dict, request_id: str, span) -> dict:
     """Outbound hop headers: ``X-Request-Id`` always (so engine logs and
     timelines join on one id even with tracing off), plus a W3C
@@ -303,7 +357,16 @@ async def proxy_and_stream(
         and endpoint == "/v1/chat/completions"
         and not parsed.get("stream")
     )
-    collect = collect or cacheable
+    # Tenant metering (docs/multi-tenancy.md): non-streamed generations
+    # are buffered so the upstream's exact usage can be billed; streams
+    # meter from the journal's accumulated usage/chunk counts.
+    tenant = request.get("tenant")
+    meter_nonstream = (
+        tenant is not None
+        and not parsed.get("stream")
+        and endpoint in ("/v1/completions", "/v1/chat/completions")
+    )
+    collect = collect or cacheable or meter_nonstream
 
     url = backend_url
     tried = {url}
@@ -357,6 +420,9 @@ async def proxy_and_stream(
             with_deadline_header(_forwardable(request.headers), deadline),
             request_id, attempt_span,
         )
+        # Canonical tenant stamp LAST: it must overwrite any client-sent
+        # tenant headers that survived _forwardable.
+        fwd_headers.update(_tenant_headers(request))
         collected = bytearray()
         response: Optional[web.StreamResponse] = None
         journal: Optional[StreamJournal] = None
@@ -503,9 +569,19 @@ async def proxy_and_stream(
                                     observe_slo_ttft(
                                         slo_model,
                                         time.monotonic() - slo_t0,
+                                        tenant=(
+                                            tenant.label
+                                            if tenant is not None else None
+                                        ),
                                     )
                                 else:
-                                    observe_slo_failure(slo_model)
+                                    observe_slo_failure(
+                                        slo_model,
+                                        tenant=(
+                                            tenant.label
+                                            if tenant is not None else None
+                                        ),
+                                    )
                         if journal is not None:
                             chunk = journal.feed(chunk)
                             _maybe_checkpoint_journal(journal, request_id)
@@ -638,7 +714,10 @@ async def proxy_and_stream(
                     # Exhausted failover with zero bytes delivered: the
                     # request burns error budget (no TTFT sample exists).
                     slo_done = True
-                    observe_slo_failure(slo_model)
+                    observe_slo_failure(
+                        slo_model,
+                        tenant=tenant.label if tenant is not None else None,
+                    )
                 return _error_response(502, f"backend error: {e}", "bad_gateway",
                                        request_id=request_id)
             logger.warning(
@@ -657,6 +736,11 @@ async def proxy_and_stream(
         break  # attempt finished cleanly: run the post-response hooks
 
     _drop_checkpoint(journal, request_id)
+    if tenant is not None:
+        _meter_tenant_usage(
+            tenant, body, journal,
+            bytes(collected) if collect else None, streaming,
+        )
     if collect:
         content = bytes(collected)
         if cacheable:
@@ -820,6 +904,7 @@ async def _resume_stream(
             with_deadline_header(_forwardable(request.headers), deadline),
             request_id, span,
         )
+        fwd.update(_tenant_headers(request))
         remaining_s = deadline.remaining_s() if deadline is not None else None
         connect_t = (retry.connect_timeout or None) if retry else None
         if connect_t is not None and remaining_s is not None:
@@ -1034,6 +1119,7 @@ async def _buffered_attempt(
         with_deadline_header(_forwardable(request.headers), deadline),
         request_id, span,
     )
+    fwd.update(_tenant_headers(request))
     remaining = deadline.remaining_s() if deadline is not None else None
     timeout = aiohttp.ClientTimeout(
         total=max(remaining, 0.001) if remaining is not None else None,
@@ -1495,6 +1581,9 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     headers = hop_headers(
         dict(request.headers), request_id=request_id, span=routing_span
     )
+    # Routing sees the resolved tenant class too (fleet scoring demotes
+    # batch-tier work from pinning/evicting interactive affinity).
+    headers.update(_tenant_headers(request))
     try:
         backend_url = await route_with_resilience(
             router, candidates, engine_stats, request_stats, headers, request_json
@@ -1548,8 +1637,9 @@ async def route_disaggregated_prefill_request(
     trace = request.get("trace") or NOOP_TRACE
     # Same relay contract as route_general_request: routing-time hops see
     # the router-assigned id (the per-pool routing spans parent their own
-    # outbound attempts below).
+    # outbound attempts below). Both legs inherit the tenant stamp.
     headers = hop_headers(dict(request.headers), request_id=request_id)
+    headers.update(_tenant_headers(request))
 
     original_max_tokens = request_json.get("max_tokens")
     original_stream = request_json.get("stream", False)

@@ -20,6 +20,7 @@ from typing import Optional
 from ...utils import parse_comma_separated
 from .base import (
     PROVIDER_BREAKERS,
+    PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
@@ -72,6 +73,7 @@ __all__ = [
     "GossipStateBackend",
     "InMemoryStateBackend",
     "PROVIDER_BREAKERS",
+    "PROVIDER_CANARY_TTFT",
     "PROVIDER_ENDPOINT_LOADS",
     "PROVIDER_ENDPOINTS",
     "PROVIDER_REQUEST_STATS",

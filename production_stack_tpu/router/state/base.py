@@ -33,6 +33,11 @@ PROVIDER_BREAKERS = "breakers"
 # scoring input behind the bounded-load constraint, replicated so every
 # replica sheds a hot-spotted engine at the same threshold.
 PROVIDER_ENDPOINT_LOADS = "endpoint_loads"
+# Canary-probe TTFT per engine (url -> seconds): the health input fleet
+# scoring multiplies in. Replicated so replicas whose probes diverged
+# (only one of them saw an engine's failed probe) still score that
+# engine the same way.
+PROVIDER_CANARY_TTFT = "canary_ttft"
 
 
 class StateBackend:
@@ -126,6 +131,15 @@ class StateBackend:
         peers; fleet scoring sums these into its local view so the
         bounded-load spill decision converges across replicas. Single
         replica: no peers, no remote load."""
+        return {}
+
+    # -- canary health (fleet-scoring health input) ------------------------
+
+    def peer_canary_ttfts(self) -> Dict[str, Dict[str, float]]:
+        """replica-id -> {engine-url -> last canary TTFT seconds} for
+        live peers; fleet scoring merges these pessimistically (max) into
+        its local view so replica scoring agrees after a failed probe.
+        Single replica: no peers, no remote opinion."""
         return {}
 
     # -- endpoint view -----------------------------------------------------

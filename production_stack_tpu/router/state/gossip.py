@@ -45,6 +45,7 @@ from ...logging_utils import init_logger
 from ...obs.tasks import spawn_owned
 from .base import (
     PROVIDER_BREAKERS,
+    PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_REQUEST_STATS,
@@ -66,7 +67,7 @@ MAX_JOURNALS = 256
 class _Peer:
     """Last-known state of one remote replica, keyed by replica id."""
 
-    __slots__ = ("seen", "endpoints", "stats", "breakers", "loads")
+    __slots__ = ("seen", "endpoints", "stats", "breakers", "loads", "canary")
 
     def __init__(self) -> None:
         self.seen = 0.0  # monotonic receipt time of the last digest
@@ -79,6 +80,10 @@ class _Peer:
         # Fleet-routing scoring input (routed-in-flight per engine).
         # pstlint: owned-by=task:_apply
         self.loads: Dict[str, float] = {}
+        # Canary TTFT per engine (fleet-scoring health input; replicated
+        # so replica scoring agrees after a failed probe).
+        # pstlint: owned-by=task:_apply
+        self.canary: Dict[str, float] = {}
 
 
 class _Target:
@@ -236,6 +241,9 @@ class GossipStateBackend(StateBackend):
     def peer_endpoint_loads(self) -> Dict[str, Dict[str, float]]:
         return {rid: p.loads for rid, p in self._live_peers().items()}
 
+    def peer_canary_ttfts(self) -> Dict[str, Dict[str, float]]:
+        return {rid: p.canary for rid, p in self._live_peers().items()}
+
     def merged_endpoint_urls(self, local: Sequence[str]) -> List[str]:
         merged = set(local)
         for peer in self._live_peers().values():
@@ -303,6 +311,7 @@ class GossipStateBackend(StateBackend):
             "stats": self._provide(PROVIDER_REQUEST_STATS, {}),
             "breakers": self._provide(PROVIDER_BREAKERS, {}),
             "loads": self._provide(PROVIDER_ENDPOINT_LOADS, {}),
+            "canary": self._provide(PROVIDER_CANARY_TTFT, {}),
             "prefix": [
                 [seq, path, ep] for seq, path, ep in list(self._prefix_out)
             ],
@@ -338,6 +347,8 @@ class GossipStateBackend(StateBackend):
         peer.breakers = breakers if isinstance(breakers, dict) else {}
         loads = digest.get("loads")
         peer.loads = loads if isinstance(loads, dict) else {}
+        canary = digest.get("canary")
+        peer.canary = canary if isinstance(canary, dict) else {}
         # Prefix insertions: apply only sequence numbers we have not seen
         # from this replica (the out-queue is a sliding window, so digests
         # re-carry recent entries every round).

@@ -105,11 +105,15 @@ class FakeEngineState:
         #           tests)
         # fail_count > 0 limits the fault to the next N generations
         # (auto-heal); -1 = until POST /admin/heal.
+        # fail_tenant scopes the fault to requests carrying that
+        # X-PST-Tenant value (isolation chaos legs fault one tenant's
+        # traffic without touching the victim's; None = every request).
         self.fail_mode: Optional[str] = None
         self.fail_status = 500
         self.fail_count = -1
         self.fail_delay = 0.5
         self.fail_jitter = 0.0
+        self.fail_tenant: Optional[str] = None
         # Delta chunks delivered before a `midstream` death (default 3,
         # the legacy hardcoded behavior).
         self.fail_after_chunks = 3
@@ -123,6 +127,10 @@ class FakeEngineState:
         # order — lets e2e tests assert one trace id spans every leg
         # (primary, retries, hedges) across engines.
         self.traces_seen: List[dict] = []
+        # (X-PST-Tenant, X-PST-Tenant-Class) per generation request, in
+        # arrival order — lets tests assert the router's tenant stamp
+        # reached the engine on every hop.
+        self.tenants_seen: List[dict] = []
         # Simulated warmup precompilation (the real engine's /ready
         # contract): the engine reports warming for ``ready_delay``
         # seconds after start. With a ``warmup_cache_dir``, a marker file
@@ -216,9 +224,15 @@ class FakeEngineState:
         derived = min(live / self.kv_capacity_tokens, 1.0)
         return max(derived, min(max(self.kv_fill_floor, 0.0), 1.0))
 
-    def take_fault(self) -> Optional[str]:
-        """Consume one fault budget entry; returns the armed mode or None."""
+    def take_fault(self, tenant: Optional[str] = None) -> Optional[str]:
+        """Consume one fault budget entry; returns the armed mode or None.
+
+        With a tenant-scoped fault armed, only requests carrying that
+        ``X-PST-Tenant`` value consume budget and fault — other tenants'
+        traffic passes untouched (the flood-isolation chaos contract)."""
         if self.fail_mode is None or self.fail_count == 0:
+            return None
+        if self.fail_tenant is not None and tenant != self.fail_tenant:
             return None
         mode = self.fail_mode
         if self.fail_count > 0:
@@ -332,6 +346,11 @@ def create_fake_engine_app(
             "traceparent": request.headers.get("traceparent"),
             "request_id": request.headers.get("X-Request-Id"),
         })
+        tenant = request.headers.get("X-PST-Tenant")
+        state.tenants_seen.append({
+            "tenant": tenant,
+            "tenant_class": request.headers.get("X-PST-Tenant-Class"),
+        })
         echo = _echo_trace_headers(request)
         t_admission = time.monotonic()
         if budget is not None and budget <= 0:
@@ -355,7 +374,7 @@ def create_fake_engine_app(
                 status=503,
                 headers={"X-PST-Warming": "1", **echo},
             )
-        fault = state.take_fault()
+        fault = state.take_fault(tenant)
         if fault == "slow":
             delay = state.fail_delay
             if state.fail_jitter:
@@ -698,13 +717,16 @@ def create_fake_engine_app(
     async def admin_fail(request: web.Request) -> web.Response:
         """Arm fault injection: {"mode": "error"|"hang"|"midstream"|"slow",
         "status": 500, "count": -1, "delay": 0.5, "jitter": 0,
-        "fail_after_chunks": 3}. ``slow`` injects ``delay`` (+ uniform
-        jitter up to ``jitter``) seconds of latency per generation,
-        honoring a propagated deadline with 504. ``midstream`` drops the
-        connection after exactly ``fail_after_chunks`` streamed delta
-        chunks (0 = before any delta; >= max_tokens = after the last delta
-        but before ``[DONE]``) — deterministic chunk boundaries for stream
-        resumption tests."""
+        "fail_after_chunks": 3, "tenant": null}. ``slow`` injects
+        ``delay`` (+ uniform jitter up to ``jitter``) seconds of latency
+        per generation, honoring a propagated deadline with 504.
+        ``midstream`` drops the connection after exactly
+        ``fail_after_chunks`` streamed delta chunks (0 = before any
+        delta; >= max_tokens = after the last delta but before
+        ``[DONE]``) — deterministic chunk boundaries for stream
+        resumption tests. ``tenant`` scopes the fault to requests whose
+        ``X-PST-Tenant`` equals it (isolation chaos legs fault one
+        tenant's traffic while the victim's flows untouched)."""
         body = await request.json() if request.can_read_body else {}
         mode = body.get("mode", "error")
         if mode not in ("error", "hang", "midstream", "slow"):
@@ -715,11 +737,16 @@ def create_fake_engine_app(
         state.fail_delay = float(body.get("delay", 0.5))
         state.fail_jitter = float(body.get("jitter", 0.0))
         state.fail_after_chunks = int(body.get("fail_after_chunks", 3))
-        return web.json_response({"status": "armed", "mode": mode})
+        tenant = body.get("tenant")
+        state.fail_tenant = str(tenant) if tenant is not None else None
+        return web.json_response(
+            {"status": "armed", "mode": mode, "tenant": state.fail_tenant}
+        )
 
     async def admin_heal(request: web.Request) -> web.Response:
         state.fail_mode = None
         state.fail_count = -1
+        state.fail_tenant = None
         return web.json_response({"status": "healed", "faulted": state.num_faulted})
 
     async def admin_fill_kv(request: web.Request) -> web.Response:

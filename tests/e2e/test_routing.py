@@ -415,6 +415,113 @@ def leg_deadline():
           f"worst {worst * 1000:.0f}ms)")
 
 
+def leg_tenant_flood():
+    """Flood-isolation chaos (docs/multi-tenancy.md): the REAL router with
+    tenant isolation on, tenant A (flooder) offered ~10x its admitted
+    rate while tenant B (victim) paces steady traffic. The guarantee:
+    the victim's p99 moves <= 10% vs its no-flood baseline, none of its
+    requests shed, and the flood's overflow is charged to the flooder
+    alone (its pst_tenant_sheds_total, its queue)."""
+    import concurrent.futures
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as tf:
+        json.dump({"tenants": {
+            "victim": {"weight": 1, "tier": "interactive"},
+            "flooder": {"weight": 1, "tier": "interactive"},
+        }}, tf)
+        tenant_file = tf.name
+    # ~20 tok/s, 4 tokens -> ~200ms/request: big enough that a 10% p99
+    # shift is far above process-level jitter.
+    with Fleet("roundrobin", speed=20,
+               router_args=["--tenant-isolation",
+                            "--tenant-config", tenant_file,
+                            "--admission-rate", "30",
+                            "--admission-queue-timeout", "0.3"]) as f:
+
+        def victim_one(i):
+            t0 = time.time()
+            status, _, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"victim {i}", "max_tokens": 4},
+                headers={"X-PST-Tenant": "victim"},
+            )
+            return status, time.time() - t0
+
+        def victim_phase(n=15):
+            lat = []
+            for i in range(n):
+                status, dt = victim_one(i)
+                assert status == 200, f"victim shed with {status}"
+                lat.append(dt)
+                time.sleep(0.05)
+            return sorted(lat)[-1]  # p99 ~ max of 15
+
+        base_p99 = victim_phase()
+
+        # Flood: ~100 rps of flooder traffic (10x its ~10 rps share)
+        # from a thread pool, sustained through the second victim phase.
+        stop = {"flag": False}
+
+        def flooder_one(i):
+            status, _, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"flood {i}", "max_tokens": 1},
+                headers={"X-PST-Tenant": "flooder"},
+            )
+            return status
+
+        flood_statuses = []
+
+        def flood_loop():
+            i = 0
+            with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+                futures = []
+                while not stop["flag"]:
+                    futures.append(ex.submit(flooder_one, i))
+                    i += 1
+                    time.sleep(0.01)
+                for fut in futures:
+                    flood_statuses.append(fut.result())
+
+        import threading
+
+        flood_thread = threading.Thread(target=flood_loop)
+        flood_thread.start()
+        time.sleep(0.3)  # flood established
+        try:
+            flood_p99 = victim_phase()
+        finally:
+            stop["flag"] = True
+            flood_thread.join(timeout=30)
+
+        assert flood_statuses.count(429) > len(flood_statuses) * 0.5, (
+            "the flood was not actually over its share"
+        )
+        assert flood_p99 <= base_p99 * 1.10 + 0.01, (
+            f"victim p99 {base_p99 * 1000:.0f}ms -> "
+            f"{flood_p99 * 1000:.0f}ms under flood"
+        )
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        flooder_sheds = metric_value(
+            metrics, "pst_tenant_sheds_total", 'tenant="flooder"'
+        )
+        victim_sheds = metric_value(
+            metrics, "pst_tenant_sheds_total", 'tenant="victim"'
+        )
+        assert flooder_sheds > 0 and victim_sheds == 0
+        assert metric_value(
+            metrics, "pst_tenant_usage_tokens_total", 'tenant="victim"'
+        ) > 0
+    os.unlink(tenant_file)
+    print(f"PASS tenant_flood (victim p99 {base_p99 * 1000:.0f}ms -> "
+          f"{flood_p99 * 1000:.0f}ms under 10x flood, "
+          f"{int(flooder_sheds)} flooder sheds, 0 victim sheds)")
+
+
 def leg_chaos():
     """Chaos smoke: SIGKILL one engine mid-run under concurrent load. The
     router's retry/failover must absorb every request (zero client-visible
@@ -836,6 +943,7 @@ LEGS = {
     "chaos": leg_chaos,
     "router_kill": leg_router_kill,
     "deadline": leg_deadline,
+    "tenant_flood": leg_tenant_flood,
 }
 
 
