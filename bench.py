@@ -45,6 +45,61 @@ TTFT_TARGET_S = 0.200  # north-star p50 TTFT (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+class BenchInterrupted(BaseException):
+    """Raised by the SIGTERM/SIGALRM handlers so an externally imposed
+    wall (the driver's `timeout`, or --time-budget) unwinds the current
+    phase THROUGH its cleanup finallys and still reaches the final
+    emit(). BaseException on purpose: the per-phase `except Exception`
+    guards must not swallow it into an ordinary phase error."""
+
+
+class TimeBudget:
+    """Total wall budget carved into per-phase walls (ROADMAP 5a: the
+    r05 run died on rc:124 with nothing parseable — a budgeted run
+    truncates phases deliberately instead of being killed mid-write).
+
+    ``phase_wall(weight, weights_left)`` hands the next phase its share
+    of whatever remains; a phase that finishes early donates the slack
+    to the rest. 0/None = unbudgeted (the historical behavior)."""
+
+    def __init__(self, total: float = 0.0) -> None:
+        self.total = max(float(total or 0.0), 0.0)
+        self.t0 = time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return self.total > 0
+
+    def remaining(self) -> float:
+        return max(self.total - (time.monotonic() - self.t0), 0.0)
+
+    def phase_wall(self, weight: float, weights_left: float) -> float:
+        """Seconds granted to the next phase: its weight share of the
+        remaining budget."""
+        return self.remaining() * weight / max(weights_left, weight)
+
+    def exhausted(self, floor: float = 20.0) -> bool:
+        """Too little budget left to produce a meaningful phase."""
+        return self.enabled and self.remaining() < floor
+
+
+def install_term_trap() -> None:
+    """SIGTERM (the driver's `timeout` sends it before SIGKILL) raises
+    BenchInterrupted in the main thread: the current phase unwinds
+    through its process-cleanup finallys and main() flushes the final
+    JSON — an rc:124 run still yields a parseable result."""
+    def _raise(signum, frame):
+        raise BenchInterrupted(f"signal {signum}")
+
+    signal.signal(signal.SIGTERM, _raise)
+    signal.signal(signal.SIGALRM, _raise)
+
+
+def phase_alarm(seconds: float) -> None:
+    """Arm the per-phase wall (0 disarms): SIGALRM -> BenchInterrupted."""
+    signal.setitimer(signal.ITIMER_REAL, max(seconds, 0.0))
+
+
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
@@ -808,6 +863,22 @@ def assemble(engine_res: dict, stack, fleet, tenants=None) -> dict:
     }
 
 
+def parse_time_budget(argv) -> float:
+    """--time-budget SECONDS (or PST_BENCH_TIME_BUDGET): total wall this
+    run may spend, carved into per-phase walls. 0 = unbudgeted."""
+    for i, a in enumerate(argv):
+        if a == "--time-budget" and i + 1 < len(argv):
+            return float(argv[i + 1])
+        if a.startswith("--time-budget="):
+            return float(a.split("=", 1)[1])
+    return float(os.environ.get("PST_BENCH_TIME_BUDGET", "0") or 0)
+
+
+# Relative phase weights for budget carving (engine dominates: it pays
+# the XLA warmup; the three stack-side phases are fake-engine-cheap).
+_PHASE_WEIGHTS = {"engine": 6.0, "stack": 1.5, "fleet": 1.5, "tenants": 1.0}
+
+
 def main() -> None:
     # --require-warm (or PST_BENCH_REQUIRE_WARM=1): the engine phase exits
     # nonzero when any measured sweep point absorbs a cold XLA compile, and
@@ -817,39 +888,81 @@ def main() -> None:
     )
     if require_warm:
         os.environ["PST_BENCH_REQUIRE_WARM"] = "1"
-    if os.environ.get("PST_BENCH_SKIP_ENGINE") == "1":  # stack-only debug
-        engine_res = {"backend": probe_backend()}
-    else:
-        engine_res = run_engine_phase()
+    budget = TimeBudget(parse_time_budget(sys.argv[1:]))
+    install_term_trap()
+    interrupted = False
+    weights_left = sum(_PHASE_WEIGHTS.values())
+
+    engine_res = {"backend": "unknown"}
+    try:
+        if os.environ.get("PST_BENCH_SKIP_ENGINE") == "1":  # stack-only debug
+            engine_res = {"backend": probe_backend()}
+        else:
+            if budget.enabled:
+                # The engine child enforces its own wall (and flushes its
+                # partial) via the existing timeout env + its budget env.
+                wall = budget.phase_wall(
+                    _PHASE_WEIGHTS["engine"], weights_left
+                )
+                os.environ["PST_BENCH_ENGINE_TIMEOUT"] = str(int(wall) + 60)
+                os.environ["PST_BENCH_ENGINE_BUDGET"] = str(int(wall))
+            engine_res = run_engine_phase()
+    except BenchInterrupted as e:
+        log(f"engine phase interrupted ({e}); flushing partial result")
+        partial = read_partial(os.environ.get(
+            "PST_BENCH_ENGINE_OUT", "/tmp/pst_bench_engine_partial.json"
+        ))
+        engine_res = partial or engine_res
+        engine_res["partial"] = True
+        engine_res["error"] = f"interrupted: {e}"
+        interrupted = True
+    weights_left -= _PHASE_WEIGHTS["engine"]
     backend = engine_res.get("backend", "unknown")
     on_tpu = backend == "tpu"
     emit(assemble(engine_res, None, None))
 
+    def run_phase(key, fn):
+        """One budget-walled stack-side phase: skipped outright when the
+        budget is gone, marked partial when the wall (or a SIGTERM) cut
+        it short — the final JSON always says what happened."""
+        nonlocal interrupted, weights_left
+        weight = _PHASE_WEIGHTS[key]
+        try:
+            if interrupted or budget.exhausted():
+                # Say WHICH wall cut the run: an external SIGTERM is not
+                # a misconfigured budget.
+                return {"partial": True,
+                        "skipped": ("interrupted" if interrupted
+                                    else "time budget exhausted")}
+            if budget.enabled:
+                phase_alarm(budget.phase_wall(weight, weights_left))
+            try:
+                return fn()
+            finally:
+                phase_alarm(0.0)
+        except BenchInterrupted as e:
+            log(f"{key} phase interrupted ({e})")
+            interrupted = str(e).startswith("signal 15")
+            return {"partial": True, "error": f"interrupted: {e}"}
+        except Exception as e:  # noqa: BLE001 — phase numbers are additive
+            log(f"{key} phase failed: {e}")
+            return {"error": str(e)}
+        finally:
+            weights_left -= weight
+
     stack = None
     if os.environ.get("PST_BENCH_SKIP_STACK") != "1":
-        try:
-            stack = run_stack_phase(on_tpu)
-        except Exception as e:  # noqa: BLE001 — stack numbers are additive
-            log(f"stack phase failed: {e}")
-            stack = {"error": str(e)}
+        stack = run_phase("stack", lambda: run_stack_phase(on_tpu))
         emit(assemble(engine_res, stack, None))
 
     fleet = None
     if os.environ.get("PST_BENCH_SKIP_FLEET") != "1":
-        try:
-            fleet = run_fleet_phase()
-        except Exception as e:  # noqa: BLE001 — fleet numbers are additive
-            log(f"fleet phase failed: {e}")
-            fleet = {"error": str(e)}
+        fleet = run_phase("fleet", run_fleet_phase)
         emit(assemble(engine_res, stack, fleet))
 
     tenants = None
     if os.environ.get("PST_BENCH_SKIP_TENANTS") != "1":
-        try:
-            tenants = run_tenant_phase()
-        except Exception as e:  # noqa: BLE001 — tenant numbers are additive
-            log(f"tenant phase failed: {e}")
-            tenants = {"error": str(e)}
+        tenants = run_phase("tenants", run_tenant_phase)
 
     emit(assemble(engine_res, stack, fleet, tenants))
     # Same fallback as assemble(): a truncated engine phase may carry only

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -53,6 +54,44 @@ V5E_HBM_GBPS = 819.0
 
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+class BenchInterrupted(BaseException):
+    """Raised from the SIGTERM handler (the driver's `timeout` sends
+    TERM before KILL): unwinds the running phase and reaches main()'s
+    final flush — an rc:124 run still prints one parseable JSON object
+    as its last stdout line. BaseException so per-phase ``except
+    Exception`` guards cannot swallow it."""
+
+
+def install_term_trap() -> None:
+    def _raise(signum, frame):
+        raise BenchInterrupted(f"signal {signum}")
+
+    signal.signal(signal.SIGTERM, _raise)
+
+
+_BUDGET_T0 = time.monotonic()
+
+
+def time_budget() -> float:
+    """--time-budget SECONDS / $PST_BENCH_ENGINE_BUDGET: total wall this
+    phase process may spend; phases that would start past it are skipped
+    and marked partial. 0 = unbudgeted."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--time-budget" and i + 1 < len(argv):
+            return float(argv[i + 1])
+        if a.startswith("--time-budget="):
+            return float(a.split("=", 1)[1])
+    return float(os.environ.get("PST_BENCH_ENGINE_BUDGET", "0") or 0)
+
+
+def budget_exhausted(floor: float = 30.0) -> bool:
+    total = time_budget()
+    if total <= 0:
+        return False
+    return total - (time.monotonic() - _BUDGET_T0) < floor
 
 
 def roofline_table(
@@ -409,7 +448,23 @@ def main() -> None:
     on_tpu = backend == "tpu"
     require_warm = require_warm_enabled()
     result: dict = {"backend": backend, "require_warm": require_warm}
+    if time_budget() > 0:
+        result["time_budget_s"] = time_budget()
     write_partial(result)
+    install_term_trap()
+    # The phase currently running, so an interruption can mark exactly it
+    # partial (its checkpoints already persisted every finished point).
+    running_phase = [None]
+
+    def skip_for_budget(key: str) -> bool:
+        if budget_exhausted():
+            log(f"{key} phase skipped: time budget exhausted")
+            result[key] = {"partial": True,
+                           "skipped": "time budget exhausted"}
+            write_partial(result)
+            return True
+        running_phase[0] = key
+        return False
 
     def phase_checkpoint(key):
         # Per-qps-point checkpointing: the phase's partial dict replaces
@@ -420,10 +475,11 @@ def main() -> None:
             write_partial(result)
         return cb
 
-    if on_tpu:
+    try:
+      if on_tpu:
         result["rpc_floor_ms"] = round(env_probe(), 1)
         log(f"rpc floor {result['rpc_floor_ms']} ms")
-        if os.environ.get("PST_BENCH_SKIP_8B") != "1":
+        if os.environ.get("PST_BENCH_SKIP_8B") != "1" and not skip_for_budget("flagship"):
             # TTFT sweep phase: 4 users (the workload must FIT with
             # headroom for ≥300 requests of history growth — at 8 users
             # the growth alone oversubscribes any 16 GiB pool and every
@@ -459,7 +515,7 @@ def main() -> None:
                 checkpoint=phase_checkpoint("flagship"),
             )
             write_partial(result)
-        if os.environ.get("PST_BENCH_SKIP_8B_CONC") != "1":
+        if os.environ.get("PST_BENCH_SKIP_8B_CONC") != "1" and not skip_for_budget("concurrency_8users"):
             # Concurrency phase: EIGHT 20k-history users on the same chip
             # (r4 topped out at 4 on int8) — int4 weights (~4.4 GiB) leave
             # a ~158k-token pool holding ~7.5 of the 8 users' KV; live-KV
@@ -495,7 +551,7 @@ def main() -> None:
             )
             result["concurrency_8users"] = conc
             write_partial(result)
-        if os.environ.get("PST_BENCH_SKIP_1B") != "1":
+        if os.environ.get("PST_BENCH_SKIP_1B") != "1" and not skip_for_budget("llama_1b"):
             result["llama_1b"] = run_model_phase(
                 "llama-1b",
                 n_users=8,
@@ -512,9 +568,10 @@ def main() -> None:
                 checkpoint=phase_checkpoint("llama_1b"),
             )
             write_partial(result)
-    else:
+      else:
         # CPU smoke: tiny model, tiny protocol — keeps the bench runnable
         # (and CI-checkable) anywhere.
+        running_phase[0] = "flagship"
         result["flagship"] = run_model_phase(
             "tiny-llama-debug",
             n_users=4,
@@ -536,12 +593,14 @@ def main() -> None:
             checkpoint=phase_checkpoint("flagship"),
         )
 
-    # Warm-restart phase (docs/engine.md "Warmup & precompilation"): the
-    # same engine built twice against one persistent compile cache;
-    # restart_to_ready_seconds is the warm construct→ready wall time.
-    # tiny-llama-debug on both backends: the cache mechanics (and on TPU,
-    # real XLA serialization) are what's measured, not model-load time.
-    if os.environ.get("PST_BENCH_SKIP_RESTART") != "1":
+      # Warm-restart phase (docs/engine.md "Warmup & precompilation"):
+      # the same engine built twice against one persistent compile cache;
+      # restart_to_ready_seconds is the warm construct→ready wall time.
+      # tiny-llama-debug on both backends: the cache mechanics (and on
+      # TPU, real XLA serialization) are what's measured, not model-load
+      # time.
+      if (os.environ.get("PST_BENCH_SKIP_RESTART") != "1"
+              and not skip_for_budget("warm_restart")):
         import shutil
         import tempfile
 
@@ -564,6 +623,20 @@ def main() -> None:
         finally:
             shutil.rmtree(cache_dir, ignore_errors=True)
         write_partial(result)
+    except BenchInterrupted as e:
+        # SIGTERM (or the parent's wall) cut the run: mark the running
+        # phase — and the run — partial; everything already measured
+        # flows into the final flush below instead of dying with rc:124
+        # and nothing parseable.
+        log(f"interrupted ({e}); flushing final JSON with finished phases")
+        phase = running_phase[0]
+        if phase is not None:
+            entry = result.get(phase)
+            if not isinstance(entry, dict):
+                entry = result[phase] = {}
+            entry["partial"] = True
+            entry.setdefault("error", f"interrupted: {e}")
+        result["partial"] = True
 
     # Run-level pollution verdict: any measured sweep point in any phase
     # that absorbed a cold compile.

@@ -343,6 +343,30 @@ def fleet_dashboard():
         ('sum(rate(pst_route_lookup_skipped_total[5m])) by (reason)',
          "skipped {{reason}} /s"),
     ], 16, 107))
+
+    # Row 14 — Fleet observability plane (docs/observability.md "Fleet
+    # debugging" / "Structured logging"): engine phase census (the scalar
+    # twin of GET /debug/fleet), structured-log sampler drops, and the
+    # exemplar-linked stage p99 — with OpenMetrics negotiated, the stage
+    # buckets carry trace_id exemplars, so this panel's dots link
+    # straight to /debug/requests timelines.
+    p.append(panel("Fleet: engines by phase (/debug/fleet census)", [
+        ('pst_fleet_engines', "{{state}}"),
+    ], 0, 114))
+    p.append(panel("Structured-log sampler drops", [
+        ('sum(rate(pst_log_dropped_total[5m])) by (component)',
+         "{{component}} dropped/s"),
+    ], 8, 114))
+    stage_p99 = panel("Stage p99 (exemplar-linked to /debug/requests)", [
+        ('histogram_quantile(0.99, sum(rate('
+         'pst_stage_duration_seconds_bucket[5m])) by (le, component))',
+         "{{component}} p99"),
+    ], 16, 114, unit="s")
+    # Grafana renders exemplar dots on this panel when the Prometheus
+    # datasource has exemplar storage enabled.
+    for t in stage_p99["targets"]:
+        t["exemplar"] = True
+    p.append(stage_p99)
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

@@ -181,6 +181,7 @@ ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
                note="emitted when observability.tracing is false"),
     _helm("--debug-requests-buffer",
           "routerSpec.observability.debugRequestsBuffer"),
+    _helm("--log-format", "routerSpec.observability.logFormat"),
     _helm("--slo-ttft-ms", "routerSpec.observability.sloTtftMs"),
     _helm("--canary-interval",
           "routerSpec.observability.canary.intervalSeconds",

@@ -749,14 +749,21 @@ class ModelRunner:
 
     # -- host-gap accounting (pst_engine_host_gap_seconds) ---------------
 
-    def _host_gap_mark(self, bucket: str, t_dispatch: float) -> None:
+    def _host_gap_mark(
+        self, bucket: str, t_dispatch: float, seqs=None
+    ) -> None:
         """Close the open host gap at a decode dispatch: the wall between
         the previous decode step's completion and this dispatch is pure
         serial host bookkeeping (batch build, detok, stop scans, scheduler
-        accounting) that idled the device."""
+        accounting) that idled the device. One sequence of the dispatching
+        burst rides along as the histogram exemplar (a slow gap bucket
+        links to the request timeline that absorbed it)."""
         t0, self._host_gap_t0 = self._host_gap_t0, None
         if t0 is not None:
-            ENGINE_TELEMETRY.record_host_gap(bucket, t_dispatch - t0)
+            ENGINE_TELEMETRY.record_host_gap(
+                bucket, t_dispatch - t0,
+                request_id=seqs[0].request_id if seqs else None,
+            )
 
     def _host_gap_arm(self) -> None:
         """A decode step's tokens just became host-visible with no further
@@ -776,7 +783,7 @@ class ModelRunner:
         key = self._tel_key("decode", batch, (want_lp, greedy))
         Bb = batch["kv_lens"].shape[0]
         t0 = time.perf_counter()
-        self._host_gap_mark(f"b{Bb}", t0)
+        self._host_gap_mark(f"b{Bb}", t0, seqs)
         rows = self._run(batch, want_lp, greedy)
         self._host_gap_arm()
         ENGINE_TELEMETRY.record_dispatch(
@@ -807,7 +814,7 @@ class ModelRunner:
         key = self._tel_key("decode", batch, (n_steps, want_lp, greedy))
         Bb = batch["kv_lens"].shape[0]
         t0 = time.perf_counter()
-        self._host_gap_mark(f"b{Bb}xn{n_steps}", t0)
+        self._host_gap_mark(f"b{Bb}xn{n_steps}", t0, seqs)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(
@@ -914,7 +921,7 @@ class ModelRunner:
         Bb = batch["kv_lens"].shape[0]
         bucket = f"b{Bb}xn{n_steps}"
         t0 = time.perf_counter()
-        self._host_gap_mark(bucket, t0)
+        self._host_gap_mark(bucket, t0, seqs)
         with self._device_lock:
             if self.publisher is not None:
                 self.publisher.announce(

@@ -33,17 +33,20 @@ from prometheus_client import (
     Counter,
     Gauge,
     Histogram,
-    generate_latest,
 )
 
 from .. import __version__
 from ..logging_utils import init_logger
 from ..obs import (
     ENGINE_TELEMETRY,
+    ENGINE_TELEMETRY_REGISTRY,
+    OBS_REGISTRY,
     SpanRecorder,
+    bind_log_context,
+    configure_logging,
     debug_requests_response,
-    render_engine_telemetry,
-    render_obs_metrics,
+    render_registries,
+    unbind_log_context,
 )
 from ..obs.tasks import spawn_owned
 from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
@@ -485,6 +488,15 @@ def create_engine_app(
         )
         request["trace"] = trace
         request["request_id"] = request_id
+        # Structured-log correlation: engine log lines under this request
+        # carry the SAME trace id the router's lines do (the propagated
+        # traceparent joined the trace above), plus the router-stamped
+        # tenant — one grep spans the whole hop chain.
+        log_token = bind_log_context(
+            request_id=request_id,
+            trace_id=trace.trace_id,
+            tenant=request.headers.get("X-PST-Tenant"),
+        )
         status: Optional[int] = None
         try:
             response = await handler(request)
@@ -493,6 +505,7 @@ def create_engine_app(
                 response.headers.setdefault("X-Request-Id", request_id)
             return response
         finally:
+            unbind_log_context(log_token)
             trace.finish(status=status)
 
     @web.middleware
@@ -1290,12 +1303,17 @@ def create_engine_app(
         ENGINE_TELEMETRY.refresh_from_stats(stats)
         # pst_stage_duration_seconds lives in the shared observability
         # registry and pst_engine_* in the engine-telemetry registry
-        # (docs/observability.md) — append both to the engine's own.
+        # (docs/observability.md) — append both to the engine's own. A
+        # scraper negotiating OpenMetrics gets the exemplar-carrying
+        # exposition; plain scrapes stay byte-identical.
+        body, content_type = render_registries(
+            (metrics.registry, OBS_REGISTRY, ENGINE_TELEMETRY_REGISTRY),
+            request.headers.get("Accept"),
+        )
+        if content_type == "text/plain":
+            return web.Response(body=body, content_type="text/plain")
         return web.Response(
-            body=generate_latest(metrics.registry)
-            + render_obs_metrics()
-            + render_engine_telemetry(),
-            content_type="text/plain",
+            body=body, headers={"Content-Type": content_type}
         )
 
     # On-demand profiling state: one capture at a time (jax.profiler is a
@@ -1363,6 +1381,26 @@ def create_engine_app(
         admission, queue wait, prefill, decode — joinable to the router's
         timelines by trace id."""
         return debug_requests_response(recorder, request)
+
+    async def debug_state(request: web.Request) -> web.Response:
+        """One-shot engine introspection (docs/observability.md "Fleet
+        debugging"): the scheduler/KV stats snapshot the metrics surface
+        derives from, plus compile totals — what /debug/fleet shows for
+        this engine, straight from the source for cross-validation."""
+        stats = engine.engine.stats()
+        return web.json_response({
+            "model": model_name,
+            "ready": engine.ready,
+            "draining": engine.draining,
+            "warming": engine.warming,
+            "sleeping": engine.sleeping,
+            "in_flight": engine.num_inflight(),
+            "compiles_total": ENGINE_TELEMETRY.compile_count(),
+            "stats": {
+                k: v for k, v in stats.items()
+                if isinstance(v, (int, float, str, bool))
+            },
+        })
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": engine.sleeping})
@@ -1455,6 +1493,7 @@ def create_engine_app(
     app.router.add_get("/ready", ready)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/state", debug_state)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
@@ -1583,6 +1622,11 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
     p.add_argument("--debug-requests-buffer", type=int, default=256,
                    help="completed request timelines kept for "
                         "GET /debug/requests (0 disables the endpoint)")
+    p.add_argument("--log-format", choices=["text", "json"], default="text",
+                   help="log output format: 'json' emits one JSON object "
+                        "per line enriched with trace_id/request_id/"
+                        "tenant/engine_id (docs/observability.md "
+                        "\"Structured logging\")")
     # On-demand jax.profiler capture (docs/observability.md "Profiling").
     p.add_argument("--profiling", dest="profiling", action="store_true",
                    default=False,
@@ -1711,6 +1755,11 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     args = parse_engine_args(argv)
+    configure_logging(
+        getattr(args, "log_format", "text") or "text",
+        component="engine",
+        engine_id=f"{args.host}:{args.port}",
+    )
     cfg = engine_config_from_args(args)
     # Must be set before the engine constructs: the runner records the
     # load/shard phases during __init__.

@@ -24,7 +24,22 @@ from .engine_telemetry import (
     render_engine_telemetry,
 )
 from .http import debug_requests_response
-from .metrics import OBS_REGISTRY, observe_stage, render_obs_metrics
+from .logging import (
+    bind_log_context,
+    configure_logging,
+    current_log_context,
+    set_log_identity,
+    unbind_log_context,
+    update_log_context,
+)
+from .metrics import (
+    OBS_REGISTRY,
+    OPENMETRICS_CONTENT_TYPE,
+    observe_stage,
+    render_obs_metrics,
+    render_registries,
+    wants_openmetrics,
+)
 from .tracing import (
     NOOP_SPAN,
     NOOP_TRACE,
@@ -68,11 +83,15 @@ __all__ = [
     "NOOP_SPAN",
     "NOOP_TRACE",
     "OBS_REGISTRY",
+    "OPENMETRICS_CONTENT_TYPE",
     "REQUEST_ID_HEADER",
     "TRACEPARENT_HEADER",
     "RequestTrace",
     "Span",
     "SpanRecorder",
+    "bind_log_context",
+    "configure_logging",
+    "current_log_context",
     "debug_requests_response",
     "error_headers",
     "format_traceparent",
@@ -85,5 +104,10 @@ __all__ = [
     "parse_traceparent",
     "render_engine_telemetry",
     "render_obs_metrics",
+    "render_registries",
+    "set_log_identity",
     "teardown_request_tracing",
+    "unbind_log_context",
+    "update_log_context",
+    "wants_openmetrics",
 ]

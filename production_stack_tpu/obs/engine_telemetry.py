@@ -315,12 +315,19 @@ class EngineTelemetry:
 
     _HOST_GAP_SAMPLE_CAP = 1024  # per bucket; enough for a stable p50
 
-    def record_host_gap(self, batch_bucket: str, seconds: float) -> None:
+    def record_host_gap(
+        self, batch_bucket: str, seconds: float,
+        request_id: "Optional[str]" = None,
+    ) -> None:
         """One decode-loop host gap (engine/runner.py host-gap accounting):
         the serial host wall between a decode step's completion and the
         next decode dispatch. Pipelined continuations record 0.0 — the
         continuation was dispatched before the previous burst's tokens
-        were read, so the device ran the two back-to-back."""
+        were read, so the device ran the two back-to-back.
+
+        ``request_id`` (one sequence of the gap-closing burst) attaches
+        as an OpenMetrics exemplar: a slow host-gap bucket links to the
+        ``/debug/requests?request_id=`` timeline that absorbed it."""
         seconds = max(seconds, 0.0)
         with self._lock:
             dq = self._host_gap.get(batch_bucket)
@@ -329,7 +336,11 @@ class EngineTelemetry:
                     maxlen=self._HOST_GAP_SAMPLE_CAP
                 )
             dq.append(seconds)
-        host_gap_seconds.labels(batch_bucket=batch_bucket).observe(seconds)
+        child = host_gap_seconds.labels(batch_bucket=batch_bucket)
+        if request_id:
+            child.observe(seconds, exemplar={"request_id": str(request_id)[:48]})
+        else:
+            child.observe(seconds)
 
     def reset_host_gap(self) -> None:
         """Drop retained host-gap samples (NOT the Prometheus histogram —

@@ -1,0 +1,201 @@
+"""Structured correlated logging (docs/observability.md "Structured logging").
+
+One JSON-lines formatter, installed at app/engine bootstrap
+(``--log-format json``), that enriches EVERY stdlib ``logging`` record the
+~50 ``init_logger`` modules emit — zero call-site churn — with the
+request identity the tracing layer already carries:
+
+- ``trace_id`` / ``request_id`` from the per-request log context the
+  tracing middlewares bind (router and engine), so one grep joins a
+  router log line, an engine log line, a ``pst_stage_duration_seconds``
+  exemplar, and the ``/debug/requests`` timeline on the same id;
+- ``tenant`` from the admission middleware (docs/multi-tenancy.md);
+- ``component`` plus ``replica_id`` (router) / ``engine_id`` (engine)
+  from the process identity set once at bootstrap.
+
+Field contract (stable — dashboards and log pipelines key on it):
+``ts`` (epoch seconds), ``level``, ``logger``, ``msg``, ``component``,
+``replica_id`` | ``engine_id``, and — when a request context is bound —
+``trace_id``, ``request_id``, ``tenant``. ``exc`` carries a formatted
+traceback when the record has one. Unknown context fields pass through
+verbatim, so callers may bind extra correlation keys.
+
+Hot-path protection: INFO-and-below records are sampled through a
+per-logger token bucket (``pst_log_dropped_total`` counts the drops, in
+the shared observability registry so both components export it).
+WARNING and above are never dropped — errors must always be joinable.
+
+The text format stays byte-identical to the historical colored output;
+this module only takes over when ``configure_logging("json", ...)`` runs.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from prometheus_client import Counter
+
+from .. import logging_utils
+from .metrics import OBS_REGISTRY
+
+JSON = "json"
+TEXT = "text"
+LOG_FORMATS = (JSON, TEXT)
+
+# Default hot-path sampling: generous enough that steady-state serving
+# never drops a line, tight enough that a per-request DEBUG/INFO storm
+# (one line per token, say) cannot melt stdout. WARNING+ is exempt.
+DEFAULT_SAMPLE_RATE = 200.0   # records/sec per logger
+DEFAULT_SAMPLE_BURST = 400
+
+log_dropped_total = Counter(
+    "pst_log_dropped",
+    "Log records dropped by the structured-logging hot-path sampler "
+    "(INFO and below only; WARNING+ is never sampled)",
+    ["component", "logger"],
+    registry=OBS_REGISTRY,
+)
+
+# Per-request correlation fields (trace_id, request_id, tenant, ...),
+# bound by the tracing/admission middlewares and inherited by every task
+# the request handler spawns (contextvars propagate through create_task).
+_LOG_CONTEXT: "contextvars.ContextVar[Optional[Dict[str, str]]]" = (
+    contextvars.ContextVar("pst_log_context", default=None)
+)
+
+# Process identity (component, replica_id / engine_id): set once at
+# bootstrap, merged into every JSON record.
+_IDENTITY: Dict[str, str] = {}
+
+
+def bind_log_context(**fields) -> contextvars.Token:
+    """Bind per-request correlation fields for the current context; the
+    returned token restores the previous binding (``finally`` in the
+    middleware). Falsy values are skipped so callers can pass optionals."""
+    merged = dict(_LOG_CONTEXT.get() or {})
+    merged.update({k: str(v) for k, v in fields.items() if v})
+    return _LOG_CONTEXT.set(merged)
+
+
+def update_log_context(**fields) -> None:
+    """Merge more fields into the current binding (the admission
+    middleware learns the tenant AFTER the tracing middleware bound the
+    trace) without a token to manage — the context dies with the request
+    context either way."""
+    merged = dict(_LOG_CONTEXT.get() or {})
+    merged.update({k: str(v) for k, v in fields.items() if v})
+    _LOG_CONTEXT.set(merged)
+
+
+def unbind_log_context(token: contextvars.Token) -> None:
+    _LOG_CONTEXT.reset(token)
+
+
+def current_log_context() -> Dict[str, str]:
+    return dict(_LOG_CONTEXT.get() or {})
+
+
+def structured_logging_active() -> bool:
+    """Whether the JSON profile (with its hot-path sampler) is installed.
+    Call sites that want a per-request correlation line gate its level on
+    this: INFO when the sampler bounds the volume, DEBUG otherwise — a
+    text-mode deployment must not grow an unbounded access log."""
+    return logging_utils._FORMATTER_FACTORY is not None
+
+
+def set_log_identity(**fields) -> None:
+    """Set (or extend) the process identity merged into every record:
+    ``component="router"``, ``replica_id=...`` / ``engine_id=...``.
+    Call again as identity becomes known (the router learns its replica
+    id when the state backend constructs)."""
+    _IDENTITY.update({k: str(v) for k, v in fields.items() if v})
+
+
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per line; stable field contract (module docstring)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, object] = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        out.update(_IDENTITY)
+        ctx = _LOG_CONTEXT.get()
+        if ctx:
+            out.update(ctx)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class _SamplingFilter(logging.Filter):
+    """Per-logger token bucket over INFO-and-below records.
+
+    WARNING+ always passes: correlation exists so failures can be
+    joined, and a sampler that could eat an error would defeat that.
+    Drops are counted (never silent) in ``pst_log_dropped_total``.
+    """
+
+    def __init__(self, rate: float, burst: int) -> None:
+        super().__init__()
+        self.rate = max(float(rate), 0.001)
+        self.burst = max(int(burst), 1)
+        self._lock = threading.Lock()
+        # logger name -> (tokens, last_refill_monotonic)
+        self._buckets: Dict[str, list] = {}
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno >= logging.WARNING:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(record.name)
+            if b is None:
+                b = self._buckets[record.name] = [float(self.burst), now]
+            tokens, last = b
+            tokens = min(tokens + (now - last) * self.rate, float(self.burst))
+            if tokens >= 1.0:
+                b[0], b[1] = tokens - 1.0, now
+                return True
+            b[0], b[1] = tokens, now
+        log_dropped_total.labels(
+            component=_IDENTITY.get("component", "unknown"),
+            logger=record.name,
+        ).inc()
+        return False
+
+
+def configure_logging(
+    fmt: str = TEXT,
+    component: Optional[str] = None,
+    sample_rate: float = DEFAULT_SAMPLE_RATE,
+    sample_burst: int = DEFAULT_SAMPLE_BURST,
+    **identity,
+) -> None:
+    """Install the structured-logging profile process-wide.
+
+    ``fmt="json"`` swaps every ``init_logger`` handler (existing and
+    future) to :class:`JsonLineFormatter` and arms the hot-path sampler;
+    ``fmt="text"`` restores the colored text profile (and disarms the
+    sampler). ``component`` + ``identity`` kwargs become the static
+    fields on every record (``replica_id=...``, ``engine_id=...``).
+    """
+    if fmt not in LOG_FORMATS:
+        raise ValueError(f"unknown log format {fmt!r} (expected json|text)")
+    if component:
+        set_log_identity(component=component)
+    set_log_identity(**identity)
+    if fmt == JSON:
+        logging_utils.apply_log_profile(
+            formatter_factory=lambda stream: JsonLineFormatter(),
+            record_filter=_SamplingFilter(sample_rate, sample_burst),
+        )
+    else:
+        logging_utils.apply_log_profile()

@@ -49,6 +49,8 @@ class MetricSpec:
 REGISTRY: Tuple[MetricSpec, ...] = (
     # --- obs/metrics.py: shared stage-latency decomposition -------------
     MetricSpec("pst_stage_duration_seconds", HISTOGRAM, "obs/metrics.py"),
+    # --- obs/logging.py: structured-logging hot-path sampler ------------
+    MetricSpec("pst_log_dropped", COUNTER, "obs/logging.py"),
     # --- obs/engine_telemetry.py: TPU engine device layer ---------------
     MetricSpec("pst_engine_compile", COUNTER, "obs/engine_telemetry.py"),
     MetricSpec("pst_engine_compile_seconds", HISTOGRAM, "obs/engine_telemetry.py"),
@@ -118,6 +120,8 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_tenant_slo_ttft_within_target", COUNTER, "router/services/metrics_service.py"),
     MetricSpec("pst_canary_ttft_seconds", GAUGE, "router/services/metrics_service.py"),
     MetricSpec("pst_canary_failures", COUNTER, "router/services/metrics_service.py"),
+    # --- router/services/fleet.py: fleet introspection plane ------------
+    MetricSpec("pst_fleet_engines", GAUGE, "router/services/fleet.py"),
 )
 
 BY_NAME: Dict[str, MetricSpec] = {s.name: s for s in REGISTRY}

@@ -15,9 +15,19 @@ stage surface shows up on either component without double registration.
 
 from __future__ import annotations
 
+from typing import Iterable, Optional, Tuple
+
 from prometheus_client import CollectorRegistry, Histogram, generate_latest
+from prometheus_client.openmetrics import exposition as _openmetrics
 
 OBS_REGISTRY = CollectorRegistry()
+
+# The OpenMetrics content type /metrics answers when the scraper
+# negotiates it (Accept: application/openmetrics-text) — the format that
+# carries exemplars. Plain Prometheus scrapes keep getting text/plain,
+# byte-identical to the pre-exemplar exposition.
+OPENMETRICS_CONTENT_TYPE = _openmetrics.CONTENT_TYPE_LATEST
+_OM_EOF = b"# EOF\n"
 
 # Buckets span sub-ms (routing decisions) to minutes (long decodes).
 _BUCKETS = (
@@ -34,12 +44,48 @@ stage_duration = Histogram(
 )
 
 
-def observe_stage(component: str, stage: str, seconds: float) -> None:
+def observe_stage(
+    component: str, stage: str, seconds: float,
+    trace_id: Optional[str] = None,
+) -> None:
     """Record one stage duration (negative durations clamp to 0 so a
-    misbehaving clock can never corrupt the histogram)."""
-    stage_duration.labels(component=component, stage=stage).observe(
-        max(seconds, 0.0)
-    )
+    misbehaving clock can never corrupt the histogram).
+
+    ``trace_id`` attaches as an OpenMetrics exemplar on the bucket this
+    observation lands in, so a Grafana p99 bucket links straight to the
+    matching ``/debug/requests`` timeline. Exemplars surface only on
+    negotiated OpenMetrics scrapes; plain exposition is unchanged.
+    """
+    child = stage_duration.labels(component=component, stage=stage)
+    if trace_id:
+        child.observe(max(seconds, 0.0), exemplar={"trace_id": trace_id})
+    else:
+        child.observe(max(seconds, 0.0))
+
+
+def wants_openmetrics(accept: Optional[str]) -> bool:
+    """Whether an Accept header negotiates the OpenMetrics exposition."""
+    return "application/openmetrics-text" in (accept or "")
+
+
+def render_registries(
+    registries: Iterable[CollectorRegistry], accept: Optional[str] = None
+) -> Tuple[bytes, str]:
+    """Render several registries as one exposition body.
+
+    Plain Prometheus (the default): the byte-for-byte concatenation the
+    pre-exemplar handlers produced. With OpenMetrics negotiated, each
+    registry renders through the OpenMetrics encoder (exemplars appear)
+    and the per-registry ``# EOF`` terminators collapse to one.
+    """
+    regs = list(registries)
+    if wants_openmetrics(accept):
+        parts = [_openmetrics.generate_latest(r) for r in regs]
+        body = b"".join(
+            p[: -len(_OM_EOF)] if p.endswith(_OM_EOF) else p for p in parts
+        ) + _OM_EOF
+        return body, OPENMETRICS_CONTENT_TYPE
+    return b"".join(generate_latest(r) for r in regs), "text/plain"
 
 
 def render_obs_metrics() -> bytes:

@@ -1,0 +1,194 @@
+"""``pst-top`` — live terminal fleet view over ``GET /debug/fleet``.
+
+Stdlib-only by design (urllib + ANSI): it must run from any pod or
+laptop with nothing installed but Python. Polls one router replica —
+any replica serves the same gossip-merged snapshot
+(docs/observability.md "Fleet debugging") — and renders the deployment
+as engines × {phase, breaker, in-flight, KV occupancy, prefix hit rate,
+canary TTFT, compiles, host-gap p50} plus the replica membership,
+routing and tenant panes.
+
+    python -m production_stack_tpu.obs.top --router http://router:8001
+    python -m production_stack_tpu.obs.top --once --json   # scripts/tests
+
+``--once`` renders a single frame and exits (``--json`` prints the raw
+snapshot instead — the mode e2e tests and shell pipelines consume).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RED = "\x1b[31m"
+_YELLOW = "\x1b[33m"
+_GREEN = "\x1b[32m"
+_RESET = "\x1b[0m"
+
+
+def fetch_snapshot(
+    router: str, timeout: float = 5.0, api_key: Optional[str] = None
+) -> dict:
+    req = urllib.request.Request(router.rstrip("/") + "/debug/fleet")
+    if api_key:
+        req.add_header("Authorization", f"Bearer {api_key}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt(value, spec: str = "", dash: str = "-") -> str:
+    if value is None:
+        return dash
+    try:
+        return format(value, spec) if spec else str(value)
+    except (TypeError, ValueError):
+        return str(value)
+
+
+def _phase_color(state: str, color: bool) -> str:
+    if not color:
+        return state
+    tint = {
+        "ready": _GREEN, "warming": _YELLOW,
+        "draining": _YELLOW, "sleeping": _DIM,
+    }.get(state, _RED)
+    return f"{tint}{state}{_RESET}"
+
+
+def render_frame(snap: dict, color: bool = True) -> str:
+    """One frame of the fleet view as a string (pure — tested directly)."""
+    bold = _BOLD if color else ""
+    dim = _DIM if color else ""
+    reset = _RESET if color else ""
+    lines = []
+    replicas = snap.get("replicas") or {}
+    tenants = snap.get("tenants") or {}
+    # Fleet-wide, not per-engine: sheds happen at router admission, so
+    # they belong in the header, never in an engine row.
+    total_sheds = sum(
+        int(t.get("sheds_total") or 0) for t in tenants.values()
+        if isinstance(t, dict)
+    )
+    lines.append(
+        f"{bold}pst-top{reset}  replica={snap.get('replica')} "
+        f"replicas={len(replicas)} synced={snap.get('synced')} "
+        f"sheds={total_sheds}"
+    )
+    ages = ", ".join(
+        f"{rid}{'*' if info.get('self') else ''}"
+        f"({_fmt(info.get('sync_age_s'), '.1f', '0.0')}s)"
+        for rid, info in sorted(replicas.items())
+    )
+    lines.append(f"{dim}membership: {ages}{reset}")
+    lines.append("")
+
+    header = (
+        f"{'ENGINE':<28} {'PHASE':<9} {'BRKR':<9} {'INFL':>5} "
+        f"{'KV%':>6} {'HIT%':>6} {'CANARY':>8} {'COMPILES':>8} "
+        f"{'HOSTGAP':>8}"
+    )
+    lines.append(bold + header + reset)
+    engines = snap.get("engines") or {}
+    for url in sorted(engines):
+        e = engines[url]
+        kv = e.get("kv_occupancy")
+        hit = e.get("prefix_hit_rate")
+        canary = e.get("canary_ttft_s")
+        lines.append(
+            f"{url:<28} "
+            f"{_phase_color(str(e.get('state', '?')), color):<9} "
+            f"{_fmt(e.get('breaker')):<9} "
+            f"{_fmt(e.get('in_flight_total', e.get('in_flight'))):>5} "
+            f"{_fmt(kv * 100 if kv is not None else None, '.1f'):>6} "
+            f"{_fmt(hit * 100 if hit is not None else None, '.1f'):>6} "
+            f"{_fmt(canary * 1000 if canary is not None else None, '.0f'):>7}m "
+            f"{_fmt(e.get('compiles_total')):>8} "
+            f"{_fmt((e.get('host_gap_p50_s') or 0) * 1000, '.1f'):>7}m"
+        )
+    if not engines:
+        lines.append(f"{dim}(no engines discovered){reset}")
+    lines.append("")
+
+    routing = snap.get("routing") or {}
+    for rid, r in sorted(routing.items()):
+        if not isinstance(r, dict):
+            continue
+        lines.append(
+            f"{dim}routing[{rid}]: {r.get('policy')} "
+            f"pins={_fmt(r.get('session_pins'))} "
+            f"trie={_fmt(r.get('trie_nodes'))} "
+            f"spills={_fmt(r.get('spills_total'))} "
+            f"remaps={_fmt(r.get('session_remaps_total'))}{reset}"
+        )
+    if tenants:
+        lines.append(bold + (
+            f"{'TENANT':<16} {'TIER':<12} {'W':>5} {'QUEUE':>6} "
+            f"{'ADMITTED':>9} {'SHEDS':>6}"
+        ) + reset)
+        for name in sorted(tenants):
+            t = tenants[name]
+            if not isinstance(t, dict):
+                continue
+            lines.append(
+                f"{name:<16} {_fmt(t.get('tier')):<12} "
+                f"{_fmt(t.get('weight'), '.1f'):>5} "
+                f"{_fmt(t.get('queue_depth')):>6} "
+                f"{_fmt(t.get('admitted_total')):>9} "
+                f"{_fmt(t.get('sheds_total')):>6}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pst-top", description="live terminal fleet view (/debug/fleet)"
+    )
+    p.add_argument("--router", default="http://127.0.0.1:8001",
+                   help="router base URL (any replica serves the merged view)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between refreshes")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="print the raw /debug/fleet JSON (implies --once "
+                        "semantics per frame; for scripts and tests)")
+    p.add_argument("--api-key", default=None,
+                   help="bearer token when the router guards /debug/fleet")
+    p.add_argument("--no-color", dest="color", action="store_false",
+                   default=sys.stdout.isatty())
+    args = p.parse_args(argv)
+
+    while True:
+        try:
+            snap = fetch_snapshot(args.router, api_key=args.api_key)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"pst-top: cannot reach {args.router}/debug/fleet: {e}",
+                  file=sys.stderr)
+            if args.once or args.as_json:
+                return 1
+            # pstlint: disable=async-blocking(pst-top is a synchronous CLI — no event loop exists in this process; the sleep IS the poll interval)
+            time.sleep(args.interval)
+            continue
+        if args.as_json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+            return 0
+        frame = render_frame(snap, color=args.color)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write(_CLEAR + frame + "\n")
+        sys.stdout.flush()
+        # pstlint: disable=async-blocking(pst-top is a synchronous CLI — no event loop exists in this process; the sleep IS the poll interval)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

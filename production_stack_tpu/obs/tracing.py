@@ -279,7 +279,13 @@ class RequestTrace:
     # -- completion --------------------------------------------------------
 
     def _on_span_end(self, span: Span) -> None:
-        observe_stage(self.recorder.component, span.name, span.duration_s or 0.0)
+        # The trace id rides along as an OpenMetrics exemplar: the
+        # histogram bucket this stage lands in links straight back to
+        # this request's /debug/requests timeline.
+        observe_stage(
+            self.recorder.component, span.name, span.duration_s or 0.0,
+            trace_id=self.trace_id,
+        )
         self.recorder._mirror_otel(self, span)
 
     def finish(self, status: Optional[int] = None) -> None:

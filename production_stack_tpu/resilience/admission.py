@@ -141,6 +141,13 @@ class AdmissionController:
         # pstlint: owned-by=task:tenant_bucket,_apply_share
         self._tenant_buckets: Dict[str, TokenBucket] = {}
         self._wfq = WeightedFairQueue() if tenants is not None else None
+        # Per-tenant admitted/shed totals (keyed by the BOUNDED metric
+        # label — ad-hoc names collapse to "other"), read back by the
+        # fleet-introspection snapshot (GET /debug/fleet "tenants" view).
+        # pstlint: owned-by=task:admit,_admit_tenant,_shed
+        self._tenant_admitted: Dict[str, int] = {}
+        # pstlint: owned-by=task:_shed
+        self._tenant_sheds: Dict[str, int] = {}
 
     def _apply_share(self) -> None:
         """Pull the current membership share and rescale the local bucket
@@ -342,6 +349,7 @@ class AdmissionController:
         if not self.enabled:
             metrics.admitted_total.inc()
             if tenant is not None:
+                self._count_tenant(self._tenant_admitted, tenant)
                 metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
             return _ADMIT
         self._apply_share()
@@ -420,6 +428,7 @@ class AdmissionController:
         bucket = self.tenant_bucket(tenant)
         if not self._wfq.has_waiters(tenant.name) and bucket.try_acquire(now):
             metrics.admitted_total.inc()
+            self._count_tenant(self._tenant_admitted, tenant)
             metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
             return _ADMIT
         depth = self._wfq.depth(tenant.name)
@@ -461,6 +470,7 @@ class AdmissionController:
             metrics.queue_depth.set(self.queue_len())
             return self._shed("expired", 0.0, tenant)
         metrics.admitted_total.inc()
+        self._count_tenant(self._tenant_admitted, tenant)
         metrics.tenant_admitted_total.labels(tenant=tenant.label).inc()
         return _ADMIT
 
@@ -472,12 +482,65 @@ class AdmissionController:
     ) -> AdmissionDecision:
         metrics.sheds_total.labels(reason=reason).inc()
         if tenant is not None:
+            self._count_tenant(self._tenant_sheds, tenant)
             metrics.tenant_sheds_total.labels(
                 tenant=tenant.label, reason=reason
             ).inc()
         return AdmissionDecision(
             admitted=False, reason=reason, retry_after=max(retry_after, 0.001)
         )
+
+    @staticmethod
+    def _count_tenant(table: Dict[str, int], tenant: TenantSpec) -> None:
+        table[tenant.label] = table.get(tenant.label, 0) + 1
+
+    def tenants_snapshot(self) -> Dict[str, dict]:
+        """Per-tenant DRR/overload state for GET /debug/fleet: tier,
+        weight, live queue depth, current bucket tokens, DRR deficit,
+        and admitted/shed totals. Keys are the bounded metric labels
+        (configured names verbatim, the ad-hoc population as "other"),
+        so the snapshot — which gossips to every peer replica — can
+        never grow with wire-invented tenant names."""
+        if self.tenants is None:
+            return {}
+        out: Dict[str, dict] = {}
+        names = set(self.tenants.tenants)
+        names.update(self._tenant_buckets)
+        if self._wfq is not None:
+            names.update(name for _, name in self._wfq.tenants_waiting())
+        for name in names:
+            spec = self.tenants.spec_for(name)
+            label = spec.label
+            bucket = self._tenant_buckets.get(
+                name if name in self.tenants.tenants else DEFAULT_TENANT
+            )
+            deficit = 0.0
+            if self._wfq is not None:
+                deficit = self._wfq._deficit.get((spec.rank, name), 0.0)
+            row = out.get(label)
+            if row is None:
+                row = out[label] = {
+                    "tier": spec.tier,
+                    "weight": spec.weight,
+                    "queue_depth": 0,
+                    # Ad-hoc names all draw the DEFAULT bucket, so the
+                    # collapsed row's tokens are consistent by design.
+                    "bucket_tokens": (
+                        round(bucket.tokens, 3) if bucket is not None
+                        else None
+                    ),
+                    "drr_deficit": 0.0,
+                    "admitted_total": self._tenant_admitted.get(label, 0),
+                    "sheds_total": self._tenant_sheds.get(label, 0),
+                }
+            # The ad-hoc population collapses to one row, but its queue
+            # and DRR state SUM across the underlying names — a flood of
+            # invented names must show its real depth, not whichever
+            # name set iteration happened to visit first.
+            if self._wfq is not None:
+                row["queue_depth"] += self._wfq.depth(name)
+            row["drr_deficit"] = round(row["drr_deficit"] + deficit, 3)
+        return out
 
     def close(self) -> None:
         if self._dispatcher is not None:

@@ -19,10 +19,15 @@ from aiohttp import web
 from ..logging_utils import init_logger
 from ..obs import (
     NOOP_TRACE,
+    bind_log_context,
+    configure_logging,
     error_headers,
     get_request_tracer,
     initialize_request_tracing,
+    set_log_identity,
     teardown_request_tracing,
+    unbind_log_context,
+    update_log_context,
 )
 from ..resilience import (
     get_admission_controller,
@@ -57,6 +62,7 @@ from .state import (
     PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
+    PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
     initialize_state_backend,
     teardown_state_backend,
@@ -159,6 +165,14 @@ async def tracing_middleware(request: web.Request, handler):
             attributes={"http.target": request.path},
         )
         request["trace"] = trace
+    # Structured-log correlation (docs/observability.md "Structured
+    # logging"): every log line emitted under this request — by any of
+    # the ~50 init_logger modules, with zero call-site churn — carries
+    # the same trace/request identity the spans and exemplars do.
+    log_token = bind_log_context(
+        request_id=request_id,
+        trace_id=trace.trace_id if trace is not None else None,
+    )
     status: Optional[int] = None
     try:
         response = await handler(request)
@@ -167,6 +181,7 @@ async def tracing_middleware(request: web.Request, handler):
             response.headers.setdefault("X-Request-Id", request_id)
         return response
     finally:
+        unbind_log_context(log_token)
         if trace is not None:
             trace.finish(status=status)
 
@@ -257,6 +272,9 @@ async def admission_middleware(request: web.Request, handler):
             request["tenant"] = tenant
             span.set_attribute("tenant", tenant.name)
             span.set_attribute("tenant_tier", tenant.tier)
+            # The bounded label, not the raw name: log pipelines index
+            # tenant like Prometheus does (ad-hoc names -> "other").
+            update_log_context(tenant=tenant.label)
         # Parse the budget once, here, for every downstream consumer
         # (admission, routing, proxy attempts) — the monotonic deadline is
         # anchored at arrival, so queue time counts against the budget.
@@ -345,7 +363,7 @@ async def admission_middleware(request: web.Request, handler):
 # guarded too — per-request timelines (ids, backend URLs, error strings)
 # are not aggregate telemetry.
 _GUARDED_ADMIN_PATHS = {"/drain", "/undrain", "/sleep", "/wake_up",
-                        "/debug/requests", "/router/drain",
+                        "/debug/requests", "/debug/fleet", "/router/drain",
                         "/router/undrain", "/_state/gossip"}
 
 
@@ -463,6 +481,18 @@ def initialize_all(app: web.Application, args) -> None:
     # (one saw the failure, one didn't) still SCORE every engine the
     # same way — fleet routing merges local + peer views pessimistically.
     backend.register_provider(PROVIDER_CANARY_TTFT, prober.ttft_view)
+    # Fleet introspection (GET /debug/fleet): THIS app's snapshot rides
+    # the fleet_snapshot digest key so every peer replica can serve the
+    # merged deployment picture (docs/observability.md "Fleet
+    # debugging").
+    from .services.fleet import fleet_snapshot_provider
+
+    backend.register_provider(
+        PROVIDER_FLEET_SNAPSHOT, fleet_snapshot_provider(app)
+    )
+    # Structured-log identity: the replica id joins every JSON log line
+    # to the gossip membership view.
+    set_log_identity(component="router", replica_id=backend.replica_id())
     initialize_request_rewriter(args.request_rewriter)
     configure_custom_callbacks(args.callbacks)
     initialize_feature_gates(args.feature_gates)
@@ -593,6 +623,9 @@ def create_app(args) -> web.Application:
 
 def main(argv: Optional[list] = None) -> None:
     args = parse_args(argv)
+    configure_logging(
+        getattr(args, "log_format", "text") or "text", component="router"
+    )
     set_ulimit()
     app = create_app(args)
     logger.info("starting pst-router on %s:%d", args.host, args.port)

@@ -187,6 +187,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-requests-buffer", type=int, default=256,
                    help="completed request timelines kept for "
                         "GET /debug/requests (0 disables the endpoint)")
+    p.add_argument("--log-format", choices=["text", "json"], default="text",
+                   help="log output format: 'json' emits one JSON object "
+                        "per line enriched with trace_id/request_id/"
+                        "tenant/component/replica_id from the request "
+                        "context (docs/observability.md \"Structured "
+                        "logging\"); 'text' keeps the colored "
+                        "human-readable format")
 
     # SLO + canary layer (docs/observability.md "SLOs & alerting"):
     # pst_slo_* counters against the TTFT target, and a per-engine

@@ -7,26 +7,26 @@ SURVEY.md §1) and ``routers/metrics_router.py:57-123``.
 
 from __future__ import annotations
 
-import json
 import time
 
 import psutil
 from aiohttp import web
-from prometheus_client import generate_latest
 
 from .. import __version__
 from ..logging_utils import init_logger
 from ..obs import (
+    OBS_REGISTRY,
     debug_requests_response,
     error_headers,
     get_request_tracer,
-    render_obs_metrics,
+    render_registries,
 )
 from ..resilience import get_admission_controller, get_breaker_registry
 from ..resilience import metrics as res_gauges
 from ..resilience.breaker import STATE_VALUE
 from .service_discovery import get_service_discovery
 from .state import GOSSIP_PATH, get_state_backend
+from .services import fleet as fleet_service
 from .services import metrics_service as gauges
 from .services.request_service import (
     route_drain_request,
@@ -326,6 +326,9 @@ async def metrics(request: web.Request) -> web.Response:
     res_gauges.warming_engines.set(
         sum(1 for ep in endpoints if ep.warming)
     )
+    # Fleet phase counts (pst_fleet_engines): the scalar twin of the
+    # /debug/fleet JSON, refreshed from this replica's discovery view.
+    fleet_service.refresh_fleet_gauges(endpoints)
     # Router-process resource usage.
     proc = psutil.Process()
     gauges.router_cpu_percent.set(proc.cpu_percent())
@@ -333,10 +336,19 @@ async def metrics(request: web.Request) -> web.Response:
     gauges.router_disk_percent.set(psutil.disk_usage("/").percent)
     # Append the shared observability registry (pst_stage_duration_seconds)
     # — it lives outside the default registry (docs/observability.md).
-    return web.Response(
-        body=generate_latest() + render_obs_metrics(),
-        content_type="text/plain",
+    # A scraper negotiating OpenMetrics (Accept: application/
+    # openmetrics-text) gets the exemplar-carrying exposition; everyone
+    # else gets the plain text/plain body, byte-identical to before
+    # exemplars existed.
+    from prometheus_client import REGISTRY as _DEFAULT_REGISTRY
+
+    accept = request.headers.get("Accept")
+    body, content_type = render_registries(
+        (_DEFAULT_REGISTRY, OBS_REGISTRY), accept
     )
+    if content_type == "text/plain":
+        return web.Response(body=body, content_type="text/plain")
+    return web.Response(body=body, headers={"Content-Type": content_type})
 
 
 @routes.get("/debug/requests")
@@ -355,6 +367,19 @@ async def debug_requests(request: web.Request) -> web.Response:
             headers=error_headers(request),
         )
     return debug_requests_response(recorder, request)
+
+
+@routes.get("/debug/fleet")
+async def debug_fleet(request: web.Request) -> web.Response:
+    """One gossip-merged snapshot of the whole deployment
+    (docs/observability.md "Fleet debugging"): replica membership + sync
+    ages, per-engine state (phase, breaker, routed in-flight fleet-wide,
+    KV occupancy, canary TTFT, compile counters), the fleet-routing view
+    and per-tenant DRR state. Served by every replica with identical
+    content modulo one sync interval — ``pst-top`` renders it live."""
+    return web.json_response(
+        fleet_service.merged_fleet_snapshot(request.app)
+    )
 
 
 @routes.post("/sleep")

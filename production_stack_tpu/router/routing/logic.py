@@ -293,6 +293,11 @@ class RoutingInterface(ABC):
     ) -> str:
         """Pick the engine URL that should serve this request."""
 
+    def describe(self) -> dict:
+        """Introspection view for GET /debug/fleet: at least the policy
+        name; stateful policies override with their live table sizes."""
+        return {"policy": type(self).__name__}
+
     @classmethod
     def destroy(cls) -> None:
         """Legacy SingletonMeta-era hook: drop the scoped policy when it
@@ -570,6 +575,13 @@ class FleetRouter(RoutingInterface):
         self._last_scores: Dict[str, float] = {}
         # pstlint: owned-by=task:route_request,evict_endpoint
         self._last_loads: Dict[str, float] = {}
+        # Introspection totals (GET /debug/fleet "routing" view): the
+        # Prometheus counters beside them are per-process families a
+        # snapshot cannot read back cheaply, so the router keeps its own.
+        # pstlint: owned-by=task:route_request,_route_session
+        self._spills_total = 0
+        # pstlint: owned-by=task:_route_session
+        self._remaps_total = 0
         self._initialized = True
 
     async def aclose(self) -> None:
@@ -738,6 +750,7 @@ class FleetRouter(RoutingInterface):
                 scores, loads, bound, batch_tier=batch_tier
             )
             if spill is not None:
+                self._spills_total += 1
                 metrics.spill_total.labels(reason=spill).inc()
         metrics.route_score.observe(max(scores.get(selected, 0.0), 0.0))
         # Insert bounded at the same depth the match walk reads: chunks
@@ -771,12 +784,14 @@ class FleetRouter(RoutingInterface):
             if not decayed and not overloaded:
                 self.pins.pin(session_id, pinned, batch_tier=batch_tier)
                 return pinned
+            self._remaps_total += 1
             metrics.session_remap_total.labels(
                 reason="score_decay" if decayed else "overload"
             ).inc()
         elif pinned is not None:
             # The pinned engine is no longer routable (draining, breaker
             # open, removed by discovery): remap within THIS decision.
+            self._remaps_total += 1
             metrics.session_remap_total.labels(reason="unroutable").inc()
         remapped = self.ring.get_node_bounded(
             session_id, loads, c=self.load_factor, allowed=set(urls)
@@ -786,6 +801,7 @@ class FleetRouter(RoutingInterface):
                 scores, loads, bound, batch_tier=batch_tier
             )
             if spill is not None:
+                self._spills_total += 1
                 metrics.spill_total.labels(reason=spill).inc()
         if pinned is not None and remapped == pinned:
             # The ring handed the evicted session straight back (e.g. the
@@ -798,6 +814,24 @@ class FleetRouter(RoutingInterface):
                 )
         self.pins.pin(session_id, remapped, batch_tier=batch_tier)
         return remapped
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self) -> dict:
+        """The fleet-routing view GET /debug/fleet serves: session-pin
+        count, trie size, spill/remap totals, and the last scoring
+        snapshot (scores + routed loads per engine)."""
+        return {
+            "policy": type(self).__name__,
+            "session_pins": len(self.pins),
+            "trie_nodes": self.hashtrie._node_count,
+            "spills_total": self._spills_total,
+            "session_remaps_total": self._remaps_total,
+            "last_scores": {
+                u: round(s, 6) for u, s in self._last_scores.items()
+            },
+            "last_loads": dict(self._last_loads),
+        }
 
     # -- churn -------------------------------------------------------------
 

@@ -1,0 +1,270 @@
+"""Fleet introspection snapshot — the read-side of the whole deployment.
+
+``GET /debug/fleet`` (docs/observability.md "Fleet debugging") answers
+with ONE gossip-merged JSON picture of a deployment: replica membership
+with sync ages, per-engine state (health phase, breaker, routed
+in-flight, KV occupancy, canary TTFT, compile counters from the
+scraper), the fleet-routing view (session pins, trie size, spill/remap
+totals), and per-tenant DRR credit/queue/shed state. Before this module
+an operator hand-joined ``/metrics`` + ``/engines`` + ``/debug/requests``
+across every router and engine pod.
+
+Mechanics: each replica builds :func:`local_fleet_snapshot` from its own
+app-scoped services; the snapshot rides the ``fleet_snapshot`` gossip
+digest key through the existing :class:`StateBackend`
+(``router/state``), so every replica holds every peer's latest view and
+:func:`merged_fleet_snapshot` renders the same deployment picture from
+any replica, modulo one sync interval. ``pst-top``
+(``python -m production_stack_tpu.obs.top``) is the terminal client.
+
+Merge policy per structure: engine fields take the freshest replica's
+view (each snapshot is stamped), routed in-flight sums across replicas
+(each replica counts only its own proxied traffic), tenant queue depths
+and admitted/shed totals sum, and routing tables stay per-replica (pins
+are replica-local state, summing them would be a lie).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from prometheus_client import Gauge
+
+from ...logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# Engines per health phase in this replica's discovery view, refreshed at
+# scrape time (GET /metrics) — the alert-friendly scalar twin of the
+# /debug/fleet JSON.
+fleet_engines = Gauge(
+    "pst_fleet_engines",
+    "Engines in the fleet by health phase (ready|warming|draining|sleeping)",
+    ["state"],
+)
+
+
+def _engine_phase(ep: Any) -> str:
+    if getattr(ep, "sleep", False):
+        return "sleeping"
+    if getattr(ep, "draining", False):
+        return "draining"
+    if getattr(ep, "warming", False):
+        return "warming"
+    return "ready"
+
+
+def refresh_fleet_gauges(endpoints) -> None:
+    counts = {"ready": 0, "warming": 0, "draining": 0, "sleeping": 0}
+    for ep in endpoints:
+        counts[_engine_phase(ep)] += 1
+    for state, n in counts.items():
+        fleet_engines.labels(state=state).set(n)
+
+
+def _resolve(app, key: str, getter) -> Any:
+    """App-injected instance first (the gossip provider runs outside any
+    request context), ambient scope lookup second, None when neither
+    resolves — a snapshot must degrade, never raise."""
+    if app is not None:
+        inst = app.get(key)
+        if inst is not None:
+            return inst
+    try:
+        return getter()
+    except Exception:  # noqa: BLE001 — absent service = absent section
+        return None
+
+
+def local_fleet_snapshot(app=None, compact: bool = False) -> dict:
+    """THIS replica's contribution to the fleet picture.
+
+    ``compact=True`` is the gossip-provider variant: it drops the
+    per-engine score/load maps from the routing view — they are
+    redundant with the ``loads`` digest key already gossiped for fleet
+    scoring, and the digest ships twice per second whether or not
+    anyone reads ``/debug/fleet``, so the replicated payload carries
+    only what no other key does (scraper warm-state, pins/trie/spill
+    totals, tenant DRR state)."""
+    from ...resilience import get_admission_controller, get_breaker_registry
+    from ..routing.logic import get_routing_logic
+    from ..service_discovery import get_service_discovery
+    from ..state import get_state_backend
+    from ..stats.engine_stats import get_engine_stats_scraper
+    from ..stats.request_stats import get_request_stats_monitor
+    from .canary import get_canary_prober
+
+    backend = _resolve(app, "state_backend", get_state_backend)
+    discovery = _resolve(app, "service_discovery", get_service_discovery)
+    scraper = _resolve(app, "engine_stats_scraper", get_engine_stats_scraper)
+    monitor = _resolve(app, "request_stats_monitor", get_request_stats_monitor)
+    prober = _resolve(app, "canary_prober", get_canary_prober)
+    router = _resolve(app, "routing_logic", get_routing_logic)
+    try:
+        breakers = get_breaker_registry()
+    except Exception:  # noqa: BLE001
+        breakers = None
+    try:
+        controller = get_admission_controller()
+    except Exception:  # noqa: BLE001
+        controller = None
+
+    engine_stats = scraper.get_engine_stats() if scraper is not None else {}
+    # LOCAL routed in-flight only: the merge sums per-replica counts, so
+    # publishing the fleet-merged view would double-count peers' traffic.
+    request_stats = (
+        monitor.get_request_stats(time.time(), fleet=False)
+        if monitor is not None else {}
+    )
+    canary = prober.ttft_view() if prober is not None else {}
+
+    engines: Dict[str, dict] = {}
+    for ep in (discovery.get_endpoint_info() if discovery is not None else []):
+        url = ep.url
+        es = engine_stats.get(url)
+        rs = request_stats.get(url)
+        entry: Dict[str, Any] = {
+            "id": ep.Id,
+            "models": list(ep.model_names),
+            "model_label": ep.model_label,
+            "state": _engine_phase(ep),
+            "breaker": (
+                breakers.state(url).value if breakers is not None else None
+            ),
+            "in_flight": (
+                rs.in_prefill_requests + rs.in_decoding_requests
+                if rs is not None else 0
+            ),
+            "canary_ttft_s": canary.get(url),
+        }
+        if es is not None:
+            entry.update({
+                "running": es.num_running_requests,
+                "waiting": es.num_queuing_requests,
+                "kv_occupancy": (
+                    es.engine_kv_page_occupancy
+                    or es.gpu_cache_usage_perc
+                ),
+                "prefix_hit_rate": es.gpu_prefix_cache_hit_rate,
+                "compiles_total": es.engine_compiles_total,
+                "host_gap_p50_s": getattr(es, "engine_host_gap_p50", 0.0),
+                "warmup_coverage": getattr(es, "engine_warmup_coverage", 0.0),
+            })
+        engines[url] = entry
+
+    routing = router.describe() if router is not None else {}
+    if compact:
+        routing = {
+            k: v for k, v in routing.items()
+            if k not in ("last_scores", "last_loads")
+        }
+    snapshot: Dict[str, Any] = {
+        "replica": backend.replica_id() if backend is not None else "local",
+        "ts": time.time(),
+        "engines": engines,
+        "routing": routing,
+        "tenants": (
+            controller.tenants_snapshot() if controller is not None else {}
+        ),
+    }
+    return snapshot
+
+
+def _merge_tenants(
+    merged: Dict[str, dict], view: Dict[str, dict], rid: str
+) -> None:
+    for name, t in (view or {}).items():
+        if not isinstance(t, dict):
+            continue
+        cur = merged.setdefault(name, {
+            "tier": t.get("tier"),
+            "weight": t.get("weight"),
+            "queue_depth": 0,
+            "admitted_total": 0,
+            "sheds_total": 0,
+        })
+        cur["tier"] = t.get("tier", cur.get("tier"))
+        cur["weight"] = t.get("weight", cur.get("weight"))
+        for key in ("queue_depth", "admitted_total", "sheds_total"):
+            try:
+                cur[key] = cur.get(key, 0) + int(t.get(key) or 0)
+            except (TypeError, ValueError):
+                continue
+        if "drr_deficit" in t:
+            cur.setdefault("drr_deficit_by_replica", {})[rid] = t[
+                "drr_deficit"
+            ]
+
+
+def merged_fleet_snapshot(app=None) -> dict:
+    """The gossip-merged deployment picture every replica serves.
+
+    Identical modulo sync lag: each replica merges its own local view
+    with every live peer's gossiped snapshot; per-engine fields follow
+    the freshest stamp, routed in-flight and tenant counters sum, and
+    routing tables key by owning replica.
+    """
+    from ..state import get_state_backend
+
+    backend = _resolve(app, "state_backend", get_state_backend)
+    local = local_fleet_snapshot(app)
+    peers = (
+        backend.peer_fleet_snapshots() if backend is not None else {}
+    )
+
+    views = [local] + [
+        v for v in peers.values() if isinstance(v, dict)
+    ]
+    # Oldest first so newer views overwrite per-engine fields.
+    views.sort(key=lambda v: float(v.get("ts") or 0.0))
+
+    engines: Dict[str, dict] = {}
+    tenants: Dict[str, dict] = {}
+    routing: Dict[str, dict] = {}
+    for view in views:
+        rid = str(view.get("replica") or "unknown")
+        for url, e in (view.get("engines") or {}).items():
+            if not isinstance(e, dict):
+                continue
+            cur = engines.setdefault(url, {"in_flight_by_replica": {}})
+            by_replica = cur["in_flight_by_replica"]
+            by_replica[rid] = int(e.get("in_flight") or 0)
+            cur.update({k: v for k, v in e.items() if k != "in_flight"})
+            cur["in_flight_by_replica"] = by_replica
+        _merge_tenants(tenants, view.get("tenants") or {}, rid)
+        if view.get("routing"):
+            routing[rid] = view["routing"]
+    for e in engines.values():
+        e["in_flight_total"] = sum(e["in_flight_by_replica"].values())
+
+    replicas: Dict[str, dict] = {
+        str(local["replica"]): {"self": True, "sync_age_s": 0.0}
+    }
+    if backend is not None:
+        ages = (backend.describe() or {}).get("peers") or {}
+        for rid in peers:
+            replicas[str(rid)] = {
+                "self": False,
+                "sync_age_s": ages.get(rid),
+            }
+
+    return {
+        "replica": local["replica"],
+        "ts": local["ts"],
+        "synced": backend.synced() if backend is not None else True,
+        "replicas": replicas,
+        "engines": engines,
+        "routing": routing,
+        "tenants": tenants,
+    }
+
+
+def fleet_snapshot_provider(app) -> "Any":
+    """The ``fleet_snapshot`` gossip provider for ``app`` — a closure so
+    the gossip loop (no request context) still snapshots THIS app's
+    services, not whichever app initialized last."""
+    def provide() -> Optional[dict]:
+        return local_fleet_snapshot(app, compact=True)
+
+    return provide

@@ -122,24 +122,30 @@ def slo_ttft_target_s() -> Optional[float]:
 
 
 def observe_slo_ttft(
-    model: Optional[str], seconds: float, tenant: Optional[str] = None
+    model: Optional[str], seconds: float, tenant: Optional[str] = None,
+    trace_id: Optional[str] = None,
 ) -> None:
     """One request reached its first upstream byte: count it, and count it
     as within-target when the router-observed TTFT met the objective.
     With tenant isolation on, ``tenant`` feeds the per-tenant SLO view
-    (``pst_tenant_slo_*``) beside the per-model one."""
+    (``pst_tenant_slo_*``) beside the per-model one. ``trace_id``
+    attaches as an OpenMetrics exemplar on the SLO counters, so a
+    burn-rate alert links straight to a concrete request timeline."""
     target = slo_ttft_target_s()
     if target is None:
         return
     m = str(model) if model else "unknown"
-    slo_requests_total.labels(model=m).inc()
+    ex = {"trace_id": trace_id} if trace_id else None
+    slo_requests_total.labels(model=m).inc(exemplar=ex)
     within = seconds <= target
     if within:
-        slo_ttft_within_target_total.labels(model=m).inc()
+        slo_ttft_within_target_total.labels(model=m).inc(exemplar=ex)
     if tenant:
-        tenant_slo_requests_total.labels(tenant=tenant).inc()
+        tenant_slo_requests_total.labels(tenant=tenant).inc(exemplar=ex)
         if within:
-            tenant_slo_ttft_within_target_total.labels(tenant=tenant).inc()
+            tenant_slo_ttft_within_target_total.labels(tenant=tenant).inc(
+                exemplar=ex
+            )
 
 
 def observe_slo_failure(

@@ -47,6 +47,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import logging
 import time
 import uuid
 from typing import Awaitable, Callable, Optional
@@ -55,7 +56,13 @@ import aiohttp
 from aiohttp import web
 
 from ...logging_utils import init_logger
-from ...obs import NOOP_TRACE, REQUEST_ID_HEADER, TRACEPARENT_HEADER, error_headers
+from ...obs import (
+    NOOP_TRACE,
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    error_headers,
+)
+from ...obs.logging import structured_logging_active
 from ..hop import hop_headers
 from ...resilience import (
     get_breaker_registry,
@@ -572,6 +579,11 @@ async def proxy_and_stream(
                                         tenant=(
                                             tenant.label
                                             if tenant is not None else None
+                                        ),
+                                        trace_id=(
+                                            getattr(
+                                                attempt_span, "trace_id", ""
+                                            ) or None
                                         ),
                                     )
                                 else:
@@ -1596,7 +1608,17 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     routing_span.set_attribute("engine", backend_url)
     routing_span.set_attribute("outcome", "routed")
     routing_span.end()
-    logger.debug("routing %s for model %s to %s", request_id, requested_model, backend_url)
+    # The one access-log-shaped line per request: INFO under --log-format
+    # json, where it carries the bound trace/request/tenant context
+    # (docs/observability.md "Structured logging") AND the hot-path
+    # sampler bounds its volume; DEBUG in text mode, where no sampler is
+    # installed and an unbounded per-request INFO line would be a log
+    # regression for existing deployments.
+    logger.log(
+        logging.INFO if structured_logging_active() else logging.DEBUG,
+        "routing %s for model %s to %s",
+        request_id, requested_model, backend_url,
+    )
     failover = make_failover(candidates, headers, request_json)
     hedge = get_hedge_policy()
     if (

@@ -23,6 +23,7 @@ from .base import (
     PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
+    PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
     StateBackend,
 )
@@ -76,6 +77,7 @@ __all__ = [
     "PROVIDER_CANARY_TTFT",
     "PROVIDER_ENDPOINT_LOADS",
     "PROVIDER_ENDPOINTS",
+    "PROVIDER_FLEET_SNAPSHOT",
     "PROVIDER_REQUEST_STATS",
     "StateBackend",
     "get_state_backend",

@@ -38,6 +38,11 @@ PROVIDER_ENDPOINT_LOADS = "endpoint_loads"
 # (only one of them saw an engine's failed probe) still score that
 # engine the same way.
 PROVIDER_CANARY_TTFT = "canary_ttft"
+# This replica's fleet-introspection snapshot (engines + routing +
+# tenants view; router/services/fleet.py): replicated so GET /debug/fleet
+# answers with the same gossip-merged deployment picture from every
+# replica — one surface instead of hand-joining N routers' local views.
+PROVIDER_FLEET_SNAPSHOT = "fleet_snapshot"
 
 
 class StateBackend:
@@ -140,6 +145,16 @@ class StateBackend:
         live peers; fleet scoring merges these pessimistically (max) into
         its local view so replica scoring agrees after a failed probe.
         Single replica: no peers, no remote opinion."""
+        return {}
+
+    # -- fleet introspection snapshots (GET /debug/fleet) ------------------
+
+    def peer_fleet_snapshots(self) -> Dict[str, dict]:
+        """replica-id -> that replica's local fleet snapshot (engines /
+        routing / tenants view) for live peers; ``/debug/fleet`` merges
+        these with the local snapshot so every replica serves the same
+        deployment picture modulo one sync interval. Single replica: no
+        peers, nothing to merge."""
         return {}
 
     # -- endpoint view -----------------------------------------------------

@@ -48,6 +48,7 @@ from .base import (
     PROVIDER_CANARY_TTFT,
     PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
+    PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
     StateBackend,
 )
@@ -67,7 +68,9 @@ MAX_JOURNALS = 256
 class _Peer:
     """Last-known state of one remote replica, keyed by replica id."""
 
-    __slots__ = ("seen", "endpoints", "stats", "breakers", "loads", "canary")
+    __slots__ = (
+        "seen", "endpoints", "stats", "breakers", "loads", "canary", "fleet",
+    )
 
     def __init__(self) -> None:
         self.seen = 0.0  # monotonic receipt time of the last digest
@@ -84,6 +87,9 @@ class _Peer:
         # so replica scoring agrees after a failed probe).
         # pstlint: owned-by=task:_apply
         self.canary: Dict[str, float] = {}
+        # Fleet-introspection snapshot (GET /debug/fleet merge input).
+        # pstlint: owned-by=task:_apply
+        self.fleet: dict = {}
 
 
 class _Target:
@@ -244,6 +250,11 @@ class GossipStateBackend(StateBackend):
     def peer_canary_ttfts(self) -> Dict[str, Dict[str, float]]:
         return {rid: p.canary for rid, p in self._live_peers().items()}
 
+    def peer_fleet_snapshots(self) -> Dict[str, dict]:
+        return {
+            rid: p.fleet for rid, p in self._live_peers().items() if p.fleet
+        }
+
     def merged_endpoint_urls(self, local: Sequence[str]) -> List[str]:
         merged = set(local)
         for peer in self._live_peers().values():
@@ -312,6 +323,7 @@ class GossipStateBackend(StateBackend):
             "breakers": self._provide(PROVIDER_BREAKERS, {}),
             "loads": self._provide(PROVIDER_ENDPOINT_LOADS, {}),
             "canary": self._provide(PROVIDER_CANARY_TTFT, {}),
+            "fleet": self._provide(PROVIDER_FLEET_SNAPSHOT, {}),
             "prefix": [
                 [seq, path, ep] for seq, path, ep in list(self._prefix_out)
             ],
@@ -349,6 +361,8 @@ class GossipStateBackend(StateBackend):
         peer.loads = loads if isinstance(loads, dict) else {}
         canary = digest.get("canary")
         peer.canary = canary if isinstance(canary, dict) else {}
+        fleet = digest.get("fleet")
+        peer.fleet = fleet if isinstance(fleet, dict) else {}
         # Prefix insertions: apply only sequence numbers we have not seen
         # from this replica (the out-queue is a sliding window, so digests
         # re-carry recent entries every round).

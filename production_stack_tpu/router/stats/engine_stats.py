@@ -45,7 +45,34 @@ _METRIC_FIELDS = {
     "pst_engine_mfu": "engine_mfu",
     "pst_engine_kv_page_occupancy": "engine_kv_page_occupancy",
     "pst_engine_kv_page_high_watermark": "engine_kv_page_high_watermark",
+    "pst_engine_warmup_coverage": "engine_warmup_coverage",
 }
+
+# Histogram whose p50 the scraper estimates from bucket counts (summed
+# over label sets): the decode-loop host gap, so /engines and
+# /debug/fleet surface the overlap-pipeline health without operators
+# scraping engines directly.
+_HOST_GAP_BUCKET = "pst_engine_host_gap_seconds_bucket"
+
+
+def _bucket_quantile(buckets, q: float) -> float:
+    """Estimate a quantile from cumulative ``{le: count}`` samples: the
+    smallest upper bound covering q of the observations (the classic
+    histogram_quantile upper-bound estimate, without interpolation —
+    good enough for a health readout)."""
+    if not buckets:
+        return 0.0
+    finite = sorted(
+        (le, c) for le, c in buckets.items() if le != float("inf")
+    )
+    total = max(buckets.values())
+    if total <= 0:
+        return 0.0
+    target = q * total
+    for le, count in finite:
+        if count >= target:
+            return le
+    return finite[-1][0] if finite else 0.0
 
 # Labeled counters summed over their label sets (pst_engine_compile_total
 # has one sample per {kind, shape_bucket}); everything else is a single
@@ -65,6 +92,9 @@ class EngineStats:
     engine_mfu: float = 0.0
     engine_kv_page_occupancy: float = 0.0
     engine_kv_page_high_watermark: float = 0.0
+    engine_warmup_coverage: float = 0.0
+    # Estimated from the pst_engine_host_gap_seconds bucket counts.
+    engine_host_gap_p50: float = 0.0
 
     @staticmethod
     def from_scrape(text: str) -> "EngineStats":
@@ -77,9 +107,20 @@ class EngineStats:
         the garbage.
         """
         values: Dict[str, float] = {}
+        host_gap_buckets: Dict[float, float] = {}
         try:
             for family in text_string_to_metric_families(text):
                 for sample in family.samples:
+                    if sample.name == _HOST_GAP_BUCKET:
+                        try:
+                            le = float(sample.labels.get("le", "inf"))
+                            host_gap_buckets[le] = (
+                                host_gap_buckets.get(le, 0.0)
+                                + float(sample.value)
+                            )
+                        except (TypeError, ValueError):
+                            pass
+                        continue
                     field = _METRIC_FIELDS.get(sample.name)
                     if field is None:
                         continue
@@ -93,6 +134,10 @@ class EngineStats:
                         values[field] = v
         except Exception as e:  # noqa: BLE001 — keep what parsed so far
             logger.debug("partial engine scrape parse: %s", e)
+        if host_gap_buckets:
+            values["engine_host_gap_p50"] = _bucket_quantile(
+                host_gap_buckets, 0.5
+            )
         stats = EngineStats()
         for field, value in values.items():
             try:

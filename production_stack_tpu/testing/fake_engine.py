@@ -28,7 +28,14 @@ import xxhash
 from aiohttp import web
 
 from ..logging_utils import init_logger
-from ..obs import observe_stage, render_obs_metrics
+from ..obs import (
+    bind_log_context,
+    configure_logging,
+    observe_stage,
+    parse_traceparent,
+    render_obs_metrics,
+    unbind_log_context,
+)
 
 logger = init_logger(__name__)
 
@@ -338,6 +345,30 @@ def create_fake_engine_app(
         )
 
     async def _generate(request: web.Request, is_chat: bool) -> web.StreamResponse:
+        # Structured-log correlation (--log-format json): the router's
+        # propagated trace/request ids land on this engine's log lines
+        # and on its stage-histogram exemplars, so e2e legs can join
+        # router logs, engine logs, exemplars and /debug/requests on one
+        # trace id — same contract as the real engine server. The token
+        # is released on EVERY exit path (shed/drain/warming/fault
+        # included): aiohttp serves keep-alive requests sequentially in
+        # one connection context, and a leaked binding would stamp the
+        # NEXT request's log lines with this request's identity.
+        parsed_tp = parse_traceparent(request.headers.get("traceparent"))
+        trace_id = parsed_tp[0] if parsed_tp else None
+        log_token = bind_log_context(
+            request_id=request.headers.get("X-Request-Id"),
+            trace_id=trace_id,
+            tenant=request.headers.get("X-PST-Tenant"),
+        )
+        try:
+            return await _generate_correlated(request, is_chat, trace_id)
+        finally:
+            unbind_log_context(log_token)
+
+    async def _generate_correlated(
+        request: web.Request, is_chat: bool, trace_id
+    ) -> web.StreamResponse:
         body = await request.json()
         state.requests_seen.append(body)
         budget = _deadline_budget_s(request)
@@ -428,15 +459,23 @@ def create_fake_engine_app(
             (body.get("stream_options") or {}).get("include_usage")
         )
         created = int(time.time())
+        logger.info(
+            "generation: model=%s stream=%s tokens=%s",
+            body.get("model"), bool(body.get("stream")),
+            body.get("max_tokens"),
+        )
         try:
             # Mirror the real engine's stage decomposition so mixed-workload
-            # e2e tests see engine-side pst_stage_duration_seconds labels.
+            # e2e tests see engine-side pst_stage_duration_seconds labels
+            # (with the propagated trace id as the bucket exemplar).
             observe_stage("engine", "engine_admission",
-                          time.monotonic() - t_admission)
+                          time.monotonic() - t_admission,
+                          trace_id=trace_id)
             t_prefill = time.monotonic()
             if ttft:
                 await asyncio.sleep(ttft)
-            observe_stage("engine", "prefill", time.monotonic() - t_prefill)
+            observe_stage("engine", "prefill", time.monotonic() - t_prefill,
+                          trace_id=trace_id)
             t_decode = time.monotonic()
             if stream:
                 resp = web.StreamResponse(status=200)
@@ -493,7 +532,9 @@ def create_fake_engine_app(
                     request.transport.close()
                     return resp
                 await resp.write(b"data: [DONE]\n\n")
-                observe_stage("engine", "decode", time.monotonic() - t_decode)
+                observe_stage("engine", "decode",
+                              time.monotonic() - t_decode,
+                              trace_id=trace_id)
                 await resp.write_eof()
                 return resp
             else:
@@ -531,7 +572,9 @@ def create_fake_engine_app(
                         ],
                         "usage": usage,
                     }
-                observe_stage("engine", "decode", time.monotonic() - t_decode)
+                observe_stage("engine", "decode",
+                              time.monotonic() - t_decode,
+                              trace_id=trace_id)
                 return web.json_response(
                     payload, headers={"X-Served-By": state.name, **echo}
                 )
@@ -586,6 +629,12 @@ def create_fake_engine_app(
                 f"pst_engine_kv_page_occupancy {state.kv_occupancy:.4f}",
                 "# TYPE pst_engine_kv_page_high_watermark gauge",
                 "pst_engine_kv_page_high_watermark 0.55",
+                "# TYPE pst_engine_host_gap_seconds histogram",
+                'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="0.001"} 5',
+                'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="0.005"} 8',
+                'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="+Inf"} 10',
+                'pst_engine_host_gap_seconds_sum{batch_bucket="b4"} 0.02',
+                'pst_engine_host_gap_seconds_count{batch_bucket="b4"} 10',
                 "# TYPE pst_engine_preemptions counter",
                 "pst_engine_preemptions_total 1",
                 "# TYPE pst_engine_swap_out counter",
@@ -653,6 +702,39 @@ def create_fake_engine_app(
             "reason": "no accelerator backend (fake engine) — nothing to "
                       "profile",
             "duration_ms": duration_ms,
+        })
+
+    async def debug_state(request: web.Request) -> web.Response:
+        """Deterministic engine introspection (docs/observability.md
+        "Fleet debugging"): the same KV/tenant/compile numbers the
+        /metrics surface exports, as one JSON object — what /debug/fleet
+        shows for this engine, straight from the source, so tests can
+        cross-validate the gossip-merged snapshot against engine truth."""
+        hit_rate = (
+            state.prefix_hits / state.prefix_queries
+            if state.prefix_queries else 0.0
+        )
+        return web.json_response({
+            "name": state.name,
+            "model": state.model,
+            # Same conjuncts as the real engine's readiness: sleeping is
+            # not ready (a contract test written against the fake must
+            # hold against the real engine too).
+            "ready": not (state.warming or state.draining or state.sleeping
+                          or state.fail_mode == "error"),
+            "draining": state.draining,
+            "warming": state.warming,
+            "sleeping": state.sleeping,
+            "in_flight": state.num_running,
+            "kv_occupancy": round(state.kv_occupancy, 4),
+            "kv_capacity_tokens": state.kv_capacity_tokens,
+            "cached_tokens": state.kv_tokens,
+            "prefix_hit_rate": round(hit_rate, 4),
+            # Matches the deterministic pst_engine_compile_total samples
+            # in /metrics (3 prefill + 2 decode).
+            "compiles_total": 5,
+            "tenants_seen": state.tenants_seen[-64:],
+            "requests_seen": len(state.requests_seen),
         })
 
     async def health(request: web.Request) -> web.Response:
@@ -865,6 +947,7 @@ def create_fake_engine_app(
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/state", debug_state)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/health", health)
     app.router.add_get("/ready", ready)
@@ -903,7 +986,15 @@ def main(argv: Optional[list] = None) -> None:
                    help="simulated KV capacity: occupancy and prefix-hit "
                         "eviction derive from it (small values make "
                         "cache-pressure effects visible in tests)")
+    p.add_argument("--log-format", choices=["text", "json"], default="text",
+                   help="'json' emits structured log lines enriched with "
+                        "the propagated trace/request/tenant ids (same "
+                        "contract as the real engine server)")
     args = p.parse_args(argv)
+    configure_logging(
+        args.log_format, component="engine",
+        engine_id=args.name or f"fake:{args.port}",
+    )
     app = create_fake_engine_app(
         args.model, args.speed, args.ttft, args.name,
         ready_delay=args.ready_delay, warmup_cache_dir=args.warmup_cache_dir,

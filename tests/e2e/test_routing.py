@@ -932,6 +932,186 @@ def leg_router_kill():
           f"{expected:.0f}; post-kill {admitted2} ≤ 1.1x {expected2:.0f})")
 
 
+
+
+def leg_fleet_observability():
+    """Fleet observability plane (docs/observability.md): one request
+    through a 2-replica gossip fleet produces the SAME trace id in the
+    router's JSON logs, the serving engine's JSON logs, a
+    pst_stage_duration_seconds exemplar (OpenMetrics negotiation only;
+    plain scrape byte-stays exemplar-free), and the /debug/requests
+    timeline; /debug/fleet from either replica lists every engine with
+    live KV/compile state; an engine SIGKILL is reflected in the
+    snapshot; pst-top --once --json renders the fleet."""
+    import tempfile
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs, log_files = [], {}
+
+    def spawn(name, args):
+        f = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"pst-obs-{name}-", suffix=".log", delete=False
+        )
+        p = subprocess.Popen(args, env=env, stdout=f, stderr=subprocess.STDOUT)
+        procs.append(p)
+        log_files[name] = f.name
+        return p
+
+    engine_ports = [free_port(), free_port()]
+    for i, port in enumerate(engine_ports):
+        spawn(f"engine-{i}", [
+            sys.executable, "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(port), "--model", MODEL, "--speed", "2000",
+            "--name", f"engine-{i}", "--log-format", "json",
+        ])
+    for port in engine_ports:
+        wait_http(f"http://127.0.0.1:{port}/health")
+
+    backends = ",".join(f"http://127.0.0.1:{p}" for p in engine_ports)
+    router_ports = [free_port(), free_port()]
+    for i, port in enumerate(router_ports):
+        peer = router_ports[1 - i]
+        spawn(f"router-{i}", [
+            sys.executable, "-m", "production_stack_tpu.router.app",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--service-discovery", "static",
+            "--static-backends", backends,
+            "--static-models", ",".join([MODEL] * 2),
+            "--routing-logic", "fleet",
+            "--engine-stats-interval", "0.3",
+            "--state-backend", "gossip",
+            "--state-peers", f"http://127.0.0.1:{peer}",
+            "--state-sync-interval", "0.2",
+            "--state-peer-timeout", "1.0",
+            "--state-replica-id", f"replica-{i}",
+            # Canary probes are the death detector: a SIGKILLed engine
+            # fails its next probe, the breaker opens, and the open state
+            # gossips into every replica's fleet snapshot.
+            "--canary-interval", "0.3",
+            "--canary-timeout", "1.0",
+            "--breaker-failure-threshold", "2",
+            "--log-format", "json",
+        ])
+    url_a, url_b = (f"http://127.0.0.1:{p}" for p in router_ports)
+    try:
+        for url in (url_a, url_b):
+            wait_http(f"{url}/health")
+            wait_http(f"{url}/ready")
+
+        status, served_by, body = post(
+            f"{url_a}/v1/completions",
+            {"model": MODEL, "prompt": "correlate me", "max_tokens": 3},
+        )
+        assert status == 200, body
+
+        # Trace id from the timeline (the request id rode the response).
+        with urllib.request.urlopen(f"{url_a}/debug/requests?limit=5") as r:
+            timelines = json.loads(r.read())["requests"]
+        assert timelines, "timeline missing from /debug/requests"
+        trace_id = timelines[0]["trace_id"]
+        request_id = timelines[0]["request_id"]
+
+        # OpenMetrics negotiation carries the exemplar; plain does not.
+        req = urllib.request.Request(
+            f"{url_a}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req) as r:
+            om = r.read().decode()
+        assert any(
+            "pst_stage_duration_seconds_bucket" in l and trace_id in l
+            for l in om.splitlines()
+        ), "stage exemplar missing from negotiated scrape"
+        with urllib.request.urlopen(f"{url_a}/metrics") as r:
+            plain = r.read().decode()
+        assert trace_id not in plain, "plain scrape must stay exemplar-free"
+
+        # JSON logs: the same trace id on a router line AND an engine line.
+        time.sleep(0.3)  # let stdout flush
+        def log_lines(name):
+            out = []
+            with open(log_files[name]) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+            return out
+
+        router_hits = [
+            l for l in log_lines("router-0")
+            if l.get("trace_id") == trace_id
+        ]
+        assert router_hits, "router JSON logs must carry the trace id"
+        assert router_hits[0]["component"] == "router"
+        assert router_hits[0]["request_id"] == request_id
+        assert router_hits[0]["replica_id"] == "replica-0"
+        engine_hits = [
+            l for name in ("engine-0", "engine-1")
+            for l in log_lines(name)
+            if l.get("trace_id") == trace_id
+        ]
+        assert engine_hits, "engine JSON logs must carry the trace id"
+        assert engine_hits[0]["component"] == "engine"
+
+        # /debug/fleet from EITHER replica lists both engines with live
+        # state (identical engine sets modulo sync lag).
+        snaps = []
+        for url in (url_a, url_b):
+            with urllib.request.urlopen(f"{url}/debug/fleet") as r:
+                snaps.append(json.loads(r.read()))
+        for snap in snaps:
+            assert len(snap["engines"]) == 2, snap["engines"].keys()
+            assert set(snap["replicas"]) == {"replica-0", "replica-1"}
+            for e in snap["engines"].values():
+                assert e["state"] == "ready"
+                assert "kv_occupancy" in e and "compiles_total" in e
+
+        # pst-top --once --json renders the same picture.
+        top = subprocess.run(
+            [sys.executable, "-m", "production_stack_tpu.obs.top",
+             "--router", url_b, "--once", "--json"],
+            env=env, stdout=subprocess.PIPE, timeout=30,
+        )
+        assert top.returncode == 0
+        assert len(json.loads(top.stdout)["engines"]) == 2
+
+        # Chaos: SIGKILL engine-1; the snapshot reflects it (breaker
+        # opens once traffic fails over) on BOTH replicas.
+        victim = f"http://127.0.0.1:{engine_ports[1]}"
+        procs[1].kill()
+        deadline = time.time() + 8.0
+        reflected = False
+        while time.time() < deadline and not reflected:
+            for _ in range(3):
+                try:
+                    post(f"{url_a}/v1/completions",
+                         {"model": MODEL, "prompt": "after kill",
+                          "max_tokens": 2})
+                except Exception:
+                    pass
+            try:
+                with urllib.request.urlopen(f"{url_b}/debug/fleet") as r:
+                    snap = json.loads(r.read())
+                ve = snap["engines"].get(victim)
+                reflected = ve is None or ve.get("breaker") != "closed"
+            except Exception:
+                pass
+            if not reflected:
+                time.sleep(0.3)
+        assert reflected, "engine SIGKILL never reached the fleet snapshot"
+        print("fleet_observability leg OK: correlation + snapshot + chaos")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 LEGS = {
     "roundrobin": leg_roundrobin,
     "session": leg_session,
@@ -944,6 +1124,7 @@ LEGS = {
     "router_kill": leg_router_kill,
     "deadline": leg_deadline,
     "tenant_flood": leg_tenant_flood,
+    "fleet_observability": leg_fleet_observability,
 }
 
 

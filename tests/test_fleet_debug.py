@@ -1,0 +1,632 @@
+"""Fleet observability plane (docs/observability.md).
+
+- Structured correlated logging: JSON field contract, retrofit of
+  existing loggers, hot-path sampling bounds + drop counter.
+- Trace exemplars: OpenMetrics negotiation carries them, plain
+  Prometheus exposition stays byte-identical.
+- ``GET /debug/fleet``: gossip-merged snapshot across two in-process
+  router replicas; an engine failure seen by one replica shows up in
+  the other replica's merged snapshot within one sync interval.
+- ``pst-top``: frame rendering and the ``--once --json`` CLI contract.
+- Correlation e2e (in-process): one request's trace id appears in the
+  router's JSON log line, the engine's JSON log line, a
+  ``pst_stage_duration_seconds`` exemplar, and ``/debug/requests``.
+"""
+
+import asyncio
+import json
+import logging
+import socket
+import sys
+import time
+import uuid
+
+import aiohttp
+import pytest
+from aiohttp import web
+from prometheus_client import generate_latest
+
+from production_stack_tpu import logging_utils
+from production_stack_tpu.obs import logging as obs_logging
+from production_stack_tpu.obs.logging import (
+    JsonLineFormatter,
+    _SamplingFilter,
+    bind_log_context,
+    configure_logging,
+    unbind_log_context,
+    update_log_context,
+)
+from production_stack_tpu.obs.metrics import (
+    OBS_REGISTRY,
+    observe_stage,
+    render_registries,
+    wants_openmetrics,
+)
+from production_stack_tpu.obs.top import fetch_snapshot, render_frame
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+from tests.router_utils import reset_router_singletons
+
+MODEL = "fake/model"
+
+
+@pytest.fixture(autouse=True)
+def _restore_log_profile():
+    yield
+    configure_logging("text")
+    obs_logging._IDENTITY.clear()
+
+
+# ---------------------------------------------------------------------------
+# Structured logging
+# ---------------------------------------------------------------------------
+
+
+def _format_record(msg="hello", level=logging.INFO, logger_name="pst.test"):
+    record = logging.LogRecord(
+        logger_name, level, __file__, 1, msg, None, None
+    )
+    return json.loads(JsonLineFormatter().format(record))
+
+
+def test_json_formatter_field_contract():
+    configure_logging("json", component="router", replica_id="r0")
+    token = bind_log_context(
+        trace_id="t" * 32, request_id="req-1", tenant="acme"
+    )
+    try:
+        out = _format_record("served")
+    finally:
+        unbind_log_context(token)
+    assert out["msg"] == "served"
+    assert out["level"] == "INFO"
+    assert out["logger"] == "pst.test"
+    assert isinstance(out["ts"], float)
+    assert out["component"] == "router"
+    assert out["replica_id"] == "r0"
+    assert out["trace_id"] == "t" * 32
+    assert out["request_id"] == "req-1"
+    assert out["tenant"] == "acme"
+
+
+def test_json_formatter_without_context_is_identity_only():
+    configure_logging("json", component="engine", engine_id="0.0.0.0:8000")
+    out = _format_record()
+    assert out["component"] == "engine"
+    assert out["engine_id"] == "0.0.0.0:8000"
+    assert "trace_id" not in out
+    assert "tenant" not in out
+
+
+def test_update_log_context_merges_for_later_fields():
+    token = bind_log_context(request_id="req-2")
+    try:
+        update_log_context(tenant="other")
+        out = _format_record()
+    finally:
+        unbind_log_context(token)
+    assert out["request_id"] == "req-2"
+    assert out["tenant"] == "other"
+
+
+def test_configure_logging_retrofits_existing_and_future_loggers():
+    before = logging_utils.init_logger(f"pst.retro.{uuid.uuid4().hex}")
+    configure_logging("json", component="router")
+    after = logging_utils.init_logger(f"pst.fresh.{uuid.uuid4().hex}")
+    for logger in (before, after):
+        assert all(
+            isinstance(h.formatter, JsonLineFormatter)
+            for h in logger.handlers
+        ), logger.name
+    configure_logging("text")
+    assert not any(
+        isinstance(h.formatter, JsonLineFormatter) for h in before.handlers
+    )
+
+
+def _drop_count(logger_name):
+    # The counter child's value, without scraping the whole registry.
+    return obs_logging.log_dropped_total.labels(
+        component=obs_logging._IDENTITY.get("component", "unknown"),
+        logger=logger_name,
+    )._value.get()
+
+
+def test_sampling_bounds_and_drop_counter():
+    configure_logging("json", component="router")
+    name = f"pst.hot.{uuid.uuid4().hex}"
+    filt = _SamplingFilter(rate=0.001, burst=10)
+    logger = logging.getLogger(name)
+    passed = []
+
+    class _Sink(logging.Handler):
+        def emit(self, record):
+            passed.append(record)
+
+    logger.addHandler(_Sink())
+    logger.addFilter(filt)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    before = _drop_count(name)
+    for i in range(100):
+        logger.info("hot %d", i)
+    # Burst 10 at a near-zero refill rate: exactly the burst passes.
+    assert len(passed) == 10
+    assert _drop_count(name) - before == 90
+    # WARNING+ is never sampled, even with the bucket dry.
+    logger.warning("must pass")
+    assert passed[-1].levelno == logging.WARNING
+    assert _drop_count(name) - before == 90
+
+
+# ---------------------------------------------------------------------------
+# Exemplars + exposition byte-compat
+# ---------------------------------------------------------------------------
+
+
+def test_exemplars_only_on_openmetrics_and_plain_bytecompat():
+    tid = uuid.uuid4().hex
+    observe_stage("router", "exemplar_test_stage", 0.012, trace_id=tid)
+    plain, ct = render_registries([OBS_REGISTRY])
+    assert ct == "text/plain"
+    # Byte-identical to the historical exposition: no exemplar residue.
+    assert plain == generate_latest(OBS_REGISTRY)
+    assert b"trace_id" not in plain
+    om, om_ct = render_registries(
+        [OBS_REGISTRY], accept="application/openmetrics-text"
+    )
+    assert "openmetrics" in om_ct
+    lines = [
+        l for l in om.decode().splitlines()
+        if "exemplar_test_stage" in l and tid in l
+    ]
+    assert lines, "stage bucket must carry the trace_id exemplar"
+    assert om.decode().count("# EOF") == 1
+
+
+def test_render_registries_collapses_eof_across_registries():
+    from prometheus_client import CollectorRegistry, Counter
+
+    r1, r2 = CollectorRegistry(), CollectorRegistry()
+    Counter("a_x", "d", registry=r1).inc()
+    Counter("b_x", "d", registry=r2).inc()
+    body, _ = render_registries(
+        [r1, r2], accept="application/openmetrics-text"
+    )
+    text = body.decode()
+    assert text.count("# EOF") == 1
+    assert text.rstrip().endswith("# EOF")
+    assert "a_x_total" in text and "b_x_total" in text
+
+
+def test_wants_openmetrics():
+    assert wants_openmetrics("application/openmetrics-text; version=1.0.0")
+    assert not wants_openmetrics("text/plain")
+    assert not wants_openmetrics(None)
+
+
+# ---------------------------------------------------------------------------
+# /debug/fleet across two gossiping replicas
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_site(app, port=0):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{bound}"
+
+
+class FleetCluster:
+    """One fake engine + two gossiping router replicas, in-process."""
+
+    def __init__(self, extra=None):
+        self.extra = extra or []
+        self.runners = []
+        self.apps = []
+        self.router_urls = []
+        self.engine_url = None
+        self.engine_runner = None
+
+    async def __aenter__(self):
+        engine_app = create_fake_engine_app(model=MODEL, speed=5000)
+        self.engine_runner, self.engine_url = await _start_site(engine_app)
+        ports = [_free_port(), _free_port()]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        for i, port in enumerate(ports):
+            argv = [
+                "--service-discovery", "static",
+                "--static-backends", self.engine_url,
+                "--static-models", MODEL,
+                "--routing-logic", "fleet",
+                "--engine-stats-interval", "0.2",
+                "--state-backend", "gossip",
+                "--state-peers",
+                ",".join(u for j, u in enumerate(urls) if j != i),
+                "--state-sync-interval", "0.1",
+                "--state-peer-timeout", "1.0",
+                "--state-replica-id", f"r{i}",
+                *self.extra,
+            ]
+            app = create_app(parse_args(argv))
+            runner, _ = await _start_site(app, port)
+            self.apps.append(app)
+            self.runners.append(runner)
+            self.router_urls.append(urls[i])
+        await asyncio.sleep(0.5)  # let gossip converge membership
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.engine_runner.cleanup()
+        for runner in reversed(self.runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+async def test_fleet_snapshot_merges_across_two_replicas():
+    async with FleetCluster() as c:
+        async with aiohttp.ClientSession() as s:
+            # Traffic through replica 0 only: the in-flight/tenant counts
+            # must still reach replica 1's merged snapshot via gossip.
+            for i in range(3):
+                async with s.post(
+                    f"{c.router_urls[0]}/v1/completions",
+                    json={"model": MODEL, "prompt": f"p{i}",
+                          "max_tokens": 2},
+                ) as resp:
+                    assert resp.status == 200
+                    await resp.read()
+            await asyncio.sleep(0.4)  # one sync interval + slack
+            snaps = []
+            for url in c.router_urls:
+                async with s.get(f"{url}/debug/fleet") as resp:
+                    assert resp.status == 200
+                    snaps.append(await resp.json())
+        for snap, rid in zip(snaps, ("r0", "r1")):
+            assert snap["replica"] == rid
+            assert set(snap["replicas"]) == {"r0", "r1"}
+            assert snap["replicas"][rid]["self"] is True
+            assert set(snap["engines"]) == {c.engine_url}
+            engine = snap["engines"][c.engine_url]
+            assert engine["state"] == "ready"
+            assert set(engine["in_flight_by_replica"]) == {"r0", "r1"}
+            assert engine["in_flight_total"] == sum(
+                engine["in_flight_by_replica"].values()
+            )
+            # Scraper warm-state fields rode into the snapshot.
+            assert engine["compiles_total"] == 5
+            assert engine["host_gap_p50_s"] == pytest.approx(0.001)
+            # Both replicas carry both replicas' routing views.
+            assert set(snap["routing"]) == {"r0", "r1"}
+            assert snap["routing"][rid]["policy"] == "FleetRouter"
+        # Identical engine content modulo sync lag: same keys and same
+        # freshest per-engine fields on both replicas.
+        e0 = {k: v for k, v in snaps[0]["engines"][c.engine_url].items()
+              if k != "in_flight_by_replica"}
+        e1 = {k: v for k, v in snaps[1]["engines"][c.engine_url].items()
+              if k != "in_flight_by_replica"}
+        assert set(e0) == set(e1)
+
+
+async def test_fleet_snapshot_reflects_engine_failure_via_gossip():
+    """An engine failure observed by replica 0 (its breaker opens) must
+    show in replica 1's merged snapshot within ~one sync interval, even
+    though replica 1 never sent the engine a request."""
+    async with FleetCluster(
+        extra=["--breaker-failure-threshold", "2", "--proxy-retries", "0"]
+    ) as c:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_url}/admin/fail",
+                json={"mode": "error", "count": -1},
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(3):
+                async with s.post(
+                    f"{c.router_urls[0]}/v1/completions",
+                    json={"model": MODEL, "prompt": "x", "max_tokens": 1},
+                ) as resp:
+                    await resp.read()
+            deadline = time.monotonic() + 3.0
+            breaker = None
+            while time.monotonic() < deadline:
+                async with s.get(
+                    f"{c.router_urls[1]}/debug/fleet"
+                ) as resp:
+                    snap = await resp.json()
+                breaker = snap["engines"][c.engine_url].get("breaker")
+                if breaker == "open":
+                    break
+                await asyncio.sleep(0.1)
+            assert breaker == "open", (
+                "replica 1's merged snapshot never learned replica 0's "
+                f"open breaker (last: {breaker})"
+            )
+
+
+async def test_debug_fleet_guarded_by_api_key():
+    engine_app = create_fake_engine_app(model=MODEL, speed=5000)
+    engine_runner, engine_url = await _start_site(engine_app)
+    app = create_app(parse_args([
+        "--service-discovery", "static",
+        "--static-backends", engine_url,
+        "--static-models", MODEL,
+        "--api-key", "sekrit",
+    ]))
+    runner, url = await _start_site(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/debug/fleet") as resp:
+                assert resp.status == 401
+            async with s.get(
+                f"{url}/debug/fleet",
+                headers={"Authorization": "Bearer sekrit"},
+            ) as resp:
+                assert resp.status == 200
+    finally:
+        await runner.cleanup()
+        await engine_runner.cleanup()
+        reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# pst-top
+# ---------------------------------------------------------------------------
+
+
+def test_render_frame_plain():
+    snap = {
+        "replica": "r0", "synced": True,
+        "replicas": {"r0": {"self": True, "sync_age_s": 0.0},
+                     "r1": {"self": False, "sync_age_s": 0.3}},
+        "engines": {"http://e0": {
+            "state": "ready", "breaker": "closed", "in_flight_total": 4,
+            "kv_occupancy": 0.5, "prefix_hit_rate": 0.9,
+            "canary_ttft_s": 0.012, "compiles_total": 7,
+            "host_gap_p50_s": 0.001,
+        }},
+        "routing": {"r0": {"policy": "FleetRouter", "session_pins": 2,
+                           "trie_nodes": 10, "spills_total": 1,
+                           "session_remaps_total": 0}},
+        "tenants": {"acme": {"tier": "interactive", "weight": 2.0,
+                             "queue_depth": 0, "admitted_total": 9,
+                             "sheds_total": 1}},
+    }
+    frame = render_frame(snap, color=False)
+    assert "http://e0" in frame
+    assert "ready" in frame
+    assert "FleetRouter" in frame
+    assert "acme" in frame
+    assert "\x1b[" not in frame  # --no-color means no ANSI
+
+
+async def test_pst_top_once_json_against_fake_fleet():
+    async with FleetCluster() as c:
+        # fetch_snapshot is blocking urllib: run it off the loop thread.
+        snap = await asyncio.to_thread(fetch_snapshot, c.router_urls[0])
+        assert set(snap["engines"]) == {c.engine_url}
+        # The CLI contract scripts/e2e rely on: --once --json prints the
+        # raw snapshot to stdout and exits 0.
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "production_stack_tpu.obs.top",
+            "--router", c.router_urls[1], "--once", "--json",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await asyncio.wait_for(proc.communicate(), timeout=30)
+        assert proc.returncode == 0, err.decode()
+        parsed = json.loads(out.decode())
+        assert parsed["replica"] == "r1"
+        assert c.engine_url in parsed["engines"]
+
+
+# ---------------------------------------------------------------------------
+# Correlation e2e (in-process): one trace id across logs, exemplar,
+# /debug/requests
+# ---------------------------------------------------------------------------
+
+
+class _JsonCapture(logging.Handler):
+    """Capture records formatted through the JSON formatter."""
+
+    def __init__(self):
+        super().__init__()
+        self.setFormatter(JsonLineFormatter())
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(json.loads(self.format(record)))
+
+
+async def test_correlation_one_trace_id_across_all_surfaces():
+    router_log = logging.getLogger(
+        "production_stack_tpu.router.services.request_service"
+    )
+    engine_log = logging.getLogger(
+        "production_stack_tpu.testing.fake_engine"
+    )
+    router_cap, engine_cap = _JsonCapture(), _JsonCapture()
+    router_log.addHandler(router_cap)
+    engine_log.addHandler(engine_cap)
+    # The per-request routing line is INFO only under the structured
+    # profile (text mode keeps it at DEBUG so existing deployments grow
+    # no unbounded access log); the autouse fixture restores text.
+    configure_logging("json", component="router")
+    try:
+        async with FleetCluster() as c:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{c.router_urls[0]}/v1/completions",
+                    json={"model": MODEL, "prompt": "correlate me",
+                          "max_tokens": 2},
+                ) as resp:
+                    assert resp.status == 200
+                    request_id = resp.headers["X-Request-Id"]
+                    echoed_tp = resp.headers.get("X-Echo-Traceparent")
+                    await resp.read()
+                # The timeline names the trace id for this request id.
+                async with s.get(
+                    f"{c.router_urls[0]}/debug/requests",
+                    params={"request_id": request_id},
+                ) as resp:
+                    timelines = (await resp.json())["requests"]
+                assert timelines, "request must appear in /debug/requests"
+                trace_id = timelines[0]["trace_id"]
+                # The engine saw the SAME trace id on the wire.
+                assert echoed_tp is not None and trace_id in echoed_tp
+                # ... and on a stage-histogram exemplar (OpenMetrics).
+                async with s.get(
+                    f"{c.router_urls[0]}/metrics",
+                    headers={"Accept": "application/openmetrics-text"},
+                ) as resp:
+                    om = await resp.text()
+                exemplar_lines = [
+                    l for l in om.splitlines()
+                    if "pst_stage_duration_seconds_bucket" in l
+                    and trace_id in l
+                ]
+                assert exemplar_lines, (
+                    "stage histogram must carry this trace's exemplar"
+                )
+                # Plain scrape: no exemplars leak.
+                async with s.get(f"{c.router_urls[0]}/metrics") as resp:
+                    plain = await resp.text()
+                assert trace_id not in plain
+        router_lines = [
+            l for l in router_cap.lines if l.get("trace_id") == trace_id
+        ]
+        engine_lines = [
+            l for l in engine_cap.lines if l.get("trace_id") == trace_id
+        ]
+        assert router_lines, "router JSON log must carry the trace id"
+        assert engine_lines, "engine JSON log must carry the trace id"
+        assert router_lines[0]["request_id"] == request_id
+        assert engine_lines[0]["request_id"] == request_id
+    finally:
+        router_log.removeHandler(router_cap)
+        engine_log.removeHandler(engine_cap)
+
+
+async def test_fake_engine_context_unbound_on_early_returns():
+    """A drained/warming/shed request must not leak its trace binding
+    into the NEXT request on the same keep-alive connection — aiohttp
+    serves them sequentially in one connection context."""
+    engine_log = logging.getLogger(
+        "production_stack_tpu.testing.fake_engine"
+    )
+    cap = _JsonCapture()
+    engine_log.addHandler(cap)
+    runner, url = await _start_site(
+        create_fake_engine_app(model=MODEL, speed=5000)
+    )
+    leaked_trace = "ab" * 16
+    tp = f"00-{leaked_trace}-{'cd' * 8}-01"
+    try:
+        # One connection, serial requests (limit=1 forces reuse).
+        connector = aiohttp.TCPConnector(limit=1)
+        async with aiohttp.ClientSession(connector=connector) as s:
+            async with s.post(f"{url}/drain") as resp:
+                assert resp.status == 200
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "a", "max_tokens": 1},
+                headers={"traceparent": tp, "X-Request-Id": "leaky"},
+            ) as resp:
+                assert resp.status == 503  # draining: early return path
+            async with s.post(f"{url}/undrain") as resp:
+                assert resp.status == 200
+            async with s.post(
+                f"{url}/v1/completions",
+                json={"model": MODEL, "prompt": "b", "max_tokens": 1},
+            ) as resp:
+                assert resp.status == 200
+                await resp.read()
+        gen_lines = [l for l in cap.lines if "generation" in l["msg"]]
+        assert gen_lines, "the served request must log its line"
+        assert gen_lines[-1].get("trace_id") != leaked_trace
+        assert gen_lines[-1].get("request_id") != "leaky"
+    finally:
+        engine_log.removeHandler(cap)
+        await runner.cleanup()
+
+
+def test_tenants_snapshot_sums_adhoc_population():
+    """The collapsed "other" row reports the SUM of all ad-hoc names'
+    queue depths, not whichever name the set iteration visited first."""
+    import asyncio as _asyncio
+
+    from production_stack_tpu.resilience.admission import (
+        AdmissionController,
+    )
+    from production_stack_tpu.resilience.tenancy import TenantConfig
+
+    cfg = TenantConfig(default_weight=1.0, default_tier="interactive")
+    ctrl = AdmissionController(rate=10.0, tenants=cfg)
+    loop = _asyncio.new_event_loop()
+    try:
+        spec1, spec2 = cfg.spec_for("x1"), cfg.spec_for("x2")
+        assert spec1.label == spec2.label == "other"
+        for _ in range(3):
+            ctrl._wfq.push(spec1.rank, "x1", loop.create_future())
+        ctrl._wfq.push(spec2.rank, "x2", loop.create_future())
+        snap = ctrl.tenants_snapshot()
+        assert snap["other"]["queue_depth"] == 4
+    finally:
+        ctrl._wfq.discard(lambda fut: True)
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# Tenant pane of the snapshot
+# ---------------------------------------------------------------------------
+
+
+async def test_fleet_snapshot_tenant_pane(tmp_path):
+    tenant_file = tmp_path / "tenants.json"
+    tenant_file.write_text(json.dumps({
+        "tenants": {"acme": {"weight": 2, "tier": "interactive"}}
+    }))
+    engine_app = create_fake_engine_app(model=MODEL, speed=5000)
+    engine_runner, engine_url = await _start_site(engine_app)
+    app = create_app(parse_args([
+        "--service-discovery", "static",
+        "--static-backends", engine_url,
+        "--static-models", MODEL,
+        "--tenant-isolation",
+        "--tenant-config", str(tenant_file),
+        "--admission-rate", "100",
+    ]))
+    runner, url = await _start_site(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(2):
+                async with s.post(
+                    f"{url}/v1/completions",
+                    json={"model": MODEL, "prompt": "hi", "max_tokens": 1},
+                    headers={"X-PST-Tenant": "acme"},
+                ) as resp:
+                    assert resp.status == 200
+                    await resp.read()
+            async with s.get(f"{url}/debug/fleet") as resp:
+                snap = await resp.json()
+        acme = snap["tenants"]["acme"]
+        assert acme["tier"] == "interactive"
+        assert acme["weight"] == 2.0
+        assert acme["admitted_total"] == 2
+        assert acme["sheds_total"] == 0
+        assert acme["queue_depth"] == 0
+    finally:
+        await runner.cleanup()
+        await engine_runner.cleanup()
+        reset_router_singletons()

@@ -76,6 +76,43 @@ def test_engine_stats_parses_engine_telemetry_names():
     assert abs(stats.engine_kv_page_high_watermark - 0.93) < 1e-9
 
 
+def test_engine_stats_parses_warm_state_fields():
+    """The /engines warm-state extension (docs/observability.md "Fleet
+    debugging"): warmup coverage passes through, and the host-gap p50 is
+    estimated from the histogram's cumulative buckets — summed across
+    batch_bucket label sets — as the smallest upper bound covering half
+    the observations."""
+    text = "\n".join(
+        [
+            "# TYPE pst_engine_warmup_coverage gauge",
+            "pst_engine_warmup_coverage 0.75",
+            "# TYPE pst_engine_host_gap_seconds histogram",
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="0.001"} 2',
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="0.005"} 4',
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b4",le="+Inf"} 5',
+            'pst_engine_host_gap_seconds_sum{batch_bucket="b4"} 0.02',
+            'pst_engine_host_gap_seconds_count{batch_bucket="b4"} 5',
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b8",le="0.001"} 1',
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b8",le="0.005"} 5',
+            'pst_engine_host_gap_seconds_bucket{batch_bucket="b8",le="+Inf"} 5',
+            'pst_engine_host_gap_seconds_sum{batch_bucket="b8"} 0.01',
+            'pst_engine_host_gap_seconds_count{batch_bucket="b8"} 5',
+            "",
+        ]
+    )
+    stats = EngineStats.from_scrape(text)
+    assert abs(stats.engine_warmup_coverage - 0.75) < 1e-9
+    # Summed buckets: le=0.001 -> 3, le=0.005 -> 9, +Inf -> 10; half of
+    # 10 observations is covered at le=0.005.
+    assert abs(stats.engine_host_gap_p50 - 0.005) < 1e-9
+
+
+def test_engine_stats_host_gap_absent_defaults_zero():
+    stats = EngineStats.from_scrape("vllm:num_requests_running 1\n")
+    assert stats.engine_host_gap_p50 == 0.0
+    assert stats.engine_warmup_coverage == 0.0
+
+
 @pytest.mark.parametrize("text", [
     "",                                         # empty scrape
     "complete garbage {{{ not prometheus",      # unparseable outright
