@@ -197,6 +197,26 @@ def run_engine_phase() -> dict:
     )
 
 
+def run_cost_phase() -> dict:
+    """Cost-attribution audit (benchmarks/bench_cost.py): per-request
+    device-seconds must sum to within 10% of the device-busy wall in
+    BOTH pipeline modes, and the heavy tenant must be billed more chip
+    time (docs/observability.md "Cost attribution"). Runs the tiny model
+    in a subprocess — the attribution math is share-exact and therefore
+    backend-independent, so this phase never needs the chip."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_cost.py")],
+        stdout=subprocess.PIPE,
+        text=True,
+        env=child_env(),
+        timeout=int(os.environ.get("PST_BENCH_COST_TIMEOUT", "600")),
+    )
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(f"cost phase failed (rc={proc.returncode})")
+    return json.loads(lines[-1])
+
+
 def ensure_port_free(port: int) -> None:
     import socket
 
@@ -833,7 +853,7 @@ def emit(out: dict) -> None:
         log(f"could not write {path}: {e}")
 
 
-def assemble(engine_res: dict, stack, fleet, tenants=None) -> dict:
+def assemble(engine_res: dict, stack, fleet, tenants=None, cost=None) -> dict:
     flag = engine_res.get("flagship", {})
     p50 = flag.get("p50_ttft_ms")
     return {
@@ -860,6 +880,7 @@ def assemble(engine_res: dict, stack, fleet, tenants=None) -> dict:
         "stack": stack,
         "fleet": fleet,
         "tenants": tenants,
+        "cost": cost,
     }
 
 
@@ -875,11 +896,16 @@ def parse_time_budget(argv) -> float:
 
 
 # Relative phase weights for budget carving (engine dominates: it pays
-# the XLA warmup; the three stack-side phases are fake-engine-cheap).
-_PHASE_WEIGHTS = {"engine": 6.0, "stack": 1.5, "fleet": 1.5, "tenants": 1.0}
+# the XLA warmup; the stack-side phases are fake-engine-cheap and the
+# cost audit runs the tiny model).
+_PHASE_WEIGHTS = {"engine": 6.0, "stack": 1.5, "fleet": 1.5, "tenants": 1.0,
+                  "cost": 0.5}
 
 
 def main() -> None:
+    # --all is accepted for driver ergonomics and is the default anyway:
+    # every phase (engine, stack, fleet, tenants, cost) runs unless its
+    # PST_BENCH_SKIP_* env is set.
     # --require-warm (or PST_BENCH_REQUIRE_WARM=1): the engine phase exits
     # nonzero when any measured sweep point absorbs a cold XLA compile, and
     # this process mirrors the verdict after emitting the full result.
@@ -963,8 +989,13 @@ def main() -> None:
     tenants = None
     if os.environ.get("PST_BENCH_SKIP_TENANTS") != "1":
         tenants = run_phase("tenants", run_tenant_phase)
+        emit(assemble(engine_res, stack, fleet, tenants))
 
-    emit(assemble(engine_res, stack, fleet, tenants))
+    cost = None
+    if os.environ.get("PST_BENCH_SKIP_COST") != "1":
+        cost = run_phase("cost", run_cost_phase)
+
+    emit(assemble(engine_res, stack, fleet, tenants, cost))
     # Same fallback as assemble(): a truncated engine phase may carry only
     # per-phase pollution flags, never the run-level verdict — the exit
     # gate must not be laxer than the emitted JSON.

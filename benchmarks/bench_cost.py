@@ -1,0 +1,155 @@
+"""Cost-attribution audit phase (bench.py `cost`): do per-request
+device-seconds sum to the device-busy wall?
+
+Runs a mixed interactive/batch two-tenant workload to completion on an
+in-process engine (tiny model — the attribution math is backend- and
+size-independent: shares are exact fractions of each measured dispatch
+wall), then checks the acceptance bar from docs/observability.md "Cost
+attribution":
+
+- sum of finished requests' attributed device-seconds covers >= 90 % of
+  ``ENGINE_TELEMETRY.device_busy_seconds()`` (and never exceeds 110 % —
+  over-attribution would mean double-counted pipeline walls);
+- ``pst_tenant_device_seconds_total`` splits the two tenants roughly by
+  the work offered (the heavy tenant is given ~3x the decode tokens).
+
+Prints ONE JSON object as its last stdout line (bench.py's contract).
+Runs BOTH pipeline modes: overlap_decode on (the default hot path,
+where double-counting would hide) and off (the parity reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(f"[bench-cost] {msg}", file=sys.stderr, flush=True)
+
+
+def run_mixed(overlap: bool) -> dict:
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.obs.engine_telemetry import ENGINE_TELEMETRY
+
+    ENGINE_TELEMETRY.reset_for_tests()
+    cfg = EngineConfig(
+        model="tiny-llama-debug",
+        max_model_len=512,
+        block_size=16,
+        num_kv_blocks=256,
+        max_num_seqs=16,
+        overlap_decode=overlap,
+        adaptive_decode_min_running=0,
+        adaptive_decode_quiet_s=0.0,
+        num_decode_steps=4 if overlap else 1,
+        cost_attribution=True,
+    )
+    eng = LLMEngine(cfg)
+
+    def drive(tag: str):
+        """One mixed two-tenant pass. acme (interactive) gets short
+        generations, batchcorp (batch tier) long ones — ~3x the decode
+        tokens, so the tenant meter must split visibly."""
+        per_tenant_tokens = {"acme": 0, "batchcorp": 0}
+        tenants = {}
+        # Batch is heavier on BOTH axes (longer prompts AND ~3x the
+        # decode tokens): its chip-time share must come out larger.
+        for i in range(6):
+            rid = f"{tag}-acme-{i}"
+            eng.add_request(
+                rid, prompt=f"interactive question {i}",
+                sampling=SamplingParams(max_tokens=6, temperature=0.0),
+                tenant="acme", tenant_class="interactive",
+            )
+            tenants[rid] = "acme"
+        for i in range(4):
+            rid = f"{tag}-batch-{i}"
+            eng.add_request(
+                rid, prompt=f"batch job {i} " * (3 * i + 4),
+                sampling=SamplingParams(max_tokens=27, temperature=0.0),
+                tenant="batchcorp", tenant_class="batch",
+            )
+            tenants[rid] = "batchcorp"
+        costs = {}
+        while eng.has_work():
+            for out in eng.step():
+                if out.finished and out.cost is not None:
+                    costs[out.request_id] = out.cost
+                    per_tenant_tokens[tenants[out.request_id]] += (
+                        out.num_output_tokens
+                    )
+        return costs, tenants, per_tenant_tokens
+
+    # Warm pass first: the measured pass must audit steady-state
+    # attribution, not which tenant happened to absorb the XLA compiles
+    # (the --require-warm discipline, in miniature).
+    drive("warm")
+    busy0 = ENGINE_TELEMETRY.device_busy_seconds()
+    t0 = time.perf_counter()
+    costs, tenants, per_tenant_tokens = drive("run")
+    wall = time.perf_counter() - t0
+
+    busy = ENGINE_TELEMETRY.device_busy_seconds() - busy0
+    attributed = sum(c["device_s"] for c in costs.values())
+    per_tenant_s = {"acme": 0.0, "batchcorp": 0.0}
+    for rid, c in costs.items():
+        per_tenant_s[tenants[rid]] += c["device_s"]
+    frac = attributed / busy if busy > 0 else 0.0
+    flight = eng.flight.stats()
+    return {
+        "mode": "overlap" if overlap else "unpipelined",
+        "requests": len(tenants),
+        "finished": len(costs),
+        "wall_s": round(wall, 3),
+        "device_busy_s": round(busy, 4),
+        "attributed_device_s": round(attributed, 4),
+        "attributed_fraction": round(frac, 4),
+        "tenant_device_s": {k: round(v, 4) for k, v in per_tenant_s.items()},
+        "tenant_tokens": per_tenant_tokens,
+        "flight_steps": flight["total_steps"],
+        "kv_page_s_total": round(
+            sum(c["kv_page_s"] for c in costs.values()), 3
+        ),
+    }
+
+
+def main() -> None:
+    results = {}
+    for overlap in (False, True):
+        mode = "overlap" if overlap else "unpipelined"
+        log(f"running mixed two-tenant workload ({mode})")
+        results[mode] = run_mixed(overlap)
+        log(
+            f"{mode}: attributed {results[mode]['attributed_fraction']:.3f} "
+            f"of {results[mode]['device_busy_s']:.3f}s device busy"
+        )
+    # Acceptance (docs/benchmarking.md "The cost phase"): coverage within
+    # [0.9, 1.1] in BOTH modes — under-coverage = unattributed device
+    # time, over-coverage = double-counted overlap shares — and the heavy
+    # tenant is billed more chip time than the light one.
+    fracs = [r["attributed_fraction"] for r in results.values()]
+    split_ok = all(
+        r["tenant_device_s"]["batchcorp"] > r["tenant_device_s"]["acme"]
+        for r in results.values()
+    )
+    out = {
+        **results,
+        "target_fraction": 0.9,
+        "meets_target": bool(
+            all(0.9 <= f <= 1.1 for f in fracs) and split_ok
+        ),
+        "tenant_split_ok": bool(split_ok),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -367,6 +367,35 @@ def fleet_dashboard():
     for t in stage_p99["targets"]:
         t["exemplar"] = True
     p.append(stage_p99)
+
+    # Row 15 — Capacity & cost (docs/observability.md "Capacity signals"
+    # / "Cost attribution"): the in-process autoscaler input
+    # (GET /autoscale/signal's gauge twins) and the chip-time billing
+    # meter. replica_hint vs ready engines is the "do we need more
+    # chips?" panel; tenant device-seconds is the bill.
+    p.append(panel("Capacity: saturation + replica hint", [
+        ('pst_capacity_saturation', "saturation"),
+        ('pst_capacity_replica_hint', "replica hint"),
+        ('pst_fleet_engines{state="ready"}', "ready engines"),
+    ], 0, 121))
+    p.append(panel("Capacity: in-process burn rate + queue slope", [
+        ('pst_capacity_burn_rate{window="5m"}', "burn 5m"),
+        ('pst_capacity_burn_rate{window="1h"}', "burn 1h"),
+        ('pst_capacity_queue_depth_slope', "queue slope /s"),
+        ('pst_capacity_kv_headroom', "kv headroom"),
+    ], 8, 121))
+    p.append(panel("Cost: tenant chip-seconds + request device time", [
+        ('sum(rate(pst_tenant_device_seconds_total[5m])) by (tenant)',
+         "{{tenant}} chip-s/s"),
+        ('histogram_quantile(0.9, sum(rate('
+         'pst_request_device_seconds_bucket[5m])) by (le, phase))',
+         "{{phase}} p90 device-s"),
+    ], 16, 121))
+    p.append(stat("Attribution coverage (5m)",
+                  'clamp_max(sum(rate(pst_request_device_seconds_sum[5m])) / '
+                  'clamp_min(sum(rate('
+                  'pst_engine_device_busy_seconds_total[5m])), 1e-9), 2)',
+                  0, 128, unit="percentunit"))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

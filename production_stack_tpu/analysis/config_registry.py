@@ -188,6 +188,14 @@ ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
           default_differs="CLI default 0 keeps probing off; the helm knob "
           "is gated on canary.enabled and then defaults to 15s"),
     _helm("--canary-timeout", "routerSpec.observability.canary.timeoutSeconds"),
+    ConfigSpec("--capacity-signal", HELM,
+               helm="routerSpec.observability.capacitySignal",
+               template=ROUTER_TEMPLATE, emit="--no-capacity-signal",
+               note="default-on: the template renders the negation when "
+               "observability.capacitySignal is false"),
+    ConfigSpec("--no-capacity-signal", TEMPLATE, template=ROUTER_TEMPLATE,
+               negation_of="--capacity-signal",
+               note="emitted when observability.capacitySignal is false"),
     _helm("--state-backend", "routerSpec.stateBackend.type", doc=_HA_DOC),
     _tpl("--state-peers",
          "rendered dns:// spec of the headless peer service", doc=_HA_DOC),
@@ -395,6 +403,11 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
                     "servingEngineSpec.warmup.bucketBudget"),
     EngineFieldSpec("compile_cache_dir", "--compile-cache-dir",
                     "servingEngineSpec.warmup.cacheDir"),
+    EngineFieldSpec("flight_buffer", "--flight-buffer",
+                    "servingEngineSpec.observability.flightBuffer"),
+    EngineFieldSpec("cost_attribution", "--cost-attribution",
+                    "servingEngineSpec.observability.costAttribution",
+                    emit="--no-cost-attribution"),
 )
 
 ROUTER_BY_FLAG: Dict[str, ConfigSpec] = {s.flag: s for s in ROUTER_FLAGS}

@@ -283,6 +283,21 @@ class AsyncLLMEngine:
                 self.last_step_time = time.time()
             except Exception as e:  # noqa: BLE001 — surface via /health
                 logger.exception("engine step failed")
+                # Post-mortem BEFORE teardown: freeze the flight ring with
+                # the failing step still at its tail (served at
+                # GET /debug/flight for as long as the pod lives, and in
+                # the log for after it doesn't).
+                try:
+                    snap = self.engine.flight.snapshot(
+                        "fatal", detail={"error": str(e)}
+                    )
+                    tail = snap["records"][-3:]
+                    logger.error(
+                        "flight snapshot (fatal): %d steps recorded, tail=%s",
+                        snap["total_steps"], tail,
+                    )
+                except Exception:  # noqa: BLE001 — never mask the real error
+                    pass
                 self.step_error = str(e)
                 with self._lock:
                     # Drain the scheduler so the loop doesn't spin hot on the

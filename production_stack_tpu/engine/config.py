@@ -182,6 +182,20 @@ class EngineConfig:
     # replacement pod on a PVC/hostPath mount) deserializes them instead
     # of paying the 46-138 s XLA cold start again. None = no persistence.
     compile_cache_dir: Optional[str] = None
+    # Flight recorder (docs/observability.md "Flight recorder"): always-on
+    # bounded ring of per-device-step records (kind, bucket, step wall,
+    # host gap, queue depths, KV occupancy, tier mix, compile events),
+    # served at GET /debug/flight and auto-snapshotted on tail outliers
+    # and SIGTERM/fatal. The value is the ring capacity in steps; 0
+    # disables recording (the endpoint then serves an empty ring).
+    flight_buffer: int = 512
+    # Per-request cost attribution (docs/observability.md "Cost
+    # attribution"): accumulate each request's prefill device-seconds,
+    # active-row share of decode-burst device-seconds, KV page-seconds
+    # and queue wait; surfaced as the X-PST-Cost response header + usage
+    # extension and the pst_request_device_seconds /
+    # pst_tenant_device_seconds metrics (chip-time billing).
+    cost_attribution: bool = True
 
 
 # Known per-chip HBM for backends whose memory_stats() is empty (the tunnel-

@@ -21,6 +21,7 @@ from ..kvcache.hashing import CHUNK_TOKENS
 from ..logging_utils import init_logger
 from ..models.registry import get_model_config
 from ..obs.engine_telemetry import ENGINE_TELEMETRY
+from ..obs.flight import NULL_FLIGHT_RECORDER, FlightRecorder
 from .config import EngineConfig
 from .kv_manager import BlockAllocator
 from .runner import ModelRunner
@@ -57,6 +58,11 @@ class RequestOutput:
     # as `compile` span events so a recompile shows up inside the victim
     # request's timeline (docs/observability.md "Engine telemetry").
     compile_events: Optional[List[dict]] = None
+    # Per-request cost attribution (finished outputs only, when
+    # cost_attribution is on): prefill/decode device-seconds, KV
+    # page-seconds, queue wait — the X-PST-Cost header / usage extension
+    # payload (docs/observability.md "Cost attribution").
+    cost: Optional[dict] = None
 
 
 class LLMEngine:
@@ -160,6 +166,21 @@ class LLMEngine:
         self._last_arrival = 0.0
         self.adaptive_deep_bursts_total = 0
         self.pipelined_bursts_total = 0
+        # Flight recorder (docs/observability.md "Flight recorder"):
+        # always-on bounded ring of per-step records, fed through
+        # ENGINE_TELEMETRY's dispatch path; this engine's scheduler/KV
+        # state rides each record via the probe closure. Attached last-
+        # wins: a fresh engine in one process must own the sink.
+        self.flight = (
+            FlightRecorder(cfg.flight_buffer)
+            if cfg.flight_buffer > 0 else NULL_FLIGHT_RECORDER
+        )
+        if self.flight.enabled:
+            # Only a live ring takes the probe: installing a bound method
+            # on the shared null singleton would pin this whole engine
+            # (params + KV) past its lifetime.
+            self.flight.set_probe(self._flight_probe)
+        ENGINE_TELEMETRY.attach_flight(self.flight)
         # Compile events awaiting an output-emitting step (see step()).
         self._pending_compile_events: List[dict] = []
         # Precompile summary (engine/precompile.py): populated by
@@ -187,6 +208,40 @@ class LLMEngine:
     @property
     def model_name(self) -> str:
         return self.cfg.served_model_name or self.model_cfg.name
+
+    def _flight_probe(self) -> dict:
+        """Scheduler/KV state attached to each flight record. Runs on the
+        step thread (the thread that mutates the scheduler), right after
+        a dispatch — plain reads, O(running)."""
+        waiting, running, swapped, batch = self.scheduler.flight_depths()
+        return {
+            "waiting": waiting,
+            "running": running,
+            "swapped": swapped,
+            "batch_tier_rows": batch,
+            "kv_occupancy": self.allocator.usage,
+            "preemptions": self.num_preempted_total,
+        }
+
+    def _finalize_cost(self, seq: Sequence) -> Optional[dict]:
+        """Close a request's cost account exactly once: integrate the KV
+        tail, export the per-phase histograms + tenant chip-time meter,
+        and return the X-PST-Cost payload."""
+        if not self.cfg.cost_attribution:
+            return None
+        if getattr(seq, "_cost_finalized", False):
+            return getattr(seq, "_cost_final", None)
+        now = time.monotonic()
+        # BEFORE the scheduler releases block_ids: the tail residency
+        # since the last charge point still belongs to this request.
+        seq.charge_kv_pages(now)
+        cost = seq.cost_snapshot(now)
+        seq._cost_finalized = True
+        seq._cost_final = cost
+        ENGINE_TELEMETRY.record_request_cost(
+            seq.tenant, seq.cost_prefill_s, seq.cost_decode_s
+        )
+        return cost
 
     # ------------------------------------------------------------------
     # Warmup precompilation (docs/engine.md "Warmup & precompilation")
@@ -301,6 +356,12 @@ class LLMEngine:
             self.lora_manager.release_slot(slot)
 
     def abort_request(self, request_id: str) -> bool:
+        # Bill the device time an aborted request already consumed (the
+        # tenant chip-time meter must not have a free-abort loophole),
+        # while its pages are still owned.
+        live = self._seqs.get(request_id)
+        if live is not None:
+            self._finalize_cost(live)
         if self.runner.burst_in_flight and any(
             s.request_id == request_id for s in self._burst_seqs
         ):
@@ -635,6 +696,8 @@ class LLMEngine:
                     num_prompt_tokens=seq.num_prompt_tokens,
                     num_output_tokens=len(seq.output_token_ids),
                     num_cached_prompt_tokens=seq.num_cached_prompt_tokens,
+                    # Shed work still consumed device time: bill it.
+                    cost=self._finalize_cost(seq),
                 )
             )
         return outs
@@ -851,6 +914,9 @@ class LLMEngine:
         )
         if finish_reason is not None:
             out.decode_time = now - seq.first_token_time
+            # Cost account closes while the pages are still owned (the
+            # scheduler releases them just below).
+            out.cost = self._finalize_cost(seq)
             if self.cfg.kv_role in ("producer", "both"):
                 sent = self._push_kv_to_remote(seq)
                 if sent:
@@ -931,6 +997,10 @@ class LLMEngine:
             "deadline_sheds_running_total": float(
                 self.scheduler.deadline_sheds_running
             ),
+            # Cost-attribution audit scalar (docs/observability.md "Cost
+            # attribution"): live-traffic device-busy wall; finished
+            # request costs must sum to >= 90% of this.
+            "device_busy_seconds_total": ENGINE_TELEMETRY.device_busy_seconds(),
         }
         if self.cfg.tenant_fairness:
             ages = self.scheduler.queue_age_by_tier()

@@ -413,6 +413,12 @@ class ModelRunner:
         )
         # Pipelined-burst state: device handles of the burst in flight.
         self._burst = None
+        # Per-request cost attribution (docs/observability.md "Cost
+        # attribution"): when on, every dispatch's measured wall is split
+        # across the sequences it served (token-weighted for prefill,
+        # active-row share for decode/verify) so request costs sum to the
+        # device-busy wall.
+        self._cost_enabled = bool(cfg.cost_attribution)
         # Host-gap accounting: perf_counter stamp of the moment the last
         # decode step's tokens became host-visible with the device idle
         # (pst_engine_host_gap_seconds measures from here to the next
@@ -747,6 +753,39 @@ class ModelRunner:
         shapes = tuple(sorted((k, np.shape(v)) for k, v in batch.items()))
         return (self._tel_scope, kind, shapes, extras)
 
+    # -- per-request cost attribution ------------------------------------
+
+    def _charge_decode(self, seqs: List[Sequence], seconds: float) -> None:
+        """Split one decode/verify dispatch's wall equally across its
+        ACTIVE rows (padding rows and already-finished pipeline members
+        cost nothing; shares sum to the step wall, so pipelined
+        continuations never double-count — each wall segment is charged
+        exactly once)."""
+        if not self._cost_enabled or seconds <= 0:
+            return
+        alive = [s for s in seqs if not s.is_finished]
+        if not alive:
+            return
+        share = seconds / len(alive)
+        now = time.monotonic()
+        for s in alive:
+            s.cost_decode_s += share
+            s.charge_kv_pages(now)
+
+    def _charge_prefill(self, items: List[PrefillItem], seconds: float) -> None:
+        """Split one prefill step's wall across its chunks by real-token
+        weight (a 2k-token chunk sharing a step with a 64-token one pays
+        accordingly)."""
+        if not self._cost_enabled or seconds <= 0 or not items:
+            return
+        total = sum(it.end - it.start for it in items)
+        if total <= 0:
+            return
+        now = time.monotonic()
+        for it in items:
+            it.seq.cost_prefill_s += seconds * (it.end - it.start) / total
+            it.seq.charge_kv_pages(now)
+
     # -- host-gap accounting (pst_engine_host_gap_seconds) ---------------
 
     def _host_gap_mark(
@@ -786,8 +825,10 @@ class ModelRunner:
         self._host_gap_mark(f"b{Bb}", t0, seqs)
         rows = self._run(batch, want_lp, greedy)
         self._host_gap_arm()
+        dt = time.perf_counter() - t0
+        self._charge_decode(seqs, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "decode", key, time.perf_counter() - t0,
+            "decode", key, dt,
             batch_bucket=f"b{Bb}", tokens=len(seqs),
             fill_ratio=len(seqs) / Bb,
         )
@@ -824,8 +865,10 @@ class ModelRunner:
                 batch, counts, n_steps, want_lp, greedy
             )
         self._host_gap_arm()
+        dt = time.perf_counter() - t0
+        self._charge_decode(seqs, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "decode", key, time.perf_counter() - t0,
+            "decode", key, dt,
             batch_bucket=f"b{Bb}xn{n_steps}", tokens=len(seqs) * n_steps,
             fill_ratio=len(seqs) / Bb,
         )
@@ -928,8 +971,10 @@ class ModelRunner:
                     "burst_start", (batch, counts, n_steps, want_lp, greedy)
                 )
             self._dispatch_burst_start(batch, counts, n_steps, want_lp, greedy)
+        dt = time.perf_counter() - t0
+        self._charge_decode(seqs, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "decode", key, time.perf_counter() - t0,
+            "decode", key, dt,
             batch_bucket=bucket, tokens=len(seqs) * n_steps,
             fill_ratio=len(seqs) / Bb,
         )
@@ -1007,9 +1052,14 @@ class ModelRunner:
             # state).
             ENGINE_TELEMETRY.record_host_gap(tel[1], 0.0)
             key, bucket, rows_b, n = tel
+            dt = time.perf_counter() - t0
+            # The continuation wall (dispatch next + overlapped fetch of
+            # the previous burst) is charged ONCE across the members still
+            # alive — the share of the just-fetched burst's device time.
+            self._charge_decode(members, dt)
             # pstlint: disable=recompile-risk(key and bucket are carried verbatim from burst_start's registered _tel_key via _burst_tel — a continuation re-dispatches the same executable, so the shape identity cannot drift)
             ENGINE_TELEMETRY.record_dispatch(
-                "decode", key, time.perf_counter() - t0,
+                "decode", key, dt,
                 batch_bucket=bucket, tokens=alive * n,
                 fill_ratio=alive / max(rows_b, 1),
             )
@@ -1079,8 +1129,10 @@ class ModelRunner:
             if self.publisher is not None:
                 self.publisher.announce("spec_verify", batch)
             ids, sampled0 = self._dispatch_spec_verify(batch)
+        dt = time.perf_counter() - t0
+        self._charge_decode(seqs, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "spec_verify", key, time.perf_counter() - t0,
+            "spec_verify", key, dt,
             batch_bucket=f"b{Bb}xk{K}", tokens=len(seqs) * (K + 1),
             fill_ratio=len(seqs) / Bb,
         )
@@ -1239,8 +1291,10 @@ class ModelRunner:
         t0 = time.perf_counter()
         self._host_gap_cancel()
         rows = self._run(batch, want_lp, greedy)
+        dt = time.perf_counter() - t0
+        self._charge_prefill(items, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "prefill", key, time.perf_counter() - t0,
+            "prefill", key, dt,
             batch_bucket=bucket, tokens=real, fill_ratio=fill,
         )
         return rows[: len(items)]
@@ -1265,8 +1319,10 @@ class ModelRunner:
             if self.publisher is not None:
                 self.publisher.announce("step_nofetch", batch)
             self._dispatch_step_nofetch(batch)
+        dt = time.perf_counter() - t0
+        self._charge_prefill(items, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "prefill", key, time.perf_counter() - t0,
+            "prefill", key, dt,
             batch_bucket=bucket, tokens=real, fill_ratio=fill,
         )
 
@@ -1298,8 +1354,10 @@ class ModelRunner:
             toks, self.kv_cache = self._step(
                 self.params, self.kv_cache, dev, want_lp, greedy
             )
+        dt = time.perf_counter() - t0
+        self._charge_prefill(items, dt)
         ENGINE_TELEMETRY.record_dispatch(
-            "prefill", key, time.perf_counter() - t0,
+            "prefill", key, dt,
             batch_bucket=bucket, tokens=real, fill_ratio=fill,
         )
         try:
@@ -1380,8 +1438,12 @@ class ModelRunner:
                        label: str) -> None:
         # tokens=0: warmup moves no real tokens, so the throughput window
         # and MFU stay honest; the compile itself is counted (it is one).
+        # count_busy=False: warmup serves no request, so it stays out of
+        # the device-busy denominator and the flight ring (a warmup pass
+        # would otherwise flood the ring with compile snapshots).
         ENGINE_TELEMETRY.record_dispatch(
-            kind, key, seconds, batch_bucket=label, tokens=0
+            kind, key, seconds, batch_bucket=label, tokens=0,
+            count_busy=False,
         )
 
     def _warmup_decode(self, bucket) -> None:

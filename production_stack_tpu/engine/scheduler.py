@@ -370,6 +370,16 @@ class Scheduler:
             self.swapped.append(best)
             self._admit_blocked = None  # free pages changed
 
+    def flight_depths(self) -> tuple:
+        """(waiting, running, swapped, batch_tier_rows) for the flight
+        recorder's per-step record (obs/flight.py). Called on the step
+        thread right after a dispatch — the same thread that mutates the
+        queues, so plain reads are safe; cost is O(running) over a list
+        bounded by max_num_seqs."""
+        running = self.running
+        batch = sum(1 for s in running if s.tier_rank)
+        return (len(self.waiting), len(running), len(self.swapped), batch)
+
     def queue_age_by_tier(self, now: Optional[float] = None) -> dict:
         """Oldest waiting sequence's queue age per tier (seconds) — the
         per-tenant starvation signal behind ``pst:tenant_queue_age_*``.

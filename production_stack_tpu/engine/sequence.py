@@ -163,6 +163,18 @@ class Sequence:
         # Admission-FIFO stamp across waiting+swapped (scheduler._admit).
         self.queue_stamp = 0
 
+        # Per-request cost attribution (docs/observability.md "Cost
+        # attribution"): device-seconds this request was charged — prefill
+        # steps charge a token-weighted share, decode bursts/spec verifies
+        # charge an active-row share (shares sum to the step wall, so a
+        # mixed run's request costs sum to the device-busy wall and
+        # pipelined continuations can never double-count). kv page-seconds
+        # integrate len(block_ids) over wall time between charge points.
+        self.cost_prefill_s = 0.0
+        self.cost_decode_s = 0.0
+        self.cost_kv_page_s = 0.0
+        self._kv_cost_mark: Optional[float] = None
+
     # -- lengths ----------------------------------------------------------
 
     @property
@@ -196,6 +208,36 @@ class Sequence:
         if self.deadline is None:
             return False
         return (now if now is not None else time.monotonic()) >= self.deadline
+
+    # -- cost attribution -------------------------------------------------
+
+    def charge_kv_pages(self, now: Optional[float] = None) -> None:
+        """Integrate KV residency since the last charge point:
+        ``kv_page_s += pages_held * elapsed``. Called at every step that
+        touches this sequence and once more at finish, so the integral
+        tracks page-count changes at step granularity."""
+        now = now if now is not None else time.monotonic()
+        mark = self._kv_cost_mark
+        if mark is not None and self.block_ids:
+            self.cost_kv_page_s += len(self.block_ids) * max(now - mark, 0.0)
+        self._kv_cost_mark = now
+
+    def cost_snapshot(self, now: Optional[float] = None) -> dict:
+        """The request's accumulated cost, for the ``X-PST-Cost`` header /
+        usage extension and the tenant chip-time meter."""
+        now = now if now is not None else time.monotonic()
+        queue_s = (
+            self.first_scheduled_time - self.arrival_time
+            if self.first_scheduled_time is not None
+            else now - self.arrival_time
+        )
+        return {
+            "prefill_device_s": round(self.cost_prefill_s, 6),
+            "decode_device_s": round(self.cost_decode_s, 6),
+            "device_s": round(self.cost_prefill_s + self.cost_decode_s, 6),
+            "kv_page_s": round(self.cost_kv_page_s, 3),
+            "queue_s": round(max(queue_s, 0.0), 6),
+        }
 
     # -- KV paging --------------------------------------------------------
 
@@ -252,6 +294,11 @@ class Sequence:
 
     def reset_for_recompute(self) -> None:
         """Preemption: KV pages were surrendered; recompute from scratch."""
+        # Close the KV cost clock: pages were charged up to the last
+        # dispatch, and the preempted gap holds ZERO pages — leaving the
+        # mark set would bill the post-recompute page count over the
+        # whole wait (systematic overcharge of preempted tenants).
+        self._kv_cost_mark = None
         self.block_ids = []
         self.num_computed_tokens = 0
         self.num_cached_prompt_tokens = 0

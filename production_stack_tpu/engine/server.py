@@ -884,6 +884,11 @@ def create_engine_app(
                             "completion_tokens": n_out,
                             "total_tokens": len(ids) + n_out,
                         }
+                        # Streams learn their cost only at the end — the
+                        # 200 headers are long gone, so the usage chunk
+                        # is the streaming cost surface.
+                        if out.cost is not None:
+                            chunk["usage"]["pst_cost"] = out.cost
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
             except (ConnectionResetError, asyncio.CancelledError):
@@ -944,6 +949,15 @@ def create_engine_app(
             "completion_tokens": len(result["token_ids"]),
             "total_tokens": len(ids) + len(result["token_ids"]),
         }
+        headers = {"X-Request-Id": rid}
+        cost = result.get("cost")
+        if cost is not None:
+            # Cost attribution (docs/observability.md "Cost attribution"):
+            # the request's device-seconds ride the response both as a
+            # header (proxied through the router untouched) and as a usage
+            # extension, so billing pipelines can consume either.
+            usage["pst_cost"] = cost
+            headers["X-PST-Cost"] = json.dumps(cost, separators=(",", ":"))
         metrics.e2e.observe(time.time() - start)
         metrics.success.inc()
         metrics.prompt_tokens.inc(len(ids))
@@ -955,7 +969,7 @@ def create_engine_app(
             "created": created, "model": req.model,
             "choices": [choice], "usage": usage,
         }
-        return web.json_response(payload, headers={"X-Request-Id": rid})
+        return web.json_response(payload, headers=headers)
 
     async def _collect(gen) -> dict:
         """Drain one generation stream into text/tokens/logprobs/finish
@@ -965,6 +979,7 @@ def create_engine_app(
         lp_entries: List[dict] = []
         compile_events: List[dict] = []
         finish_reason = None
+        cost = None
         queue_time = prefill_time = decode_time = None
         async for out in gen:
             if out.num_output_tokens == 1 and out.ttft is not None:
@@ -976,6 +991,7 @@ def create_engine_app(
             if out.compile_events:
                 compile_events.extend(out.compile_events)
             finish_reason = out.finish_reason or finish_reason
+            cost = out.cost if out.cost is not None else cost
             queue_time = out.queue_time if out.queue_time is not None else queue_time
             prefill_time = (
                 out.prefill_time if out.prefill_time is not None else prefill_time
@@ -988,6 +1004,7 @@ def create_engine_app(
             "logprobs": lp_entries, "finish_reason": finish_reason,
             "queue_time": queue_time, "prefill_time": prefill_time,
             "decode_time": decode_time, "compile_events": compile_events,
+            "cost": cost,
         }
 
     def _build_choice(req, result, index, is_chat, echo, prompt_ids) -> dict:
@@ -1396,11 +1413,30 @@ def create_engine_app(
             "sleeping": engine.sleeping,
             "in_flight": engine.num_inflight(),
             "compiles_total": ENGINE_TELEMETRY.compile_count(),
+            "flight": engine.engine.flight.stats(),
             "stats": {
                 k: v for k, v in stats.items()
                 if isinstance(v, (int, float, str, bool))
             },
         })
+
+    async def debug_flight(request: web.Request) -> web.Response:
+        """Flight-recorder dump (docs/observability.md "Flight
+        recorder"): the last-N per-step records (``?n=``) or a time
+        window (``?window_s=``), plus the retained auto-snapshots
+        (tail outliers, live compiles, fatal steps). Guarded like the
+        work endpoints when an API key is configured — step records
+        carry request ids and tenant mix."""
+        flight = engine.engine.flight
+        try:
+            n = int(request.query["n"]) if "n" in request.query else None
+            window_s = (
+                float(request.query["window_s"])
+                if "window_s" in request.query else None
+            )
+        except (TypeError, ValueError):
+            return _error("n and window_s must be numbers")
+        return web.json_response(flight.to_payload(n=n, window_s=window_s))
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": engine.sleeping})
@@ -1494,6 +1530,7 @@ def create_engine_app(
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/state", debug_state)
+    app.router.add_get("/debug/flight", debug_flight)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
@@ -1657,6 +1694,19 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                         "executables land in a subdirectory keyed on "
                         "model+mesh+dtype+code version so warm restarts "
                         "skip XLA entirely")
+    # Flight recorder + cost attribution (docs/observability.md "Flight
+    # recorder" / "Cost attribution").
+    p.add_argument("--flight-buffer", type=int, default=512,
+                   help="per-step flight-recorder ring capacity (GET "
+                        "/debug/flight; auto-snapshots on tail outliers "
+                        "and SIGTERM/fatal; 0 disables recording)")
+    p.add_argument("--cost-attribution", dest="cost_attribution",
+                   action="store_true", default=True)
+    p.add_argument("--no-cost-attribution", dest="cost_attribution",
+                   action="store_false",
+                   help="disable per-request device-seconds attribution "
+                        "(X-PST-Cost header, pst_request_device_seconds, "
+                        "pst_tenant_device_seconds)")
     return p.parse_args(argv)
 
 
@@ -1709,6 +1759,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         warmup=args.warmup,
         warmup_bucket_budget=args.warmup_bucket_budget,
         compile_cache_dir=args.compile_cache_dir,
+        flight_buffer=args.flight_buffer,
+        cost_attribution=args.cost_attribution,
     )
 
 
@@ -1820,6 +1872,18 @@ def main(argv=None) -> None:
         task = app.get("controller_task")
         if task:
             task.cancel()
+        # SIGTERM lands here via aiohttp's graceful shutdown: freeze the
+        # flight ring so the terminating pod leaves a post-mortem in its
+        # logs (the /debug/flight endpoint dies with the process).
+        try:
+            snap = engine.engine.flight.snapshot("sigterm")
+            if snap["records"]:
+                logger.info(
+                    "flight snapshot (sigterm): %d steps recorded, tail=%s",
+                    snap["total_steps"], snap["records"][-3:],
+                )
+        except Exception:  # noqa: BLE001 — shutdown must proceed
+            pass
         publisher = engine.engine.runner.publisher
         if publisher is not None:
             publisher.shutdown()  # release follower loops before exiting

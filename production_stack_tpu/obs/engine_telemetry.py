@@ -170,6 +170,36 @@ compile_cache_misses = Counter(
     "written for the next restart)",
     registry=ENGINE_TELEMETRY_REGISTRY,
 )
+# Per-request cost attribution (docs/observability.md "Cost attribution"):
+# each finished request's accumulated device-seconds, split by phase, and
+# the per-tenant chip-time meter that extends PR 12's token metering into
+# billing-grade chip-seconds.
+_REQUEST_DEVICE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0)
+request_device_seconds = Histogram(
+    "pst_request_device_seconds",
+    "Device-seconds attributed to one finished request, by phase: prefill "
+    "(token-weighted share of its prefill steps) or decode (active-row "
+    "share of its decode bursts/spec verifies)",
+    ["phase"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+    buckets=_REQUEST_DEVICE_BUCKETS,
+)
+tenant_device_seconds = Counter(
+    "pst_tenant_device_seconds",
+    "Device-seconds attributed to finished requests, per tenant — the "
+    "chip-time billing meter beside pst_tenant_usage_tokens",
+    ["tenant"],
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
+device_busy_seconds = Counter(
+    "pst_engine_device_busy_seconds",
+    "Cumulative wall the device spent executing live-traffic dispatches "
+    "(warmup precompilation excluded) — the denominator per-request cost "
+    "attribution is audited against (sum of request device-seconds must "
+    "cover >= 90% of this)",
+    registry=ENGINE_TELEMETRY_REGISTRY,
+)
 
 # Peak FLOPs per chip for the MFU denominator (public specs, bf16 MXU).
 _PEAK_FLOPS_BY_DEVICE_KIND = {
@@ -221,6 +251,14 @@ class EngineTelemetry:
         # histograms cannot answer "p50 at batch 8" locally, but the bench
         # and scripts/tpu_decode_profile.py --host-gap must.
         self._host_gap: Dict[str, "deque[float]"] = {}
+        # Flight-recorder sink (obs/flight.py): every live dispatch
+        # forwards one ring record; the null recorder makes this free.
+        from .flight import NULL_FLIGHT_RECORDER
+
+        self._flight = NULL_FLIGHT_RECORDER
+        # Live-traffic device-busy accumulator — the denominator the cost
+        # attribution audit (bench `cost` phase) sums request costs against.
+        self._device_busy_s = 0.0
         self.param_count = 0
         self.peak_flops = _DEFAULT_PEAK_FLOPS
         # --no-startup-phases: the gauges stay at 0 (helm
@@ -269,6 +307,43 @@ class EngineTelemetry:
         with self._lock:
             return self._cache_hits, self._cache_misses
 
+    # -- flight recorder / cost attribution ------------------------------
+
+    def attach_flight(self, recorder) -> None:
+        """Install the engine's flight recorder as the dispatch sink
+        (obs/flight.py). One recorder per engine; re-attachment replaces
+        (fresh engines in one process must not write a dead ring)."""
+        from .flight import NULL_FLIGHT_RECORDER
+
+        self._flight = recorder if recorder is not None else NULL_FLIGHT_RECORDER
+
+    @property
+    def flight(self):
+        return self._flight
+
+    def device_busy_seconds(self) -> float:
+        """Cumulative live-traffic dispatch wall since process start (or
+        the last reset) — warmup precompilation excluded."""
+        with self._lock:
+            return self._device_busy_s
+
+    def record_request_cost(
+        self, tenant: str, prefill_s: float, decode_s: float
+    ) -> None:
+        """One finished request's attributed device time → the per-phase
+        histograms and the per-tenant chip-time meter."""
+        prefill_s = max(prefill_s, 0.0)
+        decode_s = max(decode_s, 0.0)
+        if prefill_s > 0:
+            request_device_seconds.labels(phase="prefill").observe(prefill_s)
+        if decode_s > 0:
+            request_device_seconds.labels(phase="decode").observe(decode_s)
+        total = prefill_s + decode_s
+        if total > 0:
+            tenant_device_seconds.labels(
+                tenant=str(tenant or "default")[:64]
+            ).inc(total)
+
     # -- dispatch-level telemetry ---------------------------------------
 
     def record_dispatch(
@@ -280,9 +355,14 @@ class EngineTelemetry:
         batch_bucket: str,
         tokens: int = 0,
         fill_ratio: Optional[float] = None,
+        count_busy: bool = True,
     ) -> bool:
         """Record one device dispatch; returns True when this was the
-        first call for its shape bucket (i.e. it paid a compile)."""
+        first call for its shape bucket (i.e. it paid a compile).
+
+        ``count_busy=False`` marks warmup-precompile dispatches: they
+        compile real executables but serve no request, so they stay out
+        of the device-busy denominator and the flight ring."""
         seconds = max(seconds, 0.0)
         with self._lock:
             compiled = shape_key not in self._seen_shapes
@@ -298,6 +378,16 @@ class EngineTelemetry:
                 now = time.monotonic()
                 self._tok_samples.append((now, kind, tokens))
                 self._refresh_throughput_locked(now)
+            if count_busy:
+                self._device_busy_s += seconds
+        if count_busy:
+            device_busy_seconds.inc(seconds)
+            # Flight ring (obs/flight.py): one bounded record per live
+            # dispatch, with the scheduler/KV state the engine's probe
+            # supplies — the post-mortem trail for any step that stalls.
+            self._flight.record_step(
+                kind, batch_bucket, seconds, compiled=compiled, tokens=tokens
+            )
         if compiled:
             compile_total.labels(kind=kind, shape_bucket=batch_bucket).inc()
             compile_seconds.labels(kind=kind).observe(seconds)
@@ -336,6 +426,9 @@ class EngineTelemetry:
                     maxlen=self._HOST_GAP_SAMPLE_CAP
                 )
             dq.append(seconds)
+        # The gap closes AT the next decode dispatch: hand it to the
+        # flight ring so that dispatch's record carries it.
+        self._flight.note_host_gap(seconds)
         child = host_gap_seconds.labels(batch_bucket=batch_bucket)
         if request_id:
             child.observe(seconds, exemplar={"request_id": str(request_id)[:48]})
@@ -459,7 +552,11 @@ class EngineTelemetry:
             self._cache_hits = 0
             self._cache_misses = 0
             self._host_gap.clear()
+            self._device_busy_s = 0.0
             self.startup_enabled = True
+        from .flight import NULL_FLIGHT_RECORDER
+
+        self._flight = NULL_FLIGHT_RECORDER
 
 
 ENGINE_TELEMETRY = EngineTelemetry()

@@ -70,6 +70,12 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_engine_warmup_buckets", GAUGE, "obs/engine_telemetry.py"),
     MetricSpec("pst_engine_compile_cache_hits", COUNTER, "obs/engine_telemetry.py"),
     MetricSpec("pst_engine_compile_cache_misses", COUNTER, "obs/engine_telemetry.py"),
+    # Per-request cost attribution (docs/observability.md "Cost
+    # attribution"): device-seconds per finished request + the per-tenant
+    # chip-time billing meter and its audit denominator.
+    MetricSpec("pst_request_device_seconds", HISTOGRAM, "obs/engine_telemetry.py"),
+    MetricSpec("pst_tenant_device_seconds", COUNTER, "obs/engine_telemetry.py"),
+    MetricSpec("pst_engine_device_busy_seconds", COUNTER, "obs/engine_telemetry.py"),
     # --- resilience/metrics.py: breakers, deadlines, hedges, resume -----
     MetricSpec("pst_resilience_breaker_state", GAUGE, "resilience/metrics.py"),
     MetricSpec("pst_resilience_breaker_transitions_total", COUNTER, "resilience/metrics.py"),
@@ -122,6 +128,12 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_canary_failures", COUNTER, "router/services/metrics_service.py"),
     # --- router/services/fleet.py: fleet introspection plane ------------
     MetricSpec("pst_fleet_engines", GAUGE, "router/services/fleet.py"),
+    # --- router/services/capacity.py: autoscaler capacity signals -------
+    MetricSpec("pst_capacity_saturation", GAUGE, "router/services/capacity.py"),
+    MetricSpec("pst_capacity_burn_rate", GAUGE, "router/services/capacity.py"),
+    MetricSpec("pst_capacity_replica_hint", GAUGE, "router/services/capacity.py"),
+    MetricSpec("pst_capacity_queue_depth_slope", GAUGE, "router/services/capacity.py"),
+    MetricSpec("pst_capacity_kv_headroom", GAUGE, "router/services/capacity.py"),
 )
 
 BY_NAME: Dict[str, MetricSpec] = {s.name: s for s in REGISTRY}

@@ -44,6 +44,22 @@ def fetch_snapshot(
         return json.loads(resp.read().decode())
 
 
+def fetch_signal(
+    router: str, timeout: float = 5.0, api_key: Optional[str] = None
+) -> Optional[dict]:
+    """Best-effort GET /autoscale/signal for the cost/burn pane; None
+    when the router predates capacity signals or runs with
+    --no-capacity-signal (the fleet view still renders)."""
+    req = urllib.request.Request(router.rstrip("/") + "/autoscale/signal")
+    if api_key:
+        req.add_header("Authorization", f"Bearer {api_key}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
 def _fmt(value, spec: str = "", dash: str = "-") -> str:
     if value is None:
         return dash
@@ -63,7 +79,9 @@ def _phase_color(state: str, color: bool) -> str:
     return f"{tint}{state}{_RESET}"
 
 
-def render_frame(snap: dict, color: bool = True) -> str:
+def render_frame(
+    snap: dict, color: bool = True, signal: Optional[dict] = None
+) -> str:
     """One frame of the fleet view as a string (pure — tested directly)."""
     bold = _BOLD if color else ""
     dim = _DIM if color else ""
@@ -128,6 +146,32 @@ def render_frame(snap: dict, color: bool = True) -> str:
             f"spills={_fmt(r.get('spills_total'))} "
             f"remaps={_fmt(r.get('session_remaps_total'))}{reset}"
         )
+    if signal:
+        # Capacity / burn pane (GET /autoscale/signal): the SLO-burn and
+        # replica-hint view beside the engine table — the operator's
+        # "do we need more chips?" answer without a Grafana tab.
+        burn = signal.get("burn_rates") or {}
+        sat = signal.get("saturation")
+        tint = ""
+        if color:
+            tint = (
+                _RED if signal.get("page_burning")
+                else _YELLOW if (sat or 0) >= 0.5 else _GREEN
+            )
+        lines.append(
+            f"{bold}capacity{reset}  "
+            f"{tint}saturation={_fmt(sat, '.2f')}{reset} "
+            f"burn(5m/1h/6h)="
+            f"{_fmt(burn.get('5m'), '.2f')}/"
+            f"{_fmt(burn.get('1h'), '.2f')}/"
+            f"{_fmt(burn.get('6h'), '.2f')} "
+            f"queue={_fmt(signal.get('queue_depth'))}"
+            f"(slope {_fmt(signal.get('queue_depth_slope_per_s'), '+.2f')}/s) "
+            f"kv_headroom={_fmt(signal.get('kv_headroom'), '.2f')} "
+            f"ready={_fmt(signal.get('engines_ready'))} "
+            f"hint={tint}{_fmt(signal.get('replica_hint'))}{reset}"
+        )
+        lines.append("")
     if tenants:
         lines.append(bold + (
             f"{'TENANT':<16} {'TIER':<12} {'W':>5} {'QUEUE':>6} "
@@ -180,7 +224,8 @@ def main(argv=None) -> int:
         if args.as_json:
             print(json.dumps(snap, indent=2, sort_keys=True))
             return 0
-        frame = render_frame(snap, color=args.color)
+        signal = fetch_signal(args.router, api_key=args.api_key)
+        frame = render_frame(snap, color=args.color, signal=signal)
         if args.once:
             print(frame)
             return 0

@@ -469,6 +469,15 @@ def initialize_all(app: web.Application, args) -> None:
     # SLO counters (pst_slo_*) measure against this TTFT target; the canary
     # prober starts with the event loop in on_startup.
     metrics_service.configure_slo(getattr(args, "slo_ttft_ms", 0.0))
+    # Capacity signals (GET /autoscale/signal + pst_capacity_*): the
+    # in-process burn-rate/queue-slope/headroom monitor, fed by the same
+    # SLO events the counters export (docs/observability.md "Capacity
+    # signals").
+    from .services.capacity import initialize_capacity_monitor
+
+    initialize_capacity_monitor(
+        enabled=getattr(args, "capacity_signal", True)
+    )
     prober = initialize_canary_prober(
         getattr(args, "canary_interval", 0.0),
         timeout=getattr(args, "canary_timeout", 5.0),

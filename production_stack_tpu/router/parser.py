@@ -208,6 +208,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--canary-timeout", type=float, default=5.0,
                    help="per-probe timeout; a timed-out canary counts as "
                         "a failure")
+    # Capacity signals (docs/observability.md "Capacity signals"): the
+    # autoscaler input — multi-window SLO burn rate, admission-queue
+    # depth slope and gossip-merged fleet headroom at GET
+    # /autoscale/signal + pst_capacity_* gauges.
+    p.add_argument("--capacity-signal", dest="capacity_signal",
+                   action="store_true", default=True,
+                   help="serve GET /autoscale/signal (multi-window SLO "
+                        "burn rate, queue-depth slope, fleet KV headroom, "
+                        "replica hint) + pst_capacity_* gauges")
+    p.add_argument("--no-capacity-signal", dest="capacity_signal",
+                   action="store_false")
 
     # Router HA / replicated state (docs/router-ha.md): N router replicas
     # behave as one when they share routing state over the gossip backend.

@@ -329,6 +329,14 @@ async def metrics(request: web.Request) -> web.Response:
     # Fleet phase counts (pst_fleet_engines): the scalar twin of the
     # /debug/fleet JSON, refreshed from this replica's discovery view.
     fleet_service.refresh_fleet_gauges(endpoints)
+    # Capacity gauges (pst_capacity_*): recompute at scrape time so a
+    # plain Prometheus pipeline sees live burn/saturation/hint without
+    # anything polling /autoscale/signal.
+    from .services.capacity import compute_signal, get_capacity_monitor
+
+    cap_monitor = get_capacity_monitor()
+    if cap_monitor is not None:
+        compute_signal(cap_monitor, request.app)
     # Router-process resource usage.
     proc = psutil.Process()
     gauges.router_cpu_percent.set(proc.cpu_percent())
@@ -367,6 +375,28 @@ async def debug_requests(request: web.Request) -> web.Response:
             headers=error_headers(request),
         )
     return debug_requests_response(recorder, request)
+
+
+@routes.get("/autoscale/signal")
+async def autoscale_signal(request: web.Request) -> web.Response:
+    """Capacity signals (docs/observability.md "Capacity signals"): the
+    autoscaler input — multi-window SLO burn rate, admission-queue depth
+    + slope, gossip-merged fleet KV/compute headroom, and an absolute
+    ``replica_hint``. Scrapeable by KEDA's metrics-api scaler today
+    (docs/tutorials/21-keda-deep-dive.md); open like /metrics — it is
+    aggregate telemetry, not per-request data."""
+    from .services.capacity import compute_signal, get_capacity_monitor
+
+    monitor = get_capacity_monitor()
+    if monitor is None:
+        return web.json_response(
+            {"error": {"message": "capacity signals are disabled "
+                                  "(--no-capacity-signal)",
+                       "type": "not_found_error", "code": 404}},
+            status=404,
+            headers=error_headers(request),
+        )
+    return web.json_response(compute_signal(monitor, request.app))
 
 
 @routes.get("/debug/fleet")

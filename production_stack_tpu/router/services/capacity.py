@@ -1,0 +1,335 @@
+"""Capacity signals: the autoscaler input ROADMAP item 3 needs, computed
+in-process.
+
+``GET /autoscale/signal`` (docs/observability.md "Capacity signals")
+answers "how many replicas does this load actually need?" from the three
+signals the reference stack punts to external Prometheus rules:
+
+- **Multi-window SLO burn rate** — the PR 5 windows (5m/30m/1h/6h/3d)
+  and thresholds (page at 14.4x the error budget, ticket at 1x; mirrored
+  from ``observability/gen_dashboards.py``), computed over the SAME SLO
+  events ``pst_slo_*`` counts (``metrics_service.observe_slo_ttft``
+  feeds both), so the in-process rates and the Prometheus recorded
+  series describe one reality.
+- **Admission-queue depth + slope** — depth from the admission
+  controller, slope from a bounded sample ring: a rising queue at
+  constant offered load is the earliest saturation signal, well before
+  TTFT degrades.
+- **Fleet KV / compute headroom** — from the gossip-merged
+  ``/debug/fleet`` snapshot (PR 13), so every router replica serves the
+  same signal modulo one sync interval and KEDA can scrape any of them.
+
+The JSON is deliberately scaler-agnostic: ``saturation`` (0..1),
+per-window ``burn_rates``, ``replica_hint`` (an absolute engine-count
+suggestion) — consumable today by KEDA's ``metrics-api`` scaler
+(docs/tutorials/21-keda-deep-dive.md) without a Prometheus in the loop.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from prometheus_client import Gauge
+
+from ...logging_utils import init_logger
+from .. import appscope
+
+logger = init_logger(__name__)
+
+# The PR 5 SLO window set (observability/gen_dashboards.py and the
+# generated prometheus-rules.yaml use the same constants; the SRE-workbook
+# multi-window multi-burn-rate shape). Seconds per window label.
+BURN_WINDOWS: Tuple[Tuple[str, int], ...] = (
+    ("5m", 300),
+    ("30m", 1800),
+    ("1h", 3600),
+    ("6h", 21600),
+    ("3d", 259200),
+)
+# Mirrors gen_dashboards.SLO_OBJECTIVE (asserted equal in
+# tests/test_flight_cost.py so the two cannot drift).
+SLO_OBJECTIVE = 0.99
+SLO_ERROR_BUDGET = round(1.0 - SLO_OBJECTIVE, 6)
+# Burn-rate thresholds (multiples of the error budget): page = budget
+# gone in ~2 days, ticket = budget gone in 30 days.
+PAGE_BURN_RATE = 14.4
+TICKET_BURN_RATE = 1.0
+# The page alert fires on 1h AND 5m; in-process the short window is the
+# actionable one for scale-up (an autoscaler reacting on the 1h window
+# alone would be an hour late).
+_FAST_WINDOW = "5m"
+_SLOW_WINDOW = "1h"
+
+# Event-ring granularity: second-resolution buckets would hold 259200
+# entries for the 3d window; 30 s buckets keep it bounded (~8640) with
+# no visible loss at autoscaler timescales.
+_BUCKET_S = 30
+
+saturation_gauge = Gauge(
+    "pst_capacity_saturation",
+    "Composite fleet saturation in [0, 1]: max of KV occupancy, "
+    "normalized admission-queue pressure and normalized fast-window SLO "
+    "burn (1.0 = scale up now)",
+)
+burn_rate_gauge = Gauge(
+    "pst_capacity_burn_rate",
+    "Multi-window TTFT-SLO burn rate (error ratio over the error "
+    "budget), computed in-process over the same events pst_slo_* counts",
+    ["window"],
+)
+replica_hint_gauge = Gauge(
+    "pst_capacity_replica_hint",
+    "Suggested ready-engine count from burn rate + queue slope + "
+    "headroom — the /autoscale/signal scrape target for KEDA",
+)
+queue_slope_gauge = Gauge(
+    "pst_capacity_queue_depth_slope",
+    "Admission-queue depth slope (requests/second) over the sample "
+    "window — rising queue at constant load is the earliest saturation "
+    "signal",
+)
+kv_headroom_gauge = Gauge(
+    "pst_capacity_kv_headroom",
+    "Mean free-KV fraction across ready engines (gossip-merged view): "
+    "1.0 = empty fleet, 0.0 = every engine's pages are full",
+)
+
+
+class CapacityMonitor:
+    """In-process SLO-event windows + queue-depth samples → one signal.
+
+    Thread-safe: SLO events arrive from request handlers on the event
+    loop, but ``/metrics`` and tests may touch it from other threads;
+    the critical sections are tiny."""
+
+    _QUEUE_SAMPLES = 240  # bounded (t, depth) ring for the slope fit
+
+    def __init__(self, slo_objective: float = SLO_OBJECTIVE):
+        self.error_budget = max(1.0 - float(slo_objective), 1e-6)
+        self._lock = threading.Lock()
+        # bucket_start_ts -> [total, within]; trimmed past the longest
+        # window so memory is bounded by 3d / _BUCKET_S entries.
+        self._buckets: "Dict[int, list]" = {}
+        self._horizon = max(s for _, s in BURN_WINDOWS)
+        self._queue_samples: "deque[Tuple[float, int]]" = deque(
+            maxlen=self._QUEUE_SAMPLES
+        )
+
+    # -- event feeds -----------------------------------------------------
+
+    def observe(self, within: bool, now: Optional[float] = None) -> None:
+        """One SLO-counted request (the same event pst_slo_requests
+        counts): ``within`` = TTFT met the target."""
+        now = now if now is not None else time.time()
+        key = int(now // _BUCKET_S) * _BUCKET_S
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = [0, 0]
+                self._trim_locked(now)
+            b[0] += 1
+            if within:
+                b[1] += 1
+
+    def sample_queue_depth(self, depth: int, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        with self._lock:
+            # One sample per second at most: signal() polls can be rapid.
+            if self._queue_samples and now - self._queue_samples[-1][0] < 1.0:
+                self._queue_samples[-1] = (now, int(depth))
+            else:
+                self._queue_samples.append((now, int(depth)))
+
+    def _trim_locked(self, now: float) -> None:
+        cutoff = now - self._horizon - _BUCKET_S
+        for key in [k for k in self._buckets if k < cutoff]:
+            del self._buckets[key]
+
+    # -- derived signals -------------------------------------------------
+
+    def burn_rates(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Per-window error-ratio / error-budget, 0.0 when the window saw
+        no traffic (no requests = no budget burned)."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            items = list(self._buckets.items())
+        out: Dict[str, float] = {}
+        for label, seconds in BURN_WINDOWS:
+            cutoff = now - seconds
+            total = within = 0
+            for key, (t, w) in items:
+                if key >= cutoff - _BUCKET_S:
+                    total += t
+                    within += w
+            if total <= 0:
+                out[label] = 0.0
+            else:
+                error_ratio = (total - within) / total
+                out[label] = round(error_ratio / self.error_budget, 4)
+        return out
+
+    def queue_slope(self) -> float:
+        """Least-squares depth slope (requests/second) over the retained
+        samples; 0 with fewer than 3 samples or a degenerate time span."""
+        with self._lock:
+            pts = list(self._queue_samples)
+        if len(pts) < 3:
+            return 0.0
+        t0 = pts[0][0]
+        xs = [t - t0 for t, _ in pts]
+        ys = [d for _, d in pts]
+        n = len(pts)
+        sx, sy = sum(xs), sum(ys)
+        sxx = sum(x * x for x in xs)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        denom = n * sxx - sx * sx
+        if denom <= 1e-9:
+            return 0.0
+        return round((n * sxy - sx * sy) / denom, 4)
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self._queue_samples.clear()
+
+
+def _fleet_view(app) -> dict:
+    """Ready-engine count + KV statistics from the gossip-merged fleet
+    snapshot (every replica computes the same numbers modulo one sync
+    interval)."""
+    from .fleet import merged_fleet_snapshot
+
+    merged = merged_fleet_snapshot(app)
+    ready = 0
+    occupancies = []
+    in_flight = 0
+    for e in (merged.get("engines") or {}).values():
+        if not isinstance(e, dict):
+            continue
+        if e.get("state") == "ready" and e.get("breaker") != "open":
+            ready += 1
+            occ = e.get("kv_occupancy")
+            if isinstance(occ, (int, float)):
+                occupancies.append(min(max(float(occ), 0.0), 1.0))
+        in_flight += int(e.get("in_flight_total") or e.get("in_flight") or 0)
+    kv_mean = sum(occupancies) / len(occupancies) if occupancies else 0.0
+    kv_max = max(occupancies) if occupancies else 0.0
+    return {
+        "engines_total": len(merged.get("engines") or {}),
+        "engines_ready": ready,
+        "kv_occupancy_mean": round(kv_mean, 4),
+        "kv_occupancy_max": round(kv_max, 4),
+        "kv_headroom": round(1.0 - kv_mean, 4),
+        "in_flight_total": in_flight,
+        "replicas": len(merged.get("replicas") or {}) or 1,
+    }
+
+
+def compute_signal(monitor: CapacityMonitor, app=None) -> dict:
+    """The ``GET /autoscale/signal`` payload (and the pst_capacity_*
+    gauge refresh). Pure derivation — no I/O beyond the in-memory gossip
+    view, so scraping it is as cheap as /metrics."""
+    from ...resilience import get_admission_controller
+
+    now = time.time()
+    burn = monitor.burn_rates(now)
+    controller = None
+    try:
+        controller = get_admission_controller()
+    except Exception:  # noqa: BLE001 — resilience not initialized (tests)
+        controller = None
+    queue_depth = 0
+    queue_capacity = 0
+    if controller is not None and getattr(controller, "enabled", False):
+        queue_depth = controller.queue_len()
+        queue_capacity = int(getattr(controller, "max_queue", 0) or 0)
+    monitor.sample_queue_depth(queue_depth, now)
+    slope = monitor.queue_slope()
+    fleet = _fleet_view(app)
+
+    fast_burn = burn.get(_FAST_WINDOW, 0.0)
+    slow_burn = burn.get(_SLOW_WINDOW, 0.0)
+    queue_pressure = (
+        min(queue_depth / queue_capacity, 1.0) if queue_capacity > 0 else 0.0
+    )
+    saturation = round(
+        max(
+            fleet["kv_occupancy_max"],
+            queue_pressure,
+            min(fast_burn / PAGE_BURN_RATE, 1.0),
+        ),
+        4,
+    )
+
+    # Replica hint: an ABSOLUTE ready-engine suggestion, monotone in the
+    # burn/queue evidence. Conservative on scale-down (only when the
+    # fleet is provably idle) — flapping replicas cost warmup time.
+    current = max(fleet["engines_ready"], 1)
+    # The SRE-workbook multi-window rule the generated alert encodes:
+    # page only when the fast AND slow windows both burn past threshold
+    # (the 1h window is a superset of the 5m one, so a genuine page-rate
+    # burn reaches both quickly; a diluted 1h rate correctly vetoes).
+    page_burning = (
+        fast_burn >= PAGE_BURN_RATE and slow_burn >= PAGE_BURN_RATE
+    )
+    if page_burning:
+        # Budget gone in ~2 days at this rate: grow by half the fleet
+        # (at least one), same spirit as HPA's proportional response.
+        hint = current + max(1, math.ceil(current * 0.5))
+    elif fast_burn >= TICKET_BURN_RATE and slope > 0:
+        hint = current + 1
+    elif queue_pressure >= 0.5 or (slope > 0 and queue_depth > 2 * current):
+        hint = current + 1
+    elif (
+        saturation < 0.25
+        and fast_burn < TICKET_BURN_RATE
+        and slope <= 0
+        and fleet["engines_ready"] > 1
+    ):
+        hint = current - 1
+    else:
+        hint = current
+
+    signal = {
+        "ts": now,
+        "slo_objective": SLO_OBJECTIVE,
+        "error_budget": SLO_ERROR_BUDGET,
+        "burn_rates": burn,
+        "page_burn_rate": PAGE_BURN_RATE,
+        "ticket_burn_rate": TICKET_BURN_RATE,
+        "page_burning": bool(page_burning),
+        "queue_depth": queue_depth,
+        "queue_capacity": queue_capacity,
+        "queue_depth_slope_per_s": slope,
+        "saturation": saturation,
+        "replica_hint": hint,
+        **fleet,
+    }
+    # Gauge twins so a plain Prometheus pipeline (or the dashboards' new
+    # Capacity row) sees the same numbers the JSON serves.
+    saturation_gauge.set(saturation)
+    for window, rate in burn.items():
+        burn_rate_gauge.labels(window=window).set(rate)
+    replica_hint_gauge.set(hint)
+    queue_slope_gauge.set(slope)
+    kv_headroom_gauge.set(fleet["kv_headroom"])
+    return signal
+
+
+# -- app-scoped lifecycle (router/appscope.py) ---------------------------
+
+_SCOPE_KEY = "capacity_monitor"
+
+
+def initialize_capacity_monitor(enabled: bool = True) -> Optional[CapacityMonitor]:
+    monitor = CapacityMonitor() if enabled else None
+    appscope.scoped_set(_SCOPE_KEY, monitor)
+    return monitor
+
+
+def get_capacity_monitor() -> Optional[CapacityMonitor]:
+    return appscope.scoped_get(_SCOPE_KEY)

@@ -138,6 +138,7 @@ def observe_slo_ttft(
     ex = {"trace_id": trace_id} if trace_id else None
     slo_requests_total.labels(model=m).inc(exemplar=ex)
     within = seconds <= target
+    _feed_capacity(within)
     if within:
         slo_ttft_within_target_total.labels(model=m).inc(exemplar=ex)
     if tenant:
@@ -156,5 +157,18 @@ def observe_slo_failure(
     if slo_ttft_target_s() is None:
         return
     slo_requests_total.labels(model=str(model) if model else "unknown").inc()
+    _feed_capacity(False)
     if tenant:
         tenant_slo_requests_total.labels(tenant=tenant).inc()
+
+
+def _feed_capacity(within: bool) -> None:
+    """Mirror every SLO-counted event into the capacity monitor
+    (docs/observability.md "Capacity signals"): the in-process burn rates
+    /autoscale/signal serves are computed over EXACTLY the events the
+    pst_slo_* counters export, so the two surfaces cannot diverge."""
+    from .capacity import get_capacity_monitor
+
+    monitor = get_capacity_monitor()
+    if monitor is not None:
+        monitor.observe(within)

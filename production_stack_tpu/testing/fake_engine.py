@@ -138,6 +138,14 @@ class FakeEngineState:
         # arrival order — lets tests assert the router's tenant stamp
         # reached the engine on every hop.
         self.tenants_seen: List[dict] = []
+        # Deterministic flight-recorder ring (the real engine's
+        # GET /debug/flight contract, docs/observability.md "Flight
+        # recorder"): every generation appends one prefill + one decode
+        # record with values derived from the request, so router-side
+        # flight/capacity tests run engine-free and byte-reproducibly.
+        self.flight_records: List[dict] = []
+        self.flight_capacity = 128
+        self.flight_total = 0
         # Simulated warmup precompilation (the real engine's /ready
         # contract): the engine reports warming for ``ready_delay``
         # seconds after start. With a ``warmup_cache_dir``, a marker file
@@ -230,6 +238,51 @@ class FakeEngineState:
         live = self.kv_tokens + self.num_running * KV_RUNNING_TOKENS
         derived = min(live / self.kv_capacity_tokens, 1.0)
         return max(derived, min(max(self.kv_fill_floor, 0.0), 1.0))
+
+    def fake_cost(self, prompt_tokens: int, n_tokens: int) -> dict:
+        """Deterministic X-PST-Cost payload: the real engine's field set
+        with values derived purely from token counts, so router/billing
+        tests assert exact numbers."""
+        prefill = round(prompt_tokens * 1e-4, 6)
+        decode = round(n_tokens * 1e-3, 6)
+        return {
+            "prefill_device_s": prefill,
+            "decode_device_s": decode,
+            "device_s": round(prefill + decode, 6),
+            "kv_page_s": round((prompt_tokens + n_tokens) * 0.01, 3),
+            "queue_s": 0.0,
+        }
+
+    def record_flight(self, prompt_tokens: int, n_tokens: int) -> None:
+        """Two deterministic ring records per generation (the prefill
+        step and its decode burst), same field set as obs/flight.py."""
+        base = {
+            "ts": time.time(),
+            "host_gap_s": 0.0005,
+            "compiled": False,
+            "waiting": self.num_waiting,
+            "running": self.num_running,
+            "swapped": 0,
+            "kv_occupancy": round(self.kv_occupancy, 4),
+            "preemptions": 0,
+            "batch_tier_rows": 0,
+        }
+        self.flight_records.append({
+            **base, "kind": "prefill",
+            "bucket": f"b1xt{max(prompt_tokens, 1)}",
+            "device_s": round(prompt_tokens * 1e-4, 6),
+            "tokens": prompt_tokens,
+        })
+        self.flight_records.append({
+            **base, "kind": "decode",
+            "bucket": f"b{max(self.num_running, 1)}xn{max(n_tokens, 1)}",
+            "device_s": round(n_tokens * 1e-3, 6),
+            "tokens": n_tokens,
+        })
+        self.flight_total += 2
+        if len(self.flight_records) > self.flight_capacity:
+            del self.flight_records[: len(self.flight_records)
+                                    - self.flight_capacity]
 
     def take_fault(self, tenant: Optional[str] = None) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None.
@@ -458,6 +511,12 @@ def create_fake_engine_app(
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage")
         )
+        # Deterministic cost attribution + flight records (the real
+        # engine's contract; docs/observability.md). The fake knows its
+        # whole output upfront, so streams carry the header too.
+        cost = state.fake_cost(prompt_tokens, n_tokens)
+        cost_header = {"X-PST-Cost": json.dumps(cost, separators=(",", ":"))}
+        state.record_flight(prompt_tokens, n_tokens)
         created = int(time.time())
         logger.info(
             "generation: model=%s stream=%s tokens=%s",
@@ -481,6 +540,7 @@ def create_fake_engine_app(
                 resp = web.StreamResponse(status=200)
                 resp.headers["Content-Type"] = "text/event-stream"
                 resp.headers["X-Served-By"] = state.name
+                resp.headers.update(cost_header)
                 for k, v in echo.items():
                     resp.headers[k] = v
                 await resp.prepare(request)
@@ -517,6 +577,12 @@ def create_fake_engine_app(
                                  "finish_reason": finish}
                             ],
                         }
+                    # No pst_cost in the streamed usage chunk: the
+                    # router's stream journal merges cross-leg usage down
+                    # to the three OpenAI fields, so a resumed stream
+                    # must byte-match an unfaulted one — the fake's
+                    # streaming cost surface is the X-PST-Cost header
+                    # (deterministic, so it CAN ride the 200 headers).
                     if final and include_usage:
                         chunk["usage"] = {
                             "prompt_tokens": prompt_tokens,
@@ -545,6 +611,7 @@ def create_fake_engine_app(
                     "prompt_tokens": prompt_tokens,
                     "completion_tokens": n_tokens,
                     "total_tokens": prompt_tokens + n_tokens,
+                    "pst_cost": cost,
                 }
                 if is_chat:
                     payload = {
@@ -576,7 +643,8 @@ def create_fake_engine_app(
                               time.monotonic() - t_decode,
                               trace_id=trace_id)
                 return web.json_response(
-                    payload, headers={"X-Served-By": state.name, **echo}
+                    payload,
+                    headers={"X-Served-By": state.name, **cost_header, **echo},
                 )
         finally:
             state.num_running -= 1
@@ -733,8 +801,47 @@ def create_fake_engine_app(
             # Matches the deterministic pst_engine_compile_total samples
             # in /metrics (3 prefill + 2 decode).
             "compiles_total": 5,
+            "flight": {
+                "capacity": state.flight_capacity,
+                "total_steps": state.flight_total,
+                "resident": len(state.flight_records),
+                "snapshots": 0,
+            },
             "tenants_seen": state.tenants_seen[-64:],
             "requests_seen": len(state.requests_seen),
+        })
+
+    async def debug_flight(request: web.Request) -> web.Response:
+        """Deterministic flight-recorder ring (the real engine's
+        GET /debug/flight shape): two records per generation served, so
+        router-side capacity/cost tests assert exact contents without a
+        TPU. Supports the same ``?n=`` / ``?window_s=`` filters."""
+        records = list(state.flight_records)
+        try:
+            if "window_s" in request.query:
+                cutoff = time.time() - float(request.query["window_s"])
+                records = [r for r in records if r["ts"] >= cutoff]
+            if "n" in request.query:
+                n = int(request.query["n"])
+                if n > 0:
+                    records = records[-n:]
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "n and window_s must be numbers",
+                           "type": "invalid_request_error", "code": 400}},
+                status=400,
+            )
+        return web.json_response({
+            "capacity": state.flight_capacity,
+            "total_steps": state.flight_total,
+            "resident": len(state.flight_records),
+            "fields": [
+                "ts", "kind", "bucket", "device_s", "host_gap_s",
+                "compiled", "waiting", "running", "swapped",
+                "kv_occupancy", "preemptions", "batch_tier_rows", "tokens",
+            ],
+            "records": records,
+            "snapshot_log": [],
         })
 
     async def health(request: web.Request) -> web.Response:
@@ -948,6 +1055,7 @@ def create_fake_engine_app(
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/state", debug_state)
+    app.router.add_get("/debug/flight", debug_flight)
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/health", health)
     app.router.add_get("/ready", ready)

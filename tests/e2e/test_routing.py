@@ -522,6 +522,86 @@ def leg_tenant_flood():
           f"{int(flooder_sheds)} flooder sheds, 0 victim sheds)")
 
 
+def leg_capacity():
+    """Capacity-signal leg (docs/observability.md "Capacity signals"):
+    the REAL router under a load step-up. Baseline fast traffic keeps
+    the multi-window burn rate at 0 and the replica hint at the ready
+    count; then every engine turns slow (injected latency far past the
+    TTFT objective), the 5m burn rate crosses the page threshold
+    (14.4x the error budget) and the replica hint rises — exactly the
+    signal a KEDA metrics-api scaler would act on."""
+
+    def get_json(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    with Fleet("roundrobin",
+               router_args=["--slo-ttft-ms", "40",
+                            "--admission-rate", "200",
+                            "--proxy-retries", "0",
+                            "--breaker-failure-threshold", "50"]) as f:
+        # Phase 1: fast traffic well inside the 40ms objective.
+        for i in range(20):
+            status, _, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"fast {i}", "max_tokens": 2},
+            )
+            assert status == 200, status
+        base = get_json(f"{f.url}/autoscale/signal")
+        assert base["burn_rates"]["5m"] == 0.0, base["burn_rates"]
+        assert base["page_burning"] is False
+        assert base["engines_ready"] == N_ENGINES
+        base_hint = base["replica_hint"]
+        assert base_hint <= N_ENGINES, base
+
+        # Phase 2: load step-up into a slow fleet — every engine injects
+        # 300ms (>> the 40ms objective), so every request burns budget.
+        for port in f.engine_ports:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/admin/fail",
+                data=json.dumps({"mode": "slow", "delay": 0.3,
+                                 "count": -1}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+        for i in range(30):
+            status, _, _ = post(
+                f"{f.url}/v1/completions",
+                {"model": MODEL, "prompt": f"slow {i}", "max_tokens": 2},
+            )
+            assert status == 200, status
+        burned = get_json(f"{f.url}/autoscale/signal")
+        assert burned["burn_rates"]["5m"] >= burned["page_burn_rate"], (
+            f"5m burn {burned['burn_rates']['5m']} never crossed the page "
+            f"threshold {burned['page_burn_rate']}"
+        )
+        assert burned["page_burning"] is True
+        assert burned["replica_hint"] > base_hint, (
+            f"replica hint did not rise: {base_hint} -> "
+            f"{burned['replica_hint']}"
+        )
+        # The gauge twins ride /metrics for Prometheus-trigger setups.
+        with urllib.request.urlopen(f"{f.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(
+            metrics, "pst_capacity_burn_rate", 'window="5m"'
+        ) >= burned["page_burn_rate"]
+        assert metric_value(metrics, "pst_capacity_replica_hint") \
+            == burned["replica_hint"]
+        # The engines' deterministic flight rings + cost headers are live
+        # through the same fleet (the engine-free test surface).
+        flight = get_json(
+            f"http://127.0.0.1:{f.engine_ports[0]}/debug/flight?n=4"
+        )
+        assert flight["records"], "fake engine served an empty flight ring"
+        assert {"kind", "bucket", "device_s", "waiting"} <= set(
+            flight["records"][-1]
+        )
+    print(f"PASS capacity (burn 5m {burned['burn_rates']['5m']:.0f}x, "
+          f"hint {base_hint} -> {burned['replica_hint']})")
+
+
 def leg_chaos():
     """Chaos smoke: SIGKILL one engine mid-run under concurrent load. The
     router's retry/failover must absorb every request (zero client-visible
@@ -1125,6 +1205,7 @@ LEGS = {
     "deadline": leg_deadline,
     "tenant_flood": leg_tenant_flood,
     "fleet_observability": leg_fleet_observability,
+    "capacity": leg_capacity,
 }
 
 
