@@ -396,6 +396,31 @@ def fleet_dashboard():
                   'clamp_min(sum(rate('
                   'pst_engine_device_busy_seconds_total[5m])), 1e-9), 2)',
                   0, 128, unit="percentunit"))
+
+    # Row 16 — Disagg (docs/disagg.md): the streamed P/D handoff's
+    # health. Overlap p50 vs transfer p50 shows how much of the prefill
+    # wall the decode leg hides; fallbacks by reason is the degradation
+    # ledger (every one of them served fused with no client error).
+    p.append(panel("Disagg: transfer vs overlap (p50)", [
+        ('histogram_quantile(0.5, sum(rate('
+         'pst_disagg_transfer_seconds_bucket[5m])) by (le))',
+         "transfer p50"),
+        ('histogram_quantile(0.5, sum(rate('
+         'pst_disagg_overlap_seconds_bucket[5m])) by (le))',
+         "overlap p50"),
+    ], 0, 132, unit="s"))
+    p.append(panel("Disagg: fused-path fallbacks", [
+        ('sum(rate(pst_disagg_fallback_total[5m])) by (reason)',
+         "{{reason}} /s"),
+    ], 8, 132))
+    p.append(panel("Disagg: KV pages published vs prefetched", [
+        ('sum(rate({__name__="pst:kv_published_blocks_total"}[5m]))',
+         "published/s"),
+        ('sum(rate({__name__="pst:kv_prefetched_blocks_total"}[5m]))',
+         "prefetched/s"),
+        ('sum(rate({__name__="pst:kv_transfer_fallbacks_total"}[5m]))',
+         "engine fallbacks/s"),
+    ], 16, 132))
     return dashboard("pst-fleet", "production-stack-tpu / Fleet", p)
 
 

@@ -109,6 +109,9 @@ ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
     _cli("--static-aliases", "static discovery detail; extraArgs"),
     _cli("--static-model-labels", "static discovery detail; extraArgs"),
     _cli("--static-model-types", "static discovery detail; extraArgs"),
+    _cli("--static-pools", "static discovery detail; extraArgs — helm "
+         "fleets declare disagg pools via servingEngineSpec.modelSpec[]."
+         "pool, surfaced as the pst-pool pod label (docs/disagg.md)"),
     _cli("--static-backend-health-checks",
          "k8s discovery has readiness probes; static probing is extraArgs"),
     _cli("--health-check-interval", "companion of static health checks"),
@@ -128,6 +131,14 @@ ROUTER_FLAGS: Tuple[ConfigSpec, ...] = (
     _cli("--tokenizer-name", "kvaware hashing detail; extraArgs"),
     _helm("--prefill-model-labels", "routerSpec.prefillModelLabels"),
     _helm("--decode-model-labels", "routerSpec.decodeModelLabels"),
+    ConfigSpec("--disagg-overlap", HELM,
+               helm="routerSpec.disagg.overlap",
+               template=ROUTER_TEMPLATE, emit="--no-disagg-overlap",
+               note="default-on: the template renders the negation when "
+               "disagg.overlap is false"),
+    ConfigSpec("--no-disagg-overlap", TEMPLATE, template=ROUTER_TEMPLATE,
+               negation_of="--disagg-overlap",
+               note="emitted when disagg.overlap is false"),
     _helm("--admission-rate", "routerSpec.resilience.admissionRate",
           doc=_RESILIENCE_DOC),
     _helm("--admission-burst", "routerSpec.resilience.admissionBurst",
@@ -389,6 +400,10 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
     EngineFieldSpec("swap_stash_blocks", "--swap-stash-blocks",
                     _ms("engineConfig.swapStashBlocks")),
     EngineFieldSpec("kv_role", "--kv-role", _ms("kvCache.kvRole")),
+    EngineFieldSpec("kv_prefetch_depth", "--kv-prefetch-depth",
+                    _ms("kvCache.kvPrefetchDepth")),
+    EngineFieldSpec("kv_transfer_timeout_s", "--kv-transfer-timeout-s",
+                    _ms("kvCache.kvTransferTimeoutS")),
     EngineFieldSpec("deadline_shedding", "--deadline-shedding",
                     "servingEngineSpec.deadlineShedding",
                     emit="--no-deadline-shedding"),

@@ -73,6 +73,8 @@ class AsyncLLMEngine:
         self._work.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if getattr(self.engine, "kv_publisher", None) is not None:
+            self.engine.kv_publisher.shutdown()
 
     def is_healthy(self) -> bool:
         return (
@@ -167,6 +169,7 @@ class AsyncLLMEngine:
         deadline: Optional[float] = None,
         tenant: Optional[str] = None,
         tenant_class: Optional[str] = None,
+        kv_transfer: Optional[dict] = None,
     ) -> AsyncIterator[RequestOutput]:
         if self.step_error is not None:
             raise RuntimeError(f"engine is failed: {self.step_error}")
@@ -190,6 +193,7 @@ class AsyncLLMEngine:
                             deadline=deadline,
                             tenant=tenant,
                             tenant_class=tenant_class,
+                            kv_transfer=kv_transfer,
                         ),
                     )
                 )

@@ -124,6 +124,129 @@ class RemoteKVClient:
             logger.debug("remote KV get failed: %s", e)
             return None
 
+    # -- batched endpoints (docs/disagg.md: one round trip for N pages) ---
+
+    # Byte budget per batched POST /blocks: safely under the kvserver's
+    # 256 MiB client_max_size even for large per-page serde (big models).
+    BATCH_PUT_MAX_BYTES = 64 << 20
+
+    def put_blocks(
+        self,
+        pages: Sequence[Tuple[int, np.ndarray, np.ndarray]],
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Ship N pages in batched ``POST /blocks`` round trips (the
+        streamed-handoff and finish-push transfer primitive — the
+        per-block PUT loop it replaces paid one DCN round trip per page).
+        Batches are bounded by BYTES, not page count: a count-only bound
+        could exceed the server's request-size cap for large pages and
+        silently drop the whole batch."""
+        if not pages:
+            return True
+        try:
+            batch: list = []
+            batch_bytes = 0
+            for h, k, v in pages:
+                data = _serialize_page(k, v)
+                if batch and batch_bytes + len(data) > self.BATCH_PUT_MAX_BYTES:
+                    if not self._post_block_batch(batch, timeout):
+                        return False
+                    batch, batch_bytes = [], 0
+                batch.append((h, data))
+                batch_bytes += len(data)
+            return self._post_block_batch(batch, timeout)
+        except Exception as e:  # noqa: BLE001 — remote tier is best-effort
+            logger.debug("remote KV batched put failed: %s", e)
+            return False
+
+    def _post_block_batch(self, batch, timeout: Optional[float]) -> bool:
+        from ..kvserver.server import pack_blocks
+
+        if not batch:
+            return True
+        r = self._session.post(
+            f"{self.base_url}/blocks",
+            data=pack_blocks(batch),
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=self._effective_timeout(timeout),
+        )
+        return r.status_code == 200
+
+    def get_blocks(
+        self, hashes: Sequence[int], timeout: Optional[float] = None
+    ) -> "dict[int, Tuple[np.ndarray, np.ndarray]]":
+        """Fetch up to N pages in ONE ``GET /blocks?hashes=`` round trip;
+        absent hashes are simply missing from the result."""
+        if not hashes:
+            return {}
+        from ..kvserver.server import unpack_blocks
+
+        try:
+            r = self._session.get(
+                f"{self.base_url}/blocks",
+                params={"hashes": ",".join(str(int(h)) for h in hashes)},
+                timeout=self._effective_timeout(timeout),
+            )
+            if r.status_code != 200:
+                return {}
+            return {
+                h: _deserialize_page(data)
+                for h, data in unpack_blocks(r.content)
+            }
+        except Exception as e:  # noqa: BLE001
+            logger.debug("remote KV batched get failed: %s", e)
+            return {}
+
+    # -- disagg-transfer manifests (request-id-keyed; docs/disagg.md) -----
+
+    def post_manifest(
+        self,
+        request_id: str,
+        hashes: Sequence[int],
+        complete: bool = False,
+        total_blocks: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        try:
+            r = self._session.post(
+                f"{self.base_url}/manifests/{request_id}",
+                json={
+                    "hashes": [int(h) for h in hashes],
+                    "complete": bool(complete),
+                    "total_blocks": total_blocks,
+                },
+                timeout=self._effective_timeout(timeout),
+            )
+            return r.status_code == 200
+        except Exception as e:  # noqa: BLE001
+            logger.debug("manifest post failed: %s", e)
+            return False
+
+    def get_manifest(
+        self,
+        request_id: str,
+        wait_s: float = 0.0,
+        have: int = -1,
+        timeout: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Manifest view (``None`` = unknown request id / server down).
+        ``wait_s`` long-polls server-side for progress past ``have``."""
+        try:
+            eff = self._effective_timeout(timeout)
+            r = self._session.get(
+                f"{self.base_url}/manifests/{request_id}",
+                params={"wait_s": wait_s, "have": have},
+                # The long poll must be allowed to run its course: the
+                # read timeout covers the poll window plus slack.
+                timeout=max(eff, wait_s + 2.0),
+            )
+            if r.status_code != 200:
+                return None
+            return r.json()
+        except Exception as e:  # noqa: BLE001
+            logger.debug("manifest get failed: %s", e)
+            return None
+
 
 # v2: per-page host layout changed to [L, bs, KH, hd] (head-folded combined
 # device pages); v1 pages ([L, KH, bs, hd]) are layout-incompatible and must
@@ -224,13 +347,20 @@ class TieredAllocator(BlockAllocator):
 
     def _push_worker(self) -> None:
         while not self._push_stop.is_set():
+            batch = []
             try:
-                h, k, v = self._push_queue.popleft()
+                # Drain whatever spilled since the last pass into ONE
+                # batched POST (bounded by the queue length) — spill bursts
+                # used to pay one DCN round trip per page.
+                while len(batch) < 64:
+                    batch.append(self._push_queue.popleft())
             except IndexError:
+                pass
+            if not batch:
                 self._push_event.wait(timeout=1.0)
                 self._push_event.clear()
                 continue
-            self.remote.put(h, k, v)  # best-effort; client logs failures
+            self.remote.put_blocks(batch)  # best-effort; client logs failures
 
     def shutdown(self) -> None:
         """Stop the push worker (sleep level 2 rebuilds the allocator; without
@@ -291,6 +421,35 @@ class TieredAllocator(BlockAllocator):
         self.page_io.upload_page(blk, *page)
         return self.commit(blk, h)
 
+    def _remote_batch_fetch(
+        self, hashes: Sequence[int], deadline: Optional[float]
+    ) -> "dict[int, Tuple[np.ndarray, np.ndarray]]":
+        """One batched ``GET /blocks?hashes=`` for every hash not already
+        resident in HBM or the host pool — the remote leg of match_prefix
+        used to issue one sync HTTP call per page inside the walk."""
+        if self.remote is None:
+            return {}
+        wanted = [
+            h for h in hashes
+            if self._block_of_hash.get(h) is None
+            and (self.host_pool is None or not self.host_pool.contains(h))
+        ]
+        if not wanted:
+            return {}
+        remaining: Optional[float] = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {}
+        t0 = time.monotonic()
+        pages = self.remote.get_blocks(wanted, timeout=remaining)
+        observe_stage("engine", "kv_fetch_remote", time.monotonic() - t0)
+        self.remote_hit_blocks += len(pages)
+        if self.host_pool is not None:  # promote to the warmer tier
+            for h, (k, v) in pages.items():
+                self.host_pool.put(h, k, v)
+        return pages
+
     def match_prefix(
         self,
         token_ids: Sequence[int],
@@ -301,12 +460,34 @@ class TieredAllocator(BlockAllocator):
         if not self.enable_prefix_caching:
             return [], []
         hashes = block_hashes(token_ids, self.block_size, parent=salt)
+        fetched: "dict[int, Tuple[np.ndarray, np.ndarray]]" = {}
+        fetch_attempted = False
         matched: List[int] = []
         matched_hashes: List[int] = []
-        for h in hashes:
+        for i, h in enumerate(hashes):
             blk = self.acquire_cached(h)
             if blk is None:
-                page = self._fetch_lower_tier(h, deadline=deadline)
+                page = fetched.pop(h, None)
+                if page is None and self.host_pool is not None:
+                    t0 = time.monotonic()
+                    page = self.host_pool.get(h)
+                    if page is not None:
+                        self.host_hit_blocks += 1
+                        observe_stage(
+                            "engine", "kv_fetch_host", time.monotonic() - t0
+                        )
+                if (
+                    page is None
+                    and self.remote is not None
+                    and not fetch_attempted
+                ):
+                    # First miss below the host tier: batch-fetch the whole
+                    # remaining suffix in ONE round trip, then keep walking
+                    # — and never re-fetch: a hash absent from that reply
+                    # is a genuine remote miss.
+                    fetch_attempted = True
+                    fetched = self._remote_batch_fetch(hashes[i:], deadline)
+                    page = fetched.pop(h, None)
                 if page is None:
                     break
                 try:

@@ -152,6 +152,13 @@ class EngineConfig:
     # consumer: fault pages up from the remote store at admission
     # (TieredAllocator.match_prefix — the NIXL-receiver analogue).
     kv_role: str = "none"  # none | producer | consumer | both
+    # Streamed disagg KV handoff (docs/disagg.md). Consumer-side prefetch:
+    # max blocks per batched GET while following a prefill's manifest
+    # (bounds one response's host memory), and the wall-clock window the
+    # decode engine will wait for the manifest's completion marker before
+    # degrading to the fused path (recompute the prefill locally).
+    kv_prefetch_depth: int = 64
+    kv_transfer_timeout_s: float = 10.0
     # Deadline shedding (docs/resilience.md "Deadlines & hedging"): honor
     # the router-propagated X-PST-Deadline-Ms budget — 504 expired work at
     # admission, drop expired queued sequences before they consume a

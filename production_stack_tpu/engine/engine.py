@@ -84,11 +84,22 @@ class LLMEngine:
         if cfg.cpu_offload_blocks > 0 or cfg.remote_kv_url:
             from .cache_tiering import RemoteKVClient, TieredAllocator
 
+            host_blocks = cfg.cpu_offload_blocks
+            if (
+                host_blocks == 0
+                and cfg.remote_kv_url
+                and cfg.kv_role in ("consumer", "both")
+            ):
+                # The consumer-side prefetch stages published pages in the
+                # host pool so admission's match_prefix faults them up —
+                # a consumer engine without an explicit offload budget
+                # still needs a staging tier (docs/disagg.md).
+                host_blocks = max(self.runner.num_blocks // 2, 1024)
             self.allocator: BlockAllocator = TieredAllocator(
                 self.runner.num_blocks,
                 cfg.block_size,
                 page_io=self.runner,
-                host_blocks=cfg.cpu_offload_blocks,
+                host_blocks=host_blocks,
                 remote=RemoteKVClient(cfg.remote_kv_url)
                 if cfg.remote_kv_url
                 else None,
@@ -97,6 +108,32 @@ class LLMEngine:
         else:
             self.allocator = BlockAllocator(
                 self.runner.num_blocks, cfg.block_size, cfg.enable_prefix_caching
+            )
+        # Streamed disagg KV handoff (docs/disagg.md): a producer engine
+        # ships each prefill chunk's committed pages under the request's
+        # kv_transfer id as the chunk completes (worker thread, batched
+        # puts + manifest appends); a consumer engine follows manifests
+        # and stages published pages in the host pool while the remote
+        # prefill is still running.
+        self.kv_publisher = None
+        self.kv_prefetcher = None
+        remote = getattr(self.allocator, "remote", None)
+        if remote is not None and cfg.kv_role in ("producer", "both"):
+            from .kv_handoff import KVHandoffPublisher
+
+            self.kv_publisher = KVHandoffPublisher(remote)
+        if (
+            remote is not None
+            and cfg.kv_role in ("consumer", "both")
+            and getattr(self.allocator, "host_pool", None) is not None
+        ):
+            from .kv_handoff import KVHandoffPrefetcher
+
+            self.kv_prefetcher = KVHandoffPrefetcher(
+                remote,
+                self.allocator.host_pool,
+                timeout_s=cfg.kv_transfer_timeout_s,
+                depth=cfg.kv_prefetch_depth,
             )
         if cfg.kv_swap:
             from .swap import KVSwapper
@@ -194,6 +231,7 @@ class LLMEngine:
         # registration: hash -> last-commit time).
         self.resident_chunk_hashes: Dict[int, float] = {}
         # Cumulative counters for /metrics.
+        self.kv_published_blocks_total = 0
         self.num_preempted_total = 0
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
@@ -284,6 +322,7 @@ class LLMEngine:
         deadline: Optional[float] = None,
         tenant: Optional[str] = None,
         tenant_class: Optional[str] = None,
+        kv_transfer: Optional[dict] = None,
     ) -> Sequence:
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
@@ -313,6 +352,7 @@ class LLMEngine:
             deadline=deadline if self.cfg.deadline_shedding else None,
             tenant=tenant or "default",
             tenant_class=tenant_class or "interactive",
+            kv_transfer=kv_transfer,
         )
         self._last_arrival = time.time()
         self.scheduler.add(seq)
@@ -710,6 +750,12 @@ class LLMEngine:
             seq = item.seq
             seq.num_computed_tokens = item.end
             self._commit(seq)
+            # Streamed disagg handoff: this chunk's freshly committed
+            # pages go out NOW, overlapped with the next chunk's compute
+            # (docs/disagg.md) — not serially after the prefill response.
+            self._stream_publish(
+                seq, prefill_complete=item.end == seq.num_prompt_tokens
+            )
             # Sample only when this chunk completes a *fresh* prompt;
             # recompute chunks (post-preemption) must not re-emit tokens.
             if item.end == seq.num_prompt_tokens and not seq.output_token_ids:
@@ -718,6 +764,41 @@ class LLMEngine:
                 if out is not None:
                     outputs.append(out)
         return outputs
+
+    def _stream_publish(self, seq: Sequence, prefill_complete: bool) -> None:
+        """Hand ``seq``'s newly committed pages to the handoff publisher
+        (step-thread cost: device→host download + a deque append; all DCN
+        runs on the publisher's worker thread). The completion marker —
+        the decode side's "last block" signal — carries the full-block
+        count of the prompt, which is exactly what the consumer's
+        match_prefix can adopt."""
+        pub = self.kv_publisher
+        transfer = seq.kv_transfer
+        if pub is None or not transfer:
+            return
+        if transfer.get("role") == "consumer":
+            # The decode leg on a kv_role="both" engine: its prompt blocks
+            # were just PREFETCHED from the store — re-publishing them
+            # would re-download every page on the step thread and break
+            # the one-copy-per-page contract.
+            return
+        rid = transfer.get("request_id")
+        if not rid:
+            return
+        n = seq._committed_blocks
+        if n > seq.kv_published_cursor:
+            pages = []
+            for i in range(seq.kv_published_cursor, n):
+                k, v = self.runner.download_page(seq.block_ids[i])
+                pages.append((seq.block_hashes[i], k, v))
+            pub.publish(rid, pages)
+            self.kv_published_blocks_total += len(pages)
+            seq.kv_published_cursor = n
+        if prefill_complete and not transfer.get("_completed"):
+            transfer["_completed"] = True
+            pub.complete(
+                rid, seq.num_prompt_tokens // self.cfg.block_size
+            )
 
     # -- pipelined decode internals ------------------------------------
 
@@ -817,19 +898,29 @@ class LLMEngine:
         self.resident_chunk_hashes = fresh
 
     def _push_kv_to_remote(self, seq: Sequence) -> int:
-        """Producer-side disagg-prefill transfer: ship this request's
-        committed KV pages to the remote store before the prefill response
-        returns, so the decode engine's pull is guaranteed to hit (the
-        ordering the router's two-phase flow relies on). Returns pages sent."""
+        """Producer-side finish push: ship whatever committed pages the
+        streamed publisher has NOT already sent (``kv_published_cursor``)
+        in one batched round trip — the legacy role-based disagg path for
+        requests without ``kv_transfer_params``, and the tail (decode-
+        produced blocks) for streamed ones. One copy per page, ever."""
         remote = getattr(self.allocator, "remote", None)
         if remote is None:
             return 0
-        sent = 0
-        for blk, h in zip(seq.block_ids, seq.block_hashes):
-            k, v = self.runner.download_page(blk)
-            if remote.put(h, k, v):
-                sent += 1
-        return sent
+        start = seq.kv_published_cursor
+        if seq.kv_transfer and seq.kv_transfer.get("role") == "consumer":
+            # A consumer leg's cached prompt prefix CAME from the store
+            # (the prefetch) — only blocks computed here are new.
+            start = max(
+                start, seq.num_cached_prompt_tokens // self.cfg.block_size
+            )
+        pages = [
+            (h, *self.runner.download_page(blk))
+            for blk, h in zip(seq.block_ids[start:], seq.block_hashes[start:])
+        ]
+        if not pages or not remote.put_blocks(pages):
+            return 0
+        seq.kv_published_cursor = start + len(pages)
+        return len(pages)
 
     # ------------------------------------------------------------------
     # Token bookkeeping
@@ -1026,6 +1117,22 @@ class LLMEngine:
         for attr in ("host_hit_blocks", "remote_hit_blocks", "spilled_blocks"):
             if hasattr(self.allocator, attr):
                 out[f"kv_offload_{attr}"] = float(getattr(self.allocator, attr))
+        # Streamed disagg handoff KPIs (docs/disagg.md).
+        if self.kv_publisher is not None or self.kv_prefetcher is not None:
+            out["kv_published_blocks_total"] = float(
+                self.kv_published_blocks_total
+            )
+        if self.kv_publisher is not None:
+            out["kv_publish_failures_total"] = float(
+                self.kv_publisher.publish_failures
+            )
+        if self.kv_prefetcher is not None:
+            out["kv_prefetched_blocks_total"] = float(
+                self.kv_prefetcher.prefetched_blocks
+            )
+            out["kv_transfer_fallbacks_total"] = float(
+                self.kv_prefetcher.fallbacks
+            )
         if self.swapper is not None:
             out["kv_swap_out_total"] = float(self.swapper.swap_out_total)
             out["kv_swap_in_total"] = float(self.swapper.swap_in_total)

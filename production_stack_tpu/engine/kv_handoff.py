@@ -1,0 +1,232 @@
+"""Streamed disagg-prefill KV handoff (docs/disagg.md).
+
+The serial pre-handoff flow was: prefill finishes → every committed page is
+pushed one sync HTTP PUT at a time → the decode engine re-fetches each page
+with its own sync GET at admission. This module makes the transfer a
+*streamed, overlapped pipeline* keyed by the router's request id:
+
+- :class:`KVHandoffPublisher` (producer engine): as each prefill chunk's
+  pages commit, the step thread downloads them (device→host DMA, same as
+  the spill path) and enqueues them; a worker thread ships them in batched
+  ``POST /blocks`` round trips and appends their hashes to the request's
+  manifest. When the prefill pass completes, a completion marker with the
+  total block count lands on the manifest — the decode side's "last block"
+  signal. The step thread never blocks on DCN.
+
+- :class:`KVHandoffPrefetcher` (decode engine): long-polls the manifest
+  *while the prefill is still running*, batch-fetches each newly published
+  block into the tiered allocator's host pool, and returns as soon as the
+  completion marker is seen and every block landed — at which point the
+  sequence admits with its whole prompt a host-tier prefix hit and the
+  first decode step dispatches immediately. A manifest timeout or a dead
+  kvserver degrades to plain admission (the engine recomputes the prefill
+  — the fused path), never an error.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..logging_utils import init_logger
+
+logger = init_logger(__name__)
+
+# One publish batch per manifest append: bounds worker-loop latency so the
+# decode side sees progress at chunk granularity, not at prefill granularity.
+PUBLISH_BATCH_BLOCKS = 32
+# Bound on queued publish entries (chunk batches + completion markers): a
+# slow-but-healthy kvserver must never let device-downloaded pages pile up
+# in host RAM — same rationale as the spill path's bounded push queue. An
+# overflowing transfer is marked failed (the decode side falls back to its
+# local recompute) instead of growing without bound.
+PUBLISH_QUEUE_CAP = 1024
+
+
+class KVHandoffPublisher:
+    """Streams a disagg prefill's KV pages to the remote block store.
+
+    Thread contract: ``publish``/``complete`` are called on the engine step
+    thread (cheap: device→host download + deque append); all HTTP runs on
+    the worker thread. Failure flips the per-request ``failed`` flag — the
+    manifest then never completes and the decode side times out into its
+    fused fallback; nothing here can stall a prefill.
+    """
+
+    def __init__(self, remote) -> None:
+        self.remote = remote
+        self._queue: "collections.deque[tuple]" = collections.deque()
+        self._event = threading.Event()
+        self._stop = threading.Event()
+        # pstlint: owned-by=lock:_lock
+        self._failed: set = set()
+        self._lock = threading.Lock()
+        self.published_blocks = 0
+        self.publish_failures = 0
+        self.transfer_seconds = 0.0
+        self._thread = threading.Thread(
+            target=self._worker, name="kv-handoff-publish", daemon=True
+        )
+        self._thread.start()
+
+    def _overloaded(self, request_id: str) -> bool:
+        if len(self._queue) < PUBLISH_QUEUE_CAP:
+            return False
+        # The worker cannot keep up (slow DCN, not failing HTTP): shed
+        # THIS transfer rather than buffering unbounded host copies of
+        # device pages — its manifest never completes and the decode side
+        # recomputes (the fused fallback).
+        self._mark_failed(request_id)
+        return True
+
+    def publish(
+        self,
+        request_id: str,
+        pages: List[Tuple[int, np.ndarray, np.ndarray]],
+    ) -> None:
+        """Enqueue one prefill chunk's freshly committed pages."""
+        if not pages or self._overloaded(request_id):
+            return
+        self._queue.append(("pages", request_id, pages))
+        self._event.set()
+
+    def complete(self, request_id: str, total_blocks: int) -> None:
+        """The prefill pass finished: append the completion marker after
+        every already-enqueued page batch."""
+        if self._overloaded(request_id):
+            return
+        self._queue.append(("complete", request_id, total_blocks))
+        self._event.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._event.set()
+        self._thread.join(timeout=2.0)
+
+    def _mark_failed(self, request_id: str) -> None:
+        with self._lock:
+            self._failed.add(request_id)
+            if len(self._failed) > 4096:  # bounded: old ids age out
+                self._failed = set(list(self._failed)[-2048:])
+        self.publish_failures += 1
+
+    def _is_failed(self, request_id: str) -> bool:
+        with self._lock:
+            return request_id in self._failed
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                kind, rid, payload = self._queue.popleft()
+            except IndexError:
+                self._event.wait(timeout=0.5)
+                self._event.clear()
+                continue
+            if self._is_failed(rid):
+                continue  # transfer already broken: drop the rest
+            t0 = time.monotonic()
+            if kind == "pages":
+                pages = payload
+                # Batch within a chunk; a chunk larger than the batch cap
+                # still ships in a handful of round trips, not per-page.
+                ok = True
+                for i in range(0, len(pages), PUBLISH_BATCH_BLOCKS):
+                    batch = pages[i : i + PUBLISH_BATCH_BLOCKS]
+                    if not self.remote.put_blocks(batch):
+                        ok = False
+                        break
+                if ok:
+                    ok = self.remote.post_manifest(
+                        rid, [h for h, _, _ in pages]
+                    )
+                if ok:
+                    self.published_blocks += len(pages)
+                else:
+                    self._mark_failed(rid)
+            else:  # complete
+                if not self.remote.post_manifest(
+                    rid, [], complete=True, total_blocks=payload
+                ):
+                    self._mark_failed(rid)
+            self.transfer_seconds += time.monotonic() - t0
+
+
+class KVHandoffPrefetcher:
+    """Pulls a disagg prefill's published KV while the prefill still runs.
+
+    Blocking (requests-based) by design — the engine HTTP layer runs it in
+    an executor thread; everything here is bounded by ``timeout_s``.
+    """
+
+    def __init__(self, remote, host_pool, timeout_s: float = 10.0,
+                 depth: int = 64) -> None:
+        self.remote = remote
+        self.host_pool = host_pool
+        self.timeout_s = timeout_s
+        # Max blocks fetched per batched GET: bounds one response's memory.
+        self.depth = max(int(depth), 1)
+        self.prefetched_blocks = 0
+        self.fallbacks = 0
+
+    def prefetch(
+        self, request_id: str, deadline: Optional[float] = None
+    ) -> dict:
+        """Follow ``request_id``'s manifest to completion, batch-fetching
+        published blocks into the host pool as they appear.
+
+        Returns ``{"complete": bool, "blocks": n, "wall_s": s}`` —
+        ``complete=False`` means the caller should admit anyway (fused
+        fallback: the prefill recomputes locally)."""
+        t0 = time.monotonic()
+        expire = t0 + self.timeout_s
+        if deadline is not None:
+            expire = min(expire, deadline)
+        have = 0
+        fetched = 0
+        complete = False
+        total: Optional[int] = None
+        while True:
+            remaining = expire - time.monotonic()
+            if remaining <= 0:
+                break
+            view = self.remote.get_manifest(
+                request_id,
+                wait_s=min(remaining, 1.0),
+                have=have,
+                timeout=min(remaining + 2.0, self.timeout_s),
+            )
+            if view is None:
+                # Unknown id (prefill not started publishing yet) or the
+                # kvserver died: brief pause, retry until the window ends.
+                # pstlint: disable=async-blocking(20 ms manifest re-poll on the consumer prefetch path, which the HTTP layer always runs in an executor thread — never on the event loop; the whole loop is bounded by timeout_s)
+                time.sleep(min(0.02, max(remaining, 0.0)))
+                continue
+            hashes = view.get("hashes") or []
+            new = hashes[have:]
+            for i in range(0, len(new), self.depth):
+                batch = new[i : i + self.depth]
+                pages = self.remote.get_blocks(
+                    batch, timeout=max(expire - time.monotonic(), 0.001)
+                )
+                for h, (k, v) in pages.items():
+                    self.host_pool.put(h, k, v)
+                fetched += len(pages)
+            have = len(hashes)
+            if view.get("complete"):
+                total = view.get("total_blocks")
+                complete = total is None or have >= int(total)
+                if complete:
+                    break
+        self.prefetched_blocks += fetched
+        if not complete:
+            self.fallbacks += 1
+        return {
+            "complete": complete,
+            "blocks": fetched,
+            "total_blocks": total,
+            "wall_s": time.monotonic() - t0,
+        }

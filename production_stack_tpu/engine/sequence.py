@@ -113,6 +113,7 @@ class Sequence:
         deadline: Optional[float] = None,
         tenant: str = "default",
         tenant_class: str = "interactive",
+        kv_transfer: Optional[dict] = None,
     ):
         self.request_id = request_id
         self.prompt_token_ids: List[int] = list(prompt_token_ids)
@@ -162,6 +163,13 @@ class Sequence:
         self.resume_marker = 0
         # Admission-FIFO stamp across waiting+swapped (scheduler._admit).
         self.queue_stamp = 0
+        # Disagg KV handoff (docs/disagg.md): the router-stamped
+        # kv_transfer_params for this request ({"request_id", "role"?}),
+        # or None. On a producer engine the streamed publisher ships this
+        # sequence's pages per prefill chunk under that id; the cursor
+        # tracks how many committed blocks have been handed to it.
+        self.kv_transfer = kv_transfer
+        self.kv_published_cursor = 0
 
         # Per-request cost attribution (docs/observability.md "Cost
         # attribution"): device-seconds this request was charged — prefill

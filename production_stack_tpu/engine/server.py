@@ -48,6 +48,7 @@ from ..obs import (
     render_registries,
     unbind_log_context,
 )
+from ..obs.metrics import observe_stage
 from ..obs.tasks import spawn_owned
 from ..resilience.deadline import DEADLINE_EXCEEDED_HEADER, parse_deadline
 from ..protocols import (
@@ -213,6 +214,25 @@ class EngineMetrics:
         self.swap_stash = gauge(
             "pst:kv_swap_stash_blocks", "host-DRAM stash occupancy (pages)"
         )
+        # Streamed disagg KV handoff (docs/disagg.md): pages shipped to
+        # the remote store per prefill chunk, pages staged by the decode
+        # side's manifest-following prefetch, and transfers that degraded
+        # to the fused path (manifest timeout / kvserver death).
+        self.kv_published_blocks = counter(
+            "pst:kv_published_blocks",
+            "KV pages published to the remote store by the streamed "
+            "disagg handoff (per prefill chunk, batched)",
+        )
+        self.kv_prefetched_blocks = counter(
+            "pst:kv_prefetched_blocks",
+            "KV pages prefetched from a disagg prefill's manifest while "
+            "the prefill was still running",
+        )
+        self.kv_transfer_fallbacks = counter(
+            "pst:kv_transfer_fallbacks",
+            "disagg transfers that degraded to the fused path "
+            "(manifest timeout or kvserver failure)",
+        )
         # Tenant QoS (docs/multi-tenancy.md): per-tier queue age is the
         # starvation signal the flood-isolation guarantee asserts on, and
         # batch preemptions count pages reclaimed for interactive work.
@@ -297,6 +317,18 @@ class EngineMetrics:
             self.deadline_shed_running, "dl_running",
             stats.get("deadline_sheds_running_total", 0),
         )
+        self._counter_to(
+            self.kv_published_blocks, "kv_pub",
+            stats.get("kv_published_blocks_total", 0),
+        )
+        self._counter_to(
+            self.kv_prefetched_blocks, "kv_prefetch",
+            stats.get("kv_prefetched_blocks_total", 0),
+        )
+        self._counter_to(
+            self.kv_transfer_fallbacks, "kv_fallback",
+            stats.get("kv_transfer_fallbacks_total", 0),
+        )
         self.tenant_queue_age_interactive.set(
             stats.get("tenant_queue_age_interactive", 0.0)
         )
@@ -307,6 +339,20 @@ class EngineMetrics:
             self.tenant_batch_preemptions, "tenant_batch_preempt",
             stats.get("tenant_batch_preemptions_total", 0),
         )
+
+
+def _kv_transfer_params(req) -> Optional[dict]:
+    """The request's ``kv_transfer_params`` (the router's disagg handoff
+    stamp, pydantic ``extra="allow"``), validated to a request-id-bearing
+    dict — anything else is ignored rather than 400d, mirroring the
+    reference connector's permissive surface."""
+    raw = getattr(req, "kv_transfer_params", None)
+    if not isinstance(raw, dict) or not raw.get("request_id"):
+        return None
+    return {
+        "request_id": str(raw["request_id"]),
+        "role": str(raw["role"]) if raw.get("role") else None,
+    }
 
 
 def _parse_logit_bias(raw) -> tuple:
@@ -814,10 +860,37 @@ def create_engine_app(
             )
 
         tenant, tenant_class = _request_tenant(request)
+        kv_transfer = _kv_transfer_params(req)
+        if kv_transfer is not None:
+            # Consumer leg of a disagg handoff (docs/disagg.md): follow the
+            # prefill's manifest and stage published pages in the host pool
+            # WHILE the remote prefill still runs; admission proceeds when
+            # the completion marker lands — the prompt is then a host-tier
+            # prefix hit and the first decode step dispatches immediately.
+            # Timeout / dead kvserver → plain admission (fused fallback:
+            # this engine recomputes the prefill; no client-visible error).
+            prefetcher = engine.engine.kv_prefetcher
+            if prefetcher is not None and kv_transfer.get("role") == "consumer":
+                t_fetch = time.monotonic()
+                fetch = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: prefetcher.prefetch(
+                        str(kv_transfer["request_id"]), deadline=deadline
+                    ),
+                )
+                if trace is not None:
+                    trace.add_event(
+                        "kv_prefetch",
+                        complete=fetch["complete"], blocks=fetch["blocks"],
+                    )
+                observe_stage(
+                    "engine", "kv_prefetch", time.monotonic() - t_fetch
+                )
         gen = engine.generate(
             prompt_token_ids=ids, sampling=sampling, request_id=rid,
             lora_name=lora, deadline=deadline,
             tenant=tenant, tenant_class=tenant_class,
+            kv_transfer=kv_transfer,
         )
 
         if req.stream:
@@ -1633,6 +1706,16 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
         "--kv-role", default="none",
         choices=["none", "producer", "consumer", "both"],
     )
+    # Streamed disagg KV handoff (docs/disagg.md): consumer prefetch
+    # batching depth and the wall the decode engine waits for a prefill's
+    # manifest completion before degrading to the fused path.
+    p.add_argument("--kv-prefetch-depth", type=int, default=64,
+                   help="max KV pages per batched GET while following a "
+                        "disagg prefill's manifest")
+    p.add_argument("--kv-transfer-timeout-s", type=float, default=10.0,
+                   help="seconds the decode engine waits for a disagg "
+                        "manifest's completion marker before recomputing "
+                        "the prefill locally (fused fallback)")
     # Cross-encoder scoring sidecar for /rerank and /score (bge-reranker-
     # style HF dir or a bert preset). Without it those endpoints fall back
     # to embedding cosine similarity.
@@ -1754,6 +1837,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         cache_controller_url=args.cache_controller_url,
         engine_url=args.engine_url,
         kv_role=args.kv_role,
+        kv_prefetch_depth=args.kv_prefetch_depth,
+        kv_transfer_timeout_s=args.kv_transfer_timeout_s,
         deadline_shedding=args.deadline_shedding,
         tenant_fairness=args.tenant_fairness,
         warmup=args.warmup,

@@ -9,22 +9,73 @@ a byte-capacity LRU.
 Endpoints:
   PUT  /blocks/{hash}     store one page (raw serde body)
   GET  /blocks/{hash}     fetch one page (404 if absent)
-  POST /contains          {"hashes": [...]} → {"present": [bool, ...]}
+  POST /blocks            store N pages in ONE round trip (framed body)
+  GET  /blocks?hashes=    fetch N pages in ONE round trip (framed body;
+                          absent hashes are simply omitted from the reply)
+  POST /manifests/{rid}   append a disagg-transfer manifest update
+  GET  /manifests/{rid}   read a manifest (``?wait_s=`` long-polls for
+                          progress past ``?have=`` blocks / completion)
   GET  /stats             occupancy/bytes/hit counters
   GET  /health
+
+The framed batch body is ``repeat([8B hash LE][4B length LE][payload])`` —
+hash keys are the engine-side block hashes, payloads are the page serde.
+
+Manifests (docs/disagg.md "Manifest protocol"): the streamed prefill→decode
+KV handoff is coordinated by a request-id-keyed manifest. The prefill engine
+appends the block-hash list as each prefill chunk's pages are published, and
+posts ``complete`` with ``total_blocks`` when the prefill pass finishes; the
+decode engine long-polls the manifest and batch-fetches published blocks
+while the prefill is still running — transfer overlapped with compute.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import collections
-from typing import Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
 
 from ..logging_utils import init_logger
 
 logger = init_logger(__name__)
+
+# Manifests older than this are dropped (a crashed decode leg must not pin
+# its prefill's manifest forever); sized generously above any request
+# deadline the router would still be waiting on.
+MANIFEST_TTL_S = 10 * 60.0
+MANIFEST_CAP = 4096
+
+
+def pack_blocks(pages: List[Tuple[int, bytes]]) -> bytes:
+    """Frame N (hash, payload) pages into one batch body."""
+    parts = []
+    for h, data in pages:
+        parts.append(int(h).to_bytes(8, "little", signed=False))
+        parts.append(len(data).to_bytes(4, "little"))
+        parts.append(data)
+    return b"".join(parts)
+
+
+def unpack_blocks(buf: bytes) -> List[Tuple[int, bytes]]:
+    """Inverse of :func:`pack_blocks`; raises ValueError on a torn frame."""
+    out: List[Tuple[int, bytes]] = []
+    off = 0
+    n = len(buf)
+    while off < n:
+        if off + 12 > n:
+            raise ValueError("torn batch frame header")
+        h = int.from_bytes(buf[off : off + 8], "little")
+        ln = int.from_bytes(buf[off + 8 : off + 12], "little")
+        off += 12
+        if off + ln > n:
+            raise ValueError("torn batch frame payload")
+        out.append((h, buf[off : off + ln]))
+        off += ln
+    return out
 
 
 class BlockStore:
@@ -35,8 +86,15 @@ class BlockStore:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Transfer-audit counters (docs/disagg.md): distinguish HTTP round
+        # trips from pages moved, so tests can assert the streamed handoff
+        # ships each page ONCE and batches N pages per trip.
+        self.put_calls = 0
+        self.blocks_put = 0
+        self.get_calls = 0
 
     def put(self, h: int, data: bytes) -> None:
+        self.blocks_put += 1
         if len(data) > self.max_bytes:
             return  # unstorable; never evict the fleet's cache trying
         if h in self._blocks:
@@ -61,21 +119,206 @@ class BlockStore:
         return h in self._blocks
 
 
+class ManifestStore:
+    """Request-id-keyed disagg-transfer manifests with change signaling."""
+
+    def __init__(self):
+        self._manifests: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._events: Dict[str, asyncio.Event] = {}
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - MANIFEST_TTL_S
+        stale = [
+            rid for rid, m in self._manifests.items() if m["ts"] < cutoff
+        ]
+        for rid in stale:
+            self._manifests.pop(rid, None)
+            self._events.pop(rid, None)
+        while len(self._manifests) > MANIFEST_CAP:
+            rid, _ = self._manifests.popitem(last=False)
+            self._events.pop(rid, None)
+        if len(self._events) > 2 * MANIFEST_CAP:
+            # Events registered by pollers whose manifest never arrived
+            # (producer crashed / transfer fault) are not covered by the
+            # manifest-keyed pruning above — bound them separately.
+            self._events = {
+                rid: ev for rid, ev in self._events.items()
+                if rid in self._manifests
+            }
+
+    def update(
+        self,
+        rid: str,
+        hashes: List[int],
+        complete: bool,
+        total_blocks: Optional[int],
+    ) -> dict:
+        now = time.time()
+        self._prune(now)
+        m = self._manifests.get(rid)
+        if m is None:
+            m = {"hashes": [], "complete": False, "total_blocks": None,
+                 "ts": now}
+            self._manifests[rid] = m
+        seen = set(m["hashes"])
+        for h in hashes:
+            if h not in seen:
+                m["hashes"].append(int(h))
+                seen.add(h)
+        if complete:
+            m["complete"] = True
+        if total_blocks is not None:
+            m["total_blocks"] = int(total_blocks)
+        m["ts"] = now
+        ev = self._events.get(rid)
+        if ev is not None:
+            ev.set()
+        return m
+
+    def view(self, rid: str) -> Optional[dict]:
+        m = self._manifests.get(rid)
+        if m is None:
+            return None
+        return {
+            "request_id": rid,
+            "hashes": list(m["hashes"]),
+            "complete": m["complete"],
+            "total_blocks": m["total_blocks"],
+        }
+
+    async def wait(self, rid: str, have: int, wait_s: float) -> Optional[dict]:
+        """Long-poll: return as soon as the manifest has more than ``have``
+        blocks or is complete, else after ``wait_s``."""
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        try:
+            while True:
+                # Clear BEFORE checking: an update() that lands between
+                # the manifest check and the wait sets the event and must
+                # not be erased, or the poll stalls a full wait cycle.
+                ev = self._events.setdefault(rid, asyncio.Event())
+                ev.clear()
+                m = self._manifests.get(rid)
+                if m is not None and (
+                    len(m["hashes"]) > have or m["complete"]
+                ):
+                    return self.view(rid)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self.view(rid)
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), timeout=min(remaining, 1.0)
+                    )
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            if rid not in self._manifests:
+                # This poller registered the event for a manifest that
+                # never arrived (producer crashed / transfer fault): drop
+                # it, or every failed transfer would leak one Event.
+                self._events.pop(rid, None)
+
+    def __len__(self) -> int:
+        return len(self._manifests)
+
+
 def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
     store = BlockStore(max_bytes)
+    manifests = ManifestStore()
     app = web.Application(client_max_size=256 << 20)
     app["store"] = store
+    app["manifests"] = manifests
 
     async def put_block(request: web.Request) -> web.Response:
         h = int(request.match_info["hash"])
+        store.put_calls += 1
         store.put(h, await request.read())
         return web.json_response({"status": "ok"})
 
+    async def put_blocks(request: web.Request) -> web.Response:
+        """Batched put: N pages, one round trip (docs/disagg.md)."""
+        store.put_calls += 1
+        try:
+            pages = unpack_blocks(await request.read())
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        for h, data in pages:
+            store.put(h, data)
+        return web.json_response({"status": "ok", "stored": len(pages)})
+
     async def get_block(request: web.Request) -> web.Response:
+        if "hashes" in request.query:
+            return await get_blocks(request)
+        store.get_calls += 1
         data = store.get(int(request.match_info["hash"]))
         if data is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.Response(body=data, content_type="application/octet-stream")
+
+    async def get_blocks(request: web.Request) -> web.Response:
+        """Batched get: ``?hashes=h1,h2`` → framed body of present pages
+        (absent hashes simply omitted; the caller diffs)."""
+        store.get_calls += 1
+        try:
+            hashes = [
+                int(h) for h in request.query.get("hashes", "").split(",") if h
+            ]
+        except ValueError:
+            return web.json_response(
+                {"error": "hashes must be integers"}, status=400
+            )
+        pages = []
+        for h in hashes:
+            data = store.get(h)
+            if data is not None:
+                pages.append((h, data))
+        return web.Response(
+            body=pack_blocks(pages),
+            content_type="application/octet-stream",
+            headers={"X-PST-Blocks": str(len(pages))},
+        )
+
+    async def post_manifest(request: web.Request) -> web.Response:
+        rid = request.match_info["rid"]
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — malformed update
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be an object"},
+                                     status=400)
+        try:
+            hashes = [int(h) for h in body.get("hashes") or []]
+            total = body.get("total_blocks")
+            total = int(total) if total is not None else None
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "hashes/total_blocks must be integers"}, status=400
+            )
+        m = manifests.update(rid, hashes, bool(body.get("complete")), total)
+        return web.json_response(
+            {"status": "ok", "blocks": len(m["hashes"]),
+             "complete": m["complete"]}
+        )
+
+    async def get_manifest(request: web.Request) -> web.Response:
+        rid = request.match_info["rid"]
+        try:
+            wait_s = float(request.query.get("wait_s", 0))
+            have = int(request.query.get("have", -1))
+        except ValueError:
+            return web.json_response(
+                {"error": "wait_s/have must be numbers"}, status=400
+            )
+        if wait_s > 0:
+            view = await manifests.wait(rid, have, min(wait_s, 30.0))
+        else:
+            view = manifests.view(rid)
+        if view is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(view)
 
     async def contains(request: web.Request) -> web.Response:
         body = await request.json()
@@ -92,14 +335,22 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
                 "hits": store.hits,
                 "misses": store.misses,
                 "evictions": store.evictions,
+                "put_calls": store.put_calls,
+                "blocks_put": store.blocks_put,
+                "get_calls": store.get_calls,
+                "manifests": len(manifests),
             }
         )
 
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    app.router.add_post("/blocks", put_blocks)
+    app.router.add_get("/blocks", get_blocks)
     app.router.add_put("/blocks/{hash}", put_block)
     app.router.add_get("/blocks/{hash}", get_block)
+    app.router.add_post("/manifests/{rid}", post_manifest)
+    app.router.add_get("/manifests/{rid}", get_manifest)
     app.router.add_post("/contains", contains)
     app.router.add_get("/stats", stats)
     app.router.add_get("/health", health)

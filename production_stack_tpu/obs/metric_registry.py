@@ -105,6 +105,10 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     MetricSpec("pst_tenant_queue_depth", GAUGE, "resilience/metrics.py"),
     MetricSpec("pst_tenant_usage_tokens_total", COUNTER, "resilience/metrics.py"),
     # --- router/routing/metrics.py: fleet routing ------------------------
+    # --- router/services/disagg.py: disaggregated P/D pools -------------
+    MetricSpec("pst_disagg_transfer_seconds", HISTOGRAM, "router/services/disagg.py"),
+    MetricSpec("pst_disagg_overlap_seconds", HISTOGRAM, "router/services/disagg.py"),
+    MetricSpec("pst_disagg_fallback", COUNTER, "router/services/disagg.py"),
     MetricSpec("pst_route_score", HISTOGRAM, "router/routing/metrics.py"),
     MetricSpec("pst_route_spill", COUNTER, "router/routing/metrics.py"),
     MetricSpec("pst_route_session_remap", COUNTER, "router/routing/metrics.py"),

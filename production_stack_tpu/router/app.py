@@ -60,7 +60,6 @@ from .service_discovery import (
 )
 from .state import (
     PROVIDER_CANARY_TTFT,
-    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
@@ -406,6 +405,8 @@ def initialize_all(app: web.Application, args) -> None:
             model_types=parse_comma_separated(args.static_model_types) or None,
             static_backend_health_checks=args.static_backend_health_checks,
             health_check_interval=args.health_check_interval,
+            pools=parse_comma_separated(getattr(args, "static_pools", None))
+            or None,
             prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
             decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
         )
@@ -438,7 +439,12 @@ def initialize_all(app: web.Application, args) -> None:
         PROVIDER_ENDPOINTS,
         lambda: app["service_discovery"].get_endpoint_urls(),
     )
-    router = initialize_routing_logic(
+    # (Fleet routing's bounded-load view needs no provider of its own:
+    # the routed in-flight counts ride the request_stats digest and
+    # scoring reads the fleet-merged monitor view — the former
+    # endpoint_loads gossip key carried the same numbers twice and is
+    # gone; docs/router-ha.md.)
+    initialize_routing_logic(
         RoutingLogic(args.routing_logic),
         session_key=args.session_key,
         kv_aware_threshold=args.kv_aware_threshold,
@@ -449,18 +455,6 @@ def initialize_all(app: web.Application, args) -> None:
         prefill_model_labels=parse_comma_separated(args.prefill_model_labels) or None,
         decode_model_labels=parse_comma_separated(args.decode_model_labels) or None,
     )
-    # Fleet routing publishes its routed-in-flight loads to peer replicas
-    # (scoring's bounded-load view converges fleet-wide); policies without
-    # per-engine load state simply register nothing. THIS app's monitor is
-    # captured explicitly: the provider runs from the gossip loop, outside
-    # any request context, where the module default would be whichever app
-    # initialized last.
-    loads_provider = getattr(router, "local_loads_snapshot", None)
-    if loads_provider is not None:
-        backend.register_provider(
-            PROVIDER_ENDPOINT_LOADS,
-            lambda: loads_provider(monitor),
-        )
     initialize_resilience(args)
     initialize_request_tracing(
         enabled=getattr(args, "tracing", True),

@@ -74,6 +74,7 @@ def reconfigure_all(config: DynamicRouterConfig, args, app) -> None:
             models=parse_comma_separated(merged.get("static_models")),
             aliases=parse_static_aliases(merged.get("static_aliases")),
             model_labels=parse_comma_separated(merged.get("static_model_labels")) or None,
+            pools=parse_comma_separated(merged.get("static_pools")) or None,
         )
     else:
         reconfigure_service_discovery(
@@ -84,7 +85,7 @@ def reconfigure_all(config: DynamicRouterConfig, args, app) -> None:
             label_selector=merged.get("k8s_label_selector"),
             k8s_service_discovery_type=merged.get("k8s_service_discovery_type", "pod-ip"),
         )
-    router = reconfigure_routing_logic(
+    reconfigure_routing_logic(
         RoutingLogic(merged.get("routing_logic", "roundrobin")),
         session_key=merged.get("session_key"),
         kv_aware_threshold=merged.get("kv_aware_threshold"),
@@ -94,24 +95,9 @@ def reconfigure_all(config: DynamicRouterConfig, args, app) -> None:
         prefill_model_labels=parse_comma_separated(merged.get("prefill_model_labels")) or None,
         decode_model_labels=parse_comma_separated(merged.get("decode_model_labels")) or None,
     )
-    # Keep the state backend's endpoint-loads provider pointing at the
-    # CURRENT policy: a hot-switch to fleet must start publishing loads
-    # to peer replicas, and a switch away must stop gossiping the
-    # destroyed router's view.
-    from .state import PROVIDER_ENDPOINT_LOADS, get_state_backend
-
-    backend = get_state_backend()
-    if backend is not None:
-        loads_provider = getattr(router, "local_loads_snapshot", None)
-        monitor = app.get("request_stats_monitor") if app is not None else None
-        if loads_provider is None:
-            backend.register_provider(PROVIDER_ENDPOINT_LOADS, lambda: {})
-        else:
-            # Same app-scoped monitor capture as create_app: the provider
-            # runs in the gossip loop, outside any request context.
-            backend.register_provider(
-                PROVIDER_ENDPOINT_LOADS, lambda: loads_provider(monitor)
-            )
+    # (No endpoint-loads provider to repoint: fleet scoring reads the
+    # fleet-merged request-stats view — the in-flight counts ride the
+    # request_stats digest, which follows the app's monitor already.)
     logger.info("dynamic config applied: %s", config)
 
 

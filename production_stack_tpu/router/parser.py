@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--static-aliases", help="alias1:model1,alias2:model2")
     p.add_argument("--static-model-labels", help="comma-separated labels (one per backend)")
     p.add_argument("--static-model-types", help="comma-separated model types (chat|completion|embeddings|rerank|score)")
+    p.add_argument("--static-pools",
+                   help="comma-separated disagg pool per backend "
+                        "(prefill|decode|fused; docs/disagg.md). Declaring "
+                        "both a prefill and a decode pool makes the "
+                        "two-leg disagg flow the fleet shape for every "
+                        "generation request")
     p.add_argument("--static-backend-health-checks", action="store_true")
     p.add_argument("--health-check-interval", type=float, default=60.0,
                    help="seconds between static-backend health/drain probes")
@@ -90,6 +96,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tokenizer-name", default=None, help="tokenizer for kvaware prefix hashing (defaults to request model)")
     p.add_argument("--prefill-model-labels", default=None)
     p.add_argument("--decode-model-labels", default=None)
+    # Disaggregated P/D handoff (docs/disagg.md): with overlap on (the
+    # default) the decode leg dispatches CONCURRENTLY with the prefill leg
+    # — the decode engine prefetches the streamed KV while the prefill is
+    # still running and the prefill response is a completion signal, not a
+    # gate. Off = the pre-overlap serial two-phase flow.
+    p.add_argument("--disagg-overlap", dest="disagg_overlap",
+                   action="store_true", default=True,
+                   help="dispatch the disagg decode leg concurrently with "
+                        "the prefill leg (streamed KV handoff overlapped "
+                        "with prefill)")
+    p.add_argument("--no-disagg-overlap", dest="disagg_overlap",
+                   action="store_false")
 
     # Resilience (circuit breakers, retry/failover, admission control)
     p.add_argument("--admission-rate", type=float, default=0.0,
@@ -318,6 +336,16 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError(
                 "--static-backend-health-checks requires --static-model-types"
             )
+        if args.static_pools:
+            pools = parse_comma_separated(args.static_pools)
+            if len(pools) != len(urls):
+                raise ValueError("--static-pools length mismatch")
+            bad = [x for x in pools if x not in ("prefill", "decode", "fused")]
+            if bad:
+                raise ValueError(
+                    f"--static-pools entries must be prefill|decode|fused "
+                    f"(got {bad})"
+                )
     if args.admission_rate < 0:
         raise ValueError("--admission-rate must be >= 0")
     if args.proxy_retries < 0:

@@ -528,8 +528,9 @@ class FleetRouter(RoutingInterface):
       the trie learns the new home on the same request.
     - Under a shared state backend the trie merges peers' replicated
       inserts, the ring hashes over the fleet-wide endpoint view, and
-      loads include every live peer's published routed-in-flight counts
-      (``peer_endpoint_loads``) so replicas spill identically.
+      loads come from the FLEET-MERGED request-stats view (peers'
+      in-flight counts ride the request_stats gossip digest) so
+      replicas spill identically.
     - Discovery removing an engine calls :meth:`evict_endpoint`: trie,
       session pins, and ring view drop it in one step (churn contract).
     """
@@ -589,33 +590,6 @@ class FleetRouter(RoutingInterface):
             await self.lookup_client.aclose()
 
     # -- scoring inputs ----------------------------------------------------
-
-    def local_loads_snapshot(self, monitor=None) -> Dict[str, float]:
-        """This replica's own routed-in-flight count per engine — the
-        payload the state backend publishes to peer replicas so the
-        bounded-load view converges fleet-wide.
-
-        ``monitor`` pins the APP-SCOPED stats monitor: the provider runs
-        from the gossip backend's background task, where the per-request
-        contextvar is unbound and the module default would resolve to
-        whichever app initialized last (the multi-app bleed the scraper
-        de-singletonization fixes elsewhere in this PR). ``create_app``
-        registers the provider with its own monitor captured."""
-        if monitor is None:
-            from ..stats.request_stats import get_request_stats_monitor
-
-            try:
-                monitor = get_request_stats_monitor()
-            except ValueError:
-                # Monitor not initialized (unit harness / teardown race):
-                # publish NOTHING — republishing any merged view as "our
-                # own traffic" would double-count peers' loads.
-                return {}
-        stats = monitor.get_request_stats(fleet=False)
-        return {
-            url: float(rs.in_prefill_requests + rs.in_decoding_requests)
-            for url, rs in stats.items()
-        }
 
     def _canary_ttfts(self) -> Dict[str, float]:
         """Local canary view merged with live peers' gossiped views,
@@ -710,23 +684,20 @@ class FleetRouter(RoutingInterface):
             self.ring.update(urls)
 
         hit_tokens = await self._hit_tokens(prompt, urls, model, headers)
-        peers_backend = backend if shared else None
-        try:
-            from ..stats.request_stats import get_request_stats_monitor
-
-            local_stats = get_request_stats_monitor().get_request_stats(
-                fleet=False
-            )
-        except ValueError:
-            # No resolvable monitor (unit harness / teardown race): the
-            # caller-passed stats are the FLEET-merged view, so peers are
-            # already in it — adding peer_endpoint_loads on top would
-            # double-count every peer's traffic.
-            local_stats = request_stats or {}
-            peers_backend = None
-        loads = scoring.fleet_loads(urls, local_stats, peers_backend)
+        # The caller-passed stats are the FLEET-merged request-stats view
+        # (get_request_stats defaults fleet=True): under a shared backend
+        # live peers' in-flight counts are already summed in — one
+        # provider, one merge, scoring reads the merged view
+        # (docs/router-ha.md; the old endpoint_loads digest is gone).
+        loads = scoring.fleet_loads(urls, request_stats or {})
+        # Disagg leg hint (docs/disagg.md): the router's two-leg flow
+        # stamps the pool on kv_transfer_params so the prefill leg scores
+        # by compute/queue availability and the decode leg by KV
+        # headroom/bandwidth; plain requests score the fused way.
+        pool = (request_json.get("kv_transfer_params") or {}).get("pool")
         scores = scoring.score_engines(
-            urls, hit_tokens, engine_stats or {}, self._canary_ttfts()
+            urls, hit_tokens, engine_stats or {}, self._canary_ttfts(),
+            pool=pool if pool in ("prefill", "decode") else None,
         )
         bound = scoring.load_bound(loads, urls, self.load_factor)
         self._last_scores = dict(scores)

@@ -23,11 +23,11 @@ The score the :class:`~.logic.FleetRouter` maximizes per routing decision:
 Both headroom and health read the already-running scrape/canary
 snapshots: scoring adds **no new blocking I/O per request**.
 
-Loads for the bounded-load constraint are this replica's own routed
-in-flight requests plus every live peer replica's published loads
-(``StateBackend.peer_endpoint_loads``) — each replica contributes
-exactly its own traffic, so the fleet view converges without double
-counting and every replica sheds a hot-spotted engine the same way.
+Loads for the bounded-load constraint come from the FLEET-MERGED
+request-stats view (``get_request_stats(fleet=True)``): each replica's
+own routed in-flight counts ride the ``request_stats`` gossip digest and
+merge additively — one provider, one merge, no double counting — so
+every replica sheds a hot-spotted engine the same way.
 """
 
 from __future__ import annotations
@@ -81,17 +81,46 @@ def canary_health(
     return max(min(best / ttft, 1.0), MIN_HEALTH)
 
 
+def compute_availability(engine_stats: Optional[Any]) -> float:
+    """Prefill-pool scoring input (docs/disagg.md): free compute,
+    approximated by the engine's running+queued depth — prefill is
+    compute-bound, so queue depth predicts its TTFT where KV headroom
+    says almost nothing. In (0, 1]; 1.0 = idle."""
+    if engine_stats is None:
+        return 1.0
+    depth = float(
+        getattr(engine_stats, "num_running_requests", 0) or 0
+    ) + float(getattr(engine_stats, "num_queuing_requests", 0) or 0)
+    return 1.0 / (1.0 + max(depth, 0.0) / 4.0)
+
+
 def score_engines(
     urls: Sequence[str],
     hit_tokens: Dict[str, float],
     engine_stats: Dict[str, Any],
     canary_ttfts: Dict[str, float],
+    pool: Optional[str] = None,
 ) -> Dict[str, float]:
-    """The fused score per candidate engine (see module docstring)."""
+    """The fused score per candidate engine (see module docstring).
+
+    ``pool`` specializes the capacity factor for disagg legs
+    (docs/disagg.md): the prefill pool is compute-bound, so queue/compute
+    availability replaces KV headroom; the decode pool is
+    bandwidth/KV-bound, so the standard headroom factor applies. Fused
+    engines score under whichever leg is being routed — they stay
+    eligible for both, which is what lets mixed fleets degrade
+    gracefully."""
+
+    def capacity(url: str) -> float:
+        es = engine_stats.get(url)
+        if pool == "prefill":
+            return max(compute_availability(es), MIN_HEADROOM)
+        return kv_headroom(es)
+
     return {
         url: (
             (COLD_BASE_TOKENS + max(hit_tokens.get(url, 0.0), 0.0))
-            * kv_headroom(engine_stats.get(url))
+            * capacity(url)
             * canary_health(url, canary_ttfts)
         )
         for url in urls
@@ -153,34 +182,26 @@ def pick_bounded(
 
 def fleet_loads(
     urls: Sequence[str],
-    local_stats: Dict[str, Any],
-    backend: Optional[Any],
+    request_stats: Dict[str, Any],
 ) -> Dict[str, float]:
     """Per-engine routed-in-flight load, fleet-wide.
 
-    ``local_stats`` is THIS replica's own (non-merged) request-stats
-    view; live peers' published loads add in through the state backend's
-    ``peer_endpoint_loads`` surface. Each replica contributes exactly its
-    own routed requests — no double counting — and the sum converges
-    across replicas within one gossip round.
+    ``request_stats`` is the FLEET-MERGED request-stats view
+    (``get_request_stats(fleet=True)`` — under a shared state backend the
+    monitor already adds live peers' gossiped ``in_prefill``/
+    ``in_decoding`` counts, each replica contributing exactly its own
+    traffic). The in-flight counts ride ONE pipeline: the request-stats
+    digest. The separate ``endpoint_loads`` gossip key this function used
+    to merge carried the same numbers twice and is gone
+    (docs/router-ha.md).
     """
     loads: Dict[str, float] = {}
     for url in urls:
-        rs = local_stats.get(url)
+        rs = request_stats.get(url)
         loads[url] = float(
             getattr(rs, "in_prefill_requests", 0)
             + getattr(rs, "in_decoding_requests", 0)
         ) if rs is not None else 0.0
-    if backend is not None and getattr(backend, "shared", False):
-        for snap in backend.peer_endpoint_loads().values():
-            if not isinstance(snap, dict):
-                continue
-            for url, value in snap.items():
-                if url in loads:
-                    try:
-                        loads[url] += float(value)
-                    except (TypeError, ValueError):
-                        continue
     return loads
 
 

@@ -69,6 +69,14 @@ async def probe_warming(
         return None
 
 
+def _pool_label(labels: Dict[str, str]) -> str:
+    """Declared disagg pool from pod/service labels (helm stamps
+    ``pst-pool`` from ``servingEngineSpec.pool``); anything unrecognized
+    is fused — the safe shape."""
+    pool = (labels.get("pst-pool") or labels.get("pool") or "").strip().lower()
+    return pool if pool in ("prefill", "decode") else "fused"
+
+
 @dataclass
 class ModelInfo:
     """A model (base or LoRA adapter) served by an endpoint."""
@@ -117,6 +125,11 @@ class EndpointInfo:
     # would land requests behind the XLA compile storm — unroutable the
     # same way draining is, until /ready flips.
     warming: bool = False
+    # Declared disagg pool (docs/disagg.md): "prefill" | "decode" |
+    # "fused". Surfaced from helm's servingEngineSpec.pool (pod label
+    # pst-pool), --static-pools, or defaulted — fused engines serve both
+    # disagg legs, so mixed fleets degrade gracefully.
+    pool: str = "fused"
     pod_name: Optional[str] = None
     service_name: Optional[str] = None
     namespace: Optional[str] = None
@@ -222,17 +235,21 @@ class StaticServiceDiscovery(ServiceDiscovery):
         prefill_model_labels: Optional[List[str]] = None,
         decode_model_labels: Optional[List[str]] = None,
         health_check_interval: float = 60.0,
+        pools: Optional[List[str]] = None,
     ):
         urls = urls or []
         models = models or []
         if len(urls) != len(models):
             raise ValueError("static urls and models must have the same length")
+        if pools and len(pools) != len(urls):
+            raise ValueError("static pools and urls must have the same length")
         self.app = app
         self.urls = urls
         self.models = models
         self.aliases = aliases or {}
         self.model_labels = model_labels
         self.model_types = model_types
+        self.pools = pools
         # pstlint: owned-by=task:__init__
         self.engine_ids = [str(uuid.uuid4()) for _ in urls]
         self.added_timestamp = time.time()
@@ -460,6 +477,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     sleep=False,
                     draining=url in self._draining,
                     warming=url in self._warming,
+                    pool=(self.pools[i] if self.pools else "fused"),
                     model_info={model: ModelInfo(id=model)},
                 )
             )
@@ -665,6 +683,7 @@ class K8sPodIPServiceDiscovery(_K8sWatcherBase):
             sleep=sleep,
             draining=draining,
             warming=warming,
+            pool=_pool_label(labels),
             pod_name=name,
             namespace=self.namespace,
             model_info=model_info,
@@ -726,6 +745,7 @@ class K8sServiceNameServiceDiscovery(_K8sWatcherBase):
             sleep=sleep,
             draining=draining,
             warming=warming,
+            pool=_pool_label(labels),
             service_name=name,
             namespace=self.namespace,
             model_info=model_info,

@@ -1,0 +1,76 @@
+"""Disaggregated prefill/decode pools: pool split + the ``pst_disagg_*``
+Prometheus surface (docs/disagg.md).
+
+Pools are declarative fleet shape (``EndpointInfo.pool``: ``prefill`` |
+``decode`` | ``fused``): helm's ``servingEngineSpec.pool`` / the static
+``--static-pools`` list / the ``pst-pool`` pod label surface through
+discovery, and the router's two-leg disagg flow routes each leg within its
+pool. Fused engines stay eligible for BOTH legs, so a mixed fleet (or one
+that lost a whole pool) degrades gracefully instead of 503ing.
+
+Metrics declared in ``obs/metric_registry.py`` and documented in
+docs/observability.md ("Disagg" rows); the ``metric-registry`` pstlint
+check enforces the triangle.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from prometheus_client import Counter, Histogram
+
+POOL_PREFILL = "prefill"
+POOL_DECODE = "decode"
+POOL_FUSED = "fused"
+
+transfer_seconds = Histogram(
+    "pst_disagg_transfer_seconds",
+    "Wall time of the disagg prefill leg (dispatch to completion signal) "
+    "— the window the streamed KV transfer is overlapped into",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+overlap_seconds = Histogram(
+    "pst_disagg_overlap_seconds",
+    "Prefill wall overlapped with the decode leg's transfer+prefetch "
+    "(decode leg dispatched this long before the prefill response "
+    "returned; >0 = decode started before prefill finished)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0),
+)
+fallback_total = Counter(
+    "pst_disagg_fallback",
+    "Disagg requests that degraded to the fused path, by reason "
+    "(prefill_error = prefill leg exhausted its retries, the decode "
+    "engine recomputes; no_decode_backend = decode pool unroutable, "
+    "served fused on the prefill pool; deadline = budget died in or "
+    "between the legs). Decode-leg failover rides the ordinary "
+    "pst_resilience_* counters — its last-resort candidate IS the "
+    "prefill engine, i.e. the fused path.",
+    ["reason"],
+)
+
+
+def endpoint_pool(endpoint) -> str:
+    """An endpoint's declared pool, defaulting to fused (pre-pool
+    endpoints and fleets that never declare pools behave exactly as
+    before)."""
+    pool = getattr(endpoint, "pool", None)
+    return pool if pool in (POOL_PREFILL, POOL_DECODE) else POOL_FUSED
+
+
+def pool_candidates(endpoints: List, pool: str) -> List:
+    """The candidate list for one disagg leg: the pool's own engines plus
+    fused ones. An empty pool returns every endpoint — mixed fleets (and
+    fleets that lost a whole pool) degrade to the fused shape instead of
+    failing the request."""
+    own = [e for e in endpoints if endpoint_pool(e) == pool]
+    fused = [e for e in endpoints if endpoint_pool(e) == POOL_FUSED]
+    return (own + fused) if own or fused else list(endpoints)
+
+
+def fleet_has_pools(endpoints: List) -> bool:
+    """Disagg is the fleet shape when both a prefill and a decode pool are
+    declared — the router then runs the two-leg flow for every generation
+    request regardless of routing policy (docs/disagg.md)."""
+    pools = {endpoint_pool(e) for e in endpoints}
+    return POOL_PREFILL in pools and POOL_DECODE in pools

@@ -95,6 +95,8 @@ from ..state import get_state_backend
 from ..state import metrics as state_metrics
 from ..stats.engine_stats import get_engine_stats_scraper
 from ..stats.request_stats import get_request_stats_monitor
+from ...obs.tasks import spawn_owned
+from . import disagg
 from .callbacks import get_custom_callback_handler
 from .metrics_service import observe_slo_failure, observe_slo_ttft
 from .rewriter import get_request_rewriter
@@ -1514,19 +1516,29 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
             return cached
 
     router = get_routing_logic()
-    is_disagg = isinstance(router, DisaggregatedPrefillRouter)
 
     # Debug escape hatch: pin a specific engine by id with ?id=...
     pinned_id = request.query.get("id")
     if pinned_id:
         candidates = [e for e in endpoints if e.Id == pinned_id]
-    elif is_disagg:
+    elif isinstance(router, DisaggregatedPrefillRouter):
         # P/D pools serve under distinct labels; model filter happens per-pool.
         candidates = [e for e in endpoints if not e.sleep]
     else:
         candidates = [
             e for e in endpoints if (e.has_model(requested_model) and not e.sleep)
         ]
+    # Disagg is the fleet SHAPE, not just a routing policy
+    # (docs/disagg.md): the two-leg flow engages for the legacy
+    # label-split policy AND whenever THIS MODEL's serving set declares
+    # both a prefill and a decode pool — generation endpoints only (a
+    # pool split means nothing to embeddings/rerank), and another
+    # model's pools must never drag a fused-only model through the
+    # two-leg flow (its prefill would simply run twice).
+    is_disagg = isinstance(router, DisaggregatedPrefillRouter) or (
+        endpoint in ("/v1/completions", "/v1/chat/completions")
+        and disagg.fleet_has_pools(candidates)
+    )
     if not candidates:
         return _error_response(
             404,
@@ -1636,79 +1648,41 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
     )
 
 
-async def route_disaggregated_prefill_request(
+async def _disagg_prefill_leg(
     request: web.Request,
     endpoint: str,
-    request_json: dict,
-    endpoints: list,
+    prefill_json: dict,
+    candidates: list,
+    prefill_url: str,
     request_id: str,
-    deadline: Optional[Deadline] = None,
-) -> web.StreamResponse:
-    """Two-phase flow: prefill with max_tokens=1 (KV produced and shipped),
-    then decode streams from the decode pool with the KV pulled in.
+    deadline: Optional[Deadline],
+    trace,
+    headers: dict,
+) -> dict:
+    """The prefill leg: retry/failover across the prefill pool, same
+    per-attempt bounds as ``proxy_and_stream`` (nothing from the prefill
+    response reaches the client, so re-routing is always safe).
 
-    The deadline spans both legs: the prefill leg forwards the remaining
-    budget per attempt (and stops retrying when the budget cannot fit
-    another attempt), and whatever the prefill consumed is what the decode
-    leg has left.
-    """
-    router = get_routing_logic()
+    Returns ``{"ok", "url", "error", "shed", "done_at"}`` — under overlap
+    the caller treats this as a *completion signal* (a failure means the
+    decode engine's prefetch will time out into its fused recompute, not
+    a client error); the serial path turns failures into responses."""
     monitor = get_request_stats_monitor()
-    engine_stats = get_engine_stats_scraper().get_engine_stats()
-    request_stats = get_request_stats_monitor().get_request_stats(time.time())
-    trace = request.get("trace") or NOOP_TRACE
-    # Same relay contract as route_general_request: routing-time hops see
-    # the router-assigned id (the per-pool routing spans parent their own
-    # outbound attempts below). Both legs inherit the tenant stamp.
-    headers = hop_headers(dict(request.headers), request_id=request_id)
-    headers.update(_tenant_headers(request))
-
-    original_max_tokens = request_json.get("max_tokens")
-    original_stream = request_json.get("stream", False)
-    prefill_json = dict(request_json)
-    prefill_json["max_tokens"] = 1
-    prefill_json["stream"] = False
-    # Ask the engine to retain/publish KV for this request id so the decode
-    # engine can fetch it (kv_transfer_params mirrors the reference's
-    # connector config surface, deployment-vllm-multi.yaml:180-189).
-    prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
-
-    routing_span = trace.span(
-        "routing", attributes={"pool": "prefill",
-                               "policy": type(router).__name__}
-    )
-    try:
-        prefill_url = await route_with_resilience(
-            router, endpoints, engine_stats, request_stats, headers, prefill_json
-        )
-    except ValueError as e:
-        routing_span.set_attribute("outcome", "no_backend")
-        routing_span.end()
-        return _error_response(503, f"no prefill backend: {e}",
-                               "service_unavailable", request_id=request_id)
-    routing_span.set_attribute("engine", prefill_url)
-    routing_span.end()
-
     session: aiohttp.ClientSession = request.app["client_session"]
     policy = get_retry_policy()
-    failover = make_failover(endpoints, headers, prefill_json)
+    failover = make_failover(candidates, headers, prefill_json)
     tried = {prefill_url}
     attempt = 0
     while True:
         if deadline is not None and deadline.expired():
-            return _deadline_response(
-                "deadline exceeded before prefill attempt", "router_proxy",
-                trace=trace, request_id=request_id,
-            )
+            return {"ok": False, "url": prefill_url, "error": None,
+                    "shed": True, "done_at": time.monotonic()}
         prefill_span = trace.span(
             "disagg_prefill", attributes={"server": prefill_url}
         )
-        # Same per-attempt bounds and retry/failover semantics as
-        # proxy_and_stream — nothing from the prefill response reaches the
-        # client, so it is always safe to re-route. Without the timeout a
-        # black-holed prefill engine would hang the request forever with
-        # the breaker never fed. The prefill leg is non-streaming, so the
-        # remaining budget bounds the whole attempt.
+        # Without the timeout a black-holed prefill engine would hang the
+        # leg forever with the breaker never fed. The leg is
+        # non-streaming, so the remaining budget bounds the whole attempt.
         remaining = deadline.remaining_s() if deadline is not None else None
         attempt_timeout = aiohttp.ClientTimeout(
             total=max(remaining, 0.001) if remaining is not None else None,
@@ -1746,7 +1720,8 @@ async def route_disaggregated_prefill_request(
                 "disagg prefill for %s done in %.3fs",
                 request_id, time.time() - t_prefill_start,
             )
-            break
+            return {"ok": True, "url": prefill_url, "error": None,
+                    "shed": False, "done_at": time.monotonic()}
         monitor.on_request_complete(prefill_url, f"{request_id}-prefill", time.time())
         if error is not None:
             prefill_span.set_attribute("error", error)
@@ -1763,10 +1738,8 @@ async def route_disaggregated_prefill_request(
             # Budget exhausted mid-prefill: a deadline shed, not a failure.
             prefill_span.set_attribute("outcome", "deadline_shed")
             prefill_span.end()
-            return _deadline_response(
-                "deadline exceeded during prefill", "router_proxy",
-                trace=trace, request_id=request_id,
-            )
+            return {"ok": False, "url": prefill_url, "error": None,
+                    "shed": True, "done_at": time.monotonic()}
         else:
             _note_failure(prefill_url, request_id, span=prefill_span)
             prefill_span.set_attribute("outcome", "error")
@@ -1778,12 +1751,9 @@ async def route_disaggregated_prefill_request(
         else:
             next_url = await _next_backend(failover, tried, attempt)
         if next_url is None:
-            return _error_response(
-                502,
-                f"prefill failed: {error or 'engine draining'}",
-                "bad_gateway",
-                request_id=request_id,
-            )
+            return {"ok": False, "url": prefill_url,
+                    "error": error or "engine draining", "shed": False,
+                    "done_at": time.monotonic()}
         logger.warning(
             "prefill engine %s failed for %s (%s); failing over to %s",
             prefill_url, request_id, error or "draining", next_url,
@@ -1795,37 +1765,223 @@ async def route_disaggregated_prefill_request(
         prefill_url = next_url
         tried.add(prefill_url)
 
+
+async def route_disaggregated_prefill_request(
+    request: web.Request,
+    endpoint: str,
+    request_json: dict,
+    endpoints: list,
+    request_id: str,
+    deadline: Optional[Deadline] = None,
+) -> web.StreamResponse:
+    """Two-leg disagg flow with streamed KV handoff (docs/disagg.md).
+
+    With overlap on (the default) the decode leg dispatches CONCURRENTLY
+    with the prefill leg: the prefill engine publishes each chunk's KV
+    pages to the remote block store as the chunk completes, the decode
+    engine follows the request's manifest and prefetches them while the
+    prefill is still running, and the first decode step dispatches as
+    soon as the final block lands — the prefill response is a completion
+    *signal*, not a gate. Transfer failure at any point degrades to the
+    fused path (the serving engine recomputes the prefill) with no
+    client-visible error, counted in ``pst_disagg_fallback_total``.
+
+    The deadline spans both legs: each leg forwards the remaining budget
+    per attempt, and a budget that dies between the legs sheds with a
+    tagged 504 before the decode leg dispatches.
+    """
+    router = get_routing_logic()
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    trace = request.get("trace") or NOOP_TRACE
+    # Same relay contract as route_general_request: routing-time hops see
+    # the router-assigned id (the per-pool routing spans parent their own
+    # outbound attempts below). Both legs inherit the tenant stamp.
+    headers = hop_headers(dict(request.headers), request_id=request_id)
+    headers.update(_tenant_headers(request))
+
+    # Pool split (docs/disagg.md): each leg routes within its declared
+    # pool plus the fused engines; an empty pool degrades to the whole
+    # candidate list so mixed fleets keep serving.
+    prefill_candidates = disagg.pool_candidates(endpoints, disagg.POOL_PREFILL)
+    decode_candidates = disagg.pool_candidates(endpoints, disagg.POOL_DECODE)
+
+    original_max_tokens = request_json.get("max_tokens")
+    original_stream = request_json.get("stream", False)
+    prefill_json = dict(request_json)
+    prefill_json["max_tokens"] = 1
+    prefill_json["stream"] = False
+    # Ask the engine to retain/publish KV for this request id so the decode
+    # engine can fetch it (kv_transfer_params mirrors the reference's
+    # connector config surface, deployment-vllm-multi.yaml:180-189) — the
+    # producer role makes the engine's streamed publisher ship each
+    # prefill chunk's pages under this id as the chunk completes.
+    prefill_json["kv_transfer_params"] = {
+        "request_id": request_id, "role": "producer", "pool": "prefill",
+    }
+
+    routing_span = trace.span(
+        "routing", attributes={"pool": "prefill",
+                               "policy": type(router).__name__}
+    )
+    try:
+        prefill_url = await route_with_resilience(
+            router, prefill_candidates, engine_stats, request_stats, headers,
+            prefill_json,
+        )
+    except ValueError as e:
+        routing_span.set_attribute("outcome", "no_backend")
+        routing_span.end()
+        return _error_response(503, f"no prefill backend: {e}",
+                               "service_unavailable", request_id=request_id)
+    routing_span.set_attribute("engine", prefill_url)
+    routing_span.end()
+
     decode_json = dict(request_json)
     if original_max_tokens is not None:
         decode_json["max_tokens"] = original_max_tokens
     decode_json["stream"] = original_stream
-    decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
-    decode_json["kv_transfer_params"]["prefill_url"] = prefill_url
+    decode_json["kv_transfer_params"] = {
+        "request_id": request_id, "role": "consumer", "pool": "decode",
+        "prefill_url": prefill_url,
+    }
     routing_span = trace.span(
         "routing", attributes={"pool": "decode",
                                "policy": type(router).__name__}
     )
     try:
         decode_url = await route_with_resilience(
-            router, endpoints, engine_stats, request_stats, headers, decode_json
+            router, decode_candidates, engine_stats, request_stats, headers,
+            decode_json,
         )
-    except ValueError as e:
+    except ValueError:
+        # No routable decode pool: serve the request FUSED on the prefill
+        # pool (it holds the model too) — degradation, not a 503.
         routing_span.set_attribute("outcome", "no_backend")
         routing_span.end()
-        return _error_response(503, f"no decode backend: {e}",
-                               "service_unavailable", request_id=request_id)
+        disagg.fallback_total.labels(reason="no_decode_backend").inc()
+        fused_json = dict(request_json)
+        fused_json.pop("kv_transfer_params", None)
+        return await proxy_and_stream(
+            request, prefill_url, endpoint,
+            json.dumps(fused_json).encode(), request_id,
+            debug_headers={"X-Disagg-Fallback": "no_decode_backend"},
+            failover=make_failover(prefill_candidates, headers, fused_json),
+            deadline=deadline,
+        )
     routing_span.set_attribute("engine", decode_url)
     routing_span.end()
-    return await proxy_and_stream(
-        request,
-        decode_url,
-        endpoint,
-        json.dumps(decode_json).encode(),
-        request_id,
-        debug_headers={"X-Prefill-Url": prefill_url, "X-Decode-Url": decode_url},
-        failover=make_failover(endpoints, headers, decode_json),
-        deadline=deadline,
+
+    # Decode-leg failover list: the decode pool first, then the prefill
+    # engine as the last resort — it holds the freshly computed KV
+    # resident, so serving the full request there IS the fused path.
+    decode_failover = list(decode_candidates)
+    if all(e.url != prefill_url for e in decode_failover):
+        decode_failover += [e for e in endpoints if e.url == prefill_url]
+
+    overlap_enabled = bool(
+        getattr(request.app.get("args"), "disagg_overlap", True)
     )
+    serial_outcome: Optional[dict] = None
+    prefill_task: Optional[asyncio.Task] = None
+    t_prefill_dispatch = time.monotonic()
+    if overlap_enabled:
+        # THE overlap: the prefill leg becomes a concurrent task whose
+        # response is a completion signal; the decode leg dispatches NOW
+        # and prefetches the streamed KV while the prefill runs.
+        prefill_task = spawn_owned(
+            _disagg_prefill_leg(
+                request, endpoint, prefill_json, prefill_candidates,
+                prefill_url, request_id, deadline, trace, headers,
+            ),
+            name=f"disagg-prefill:{request_id}",
+        )
+    else:
+        serial_outcome = await _disagg_prefill_leg(
+            request, endpoint, prefill_json, prefill_candidates,
+            prefill_url, request_id, deadline, trace, headers,
+        )
+        if serial_outcome["shed"]:
+            return _deadline_response(
+                "deadline exceeded during prefill", "router_proxy",
+                trace=trace, request_id=request_id,
+            )
+        if not serial_outcome["ok"]:
+            disagg.fallback_total.labels(reason="prefill_error").inc()
+            return _error_response(
+                502, f"prefill failed: {serial_outcome['error']}",
+                "bad_gateway", request_id=request_id,
+            )
+        disagg.transfer_seconds.observe(
+            max(serial_outcome["done_at"] - t_prefill_dispatch, 0.0)
+        )
+        # Serial flow: zero overlap by construction (the old gate).
+        disagg.overlap_seconds.observe(0.0)
+
+    # Budget died between the legs (or while the overlap was being set
+    # up): shed with the tagged 504 before dispatching the decode leg.
+    if deadline is not None and deadline.expired():
+        if prefill_task is not None:
+            prefill_task.cancel()
+        disagg.fallback_total.labels(reason="deadline").inc()
+        return _deadline_response(
+            "deadline exceeded between disagg legs", "router_proxy",
+            trace=trace, request_id=request_id,
+        )
+
+    t_decode_dispatch = time.monotonic()
+    try:
+        return await proxy_and_stream(
+            request,
+            decode_url,
+            endpoint,
+            json.dumps(decode_json).encode(),
+            request_id,
+            debug_headers={"X-Prefill-Url": prefill_url,
+                           "X-Decode-Url": decode_url},
+            failover=make_failover(decode_failover, headers, decode_json),
+            deadline=deadline,
+        )
+    finally:
+        if prefill_task is not None:
+            # Completion signal, not a gate: the decode response is done
+            # (or the client left) — collect the prefill outcome with a
+            # bounded wait so a hung leg can never pin this handler.
+            try:
+                outcome = await asyncio.wait_for(
+                    asyncio.shield(prefill_task), timeout=30.0
+                )
+            except asyncio.CancelledError:
+                # The handler itself is being torn down (client gone):
+                # release the leg and let the cancellation propagate.
+                prefill_task.cancel()
+                raise
+            except (asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
+                prefill_task.cancel()
+                logger.warning(
+                    "disagg prefill leg for %s did not complete: %s",
+                    request_id, e,
+                )
+                outcome = None
+            if outcome is not None:
+                disagg.transfer_seconds.observe(
+                    max(outcome["done_at"] - t_prefill_dispatch, 0.0)
+                )
+                # >0 means the decode leg was in flight before the
+                # prefill response returned — decode started before
+                # prefill finished, the number the tentpole is about.
+                disagg.overlap_seconds.observe(
+                    max(outcome["done_at"] - t_decode_dispatch, 0.0)
+                )
+                if not outcome["ok"]:
+                    # The decode engine's prefetch times out into its
+                    # fused recompute; the client saw no error. A budget
+                    # death inside the leg is a shed, not engine failure
+                    # — it keeps its own reason.
+                    disagg.fallback_total.labels(
+                        reason="deadline" if outcome["shed"]
+                        else "prefill_error"
+                    ).inc()
 
 
 async def _admin_fanout(targets, call) -> dict:

@@ -21,7 +21,6 @@ from ...utils import parse_comma_separated
 from .base import (
     PROVIDER_BREAKERS,
     PROVIDER_CANARY_TTFT,
-    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
@@ -75,7 +74,6 @@ __all__ = [
     "InMemoryStateBackend",
     "PROVIDER_BREAKERS",
     "PROVIDER_CANARY_TTFT",
-    "PROVIDER_ENDPOINT_LOADS",
     "PROVIDER_ENDPOINTS",
     "PROVIDER_FLEET_SNAPSHOT",
     "PROVIDER_REQUEST_STATS",

@@ -29,10 +29,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 PROVIDER_REQUEST_STATS = "request_stats"
 PROVIDER_ENDPOINTS = "endpoints"
 PROVIDER_BREAKERS = "breakers"
-# Fleet routing's per-engine routed-in-flight loads (url -> float): the
-# scoring input behind the bounded-load constraint, replicated so every
-# replica sheds a hot-spotted engine at the same threshold.
-PROVIDER_ENDPOINT_LOADS = "endpoint_loads"
+# (There is deliberately NO endpoint-loads provider: fleet routing's
+# bounded-load input is the in_prefill/in_decoding counts already riding
+# the request_stats digest — one provider, one merge; docs/router-ha.md.)
 # Canary-probe TTFT per engine (url -> seconds): the health input fleet
 # scoring multiplies in. Replicated so replicas whose probes diverged
 # (only one of them saw an engine's failed probe) still score that
@@ -127,15 +126,6 @@ class StateBackend:
     def peer_request_stats(self) -> Dict[str, Dict[str, dict]]:
         """replica-id -> {engine-url -> compact stats dict} for live
         peers; the monitor merges these additively into its local view."""
-        return {}
-
-    # -- endpoint loads (fleet-routing scoring input) ----------------------
-
-    def peer_endpoint_loads(self) -> Dict[str, Dict[str, float]]:
-        """replica-id -> {engine-url -> routed-in-flight load} for live
-        peers; fleet scoring sums these into its local view so the
-        bounded-load spill decision converges across replicas. Single
-        replica: no peers, no remote load."""
         return {}
 
     # -- canary health (fleet-scoring health input) ------------------------

@@ -46,7 +46,6 @@ from ...obs.tasks import spawn_owned
 from .base import (
     PROVIDER_BREAKERS,
     PROVIDER_CANARY_TTFT,
-    PROVIDER_ENDPOINT_LOADS,
     PROVIDER_ENDPOINTS,
     PROVIDER_FLEET_SNAPSHOT,
     PROVIDER_REQUEST_STATS,
@@ -69,7 +68,7 @@ class _Peer:
     """Last-known state of one remote replica, keyed by replica id."""
 
     __slots__ = (
-        "seen", "endpoints", "stats", "breakers", "loads", "canary", "fleet",
+        "seen", "endpoints", "stats", "breakers", "canary", "fleet",
     )
 
     def __init__(self) -> None:
@@ -82,7 +81,6 @@ class _Peer:
         self.breakers: Dict[str, str] = {}
         # Fleet-routing scoring input (routed-in-flight per engine).
         # pstlint: owned-by=task:_apply
-        self.loads: Dict[str, float] = {}
         # Canary TTFT per engine (fleet-scoring health input; replicated
         # so replica scoring agrees after a failed probe).
         # pstlint: owned-by=task:_apply
@@ -244,9 +242,6 @@ class GossipStateBackend(StateBackend):
     def peer_request_stats(self) -> Dict[str, Dict[str, dict]]:
         return {rid: p.stats for rid, p in self._live_peers().items()}
 
-    def peer_endpoint_loads(self) -> Dict[str, Dict[str, float]]:
-        return {rid: p.loads for rid, p in self._live_peers().items()}
-
     def peer_canary_ttfts(self) -> Dict[str, Dict[str, float]]:
         return {rid: p.canary for rid, p in self._live_peers().items()}
 
@@ -321,7 +316,6 @@ class GossipStateBackend(StateBackend):
             "endpoints": list(self._provide(PROVIDER_ENDPOINTS, [])),
             "stats": self._provide(PROVIDER_REQUEST_STATS, {}),
             "breakers": self._provide(PROVIDER_BREAKERS, {}),
-            "loads": self._provide(PROVIDER_ENDPOINT_LOADS, {}),
             "canary": self._provide(PROVIDER_CANARY_TTFT, {}),
             "fleet": self._provide(PROVIDER_FLEET_SNAPSHOT, {}),
             "prefix": [
@@ -357,8 +351,6 @@ class GossipStateBackend(StateBackend):
         peer.stats = stats if isinstance(stats, dict) else {}
         breakers = digest.get("breakers")
         peer.breakers = breakers if isinstance(breakers, dict) else {}
-        loads = digest.get("loads")
-        peer.loads = loads if isinstance(loads, dict) else {}
         canary = digest.get("canary")
         peer.canary = canary if isinstance(canary, dict) else {}
         fleet = digest.get("fleet")

@@ -24,6 +24,7 @@ import uuid
 from collections import OrderedDict
 from typing import List, Optional
 
+import aiohttp
 import xxhash
 from aiohttp import web
 
@@ -72,10 +73,34 @@ def kv_chunk_hashes(text: str) -> List[int]:
 
 class FakeEngineState:
     def __init__(self, model: str, speed: float, max_tokens_default: int = 32,
-                 kv_capacity_tokens: int = 20000):
+                 kv_capacity_tokens: int = 20000, kv_url: Optional[str] = None):
         self.model = model
         self.speed = speed  # tokens per second
         self.max_tokens_default = max_tokens_default
+        # Streamed disagg KV handoff (docs/disagg.md): with a kvserver URL
+        # configured, a producer-leg generation publishes deterministic
+        # block manifests + pages per simulated prefill chunk, and a
+        # consumer-leg generation follows the manifest and batch-fetches
+        # them BEFORE decoding — the real handoff protocol without TPUs.
+        self.kv_url = kv_url.rstrip("/") if kv_url else None
+        self.kv_transfer_timeout = 5.0
+        self.kv_published_blocks = 0
+        self.kv_prefetched_blocks = 0
+        self.kv_transfer_fallbacks = 0
+        self.manifest_fetches = 0
+        self.kv_publish_chunks = 3  # simulated prefill chunk count
+        self.kv_chunk_delay = 0.02  # seconds between chunk publishes
+        # Opt-in chip queueing model (--chip-ms-per-ktok; bench's disagg
+        # phase): one "chip" per engine processes slices FIFO — a prefill
+        # is one big exclusive slice (this many ms per 1000 prompt
+        # tokens), each decode token a small one. On a fused engine every
+        # prefill queues behind in-flight decode slices and vice versa —
+        # exactly the head-of-line interference P/D disaggregation
+        # removes. A consumer leg whose prefetch completed pays only a
+        # tail slice (10%): its prefix KV arrived over the wire. 0 = off
+        # (the legacy instant-concurrency behavior every other test
+        # relies on).
+        self.chip_ms_per_ktok = 0.0
         self.num_running = 0
         self.num_waiting = 0
         # Token-weighted prefix-cache accounting, fed by the simulated
@@ -98,6 +123,11 @@ class FakeEngineState:
         self.requests_seen: List[dict] = []
         # Fault injection (resilience tests): POST /admin/fail arms one of
         #   error — respond fail_status (default 500) immediately
+        #   transfer — break the disagg KV handoff only: a producer leg
+        #           publishes nothing (its manifest never completes) and a
+        #           consumer leg finds nothing — both degrade to the fused
+        #           path and count kv_transfer_fallbacks; the generation
+        #           itself still succeeds (no client-visible error)
         #   hang  — accept the request and never answer
         #   midstream — stream fail_after_chunks delta chunks, then drop
         #               the connection (tests the never-replay-after-
@@ -303,6 +333,79 @@ class FakeEngineState:
         return mode
 
 
+class ChipSim:
+    """Opt-in chip contention model (--chip-ms-per-ktok; bench's disagg
+    phase), shaped like a continuously-batched serving chip:
+
+    - a PREFILL is one **exclusive** slice — it stalls the running decode
+      batch for its whole duration (the ITL hiccup / TTFT head-of-line
+      interference fused engines suffer);
+    - DECODE bursts are **shared** — all running streams burst
+      concurrently (continuous batching), but no burst may start while a
+      prefill runs or waits, and a prefill waits for in-flight bursts to
+      drain (≤ one burst residual).
+
+    Disaggregation removes exactly the cross-class interference this
+    models: a prefill-pool chip never stalls on decode bursts, a
+    decode-pool chip only pays tail-compute slices.
+    """
+
+    # Prefill slowdown per concurrently-decoding stream: a fused chip's
+    # prefill competes with the running decode batch for compute/HBM
+    # bandwidth — dedicated prefill chips escape exactly this factor.
+    DECODE_DRAG = 0.35
+
+    def __init__(self):
+        self._cond = asyncio.Condition()
+        self._prefill_active = False
+        self._prefill_waiting = 0
+        self._decode_bursts = 0
+        self.decode_streams = 0
+
+    def enter_decode(self) -> None:
+        self.decode_streams += 1
+
+    def exit_decode(self) -> None:
+        self.decode_streams = max(self.decode_streams - 1, 0)
+
+    def prefill_drag(self) -> float:
+        """How much slower a prefill runs with the current decode batch
+        resident on this chip."""
+        return 1.0 + self.DECODE_DRAG * self.decode_streams
+
+    async def acquire_prefill(self) -> None:
+        async with self._cond:
+            self._prefill_waiting += 1
+            while self._prefill_active or self._decode_bursts:
+                await self._cond.wait()
+            self._prefill_waiting -= 1
+            self._prefill_active = True
+
+    async def release_prefill(self) -> None:
+        async with self._cond:
+            self._prefill_active = False
+            self._cond.notify_all()
+
+    async def prefill_slice(self, duration: float) -> None:
+        await self.acquire_prefill()
+        try:
+            await asyncio.sleep(max(duration, 0.0) * self.prefill_drag())
+        finally:
+            await self.release_prefill()
+
+    async def decode_burst(self, duration: float) -> None:
+        async with self._cond:
+            while self._prefill_active or self._prefill_waiting:
+                await self._cond.wait()
+            self._decode_bursts += 1
+        try:
+            await asyncio.sleep(max(duration, 0.0))
+        finally:
+            async with self._cond:
+                self._decode_bursts -= 1
+                self._cond.notify_all()
+
+
 def _prompt_text(body: dict) -> str:
     """Flatten the request prompt (chat messages or completions prompt)
     into one text blob — the fake model's whole world view."""
@@ -352,14 +455,128 @@ def create_fake_engine_app(
     ready_delay: float = 0.0,
     warmup_cache_dir: Optional[str] = None,
     kv_capacity_tokens: int = 20000,
+    kv_url: Optional[str] = None,
 ) -> web.Application:
-    state = FakeEngineState(model, speed, kv_capacity_tokens=kv_capacity_tokens)
+    state = FakeEngineState(model, speed, kv_capacity_tokens=kv_capacity_tokens,
+                            kv_url=kv_url)
     # Instance identity for routing-distribution e2e assertions: surfaces in
     # the X-Served-By header of every generation response.
     state.name = name or f"fake-{uuid.uuid4().hex[:6]}"
     state.configure_warmup(ready_delay, warmup_cache_dir)
     app = web.Application()
     app["state"] = state
+    # One simulated chip per engine for the opt-in contention model
+    # (state.chip_ms_per_ktok; bench's disagg phase).
+    app["chip"] = ChipSim()
+
+    def _kv_session() -> aiohttp.ClientSession:
+        sess = app.get("kv_session")
+        if sess is None or sess.closed:
+            sess = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10)
+            )
+            app["kv_session"] = sess
+        return sess
+
+    async def _close_kv_session(app_: web.Application) -> None:
+        sess = app_.get("kv_session")
+        if sess is not None and not sess.closed:
+            await sess.close()
+
+    app.on_cleanup.append(_close_kv_session)
+
+    async def _kv_publish(rid: str, hashes: List[int], faulted: bool,
+                          chunk_delay: Optional[float] = None) -> None:
+        """Producer leg: publish deterministic pages + manifest appends in
+        ``kv_publish_chunks`` batches with a delay between them — the
+        simulated chunked prefill the decode side overlaps against. A
+        ``transfer`` fault (or a dead kvserver) publishes nothing, so the
+        manifest never completes and the consumer times out into its
+        fused fallback."""
+        from ..kvserver.server import pack_blocks
+
+        n = max(state.kv_publish_chunks, 1)
+        per = max(-(-len(hashes) // n), 1)
+        sent = 0
+        for i in range(0, len(hashes), per):
+            chunk = hashes[i : i + per]
+            if not faulted:
+                try:
+                    sess = _kv_session()
+                    body = pack_blocks(
+                        [(h, f"page-{h}".encode()) for h in chunk]
+                    )
+                    async with sess.post(
+                        f"{state.kv_url}/blocks", data=body
+                    ) as r:
+                        r.raise_for_status()
+                    async with sess.post(
+                        f"{state.kv_url}/manifests/{rid}",
+                        json={"hashes": chunk},
+                    ) as r:
+                        r.raise_for_status()
+                    sent += len(chunk)
+                    state.kv_published_blocks += len(chunk)
+                except (aiohttp.ClientError, OSError):
+                    faulted = True  # kvserver died mid-transfer
+            await asyncio.sleep(
+                state.kv_chunk_delay if chunk_delay is None else chunk_delay
+            )
+        if faulted:
+            state.kv_transfer_fallbacks += 1
+            return
+        try:
+            async with _kv_session().post(
+                f"{state.kv_url}/manifests/{rid}",
+                json={"complete": True, "total_blocks": len(hashes)},
+            ) as r:
+                r.raise_for_status()
+        except (aiohttp.ClientError, OSError):
+            state.kv_transfer_fallbacks += 1
+
+    async def _kv_prefetch(rid: str, faulted: bool) -> dict:
+        """Consumer leg: follow the manifest (long-poll) and batch-fetch
+        published blocks until the completion marker — the real handoff
+        protocol. Timeout/fault → fused fallback (serve anyway)."""
+        from ..kvserver.server import unpack_blocks
+
+        expire = time.monotonic() + state.kv_transfer_timeout
+        have = 0
+        fetched = 0
+        complete = False
+        while not faulted and time.monotonic() < expire:
+            remaining = expire - time.monotonic()
+            try:
+                sess = _kv_session()
+                async with sess.get(
+                    f"{state.kv_url}/manifests/{rid}",
+                    params={"wait_s": round(min(remaining, 0.5), 3),
+                            "have": have},
+                ) as r:
+                    state.manifest_fetches += 1
+                    if r.status != 200:
+                        await asyncio.sleep(0.02)
+                        continue
+                    view = await r.json()
+                new = (view.get("hashes") or [])[have:]
+                if new:
+                    async with sess.get(
+                        f"{state.kv_url}/blocks",
+                        params={"hashes": ",".join(str(h) for h in new)},
+                    ) as r:
+                        fetched += len(unpack_blocks(await r.read()))
+                have = len(view.get("hashes") or [])
+                if view.get("complete") and have >= int(
+                    view.get("total_blocks") or 0
+                ):
+                    complete = True
+                    break
+            except (aiohttp.ClientError, OSError, ValueError):
+                await asyncio.sleep(0.05)
+        state.kv_prefetched_blocks += fetched
+        if not complete:
+            state.kv_transfer_fallbacks += 1
+        return {"complete": complete, "blocks": fetched}
 
     async def list_models(request: web.Request) -> web.Response:
         return web.json_response(_models_payload(state))
@@ -489,6 +706,15 @@ def create_fake_engine_app(
         n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
         stream = bool(body.get("stream", False))
         die_midstream = fault == "midstream"
+        # Disagg KV handoff (docs/disagg.md): the router's two-leg flow
+        # stamps kv_transfer_params; with a kvserver configured this fake
+        # speaks the real manifest protocol. A `transfer` fault breaks
+        # ONLY the handoff (fused fallback, no client-visible error).
+        kv_params = body.get("kv_transfer_params")
+        kv_params = kv_params if isinstance(kv_params, dict) else {}
+        kv_rid = kv_params.get("request_id")
+        kv_role = kv_params.get("role")
+        transfer_fault = fault == "transfer"
         state.num_running += 1
         req_id = f"fake-{uuid.uuid4().hex[:12]}"
         token_interval = 1.0 / state.speed if state.speed > 0 else 0.0
@@ -523,6 +749,9 @@ def create_fake_engine_app(
             body.get("model"), bool(body.get("stream")),
             body.get("max_tokens"),
         )
+        chip = request.app.get("chip")
+        chip_on = state.chip_ms_per_ktok > 0 and chip is not None
+        decode_entered = False
         try:
             # Mirror the real engine's stage decomposition so mixed-workload
             # e2e tests see engine-side pst_stage_duration_seconds labels
@@ -530,12 +759,80 @@ def create_fake_engine_app(
             observe_stage("engine", "engine_admission",
                           time.monotonic() - t_admission,
                           trace_id=trace_id)
+            prefetch_complete = False
+            if kv_rid and state.kv_url and kv_role == "consumer":
+                # Prefetch BEFORE the chip: following the manifest is
+                # DCN work, not compute — it overlaps the remote prefill.
+                t_fetch = time.monotonic()
+                fetch = await _kv_prefetch(str(kv_rid), transfer_fault)
+                prefetch_complete = fetch["complete"]
+                observe_stage("engine", "kv_prefetch",
+                              time.monotonic() - t_fetch, trace_id=trace_id)
             t_prefill = time.monotonic()
             if ttft:
                 await asyncio.sleep(ttft)
+            prefill_s = 0.0
+            if chip_on:
+                prefill_s = (prompt_tokens / 1000.0) * (
+                    state.chip_ms_per_ktok / 1000.0
+                )
+                if kv_role == "consumer" and prefetch_complete:
+                    prefill_s *= 0.1  # prefix arrived over the wire
+            if kv_rid and state.kv_url and kv_role == "producer":
+                # The simulated chunked prefill IS the publish loop: each
+                # chunk's blocks land on the store before the next chunk
+                # "computes", so a concurrently dispatched decode leg
+                # observes genuine transfer/prefill overlap. Under the
+                # chip model the prefill slice is exclusive and the
+                # per-chunk pacing IS the slice (publishing adds no wall
+                # beyond the compute it rides).
+                if chip_on:
+                    # The publisher runs OFF the step thread in the real
+                    # engine: the chunk-paced publish overlaps the
+                    # exclusive prefill slice instead of inflating it
+                    # with DCN round trips.
+                    n_chunks = max(state.kv_publish_chunks, 1)
+                    pub = asyncio.ensure_future(_kv_publish(
+                        str(kv_rid), kv_chunk_hashes(prompt_text),
+                        transfer_fault,
+                        chunk_delay=prefill_s / n_chunks,
+                    ))
+                    try:
+                        await chip.prefill_slice(prefill_s)
+                    finally:
+                        await pub
+                else:
+                    await _kv_publish(
+                        str(kv_rid), kv_chunk_hashes(prompt_text),
+                        transfer_fault,
+                    )
+            elif chip_on and prefill_s > 0:
+                await chip.prefill_slice(prefill_s)
             observe_stage("engine", "prefill", time.monotonic() - t_prefill,
                           trace_id=trace_id)
             t_decode = time.monotonic()
+            decode_count = 0
+            if chip_on and n_tokens > 1:
+                # This request's decode stream joins the chip's resident
+                # batch: every prefill pays the drag while it lives.
+                chip.enter_decode()
+                decode_entered = True
+
+            async def decode_pace():
+                """One token of decode. Under the chip model tokens are
+                produced in bursts of 8 holding the chip exclusively —
+                the multi-step decode burst that makes an arriving
+                prefill wait, i.e. the interference disagg removes."""
+                nonlocal decode_count
+                if chip_on:
+                    if decode_count % 8 == 0:
+                        burst = min(8, n_tokens - decode_count)
+                        await chip.decode_burst(
+                            burst * (token_interval or 0.0005)
+                        )
+                    decode_count += 1
+                elif token_interval:
+                    await asyncio.sleep(token_interval)
             if stream:
                 resp = web.StreamResponse(status=200)
                 resp.headers["Content-Type"] = "text/event-stream"
@@ -590,8 +887,7 @@ def create_fake_engine_app(
                             "total_tokens": prompt_tokens + n_tokens,
                         }
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    if token_interval:
-                        await asyncio.sleep(token_interval)
+                    await decode_pace()
                 if die_midstream:
                     # fail_after_chunks >= max_tokens: death after the last
                     # delta but before the terminal [DONE].
@@ -604,7 +900,10 @@ def create_fake_engine_app(
                 await resp.write_eof()
                 return resp
             else:
-                if token_interval:
+                if chip_on:
+                    for _ in range(n_tokens):
+                        await decode_pace()
+                elif token_interval:
                     await asyncio.sleep(token_interval * n_tokens)
                 text = " ".join(f"tok{tok_start + i}" for i in range(n_tokens))
                 usage = {
@@ -648,6 +947,8 @@ def create_fake_engine_app(
                 )
         finally:
             state.num_running -= 1
+            if decode_entered:
+                chip.exit_decode()
 
     async def chat(request: web.Request) -> web.StreamResponse:
         return await _generate(request, is_chat=True)
@@ -735,6 +1036,15 @@ def create_fake_engine_app(
                 "# TYPE pst_engine_compile_cache_misses counter",
                 "pst_engine_compile_cache_misses_total "
                 f"{0 if state.warm_start else FAKE_WARMUP_BUCKETS}",
+                # Streamed disagg handoff (docs/disagg.md) — same pst:
+                # names as the real engine server.
+                "# TYPE pst:kv_published_blocks counter",
+                f"pst:kv_published_blocks_total {state.kv_published_blocks}",
+                "# TYPE pst:kv_prefetched_blocks counter",
+                f"pst:kv_prefetched_blocks_total {state.kv_prefetched_blocks}",
+                "# TYPE pst:kv_transfer_fallbacks counter",
+                "pst:kv_transfer_fallbacks_total "
+                f"{state.kv_transfer_fallbacks}",
                 "",
             ]
         )
@@ -797,6 +1107,10 @@ def create_fake_engine_app(
             "kv_occupancy": round(state.kv_occupancy, 4),
             "kv_capacity_tokens": state.kv_capacity_tokens,
             "cached_tokens": state.kv_tokens,
+            "kv_published_blocks": state.kv_published_blocks,
+            "kv_prefetched_blocks": state.kv_prefetched_blocks,
+            "kv_transfer_fallbacks": state.kv_transfer_fallbacks,
+            "manifest_fetches": state.manifest_fetches,
             "prefix_hit_rate": round(hit_rate, 4),
             # Matches the deterministic pst_engine_compile_total samples
             # in /metrics (3 prefill + 2 decode).
@@ -918,7 +1232,7 @@ def create_fake_engine_app(
         tenant's traffic while the victim's flows untouched)."""
         body = await request.json() if request.can_read_body else {}
         mode = body.get("mode", "error")
-        if mode not in ("error", "hang", "midstream", "slow"):
+        if mode not in ("error", "hang", "midstream", "slow", "transfer"):
             return web.json_response({"error": f"unknown mode {mode!r}"}, status=400)
         state.fail_mode = mode
         state.fail_status = int(body.get("status", 500))
@@ -1090,6 +1404,19 @@ def main(argv: Optional[list] = None) -> None:
                    help="simulated persistent compile cache: a marker left "
                         "by a previous instance makes this start warm "
                         "(shorter ready delay, all cache hits)")
+    p.add_argument("--chip-ms-per-ktok", type=float, default=0.0,
+                   help="opt-in chip queueing model: one FIFO chip per "
+                        "engine; a prefill is one exclusive slice of this "
+                        "many ms per 1000 prompt tokens, each decode "
+                        "token a small slice — models the prefill/decode "
+                        "head-of-line interference disagg removes "
+                        "(bench disagg phase; 0 = off)")
+    p.add_argument("--kv-url", default=None,
+                   help="remote KV block store (kvserver) base URL: "
+                        "enables the disagg handoff protocol — producer "
+                        "legs publish deterministic block manifests per "
+                        "simulated prefill chunk, consumer legs follow "
+                        "them and batch-fetch before decoding")
     p.add_argument("--kv-capacity-tokens", type=int, default=20000,
                    help="simulated KV capacity: occupancy and prefix-hit "
                         "eviction derive from it (small values make "
@@ -1107,7 +1434,9 @@ def main(argv: Optional[list] = None) -> None:
         args.model, args.speed, args.ttft, args.name,
         ready_delay=args.ready_delay, warmup_cache_dir=args.warmup_cache_dir,
         kv_capacity_tokens=args.kv_capacity_tokens,
+        kv_url=args.kv_url,
     )
+    app["state"].chip_ms_per_ktok = max(args.chip_ms_per_ktok, 0.0)
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 
 

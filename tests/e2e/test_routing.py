@@ -326,6 +326,106 @@ def leg_disagg():
     print("PASS disagg", dict(prefill_served), dict(decode_served))
 
 
+def leg_disagg_pools():
+    """Declarative P/D pools with the streamed KV handoff
+    (docs/disagg.md): 2 prefill + 2 decode fake engines + a real
+    kvserver, fleet policy. Every generation request runs the two-leg
+    flow: the prefill pool publishes block manifests per chunk, the
+    decode pool prefetches them while the prefill runs, pool-aware
+    routing splits the legs, and the router's overlap histogram proves
+    decode dispatched before the prefill response."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+    try:
+        kv_port = free_port()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.kvserver.server",
+             "--host", "127.0.0.1", "--port", str(kv_port)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+        kv_url = f"http://127.0.0.1:{kv_port}"
+        wait_http(f"{kv_url}/health")
+        pools = ["prefill", "prefill", "decode", "decode"]
+        eports = [free_port() for _ in pools]
+        for i, port in enumerate(eports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", MODEL, "--speed", "2000",
+                 "--name", f"{pools[i]}-{i}", "--kv-url", kv_url],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ))
+        for port in eports:
+            wait_http(f"http://127.0.0.1:{port}/health")
+        rport = free_port()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.router.app",
+             "--host", "127.0.0.1", "--port", str(rport),
+             "--service-discovery", "static",
+             "--static-backends",
+             ",".join(f"http://127.0.0.1:{p}" for p in eports),
+             "--static-models", ",".join([MODEL] * len(pools)),
+             "--static-pools", ",".join(pools),
+             "--routing-logic", "fleet",
+             "--engine-stats-interval", "1"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+        url = f"http://127.0.0.1:{rport}"
+        wait_http(f"{url}/health")
+
+        decode_served = Counter()
+        for i in range(12):
+            status, by, _ = post(
+                f"{url}/v1/completions",
+                {"model": MODEL, "prompt": f"pools rule {i} " * 20,
+                 "max_tokens": 4},
+            )
+            assert status == 200, status
+            decode_served[by] += 1
+        # Pool-aware routing: the client-facing leg lands on the decode
+        # pool only.
+        assert set(decode_served) <= {"decode-2", "decode-3"}, decode_served
+
+        def dbg(port):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/state", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        published = sum(dbg(p)["kv_published_blocks"] for p in eports[:2])
+        prefetched = sum(dbg(p)["kv_prefetched_blocks"] for p in eports[2:])
+        manifest_fetches = sum(dbg(p)["manifest_fetches"] for p in eports[2:])
+        fallbacks = sum(dbg(p)["kv_transfer_fallbacks"] for p in eports)
+        assert published > 0, "prefill pool never published"
+        assert prefetched == published, (prefetched, published)
+        assert manifest_fetches >= 12, manifest_fetches
+        assert fallbacks == 0, fallbacks
+
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_route_score_count") > 0
+        assert metric_value(metrics, "pst_disagg_overlap_seconds_count") >= 12
+        assert metric_value(metrics, "pst_disagg_overlap_seconds_sum") > 0, \
+            "decode never started before the prefill response"
+        # kvserver audit: one streamed copy per page, batched round trips.
+        with urllib.request.urlopen(f"{kv_url}/stats", timeout=5) as r:
+            st = json.loads(r.read())
+        assert st["blocks_put"] == published, st
+        assert st["put_calls"] < st["blocks_put"], st
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(f"PASS disagg_pools (published={published}, "
+          f"overlap_sum={metric_value(metrics, 'pst_disagg_overlap_seconds_sum'):.3f}s, "
+          f"decode={dict(decode_served)})")
+
+
 def leg_stress():
     """Concurrency leg: a burst of parallel streaming + non-streaming
     requests all succeed (reference stress-test.sh analogue)."""
@@ -1199,6 +1299,7 @@ LEGS = {
     "kvaware": leg_kvaware,
     "fleet": leg_fleet,
     "disaggregated_prefill": leg_disagg,
+    "disagg_pools": leg_disagg_pools,
     "stress": leg_stress,
     "chaos": leg_chaos,
     "router_kill": leg_router_kill,

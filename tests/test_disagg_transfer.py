@@ -1,0 +1,548 @@
+"""Transfer-correctness ring for the streamed disagg KV handoff
+(docs/disagg.md): manifest protocol round-trips, single-streamed-copy
+accounting, decode parity disagg-vs-fused (greedy AND sampled), fused
+fallback on kvserver death, the router's two-leg overlap, and deadline
+expiry between the legs.
+"""
+
+import asyncio
+import threading
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+from aiohttp import web
+from prometheus_client import REGISTRY
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+from production_stack_tpu.kvserver.server import (
+    create_kv_server_app,
+    pack_blocks,
+    unpack_blocks,
+)
+from production_stack_tpu.router.app import create_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import create_fake_engine_app
+
+from .router_utils import reset_router_singletons
+
+
+def _metric(name: str, **labels) -> float:
+    return REGISTRY.get_sample_value(name, labels or None) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# Framed batch serde
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_blocks_roundtrip():
+    pages = [(1, b"alpha"), (2**63 - 1, b""), (7, b"x" * 4096)]
+    assert unpack_blocks(pack_blocks(pages)) == pages
+
+
+def test_unpack_blocks_rejects_torn_frames():
+    buf = pack_blocks([(5, b"hello")])
+    with pytest.raises(ValueError):
+        unpack_blocks(buf[:-2])
+    with pytest.raises(ValueError):
+        unpack_blocks(buf + b"\x01\x02")
+
+
+# ---------------------------------------------------------------------------
+# kvserver: batched endpoints + manifests
+# ---------------------------------------------------------------------------
+
+
+async def test_kvserver_batched_blocks_and_manifest(aiohttp_client=None):
+    app = create_kv_server_app(max_bytes=1 << 20)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    base = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            # N pages, ONE round trip.
+            pages = [(h, f"pg{h}".encode()) for h in (11, 22, 33)]
+            async with s.post(f"{base}/blocks", data=pack_blocks(pages)) as r:
+                assert (await r.json())["stored"] == 3
+            async with s.get(
+                f"{base}/blocks", params={"hashes": "11,22,99"}
+            ) as r:
+                got = unpack_blocks(await r.read())
+            assert dict(got) == {11: b"pg11", 22: b"pg22"}  # 99 omitted
+            # Manifest: incremental appends, dedupe, completion marker.
+            async with s.post(f"{base}/manifests/r1",
+                              json={"hashes": [11, 22]}) as r:
+                assert (await r.json())["blocks"] == 2
+            async with s.post(f"{base}/manifests/r1",
+                              json={"hashes": [22, 33], "complete": True,
+                                    "total_blocks": 3}) as r:
+                body = await r.json()
+                assert body["blocks"] == 3 and body["complete"]
+            async with s.get(f"{base}/manifests/r1") as r:
+                view = await r.json()
+            assert view["hashes"] == [11, 22, 33]
+            assert view["complete"] and view["total_blocks"] == 3
+            # Long-poll returns early when progress lands.
+            async def append_later():
+                await asyncio.sleep(0.1)
+                async with s.post(f"{base}/manifests/r2",
+                                  json={"hashes": [1]}) as r2:
+                    assert r2.status == 200
+
+            t0 = time.monotonic()
+            task = asyncio.ensure_future(append_later())
+            async with s.get(f"{base}/manifests/r2",
+                             params={"wait_s": 5, "have": 0}) as r:
+                # Unknown rid until the append lands; the poll must not
+                # burn the whole window.
+                await r.json()
+            await task
+            assert time.monotonic() - t0 < 4.0
+            # Audit counters: one batched put call, three pages.
+            async with s.get(f"{base}/stats") as r:
+                st = await r.json()
+            assert st["put_calls"] == 1 and st["blocks_put"] == 3
+    finally:
+        await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Real-engine ring: streamed publish, single copy, parity, fallback
+# ---------------------------------------------------------------------------
+
+
+class ThreadedKVServer:
+    """The aiohttp KV store on its own loop/thread so synchronous engines
+    can call it with blocking HTTP — as in production."""
+
+    def __init__(self):
+        self.url = None
+        self._ready = threading.Event()
+        self._loop = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10), "KV server failed to start"
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            app = create_kv_server_app(max_bytes=1 << 30)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+            self.app = app
+            self._ready.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def stop(self):
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+@pytest.fixture()
+def kv_server():
+    server = ThreadedKVServer().start()
+    yield server
+    server.stop()
+
+
+def _engine(role: str, remote_url: str, **over) -> LLMEngine:
+    cfg = dict(
+        model="tiny-llama-debug", max_model_len=256, block_size=8,
+        num_kv_blocks=96, max_num_seqs=4, max_prefill_tokens=16,
+        remote_kv_url=remote_url, kv_role=role,
+    )
+    cfg.update(over)
+    return LLMEngine(EngineConfig(**cfg))
+
+
+def _gen(engine, prompt, sampling, kv_transfer=None):
+    rid = f"req-{id(sampling)}-{len(prompt)}"
+    engine.add_request(rid, prompt_token_ids=prompt, sampling=sampling,
+                       kv_transfer=kv_transfer)
+    out = {"token_ids": []}
+    while engine.has_work():
+        for o in engine.step():
+            out["token_ids"].extend(o.new_token_ids)
+    return out
+
+
+@pytest.mark.parametrize("sampling_kwargs", [
+    dict(temperature=0.0),                     # greedy
+    dict(temperature=0.8, top_p=0.9, seed=7),  # sampled, seeded
+])
+def test_disagg_decode_parity_and_single_copy(kv_server, sampling_kwargs):
+    """Decode output parity disagg-vs-fused, and the single-streamed-copy
+    accounting: each prefill page reaches the store EXACTLY once, in
+    batched round trips, with the manifest complete before the prefill
+    response would have returned."""
+    rng = np.random.default_rng(5)
+    prompt = [int(x) for x in rng.integers(1, 500, size=48)]  # 6 full blocks
+    sp = SamplingParams(max_tokens=8, ignore_eos=True, **sampling_kwargs)
+
+    fused = _engine("none", None, remote_kv_url=None, max_prefill_tokens=64)
+    expected = _gen(fused, prompt, sp)
+
+    producer = _engine("producer", kv_server.url)
+    rid = f"xfer-{sampling_kwargs['temperature']}"
+    sp_prefill = SamplingParams(max_tokens=1, temperature=0.0, ignore_eos=True)
+    _gen(producer, prompt, sp_prefill,
+         kv_transfer={"request_id": rid, "role": "producer"})
+    # The streamed publisher runs on its worker thread: wait for the
+    # completion marker.
+    deadline = time.monotonic() + 5.0
+    store = kv_server.app["store"]
+    manifests = kv_server.app["manifests"]
+    while time.monotonic() < deadline:
+        view = manifests.view(rid)
+        if view and view["complete"]:
+            break
+        time.sleep(0.02)
+    view = manifests.view(rid)
+    assert view and view["complete"] and view["total_blocks"] == 6
+    assert len(view["hashes"]) == 6
+    # Single streamed copy per page: 6 pages put, ever — and batched
+    # (fewer HTTP calls than pages, chunk-granular).
+    assert store.blocks_put == 6
+    assert store.put_calls < 6
+    assert producer.kv_published_blocks_total == 6
+
+    consumer = _engine("consumer", kv_server.url, max_prefill_tokens=64)
+    fetch = consumer.kv_prefetcher.prefetch(rid)
+    assert fetch["complete"] and fetch["blocks"] == 6
+    got = _gen(consumer, prompt, sp)
+    assert got["token_ids"] == expected["token_ids"]
+    # The decode engine computed almost nothing of the prefill.
+    assert consumer.allocator.host_hit_blocks >= 5
+    # No page was re-put by the consumer: still exactly one copy each.
+    assert store.blocks_put == 6
+
+
+def test_mid_transfer_kvserver_death_falls_back_fused(kv_server):
+    """The kvserver dies between the prefill publish and the decode
+    prefetch: the consumer's manifest poll times out, admission proceeds,
+    the prefill recomputes locally — same tokens, no error."""
+    rng = np.random.default_rng(9)
+    prompt = [int(x) for x in rng.integers(1, 500, size=40)]
+    sp = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    fused = _engine("none", None, remote_kv_url=None, max_prefill_tokens=64)
+    expected = _gen(fused, prompt, sp)
+
+    consumer = _engine("consumer", kv_server.url, max_prefill_tokens=64,
+                       kv_transfer_timeout_s=0.5)
+    kv_server.stop()
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    fetch = consumer.kv_prefetcher.prefetch("never-published")
+    assert not fetch["complete"]
+    assert time.monotonic() - t0 < 3.0  # bounded by the transfer timeout
+    assert consumer.kv_prefetcher.fallbacks == 1
+    got = _gen(consumer, prompt, sp)
+    assert got["token_ids"] == expected["token_ids"]
+
+
+# ---------------------------------------------------------------------------
+# Router two-leg overlap over fake engines + a real kvserver
+# ---------------------------------------------------------------------------
+
+
+class DisaggCluster:
+    """kvserver + pooled fake engines + the real router app."""
+
+    def __init__(self, pools=("prefill", "decode"), extra_args=None,
+                 routing_logic="roundrobin"):
+        self.pools = pools
+        self.extra_args = extra_args or []
+        self.routing_logic = routing_logic
+        self.runners = []
+        self.engine_urls = []
+        self.engine_apps = []
+
+    async def __aenter__(self):
+        kv_app = create_kv_server_app(max_bytes=1 << 30)
+        self.kv_app = kv_app
+        kv_runner = web.AppRunner(kv_app)
+        await kv_runner.setup()
+        site = web.TCPSite(kv_runner, "127.0.0.1", 0)
+        await site.start()
+        self.runners.append(kv_runner)
+        self.kv_url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+        for i, _pool in enumerate(self.pools):
+            app = create_fake_engine_app(
+                model="fake/model", speed=5000.0, name=f"eng-{i}",
+                kv_url=self.kv_url,
+            )
+            app["state"].kv_transfer_timeout = 2.0
+            self.engine_apps.append(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.runners.append(runner)
+            port = site._server.sockets[0].getsockname()[1]
+            self.engine_urls.append(f"http://127.0.0.1:{port}")
+        argv = [
+            "--service-discovery", "static",
+            "--static-backends", ",".join(self.engine_urls),
+            "--static-models", ",".join(["fake/model"] * len(self.pools)),
+            "--static-pools", ",".join(self.pools),
+            "--routing-logic", self.routing_logic,
+            "--engine-stats-interval", "0.2",
+            *self.extra_args,
+        ]
+        args = parse_args(argv)
+        router_app = create_app(args)
+        runner = web.AppRunner(router_app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        self.runners.append(runner)
+        port = site._server.sockets[0].getsockname()[1]
+        self.router_url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def __aexit__(self, *exc):
+        for runner in reversed(self.runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+    def engine_state(self, i):
+        return self.engine_apps[i]["state"]
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_router_singletons()
+    yield
+    reset_router_singletons()
+
+
+async def test_two_leg_overlap_decode_starts_before_prefill_response():
+    """The tentpole: with declared pools, a generation request runs the
+    two-leg flow — the producer publishes per chunk, the decode engine
+    prefetches while the prefill still runs, and the router observes
+    pst_disagg_overlap_seconds > 0 (decode dispatched before the prefill
+    response returned)."""
+    overlap_before = _metric("pst_disagg_overlap_seconds_sum")
+    count_before = _metric("pst_disagg_overlap_seconds_count")
+    async with DisaggCluster() as c:
+        # A prompt long enough for several manifest chunks.
+        prompt = "alpha bravo charlie " * 40
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": prompt,
+                      "max_tokens": 8},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["text"].startswith("tok0")
+                assert resp.headers.get("X-Prefill-Url") == c.engine_urls[0]
+                assert resp.headers.get("X-Decode-Url") == c.engine_urls[1]
+        prefill_state = c.engine_state(0)
+        decode_state = c.engine_state(1)
+        assert prefill_state.kv_published_blocks > 0
+        assert decode_state.kv_prefetched_blocks == prefill_state.kv_published_blocks
+        assert decode_state.manifest_fetches > 0
+        assert decode_state.kv_transfer_fallbacks == 0
+        # Single streamed copy per page, batched round trips.
+        store = c.kv_app["store"]
+        assert store.blocks_put == prefill_state.kv_published_blocks
+        assert store.put_calls < store.blocks_put
+    assert _metric("pst_disagg_overlap_seconds_count") == count_before + 1
+    assert _metric("pst_disagg_overlap_seconds_sum") > overlap_before
+
+
+async def test_transfer_fault_degrades_fused_no_client_error():
+    """`/admin/fail` mode=transfer on the prefill engine: nothing is
+    published, the decode leg's prefetch times out into the fused path,
+    and the client still gets a clean 200."""
+    async with DisaggCluster() as c:
+        c.engine_state(1).kv_transfer_timeout = 0.4
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail",
+                json={"mode": "transfer", "count": 1},
+            ) as r:
+                assert (await r.json())["mode"] == "transfer"
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "hello world",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["text"].startswith("tok0")
+        assert c.engine_state(0).kv_transfer_fallbacks >= 1  # producer side
+        assert c.engine_state(1).kv_transfer_fallbacks == 1  # consumer side
+        assert c.engine_state(1).kv_prefetched_blocks == 0
+
+
+async def test_prefill_leg_death_counts_fallback_client_clean():
+    """The whole prefill pool errors: the overlapped decode leg still
+    serves (fused recompute engine-side), the router counts
+    pst_disagg_fallback_total{reason=prefill_error}, client sees 200."""
+    before = _metric("pst_disagg_fallback_total", reason="prefill_error")
+    async with DisaggCluster(extra_args=["--proxy-retries", "0"]) as c:
+        c.engine_state(1).kv_transfer_timeout = 0.4
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail",
+                json={"mode": "error", "count": -1},
+            ) as r:
+                assert r.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "prefill is down",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["text"].startswith("tok0")
+    assert _metric(
+        "pst_disagg_fallback_total", reason="prefill_error"
+    ) == before + 1
+
+
+async def test_deadline_expiry_between_legs_sheds_tagged_504():
+    """Serial mode (--no-disagg-overlap): the prefill leg eats the whole
+    budget. Whichever check catches the expiry first — the between-legs
+    gate (pst_disagg_fallback{deadline}) or the decode dispatch's own
+    shed — the client contract holds: a tagged 504, no decode stream,
+    counted as a deadline shed and never as engine failure."""
+    fallback_before = _metric("pst_disagg_fallback_total", reason="deadline")
+
+    def sheds():
+        return sum(
+            _metric("pst_deadline_sheds_total", stage=s)
+            for s in ("router_proxy", "router_retry")
+        ) + _metric("pst_disagg_fallback_total", reason="deadline")
+
+    sheds_before = sheds()
+    failures_before = _metric("pst_resilience_upstream_failures_total")
+    async with DisaggCluster(
+        extra_args=["--no-disagg-overlap"],
+    ) as c:
+        # The slow fault honors the propagated budget: the prefill leg
+        # succeeds just under the deadline, leaving (almost) nothing for
+        # the decode leg.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{c.engine_urls[0]}/admin/fail",
+                json={"mode": "slow", "delay": 0.25, "count": 1},
+            ) as r:
+                assert r.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "q",
+                      "max_tokens": 4},
+                headers={"X-PST-Deadline-Ms": "280"},
+            ) as resp:
+                assert resp.status == 504
+                assert resp.headers.get("X-PST-Deadline-Exceeded") == "1"
+    assert sheds() >= sheds_before + 1
+    # A budget death is never engine failure: the breakers were not fed.
+    assert _metric(
+        "pst_resilience_upstream_failures_total"
+    ) == failures_before
+    assert _metric(
+        "pst_disagg_fallback_total", reason="deadline"
+    ) >= fallback_before
+
+
+async def test_other_models_pools_do_not_drag_fused_model_into_disagg():
+    """Multi-model fleet: model A runs on P/D pools, model B on a plain
+    fused engine. A model-B request must take the ordinary single-proxy
+    path — another model's pools must not make B's prefill run twice."""
+    reset_router_singletons()
+    runners = []
+    try:
+        urls = []
+        specs = [("model-a", "prefill"), ("model-a", "decode"),
+                 ("model-b", "fused")]
+        for i, (model, _pool) in enumerate(specs):
+            app = create_fake_engine_app(model=model, speed=5000.0,
+                                         name=f"mm-{i}")
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            urls.append(
+                f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+            )
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(m for m, _ in specs),
+            "--static-pools", ",".join(p for _, p in specs),
+            "--routing-logic", "roundrobin",
+            "--engine-stats-interval", "0.2",
+        ])
+        router_app = create_app(args)
+        runner = web.AppRunner(router_app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        router_url = (
+            f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+        )
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{router_url}/v1/completions",
+                json={"model": "model-b", "prompt": "plain please",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                assert "X-Prefill-Url" not in resp.headers  # single proxy
+        seen = runners[2].app["state"].requests_seen
+        assert len(seen) == 1  # one request, not a prefill+decode pair
+        assert "kv_transfer_params" not in seen[0]
+    finally:
+        for runner in reversed(runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
+async def test_no_decode_pool_serves_fused_on_prefill_pool():
+    """A fleet whose decode pool vanished: the request serves FUSED on
+    the prefill pool and counts the fallback — degradation, not a 503."""
+    before = _metric("pst_disagg_fallback_total", reason="no_decode_backend")
+    async with DisaggCluster(pools=("prefill", "decode")) as c:
+        async with aiohttp.ClientSession() as s:
+            # Drain the only decode engine through the router's fan-out:
+            # discovery marks it unroutable immediately.
+            async with s.post(
+                f"{c.router_url}/drain", params={"url": c.engine_urls[1]}
+            ) as r:
+                assert r.status == 200
+            async with s.post(
+                f"{c.router_url}/v1/completions",
+                json={"model": "fake/model", "prompt": "fused please",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["text"].startswith("tok0")
+                # Served by the prefill engine, fused.
+                assert resp.headers.get("X-Served-By") == "eng-0"
+    assert _metric(
+        "pst_disagg_fallback_total", reason="no_decode_backend"
+    ) == before + 1

@@ -318,37 +318,43 @@ def test_churn_evicts_trie_pins_and_scores_in_one_step(event_loop):
 
 
 # ---------------------------------------------------------------------------
-# Replicated scoring inputs: the endpoint-loads state surface
+# Replicated scoring inputs: in-flight loads ride the request-stats digest
 # ---------------------------------------------------------------------------
 
 
-def test_gossip_digest_carries_endpoint_loads():
-    from production_stack_tpu.router.state import PROVIDER_ENDPOINT_LOADS
+def test_endpoint_loads_digest_key_is_gone():
+    """ROADMAP 5(b) residual, collapsed: the gossip digest carries the
+    routed in-flight counts ONCE — inside the request_stats snapshot —
+    and the separate "loads" key no longer exists."""
+    from production_stack_tpu.router.state import PROVIDER_REQUEST_STATS
     from production_stack_tpu.router.state.gossip import GossipStateBackend
 
     a = GossipStateBackend(peers=[], replica_id="ra")
     b = GossipStateBackend(peers=[], replica_id="rb")
     a.register_provider(
-        PROVIDER_ENDPOINT_LOADS, lambda: {"http://e0": 3.0, "http://e1": 1.0}
+        PROVIDER_REQUEST_STATS,
+        lambda: {"http://e0": {"in_prefill": 2, "in_decoding": 1}},
     )
     digest = a.digest()
-    assert digest["loads"] == {"http://e0": 3.0, "http://e1": 1.0}
+    assert "loads" not in digest
+    assert digest["stats"]["http://e0"]["in_prefill"] == 2
     b.exchange(digest)
-    assert b.peer_endpoint_loads() == {
-        "ra": {"http://e0": 3.0, "http://e1": 1.0}
-    }
+    assert not hasattr(b, "peer_endpoint_loads")
+    assert b.peer_request_stats()["ra"]["http://e0"]["in_decoding"] == 1
 
 
 def test_peer_loads_shift_bounded_load_pick(event_loop, monkeypatch):
-    """A peer replica's published load on the warm engine pushes it over
-    the bound even when THIS replica routed nothing to it — replicas
-    spill identically."""
+    """A peer replica's published in-flight load on the warm engine
+    pushes it over the bound even when THIS replica routed nothing to it
+    — replicas spill identically. The peer counts arrive through the
+    request-stats merge (the only pipeline they ride now)."""
 
     class StubBackend:
         shared = True
 
-        def peer_endpoint_loads(self):
-            return {"peer": {"http://e0": 40.0}}
+        def peer_request_stats(self):
+            return {"peer": {"http://e0": {"in_prefill": 25,
+                                           "in_decoding": 15}}}
 
         def merged_endpoint_urls(self, local):
             return list(local)
@@ -366,34 +372,48 @@ def test_peer_loads_shift_bounded_load_pick(event_loop, monkeypatch):
 
     appscope.scoped_set("state_backend", StubBackend())
     try:
-        # A resolvable local monitor is required for peer loads to merge in:
-        # without one, routing treats the caller-passed stats as already
-        # fleet-merged and deliberately ignores peer_endpoint_loads.
-        initialize_request_stats_monitor(60.0)
+        monitor = initialize_request_stats_monitor(60.0)
+        merged = monitor.get_request_stats(fleet=True)
+        assert merged["http://e0"].in_prefill_requests == 25
         router = FleetRouter(load_factor=2.0)
         eps = [make_endpoint(f"http://e{i}") for i in range(4)]
         body = {"model": "m", "prompt": "W" * 600}
         # Warm up e0 deliberately: insert its prefix directly.
         _run(event_loop, router.hashtrie.insert("W" * 600, "http://e0"))
-        url = _run(event_loop, router.route_request(eps, {}, {}, {}, body))
+        url = _run(
+            event_loop, router.route_request(eps, {}, merged, {}, body)
+        )
         assert url != "http://e0"
     finally:
         appscope.scoped_set("state_backend", None)
 
 
-def test_fleet_loads_sums_local_and_peers():
-    local = {"http://e0": RequestStats(in_prefill_requests=2,
-                                       in_decoding_requests=1)}
+def test_fleet_loads_reads_the_merged_stats_view():
+    """One provider, one merge: fleet_loads consumes the fleet-merged
+    request-stats view directly (local + peers already summed by the
+    monitor merge) instead of a second loads pipeline."""
+    from production_stack_tpu.router import appscope
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
 
     class Backend:
         shared = True
 
-        def peer_endpoint_loads(self):
-            return {"p1": {"http://e0": 4.0, "http://gone": 9.0},
+        def peer_request_stats(self):
+            return {"p1": {"http://e0": {"in_prefill": 3, "in_decoding": 1},
+                           "http://gone": {"in_prefill": 9}},
                     "p2": "garbage"}
 
-    loads = scoring.fleet_loads(["http://e0", "http://e1"], local, Backend())
-    assert loads == {"http://e0": 7.0, "http://e1": 0.0}
+    appscope.scoped_set("state_backend", Backend())
+    try:
+        monitor = initialize_request_stats_monitor(60.0)
+        monitor.on_new_request("http://e0", "r1", 0.0)  # local in-prefill
+        merged = monitor.get_request_stats(fleet=True)
+        loads = scoring.fleet_loads(["http://e0", "http://e1"], merged)
+        assert loads == {"http://e0": 5.0, "http://e1": 0.0}
+    finally:
+        appscope.scoped_set("state_backend", None)
 
 
 # ---------------------------------------------------------------------------

@@ -240,6 +240,30 @@ def test_full_warmup_then_zero_compiles_on_spanning_traffic():
         "live traffic after a full warmup must not compile anything"
     )
 
+    # 3b) Disagg mix (docs/disagg.md): a producer-leg prefill
+    #     (max_tokens=1, kv_transfer stamped) and a consumer-style
+    #     request that adopts a cached prefix then decodes the tail.
+    #     Both reuse warmed bucket families — the zero-live-compile
+    #     invariant holds for the disagg fleet shape (publish/prefetch
+    #     are host/DCN work, never new executables).
+    engine.add_request(
+        "r-dp", prompt_token_ids=list(range(3, 13)),
+        sampling=SamplingParams(max_tokens=1, temperature=0.0,
+                                ignore_eos=True),
+        kv_transfer={"request_id": "xfer-span", "role": "producer"},
+    )
+    _drain(engine)
+    engine.add_request(
+        "r-dc", prompt_token_ids=list(range(3, 13)),
+        sampling=SamplingParams(max_tokens=3, temperature=0.0),
+        kv_transfer={"request_id": "xfer-span", "role": "consumer"},
+    )
+    _drain(engine)
+    assert ENGINE_TELEMETRY.compile_count() == c0, (
+        "disagg prefill/decode dispatches must reuse warmed bucket "
+        "families"
+    )
+
     # 4) Penalized row: its DECODE bursts ride the warmed with_pen variant
     #    (dense [B, V] penalty state — zero decode compiles). Its prefill
     #    is the documented exception: single-step/prefill penalty shapes
