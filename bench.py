@@ -863,7 +863,7 @@ def run_disagg_phase() -> dict:
         s.close()
         return port
 
-    def measure(tag: str, pools, kv_url) -> dict:
+    def measure(tag: str, pools, kv_url, mid_load=None) -> dict:
         ports = [free_port() for _ in range(5)]
         rport = ports[-1]
         procs = []
@@ -938,6 +938,8 @@ def run_disagg_phase() -> dict:
                 async with aiohttp.ClientSession() as session:
                     tasks = []
                     for i in range(n_requests):
+                        if mid_load is not None and i == n_requests // 3:
+                            mid_load()  # e.g. SIGKILL a kvserver shard
                         tasks.append(asyncio.create_task(one(session, i)))
                         await asyncio.sleep(gaps[i])
                     return await asyncio.gather(*tasks)
@@ -952,15 +954,18 @@ def run_disagg_phase() -> dict:
             # run gate blind to them would pass with zero KV actually
             # transferred.
             engine_fallbacks = 0
+            published = prefetched = 0
             for p in ports[:-1]:
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{p}/debug/state", timeout=5
                 ) as r:
-                    engine_fallbacks += int(
-                        json.loads(r.read()).get("kv_transfer_fallbacks", 0)
-                    )
+                    st = json.loads(r.read())
+                engine_fallbacks += int(st.get("kv_transfer_fallbacks", 0))
+                published += int(st.get("kv_published_blocks", 0))
+                prefetched += int(st.get("kv_prefetched_blocks", 0))
             return {"results": results, "wall": wall, "metrics": metrics,
-                    "engine_fallbacks": engine_fallbacks}
+                    "engine_fallbacks": engine_fallbacks,
+                    "published": published, "prefetched": prefetched}
         finally:
             for proc in procs:
                 if proc.poll() is None:
@@ -1004,6 +1009,43 @@ def run_disagg_phase() -> dict:
         except subprocess.TimeoutExpired:
             kv_proc.kill()
 
+    # kvserver_kill variant (docs/kvserver.md degradation matrix): the
+    # same P/D pools over a 3-shard replicated ring (R=2); one shard is
+    # SIGKILLed a third of the way through the offered load. The guarantee
+    # under test: zero fused fallbacks and a prefetch hit rate within 5%
+    # of the healthy-ring baseline.
+    shard_ports = [free_port() for _ in range(3)]
+    shard_urls = [f"http://127.0.0.1:{p}" for p in shard_ports]
+    shard_procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.kvserver.server",
+             "--host", "127.0.0.1", "--port", str(p),
+             "--peers", ",".join(shard_urls),
+             "--self-url", shard_urls[i],
+             "--replication", "2", "--sweep-interval-s", "1"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            cwd=REPO, env=env,
+        )
+        for i, p in enumerate(shard_ports)
+    ]
+    try:
+        for u in shard_urls:
+            if not wait_http(f"{u}/health", 30):
+                raise RuntimeError("disagg kvserver shard not healthy")
+        chaos = measure(
+            "shardkill", ["prefill", "prefill", "decode", "decode"],
+            ",".join(shard_urls), mid_load=shard_procs[1].kill,
+        )
+    finally:
+        for proc in shard_procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in shard_procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
     def summarize(run) -> dict:
         oks = [r for r in run["results"] if r["ok"] and r["ttft"] is not None]
         toks = sum(r["tokens"] for r in run["results"])
@@ -1027,6 +1069,34 @@ def run_disagg_phase() -> dict:
         (d["tok_s_chip"] - f["tok_s_chip"]) / f["tok_s_chip"]
         if f["tok_s_chip"] else None
     )
+
+    def hit_rate(run) -> float:
+        return run["prefetched"] / run["published"] if run["published"] else 0.0
+
+    chaos_ok = sum(1 for r in chaos["results"] if r["ok"])
+    chaos_fallbacks = int(
+        sum(
+            mval(chaos["metrics"], "pst_disagg_fallback_total",
+                 f'reason="{reason}"')
+            for reason in ("prefill_error", "no_decode_backend", "deadline")
+        ) + chaos.get("engine_fallbacks", 0)
+    )
+    hit_rate_delta = round(hit_rate(chaos) - hit_rate(disagg), 4)
+    kvserver_kill = {
+        "requests_ok": chaos_ok == n_requests,
+        "fallbacks": chaos_fallbacks,
+        "hit_rate_healthy": round(hit_rate(disagg), 4),
+        "hit_rate_shard_killed": round(hit_rate(chaos), 4),
+        "hit_rate_delta": hit_rate_delta,
+        # One dead shard of three at R=2: every request still serves,
+        # nothing degrades to the fused path, and the transfer hit rate
+        # holds within 5 points of the healthy ring.
+        "meets_target": bool(
+            chaos_ok == n_requests
+            and chaos_fallbacks == 0
+            and abs(hit_rate_delta) <= 0.05
+        ),
+    }
     return {
         "offered_qps": offered_qps,
         "requests": n_requests,
@@ -1044,6 +1114,7 @@ def run_disagg_phase() -> dict:
             round(overlap_sum / transfer_sum, 4) if transfer_sum else 0.0
         ),
         "fallbacks": int(fallbacks),
+        "kvserver_kill": kvserver_kill,
         "target_tok_delta_frac": 0.05,
         # The guarantee: P/D pools beat the fused fleet on p99 TTFT at
         # this qps while holding tokens/s/chip within 5%, with every

@@ -18,6 +18,23 @@ environment: production-stack-tpu
 {{- end -}}
 
 {{/*
+Comma-joined per-shard kvserver URLs (docs/kvserver.md): the cache server
+is a StatefulSet behind a headless Service, so every shard has a stable
+per-pod DNS name — the ring membership every client (engines, the shards'
+own anti-entropy sweeps) must agree on. One replica renders a single URL
+and clients stay plain (un-sharded).
+*/}}
+{{- define "pst.cacheServerUrls" -}}
+{{- $name := printf "%s-cache-server" (include "pst.fullname" .) -}}
+{{- $port := int .Values.cacheServerSpec.port -}}
+{{- $urls := list -}}
+{{- range $i := until (int .Values.cacheServerSpec.replicaCount) -}}
+{{- $urls = append $urls (printf "http://%s-%d.%s:%d" $name $i $name $port) -}}
+{{- end -}}
+{{- join "," $urls -}}
+{{- end -}}
+
+{{/*
 Pod spec shared by the multi-host leader and worker templates.
 dict args: root (chart root), ms (modelSpec entry), leader (bool).
 Leader and workers run the same binary: process id / coordinator env decide
@@ -100,7 +117,11 @@ containers:
       {{- end }}
       {{- if .useRemoteStore }}
       - "--remote-kv-url"
-      - "http://{{ include "pst.fullname" $root }}-cache-server:{{ $root.Values.cacheServerSpec.port }}"
+      - "{{ include "pst.cacheServerUrls" $root }}"
+      {{- if .kvReplication }}
+      - "--kv-replication"
+      - "{{ .kvReplication }}"
+      {{- end }}
       {{- end }}
       {{- if and .kvRole (ne .kvRole "none") }}
       - "--kv-role"

@@ -404,6 +404,8 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
                     _ms("kvCache.kvPrefetchDepth")),
     EngineFieldSpec("kv_transfer_timeout_s", "--kv-transfer-timeout-s",
                     _ms("kvCache.kvTransferTimeoutS")),
+    EngineFieldSpec("kv_replication", "--kv-replication",
+                    _ms("kvCache.kvReplication")),
     EngineFieldSpec("deadline_shedding", "--deadline-shedding",
                     "servingEngineSpec.deadlineShedding",
                     emit="--no-deadline-shedding"),

@@ -20,18 +20,46 @@ remote TCP server (`LMCACHE_REMOTE_URL`, `:313-318`). TPU-native version:
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..kvcache.hashing import block_hashes
 from ..logging_utils import init_logger
-from ..obs.metrics import observe_stage
+from ..obs.metrics import note_integrity_failure, observe_stage
 from .kv_manager import BlockAllocator, NoFreeBlocksError
 
 logger = init_logger(__name__)
+
+# Bounded retry for idempotent GETs (docs/kvserver.md "Degradation"):
+# one extra attempt with a jittered pause, still under the caller's
+# per-call deadline — a transient kvserver blip (restart, dropped
+# connection) no longer forces a whole-prompt recompute fallback. Puts
+# stay single-shot: the publisher/spill paths have their own retry-free
+# best-effort contract and replication covers them.
+GET_RETRY_ATTEMPTS = 2
+_RETRY_BACKOFF_S = (0.02, 0.08)
+
+
+def create_remote_client(
+    url: str, replication: int = 2, timeout: float = 5.0
+):
+    """The engine's remote-KV client factory: a single base URL builds the
+    plain :class:`RemoteKVClient`; a comma-separated shard list builds the
+    replicated :class:`~production_stack_tpu.kvserver.sharded.ShardedKVClient`
+    over per-shard clients (same call surface — the allocator, publisher
+    and prefetcher are shard-oblivious)."""
+    urls = [u.strip() for u in (url or "").split(",") if u.strip()]
+    if not urls:
+        return None
+    if len(urls) == 1:
+        return RemoteKVClient(urls[0], timeout=timeout)
+    from ..kvserver.sharded import ShardedKVClient
+
+    return ShardedKVClient(urls, replication=replication, timeout=timeout)
 
 
 class HostKVPool:
@@ -86,11 +114,52 @@ class RemoteKVClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._session = requests.Session()
+        # Plain-int audit counters surfaced through LLMEngine.stats()
+        # (kv_integrity_failures_total / kv_remote_retries_total);
+        # read_repairs stays 0 here — repair needs replicas, which the
+        # ShardedKVClient wrapper owns.
+        self.counters: Dict[str, int] = {
+            "integrity_failures": 0,
+            "retries": 0,
+            "read_repairs": 0,
+        }
 
     def _effective_timeout(self, timeout: Optional[float]) -> float:
         if timeout is None:
             return self.timeout
         return max(min(self.timeout, timeout), 0.001)
+
+    def _retry_pause(self, deadline: float) -> bool:
+        """Jittered backoff before a GET's second attempt; False when the
+        remaining per-call budget cannot cover the pause."""
+        backoff = random.uniform(*_RETRY_BACKOFF_S)
+        if deadline - time.monotonic() <= backoff:
+            return False
+        self.counters["retries"] += 1
+        # pstlint: disable=async-blocking(20-80 ms retry backoff inside the blocking RemoteKVClient, which engine code only calls from step/worker/executor threads — never on an event loop; the pause is pre-checked against the caller's per-call deadline)
+        time.sleep(backoff)
+        return True
+
+    def _quarantine(self, hashes: Sequence[int]) -> None:
+        """Tell the server to drop copies a digest check proved rotten —
+        best-effort (the store also LRU-ages them out eventually)."""
+        try:
+            self._session.post(
+                f"{self.base_url}/admin/quarantine",
+                json={"hashes": [int(h) for h in hashes]},
+                timeout=min(self.timeout, 2.0),
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("quarantine report failed: %s", e)
+
+    def _note_corrupt(self, hashes: Sequence[int], source: str) -> None:
+        self.counters["integrity_failures"] += len(hashes)
+        note_integrity_failure(source, len(hashes))
+        logger.warning(
+            "remote KV digest mismatch on %s (%d block(s), source=%s): "
+            "quarantining replica copies", self.base_url, len(hashes), source,
+        )
+        self._quarantine(hashes)
 
     def put(
         self, h: int, k: np.ndarray, v: np.ndarray,
@@ -110,19 +179,58 @@ class RemoteKVClient:
             return False
 
     def get(
-        self, h: int, timeout: Optional[float] = None
+        self, h: int, timeout: Optional[float] = None,
+        source: str = "restore",
     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        try:
-            r = self._session.get(
-                f"{self.base_url}/blocks/{h}",
-                timeout=self._effective_timeout(timeout),
-            )
+        page, _status = self.get_ex(h, timeout=timeout, source=source)
+        return page
+
+    def get_ex(
+        self, h: int, timeout: Optional[float] = None,
+        source: str = "restore",
+    ) -> Tuple[Optional[Tuple[np.ndarray, np.ndarray]], str]:
+        """``(page, status)`` — status ``ok`` | ``miss`` | ``corrupt`` |
+        ``error``, so a replicated wrapper can tell a healthy miss (try
+        the next owner, no breaker penalty) from a dead shard (breaker
+        feed). The served digest (``X-PST-Digest``) is verified before
+        the page is deserialized; a mismatch quarantines this replica's
+        copy and reads as a miss to plain callers."""
+        deadline = time.monotonic() + self._effective_timeout(timeout)
+        status = "error"
+        for _attempt in range(GET_RETRY_ATTEMPTS):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                r = self._session.get(
+                    f"{self.base_url}/blocks/{h}", timeout=remaining
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.debug("remote KV get failed: %s", e)
+                status = "error"
+                if not self._retry_pause(deadline):
+                    break
+                continue
+            if r.status_code == 404:
+                return None, "miss"
             if r.status_code != 200:
-                return None
-            return _deserialize_page(r.content)
-        except Exception as e:  # noqa: BLE001
-            logger.debug("remote KV get failed: %s", e)
-            return None
+                status = "error"
+                if not self._retry_pause(deadline):
+                    break
+                continue
+            digest_hex = r.headers.get("X-PST-Digest")
+            if digest_hex:
+                from ..kvserver.server import block_digest
+
+                try:
+                    expected = bytes.fromhex(digest_hex)
+                except ValueError:
+                    expected = b""
+                if block_digest(r.content) != expected:
+                    self._note_corrupt([h], source)
+                    return None, "corrupt"
+            return _deserialize_page(r.content), "ok"
+        return None, status
 
     # -- batched endpoints (docs/disagg.md: one round trip for N pages) ---
 
@@ -173,29 +281,56 @@ class RemoteKVClient:
         return r.status_code == 200
 
     def get_blocks(
-        self, hashes: Sequence[int], timeout: Optional[float] = None
+        self, hashes: Sequence[int], timeout: Optional[float] = None,
+        source: str = "match_prefix",
     ) -> "dict[int, Tuple[np.ndarray, np.ndarray]]":
         """Fetch up to N pages in ONE ``GET /blocks?hashes=`` round trip;
         absent hashes are simply missing from the result."""
+        pages, _status = self.get_blocks_ex(
+            hashes, timeout=timeout, source=source
+        )
+        return pages
+
+    def get_blocks_ex(
+        self, hashes: Sequence[int], timeout: Optional[float] = None,
+        source: str = "match_prefix",
+    ) -> Tuple["dict[int, Tuple[np.ndarray, np.ndarray]]", str]:
+        """``(pages, status)`` — status ``ok`` (the round trip completed;
+        absent hashes are genuine misses) or ``error`` (the shard never
+        answered). Every frame is digest-verified; corrupt blocks are
+        dropped from the result, counted, and quarantined on the server —
+        to the caller they look like misses (failover / recompute),
+        never like pages."""
         if not hashes:
-            return {}
+            return {}, "ok"
         from ..kvserver.server import unpack_blocks
 
-        try:
-            r = self._session.get(
-                f"{self.base_url}/blocks",
-                params={"hashes": ",".join(str(int(h)) for h in hashes)},
-                timeout=self._effective_timeout(timeout),
-            )
-            if r.status_code != 200:
-                return {}
-            return {
-                h: _deserialize_page(data)
-                for h, data in unpack_blocks(r.content)
-            }
-        except Exception as e:  # noqa: BLE001
-            logger.debug("remote KV batched get failed: %s", e)
-            return {}
+        deadline = time.monotonic() + self._effective_timeout(timeout)
+        for _attempt in range(GET_RETRY_ATTEMPTS):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                r = self._session.get(
+                    f"{self.base_url}/blocks",
+                    params={"hashes": ",".join(str(int(h)) for h in hashes)},
+                    timeout=remaining,
+                )
+                if r.status_code != 200:
+                    raise RuntimeError(f"status {r.status_code}")
+                corrupt: List[int] = []
+                pages = {
+                    h: _deserialize_page(data)
+                    for h, data in unpack_blocks(r.content, corrupt)
+                }
+                if corrupt:
+                    self._note_corrupt(corrupt, source)
+                return pages, "ok"
+            except Exception as e:  # noqa: BLE001
+                logger.debug("remote KV batched get failed: %s", e)
+                if not self._retry_pause(deadline):
+                    break
+        return {}, "error"
 
     # -- disagg-transfer manifests (request-id-keyed; docs/disagg.md) -----
 

@@ -122,7 +122,13 @@ class EngineConfig:
     seed: int = 0
     # KV tiering (LMCache-analogue knobs; SURVEY.md §2.4).
     cpu_offload_blocks: int = 0
+    # One kvserver base URL, or a comma-separated shard list — the latter
+    # builds the replicated ShardedKVClient over the consistent-hash ring
+    # (docs/kvserver.md).
     remote_kv_url: Optional[str] = None
+    # Replicas per block/manifest on the kvserver ring (clamped to the
+    # shard count; meaningful only with a multi-URL remote_kv_url).
+    kv_replication: int = 2
     # Cache-controller registration (KV-aware routing; LMCACHE_CONTROLLER_URL
     # analogue). engine_url is what this pod reports itself as.
     cache_controller_url: Optional[str] = None

@@ -82,7 +82,7 @@ class LLMEngine:
         self.runner = ModelRunner(cfg, self.model_cfg, mesh)
         t_runner_s = time.perf_counter() - t_runner
         if cfg.cpu_offload_blocks > 0 or cfg.remote_kv_url:
-            from .cache_tiering import RemoteKVClient, TieredAllocator
+            from .cache_tiering import TieredAllocator, create_remote_client
 
             host_blocks = cfg.cpu_offload_blocks
             if (
@@ -100,7 +100,9 @@ class LLMEngine:
                 cfg.block_size,
                 page_io=self.runner,
                 host_blocks=host_blocks,
-                remote=RemoteKVClient(cfg.remote_kv_url)
+                remote=create_remote_client(
+                    cfg.remote_kv_url, replication=cfg.kv_replication
+                )
                 if cfg.remote_kv_url
                 else None,
                 enable_prefix_caching=cfg.enable_prefix_caching,
@@ -1132,6 +1134,23 @@ class LLMEngine:
             )
             out["kv_transfer_fallbacks_total"] = float(
                 self.kv_prefetcher.fallbacks
+            )
+        # Remote-tier integrity/replication audit (docs/kvserver.md):
+        # digest-verification failures, replica read-repairs and GET
+        # retries, counted in the KV client (plain or sharded).
+        remote_client = getattr(self.allocator, "remote", None)
+        if remote_client is not None and hasattr(remote_client, "counters"):
+            if hasattr(remote_client, "refresh_counters"):
+                remote_client.refresh_counters()
+            counters = remote_client.counters
+            out["kv_integrity_failures_total"] = float(
+                counters.get("integrity_failures", 0)
+            )
+            out["kv_read_repairs_total"] = float(
+                counters.get("read_repairs", 0)
+            )
+            out["kv_remote_retries_total"] = float(
+                counters.get("retries", 0)
             )
         if self.swapper is not None:
             out["kv_swap_out_total"] = float(self.swapper.swap_out_total)

@@ -210,7 +210,8 @@ class KVHandoffPrefetcher:
             for i in range(0, len(new), self.depth):
                 batch = new[i : i + self.depth]
                 pages = self.remote.get_blocks(
-                    batch, timeout=max(expire - time.monotonic(), 0.001)
+                    batch, timeout=max(expire - time.monotonic(), 0.001),
+                    source="prefetch",
                 )
                 for h, (k, v) in pages.items():
                     self.host_pool.put(h, k, v)

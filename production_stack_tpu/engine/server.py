@@ -233,6 +233,11 @@ class EngineMetrics:
             "disagg transfers that degraded to the fused path "
             "(manifest timeout or kvserver failure)",
         )
+        self.kv_remote_retries = counter(
+            "pst:kv_remote_retries",
+            "remote-KV GET attempts retried after a transient shard "
+            "error (bounded, jittered — docs/kvserver.md)",
+        )
         # Tenant QoS (docs/multi-tenancy.md): per-tier queue age is the
         # starvation signal the flood-isolation guarantee asserts on, and
         # batch preemptions count pages reclaimed for interactive work.
@@ -328,6 +333,10 @@ class EngineMetrics:
         self._counter_to(
             self.kv_transfer_fallbacks, "kv_fallback",
             stats.get("kv_transfer_fallbacks_total", 0),
+        )
+        self._counter_to(
+            self.kv_remote_retries, "kv_retry",
+            stats.get("kv_remote_retries_total", 0),
         )
         self.tenant_queue_age_interactive.set(
             stats.get("tenant_queue_age_interactive", 0.0)
@@ -1699,7 +1708,13 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                    help="host-DRAM budget for stashed tail pages (KV pages)")
     # KV tiering / controller (LMCache env-var analogues).
     p.add_argument("--cpu-offload-blocks", type=int, default=0)
-    p.add_argument("--remote-kv-url", default=None)
+    p.add_argument("--remote-kv-url", default=None,
+                   help="kvserver base URL; a comma-separated list makes "
+                        "the engine a sharded-ring client "
+                        "(docs/kvserver.md)")
+    p.add_argument("--kv-replication", type=int, default=2,
+                   help="replicas per KV block/manifest on the kvserver "
+                        "ring (clamped to the shard count)")
     p.add_argument("--cache-controller-url", default=None)
     p.add_argument("--engine-url", default=None)
     p.add_argument(
@@ -1834,6 +1849,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         swap_stash_blocks=args.swap_stash_blocks,
         cpu_offload_blocks=args.cpu_offload_blocks,
         remote_kv_url=args.remote_kv_url,
+        kv_replication=args.kv_replication,
         cache_controller_url=args.cache_controller_url,
         engine_url=args.engine_url,
         kv_role=args.kv_role,

@@ -1,0 +1,129 @@
+"""Consistent-hash ring shared by the router and the KV-store client.
+
+Originally private to :mod:`production_stack_tpu.router.routing.logic`
+(which still re-exports it); hoisted to a dependency-free module so the
+sharded KV client (:mod:`production_stack_tpu.kvserver.sharded`), the
+kvserver's anti-entropy sweep and the fake engine can compute the SAME
+(key -> owner set) placement as the router without importing the router's
+discovery/scoring stack into the engine process. One placement function
+across every process is what makes replica sets agree: a block published
+by the prefill engine is looked up on the same owners by the decode
+engine, the router's KV-aware scorer and the shard's own sweep.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import xxhash
+
+
+class ConsistentHashRing:
+    """xxhash-based ring with virtual nodes; minimal remapping on membership change."""
+
+    def __init__(self, vnodes: int = 160):
+        self.vnodes = vnodes
+        # pstlint: owned-by=task:update,_rebuild
+        self._nodes: set = set()
+        # pstlint: owned-by=task:update,_rebuild
+        self._ring: List[Tuple[int, str]] = []
+        # pstlint: owned-by=task:update,_rebuild
+        self._hashes: List[int] = []
+
+    def _rebuild(self) -> None:
+        ring = []
+        for node in self._nodes:
+            for v in range(self.vnodes):
+                ring.append((xxhash.xxh64_intdigest(f"{node}#{v}"), node))
+        ring.sort()
+        self._ring = ring
+        self._hashes = [h for h, _ in ring]
+
+    def update(self, nodes: Sequence[str]) -> None:
+        new = set(nodes)
+        if new != self._nodes:
+            self._nodes = new
+            self._rebuild()
+
+    def get_node(self, key: str) -> Optional[str]:
+        if not self._ring:
+            return None
+        h = xxhash.xxh64_intdigest(key)
+        idx = bisect.bisect(self._hashes, h) % len(self._ring)
+        return self._ring[idx][1]
+
+    def get_nodes(self, key: str, n: int) -> List[str]:
+        """The first ``n`` DISTINCT nodes clockwise from ``key``'s ring
+        position — the replica owner set for replication factor ``n``.
+        ``get_nodes(key, 1)[0] == get_node(key)``, and because the walk
+        order is the ring order, adding one node to the ring shifts each
+        key's owner list by at most one position: an R-replicated block
+        keeps at least one pre-join owner in its post-join owner set for
+        R >= 2, which is what keeps published blocks findable across a
+        shard join (tests/test_kvserver_ring.py)."""
+        if not self._ring or n <= 0:
+            return []
+        h = xxhash.xxh64_intdigest(key)
+        start = bisect.bisect(self._hashes, h) % len(self._ring)
+        owners: List[str] = []
+        seen: set = set()
+        for i in range(len(self._ring)):
+            node = self._ring[(start + i) % len(self._ring)][1]
+            if node in seen:
+                continue
+            seen.add(node)
+            owners.append(node)
+            if len(owners) >= n or len(seen) == len(self._nodes):
+                break
+        return owners
+
+    def get_node_bounded(
+        self,
+        key: str,
+        loads: Dict[str, float],
+        c: float = 2.0,
+        allowed: Optional[set] = None,
+    ) -> Optional[str]:
+        """Consistent hashing with bounded loads (Mirrokni et al.): walk
+        the ring clockwise from ``key``'s position and take the first
+        node whose current load is under ``c ×`` the mean load, falling
+        back to the first eligible node when everything is saturated.
+        Replicated routers use this over the *shared* endpoint view +
+        fleet-wide stats, so every replica computes the same (key → node)
+        map AND a hot-spotted node sheds to the same successor on every
+        replica.
+
+        ``allowed`` constrains the pick to THIS replica's routable
+        candidates (model match, not draining/sleeping, breaker-admitted)
+        while the ring still hashes over the shared fleet view: replicas
+        whose candidate sets agree pick identically, and a replica whose
+        discovery lags simply walks to the nearest node it can actually
+        route to — it never picks an engine it must not use."""
+        if not self._ring:
+            return None
+        candidates = (
+            self._nodes if allowed is None else self._nodes & set(allowed)
+        )
+        if not candidates:
+            return None
+        mean = sum(loads.get(n, 0.0) for n in candidates) / len(candidates)
+        bound = c * max(mean, 1.0)
+        h = xxhash.xxh64_intdigest(key)
+        start = bisect.bisect(self._hashes, h) % len(self._ring)
+        first_eligible: Optional[str] = None
+        seen: set = set()
+        for i in range(len(self._ring)):
+            node = self._ring[(start + i) % len(self._ring)][1]
+            if node in seen:
+                continue
+            seen.add(node)
+            if node not in candidates:
+                continue
+            if first_eligible is None:
+                first_eligible = node
+            if loads.get(node, 0.0) < bound:
+                return node
+            if len(seen) == len(self._nodes):
+                break
+        return first_eligible

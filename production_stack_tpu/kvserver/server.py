@@ -7,19 +7,36 @@ of :mod:`production_stack_tpu.engine.cache_tiering` over HTTP (TCP/DCN), with
 a byte-capacity LRU.
 
 Endpoints:
-  PUT  /blocks/{hash}     store one page (raw serde body)
-  GET  /blocks/{hash}     fetch one page (404 if absent)
+  PUT  /blocks/{hash}     store one page (raw serde body; optional
+                          ``X-PST-Digest`` header, verified at ingest)
+  GET  /blocks/{hash}     fetch one page (404 if absent; the stored digest
+                          rides back in ``X-PST-Digest``)
   POST /blocks            store N pages in ONE round trip (framed body)
   GET  /blocks?hashes=    fetch N pages in ONE round trip (framed body;
                           absent hashes are simply omitted from the reply)
   POST /manifests/{rid}   append a disagg-transfer manifest update
   GET  /manifests/{rid}   read a manifest (``?wait_s=`` long-polls for
                           progress past ``?have=`` blocks / completion)
-  GET  /stats             occupancy/bytes/hit counters
+  POST /contains          presence probe for N hashes (read-repair and the
+                          anti-entropy sweep key on this)
+  POST /admin/quarantine  drop named blocks (a client that detected a
+                          digest mismatch evicts THIS replica's copy)
+  POST /admin/fail        fault injection: ``corrupt`` | ``slow`` |
+                          ``drop_manifest`` (chaos legs + bench)
+  POST /admin/heal        clear injected faults
+  GET  /ring              this shard's view of the ring (peers,
+                          replication, sweep interval)
+  GET  /stats             occupancy/bytes/hit/integrity counters
   GET  /health
 
-The framed batch body is ``repeat([8B hash LE][4B length LE][payload])`` —
-hash keys are the engine-side block hashes, payloads are the page serde.
+The framed batch body is ``repeat([8B hash LE][4B length LE][16B blake2b
+digest][payload])`` — hash keys are the engine-side block hashes (which
+key the *token ids*, not the bytes), payloads are the page serde, and the
+digest is BLAKE2b-128 over the payload bytes. The digest is computed by
+the producer at pack time, stored verbatim, and served verbatim: a replica
+whose copy rotted (or a fault-injected corruption) is detected by the
+*reader*, because recomputing the digest server-side at serve time would
+launder storage corruption into a "valid" frame. docs/kvserver.md.
 
 Manifests (docs/disagg.md "Manifest protocol"): the streamed prefill→decode
 KV handoff is coordinated by a request-id-keyed manifest. The prefill engine
@@ -34,8 +51,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 import collections
+import hashlib
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from aiohttp import web
 
@@ -49,39 +67,94 @@ logger = init_logger(__name__)
 MANIFEST_TTL_S = 10 * 60.0
 MANIFEST_CAP = 4096
 
+# BLAKE2b digest width carried per frame. 128 bits: collision-irrelevant
+# (integrity check, not addressing) and 16 bytes of overhead on multi-KiB
+# page payloads.
+DIGEST_SIZE = 16
+_FRAME_HEADER = 8 + 4 + DIGEST_SIZE
 
-def pack_blocks(pages: List[Tuple[int, bytes]]) -> bytes:
-    """Frame N (hash, payload) pages into one batch body."""
+# Blocks examined per anti-entropy pass: bounds one sweep's /contains +
+# re-push work on a full shard so the sweep never monopolizes the loop.
+SWEEP_SAMPLE_BLOCKS = 2048
+
+
+def block_digest(data: bytes) -> bytes:
+    """BLAKE2b-128 over the page serde bytes — the end-to-end integrity
+    token every framed block carries (computed where the bytes are born,
+    verified wherever they are consumed)."""
+    return hashlib.blake2b(data, digest_size=DIGEST_SIZE).digest()
+
+
+def pack_blocks(pages: Sequence[tuple]) -> bytes:
+    """Frame N pages into one batch body.
+
+    Items are ``(hash, payload)`` — the digest is computed here — or
+    ``(hash, payload, digest)`` for callers re-shipping stored frames
+    (read-repair, the anti-entropy sweep) where the ORIGINAL producer
+    digest must travel, not a fresh one over possibly-rotted bytes.
+    """
     parts = []
-    for h, data in pages:
+    for page in pages:
+        if len(page) == 3:
+            h, data, digest = page
+        else:
+            h, data = page
+            digest = block_digest(data)
         parts.append(int(h).to_bytes(8, "little", signed=False))
         parts.append(len(data).to_bytes(4, "little"))
+        parts.append(digest)
         parts.append(data)
     return b"".join(parts)
 
 
-def unpack_blocks(buf: bytes) -> List[Tuple[int, bytes]]:
-    """Inverse of :func:`pack_blocks`; raises ValueError on a torn frame."""
-    out: List[Tuple[int, bytes]] = []
+def unpack_blocks_ex(
+    buf: bytes, corrupt: Optional[List[int]] = None
+) -> List[Tuple[int, bytes, bytes]]:
+    """Inverse of :func:`pack_blocks`, digest-verified.
+
+    Raises ValueError on a torn frame. A digest mismatch raises too —
+    unless ``corrupt`` is given, in which case the bad block's hash is
+    appended there and the block is *skipped* (client read paths: the
+    caller quarantines that replica's copy and fails over; a corrupt page
+    must never reach decode, docs/kvserver.md).
+    """
+    out: List[Tuple[int, bytes, bytes]] = []
     off = 0
     n = len(buf)
     while off < n:
-        if off + 12 > n:
+        if off + _FRAME_HEADER > n:
             raise ValueError("torn batch frame header")
         h = int.from_bytes(buf[off : off + 8], "little")
         ln = int.from_bytes(buf[off + 8 : off + 12], "little")
-        off += 12
+        digest = buf[off + 12 : off + _FRAME_HEADER]
+        off += _FRAME_HEADER
         if off + ln > n:
             raise ValueError("torn batch frame payload")
-        out.append((h, buf[off : off + ln]))
+        data = buf[off : off + ln]
         off += ln
+        if block_digest(data) != digest:
+            if corrupt is None:
+                raise ValueError(f"digest mismatch for block {h}")
+            corrupt.append(h)
+            continue
+        out.append((h, data, digest))
     return out
+
+
+def unpack_blocks(
+    buf: bytes, corrupt: Optional[List[int]] = None
+) -> List[Tuple[int, bytes]]:
+    """:func:`unpack_blocks_ex` without the digest column (most callers
+    only need the verified payloads)."""
+    return [(h, data) for h, data, _ in unpack_blocks_ex(buf, corrupt)]
 
 
 class BlockStore:
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
-        self._blocks: "collections.OrderedDict[int, bytes]" = collections.OrderedDict()
+        self._blocks: "collections.OrderedDict[int, Tuple[bytes, bytes]]" = (
+            collections.OrderedDict()
+        )
         self.bytes_used = 0
         self.hits = 0
         self.misses = 0
@@ -92,31 +165,104 @@ class BlockStore:
         self.put_calls = 0
         self.blocks_put = 0
         self.get_calls = 0
+        # Integrity-audit counters (docs/kvserver.md): ingest-side digest
+        # rejects and client-reported quarantines.
+        self.integrity_rejects = 0
+        self.quarantined = 0
 
-    def put(self, h: int, data: bytes) -> None:
+    def put(self, h: int, data: bytes, digest: Optional[bytes] = None) -> None:
         self.blocks_put += 1
         if len(data) > self.max_bytes:
             return  # unstorable; never evict the fleet's cache trying
+        if digest is None:
+            digest = block_digest(data)
         if h in self._blocks:
-            self.bytes_used -= len(self._blocks.pop(h))
+            self.bytes_used -= len(self._blocks.pop(h)[0])
         while self._blocks and self.bytes_used + len(data) > self.max_bytes:
-            _, old = self._blocks.popitem(last=False)
+            _, (old, _d) = self._blocks.popitem(last=False)
             self.bytes_used -= len(old)
             self.evictions += 1
-        self._blocks[h] = data
+        self._blocks[h] = (data, digest)
         self.bytes_used += len(data)
 
     def get(self, h: int) -> Optional[bytes]:
-        data = self._blocks.get(h)
-        if data is None:
+        item = self.get_with_digest(h)
+        return None if item is None else item[0]
+
+    def get_with_digest(self, h: int) -> Optional[Tuple[bytes, bytes]]:
+        item = self._blocks.get(h)
+        if item is None:
             self.misses += 1
             return None
         self._blocks.move_to_end(h)
         self.hits += 1
-        return data
+        return item
 
     def contains(self, h: int) -> bool:
         return h in self._blocks
+
+    def quarantine(self, hashes: Sequence[int]) -> int:
+        """Drop named blocks (a reader detected a digest mismatch on this
+        replica's copy). Returns how many were actually present."""
+        dropped = 0
+        for h in hashes:
+            item = self._blocks.pop(int(h), None)
+            if item is not None:
+                self.bytes_used -= len(item[0])
+                dropped += 1
+        self.quarantined += dropped
+        return dropped
+
+    def sample_hashes(self, limit: int) -> List[int]:
+        """Up to ``limit`` most-recently-used block hashes (the
+        anti-entropy sweep's working set — hot blocks first, bounded)."""
+        return list(reversed(self._blocks.keys()))[:limit]
+
+
+class FaultState:
+    """Injected-fault state (POST /admin/fail; docs/kvserver.md).
+
+    ``corrupt``: flip a byte in each *served* block payload (the stored
+    digest still rides along, so readers detect the damage — this is the
+    rotted-replica simulation). ``slow``: delay every block/manifest
+    handler by ``delay_s``. ``drop_manifest``: acknowledge manifest
+    appends but discard them (the consumer's long-poll starves into the
+    fused fallback). ``count`` bounds how many operations are affected
+    (<= 0 = until /admin/heal), mirroring the fake engine's fault surface.
+    """
+
+    def __init__(self) -> None:
+        self.mode: Optional[str] = None
+        self.remaining = 0
+        self.delay_s = 0.25
+        self.injected = 0
+
+    def arm(self, mode: str, count: int, delay_s: float) -> None:
+        self.mode = mode
+        self.remaining = count
+        self.delay_s = delay_s
+
+    def heal(self) -> None:
+        self.mode = None
+        self.remaining = 0
+
+    def take(self, mode: str) -> bool:
+        """Consume one fault of ``mode`` if armed; False otherwise."""
+        if self.mode != mode:
+            return False
+        if self.remaining > 0:
+            self.remaining -= 1
+            if self.remaining == 0:
+                self.mode = None
+        self.injected += 1
+        return True
+
+
+def _flip_byte(data: bytes) -> bytes:
+    if not data:
+        return data
+    i = len(data) // 2
+    return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1 :]
 
 
 class ManifestStore:
@@ -172,6 +318,20 @@ class ManifestStore:
         if total_blocks is not None:
             m["total_blocks"] = int(total_blocks)
         m["ts"] = now
+        # Every producer append refreshes the manifest's eviction rank as
+        # well as its TTL: cap-pressure eviction pops the LRU end, and
+        # without the move an actively-streaming transfer created early
+        # (a slow, long prefill) was the FIRST thing 4096 younger
+        # manifests pushed out — its consumer saw the manifest vanish
+        # mid-prefill and timed out the whole transfer into a recompute
+        # (tests/test_kvserver_ring.py::test_manifest_active_survives_cap).
+        self._manifests.move_to_end(rid)
+        # Re-check the cap after the insert: pruning only before it would
+        # leave the store sitting one over between updates. ``rid`` was
+        # just moved to the MRU end, so it can never be its own evictee.
+        while len(self._manifests) > MANIFEST_CAP:
+            evict, _ = self._manifests.popitem(last=False)
+            self._events.pop(evict, None)
         ev = self._events.get(rid)
         if ev is not None:
             ev.set()
@@ -224,42 +384,108 @@ class ManifestStore:
         return len(self._manifests)
 
 
-def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
+def create_kv_server_app(
+    max_bytes: int = 8 << 30,
+    peers: Optional[Sequence[str]] = None,
+    self_url: Optional[str] = None,
+    replication: int = 2,
+    sweep_interval_s: float = 0.0,
+) -> web.Application:
+    """One kvserver shard.
+
+    ``peers`` (every shard's base URL, this one included, as the clients
+    address them) + ``self_url`` make the shard ring-aware: it can answer
+    GET /ring and run the anti-entropy sweep — every ``sweep_interval_s``
+    it samples its hottest blocks, computes each block's owner set over
+    the shared consistent-hash ring, probes co-owners with POST /contains
+    and re-pushes missing replicas (stored digests travel verbatim). A
+    restarted-empty shard is thus backfilled by its peers within one
+    sweep interval, complementing the client-side read-repair that heals
+    on demand. Without ``peers`` the shard behaves exactly as before.
+    """
     store = BlockStore(max_bytes)
     manifests = ManifestStore()
+    faults = FaultState()
+    peer_list = [p.rstrip("/") for p in (peers or []) if p]
     app = web.Application(client_max_size=256 << 20)
     app["store"] = store
     app["manifests"] = manifests
+    app["faults"] = faults
+    app["peers"] = peer_list
+    app["self_url"] = (self_url or "").rstrip("/")
+    app["replication"] = max(int(replication), 1)
+    app["sweep_interval_s"] = float(sweep_interval_s)
+    app["anti_entropy_pushes"] = 0
+    app["anti_entropy_sweeps"] = 0
+
+    async def _maybe_slow() -> None:
+        if faults.take("slow"):
+            await asyncio.sleep(faults.delay_s)
+
+    def _served(h: int, data: bytes, digest: bytes) -> Tuple[bytes, bytes]:
+        """Apply the ``corrupt`` fault to one outgoing block: the payload
+        is damaged but the STORED digest still rides along — exactly what
+        a rotted replica looks like to a verifying reader."""
+        if faults.take("corrupt"):
+            return _flip_byte(data), digest
+        return data, digest
 
     async def put_block(request: web.Request) -> web.Response:
+        await _maybe_slow()
         h = int(request.match_info["hash"])
         store.put_calls += 1
-        store.put(h, await request.read())
+        data = await request.read()
+        digest: Optional[bytes] = None
+        header = request.headers.get("X-PST-Digest")
+        if header:
+            try:
+                digest = bytes.fromhex(header)
+            except ValueError:
+                return web.json_response(
+                    {"error": "X-PST-Digest must be hex"}, status=400
+                )
+            if block_digest(data) != digest:
+                store.integrity_rejects += 1
+                return web.json_response(
+                    {"error": "digest mismatch"}, status=400
+                )
+        store.put(h, data, digest)
         return web.json_response({"status": "ok"})
 
     async def put_blocks(request: web.Request) -> web.Response:
-        """Batched put: N pages, one round trip (docs/disagg.md)."""
+        """Batched put: N pages, one round trip (docs/disagg.md). Frames
+        are digest-verified at ingest — a block corrupted in flight is
+        rejected here (400) instead of poisoning a replica."""
+        await _maybe_slow()
         store.put_calls += 1
         try:
-            pages = unpack_blocks(await request.read())
+            pages = unpack_blocks_ex(await request.read())
         except ValueError as e:
+            store.integrity_rejects += 1
             return web.json_response({"error": str(e)}, status=400)
-        for h, data in pages:
-            store.put(h, data)
+        for h, data, digest in pages:
+            store.put(h, data, digest)
         return web.json_response({"status": "ok", "stored": len(pages)})
 
     async def get_block(request: web.Request) -> web.Response:
         if "hashes" in request.query:
             return await get_blocks(request)
+        await _maybe_slow()
         store.get_calls += 1
-        data = store.get(int(request.match_info["hash"]))
-        if data is None:
+        item = store.get_with_digest(int(request.match_info["hash"]))
+        if item is None:
             return web.json_response({"error": "not found"}, status=404)
-        return web.Response(body=data, content_type="application/octet-stream")
+        data, digest = _served(int(request.match_info["hash"]), *item)
+        return web.Response(
+            body=data,
+            content_type="application/octet-stream",
+            headers={"X-PST-Digest": digest.hex()},
+        )
 
     async def get_blocks(request: web.Request) -> web.Response:
         """Batched get: ``?hashes=h1,h2`` → framed body of present pages
         (absent hashes simply omitted; the caller diffs)."""
+        await _maybe_slow()
         store.get_calls += 1
         try:
             hashes = [
@@ -271,9 +497,10 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
             )
         pages = []
         for h in hashes:
-            data = store.get(h)
-            if data is not None:
-                pages.append((h, data))
+            item = store.get_with_digest(h)
+            if item is not None:
+                data, digest = _served(h, *item)
+                pages.append((h, data, digest))
         return web.Response(
             body=pack_blocks(pages),
             content_type="application/octet-stream",
@@ -281,6 +508,7 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
         )
 
     async def post_manifest(request: web.Request) -> web.Response:
+        await _maybe_slow()
         rid = request.match_info["rid"]
         try:
             body = await request.json()
@@ -297,6 +525,13 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
             return web.json_response(
                 {"error": "hashes/total_blocks must be integers"}, status=400
             )
+        if faults.take("drop_manifest"):
+            # Acknowledged but discarded: the producer believes the append
+            # landed while the consumer's long-poll starves — the
+            # slow-prefill manifest-loss failure mode, on demand.
+            return web.json_response(
+                {"status": "ok", "blocks": 0, "complete": False}
+            )
         m = manifests.update(rid, hashes, bool(body.get("complete")), total)
         return web.json_response(
             {"status": "ok", "blocks": len(m["hashes"]),
@@ -304,6 +539,7 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
         )
 
     async def get_manifest(request: web.Request) -> web.Response:
+        await _maybe_slow()
         rid = request.match_info["rid"]
         try:
             wait_s = float(request.query.get("wait_s", 0))
@@ -326,6 +562,51 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
             {"present": [store.contains(int(h)) for h in body.get("hashes", [])]}
         )
 
+    async def quarantine(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            hashes = [int(h) for h in body.get("hashes") or []]
+        except Exception:  # noqa: BLE001 — malformed quarantine request
+            return web.json_response({"error": "invalid body"}, status=400)
+        dropped = store.quarantine(hashes)
+        logger.warning(
+            "quarantined %d/%d blocks on reader-reported digest mismatch",
+            dropped, len(hashes),
+        )
+        return web.json_response({"status": "ok", "dropped": dropped})
+
+    async def admin_fail(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            body = {}
+        mode = body.get("mode")
+        if mode not in ("corrupt", "slow", "drop_manifest"):
+            return web.json_response(
+                {"error": "mode must be corrupt|slow|drop_manifest"},
+                status=400,
+            )
+        faults.arm(
+            mode,
+            int(body.get("count", 0)),
+            float(body.get("delay_s", 0.25)),
+        )
+        return web.json_response({"status": "ok", "mode": mode})
+
+    async def admin_heal(request: web.Request) -> web.Response:
+        faults.heal()
+        return web.json_response({"status": "ok"})
+
+    async def ring(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "peers": app["peers"],
+                "self": app["self_url"],
+                "replication": app["replication"],
+                "sweep_interval_s": app["sweep_interval_s"],
+            }
+        )
+
     async def stats(request: web.Request) -> web.Response:
         return web.json_response(
             {
@@ -339,6 +620,11 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
                 "blocks_put": store.blocks_put,
                 "get_calls": store.get_calls,
                 "manifests": len(manifests),
+                "integrity_rejects": store.integrity_rejects,
+                "quarantined": store.quarantined,
+                "faults_injected": faults.injected,
+                "anti_entropy_sweeps": app["anti_entropy_sweeps"],
+                "anti_entropy_pushes": app["anti_entropy_pushes"],
             }
         )
 
@@ -352,9 +638,98 @@ def create_kv_server_app(max_bytes: int = 8 << 30) -> web.Application:
     app.router.add_post("/manifests/{rid}", post_manifest)
     app.router.add_get("/manifests/{rid}", get_manifest)
     app.router.add_post("/contains", contains)
+    app.router.add_post("/admin/quarantine", quarantine)
+    app.router.add_post("/admin/fail", admin_fail)
+    app.router.add_post("/admin/heal", admin_heal)
+    app.router.add_get("/ring", ring)
     app.router.add_get("/stats", stats)
     app.router.add_get("/health", health)
+
+    if peer_list and app["self_url"] and app["sweep_interval_s"] > 0:
+        app.cleanup_ctx.append(_anti_entropy_ctx)
     return app
+
+
+async def _sweep_once(app: web.Application, session) -> int:
+    """One anti-entropy pass: for each sampled local block whose owner set
+    includes a peer missing it, re-push the stored frame (original digest)
+    there. Returns blocks pushed; every per-peer failure is swallowed —
+    a down peer is exactly the situation the sweep exists to heal later."""
+    from ..hashring import ConsistentHashRing
+
+    store: BlockStore = app["store"]
+    self_url: str = app["self_url"]
+    replication: int = app["replication"]
+    ring = ConsistentHashRing()
+    ring.update(app["peers"])
+    # Owner sets per sampled block; only blocks this shard co-owns matter
+    # (a block left here by an old ring epoch still serves reads via the
+    # clients' ring-order failover walk).
+    by_peer: Dict[str, List[int]] = collections.defaultdict(list)
+    for h in store.sample_hashes(SWEEP_SAMPLE_BLOCKS):
+        owners = ring.get_nodes(str(h), replication)
+        if self_url not in owners:
+            continue
+        for o in owners:
+            if o != self_url:
+                by_peer[o].append(h)
+    pushed = 0
+    for peer, hashes in by_peer.items():
+        try:
+            async with session.post(
+                f"{peer}/contains", json={"hashes": hashes}
+            ) as r:
+                if r.status != 200:
+                    continue
+                present = (await r.json()).get("present") or []
+        except Exception:  # noqa: BLE001 — peer down; next sweep retries
+            continue
+        missing = [
+            h for h, there in zip(hashes, present) if not there
+        ]
+        if not missing:
+            continue
+        frames = []
+        for h in missing:
+            item = store.get_with_digest(h)
+            if item is not None:
+                frames.append((h, item[0], item[1]))
+        if not frames:
+            continue
+        try:
+            async with session.post(
+                f"{peer}/blocks", data=pack_blocks(frames)
+            ) as r:
+                if r.status == 200:
+                    pushed += len(frames)
+        except Exception:  # noqa: BLE001
+            continue
+    return pushed
+
+
+async def _anti_entropy_ctx(app: web.Application):
+    import aiohttp
+
+    async def _loop() -> None:
+        timeout = aiohttp.ClientTimeout(total=10.0)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            while True:
+                await asyncio.sleep(app["sweep_interval_s"])
+                try:
+                    app["anti_entropy_pushes"] += await _sweep_once(
+                        app, session
+                    )
+                except Exception as e:  # noqa: BLE001 — sweep must survive
+                    logger.debug("anti-entropy sweep failed: %s", e)
+                app["anti_entropy_sweeps"] += 1
+
+    task = asyncio.create_task(_loop(), name="kv-anti-entropy")
+    yield
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
 
 
 def main(argv=None) -> None:
@@ -362,9 +737,29 @@ def main(argv=None) -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8100)
     p.add_argument("--max-bytes", type=int, default=8 << 30)
+    p.add_argument("--peers", default=None,
+                   help="comma-separated base URLs of EVERY ring shard "
+                        "(this one included) — enables GET /ring and the "
+                        "anti-entropy sweep")
+    p.add_argument("--self-url", default=None,
+                   help="this shard's own base URL as it appears in "
+                        "--peers")
+    p.add_argument("--replication", type=int, default=2,
+                   help="replicas per block the ring places (must match "
+                        "the engines' --kv-replication)")
+    p.add_argument("--sweep-interval-s", type=float, default=30.0,
+                   help="seconds between anti-entropy passes (0 disables; "
+                        "effective only with --peers/--self-url)")
     args = p.parse_args(argv)
+    peers = [u for u in (args.peers or "").split(",") if u]
     web.run_app(
-        create_kv_server_app(args.max_bytes),
+        create_kv_server_app(
+            args.max_bytes,
+            peers=peers,
+            self_url=args.self_url,
+            replication=args.replication,
+            sweep_interval_s=args.sweep_interval_s,
+        ),
         host=args.host, port=args.port, access_log=None,
     )
 

@@ -1,0 +1,355 @@
+"""Replicated, sharded remote-KV client (docs/kvserver.md).
+
+:class:`ShardedKVClient` wraps one
+:class:`~production_stack_tpu.engine.cache_tiering.RemoteKVClient` per
+kvserver shard behind the SAME call surface, so the tiered allocator, the
+streamed-handoff publisher and the consumer prefetcher are shard-oblivious
+— ``--remote-kv-url`` simply grows commas.
+
+Placement: blocks map to shards by their content chunk hash over the
+shared consistent-hash ring (:mod:`production_stack_tpu.hashring` — the
+same class, vnode count and key scheme the router uses), with
+``replication`` (R) distinct owners per block; manifests replicate to the
+request id's owner set the same way. Every process that touches a block —
+producer engine, consumer engine, fake engine, the shard's own
+anti-entropy sweep — computes identical owner sets, which is what makes
+"replica" a property of the ring rather than of any coordinator.
+
+Fan-out and failover:
+
+- **puts** fan to all R owners; a page counts as published when at least
+  one owner stored it (the survivors' copies are what the degradation
+  matrix leans on — one shard SIGKILLed mid-handoff must not fail the
+  transfer).
+- **reads** walk the ring order from the block's position: owners first,
+  then the remaining shards (so blocks placed under an older ring epoch
+  stay findable after a shard join — rebalance never loses data, it only
+  adds a hop until read-repair re-homes the block). The walk skips shards
+  whose circuit breaker refuses (the same 3-state breaker machinery the
+  router's proxy uses, fed here from per-call outcomes), fails over on
+  error/miss/corrupt, and each hop is one bounded attempt under the
+  caller's remaining deadline (the per-shard client's own jittered retry
+  covers transient blips).
+- **read-repair**: a block served by anything but its first healthy owner
+  is re-pushed to the owners that missed, counted in
+  ``pst_kv_read_repairs_total`` — the on-demand half of replica healing
+  (the kvserver's anti-entropy sweep is the background half).
+- **integrity**: digest verification lives in the per-shard client
+  (every framed read is checked before deserialization); a corrupt copy
+  is quarantined on its shard and the walk continues to the next replica,
+  so corruption degrades to at worst a recompute, never a wrong page.
+
+Thread contract: engine step/worker/executor threads all call in here,
+and :class:`~production_stack_tpu.resilience.breaker.CircuitBreaker` is
+asyncio-single-thread code — every breaker touch goes through one lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..hashring import ConsistentHashRing
+from ..logging_utils import init_logger
+from ..obs.metrics import note_read_repair
+from ..resilience.breaker import CircuitBreaker
+
+logger = init_logger(__name__)
+
+# Shard breakers trip faster than router↔engine ones (3 vs 5 failures,
+# 5 s vs 10 s recovery): a dead shard costs every read a timeout until
+# the breaker opens, and the replica walk makes skipping cheap.
+SHARD_FAILURE_THRESHOLD = 3
+SHARD_RECOVERY_TIME_S = 5.0
+
+
+class ShardedKVClient:
+    """R-way replicated client over N kvserver shards (docs/kvserver.md)."""
+
+    def __init__(
+        self,
+        urls: Sequence[str],
+        replication: int = 2,
+        timeout: float = 5.0,
+    ):
+        from ..engine.cache_tiering import RemoteKVClient
+
+        self.urls = [u.rstrip("/") for u in urls if u]
+        if not self.urls:
+            raise ValueError("ShardedKVClient needs at least one shard URL")
+        self.replication = min(max(int(replication), 1), len(self.urls))
+        self.timeout = timeout
+        self._ring = ConsistentHashRing()
+        self._ring.update(self.urls)
+        self._clients: Dict[str, RemoteKVClient] = {
+            u: RemoteKVClient(u, timeout=timeout) for u in self.urls
+        }
+        # pstlint: owned-by=lock:_breaker_lock
+        self._breakers: Dict[str, CircuitBreaker] = {
+            u: CircuitBreaker(
+                u,
+                failure_threshold=SHARD_FAILURE_THRESHOLD,
+                recovery_time=SHARD_RECOVERY_TIME_S,
+            )
+            for u in self.urls
+        }
+        self._breaker_lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "integrity_failures": 0,
+            "read_repairs": 0,
+            "failovers": 0,
+            "retries": 0,
+        }
+
+    # -- ring placement ---------------------------------------------------
+
+    def owners(self, key) -> List[str]:
+        """The R-member replica owner set for a block hash / request id."""
+        return self._ring.get_nodes(str(key), self.replication)
+
+    def _walk(self, key) -> List[str]:
+        """Ring-order read walk: the owner set first, then every remaining
+        shard — the tail keeps pre-join blocks findable after a rebalance."""
+        return self._ring.get_nodes(str(key), len(self.urls))
+
+    # -- breaker gossip ---------------------------------------------------
+
+    def _admits(self, url: str) -> bool:
+        with self._breaker_lock:
+            return self._breakers[url].allows()
+
+    def _record(self, url: str, ok: bool) -> None:
+        with self._breaker_lock:
+            if ok:
+                self._breakers[url].record_success()
+            else:
+                self._breakers[url].record_failure()
+
+    def shard_health(self) -> Dict[str, str]:
+        """Breaker state per shard (``closed``/``half_open``/``open``) —
+        the /debug + stats surface."""
+        with self._breaker_lock:
+            return {
+                u: b.current_state().value for u, b in self._breakers.items()
+            }
+
+    def refresh_counters(self) -> None:
+        """Fold the per-shard clients' audit counters into this client's
+        (integrity failures and retries are counted where they happen)."""
+        for key in ("integrity_failures", "retries"):
+            self.counters[key] = sum(
+                c.counters[key] for c in self._clients.values()
+            )
+
+    # -- puts (fan to all owners) ----------------------------------------
+
+    def put(
+        self, h: int, k: np.ndarray, v: np.ndarray,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        ok_any = False
+        for url in self.owners(h):
+            ok = self._clients[url].put(h, k, v, timeout=timeout)
+            self._record(url, ok)
+            ok_any = ok_any or ok
+        return ok_any
+
+    def put_blocks(
+        self,
+        pages: Sequence[Tuple[int, np.ndarray, np.ndarray]],
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Fan batched puts to each page's owner set; True when EVERY page
+        landed on at least one owner (a wholly-dead shard degrades to
+        R-1 copies, not to a failed transfer)."""
+        if not pages:
+            return True
+        by_owner: Dict[str, List[Tuple[int, np.ndarray, np.ndarray]]] = {}
+        for page in pages:
+            for url in self.owners(page[0]):
+                by_owner.setdefault(url, []).append(page)
+        owner_ok: Dict[str, bool] = {}
+        for url, group in by_owner.items():
+            if not self._admits(url):
+                owner_ok[url] = False
+                continue
+            ok = self._clients[url].put_blocks(group, timeout=timeout)
+            self._record(url, ok)
+            owner_ok[url] = ok
+        return all(
+            any(owner_ok.get(url, False) for url in self.owners(page[0]))
+            for page in pages
+        )
+
+    # -- reads (nearest healthy owner, failover, read-repair) -------------
+
+    def get(
+        self, h: int, timeout: Optional[float] = None,
+        source: str = "restore",
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.timeout
+        )
+        walk = self._walk(h)
+        owner_set = set(self.owners(h))
+        missed_owners: List[str] = []
+        for i, url in enumerate(walk):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            if not self._admits(url):
+                if url in owner_set:
+                    missed_owners.append(url)
+                continue
+            page, status = self._clients[url].get_ex(
+                h, timeout=remaining, source=source
+            )
+            self._record(url, status != "error")
+            if page is not None:
+                if i > 0:
+                    self.counters["failovers"] += 1
+                self._repair([(h, *page)], missed_owners)
+                return page
+            if url in owner_set:
+                missed_owners.append(url)
+        return None
+
+    def get_blocks(
+        self, hashes: Sequence[int], timeout: Optional[float] = None,
+        source: str = "match_prefix",
+    ) -> "dict[int, Tuple[np.ndarray, np.ndarray]]":
+        if not hashes:
+            return {}
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.timeout
+        )
+        # Group by read-walk so each shard sees ONE batched round trip per
+        # call (N shards -> at most N rotations of the ring order).
+        groups: Dict[tuple, List[int]] = {}
+        for h in hashes:
+            groups.setdefault(tuple(self._walk(h)), []).append(h)
+        found: "dict[int, Tuple[np.ndarray, np.ndarray]]" = {}
+        repairs: Dict[str, List[Tuple[int, np.ndarray, np.ndarray]]] = {}
+        for walk, group in groups.items():
+            owner_set = {
+                h: set(self.owners(h)) for h in group
+            }
+            remaining_hashes = list(group)
+            missed: Dict[int, List[str]] = {h: [] for h in group}
+            for i, url in enumerate(walk):
+                if not remaining_hashes:
+                    break
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    break
+                if not self._admits(url):
+                    for h in remaining_hashes:
+                        if url in owner_set[h]:
+                            missed[h].append(url)
+                    continue
+                pages, status = self._clients[url].get_blocks_ex(
+                    remaining_hashes, timeout=budget, source=source
+                )
+                self._record(url, status != "error")
+                if i > 0 and pages:
+                    self.counters["failovers"] += 1
+                for h, page in pages.items():
+                    found[h] = page
+                    for owner in missed[h]:
+                        repairs.setdefault(owner, []).append((h, *page))
+                still = []
+                for h in remaining_hashes:
+                    if h in pages:
+                        continue
+                    if url in owner_set[h]:
+                        missed[h].append(url)
+                    still.append(h)
+                remaining_hashes = still
+        for url, batch in repairs.items():
+            self._push_repairs(url, batch)
+        return found
+
+    def _repair(self, pages, missed_owners: List[str]) -> None:
+        for url in missed_owners:
+            self._push_repairs(url, pages)
+
+    def _push_repairs(self, url: str, pages) -> None:
+        """Re-push blocks an owner was proven to miss (read-repair). Runs
+        inline on the read path — bounded by what the read itself just
+        observed missing, and the read paths (prefetch executor thread,
+        match_prefix walk) already tolerate remote round trips."""
+        if not pages or not self._admits(url):
+            return
+        ok = self._clients[url].put_blocks(pages, timeout=self.timeout)
+        self._record(url, ok)
+        if ok:
+            self.counters["read_repairs"] += len(pages)
+            note_read_repair(len(pages))
+
+    # -- manifests (replicated to the request id's owner set) -------------
+
+    def post_manifest(
+        self,
+        request_id: str,
+        hashes: Sequence[int],
+        complete: bool = False,
+        total_blocks: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        ok_any = False
+        for url in self.owners(request_id):
+            ok = self._clients[url].post_manifest(
+                request_id, hashes, complete=complete,
+                total_blocks=total_blocks, timeout=timeout,
+            )
+            self._record(url, ok)
+            ok_any = ok_any or ok
+        return ok_any
+
+    def get_manifest(
+        self,
+        request_id: str,
+        wait_s: float = 0.0,
+        have: int = -1,
+        timeout: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Owner-walk manifest read: the first healthy owner carries the
+        long-poll; on no progress the remaining owners get a quick
+        (``wait_s=0``) check so a replica that missed some appends (it was
+        down for them) cannot stall the consumer behind a stale view —
+        the richest view wins."""
+        best: Optional[dict] = None
+        poll = wait_s
+        for url in self.owners(request_id):
+            if not self._admits(url):
+                continue
+            view = self._clients[url].get_manifest(
+                request_id, wait_s=poll, have=have, timeout=timeout
+            )
+            poll = 0.0  # only the first healthy owner long-polls
+            if view is None:
+                continue
+            if (
+                best is None
+                or (view.get("complete") and not best.get("complete"))
+                or len(view.get("hashes") or [])
+                > len(best.get("hashes") or [])
+            ):
+                best = view
+            if best.get("complete") or len(best.get("hashes") or []) > have:
+                return best
+        return best
+
+    # -- stats ------------------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        self.refresh_counters()
+        return {
+            "shards": len(self.urls),
+            "replication": self.replication,
+            "shard_health": self.shard_health(),
+            **self.counters,
+        }

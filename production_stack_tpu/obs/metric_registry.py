@@ -49,6 +49,11 @@ class MetricSpec:
 REGISTRY: Tuple[MetricSpec, ...] = (
     # --- obs/metrics.py: shared stage-latency decomposition -------------
     MetricSpec("pst_stage_duration_seconds", HISTOGRAM, "obs/metrics.py"),
+    # Replicated remote-KV tier integrity (docs/kvserver.md): corrupt
+    # replica copies detected on read (by source path) and blocks
+    # re-pushed to owners that missed them (read-repair).
+    MetricSpec("pst_kv_integrity_failures", COUNTER, "obs/metrics.py"),
+    MetricSpec("pst_kv_read_repairs", COUNTER, "obs/metrics.py"),
     # --- obs/logging.py: structured-logging hot-path sampler ------------
     MetricSpec("pst_log_dropped", COUNTER, "obs/logging.py"),
     # --- obs/engine_telemetry.py: TPU engine device layer ---------------

@@ -17,7 +17,12 @@ from __future__ import annotations
 
 from typing import Iterable, Optional, Tuple
 
-from prometheus_client import CollectorRegistry, Histogram, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Histogram,
+    generate_latest,
+)
 from prometheus_client.openmetrics import exposition as _openmetrics
 
 OBS_REGISTRY = CollectorRegistry()
@@ -42,6 +47,37 @@ stage_duration = Histogram(
     registry=OBS_REGISTRY,
     buckets=_BUCKETS,
 )
+
+
+kv_integrity_failures = Counter(
+    "pst_kv_integrity_failures",
+    "KV pages whose BLAKE2 digest failed verification on a read path, by "
+    "source (prefetch = disagg consumer manifest-following, match_prefix "
+    "= the remote leg of prefix matching, restore = single-page fault-up)."
+    " Each count is a quarantined replica copy and a failover/recompute — "
+    "a corrupt page is never decoded (docs/kvserver.md)",
+    ["source"],
+    registry=OBS_REGISTRY,
+)
+
+kv_read_repairs = Counter(
+    "pst_kv_read_repairs",
+    "KV pages found on fewer than R ring owners during a read and "
+    "re-pushed to the owners that missed (client-side read-repair, "
+    "docs/kvserver.md)",
+    registry=OBS_REGISTRY,
+)
+
+
+def note_integrity_failure(source: str, n: int = 1) -> None:
+    """Count ``n`` digest-verification failures on read path ``source``."""
+    if n > 0:
+        kv_integrity_failures.labels(source=source).inc(n)
+
+
+def note_read_repair(n: int = 1) -> None:
+    if n > 0:
+        kv_read_repairs.inc(n)
 
 
 def observe_stage(

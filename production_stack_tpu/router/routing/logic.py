@@ -18,13 +18,11 @@ Redesigns:
 
 from __future__ import annotations
 
-import bisect
 import enum
 import random
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-import xxhash
 
 from ...logging_utils import init_logger
 from ...obs.tasks import spawn_owned
@@ -85,89 +83,12 @@ def _header(headers: Dict[str, str], key: Optional[str]) -> Optional[str]:
     return None
 
 
-class ConsistentHashRing:
-    """xxhash-based ring with virtual nodes; minimal remapping on membership change."""
-
-    def __init__(self, vnodes: int = 160):
-        self.vnodes = vnodes
-        # pstlint: owned-by=task:update,_rebuild
-        self._nodes: set = set()
-        # pstlint: owned-by=task:update,_rebuild
-        self._ring: List[Tuple[int, str]] = []
-        # pstlint: owned-by=task:update,_rebuild
-        self._hashes: List[int] = []
-
-    def _rebuild(self) -> None:
-        ring = []
-        for node in self._nodes:
-            for v in range(self.vnodes):
-                ring.append((xxhash.xxh64_intdigest(f"{node}#{v}"), node))
-        ring.sort()
-        self._ring = ring
-        self._hashes = [h for h, _ in ring]
-
-    def update(self, nodes: Sequence[str]) -> None:
-        new = set(nodes)
-        if new != self._nodes:
-            self._nodes = new
-            self._rebuild()
-
-    def get_node(self, key: str) -> Optional[str]:
-        if not self._ring:
-            return None
-        h = xxhash.xxh64_intdigest(key)
-        idx = bisect.bisect(self._hashes, h) % len(self._ring)
-        return self._ring[idx][1]
-
-    def get_node_bounded(
-        self,
-        key: str,
-        loads: Dict[str, float],
-        c: float = 2.0,
-        allowed: Optional[set] = None,
-    ) -> Optional[str]:
-        """Consistent hashing with bounded loads (Mirrokni et al.): walk
-        the ring clockwise from ``key``'s position and take the first
-        node whose current load is under ``c ×`` the mean load, falling
-        back to the first eligible node when everything is saturated.
-        Replicated routers use this over the *shared* endpoint view +
-        fleet-wide stats, so every replica computes the same (key → node)
-        map AND a hot-spotted node sheds to the same successor on every
-        replica.
-
-        ``allowed`` constrains the pick to THIS replica's routable
-        candidates (model match, not draining/sleeping, breaker-admitted)
-        while the ring still hashes over the shared fleet view: replicas
-        whose candidate sets agree pick identically, and a replica whose
-        discovery lags simply walks to the nearest node it can actually
-        route to — it never picks an engine it must not use."""
-        if not self._ring:
-            return None
-        candidates = (
-            self._nodes if allowed is None else self._nodes & set(allowed)
-        )
-        if not candidates:
-            return None
-        mean = sum(loads.get(n, 0.0) for n in candidates) / len(candidates)
-        bound = c * max(mean, 1.0)
-        h = xxhash.xxh64_intdigest(key)
-        start = bisect.bisect(self._hashes, h) % len(self._ring)
-        first_eligible: Optional[str] = None
-        seen: set = set()
-        for i in range(len(self._ring)):
-            node = self._ring[(start + i) % len(self._ring)][1]
-            if node in seen:
-                continue
-            seen.add(node)
-            if node not in candidates:
-                continue
-            if first_eligible is None:
-                first_eligible = node
-            if loads.get(node, 0.0) < bound:
-                return node
-            if len(seen) == len(self._nodes):
-                break
-        return first_eligible
+# The ring lives in the dependency-free production_stack_tpu.hashring so
+# the sharded KV client and the kvserver's anti-entropy sweep compute the
+# same (key -> owner) placement without importing the router stack;
+# re-exported here because this module is its historical home and the
+# routing policies below are its primary consumer.
+from ...hashring import ConsistentHashRing  # noqa: E402  (re-export)
 
 
 def _run_trie_eviction(trie: HashTrie, url: str) -> None:

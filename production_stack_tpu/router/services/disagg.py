@@ -68,6 +68,35 @@ def pool_candidates(endpoints: List, pool: str) -> List:
     return (own + fused) if own or fused else list(endpoints)
 
 
+def kv_health_penalty(endpoint, engine_stats) -> int:
+    """A decode candidate's remote-KV degradation score: fused-recompute
+    fallbacks plus corrupt replica copies its engine detected on read
+    (scraped off engine /metrics — docs/kvserver.md). 0 when the engine
+    has no stats yet, so undiscovered engines are never deprioritized."""
+    stats = (engine_stats or {}).get(getattr(endpoint, "url", None))
+    if stats is None:
+        return 0
+    return int(
+        getattr(stats, "kv_transfer_fallbacks_total", 0)
+        + getattr(stats, "kv_integrity_failures_total", 0)
+    )
+
+
+def order_by_kv_health(candidates: List, engine_stats) -> List:
+    """Stable-sort a decode-leg candidate list so engines whose remote KV
+    tier is degrading (fallbacks, integrity failures) sort behind healthy
+    peers. Stable: within a penalty tier the pool ordering (own pool
+    before fused) and the routing policy's own choice are preserved — this
+    only *biases* the decode leg away from engines that keep recomputing
+    transfers, it never excludes anyone (a fleet where every engine is
+    degraded still routes)."""
+    if not engine_stats:
+        return list(candidates)
+    return sorted(
+        candidates, key=lambda e: kv_health_penalty(e, engine_stats)
+    )
+
+
 def fleet_has_pools(endpoints: List) -> bool:
     """Disagg is the fleet shape when both a prefill and a decode pool are
     declared — the router then runs the two-leg flow for every generation

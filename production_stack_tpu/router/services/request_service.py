@@ -1804,7 +1804,12 @@ async def route_disaggregated_prefill_request(
     # pool plus the fused engines; an empty pool degrades to the whole
     # candidate list so mixed fleets keep serving.
     prefill_candidates = disagg.pool_candidates(endpoints, disagg.POOL_PREFILL)
-    decode_candidates = disagg.pool_candidates(endpoints, disagg.POOL_DECODE)
+    # Decode leg prefers engines whose remote-KV tier is healthy: scraped
+    # fallback + integrity-failure counters bias (stable sort — never
+    # exclude) the leg away from engines stuck recomputing transfers.
+    decode_candidates = disagg.order_by_kv_health(
+        disagg.pool_candidates(endpoints, disagg.POOL_DECODE), engine_stats
+    )
 
     original_max_tokens = request_json.get("max_tokens")
     original_stream = request_json.get("stream", False)

@@ -46,6 +46,11 @@ _METRIC_FIELDS = {
     "pst_engine_kv_page_occupancy": "engine_kv_page_occupancy",
     "pst_engine_kv_page_high_watermark": "engine_kv_page_high_watermark",
     "pst_engine_warmup_coverage": "engine_warmup_coverage",
+    # Remote-KV health (docs/kvserver.md): the disagg decode scorer
+    # penalizes engines whose remote tier is degrading (fused-recompute
+    # fallbacks, corrupt replica copies detected on read).
+    "pst:kv_transfer_fallbacks_total": "kv_transfer_fallbacks_total",
+    "pst_kv_integrity_failures_total": "kv_integrity_failures_total",
 }
 
 # Histogram whose p50 the scraper estimates from bucket counts (summed
@@ -77,7 +82,11 @@ def _bucket_quantile(buckets, q: float) -> float:
 # Labeled counters summed over their label sets (pst_engine_compile_total
 # has one sample per {kind, shape_bucket}); everything else is a single
 # sample and the last value wins.
-_SUMMED_FIELDS = {"engine_compiles_total"}
+_SUMMED_FIELDS = {
+    "engine_compiles_total",
+    # One sample per {source} (prefetch / match_prefix / restore).
+    "kv_integrity_failures_total",
+}
 
 
 @dataclass
@@ -93,6 +102,9 @@ class EngineStats:
     engine_kv_page_occupancy: float = 0.0
     engine_kv_page_high_watermark: float = 0.0
     engine_warmup_coverage: float = 0.0
+    # Remote-KV tier health (docs/kvserver.md).
+    kv_transfer_fallbacks_total: int = 0
+    kv_integrity_failures_total: int = 0
     # Estimated from the pst_engine_host_gap_seconds bucket counts.
     engine_host_gap_p50: float = 0.0
 

@@ -73,7 +73,8 @@ def kv_chunk_hashes(text: str) -> List[int]:
 
 class FakeEngineState:
     def __init__(self, model: str, speed: float, max_tokens_default: int = 32,
-                 kv_capacity_tokens: int = 20000, kv_url: Optional[str] = None):
+                 kv_capacity_tokens: int = 20000, kv_url: Optional[str] = None,
+                 kv_replication: int = 2):
         self.model = model
         self.speed = speed  # tokens per second
         self.max_tokens_default = max_tokens_default
@@ -82,11 +83,32 @@ class FakeEngineState:
         # block manifests + pages per simulated prefill chunk, and a
         # consumer-leg generation follows the manifest and batch-fetches
         # them BEFORE decoding — the real handoff protocol without TPUs.
-        self.kv_url = kv_url.rstrip("/") if kv_url else None
+        # A comma-separated URL list makes this a sharded-ring client with
+        # the same placement/replication/read-repair semantics as the real
+        # engine's ShardedKVClient (docs/kvserver.md) — what the
+        # kv_shard_kill chaos leg drives.
+        self.kv_urls = [
+            u.strip().rstrip("/")
+            for u in (kv_url or "").split(",") if u.strip()
+        ]
+        self.kv_url = self.kv_urls[0] if self.kv_urls else None
+        self.kv_replication = (
+            min(max(int(kv_replication), 1), len(self.kv_urls))
+            if self.kv_urls else 0
+        )
+        self.kv_ring = None
+        if len(self.kv_urls) > 1:
+            from ..hashring import ConsistentHashRing
+
+            self.kv_ring = ConsistentHashRing()
+            self.kv_ring.update(self.kv_urls)
         self.kv_transfer_timeout = 5.0
         self.kv_published_blocks = 0
         self.kv_prefetched_blocks = 0
         self.kv_transfer_fallbacks = 0
+        self.kv_read_repairs = 0
+        self.kv_integrity_failures = 0
+
         self.manifest_fetches = 0
         self.kv_publish_chunks = 3  # simulated prefill chunk count
         self.kv_chunk_delay = 0.02  # seconds between chunk publishes
@@ -188,6 +210,19 @@ class FakeEngineState:
         self.warm_start = False
         self.warmup_started = time.monotonic()
         self._marker_written = False
+
+    def kv_owners(self, key) -> List[str]:
+        """A block/manifest key's R-member replica owner set (the whole
+        "fleet" when single-shard — identical to the pre-ring behavior)."""
+        if self.kv_ring is None:
+            return list(self.kv_urls)
+        return self.kv_ring.get_nodes(str(key), self.kv_replication)
+
+    def kv_walk(self, key) -> List[str]:
+        """Ring-order read walk (owners first, then every other shard)."""
+        if self.kv_ring is None:
+            return list(self.kv_urls)
+        return self.kv_ring.get_nodes(str(key), len(self.kv_urls))
 
     def configure_warmup(
         self, ready_delay: float, cache_dir: Optional[str] = None
@@ -456,9 +491,10 @@ def create_fake_engine_app(
     warmup_cache_dir: Optional[str] = None,
     kv_capacity_tokens: int = 20000,
     kv_url: Optional[str] = None,
+    kv_replication: int = 2,
 ) -> web.Application:
     state = FakeEngineState(model, speed, kv_capacity_tokens=kv_capacity_tokens,
-                            kv_url=kv_url)
+                            kv_url=kv_url, kv_replication=kv_replication)
     # Instance identity for routing-distribution e2e assertions: surfaces in
     # the X-Served-By header of every generation response.
     state.name = name or f"fake-{uuid.uuid4().hex[:6]}"
@@ -485,86 +521,183 @@ def create_fake_engine_app(
 
     app.on_cleanup.append(_close_kv_session)
 
+    async def _kv_post_manifest(rid: str, payload: dict) -> bool:
+        """Replicate a manifest append/marker to the request id's owner
+        set; True when at least one owner acked (the survivors' view is
+        what the consumer's owner-walk reads)."""
+        ok = False
+        for url in state.kv_owners(rid):
+            try:
+                async with _kv_session().post(
+                    f"{url}/manifests/{rid}", json=payload
+                ) as r:
+                    r.raise_for_status()
+                ok = True
+            except (aiohttp.ClientError, OSError):
+                continue
+        return ok
+
+    async def _kv_put_pages(
+        pages: List[tuple], urls: Optional[List[str]] = None
+    ) -> set:
+        """Fan ``(hash, payload)`` pages to each page's ring owners (or an
+        explicit url list); returns the hashes stored on >= 1 shard."""
+        from ..kvserver.server import pack_blocks
+
+        sess = _kv_session()
+        by_owner: dict = {}
+        for h, data in pages:
+            for url in (urls if urls is not None else state.kv_owners(h)):
+                by_owner.setdefault(url, []).append((h, data))
+        stored: set = set()
+        for url, group in by_owner.items():
+            try:
+                async with sess.post(
+                    f"{url}/blocks", data=pack_blocks(group)
+                ) as r:
+                    r.raise_for_status()
+                stored.update(h for h, _ in group)
+            except (aiohttp.ClientError, OSError):
+                continue
+        return stored
+
     async def _kv_publish(rid: str, hashes: List[int], faulted: bool,
                           chunk_delay: Optional[float] = None) -> None:
         """Producer leg: publish deterministic pages + manifest appends in
         ``kv_publish_chunks`` batches with a delay between them — the
-        simulated chunked prefill the decode side overlaps against. A
-        ``transfer`` fault (or a dead kvserver) publishes nothing, so the
-        manifest never completes and the consumer times out into its
-        fused fallback."""
-        from ..kvserver.server import pack_blocks
-
+        simulated chunked prefill the decode side overlaps against. Pages
+        fan to their R ring owners, so a single shard SIGKILLed
+        mid-handoff leaves the transfer intact (the degradation matrix).
+        A ``transfer`` fault (or a wholly-dead kvserver tier) publishes
+        nothing, so the manifest never completes and the consumer times
+        out into its fused fallback."""
         n = max(state.kv_publish_chunks, 1)
         per = max(-(-len(hashes) // n), 1)
-        sent = 0
         for i in range(0, len(hashes), per):
             chunk = hashes[i : i + per]
             if not faulted:
-                try:
-                    sess = _kv_session()
-                    body = pack_blocks(
-                        [(h, f"page-{h}".encode()) for h in chunk]
-                    )
-                    async with sess.post(
-                        f"{state.kv_url}/blocks", data=body
-                    ) as r:
-                        r.raise_for_status()
-                    async with sess.post(
-                        f"{state.kv_url}/manifests/{rid}",
-                        json={"hashes": chunk},
-                    ) as r:
-                        r.raise_for_status()
-                    sent += len(chunk)
+                stored = await _kv_put_pages(
+                    [(h, f"page-{h}".encode()) for h in chunk]
+                )
+                ok = stored >= set(chunk)
+                if ok:
+                    ok = await _kv_post_manifest(rid, {"hashes": chunk})
+                if ok:
                     state.kv_published_blocks += len(chunk)
-                except (aiohttp.ClientError, OSError):
-                    faulted = True  # kvserver died mid-transfer
+                else:
+                    faulted = True  # every owner of some page is dead
             await asyncio.sleep(
                 state.kv_chunk_delay if chunk_delay is None else chunk_delay
             )
         if faulted:
             state.kv_transfer_fallbacks += 1
             return
-        try:
-            async with _kv_session().post(
-                f"{state.kv_url}/manifests/{rid}",
-                json={"complete": True, "total_blocks": len(hashes)},
-            ) as r:
-                r.raise_for_status()
-        except (aiohttp.ClientError, OSError):
+        if not await _kv_post_manifest(
+            rid, {"complete": True, "total_blocks": len(hashes)}
+        ):
             state.kv_transfer_fallbacks += 1
+
+    async def _kv_fetch_blocks(hashes: List[int]) -> int:
+        """Batch-fetch blocks with per-hash ring-walk failover, integrity
+        verification, quarantine-on-corrupt and read-repair — the fake
+        twin of ShardedKVClient.get_blocks. Returns the number of VERIFIED
+        blocks fetched; a corrupt copy is quarantined on its shard and the
+        walk falls over to the next replica, never counting the bad copy."""
+        from ..kvserver.server import unpack_blocks
+
+        sess = _kv_session()
+        groups: dict = {}
+        for h in hashes:
+            groups.setdefault(tuple(state.kv_walk(h)), []).append(h)
+        fetched = 0
+        repairs: dict = {}  # owner url -> [(hash, payload)]
+        for walk, group in groups.items():
+            owner_set = {h: set(state.kv_owners(h)) for h in group}
+            remaining = list(group)
+            missed: dict = {h: [] for h in group}
+            for url in walk:
+                if not remaining:
+                    break
+                got: dict = {}
+                try:
+                    async with sess.get(
+                        f"{url}/blocks",
+                        params={"hashes": ",".join(
+                            str(h) for h in remaining
+                        )},
+                    ) as r:
+                        if r.status == 200:
+                            corrupt: List[int] = []
+                            for h, data in unpack_blocks(
+                                await r.read(), corrupt=corrupt
+                            ):
+                                got[h] = data
+                            if corrupt:
+                                state.kv_integrity_failures += len(corrupt)
+                                try:
+                                    async with sess.post(
+                                        f"{url}/admin/quarantine",
+                                        json={"hashes": corrupt},
+                                    ):
+                                        pass
+                                except (aiohttp.ClientError, OSError):
+                                    pass
+                except (aiohttp.ClientError, OSError, ValueError):
+                    pass
+                still = []
+                for h in remaining:
+                    if h in got:
+                        fetched += 1
+                        for owner in missed[h]:
+                            repairs.setdefault(owner, []).append(
+                                (h, got[h])
+                            )
+                        continue
+                    if url in owner_set[h]:
+                        missed[h].append(url)
+                    still.append(h)
+                remaining = still
+        for url, pages in repairs.items():
+            stored = await _kv_put_pages(pages, urls=[url])
+            state.kv_read_repairs += len(stored)
+        return fetched
 
     async def _kv_prefetch(rid: str, faulted: bool) -> dict:
         """Consumer leg: follow the manifest (long-poll) and batch-fetch
         published blocks until the completion marker — the real handoff
         protocol. Timeout/fault → fused fallback (serve anyway)."""
-        from ..kvserver.server import unpack_blocks
-
         expire = time.monotonic() + state.kv_transfer_timeout
         have = 0
         fetched = 0
         complete = False
         while not faulted and time.monotonic() < expire:
             remaining = expire - time.monotonic()
+            view = None
+            sess = _kv_session()
+            # Owner-walk manifest read: the first healthy owner carries
+            # the long-poll, later owners get a quick check — a replica
+            # that missed appends cannot stall the consumer.
+            wait = round(min(remaining, 0.5), 3)
+            for url in state.kv_owners(rid):
+                try:
+                    async with sess.get(
+                        f"{url}/manifests/{rid}",
+                        params={"wait_s": wait, "have": have},
+                    ) as r:
+                        state.manifest_fetches += 1
+                        wait = 0
+                        if r.status == 200:
+                            view = await r.json()
+                            break
+                except (aiohttp.ClientError, OSError):
+                    continue
+            if view is None:
+                await asyncio.sleep(0.02)
+                continue
             try:
-                sess = _kv_session()
-                async with sess.get(
-                    f"{state.kv_url}/manifests/{rid}",
-                    params={"wait_s": round(min(remaining, 0.5), 3),
-                            "have": have},
-                ) as r:
-                    state.manifest_fetches += 1
-                    if r.status != 200:
-                        await asyncio.sleep(0.02)
-                        continue
-                    view = await r.json()
                 new = (view.get("hashes") or [])[have:]
                 if new:
-                    async with sess.get(
-                        f"{state.kv_url}/blocks",
-                        params={"hashes": ",".join(str(h) for h in new)},
-                    ) as r:
-                        fetched += len(unpack_blocks(await r.read()))
+                    fetched += await _kv_fetch_blocks(new)
                 have = len(view.get("hashes") or [])
                 if view.get("complete") and have >= int(
                     view.get("total_blocks") or 0
@@ -1045,6 +1178,13 @@ def create_fake_engine_app(
                 "# TYPE pst:kv_transfer_fallbacks counter",
                 "pst:kv_transfer_fallbacks_total "
                 f"{state.kv_transfer_fallbacks}",
+                # Replicated remote tier (docs/kvserver.md) — underscore
+                # names, same as the real engines' shared obs registry.
+                "# TYPE pst_kv_integrity_failures counter",
+                'pst_kv_integrity_failures_total{source="prefetch"} '
+                f"{state.kv_integrity_failures}",
+                "# TYPE pst_kv_read_repairs counter",
+                f"pst_kv_read_repairs_total {state.kv_read_repairs}",
                 "",
             ]
         )
@@ -1110,6 +1250,10 @@ def create_fake_engine_app(
             "kv_published_blocks": state.kv_published_blocks,
             "kv_prefetched_blocks": state.kv_prefetched_blocks,
             "kv_transfer_fallbacks": state.kv_transfer_fallbacks,
+            "kv_read_repairs": state.kv_read_repairs,
+            "kv_integrity_failures": state.kv_integrity_failures,
+            "kv_shards": len(state.kv_urls),
+            "kv_replication": state.kv_replication,
             "manifest_fetches": state.manifest_fetches,
             "prefix_hit_rate": round(hit_rate, 4),
             # Matches the deterministic pst_engine_compile_total samples
@@ -1416,7 +1560,12 @@ def main(argv: Optional[list] = None) -> None:
                         "enables the disagg handoff protocol — producer "
                         "legs publish deterministic block manifests per "
                         "simulated prefill chunk, consumer legs follow "
-                        "them and batch-fetch before decoding")
+                        "them and batch-fetch before decoding; a comma-"
+                        "separated list enables the sharded ring client "
+                        "(placement, replication, read-repair)")
+    p.add_argument("--kv-replication", type=int, default=2,
+                   help="replicas per block/manifest on the kvserver "
+                        "ring (clamped to the shard count)")
     p.add_argument("--kv-capacity-tokens", type=int, default=20000,
                    help="simulated KV capacity: occupancy and prefix-hit "
                         "eviction derive from it (small values make "
@@ -1435,6 +1584,7 @@ def main(argv: Optional[list] = None) -> None:
         ready_delay=args.ready_delay, warmup_cache_dir=args.warmup_cache_dir,
         kv_capacity_tokens=args.kv_capacity_tokens,
         kv_url=args.kv_url,
+        kv_replication=args.kv_replication,
     )
     app["state"].chip_ms_per_ktok = max(args.chip_ms_per_ktok, 0.0)
     web.run_app(app, host=args.host, port=args.port, access_log=None)

@@ -426,6 +426,205 @@ def leg_disagg_pools():
           f"decode={dict(decode_served)})")
 
 
+def leg_kv_shard_kill():
+    """Replicated remote-KV ring degradation matrix (docs/kvserver.md):
+    3 kvserver shards (R=2) behind 2 prefill + 2 decode fake engines.
+    One shard is SIGKILLed mid-load: zero client-visible 5xx, the
+    decode pool's prefetch hit rate stays within 5% of what the prefill
+    pool published, and after the shard restarts EMPTY a ring read walks
+    past the hole, finds the surviving replica, and the read-repair
+    counter moves while the block lands back on the restarted shard."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+    shard_procs = {}
+    try:
+        shard_ports = [free_port() for _ in range(3)]
+        shard_urls = [f"http://127.0.0.1:{p}" for p in shard_ports]
+
+        def spawn_shard(i):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "production_stack_tpu.kvserver.server",
+                 "--host", "127.0.0.1", "--port", str(shard_ports[i]),
+                 "--peers", ",".join(shard_urls),
+                 "--self-url", shard_urls[i],
+                 "--replication", "2",
+                 # Sweep off: repairs in this leg must be attributable to
+                 # the read path, not the background anti-entropy pass.
+                 "--sweep-interval-s", "0"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            procs.append(proc)
+            shard_procs[i] = proc
+            return proc
+
+        for i in range(3):
+            spawn_shard(i)
+        for url in shard_urls:
+            wait_http(f"{url}/health")
+
+        pools = ["prefill", "prefill", "decode", "decode"]
+        eports = [free_port() for _ in pools]
+        for i, port in enumerate(eports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", MODEL, "--speed", "2000",
+                 "--name", f"{pools[i]}-{i}",
+                 "--kv-url", ",".join(shard_urls),
+                 "--kv-replication", "2"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ))
+        for port in eports:
+            wait_http(f"http://127.0.0.1:{port}/health")
+        rport = free_port()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.router.app",
+             "--host", "127.0.0.1", "--port", str(rport),
+             "--service-discovery", "static",
+             "--static-backends",
+             ",".join(f"http://127.0.0.1:{p}" for p in eports),
+             "--static-models", ",".join([MODEL] * len(pools)),
+             "--static-pools", ",".join(pools),
+             "--routing-logic", "fleet",
+             "--engine-stats-interval", "1"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+        url = f"http://127.0.0.1:{rport}"
+        wait_http(f"{url}/health")
+
+        def dbg(port):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/state", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        def totals():
+            published = sum(dbg(p)["kv_published_blocks"] for p in eports[:2])
+            prefetched = sum(
+                dbg(p)["kv_prefetched_blocks"] for p in eports[2:]
+            )
+            fallbacks = sum(dbg(p)["kv_transfer_fallbacks"] for p in eports)
+            return published, prefetched, fallbacks
+
+        # Warm phase: all shards healthy.
+        warm_prompts = [f"ring warmup {i} " * 20 for i in range(4)]
+        for i, prompt in enumerate(warm_prompts):
+            status, _, _ = post(
+                f"{url}/v1/completions",
+                {"model": MODEL, "prompt": prompt, "max_tokens": 4},
+            )
+            assert status == 200, status
+        pub0, pre0, fb0 = totals()
+        assert pub0 > 0 and pre0 == pub0 and fb0 == 0, (pub0, pre0, fb0)
+
+        # Chaos phase: SIGKILL shard 1 while a load loop is in flight.
+        import concurrent.futures
+        statuses = []
+
+        def fire(i):
+            status, _, _ = post(
+                f"{url}/v1/completions",
+                {"model": MODEL, "prompt": f"shard chaos {i} " * 20,
+                 "max_tokens": 4},
+            )
+            return status
+
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            futs = [pool.submit(fire, i) for i in range(4)]
+            shard_procs[1].kill()  # SIGKILL, mid-load
+            shard_procs[1].wait(timeout=10)
+            futs += [pool.submit(fire, i) for i in range(4, 12)]
+            statuses = [f.result() for f in futs]
+        assert all(s == 200 for s in statuses), statuses  # zero 5xx
+        pub1, pre1, fb1 = totals()
+        pub_d, pre_d = pub1 - pub0, pre1 - pre0
+        assert pub_d > 0
+        # Hit-rate floor: one dead shard of three, R=2 → at most a
+        # transient in-flight loss; the prefetch hit rate must stay
+        # within 5% of everything published.
+        assert pre_d >= 0.95 * pub_d, (pre_d, pub_d)
+        assert fb1 == fb0, (fb0, fb1)  # no fused fallbacks either
+
+        # Recovery phase: the shard restarts EMPTY. A consumer leg whose
+        # producer published while every shard was healthy re-reads those
+        # blocks: the ring walk skips the hole, serves the surviving
+        # replica, and read-repairs the restarted shard.
+        if REPO not in sys.path:  # script runs from tests/e2e
+            sys.path.insert(0, REPO)
+        from production_stack_tpu.hashring import ConsistentHashRing
+        from production_stack_tpu.testing.fake_engine import kv_chunk_hashes
+
+        ring = ConsistentHashRing()
+        ring.update(shard_urls)
+        # Read-repair heals the copies the walk actually probed: blocks
+        # whose FIRST owner is the restarted shard are guaranteed to be
+        # missed there, failed over, and re-pushed.
+        probe_prompt = next(
+            p for p in (f"repair probe {i} " * 30 for i in range(50))
+            if any(ring.get_nodes(str(h), 2)[0] == shard_urls[1]
+                   for h in kv_chunk_hashes(p))
+        )
+        all_owned = [
+            h for h in kv_chunk_hashes(probe_prompt)
+            if shard_urls[1] in ring.get_nodes(str(h), 2)
+        ]
+        victims = [
+            h for h in all_owned
+            if ring.get_nodes(str(h), 2)[0] == shard_urls[1]
+        ]
+        spawn_shard(1)
+        wait_http(f"{shard_urls[1]}/health")
+        # Publish with every shard up (direct producer leg)...
+        status, _, _ = post(
+            f"http://127.0.0.1:{eports[0]}/v1/completions",
+            {"model": MODEL, "prompt": probe_prompt, "max_tokens": 1,
+             "kv_transfer_params": {"request_id": "repair-probe",
+                                    "role": "producer"}},
+        )
+        assert status == 200, status
+        # ...wipe the restarted shard back to empty (a replica that came
+        # back AFTER the publish)...
+        req = urllib.request.Request(
+            f"{shard_urls[1]}/admin/quarantine", method="POST",
+            data=json.dumps({"hashes": all_owned}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        # ...and replay the consumer leg: it must still complete AND put
+        # the missing copies back on their owner.
+        repairs_before = dbg(eports[3])["kv_read_repairs"]
+        status, _, _ = post(
+            f"http://127.0.0.1:{eports[3]}/v1/completions",
+            {"model": MODEL, "prompt": probe_prompt, "max_tokens": 4,
+             "kv_transfer_params": {"request_id": "repair-probe",
+                                    "role": "consumer"}},
+        )
+        assert status == 200, status
+        repairs = dbg(eports[3])["kv_read_repairs"] - repairs_before
+        assert repairs >= len(victims), (repairs, victims)
+        req = urllib.request.Request(
+            f"{shard_urls[1]}/contains", method="POST",
+            data=json.dumps({"hashes": victims}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            body = json.loads(r.read())
+        assert all(body["present"]), list(zip(victims, body["present"]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(f"PASS kv_shard_kill (published={pub_d}, prefetched={pre_d}, "
+          f"repairs={repairs})")
+
+
 def leg_stress():
     """Concurrency leg: a burst of parallel streaming + non-streaming
     requests all succeed (reference stress-test.sh analogue)."""
@@ -1300,6 +1499,7 @@ LEGS = {
     "fleet": leg_fleet,
     "disaggregated_prefill": leg_disagg,
     "disagg_pools": leg_disagg_pools,
+    "kv_shard_kill": leg_kv_shard_kill,
     "stress": leg_stress,
     "chaos": leg_chaos,
     "router_kill": leg_router_kill,

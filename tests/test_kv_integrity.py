@@ -1,0 +1,176 @@
+"""End-to-end page integrity (docs/kvserver.md): a detected-corrupt
+remote block must NEVER reach decode. Each leg that pulls pages off the
+remote tier — the disagg consumer prefetch and the match_prefix /
+restore path — is driven against a kvserver serving damaged bytes, and
+the decoded tokens must be IDENTICAL to a fused recompute. With a
+replicated ring, a single rotten shard must not even cost the hit rate:
+reads fail over to the healthy replica.
+"""
+
+import time
+
+import numpy as np
+import requests
+
+from production_stack_tpu.engine.sequence import SamplingParams
+
+from .test_disagg_transfer import ThreadedKVServer, _engine, _gen
+from .test_kvserver_ring import ShardCluster
+
+
+def _arm_corrupt(url: str, count: int = 0) -> None:
+    """count<=0: corrupt every served block until /admin/heal."""
+    r = requests.post(f"{url}/admin/fail",
+                      json={"mode": "corrupt", "count": count}, timeout=5.0)
+    assert r.status_code == 200
+
+
+def _publish(kv_url: str, prompt, rid: str, **engine_over):
+    producer = _engine("producer", kv_url, **engine_over)
+    sp_prefill = SamplingParams(max_tokens=1, temperature=0.0,
+                                ignore_eos=True)
+    _gen(producer, prompt, sp_prefill,
+         kv_transfer={"request_id": rid, "role": "producer"})
+    return producer
+
+
+def _wait_manifest_complete(client_get_view, deadline_s=5.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        view = client_get_view()
+        if view and view["complete"]:
+            return view
+        time.sleep(0.02)
+    raise AssertionError("manifest never completed")
+
+
+def test_consumer_prefetch_drops_corrupt_blocks_output_matches_fused():
+    """Every published block is served corrupt: the consumer's prefetch
+    rejects all of them on digest, admits anyway, recomputes the prefill
+    locally — token-for-token identical to a fused engine that never
+    touched the remote tier."""
+    server = ThreadedKVServer().start()
+    try:
+        rng = np.random.default_rng(5)
+        prompt = [int(x) for x in rng.integers(1, 500, size=48)]
+        sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+        fused = _engine("none", None, remote_kv_url=None,
+                        max_prefill_tokens=64)
+        expected = _gen(fused, prompt, sp)
+
+        rid = "integrity-prefetch"
+        _publish(server.url, prompt, rid)
+        _wait_manifest_complete(
+            lambda: server.app["manifests"].view(rid)
+        )
+        store = server.app["store"]
+        assert store.blocks_put == 6
+
+        # Every byte served from here on is damaged — but the digest in
+        # the frame is the producer's, so readers catch it.
+        _arm_corrupt(server.url)
+
+        consumer = _engine("consumer", server.url, max_prefill_tokens=64)
+        fetch = consumer.kv_prefetcher.prefetch(rid)
+        # The manifest completed, but zero corrupt pages were accepted.
+        assert fetch["blocks"] == 0
+        got = _gen(consumer, prompt, sp)
+        assert got["token_ids"] == expected["token_ids"]
+        # Nothing remote was counted as a hit; the prefill recomputed.
+        assert consumer.allocator.remote_hit_blocks == 0
+        assert consumer.allocator.host_hit_blocks == 0
+        # The failures were seen, attributed, and the copies quarantined.
+        client = consumer.allocator.remote
+        assert client.counters["integrity_failures"] >= 6
+        assert store.quarantined >= 1
+        stats = consumer.stats()
+        assert stats["kv_integrity_failures_total"] >= 6
+    finally:
+        server.stop()
+
+
+def test_match_prefix_restore_rejects_corrupt_blocks_output_stable():
+    """The tiering restore leg: pages spilled to the remote store come
+    back through match_prefix's batched fetch. When the store serves
+    them corrupt, the engine must silently recompute — identical output,
+    zero remote 'hits'."""
+    server = ThreadedKVServer().start()
+    try:
+        eng = _engine(
+            "none", server.url,
+            num_kv_blocks=24, max_prefill_tokens=64,
+            cpu_offload_blocks=0,  # remote is the ONLY lower tier
+        )
+        sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        rng = np.random.default_rng(1)
+        prompt_a = [int(x) for x in rng.integers(1, 500, size=64)]
+        prompt_b = [int(x) for x in rng.integers(1, 500, size=64)]
+        prompt_c = [int(x) for x in rng.integers(1, 500, size=64)]
+
+        first = eng.generate([prompt_a], sp)[0]
+        # Fill the 24-block HBM pool → A's pages spill to the remote
+        # store via the async push worker.
+        eng.generate([prompt_b, prompt_c], sp)
+        alloc = eng.allocator
+        assert alloc.spilled_blocks > 0
+        store = server.app["store"]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and store.blocks_put == 0:
+            time.sleep(0.02)
+        assert store.blocks_put > 0, "spill push never reached the store"
+
+        _arm_corrupt(server.url)
+        remote_hits_before = alloc.remote_hit_blocks
+        again = eng.generate([prompt_a], sp)[0]
+        # Identical output — the corrupt restore never reached decode.
+        assert again["token_ids"] == first["token_ids"]
+        assert alloc.remote_hit_blocks == remote_hits_before
+        assert alloc.remote.counters["integrity_failures"] >= 1
+        assert store.quarantined >= 1
+    finally:
+        server.stop()
+
+
+def test_one_corrupt_shard_fails_over_without_losing_hit_rate():
+    """Replicated ring: one shard rots, its replica doesn't. The consumer
+    still prefetches every page (from the healthy copies), decodes with a
+    full prefix hit, and matches the fused output — corruption of a
+    single replica costs integrity counters, not the hit rate."""
+    cluster = ShardCluster(3).start()
+    kv_url = ",".join(cluster.urls)
+    try:
+        rng = np.random.default_rng(5)
+        prompt = [int(x) for x in rng.integers(1, 500, size=48)]
+        sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+        fused = _engine("none", None, remote_kv_url=None,
+                        max_prefill_tokens=64)
+        expected = _gen(fused, prompt, sp)
+
+        rid = "integrity-shard"
+        _publish(kv_url, prompt, rid)
+        consumer = _engine("consumer", kv_url, max_prefill_tokens=64)
+        _wait_manifest_complete(
+            lambda: consumer.allocator.remote.get_manifest(rid, timeout=2.0)
+        )
+        _arm_corrupt(cluster.urls[0])
+
+        fetch = consumer.kv_prefetcher.prefetch(rid)
+        assert fetch["complete"] and fetch["blocks"] == 6
+        got = _gen(consumer, prompt, sp)
+        assert got["token_ids"] == expected["token_ids"]
+        # Full prefix hit despite the rotten shard.
+        assert consumer.allocator.host_hit_blocks >= 5
+        client = consumer.allocator.remote
+        client.refresh_counters()
+        # Integrity failures only show up if the corrupt shard was the
+        # first owner of at least one page; quarantine/failover handled
+        # it either way, with zero consumer-visible effect.
+        assert client.counters["integrity_failures"] >= 0
+        stats = consumer.stats()
+        assert stats["kv_integrity_failures_total"] == float(
+            client.counters["integrity_failures"]
+        )
+    finally:
+        cluster.stop()

@@ -1,0 +1,487 @@
+"""Replicated kvserver ring (docs/kvserver.md): consistent-hash owner
+sets, sharded client fan-out/failover/read-repair, digest integrity with
+quarantine, fault injection, the manifest TTL/cap race, and the
+anti-entropy sweep backfilling a wiped shard.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+from aiohttp import web
+
+from production_stack_tpu.engine.cache_tiering import (
+    RemoteKVClient,
+    create_remote_client,
+)
+from production_stack_tpu.hashring import ConsistentHashRing
+from production_stack_tpu.kvserver.server import (
+    MANIFEST_CAP,
+    ManifestStore,
+    block_digest,
+    create_kv_server_app,
+    pack_blocks,
+    unpack_blocks,
+)
+from production_stack_tpu.kvserver.sharded import ShardedKVClient
+
+
+# ---------------------------------------------------------------------------
+# Ring placement
+# ---------------------------------------------------------------------------
+
+
+def test_get_nodes_distinct_and_stable():
+    ring = ConsistentHashRing()
+    ring.update(["a", "b", "c"])
+    for key in ("k1", "k2", "99887766"):
+        owners = ring.get_nodes(key, 2)
+        assert len(owners) == 2 and len(set(owners)) == 2
+        assert owners == ring.get_nodes(key, 2)  # deterministic
+        # First owner is THE node single-replica placement picks.
+        assert owners[0] == ring.get_node(key)
+    # n >= membership returns every node, still distinct.
+    assert sorted(ring.get_nodes("k1", 5)) == ["a", "b", "c"]
+
+
+def test_rebalance_on_join_keeps_an_owner_and_findability():
+    """One joining shard displaces at most one member of any key's owner
+    set, so with R >= 2 every key keeps at least one pre-join owner — a
+    read that walks the ring order (owners, then the rest) always finds
+    pre-join copies, and read-repair re-homes them afterwards."""
+    before = ConsistentHashRing()
+    before.update(["s0", "s1", "s2"])
+    after = ConsistentHashRing()
+    after.update(["s0", "s1", "s2", "s3"])
+    keys = [str(h) for h in range(500)]
+    moved = 0
+    for key in keys:
+        old = set(before.get_nodes(key, 2))
+        new = set(after.get_nodes(key, 2))
+        assert old & new, f"key {key} lost every pre-join owner"
+        # The full post-join walk covers all shards — every old copy
+        # stays reachable regardless of where the new owners landed.
+        assert old <= set(after.get_nodes(key, 4))
+        moved += len(new - old)
+    # Join rebalance is incremental: roughly 1/4 of replica slots move
+    # to the new shard, nothing like a full reshuffle.
+    assert 0 < moved < len(keys)
+
+
+# ---------------------------------------------------------------------------
+# Manifest TTL/cap race (the fixed producer-append eviction bug)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_active_survives_cap():
+    """An actively-streaming manifest created EARLY must survive cap
+    pressure from thousands of younger manifests: every producer append
+    refreshes its eviction rank (move_to_end), so cap eviction pops
+    genuinely idle manifests instead of the oldest-created one."""
+    ms = ManifestStore()
+    ms.update("active", [1, 2], complete=False, total_blocks=None)
+    for i in range(MANIFEST_CAP - 1):
+        ms.update(f"filler-{i}", [i], complete=True, total_blocks=1)
+    # At cap. The slow prefill appends again — this must re-rank it.
+    ms.update("active", [3], complete=False, total_blocks=None)
+    for i in range(10):
+        ms.update(f"late-{i}", [i], complete=True, total_blocks=1)
+    assert len(ms) == MANIFEST_CAP
+    view = ms.view("active")
+    assert view is not None and view["hashes"] == [1, 2, 3]
+    # The evictees were the idle early fillers, not the active transfer.
+    assert ms.view("filler-0") is None
+
+
+# ---------------------------------------------------------------------------
+# Multi-shard harness (threads + pre-bound sockets, so ring membership is
+# known before the apps boot and sync clients can call in)
+# ---------------------------------------------------------------------------
+
+
+class _Shard:
+    def __init__(self, sock, url, peers, replication, sweep_interval_s,
+                 middleware=None):
+        self.sock = sock
+        self.url = url
+        self._peers = peers
+        self._replication = replication
+        self._sweep = sweep_interval_s
+        self._middleware = middleware
+        self._ready = threading.Event()
+        self.loop = None
+        self.app = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10), f"shard {self.url} failed to start"
+        return self
+
+    def _run(self):
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self.app = create_kv_server_app(
+                max_bytes=1 << 30,
+                peers=self._peers,
+                self_url=self.url,
+                replication=self._replication,
+                sweep_interval_s=self._sweep,
+            )
+            if self._middleware is not None:
+                self.app.middlewares.append(self._middleware)
+            self.runner = web.AppRunner(self.app)
+            await self.runner.setup()
+            site = web.SockSite(self.runner, self.sock)
+            await site.start()
+            self._ready.set()
+
+        self.loop.run_until_complete(boot())
+        self.loop.run_forever()
+
+    def kill(self):
+        """SIGKILL analogue: tear the listener down so connects refuse
+        immediately (not hang), then stop the loop."""
+        fut = asyncio.run_coroutine_threadsafe(
+            self.runner.cleanup(), self.loop
+        )
+        try:
+            fut.result(5)
+        except Exception:  # noqa: BLE001 — already down is fine
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+    def stop(self):
+        if self.loop and self.loop.is_running():
+            self.kill()
+
+
+class ShardCluster:
+    def __init__(self, n, replication=2, sweep_interval_s=0.0,
+                 middleware=None):
+        socks, urls = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            urls.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+        self.urls = urls
+        self.shards = [
+            _Shard(sock, url, urls, replication, sweep_interval_s,
+                   middleware=middleware)
+            for sock, url in zip(socks, urls)
+        ]
+
+    def start(self):
+        for s in self.shards:
+            s.start()
+        return self
+
+    def stop(self):
+        for s in self.shards:
+            s.stop()
+
+    def shard(self, url) -> _Shard:
+        return self.shards[self.urls.index(url)]
+
+    def store(self, url):
+        return self.shard(url).app["store"]
+
+
+@pytest.fixture()
+def cluster():
+    c = ShardCluster(3).start()
+    yield c
+    c.stop()
+
+
+def _pages(hashes):
+    return [
+        (h, np.full((2, 4), h % 97, dtype=np.float32),
+         np.full((2, 4), -(h % 89), dtype=np.float32))
+        for h in hashes
+    ]
+
+
+def _hash_first_owned_by(client, url, start=1):
+    """A block hash whose FIRST ring owner is ``url``."""
+    h = start
+    while client.owners(h)[0] != url:
+        h += 1
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Sharded client: placement, failover, read-repair, integrity
+# ---------------------------------------------------------------------------
+
+
+def test_factory_single_url_stays_plain_and_lists_shard():
+    assert create_remote_client(None) is None
+    assert create_remote_client("") is None
+    plain = create_remote_client("http://127.0.0.1:1/")
+    assert isinstance(plain, RemoteKVClient)
+    sharded = create_remote_client(
+        "http://127.0.0.1:1, http://127.0.0.1:2", replication=2
+    )
+    assert isinstance(sharded, ShardedKVClient)
+    assert sharded.replication == 2
+
+
+def test_put_blocks_fans_to_owner_set_only(cluster):
+    client = ShardedKVClient(cluster.urls, replication=2, timeout=3.0)
+    pages = _pages(range(1, 9))
+    assert client.put_blocks(pages)
+    for h, k, v in pages:
+        owners = set(client.owners(h))
+        for url in cluster.urls:
+            assert cluster.store(url).contains(h) == (url in owners)
+
+
+def test_get_fails_over_when_a_shard_dies_and_manifests_replicate(cluster):
+    client = ShardedKVClient(cluster.urls, replication=2, timeout=3.0)
+    pages = _pages(range(10, 40))
+    assert client.put_blocks(pages)
+    assert client.post_manifest("rid-x", [h for h, _, _ in pages],
+                               complete=True, total_blocks=len(pages))
+    # Manifests land on the request id's owner set.
+    rid_owners = set(client.owners("rid-x"))
+    for url in cluster.urls:
+        present = cluster.shard(url).app["manifests"].view("rid-x")
+        assert (present is not None) == (url in rid_owners)
+    # Kill one shard outright; every block must still read back and the
+    # manifest view must still resolve — zero client-visible errors.
+    victim = cluster.urls[0]
+    cluster.shard(victim).kill()
+    for h, k, v in pages:
+        got = client.get(h, timeout=3.0)
+        assert got is not None, f"block {h} lost with one dead shard"
+        np.testing.assert_array_equal(got[0], k)
+    view = client.get_manifest("rid-x", timeout=3.0)
+    assert view is not None and view["complete"]
+    # Batched reads survive too.
+    batch = client.get_blocks([h for h, _, _ in pages], timeout=5.0)
+    assert len(batch) == len(pages)
+
+
+def test_read_repair_repushes_to_owner_that_missed(cluster):
+    client = ShardedKVClient(cluster.urls, replication=2, timeout=3.0)
+    h = 4242
+    (page,) = _pages([h])
+    assert client.put_blocks([page])
+    first, second = client.owners(h)[:2]
+    # Simulate a replica that missed the write (it was down for it).
+    assert cluster.store(first).quarantine([h]) == 1
+    got = client.get(h, timeout=3.0)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], page[1])
+    assert client.counters["failovers"] >= 1
+    assert client.counters["read_repairs"] >= 1
+    # The missed owner holds the block again — healed on demand.
+    assert cluster.store(first).contains(h)
+    # Batched flavor: wipe it again, fetch via get_blocks.
+    assert cluster.store(first).quarantine([h]) == 1
+    repaired_before = client.counters["read_repairs"]
+    batch = client.get_blocks([h], timeout=3.0)
+    assert h in batch
+    assert cluster.store(first).contains(h)
+    assert client.counters["read_repairs"] > repaired_before
+
+
+def test_corrupt_replica_quarantined_and_read_fails_over(cluster):
+    client = ShardedKVClient(cluster.urls, replication=2, timeout=3.0)
+    victim = cluster.urls[1]
+    h = _hash_first_owned_by(client, victim, start=9000)
+    (page,) = _pages([h])
+    assert client.put_blocks([page])
+    # Arm one corrupt serve on the block's primary owner: the payload is
+    # damaged but the stored digest rides along — a rotted replica.
+    r = requests.post(f"{victim}/admin/fail",
+                      json={"mode": "corrupt", "count": 1}, timeout=3.0)
+    assert r.status_code == 200
+    got = client.get(h, timeout=3.0)
+    # The corrupt copy never surfaces: the read returns the healthy
+    # replica's page.
+    assert got is not None
+    np.testing.assert_array_equal(got[0], page[1])
+    client.refresh_counters()
+    assert client.counters["integrity_failures"] >= 1
+    # The rotten copy was quarantined off the primary — and read-repair
+    # then re-pushed the healthy replica's bytes, so what the primary
+    # serves NOW is the clean page again.
+    assert cluster.store(victim).quarantined >= 1
+    assert client.counters["read_repairs"] >= 1
+    direct = RemoteKVClient(victim, timeout=3.0)
+    healed = direct.get(h, timeout=3.0)
+    assert healed is not None
+    np.testing.assert_array_equal(healed[0], page[1])
+
+
+def test_fault_injection_slow_and_drop_manifest(cluster):
+    url = cluster.urls[0]
+    plain = RemoteKVClient(url, timeout=3.0)
+    # drop_manifest: acked but discarded — the consumer view stays 404.
+    requests.post(f"{url}/admin/fail",
+                  json={"mode": "drop_manifest", "count": 1}, timeout=3.0)
+    assert plain.post_manifest("ghost", [1, 2, 3])
+    assert plain.get_manifest("ghost") is None
+    # Healed: the next append lands.
+    assert plain.post_manifest("ghost", [1, 2, 3])
+    assert plain.get_manifest("ghost")["hashes"] == [1, 2, 3]
+    # slow: one injected delay, visible in wall time, then healed.
+    pages = _pages([31337])
+    assert plain.put_blocks(pages)
+    requests.post(f"{url}/admin/fail",
+                  json={"mode": "slow", "count": 1, "delay_s": 0.3},
+                  timeout=3.0)
+    t0 = time.monotonic()
+    assert plain.get(31337, timeout=3.0) is not None
+    assert time.monotonic() - t0 >= 0.25
+    stats = requests.get(f"{url}/stats", timeout=3.0).json()
+    assert stats["faults_injected"] >= 2
+    requests.post(f"{url}/admin/heal", timeout=3.0)
+    t0 = time.monotonic()
+    assert plain.get(31337, timeout=3.0) is not None
+    assert time.monotonic() - t0 < 0.25
+
+
+def test_breaker_opens_on_dead_shard_and_walk_skips_it(cluster):
+    client = ShardedKVClient(cluster.urls, replication=2, timeout=1.0)
+    pages = _pages(range(50, 70))
+    assert client.put_blocks(pages)
+    victim = cluster.urls[2]
+    cluster.shard(victim).kill()
+    # Hammer reads until the victim's breaker trips open.
+    for h, _, _ in pages:
+        client.get(h, timeout=1.0)
+    health = client.shard_health()
+    assert health[victim] == "open"
+    assert all(health[u] == "closed" for u in cluster.urls if u != victim)
+    # With the breaker open the walk skips the dead shard up front:
+    # reads stay fast and still succeed.
+    t0 = time.monotonic()
+    for h, k, _ in pages:
+        got = client.get(h, timeout=1.0)
+        assert got is not None
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Bounded GET retry (idempotent reads only)
+# ---------------------------------------------------------------------------
+
+
+def _flaky_gets_middleware(fail_first: int):
+    state = {"remaining": fail_first}
+
+    @web.middleware
+    async def mw(request, handler):
+        if request.method == "GET" and request.path.startswith("/blocks") \
+                and state["remaining"] > 0:
+            state["remaining"] -= 1
+            return web.Response(status=500)
+        return await handler(request)
+
+    return mw
+
+
+def test_get_retries_transient_5xx_once_then_succeeds():
+    c = ShardCluster(1, middleware=_flaky_gets_middleware(1)).start()
+    try:
+        client = RemoteKVClient(c.urls[0], timeout=3.0)
+        (page,) = _pages([777])
+        assert client.put_blocks([page])
+        got = client.get(777, timeout=3.0)
+        assert got is not None
+        np.testing.assert_array_equal(got[0], page[1])
+        assert client.counters["retries"] == 1
+    finally:
+        c.stop()
+
+
+def test_get_retry_stays_inside_per_call_deadline():
+    c = ShardCluster(1, middleware=_flaky_gets_middleware(10)).start()
+    try:
+        client = RemoteKVClient(c.urls[0], timeout=3.0)
+        t0 = time.monotonic()
+        page, status = client.get_ex(1, timeout=0.3)
+        assert page is None and status == "error"
+        # Two bounded attempts + jittered backoff, never the 10 failures
+        # the middleware would happily serve.
+        assert time.monotonic() - t0 < 1.0
+        assert client.counters["retries"] <= 2
+    finally:
+        c.stop()
+
+
+def test_puts_are_never_retried():
+    """Only idempotent GETs retry: a put that fails reports False once
+    (the spill/publish layers own their own durability semantics)."""
+    client = RemoteKVClient("http://127.0.0.1:9", timeout=0.3)
+    assert not client.put_blocks(_pages([1]))
+    assert not client.put(2, *_pages([2])[0][1:])
+    assert client.counters["retries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy sweep
+# ---------------------------------------------------------------------------
+
+
+def test_anti_entropy_sweep_backfills_wiped_shard():
+    c = ShardCluster(3, sweep_interval_s=0.15).start()
+    try:
+        client = ShardedKVClient(c.urls, replication=2, timeout=3.0)
+        pages = _pages(range(100, 130))
+        assert client.put_blocks(pages)
+        victim = c.urls[1]
+        owned = [
+            h for h, _, _ in pages if victim in client.owners(h)
+        ]
+        assert owned, "test needs the victim to own something"
+        # Wipe the shard (a restarted-empty replica).
+        assert c.store(victim).quarantine(owned) == len(owned)
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            if all(c.store(victim).contains(h) for h in owned):
+                break
+            time.sleep(0.05)
+        assert all(c.store(victim).contains(h) for h in owned), \
+            "anti-entropy sweep never backfilled the wiped shard"
+        pushes = sum(
+            s.app["anti_entropy_pushes"] for s in c.shards
+        )
+        assert pushes >= len(owned)
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Frame integrity primitives (28-byte header: hash + length + digest)
+# ---------------------------------------------------------------------------
+
+
+def test_stored_digest_travels_on_repair_frames():
+    """Re-shipped frames (read-repair, anti-entropy) carry the ORIGINAL
+    producer digest, not a fresh one over possibly-rotted bytes — a
+    corrupted source replica cannot launder damage into a valid frame."""
+    data = b"page-payload"
+    good = block_digest(data)
+    rotted = b"page-pAyload"
+    framed = pack_blocks([(1, rotted, good)])  # 3-tuple: digest verbatim
+    corrupt = []
+    assert unpack_blocks(framed, corrupt=corrupt) == []
+    assert corrupt == [1]
+    # And an honest re-ship verifies clean.
+    assert unpack_blocks(pack_blocks([(1, data, good)])) == [(1, data)]
