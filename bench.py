@@ -38,11 +38,20 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
 TTFT_TARGET_S = 0.200  # north-star p50 TTFT (BASELINE.md)
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# A run with NO budget is how r05 died: the driver's `timeout` landed
+# mid-bring-up with nothing flushed. Every run is budgeted now — an
+# explicit --time-budget wins, else these defaults (just under the
+# historical 3600 s driver wall; --tiny is the CPU smoke profile).
+DEFAULT_TIME_BUDGET_S = 3300.0
+TINY_TIME_BUDGET_S = 240.0
+WATCHDOG_LEAD_S = 30.0
 
 
 class BenchInterrupted(BaseException):
@@ -146,6 +155,9 @@ def run_engine_phase() -> dict:
     )
     env = child_env()
     env["PST_BENCH_ENGINE_OUT"] = partial_path
+    # The child persists flight snapshots here so a tail outlier stays
+    # explainable even when the child is SIGKILLed (post-mortem path).
+    env["PST_BENCH_FLIGHT_SNAPSHOT_DIR"] = engine_snapshot_dir()
     try:
         os.remove(partial_path)  # never serve a previous run's partial
     except OSError:
@@ -732,6 +744,25 @@ def run_tenant_phase() -> dict:
         import aiohttp
 
         base = f"http://127.0.0.1:{rport}"
+        engine_urls = [f"http://127.0.0.1:{p}" for p in eports]
+        collector = forensics_collector()
+        stall_injected = os.environ.get("PST_BENCH_INJECT_STALL") == "1"
+        if stall_injected:
+            # CI's induced r05 signature: a one-shot N-ms decode stall on
+            # the first engine — the victim leg's p99 blows past 3x its
+            # p50 and the collector below must harvest a bundle naming
+            # the stalled bucket + queue state.
+            stall_s = float(os.environ.get("PST_BENCH_STALL_S", "1.5"))
+            req = urllib.request.Request(
+                f"{engine_urls[0]}/admin/fail",
+                data=json.dumps({"mode": "stall", "delay": stall_s,
+                                 "count": 1}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+            log(f"tenants: armed one-shot {stall_s}s stall on engine 0")
+        metrics_baseline = collector.mark(engine_urls + [base])
 
         async def one(session, tenant, max_tokens=4):
             t0 = time.monotonic()
@@ -798,7 +829,28 @@ def run_tenant_phase() -> dict:
             (flood_p99 - base_p99) / base_p99
             if base_p99 and flood_p99 else None
         )
+        # Tail forensics while the stack is still alive: a leg whose p99
+        # blows past 3x its p50 (the injected stall, or a real isolation
+        # failure) harvests flight snapshots, worst traces, fleet state
+        # and metrics deltas into the run's evidence dir.
+        evidence = []
+        for leg, samples in (("baseline", res["baseline"]),
+                             ("flooded", res["flooded"])):
+            p50_s, p99_s = pct(samples, 0.5), pct(samples, 0.99)
+            bundle = collector.maybe_collect(
+                "tenants", leg,
+                p50_s * 1e3 if p50_s else None,
+                p99_s * 1e3 if p99_s else None,
+                engines=engine_urls, router=base,
+                baseline=metrics_baseline,
+                detail={"stall_injected": stall_injected},
+            )
+            if bundle:
+                evidence.append(bundle)
+                log(f"forensics: tenants/{leg} tail bar crossed "
+                    f"-> {bundle}")
         return {
+            "evidence_bundles": evidence,
             "victim_p50_ms": round(pct(res["baseline"], 0.5) * 1e3, 1),
             "victim_p99_ms": round(base_p99 * 1e3, 1),
             "flood_victim_p50_ms": round(pct(res["flooded"], 0.5) * 1e3, 1),
@@ -843,8 +895,10 @@ def run_disagg_phase() -> dict:
 
     model = "fake/model"
     env = dict(os.environ, PYTHONPATH=REPO)
-    n_requests = 150
-    offered_qps = 24.0
+    # Env-tunable so --tiny (and CI's bench-smoke) can shrink the load
+    # without forking the protocol.
+    n_requests = int(os.environ.get("PST_BENCH_DISAGG_REQUESTS", "150"))
+    offered_qps = float(os.environ.get("PST_BENCH_DISAGG_QPS", "24.0"))
     # Mixed workload: heavy prefills (the head-of-line blockers) and
     # light TTFT-sensitive requests, Poisson arrivals — the tail of the
     # light class is where fused interference shows.
@@ -963,6 +1017,24 @@ def run_disagg_phase() -> dict:
                 engine_fallbacks += int(st.get("kv_transfer_fallbacks", 0))
                 published += int(st.get("kv_published_blocks", 0))
                 prefetched += int(st.get("kv_prefetched_blocks", 0))
+            # Tail forensics while this leg's stack is still alive: an
+            # unexplained e2e tail here harvests live evidence (the
+            # engines are torn down in the finally below).
+            ttfts = sorted(r["ttft"] for r in results
+                           if r["ok"] and r["ttft"] is not None)
+            if ttfts:
+                q = lambda f: ttfts[min(int(len(ttfts) * f),  # noqa: E731
+                                        len(ttfts) - 1)]
+                bundle = forensics_collector().maybe_collect(
+                    "disagg", tag, q(0.5) * 1e3, q(0.99) * 1e3,
+                    engines=[f"http://127.0.0.1:{p}" for p in ports[:-1]],
+                    router=base,
+                    detail={"offered_qps": offered_qps,
+                            "n_requests": n_requests},
+                )
+                if bundle:
+                    log(f"forensics: disagg/{tag} tail bar crossed "
+                        f"-> {bundle}")
             return {"results": results, "wall": wall, "metrics": metrics,
                     "engine_fallbacks": engine_fallbacks,
                     "published": published, "prefetched": prefetched}
@@ -1137,22 +1209,94 @@ def probe_backend() -> str:
     return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "cpu"
 
 
+# The watchdog thread and the main thread both emit; without the lock a
+# T−30s force-emit could interleave with a phase emit and the "last
+# stdout line is parseable JSON" contract would be the casualty.
+_EMIT_LOCK = threading.Lock()
+
+
 def emit(out: dict) -> None:
     """Emit the (cumulative) result: one JSON line on stdout per phase —
     the LAST stdout line is always a complete, parseable JSON object, so
     a harness that kills this process mid-run still parses every phase
     that finished — plus an atomic copy at $PST_BENCH_OUT when set."""
-    print(json.dumps(out), flush=True)
-    path = os.environ.get("PST_BENCH_OUT")
-    if not path:
-        return
-    tmp = path + ".tmp"
-    try:
-        with open(tmp, "w") as f:
-            json.dump(out, f)
-        os.replace(tmp, path)
-    except OSError as e:
-        log(f"could not write {path}: {e}")
+    with _EMIT_LOCK:
+        print(json.dumps(out), flush=True)
+        path = os.environ.get("PST_BENCH_OUT")
+        if not path:
+            return
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(out, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log(f"could not write {path}: {e}")
+
+
+_FORENSICS = None
+
+
+def forensics_collector():
+    """Lazy singleton: every phase shares one collector so its bundle
+    list (and the evidence dir) is run-scoped, not phase-scoped."""
+    global _FORENSICS
+    if _FORENSICS is None:
+        from production_stack_tpu.obs.forensics import (
+            ForensicsCollector, evidence_dir_for,
+        )
+
+        _FORENSICS = ForensicsCollector(
+            evidence_dir_for(os.environ.get("PST_BENCH_OUT"))
+        )
+    return _FORENSICS
+
+
+def engine_snapshot_dir() -> str:
+    """Where the engine child persists flight snapshots (the post-mortem
+    forensics path): inside the run's evidence dir so bundles and their
+    raw snapshots travel together."""
+    return os.environ.get(
+        "PST_BENCH_FLIGHT_SNAPSHOT_DIR",
+        os.path.join(forensics_collector().evidence_dir, "engine_flight"),
+    )
+
+
+def collect_engine_tail_evidence(engine_res: dict) -> list:
+    """Post-mortem forensics over the engine phase's sweep points: the
+    child is gone by the time its JSON is parsed, so a tail-outlier point
+    (r05's 120 s p99 at qps 0.5) is matched against whatever snapshots
+    the engine persisted to --flight-snapshot-dir before dying."""
+    from production_stack_tpu.obs.forensics import crosses_tail_bar
+
+    collector = forensics_collector()
+    snap_dir = engine_snapshot_dir()
+    bundles = []
+    sweeps = [(engine_res.get("model") or "flagship",
+               engine_res.get("sweep") or [])]
+    for key in ("concurrency_8users", "llama_1b"):
+        sub = engine_res.get(key)
+        if isinstance(sub, dict):
+            sweeps.append((key, sub.get("sweep") or []))
+    for tag, sweep in sweeps:
+        for p in sweep:
+            if not isinstance(p, dict):
+                continue
+            trigger = crosses_tail_bar(
+                p.get("p50_ttft_ms"), p.get("p99_ttft_ms")
+            )
+            if trigger is None:
+                continue
+            path = collector.collect_postmortem(
+                f"engine_{tag}", f"qps{p.get('qps')}",
+                snapshot_dirs=[snap_dir],
+                detail={"trigger": trigger, **p},
+            )
+            if path:
+                bundles.append(path)
+                log(f"forensics: engine tail outlier ({tag} qps "
+                    f"{p.get('qps')}) -> {path}")
+    return bundles
 
 
 def assemble(engine_res: dict, stack, fleet, tenants=None, cost=None,
@@ -1206,6 +1350,52 @@ _PHASE_WEIGHTS = {"engine": 6.0, "stack": 1.5, "fleet": 1.5, "tenants": 1.0,
                   "disagg": 1.0, "cost": 0.5}
 
 
+def finalize(state: dict, extra: dict = None) -> dict:
+    """Assemble the cumulative result PLUS the verdicts block — the
+    shape every terminal emit (normal, watchdog, interrupted) shares, so
+    the driver's last-line parse always finds the same contract."""
+    out = assemble(state["engine"], state["stack"], state["fleet"],
+                   state["tenants"], state["cost"], state["disagg"])
+    if _FORENSICS is not None and _FORENSICS.bundles:
+        out["evidence_bundles"] = list(_FORENSICS.bundles)
+    if extra:
+        out.update(extra)
+    if state.get("watchdog_fired"):
+        out["watchdog_fired"] = True
+    try:
+        from benchmarks.verdicts import evaluate_round
+
+        out["verdicts"] = evaluate_round(out)
+    except Exception as e:  # noqa: BLE001 — verdicts must not kill the emit
+        out["verdicts"] = {"ok": False, "error": f"verdicts failed: {e}"}
+    return out
+
+
+def start_watchdog(budget: TimeBudget, state: dict,
+                   lead: float = WATCHDOG_LEAD_S) -> threading.Event:
+    """Arm the T−lead force-emit (the r05 hole: rc 124 with nothing on
+    stdout). If the run is still going ``lead`` seconds before the
+    budget's wall, the watchdog emits the partial result under the emit
+    lock and SIGTERMs the main thread so it unwinds through the phase
+    cleanups to the final emit. Returns the stop event the happy path
+    sets before its own terminal emit."""
+    stop = threading.Event()
+
+    def _fire() -> None:
+        delay = max(budget.remaining() - lead, 0.5)
+        if stop.wait(delay):
+            return
+        state["watchdog_fired"] = True
+        log(f"watchdog: T-{lead:.0f}s before the wall — force-emitting "
+            "the partial result and interrupting the run")
+        emit(finalize(state, {"partial": True}))
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    threading.Thread(target=_fire, daemon=True,
+                     name="bench-watchdog").start()
+    return stop
+
+
 def main() -> None:
     # --all is accepted for driver ergonomics and is the default anyway:
     # every phase (engine, stack, fleet, tenants, cost) runs unless its
@@ -1218,10 +1408,32 @@ def main() -> None:
     )
     if require_warm:
         os.environ["PST_BENCH_REQUIRE_WARM"] = "1"
-    budget = TimeBudget(parse_time_budget(sys.argv[1:]))
+    # --tiny (or PST_BENCH_TINY=1): the CPU smoke profile CI's
+    # bench-smoke job runs — small pair counts, light disagg load, a
+    # 240 s budget. Only missing knobs are defaulted, so a caller can
+    # still pin any of them.
+    tiny = "--tiny" in sys.argv[1:] or os.environ.get("PST_BENCH_TINY") == "1"
+    if tiny:
+        os.environ["PST_BENCH_TINY"] = "1"
+        os.environ.setdefault("PST_BENCH_CPU", "1")
+        os.environ.setdefault("PST_BENCH_PAIRS", "40")
+        os.environ.setdefault("PST_BENCH_PAIRS_R2", "24")
+        os.environ.setdefault("PST_BENCH_DISAGG_REQUESTS", "40")
+        os.environ.setdefault("PST_BENCH_DISAGG_QPS", "12.0")
+    total = parse_time_budget(sys.argv[1:])
+    if total <= 0:
+        # Never run unbudgeted: r05's rc:124 was an unbudgeted run hitting
+        # the driver's external wall mid-bring-up with nothing flushed.
+        total = TINY_TIME_BUDGET_S if tiny else DEFAULT_TIME_BUDGET_S
+        log(f"no --time-budget given; defaulting to {total:.0f}s "
+            f"({'tiny' if tiny else 'full'} profile)")
+    budget = TimeBudget(total)
     install_term_trap()
     interrupted = False
     weights_left = sum(_PHASE_WEIGHTS.values())
+    state = {"engine": {"backend": "unknown"}, "stack": None, "fleet": None,
+             "tenants": None, "cost": None, "disagg": None}
+    watchdog_stop = start_watchdog(budget, state)
 
     engine_res = {"backend": "unknown"}
     try:
@@ -1249,7 +1461,14 @@ def main() -> None:
     weights_left -= _PHASE_WEIGHTS["engine"]
     backend = engine_res.get("backend", "unknown")
     on_tpu = backend == "tpu"
+    state["engine"] = engine_res
     emit(assemble(engine_res, None, None))
+    try:
+        # Post-mortem forensics: tail-outlier sweep points matched to the
+        # flight snapshots the (now dead) engine child persisted.
+        collect_engine_tail_evidence(engine_res)
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        log(f"forensics: engine tail scan failed: {e}")
 
     def run_phase(key, fn):
         """One budget-walled stack-side phase: skipped outright when the
@@ -1283,28 +1502,34 @@ def main() -> None:
     stack = None
     if os.environ.get("PST_BENCH_SKIP_STACK") != "1":
         stack = run_phase("stack", lambda: run_stack_phase(on_tpu))
+        state["stack"] = stack
         emit(assemble(engine_res, stack, None))
 
     fleet = None
     if os.environ.get("PST_BENCH_SKIP_FLEET") != "1":
         fleet = run_phase("fleet", run_fleet_phase)
+        state["fleet"] = fleet
         emit(assemble(engine_res, stack, fleet))
 
     tenants = None
     if os.environ.get("PST_BENCH_SKIP_TENANTS") != "1":
         tenants = run_phase("tenants", run_tenant_phase)
+        state["tenants"] = tenants
         emit(assemble(engine_res, stack, fleet, tenants))
 
     disagg = None
     if os.environ.get("PST_BENCH_SKIP_DISAGG") != "1":
         disagg = run_phase("disagg", run_disagg_phase)
+        state["disagg"] = disagg
         emit(assemble(engine_res, stack, fleet, tenants, disagg=disagg))
 
     cost = None
     if os.environ.get("PST_BENCH_SKIP_COST") != "1":
         cost = run_phase("cost", run_cost_phase)
+        state["cost"] = cost
 
-    emit(assemble(engine_res, stack, fleet, tenants, cost, disagg))
+    watchdog_stop.set()
+    emit(finalize(state, {"interrupted": True} if interrupted else None))
     # Same fallback as assemble(): a truncated engine phase may carry only
     # per-phase pollution flags, never the run-level verdict — the exit
     # gate must not be laxer than the emitted JSON.

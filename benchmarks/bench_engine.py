@@ -87,11 +87,32 @@ def time_budget() -> float:
     return float(os.environ.get("PST_BENCH_ENGINE_BUDGET", "0") or 0)
 
 
-def budget_exhausted(floor: float = 30.0) -> bool:
+def budget_remaining() -> float:
+    """Seconds left in the budget; +inf when unbudgeted."""
     total = time_budget()
     if total <= 0:
-        return False
-    return total - (time.monotonic() - _BUDGET_T0) < floor
+        return float("inf")
+    return total - (time.monotonic() - _BUDGET_T0)
+
+
+def budget_exhausted(floor: float = 30.0) -> bool:
+    return budget_remaining() < floor
+
+
+# Observed phase walls, so later phases are gated on what THIS run's
+# hardware actually costs instead of a static floor. The r05 wreck was
+# exactly this hole: the second engine bring-up started near the
+# driver's wall because nothing asked whether it could still fit.
+_PHASE_WALLS: dict = {}
+
+
+def phase_estimate(key: str, default: float = 0.0) -> float:
+    """Weighted estimate for a phase about to start: 0.6 x the heaviest
+    observed model-phase wall (bring-up + warmup dominate and repeat;
+    sweeps shrink), floored at ``default``. Before any phase has run
+    there is nothing observed and the static floor is all we have."""
+    observed = max(_PHASE_WALLS.values(), default=0.0)
+    return max(0.6 * observed, default)
 
 
 def roofline_table(
@@ -244,6 +265,11 @@ def run_model_phase(
         adaptive_decode_quiet_s=1.0,
         adaptive_decode_min_running=n_users,
         min_decode_bucket=min(8, n_users),
+        # Forensics: tail-outlier flight snapshots persist to disk so the
+        # evidence survives this process (bench.py collects post-mortem).
+        flight_snapshot_dir=(
+            os.environ.get("PST_BENCH_FLIGHT_SNAPSHOT_DIR") or None
+        ),
     )
     t0 = time.time()
     engine = LLMEngine(cfg)
@@ -267,8 +293,24 @@ def run_model_phase(
 
     points = []
     all_ttfts: list = []
+    sweep_truncated = False
+    round_walls: list = []  # observed seconds per protocol round
     t_meas = time.time()
     for qps, n_rounds in sweep:
+        # Point-level budget gate: estimate this point's wall from the
+        # rounds already measured (first point: the static floor only)
+        # and refuse to start a point that cannot finish — a truncated
+        # sweep with N clean points beats a killed run with none.
+        if round_walls:
+            est = 1.2 * n_rounds * (sum(round_walls) / len(round_walls))
+        else:
+            est = 0.0
+        if budget_remaining() < max(est, 30.0):
+            log(f"{model}: stopping sweep before qps {qps}: "
+                f"~{est:.0f}s point vs {budget_remaining():.0f}s left")
+            sweep_truncated = True
+            break
+        t_point = time.time()
         # Per-point tunnel drift: the RPC floor bounds TTFT from below and
         # drifts hour to hour; recording it beside each point lets a reader
         # separate engine regressions from environment drift.
@@ -299,6 +341,7 @@ def run_model_phase(
             "tail_outlier": p99 > 3.0 * p50,
         })
         all_ttfts.extend(ttfts)
+        round_walls.append((time.time() - t_point) / max(n_rounds, 1))
         log(f"{model}: qps {qps}: {points[-1]}")
         if checkpoint is not None:
             checkpoint({
@@ -313,9 +356,14 @@ def run_model_phase(
     # Per-phase isolation: ENGINE_TELEMETRY is process-global and earlier
     # phases may have landed samples in the same batch buckets.
     ENGINE_TELEMETRY.reset_host_gap()
-    decode_rate = pr.decode_probe(
-        max_tokens=decode_probe_tokens, pipelined=pipelined_probe
-    )
+    if budget_exhausted():
+        log(f"{model}: skipping decode probe "
+            f"({budget_remaining():.0f}s budget left)")
+        decode_rate = None
+    else:
+        decode_rate = pr.decode_probe(
+            max_tokens=decode_probe_tokens, pipelined=pipelined_probe
+        )
     # Roofline verdict for the saturated probe: theoretical vs achieved
     # HBM GB/s and tok/s/chip at the probe's batch/context shape. The
     # host-gap summary beside it is the direct measure of the serial host
@@ -336,9 +384,15 @@ def run_model_phase(
         log(f"{model}: host gap per decode dispatch: {host_gap}")
     floor_end = env_probe()
     n_params = engine.runner.param_count
-    raw_p50 = float(np.percentile(all_ttfts, 50)) * 1e3
-    raw_p99 = float(np.percentile(all_ttfts, 99)) * 1e3
-    med_floor = float(np.median([p["rpc_floor_ms"] for p in points]))
+    # A fully budget-truncated sweep has no measured points; the phase
+    # still returns (bring-up numbers + the truncation marker) instead
+    # of crashing on empty percentiles.
+    if all_ttfts:
+        raw_p50 = float(np.percentile(all_ttfts, 50)) * 1e3
+        raw_p99 = float(np.percentile(all_ttfts, 99)) * 1e3
+        med_floor = float(np.median([p["rpc_floor_ms"] for p in points]))
+    else:
+        raw_p50 = raw_p99 = med_floor = 0.0
     out = {
         "model": engine.model_cfg.name,
         "quantization": quantization,
@@ -354,6 +408,7 @@ def run_model_phase(
         "rpc_floor_ms_median": round(med_floor, 1),
         "rpc_floor_ms_end": round(floor_end, 1),
         "sweep": points,
+        "sweep_truncated_for_budget": sweep_truncated,
         "warmup_compiles": warmup_compiles,
         "sweep_compiles": int(sum(p["compiles"] for p in points)),
         # True when ANY measured point absorbed a cold compile — the
@@ -456,15 +511,25 @@ def main() -> None:
     # partial (its checkpoints already persisted every finished point).
     running_phase = [None]
 
-    def skip_for_budget(key: str) -> bool:
-        if budget_exhausted():
-            log(f"{key} phase skipped: time budget exhausted")
+    def skip_for_budget(key: str, est_floor: float = 30.0) -> bool:
+        # Gate on the phase's WEIGHTED ESTIMATE, not just a static floor:
+        # once one model phase has run, its observed wall prices the next
+        # bring-up — the r05 second bring-up (148.7 s, started with less
+        # than that left) would never begin under this gate.
+        est = phase_estimate(key, est_floor)
+        if budget_remaining() < est:
+            log(f"{key} phase skipped: ~{est:.0f}s estimate vs "
+                f"{max(budget_remaining(), 0):.0f}s budget left")
             result[key] = {"partial": True,
-                           "skipped": "time budget exhausted"}
+                           "skipped": "time budget exhausted",
+                           "estimate_s": round(est, 1)}
             write_partial(result)
             return True
         running_phase[0] = key
         return False
+
+    def record_wall(key: str, t0: float) -> None:
+        _PHASE_WALLS[key] = time.monotonic() - t0
 
     def phase_checkpoint(key):
         # Per-qps-point checkpointing: the phase's partial dict replaces
@@ -486,6 +551,7 @@ def main() -> None:
             # round re-prefills evicted history: measured 10 s TTFTs).
             # int4's bigger pool gives MORE eviction headroom than r4's
             # int8 run (1232 vs 844 pages for the same 4-user set).
+            t_phase = time.monotonic()
             result["flagship"] = run_model_phase(
                 "llama-3-8b",
                 quantization="int4",
@@ -514,6 +580,7 @@ def main() -> None:
                 require_warm=require_warm,
                 checkpoint=phase_checkpoint("flagship"),
             )
+            record_wall("flagship", t_phase)
             write_partial(result)
         if os.environ.get("PST_BENCH_SKIP_8B_CONC") != "1" and not skip_for_budget("concurrency_8users"):
             # Concurrency phase: EIGHT 20k-history users on the same chip
@@ -523,6 +590,7 @@ def main() -> None:
             # fleet serves MORE sessions than HBM holds, degrading
             # smoothly instead of thrashing. One warm round for liveness,
             # then the pipelined saturated decode probe.
+            t_phase = time.monotonic()
             conc = run_model_phase(
                 "llama-3-8b",
                 quantization="int4",
@@ -549,9 +617,11 @@ def main() -> None:
                 "- the TTFT story is the flagship sweep; this phase's "
                 "headline is decode_tok_per_s_chip"
             )
+            record_wall("concurrency_8users", t_phase)
             result["concurrency_8users"] = conc
             write_partial(result)
         if os.environ.get("PST_BENCH_SKIP_1B") != "1" and not skip_for_budget("llama_1b"):
+            t_phase = time.monotonic()
             result["llama_1b"] = run_model_phase(
                 "llama-1b",
                 n_users=8,
@@ -567,12 +637,15 @@ def main() -> None:
                 require_warm=require_warm,
                 checkpoint=phase_checkpoint("llama_1b"),
             )
+            record_wall("llama_1b", t_phase)
             write_partial(result)
       else:
         # CPU smoke: tiny model, tiny protocol — keeps the bench runnable
-        # (and CI-checkable) anywhere.
-        running_phase[0] = "flagship"
-        result["flagship"] = run_model_phase(
+        # (and CI-checkable) anywhere. Budget-gated like the TPU phases:
+        # the r05 re-entry bug was a loop iteration starting unbudgeted.
+        if not skip_for_budget("flagship"):
+          t_phase = time.monotonic()
+          result["flagship"] = run_model_phase(
             "tiny-llama-debug",
             n_users=4,
             sys_len=64,
@@ -591,7 +664,8 @@ def main() -> None:
             kv_cache_dtype=None,
             require_warm=require_warm,
             checkpoint=phase_checkpoint("flagship"),
-        )
+          )
+          record_wall("flagship", t_phase)
 
       # Warm-restart phase (docs/engine.md "Warmup & precompilation"):
       # the same engine built twice against one persistent compile cache;

@@ -396,6 +396,15 @@ def fleet_dashboard():
                   'clamp_min(sum(rate('
                   'pst_engine_device_busy_seconds_total[5m])), 1e-9), 2)',
                   0, 128, unit="percentunit"))
+    # The evidence plane (docs/observability.md "Forensics bundles"): a
+    # non-zero bundle rate means measured points are crossing their tail
+    # bars — every count here has a JSON bundle on disk explaining it.
+    p.append(panel("Forensics: evidence bundles + persisted snapshots", [
+        ('sum(increase(pst_forensics_bundles_total[1h])) by (trigger)',
+         "{{trigger}} bundles/h"),
+        ('sum(increase(pst_engine_flight_snapshots_persisted_total[1h]))',
+         "snapshots persisted/h"),
+    ], 4, 128))
 
     # Row 16 — Disagg (docs/disagg.md): the streamed P/D handoff's
     # health. Overlap p50 vs transfer p50 shows how much of the prefill

@@ -422,6 +422,8 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
                     "servingEngineSpec.warmup.cacheDir"),
     EngineFieldSpec("flight_buffer", "--flight-buffer",
                     "servingEngineSpec.observability.flightBuffer"),
+    EngineFieldSpec("flight_snapshot_dir", "--flight-snapshot-dir",
+                    "servingEngineSpec.observability.flightSnapshotDir"),
     EngineFieldSpec("cost_attribution", "--cost-attribution",
                     "servingEngineSpec.observability.costAttribution",
                     emit="--no-cost-attribution"),

@@ -202,6 +202,14 @@ class EngineConfig:
     # and SIGTERM/fatal. The value is the ring capacity in steps; 0
     # disables recording (the endpoint then serves an empty ring).
     flight_buffer: int = 512
+    # Flight-snapshot persistence (docs/observability.md "Flight
+    # recorder"): every retained snapshot (tail outlier, live compile,
+    # SIGTERM/fatal) is also written as one JSON file under this
+    # directory, bounded with oldest-first eviction, and loaded back into
+    # GET /debug/flight?snapshots=1 after a restart — so a forensics
+    # collector can harvest the post-mortem even when the engine died
+    # before anyone scraped it. None = in-memory retention only.
+    flight_snapshot_dir: Optional[str] = None
     # Per-request cost attribution (docs/observability.md "Cost
     # attribution"): accumulate each request's prefill device-seconds,
     # active-row share of decode-burst device-seconds, KV page-seconds

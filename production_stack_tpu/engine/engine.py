@@ -211,7 +211,9 @@ class LLMEngine:
         # state rides each record via the probe closure. Attached last-
         # wins: a fresh engine in one process must own the sink.
         self.flight = (
-            FlightRecorder(cfg.flight_buffer)
+            FlightRecorder(
+                cfg.flight_buffer, snapshot_dir=cfg.flight_snapshot_dir
+            )
             if cfg.flight_buffer > 0 else NULL_FLIGHT_RECORDER
         )
         if self.flight.enabled:

@@ -1518,7 +1518,12 @@ def create_engine_app(
             )
         except (TypeError, ValueError):
             return _error("n and window_s must be numbers")
-        return web.json_response(flight.to_payload(n=n, window_s=window_s))
+        # ?snapshots=1: include snapshots a PREVIOUS process persisted to
+        # --flight-snapshot-dir — the post-mortem collection path.
+        include_restored = request.query.get("snapshots") in ("1", "true")
+        return web.json_response(flight.to_payload(
+            n=n, window_s=window_s, include_restored=include_restored,
+        ))
 
     async def is_sleeping(request: web.Request) -> web.Response:
         return web.json_response({"is_sleeping": engine.sleeping})
@@ -1798,6 +1803,12 @@ def parse_engine_args(argv=None) -> argparse.Namespace:
                    help="per-step flight-recorder ring capacity (GET "
                         "/debug/flight; auto-snapshots on tail outliers "
                         "and SIGTERM/fatal; 0 disables recording)")
+    p.add_argument("--flight-snapshot-dir", default=None,
+                   help="persist retained flight snapshots as JSON files "
+                        "under this directory (bounded, oldest-first "
+                        "eviction) and load them back into GET "
+                        "/debug/flight?snapshots=1 after a restart — "
+                        "tail-outlier post-mortems survive process death")
     p.add_argument("--cost-attribution", dest="cost_attribution",
                    action="store_true", default=True)
     p.add_argument("--no-cost-attribution", dest="cost_attribution",
@@ -1861,6 +1872,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         warmup_bucket_budget=args.warmup_bucket_budget,
         compile_cache_dir=args.compile_cache_dir,
         flight_buffer=args.flight_buffer,
+        flight_snapshot_dir=args.flight_snapshot_dir,
         cost_attribution=args.cost_attribution,
     )
 

@@ -32,6 +32,8 @@ Served by ``GET /debug/flight`` (last-N or time-window) on the engine.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from collections import deque
@@ -64,6 +66,36 @@ def _row_dict(row: tuple) -> dict:
     return dict(zip(_FIELDS, row))
 
 
+def load_snapshot_dir(path: str, limit: Optional[int] = None) -> List[dict]:
+    """Read persisted snapshots back from a ``--flight-snapshot-dir``,
+    oldest first. Filenames encode a monotone (time_ns, seq) pair so a
+    lexical sort is chronological. Unparseable files are skipped — a
+    snapshot half-written at SIGKILL must not poison the post-mortem.
+
+    Shared by the recorder's restart load-back and the forensics
+    collector's post-mortem path (obs/forensics.py)."""
+    snaps: List[dict] = []
+    try:
+        names = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("flight_") and f.endswith(".json")
+        )
+    except OSError:
+        return snaps
+    if limit is not None and limit > 0:
+        names = names[-limit:]
+    for name in names:
+        try:
+            with open(os.path.join(path, name)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(snap, dict):
+            snap.setdefault("persisted_as", name)
+            snaps.append(snap)
+    return snaps
+
+
 class FlightRecorder:
     """Bounded, thread-safe per-step ring + outlier auto-snapshots.
 
@@ -89,6 +121,8 @@ class FlightRecorder:
         outlier_factor: float = 3.0,
         snapshot_keep: int = 8,
         snapshot_tail: int = 64,
+        snapshot_dir: Optional[str] = None,
+        snapshot_disk_keep: int = 32,
     ):
         self.capacity = max(int(capacity), 0)
         self.outlier_factor = float(outlier_factor)
@@ -98,6 +132,23 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._snapshots: "deque[dict]" = deque(maxlen=max(snapshot_keep, 1))
         self._snapshot_tail = max(int(snapshot_tail), 1)
+        # Snapshot persistence (--flight-snapshot-dir): every retained
+        # snapshot is also written as one JSON file, bounded to
+        # ``snapshot_disk_keep`` with oldest-first eviction, and loaded
+        # back after a restart — the post-mortem survives the process.
+        self.snapshot_dir = snapshot_dir or None
+        self._snapshot_disk_keep = max(int(snapshot_disk_keep), 1)
+        self._persist_seq = 0
+        self._restored: List[dict] = []
+        if self.snapshot_dir:
+            try:
+                os.makedirs(self.snapshot_dir, exist_ok=True)
+            except OSError:
+                self.snapshot_dir = None
+            else:
+                self._restored = load_snapshot_dir(
+                    self.snapshot_dir, limit=self._snapshot_disk_keep
+                )
         # (bucket -> recent device_s samples) for the rolling median.
         self._samples: Dict[Tuple[str, str], "deque[float]"] = {}
         # Engine-supplied closure: () -> dict(waiting, running, swapped,
@@ -230,7 +281,47 @@ class FlightRecorder:
                 "records": [_row_dict(r) for r in rows],
             }
             self._snapshots.append(snap)
+        self._persist(snap)
         return snap
+
+    def _persist(self, snap: dict) -> None:
+        """Write one snapshot file (atomic rename) and evict beyond the
+        disk bound, oldest first. Disk I/O stays off the ring lock; any
+        failure downgrades to in-memory-only retention."""
+        d = self.snapshot_dir
+        if not d:
+            return
+        with self._lock:
+            self._persist_seq += 1
+            seq = self._persist_seq
+        name = f"flight_{time.time_ns():020d}_{seq:06d}_{snap['reason']}.json"
+        try:
+            tmp = os.path.join(d, name + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, os.path.join(d, name))
+            stale = sorted(
+                f for f in os.listdir(d)
+                if f.startswith("flight_") and f.endswith(".json")
+            )[: -self._snapshot_disk_keep]
+            for old in stale:
+                try:
+                    os.remove(os.path.join(d, old))
+                except OSError:
+                    pass
+        except OSError:
+            return
+        try:
+            from .metrics import note_flight_snapshot_persisted
+
+            note_flight_snapshot_persisted()
+        except Exception:  # noqa: BLE001 — metrics must not kill snapshots
+            pass
+
+    def restored_snapshots(self) -> List[dict]:
+        """Snapshots a previous process persisted to the snapshot dir,
+        loaded at construction (``GET /debug/flight?snapshots=1``)."""
+        return list(self._restored)
 
     def snapshots(self) -> List[dict]:
         with self._lock:
@@ -246,15 +337,25 @@ class FlightRecorder:
             }
 
     def to_payload(
-        self, n: Optional[int] = None, window_s: Optional[float] = None
+        self,
+        n: Optional[int] = None,
+        window_s: Optional[float] = None,
+        include_restored: bool = False,
     ) -> dict:
-        """The ``GET /debug/flight`` response body."""
-        return {
+        """The ``GET /debug/flight`` response body. ``include_restored``
+        (the ``?snapshots=1`` query) adds snapshots persisted by a
+        previous process to this snapshot dir — the post-mortem a
+        forensics collector reads after a restart."""
+        payload = {
             **self.stats(),
             "fields": list(_FIELDS),
             "records": self.records(n=n, window_s=window_s),
             "snapshot_log": self.snapshots(),
         }
+        if include_restored:
+            payload["restored_snapshots"] = self.restored_snapshots()
+            payload["snapshot_dir"] = self.snapshot_dir
+        return payload
 
     def reset_for_tests(self) -> None:
         with self._lock:

@@ -54,6 +54,12 @@ REGISTRY: Tuple[MetricSpec, ...] = (
     # re-pushed to owners that missed them (read-repair).
     MetricSpec("pst_kv_integrity_failures", COUNTER, "obs/metrics.py"),
     MetricSpec("pst_kv_read_repairs", COUNTER, "obs/metrics.py"),
+    # Evidence plane (docs/observability.md "Forensics bundles" /
+    # "Flight recorder"): bundles harvested when a measured point crosses
+    # its tail bar, and flight snapshots persisted to disk so they
+    # survive process death.
+    MetricSpec("pst_forensics_bundles", COUNTER, "obs/metrics.py"),
+    MetricSpec("pst_engine_flight_snapshots_persisted", COUNTER, "obs/metrics.py"),
     # --- obs/logging.py: structured-logging hot-path sampler ------------
     MetricSpec("pst_log_dropped", COUNTER, "obs/logging.py"),
     # --- obs/engine_telemetry.py: TPU engine device layer ---------------

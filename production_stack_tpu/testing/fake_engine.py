@@ -198,6 +198,18 @@ class FakeEngineState:
         self.flight_records: List[dict] = []
         self.flight_capacity = 128
         self.flight_total = 0
+        # Retained flight snapshots (the real recorder's snapshot_log
+        # contract): the `stall` fault appends a deterministic
+        # tail_outlier snapshot naming the stalled step's bucket and
+        # queue depths, so forensics tests induce the BENCH_r05
+        # signature on CPU. With a flight_snapshot_dir set, each
+        # snapshot is also persisted (same file naming as
+        # obs/flight.py) so post-mortem collection works after SIGKILL.
+        self.flight_snapshots: List[dict] = []
+        self.flight_snapshot_keep = 8
+        self.flight_snapshot_dir: Optional[str] = None
+        self.restored_snapshots: List[dict] = []
+        self._snapshot_seq = 0
         # Simulated warmup precompilation (the real engine's /ready
         # contract): the engine reports warming for ``ready_delay``
         # seconds after start. With a ``warmup_cache_dir``, a marker file
@@ -348,6 +360,69 @@ class FakeEngineState:
         if len(self.flight_records) > self.flight_capacity:
             del self.flight_records[: len(self.flight_records)
                                     - self.flight_capacity]
+
+    def record_stall(self, stall_s: float, n_tokens: int) -> None:
+        """One stalled decode step: an extra ring record whose device_s
+        is the injected stall, plus a retained tail_outlier snapshot
+        naming the stalled bucket and queue state — the same evidence
+        the real recorder leaves for an unexplained p99 (obs/flight.py
+        auto-snapshot contract)."""
+        bucket = f"b{max(self.num_running, 1)}xn{max(n_tokens, 1)}"
+        baseline_s = max(n_tokens, 1) * 1e-3  # the unstalled decode cost
+        row = {
+            "ts": time.time(),
+            "kind": "decode",
+            "bucket": bucket,
+            "device_s": round(stall_s, 6),
+            "host_gap_s": 0.0005,
+            "compiled": False,
+            "waiting": self.num_waiting,
+            "running": self.num_running,
+            "swapped": 0,
+            "kv_occupancy": round(self.kv_occupancy, 4),
+            "preemptions": 0,
+            "batch_tier_rows": 0,
+            "tokens": n_tokens,
+        }
+        self.flight_records.append(row)
+        self.flight_total += 1
+        if len(self.flight_records) > self.flight_capacity:
+            del self.flight_records[: len(self.flight_records)
+                                    - self.flight_capacity]
+        snap = {
+            "reason": "tail_outlier",
+            "ts": time.time(),
+            "detail": {
+                "kind": "decode",
+                "bucket": bucket,
+                "device_s": round(stall_s, 6),
+                "bar_s": round(baseline_s * 3.0, 6),
+                "waiting": self.num_waiting,
+                "running": self.num_running,
+                "swapped": 0,
+                "kv_occupancy": round(self.kv_occupancy, 4),
+                "injected": "stall",
+            },
+            "total_steps": self.flight_total,
+            "records": list(self.flight_records[-16:]),
+        }
+        self.flight_snapshots.append(snap)
+        if len(self.flight_snapshots) > self.flight_snapshot_keep:
+            del self.flight_snapshots[: len(self.flight_snapshots)
+                                      - self.flight_snapshot_keep]
+        d = self.flight_snapshot_dir
+        if d:
+            try:
+                os.makedirs(d, exist_ok=True)
+                self._snapshot_seq += 1
+                name = (f"flight_{time.time_ns():020d}_"
+                        f"{self._snapshot_seq:06d}_{snap['reason']}.json")
+                tmp = os.path.join(d, name + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, os.path.join(d, name))
+            except OSError:
+                pass
 
     def take_fault(self, tenant: Optional[str] = None) -> Optional[str]:
         """Consume one fault budget entry; returns the armed mode or None.
@@ -876,6 +951,14 @@ def create_fake_engine_app(
         cost = state.fake_cost(prompt_tokens, n_tokens)
         cost_header = {"X-PST-Cost": json.dumps(cost, separators=(",", ":"))}
         state.record_flight(prompt_tokens, n_tokens)
+        if fault == "stall":
+            # One-shot N-ms stall on this generation's decode step (the
+            # BENCH_r05 signature, inducible on CPU): the request serves
+            # normally but pays fail_delay seconds first, and the flight
+            # ring retains a deterministic tail_outlier snapshot naming
+            # the stalled bucket and queue depths.
+            await asyncio.sleep(max(state.fail_delay, 0.0))
+            state.record_stall(state.fail_delay, n_tokens)
         created = int(time.time())
         logger.info(
             "generation: model=%s stream=%s tokens=%s",
@@ -1299,7 +1382,12 @@ def create_fake_engine_app(
                 "kv_occupancy", "preemptions", "batch_tier_rows", "tokens",
             ],
             "records": records,
-            "snapshot_log": [],
+            "snapshot_log": list(state.flight_snapshots),
+            **(
+                {"restored_snapshots": list(state.restored_snapshots),
+                 "snapshot_dir": state.flight_snapshot_dir}
+                if request.query.get("snapshots") in ("1", "true") else {}
+            ),
         })
 
     async def health(request: web.Request) -> web.Response:
@@ -1373,14 +1461,19 @@ def create_fake_engine_app(
         ``[DONE]``) — deterministic chunk boundaries for stream
         resumption tests. ``tenant`` scopes the fault to requests whose
         ``X-PST-Tenant`` equals it (isolation chaos legs fault one
-        tenant's traffic while the victim's flows untouched)."""
+        tenant's traffic while the victim's flows untouched). ``stall``
+        one-shots a ``delay``-second pause on the next decode step and
+        records a deterministic flight snapshot naming the stalled
+        bucket + queue state — the BENCH_r05 tail signature on CPU
+        (``count`` defaults to 1 for stall: one outlier, not a slow
+        engine)."""
         body = await request.json() if request.can_read_body else {}
         mode = body.get("mode", "error")
-        if mode not in ("error", "hang", "midstream", "slow", "transfer"):
+        if mode not in ("error", "hang", "midstream", "slow", "transfer", "stall"):
             return web.json_response({"error": f"unknown mode {mode!r}"}, status=400)
         state.fail_mode = mode
         state.fail_status = int(body.get("status", 500))
-        state.fail_count = int(body.get("count", -1))
+        state.fail_count = int(body.get("count", 1 if mode == "stall" else -1))
         state.fail_delay = float(body.get("delay", 0.5))
         state.fail_jitter = float(body.get("jitter", 0.0))
         state.fail_after_chunks = int(body.get("fail_after_chunks", 3))
@@ -1570,6 +1663,14 @@ def main(argv: Optional[list] = None) -> None:
                    help="simulated KV capacity: occupancy and prefix-hit "
                         "eviction derive from it (small values make "
                         "cache-pressure effects visible in tests)")
+    p.add_argument("--flight-snapshot-dir", default=None,
+                   help="persist flight snapshots (stall outliers) as "
+                        "JSON files here, same naming contract as the "
+                        "real engine's --flight-snapshot-dir — the "
+                        "post-mortem forensics path: bundles survive "
+                        "SIGKILL; any snapshots already in the dir are "
+                        "loaded back and served via "
+                        "/debug/flight?snapshots=1")
     p.add_argument("--log-format", choices=["text", "json"], default="text",
                    help="'json' emits structured log lines enriched with "
                         "the propagated trace/request/tenant ids (same "
@@ -1587,6 +1688,13 @@ def main(argv: Optional[list] = None) -> None:
         kv_replication=args.kv_replication,
     )
     app["state"].chip_ms_per_ktok = max(args.chip_ms_per_ktok, 0.0)
+    if args.flight_snapshot_dir:
+        from ..obs.flight import load_snapshot_dir
+
+        app["state"].flight_snapshot_dir = args.flight_snapshot_dir
+        app["state"].restored_snapshots = load_snapshot_dir(
+            args.flight_snapshot_dir
+        )
     web.run_app(app, host=args.host, port=args.port, access_log=None)
 
 

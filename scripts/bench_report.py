@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Render a bench round's verdicts as a markdown report.
+
+``python scripts/bench_report.py BENCH_r06.json [-o report.md]`` —
+the human-facing face of ``benchmarks/verdicts.py``: per-claim status
+table, the evidence bundles the round's forensics collector wrote, and
+the round-over-round trajectory, so a reviewer reads one page instead
+of diffing raw JSON against five prior rounds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.verdicts import (  # noqa: E402
+    evaluate_round, load_round, round_files, trajectory,
+)
+
+_STATUS_ICON = {"pass": "✅ pass", "fail": "❌ FAIL",
+                "unevaluable": "⚪ unevaluable"}
+
+
+def _fmt(val) -> str:
+    if isinstance(val, float):
+        return f"{val:g}"
+    if isinstance(val, (dict, list)):
+        return "`" + json.dumps(val, sort_keys=True) + "`"
+    return str(val)
+
+
+def render(verdicts: dict, evidence_dir: str = None) -> str:
+    lines = ["# Bench round verdicts", ""]
+    head = "**OK**" if verdicts.get("ok") else "**FAILING**"
+    lines.append(
+        f"{head} — {verdicts.get('n_pass', 0)} pass / "
+        f"{verdicts.get('n_fail', 0)} fail / "
+        f"{verdicts.get('n_unevaluable', 0)} unevaluable"
+    )
+    if verdicts.get("recovered_from"):
+        lines.append("")
+        lines.append(
+            f"> Result recovered from the driver tail "
+            f"(`{verdicts['recovered_from']}`; rc={verdicts.get('rc')}) — "
+            "the round never emitted its final JSON."
+        )
+    if verdicts.get("error"):
+        lines.append("")
+        lines.append(f"> {verdicts['error']}")
+    lines += ["", "| claim | target | status | observed |",
+              "|---|---|---|---|"]
+    for c in verdicts.get("claims", []):
+        observed = _fmt(c.get("observed", "—"))
+        note = c.get("note")
+        status = _STATUS_ICON.get(c["status"], c["status"])
+        if note:
+            status += f" ({note})"
+        lines.append(
+            f"| {c['claim']} | {c['target']} | {status} | {observed} |"
+        )
+
+    bundles = []
+    if evidence_dir and os.path.isdir(evidence_dir):
+        bundles = sorted(
+            f for f in os.listdir(evidence_dir) if f.endswith(".json")
+        )
+    if bundles:
+        lines += ["", "## Evidence bundles", ""]
+        for name in bundles:
+            path = os.path.join(evidence_dir, name)
+            trigger = phase = point = "?"
+            try:
+                with open(path) as f:
+                    b = json.load(f)
+                trigger, phase, point = (b.get("trigger"), b.get("phase"),
+                                         b.get("point"))
+            except (OSError, ValueError):
+                pass
+            lines.append(
+                f"- `{name}` — trigger `{trigger}`, phase `{phase}`, "
+                f"point `{point}`"
+            )
+
+    traj = verdicts.get("trajectory")
+    if traj:
+        lines += ["", "## Trajectory", "",
+                  "| round | p50 TTFT (ms) | p99 TTFT (ms) | "
+                  "restart→ready (s) | health |",
+                  "|---|---|---|---|---|"]
+        for row in traj:
+            if row.get("parsed"):
+                health = "parsed"
+                if row.get("recovered_from"):
+                    health = f"recovered ({row['recovered_from']})"
+            else:
+                health = f"UNPARSEABLE (rc={row.get('rc')})"
+            lines.append(
+                f"| {row['round']} | {_fmt(row.get('p50_ttft_ms', '—'))} "
+                f"| {_fmt(row.get('p99_ttft_ms', '—'))} "
+                f"| {_fmt(row.get('restart_to_ready_s', '—'))} "
+                f"| {health} |"
+            )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("round", help="bench result JSON or BENCH_rNN capture")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the markdown here (default: stdout)")
+    ap.add_argument("--rounds-dir", default=None,
+                    help="BENCH_rNN.json directory for the trajectory "
+                         "section (default: the round file's directory)")
+    ap.add_argument("--evidence-dir", default=None,
+                    help="forensics bundle directory (default: "
+                         "<round>.evidence when it exists)")
+    args = ap.parse_args(argv)
+
+    parsed, meta = load_round(args.round)
+    verdicts = parsed.get("verdicts") if isinstance(parsed, dict) else None
+    if not isinstance(verdicts, dict) or "claims" not in verdicts:
+        verdicts = evaluate_round(parsed, meta)
+    root = args.rounds_dir or os.path.dirname(
+        os.path.abspath(args.round)) or "."
+    try:
+        verdicts.setdefault("trajectory", trajectory(round_files(root)))
+    except OSError:
+        pass
+    evidence = args.evidence_dir or (
+        args.round + ".evidence" if os.path.isdir(args.round + ".evidence")
+        else None
+    )
+    text = render(verdicts, evidence)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0 if verdicts.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
