@@ -682,6 +682,288 @@ def run_fleet_phase() -> dict:
     }
 
 
+def run_autoscale_phase() -> dict:
+    """Closed-loop autoscale under surge (docs/autoscaling.md): the REAL
+    router (k8s discovery against the in-process fake API server) + the
+    REAL pst-operator actuator + fake engines, with offered load DOUBLED
+    mid-run. Measures how long the loop takes to absorb the surge, the
+    client p99 while absorbing, that the new replica comes up with ZERO
+    fresh compiles (warm-start path), and the wake→first-token bound of a
+    scaled-to-zero pool. Kill-surviving like every stack phase: the
+    subprocess fleet dies in the finally, partial numbers ride the emit."""
+    from production_stack_tpu.testing.fake_k8s import PST, FakeK8s
+
+    operator_dir = os.path.join(REPO, "operator")
+    operator_bin = os.path.join(operator_dir, "build", "pst-operator")
+    build = subprocess.run(["make"], cwd=operator_dir,
+                           capture_output=True, text=True)
+    if build.returncode != 0 or not os.path.exists(operator_bin):
+        return {"error": f"operator build failed: {build.stderr[-400:]}"}
+
+    model = "fake/model"
+    slo_ms = float(os.environ.get("PST_BENCH_AUTOSCALE_SLO_MS", "1500"))
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def operator_tick(api: str) -> None:
+        proc = subprocess.run(
+            [operator_bin, "--api-server", api, "--namespace", "default",
+             "--once"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(f"operator tick failed: {proc.stderr[-300:]}")
+
+    def get_json(url: str) -> dict:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    def compile_total(eng_url: str) -> float:
+        with urllib.request.urlopen(f"{eng_url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        return sum(float(line.rsplit(" ", 1)[1])
+                   for line in text.splitlines()
+                   if line.startswith("pst_engine_compile_total"))
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(int(round(q * (len(vals) - 1))), len(vals) - 1)]
+
+    def seed_runtime(k8s, autoscale):
+        k8s.seed(PST, "tpuruntimes", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "TPURuntime",
+            "metadata": {"name": "base", "namespace": "default"},
+            "spec": {"model": model, "replicas": 1, "engineConfig": {},
+                     "kvCache": {}, "autoscale": autoscale},
+        })
+
+    def start_engine(k8s, procs, engines, idx, eport, ip_base):
+        ip = f"127.0.0.{ip_base + idx}"
+        name = f"base-engine-{idx}"
+        lg = f"/tmp/pst_autoscale_engine_{ip_base + idx}.log"
+        p = subprocess.Popen(
+            [sys.executable, "-m",
+             "production_stack_tpu.testing.fake_engine",
+             "--host", ip, "--port", str(eport), "--model", model,
+             "--speed", "2000", "--name", name],
+            stdout=open(lg, "w"), stderr=subprocess.STDOUT,
+            cwd=REPO, env=env)
+        procs.append(p)
+        url = f"http://{ip}:{eport}"
+        if not wait_http(f"{url}/health", 60, proc=p, log_path=lg):
+            raise RuntimeError(f"autoscale fake engine {name} not healthy")
+        engines[name] = url
+        k8s.seed_engine_pod(name, eport, ip=ip)
+        return name
+
+    def start_router(k8s, procs, eport, rport, tag):
+        lg = f"/tmp/pst_autoscale_router_{tag}.log"
+        p = subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.router.app",
+             "--host", "127.0.0.1", "--port", str(rport),
+             "--service-discovery", "k8s",
+             "--k8s-label-selector", "model=base",
+             "--k8s-port", str(eport),
+             "--routing-logic", "roundrobin",
+             "--engine-stats-interval", "1",
+             "--slo-ttft-ms", "40", "--admission-rate", "400",
+             "--proxy-retries", "0", "--breaker-failure-threshold", "100"],
+            stdout=open(lg, "w"), stderr=subprocess.STDOUT, cwd=REPO,
+            env=dict(env, PST_K8S_API_SERVER=k8s.url))
+        procs.append(p)
+        if not wait_http(f"http://127.0.0.1:{rport}/health", 60,
+                         proc=p, log_path=lg):
+            raise RuntimeError("autoscale router not healthy")
+        k8s.seed_router_replica("pst-router", rport)
+        return f"http://127.0.0.1:{rport}"
+
+    def wait_signal(router_url, pred, timeout_s, what):
+        deadline = time.time() + timeout_s
+        sig = None
+        while time.time() < deadline:
+            sig = get_json(f"{router_url}/autoscale/signal")
+            if pred(sig):
+                return sig
+            time.sleep(0.3)
+        raise RuntimeError(f"autoscale signal never converged ({what}): {sig}")
+
+    # ---- surge leg: offered load doubles against a saturating pool ------
+    eport, rport = 18400, 18409
+    for p in (eport, rport):
+        ensure_port_free(p)
+    k8s = FakeK8s().start()
+    procs = []
+    engines = {}
+    records = []  # (t_done, latency_ms, served_by, ok)
+    rec_lock = threading.Lock()
+    stop_load = threading.Event()
+    workers = []
+    out = {"slo_ms": slo_ms}
+    try:
+        start_engine(k8s, procs, engines, 0, eport, ip_base=2)
+        router_url = start_router(k8s, procs, eport, rport, "surge")
+        seed_runtime(k8s, {"minReplicas": 1, "maxReplicas": 3,
+                           "scaleDownStabilizationS": 3600,
+                           "idleVerdicts": 3})
+        wait_signal(router_url, lambda s: s["engines_ready"] == 1, 30,
+                    "initial discovery")
+
+        def worker(idx):
+            i = 0
+            while not stop_load.is_set():
+                t0 = time.time()
+                try:
+                    req = urllib.request.Request(
+                        f"{router_url}/v1/completions",
+                        data=json.dumps({
+                            "model": model, "prompt": f"load-{idx}-{i}",
+                            "max_tokens": 2}).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST")
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        by = resp.headers.get("X-Served-By")
+                        resp.read()
+                    ok = True
+                except Exception:  # noqa: BLE001 — shed/failure is a datum
+                    by, ok = None, False
+                with rec_lock:
+                    records.append(
+                        (time.time(), (time.time() - t0) * 1e3, by, ok))
+                i += 1
+                time.sleep(0.05)
+
+        def add_workers(n):
+            for _ in range(n):
+                t = threading.Thread(target=worker, args=(len(workers),),
+                                     daemon=True)
+                workers.append(t)
+                t.start()
+
+        add_workers(2)          # baseline offered load
+        time.sleep(3.0)
+        # Surge: the lone engine saturates (120ms >> the 40ms objective)
+        # AND the offered load doubles.
+        req = urllib.request.Request(
+            f"{engines['base-engine-0']}/admin/fail",
+            data=json.dumps({"mode": "slow", "delay": 0.12,
+                             "count": -1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        surge_start = time.time()
+        add_workers(2)
+        sig = wait_signal(router_url, lambda s: s["replica_hint"] >= 2, 45,
+                          "surge hint")
+        out["surge_hint"] = sig["replica_hint"]
+        operator_tick(k8s.url)
+        st = k8s.bucket(PST, "tpuruntimes")["base"].get("status", {})
+        if st.get("lastAutoscaleAction") != "scale_up":
+            raise RuntimeError(f"operator never scaled up: {st}")
+        want = int(st["desiredReplicas"])
+        new_names = [
+            start_engine(k8s, procs, engines, i, eport, ip_base=2)
+            for i in range(1, want)
+        ]
+        compile_before = {n: compile_total(engines[n]) for n in new_names}
+        # Absorbed: a new replica serves live traffic.
+        absorb_deadline = time.time() + 60
+        absorb_end = None
+        while absorb_end is None and time.time() < absorb_deadline:
+            with rec_lock:
+                tail = records[-20:]
+            if any(by in new_names for _, _, by, _ in tail):
+                absorb_end = time.time()
+            else:
+                time.sleep(0.2)
+        if absorb_end is None:
+            raise RuntimeError("new replica never took traffic")
+        time.sleep(2.0)         # post-absorb sample window
+        stop_load.set()
+        for t in workers:
+            t.join(timeout=30)
+        cold = sum(compile_total(engines[n]) - compile_before[n]
+                   for n in new_names)
+        with rec_lock:
+            absorb_window = [r for r in records if r[0] >= surge_start]
+        p99 = pct([ms for _, ms, _, ok in absorb_window if ok], 0.99)
+        failed = sum(1 for *_, ok in absorb_window if not ok)
+        out.update({
+            "absorb_seconds": round(absorb_end - surge_start, 2),
+            "p99_during_absorb_ms": round(p99, 1) if p99 else None,
+            "cold_compiles_on_new_replicas": cold,
+            "replicas_after": want,
+            "requests_during_absorb": len(absorb_window),
+            "failed_during_absorb": failed,
+        })
+    finally:
+        stop_load.set()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        k8s.stop()
+
+    # ---- wake leg: a fresh pool parks slept, first arrival wakes it -----
+    # Fresh fleet on purpose: the surge leg's burn windows keep its hint
+    # high for minutes, which is exactly the anti-flap conservatism the
+    # actuator encodes — waiting them out would blow the phase wall.
+    eport2, rport2 = 18410, 18419
+    for p in (eport2, rport2):
+        ensure_port_free(p)
+    k8s = FakeK8s().start()
+    procs = []
+    engines = {}
+    try:
+        start_engine(k8s, procs, engines, 0, eport2, ip_base=21)
+        router_url = start_router(k8s, procs, eport2, rport2, "wake")
+        seed_runtime(k8s, {"minReplicas": 1, "maxReplicas": 2,
+                           "scaleDownStabilizationS": 0, "idleVerdicts": 1,
+                           "scaleToZero": True})
+        wait_signal(router_url, lambda s: s["engines_ready"] == 1
+                    and s["in_flight_total"] == 0, 30, "wake-leg discovery")
+        operator_tick(k8s.url)
+        st = k8s.bucket(PST, "tpuruntimes")["base"].get("status", {})
+        if st.get("lastAutoscaleAction") != "sleep":
+            raise RuntimeError(f"pool never parked slept: {st}")
+        t0 = time.time()
+        req = urllib.request.Request(
+            f"{router_url}/v1/completions",
+            data=json.dumps({"model": model, "prompt": "wake",
+                             "max_tokens": 4, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read(16)       # first streamed token bytes
+            wake_s = time.time() - t0
+            resp.read()
+        out["wake_to_first_token_s"] = round(wake_s, 3)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        k8s.stop()
+
+    out["meets_target"] = bool(
+        out.get("absorb_seconds") is not None
+        and out.get("p99_during_absorb_ms") is not None
+        and out["p99_during_absorb_ms"] <= slo_ms
+        and out.get("cold_compiles_on_new_replicas") == 0
+        and out.get("failed_during_absorb") == 0
+        and out.get("wake_to_first_token_s") is not None
+        and out["wake_to_first_token_s"] < 10.0
+    )
+    return out
+
+
 def run_tenant_phase() -> dict:
     """Tenant flood isolation (docs/multi-tenancy.md): the real router
     with --tenant-isolation over two fake engines; a victim tenant paces
@@ -1300,7 +1582,7 @@ def collect_engine_tail_evidence(engine_res: dict) -> list:
 
 
 def assemble(engine_res: dict, stack, fleet, tenants=None, cost=None,
-             disagg=None) -> dict:
+             disagg=None, autoscale=None) -> dict:
     flag = engine_res.get("flagship", {})
     p50 = flag.get("p50_ttft_ms")
     return {
@@ -1329,6 +1611,7 @@ def assemble(engine_res: dict, stack, fleet, tenants=None, cost=None,
         "tenants": tenants,
         "cost": cost,
         "disagg": disagg,
+        "autoscale": autoscale,
     }
 
 
@@ -1347,7 +1630,7 @@ def parse_time_budget(argv) -> float:
 # the XLA warmup; the stack-side phases are fake-engine-cheap and the
 # cost audit runs the tiny model).
 _PHASE_WEIGHTS = {"engine": 6.0, "stack": 1.5, "fleet": 1.5, "tenants": 1.0,
-                  "disagg": 1.0, "cost": 0.5}
+                  "disagg": 1.0, "autoscale": 1.0, "cost": 0.5}
 
 
 def finalize(state: dict, extra: dict = None) -> dict:
@@ -1355,7 +1638,8 @@ def finalize(state: dict, extra: dict = None) -> dict:
     shape every terminal emit (normal, watchdog, interrupted) shares, so
     the driver's last-line parse always finds the same contract."""
     out = assemble(state["engine"], state["stack"], state["fleet"],
-                   state["tenants"], state["cost"], state["disagg"])
+                   state["tenants"], state["cost"], state["disagg"],
+                   state.get("autoscale"))
     if _FORENSICS is not None and _FORENSICS.bundles:
         out["evidence_bundles"] = list(_FORENSICS.bundles)
     if extra:
@@ -1432,7 +1716,7 @@ def main() -> None:
     interrupted = False
     weights_left = sum(_PHASE_WEIGHTS.values())
     state = {"engine": {"backend": "unknown"}, "stack": None, "fleet": None,
-             "tenants": None, "cost": None, "disagg": None}
+             "tenants": None, "cost": None, "disagg": None, "autoscale": None}
     watchdog_stop = start_watchdog(budget, state)
 
     engine_res = {"backend": "unknown"}
@@ -1522,6 +1806,13 @@ def main() -> None:
         disagg = run_phase("disagg", run_disagg_phase)
         state["disagg"] = disagg
         emit(assemble(engine_res, stack, fleet, tenants, disagg=disagg))
+
+    autoscale = None
+    if os.environ.get("PST_BENCH_SKIP_AUTOSCALE") != "1":
+        autoscale = run_phase("autoscale", run_autoscale_phase)
+        state["autoscale"] = autoscale
+        emit(assemble(engine_res, stack, fleet, tenants, disagg=disagg,
+                      autoscale=autoscale))
 
     cost = None
     if os.environ.get("PST_BENCH_SKIP_COST") != "1":

@@ -263,6 +263,30 @@ def claim_kvserver_kill(parsed: dict) -> dict:
                             "fallbacks": kill.get("fallbacks")})
 
 
+def claim_autoscale(parsed: dict) -> dict:
+    """The closed-loop surge claim (docs/autoscaling.md): doubled offered
+    load is absorbed — p99 inside the phase's SLO, the scaled-up replicas
+    come up with ZERO fresh compiles (warm-start path), nothing was shed,
+    and a scaled-to-zero pool's wake→first-token bound was measured."""
+    name = "autoscale_surge_absorb"
+    target = ("surge absorbed: p99 <= slo_ms, 0 cold compiles on new "
+              "replicas, 0 sheds, wake_to_first_token_s measured")
+    a = parsed.get("autoscale")
+    if not isinstance(a, dict) or a.get("absorb_seconds") is None:
+        return _unevaluable(name, target, "autoscale phase absent/failed")
+    ok = bool(a.get("meets_target"))
+    return _claim(
+        name, target, "pass" if ok else "fail",
+        observed={
+            "absorb_seconds": a.get("absorb_seconds"),
+            "p99_during_absorb_ms": a.get("p99_during_absorb_ms"),
+            "cold_compiles_on_new_replicas":
+                a.get("cold_compiles_on_new_replicas"),
+            "failed_during_absorb": a.get("failed_during_absorb"),
+            "wake_to_first_token_s": a.get("wake_to_first_token_s"),
+        })
+
+
 def _iter_sweeps(parsed: dict):
     """Every (model_tag, sweep point) in the round — flagship fields are
     inlined at top level, the other models nest under their keys, and a
@@ -316,6 +340,7 @@ CLAIMS: List[Callable[[dict], dict]] = [
     claim_disagg,
     claim_cost,
     claim_kvserver_kill,
+    claim_autoscale,
     claim_tail_shape,
 ]
 

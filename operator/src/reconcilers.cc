@@ -1,6 +1,7 @@
 #include "reconcilers.hpp"
 
 #include <algorithm>
+#include <climits>
 #include <cstdio>
 #include <ctime>
 
@@ -257,7 +258,14 @@ Json build_engine_deployment(const Json& cr, const std::string& ns) {
   selector["matchLabels"] = match;
 
   Json dspec = Json::object();
-  dspec["replicas"] = spec.at("replicas").as_int(1);
+  long replicas = spec.at("replicas").as_int(1);
+  if (spec.has("autoscale")) {
+    // The actuator owns the replica count: a spec change (hash mismatch →
+    // full replace) must carry the last ACTUATED scale forward, not reset
+    // the fleet to spec.replicas mid-surge.
+    replicas = cr.at({"status", "desiredReplicas"}).as_int(replicas);
+  }
+  dspec["replicas"] = replicas;
   dspec["selector"] = selector;
   dspec["template"] = tmpl;
 
@@ -313,6 +321,391 @@ Json build_engine_pvc(const Json& cr, const std::string& ns) {
   return pvc;
 }
 
+// ---------------------------------------------------------------------------
+// Autoscale actuator (docs/autoscaling.md "Reconcile semantics")
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct EnginePod {
+  std::string name;
+  std::string base;  // http://ip:port
+};
+
+std::vector<EnginePod> ready_engine_pods(const K8sClient& k8s,
+                                         const std::string& base_model) {
+  std::vector<EnginePod> pods;
+  Json list = k8s.list(kCoreV1, "pods", "model%3D" + base_model);
+  for (const auto& pod : list.at("items").items()) {
+    const std::string ip = pod.at({"status", "podIP"}).as_string();
+    const std::string phase = pod.at({"status", "phase"}).as_string();
+    if (ip.empty() || phase != "Running") continue;
+    // Engine port from the pod's declared containerPort (default 8000).
+    long port = 8000;
+    const auto& containers = pod.at({"spec", "containers"}).items();
+    if (!containers.empty()) {
+      const auto& ports = containers[0].at("ports").items();
+      if (!ports.empty()) port = ports[0].at("containerPort").as_int(8000);
+    }
+    pods.push_back({pod.at({"metadata", "name"}).as_string(),
+                    "http://" + ip + ":" + std::to_string(port)});
+  }
+  std::sort(pods.begin(), pods.end(),
+            [](const EnginePod& a, const EnginePod& b) { return a.name < b.name; });
+  return pods;
+}
+
+// Consumer contract with the router's GET /autoscale/signal
+// (production_stack_tpu/router/services/capacity.py compute_signal).
+// tests/test_flight_cost.py regex-extracts this list and asserts every
+// field exists in the Python producer's output, so a producer rename
+// breaks the build's tests, not a running fleet. A signal response
+// missing any of these is version skew and is discarded — the operator
+// never actuates on partial evidence.
+constexpr const char* kSignalFields[] = {
+    "ts",
+    "replica_hint",
+    "queue_depth",
+    "in_flight_total",
+    "engines_ready",
+    "page_burning",
+    "saturation",
+    "evidence_replicas",
+};
+
+bool signal_valid(const Json& sig) {
+  for (const char* field : kSignalFields)
+    if (!sig.has(field)) return false;
+  return true;
+}
+
+// One router replica's worth of evidence, max-merged across replicas.
+// Each replica's signal is already gossip-merged over the fleet (burn =
+// max, queue = sum across router peers), so replicas converge on the SAME
+// values within one sync interval — max here is anti-skew defense for the
+// convergence window, not an aggregation step; summing would double-count.
+struct SignalView {
+  long hint = -1;  // -1 = no reachable router produced a valid signal
+  long queue_depth = 0;
+  long in_flight = 0;
+  long routers = 0;  // replicas that answered with a valid signal
+};
+
+struct RouterReplica {
+  std::string pod;
+  std::string base;  // http://ip:port
+};
+
+std::vector<RouterReplica> router_replicas(const K8sClient& k8s) {
+  // Router pods carry only {app: <name>-router}; the component label lives
+  // on the Deployment/Service metadata. Walk component=router Services to
+  // their selector, then to Running pods.
+  std::vector<RouterReplica> out;
+  Json svcs = k8s.list(kCoreV1, "services",
+                       "app.kubernetes.io%2Fcomponent%3Drouter");
+  for (const auto& svc : svcs.at("items").items()) {
+    const std::string app = svc.at({"spec", "selector", "app"}).as_string();
+    if (app.empty()) continue;
+    long port = 8000;
+    const auto& ports = svc.at({"spec", "ports"}).items();
+    if (!ports.empty()) port = ports[0].at("targetPort").as_int(8000);
+    Json pods = k8s.list(kCoreV1, "pods", "app%3D" + app);
+    for (const auto& pod : pods.at("items").items()) {
+      const std::string ip = pod.at({"status", "podIP"}).as_string();
+      if (ip.empty()) continue;
+      if (pod.at({"status", "phase"}).as_string() != "Running") continue;
+      out.push_back({pod.at({"metadata", "name"}).as_string(),
+                     "http://" + ip + ":" + std::to_string(port)});
+    }
+  }
+  std::sort(out.begin(), out.end(),
+            [](const RouterReplica& a, const RouterReplica& b) {
+              return a.pod < b.pod;
+            });
+  return out;
+}
+
+SignalView poll_signal(const std::vector<RouterReplica>& routers) {
+  SignalView v;
+  for (const auto& r : routers) {
+    try {
+      auto resp = http_request("GET", r.base + "/autoscale/signal", "", "", 5);
+      if (!resp.ok()) continue;
+      Json sig = Json::parse(resp.body);
+      if (!signal_valid(sig)) continue;
+      v.routers++;
+      v.hint = std::max(v.hint, sig.at("replica_hint").as_int(0));
+      v.queue_depth = std::max(v.queue_depth, sig.at("queue_depth").as_int(0));
+      v.in_flight = std::max(v.in_flight, sig.at("in_flight_total").as_int(0));
+    } catch (...) {
+      // Unreachable/unparseable replica: its evidence simply doesn't count.
+    }
+  }
+  return v;
+}
+
+// Crash-looping / never-ready engine pods are FENCED: they count against
+// the Deployment's desired replicas (they hold a slot) but are excluded
+// from victim selection and freeze scale-up — otherwise one bad image
+// turns "ready < hint" into maxReplicas copies of the same crash loop.
+std::vector<std::string> fenced_engine_pods(const K8sClient& k8s,
+                                            const std::string& base_model) {
+  std::vector<std::string> fenced;
+  Json list = k8s.list(kCoreV1, "pods", "model%3D" + base_model);
+  for (const auto& pod : list.at("items").items()) {
+    bool bad = false;
+    for (const auto& cs : pod.at({"status", "containerStatuses"}).items()) {
+      const std::string reason =
+          cs.at({"state", "waiting", "reason"}).as_string();
+      if (reason == "CrashLoopBackOff" || reason == "ImagePullBackOff" ||
+          reason == "ErrImagePull" || cs.at("restartCount").as_int(0) >= 3)
+        bad = true;
+    }
+    if (bad) fenced.push_back(pod.at({"metadata", "name"}).as_string());
+  }
+  std::sort(fenced.begin(), fenced.end());
+  return fenced;
+}
+
+// Victim = the engine the router fleet scores lowest (least routed
+// in-flight per /debug/fleet). Falls back to the last pod by name when no
+// router can answer — deterministic either way.
+const EnginePod* pick_victim(const std::vector<RouterReplica>& routers,
+                             const std::vector<EnginePod>& ready) {
+  if (ready.empty()) return nullptr;
+  for (const auto& r : routers) {
+    try {
+      auto resp = http_request("GET", r.base + "/debug/fleet", "", "", 5);
+      if (!resp.ok()) continue;
+      Json fleet = Json::parse(resp.body);
+      const Json& engines = fleet.at("engines");
+      long best = LONG_MAX;
+      const EnginePod* victim = nullptr;
+      for (const auto& pod : ready) {
+        const long in_flight =
+            engines.at(pod.base).at("in_flight_total").as_int(0);
+        // <= so name-order ties break toward the LAST pod: matches the
+        // no-router fallback, so flapping router reachability cannot flap
+        // the victim choice between passes.
+        if (in_flight <= best) {
+          best = in_flight;
+          victim = &pod;
+        }
+      }
+      if (victim != nullptr) return victim;
+    } catch (...) {
+    }
+  }
+  return &ready.back();
+}
+
+void set_deployment_replicas(const K8sClient& k8s, const std::string& name,
+                             long replicas) {
+  auto dep = k8s.get(kAppsV1, "deployments", name);
+  if (!dep) return;
+  Json updated = *dep;
+  updated["spec"]["replicas"] = replicas;
+  k8s.replace(kAppsV1, "deployments", name, updated);
+}
+
+// POST an engine-admin action (drain/sleep/wake_up) THROUGH a router so
+// service discovery marks the endpoint unroutable/routable in the same
+// breath (request_service.py route_drain_request / route_sleep_wakeup) —
+// falling back to the engine directly when no router is reachable (the
+// probes reconcile discovery on the next cycle).
+bool engine_admin_post(const std::vector<RouterReplica>& routers,
+                       const std::string& engine_base,
+                       const std::string& action, const std::string& params,
+                       int timeout_s) {
+  for (const auto& r : routers) {
+    try {
+      auto resp = http_request(
+          "POST", r.base + "/" + action + "?url=" + engine_base + params, "",
+          "", timeout_s);
+      if (resp.ok()) return true;
+    } catch (...) {
+    }
+  }
+  try {
+    return http_request("POST", engine_base + "/" + action +
+                        (params.empty() ? "" : "?" + params.substr(1)),
+                        "", "", timeout_s)
+        .ok();
+  } catch (...) {
+    return false;
+  }
+}
+
+// The full actuator for one autoscale-enabled TPURuntime. Returns status
+// fields (desiredReplicas, idleStreak, lastScaleEpoch, fencedPods,
+// sleeping, lastAutoscaleAction, replicaHint, routersPolled) — hysteresis
+// state RIDES THE CR STATUS so `--once` passes (tests/CI) and operator
+// restarts resume mid-cooldown instead of forgetting it.
+Json autoscale_tpu_runtime(const K8sClient& k8s, const Json& cr) {
+  const Json& as = cr.at({"spec", "autoscale"});
+  const std::string cr_name = cr.at({"metadata", "name"}).as_string();
+  const std::string dep_name = cr_name + "-engine";
+
+  const long min_r = std::max(as.at("minReplicas").as_int(1), 0L);
+  const long max_r = std::max(as.at("maxReplicas").as_int(8), min_r);
+  const long stabilization_s = as.at("scaleDownStabilizationS").as_int(300);
+  const long drain_deadline_s = as.at("drainDeadlineS").as_int(120);
+  const long idle_verdicts = std::max(as.at("idleVerdicts").as_int(3), 1L);
+  const bool scale_to_zero = as.at("scaleToZero").as_bool(false);
+  // Scale-to-zero keeps ONE engine — slept, compile cache warm on disk —
+  // so the floor never reaches an empty Deployment even when minReplicas=0.
+  const long floor_r = std::max(min_r, 1L);
+
+  const Json& st = cr.at("status");
+  long idle_streak = st.at("idleStreak").as_int(0);
+  long last_scale = st.at("lastScaleEpoch").as_int(0);
+  bool sleeping = st.at("sleeping").as_bool(false);
+
+  long current = floor_r;
+  if (auto dep = k8s.get(kAppsV1, "deployments", dep_name))
+    current = std::max(dep->at({"spec", "replicas"}).as_int(floor_r), 1L);
+
+  const auto routers = router_replicas(k8s);
+  const SignalView sig = poll_signal(routers);
+  const auto fenced = fenced_engine_pods(k8s, cr_name);
+
+  Json status = Json::object();
+  Json fenced_json = Json::array();
+  for (const auto& name : fenced) fenced_json.push_back(Json(name));
+  status["fencedPods"] = fenced_json;
+  status["routersPolled"] = sig.routers;
+  status["replicaHint"] = sig.hint;
+
+  if (sig.routers == 0) {
+    // Zero evidence — hold position. An unreachable router fleet must
+    // never read as "idle fleet": actuating blind is how autoscalers
+    // delete the replicas that were busy serving.
+    status["desiredReplicas"] = current;
+    status["idleStreak"] = idle_streak;
+    status["lastScaleEpoch"] = last_scale;
+    status["sleeping"] = sleeping;
+    status["lastAutoscaleAction"] = "hold_no_signal";
+    return status;
+  }
+
+  long desired = std::min(std::max(sig.hint, floor_r), max_r);
+  const long now = time(nullptr);
+  std::string action = "none";
+
+  // Idle verdict: nothing queued and the hint does not ask for more than we
+  // run. Genuine surplus (hint < current) counts even with streams still in
+  // flight — the blocking drain is what protects them; an exact-fit hint
+  // (hint == current) counts only when the fleet is fully quiet, so the
+  // streak can arm scale-to-zero at the floor but a momentary load dip
+  // never pre-arms a scale-down. N consecutive verdicts arm the shrink
+  // paths; any pressure resets the streak (anti-flap hysteresis).
+  const bool idle =
+      sig.queue_depth == 0 && sig.hint <= current &&
+      (sig.hint < current || sig.in_flight == 0);
+  idle_streak = idle ? idle_streak + 1 : 0;
+
+  if (desired > current) {
+    if (!fenced.empty()) {
+      // Failure-aware: fenced pods already hold replica slots; piling more
+      // replicas onto a crash loop is fuel, not capacity.
+      action = "hold_fenced";
+      desired = current;
+    } else {
+      set_deployment_replicas(k8s, dep_name, desired);
+      if (sleeping) {
+        // Surge while parked at zero: wake the slept standby FIRST — it
+        // serves from its warm compile cache while the new pods come up.
+        auto ready = ready_engine_pods(k8s, cr_name);
+        if (!ready.empty())
+          engine_admin_post(routers, ready.front().base, "wake_up", "", 10);
+        sleeping = false;
+      }
+      last_scale = now;
+      idle_streak = 0;
+      current = desired;
+      action = "scale_up";
+    }
+  } else if (desired < current) {
+    if (idle_streak < idle_verdicts) {
+      action = "hold_streak";
+    } else if (now - last_scale < stabilization_s) {
+      action = "hold_cooldown";
+    } else if (!fenced.empty()) {
+      // A fenced pod is the obvious victim: it serves nothing, so no
+      // drain — shrink the Deployment and delete the broken pod.
+      set_deployment_replicas(k8s, dep_name, current - 1);
+      k8s.destroy(kCoreV1, "pods", fenced.front());
+      last_scale = now;
+      idle_streak = 0;
+      action = "scale_down_fenced";
+      current -= 1;
+    } else {
+      auto ready = ready_engine_pods(k8s, cr_name);
+      const EnginePod* victim = pick_victim(routers, ready);
+      if (victim == nullptr) {
+        action = "hold_no_victim";
+      } else {
+        // Graceful ordering: drain THROUGH the router (discovery marks
+        // the endpoint unroutable before the engine sees the POST), block
+        // until in-flight work finishes or the drain deadline passes,
+        // and only then shrink the Deployment and delete the pod —
+        // SIGKILL never lands on a streaming response.
+        engine_admin_post(
+            routers, victim->base, "drain",
+            "&wait=1&timeout=" + std::to_string(drain_deadline_s),
+            static_cast<int>(drain_deadline_s) + 10);
+        set_deployment_replicas(k8s, dep_name, current - 1);
+        // Deleting the drained pod explicitly (instead of letting the
+        // ReplicaSet pick) is what makes the drain meaningful; on a real
+        // API server the pod-deletion-cost annotation would remove the
+        // remaining race with the ReplicaSet controller.
+        k8s.destroy(kCoreV1, "pods", victim->name);
+        last_scale = now;
+        idle_streak = 0;
+        action = "scale_down";
+        current -= 1;
+      }
+    }
+  }
+
+  // Pre-warmed scale-to-zero (docs/autoscaling.md "Scale to zero"): parked
+  // at the floor with a fully idle fleet, the last engine sleeps — KV
+  // freed, compile cache warm on disk. The FIRST admission-queue arrival
+  // wakes it through the router (request_service wake-on-arrival); the
+  // operator also wakes on queue evidence as the slower backstop.
+  if (scale_to_zero && current == floor_r && action == "none") {
+    // Sleeping is stricter than shrinking: no drain protects a slept
+    // engine, so the fleet must be FULLY quiet, not merely surplus.
+    if (!sleeping && idle && sig.in_flight == 0 &&
+        idle_streak >= idle_verdicts) {
+      auto ready = ready_engine_pods(k8s, cr_name);
+      if (!ready.empty() &&
+          engine_admin_post(routers, ready.front().base, "sleep", "&level=1",
+                            10)) {
+        sleeping = true;
+        action = "sleep";
+      }
+    } else if (sleeping &&
+               (sig.queue_depth > 0 || sig.in_flight > 0 ||
+                sig.hint > current)) {
+      auto ready = ready_engine_pods(k8s, cr_name);
+      if (!ready.empty())
+        engine_admin_post(routers, ready.front().base, "wake_up", "", 10);
+      sleeping = false;
+      action = "wake";
+    }
+  }
+
+  status["desiredReplicas"] = desired;
+  status["idleStreak"] = idle_streak;
+  status["lastScaleEpoch"] = last_scale;
+  status["sleeping"] = sleeping;
+  status["lastAutoscaleAction"] = action;
+  return status;
+}
+
+}  // namespace
+
 ReconcileResult reconcile_tpu_runtime(const K8sClient& k8s, const Json& cr) {
   ReconcileResult result;
   const std::string ns = k8s.ns();
@@ -326,15 +719,33 @@ ReconcileResult reconcile_tpu_runtime(const K8sClient& k8s, const Json& cr) {
   }
   changed |= upsert(k8s, kAppsV1, "deployments", build_engine_deployment(cr, ns));
 
+  // Autoscale actuation runs AFTER the structural upserts so a fresh CR's
+  // first pass creates the Deployment the actuator then scales.
+  Json status = Json::object();
+  if (cr.at("spec").has("autoscale")) {
+    try {
+      status = autoscale_tpu_runtime(k8s, cr);
+      const std::string action =
+          status.at("lastAutoscaleAction").as_string_or("none");
+      if (action.rfind("scale", 0) == 0 || action == "sleep" ||
+          action == "wake")
+        changed = true;
+    } catch (const std::exception& e) {
+      fprintf(stderr, "[operator] tpuruntimes/%s: autoscale pass failed: %s\n",
+              cr.at({"metadata", "name"}).as_string().c_str(), e.what());
+    }
+  }
+
   // Status: ready replicas from the owned Deployment.
   const std::string dep_name =
       cr.at({"metadata", "name"}).as_string() + "-engine";
   long ready = 0;
   if (auto dep = k8s.get(kAppsV1, "deployments", dep_name))
     ready = dep->at({"status", "readyReplicas"}).as_int(0);
-  Json status = Json::object();
   status["readyReplicas"] = ready;
-  status["phase"] = ready > 0 ? "Ready" : "Pending";
+  status["phase"] = status.at("sleeping").as_bool(false)
+                        ? "Sleeping"
+                        : (ready > 0 ? "Ready" : "Pending");
   status["lastReconciled"] = now_rfc3339();
   k8s.patch_status(kPstV1, "tpuruntimes",
                    cr.at({"metadata", "name"}).as_string(), status);
@@ -561,34 +972,6 @@ ReconcileResult reconcile_cache_server(const K8sClient& k8s, const Json& cr) {
 // ---------------------------------------------------------------------------
 
 namespace {
-
-struct EnginePod {
-  std::string name;
-  std::string base;  // http://ip:port
-};
-
-std::vector<EnginePod> ready_engine_pods(const K8sClient& k8s,
-                                         const std::string& base_model) {
-  std::vector<EnginePod> pods;
-  Json list = k8s.list(kCoreV1, "pods", "model%3D" + base_model);
-  for (const auto& pod : list.at("items").items()) {
-    const std::string ip = pod.at({"status", "podIP"}).as_string();
-    const std::string phase = pod.at({"status", "phase"}).as_string();
-    if (ip.empty() || phase != "Running") continue;
-    // Engine port from the pod's declared containerPort (default 8000).
-    long port = 8000;
-    const auto& containers = pod.at({"spec", "containers"}).items();
-    if (!containers.empty()) {
-      const auto& ports = containers[0].at("ports").items();
-      if (!ports.empty()) port = ports[0].at("containerPort").as_int(8000);
-    }
-    pods.push_back({pod.at({"metadata", "name"}).as_string(),
-                    "http://" + ip + ":" + std::to_string(port)});
-  }
-  std::sort(pods.begin(), pods.end(),
-            [](const EnginePod& a, const EnginePod& b) { return a.name < b.name; });
-  return pods;
-}
 
 bool adapter_loaded(const std::string& base, const std::string& adapter) {
   try {

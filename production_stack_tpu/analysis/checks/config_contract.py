@@ -526,4 +526,118 @@ def run(project: Project) -> List[Finding]:
                                 spec.field, fields[spec.field][0],
                                 spec.helm, helm_default),
                         ))
+
+    # -- operator autoscale knobs (CRD surfaces) ---------------------------
+    # spec.autoscale.* lives in the TPURuntime CRD, not helm (the chart
+    # renders no CRs); its four surfaces are the CRD schema, the C++
+    # reconciler consuming the key, the committed sample CR, and the
+    # autoscaling doc. Proved both directions, same philosophy as the
+    # routerSpec sweep above.
+    autoscale_specs = list(namespace.get("AUTOSCALE_KEYS") or ())
+    if autoscale_specs:
+        crd_rel = str(namespace.get("OPERATOR_CRD") or "operator/crds/crds.yaml")
+        cc_rel = str(
+            namespace.get("OPERATOR_RECONCILERS")
+            or "operator/src/reconcilers.cc"
+        )
+        sample_rel = str(
+            namespace.get("OPERATOR_SAMPLE")
+            or "operator/config/samples/tpuruntime.yaml"
+        )
+        adoc_rel = str(namespace.get("AUTOSCALE_DOC") or "docs/autoscaling.md")
+        crd_text = _read_text(project.root, crd_rel)
+        cc_text = _read_text(project.root, cc_rel)
+        sample_text = _read_text(project.root, sample_rel)
+        adoc_text = _read_text(project.root, adoc_rel)
+        declared = {s.key for s in autoscale_specs}
+
+        def _yaml_key(text: str, key: str) -> bool:
+            return bool(re.search(
+                r"^\s*%s\s*:" % re.escape(key), text, re.MULTILINE
+            ))
+
+        for spec in autoscale_specs:
+            if crd_text is not None and not _yaml_key(crd_text, spec.key):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "AutoscaleKeySpec %r is absent from %s — the CRD schema "
+                    "would reject the documented knob" % (spec.key, crd_rel),
+                ))
+            if cc_text is not None and '"%s"' % spec.key not in cc_text:
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "AutoscaleKeySpec %r is never read by %s — a CRD knob "
+                    "no reconciler consumes is configuration theater" % (
+                        spec.key, cc_rel),
+                ))
+            if sample_text is not None and not _yaml_key(sample_text, spec.key):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "AutoscaleKeySpec %r is missing from the sample CR %s — "
+                    "the sample is the values.yaml analogue for CRD knobs" % (
+                        spec.key, sample_rel),
+                ))
+            if adoc_text is not None and not _flag_re(spec.key).search(
+                adoc_text
+            ):
+                findings.append(Finding(
+                    CHECK_ID, registry_src.rel, 1, 0,
+                    "autoscale knob %r is not documented in %s — the knob "
+                    "table is the operator contract" % (spec.key, adoc_rel),
+                ))
+        # Reverse direction 1: every key under the CRD's autoscale block
+        # must be declared.
+        if crd_text is not None:
+            for key in _crd_autoscale_keys(crd_text):
+                if key not in declared and key != "type":
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "CRD autoscale key %r has no AutoscaleKeySpec in "
+                        "%s — undeclared knob" % (key, registry_src.rel),
+                    ))
+        # Reverse direction 2: every spec.autoscale read in the reconciler
+        # (`as.at("<key>")`) must be declared.
+        if cc_text is not None:
+            for key in sorted(set(re.findall(r'\bas\.at\("(\w+)"\)', cc_text))):
+                if key not in declared:
+                    findings.append(Finding(
+                        CHECK_ID, registry_src.rel, 1, 0,
+                        "%s reads spec.autoscale.%s but no AutoscaleKeySpec "
+                        "declares it — undeclared knob" % (cc_rel, key),
+                    ))
     return findings
+
+
+def _crd_autoscale_keys(crd_text: str) -> List[str]:
+    """Keys under the TPURuntime ``autoscale.properties`` block, by
+    indentation (the full CRD is outside simpleyaml's subset)."""
+    lines = crd_text.splitlines()
+    keys: List[str] = []
+    i = 0
+    while i < len(lines):
+        m = re.match(r"^(\s*)autoscale:\s*$", lines[i])
+        if not m:
+            i += 1
+            continue
+        base = len(m.group(1))
+        i += 1
+        prop_indent = None
+        while i < len(lines):
+            line = lines[i]
+            stripped = line.strip()
+            if stripped and not stripped.startswith("#"):
+                indent = len(line) - len(line.lstrip())
+                if indent <= base:
+                    break  # dedent: autoscale block ended
+                pm = re.match(r"^(\s*)properties:\s*$", line)
+                if pm:
+                    prop_indent = len(pm.group(1))
+                elif (
+                    prop_indent is not None
+                    and indent == prop_indent + 2
+                ):
+                    km = re.match(r"^\s*(\w+)\s*:", line)
+                    if km:
+                        keys.append(km.group(1))
+            i += 1
+    return keys

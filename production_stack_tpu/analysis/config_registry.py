@@ -429,6 +429,44 @@ ENGINE_FIELDS: Tuple[EngineFieldSpec, ...] = (
                     emit="--no-cost-attribution"),
 )
 
+@dataclasses.dataclass(frozen=True)
+class AutoscaleKeySpec:
+    """One ``spec.autoscale.<key>`` TPURuntime knob's contract.
+
+    The autoscale knobs live in the CRD, not in helm (the chart does
+    not render TPURuntime CRs — per-pool policy is declarative), so
+    their four surfaces are: the CRD openAPI schema
+    (:data:`OPERATOR_CRD`), the C++ reconciler that consumes them
+    (:data:`OPERATOR_RECONCILERS` reads ``as.at("<key>")``), the
+    committed sample CR (:data:`OPERATOR_SAMPLE`), and the docs page
+    (:data:`AUTOSCALE_DOC`). The config-contract check proves all four
+    in both directions — a CRD key no reconciler reads is
+    configuration theater, a reconciler read the CRD does not declare
+    is an undocumented knob.
+    """
+
+    key: str
+    note: str = ""
+
+
+OPERATOR_CRD = "operator/crds/crds.yaml"
+OPERATOR_RECONCILERS = "operator/src/reconcilers.cc"
+OPERATOR_SAMPLE = "operator/config/samples/tpuruntime.yaml"
+AUTOSCALE_DOC = "docs/autoscaling.md"
+
+AUTOSCALE_KEYS: Tuple[AutoscaleKeySpec, ...] = (
+    AutoscaleKeySpec("minReplicas", "floor; 0 allowed with scaleToZero"),
+    AutoscaleKeySpec("maxReplicas", "ceiling, clamps any replica hint"),
+    AutoscaleKeySpec("scaleDownStabilizationS",
+                     "cooldown after any scale event"),
+    AutoscaleKeySpec("drainDeadlineS",
+                     "blocking-drain bound per scale-down victim"),
+    AutoscaleKeySpec("idleVerdicts",
+                     "consecutive idle passes arming the shrink paths"),
+    AutoscaleKeySpec("scaleToZero",
+                     "park a single slept standby at sustained idle"),
+)
+
 ROUTER_BY_FLAG: Dict[str, ConfigSpec] = {s.flag: s for s in ROUTER_FLAGS}
 ENGINE_BY_FIELD: Dict[str, EngineFieldSpec] = {
     s.field: s for s in ENGINE_FIELDS

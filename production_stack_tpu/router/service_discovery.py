@@ -174,6 +174,15 @@ class ServiceDiscovery(ABC):
         """Mark/unmark an endpoint as warming (precompiling) immediately —
         the probes / watch events reconcile against the engine's /ready."""
 
+    def set_sleeping(self, url: str, sleeping: bool) -> None:
+        """Mark/unmark an endpoint as slept immediately.
+
+        Router-initiated sleep (the /sleep fan-out — the operator's
+        scale-to-zero path, docs/autoscaling.md "Scale to zero") calls
+        this so the standby stops receiving traffic BEFORE the engine
+        acks the sleep; the probes / watch events reconcile against the
+        engine's /is_sleeping."""
+
     async def start(self) -> None:
         """Begin background watch/health tasks (called from app startup)."""
 
@@ -269,6 +278,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self._draining: set = set()  # urls reporting is_draining
         # pstlint: owned-by=task:_health_loop,check_backend,_drain_reconcile_loop,set_warming
         self._warming: set = set()  # urls whose /ready reports warming
+        # pstlint: owned-by=task:set_sleeping
+        self._sleeping: set = set()  # urls slept via the router fan-out
         self._task: Optional[asyncio.Task] = None
 
     @staticmethod
@@ -461,6 +472,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
         else:
             self._warming.discard(url)
 
+    def set_sleeping(self, url: str, sleeping: bool) -> None:
+        if sleeping:
+            self._sleeping.add(url)
+        else:
+            self._sleeping.discard(url)
+
     def get_endpoint_info(self) -> List[EndpointInfo]:
         infos = []
         for i, (url, model) in enumerate(zip(self.urls, self.models)):
@@ -474,7 +491,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     Id=self.engine_ids[i],
                     added_timestamp=self.added_timestamp,
                     model_label=label,
-                    sleep=False,
+                    sleep=url in self._sleeping,
                     draining=url in self._draining,
                     warming=url in self._warming,
                     pool=(self.pools[i] if self.pools else "fused"),
@@ -552,6 +569,11 @@ class _K8sWatcherBase(ServiceDiscovery):
         for info in self.available_engines.values():
             if info.url == url:
                 info.warming = warming
+
+    def set_sleeping(self, url: str, sleeping: bool) -> None:
+        for info in self.available_engines.values():
+            if info.url == url:
+                info.sleep = sleeping
 
     async def start(self) -> None:
         if self._task is None:

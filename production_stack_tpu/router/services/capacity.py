@@ -197,6 +197,32 @@ class CapacityMonitor:
             self._queue_samples.clear()
 
 
+def _peer_capacity_evidence(app) -> list:
+    """Peers' gossiped capacity evidence (the ``capacity`` section each
+    replica publishes inside its fleet snapshot, ``services/fleet.py``).
+    Empty when gossip is off or no peer has synced yet."""
+    from ..state import get_state_backend
+
+    backend = app.get("state_backend") if app is not None else None
+    if backend is None:
+        try:
+            backend = get_state_backend()
+        except Exception:  # noqa: BLE001 — no backend (tests, static)
+            return []
+    if backend is None:
+        return []
+    try:
+        peers = backend.peer_fleet_snapshots() or {}
+    except Exception:  # noqa: BLE001 — backend without snapshot support
+        return []
+    out = []
+    for view in peers.values():
+        cap = view.get("capacity") if isinstance(view, dict) else None
+        if isinstance(cap, dict):
+            out.append(cap)
+    return out
+
+
 def _fleet_view(app) -> dict:
     """Ready-engine count + KV statistics from the gossip-merged fleet
     snapshot (every replica computes the same numbers modulo one sync
@@ -250,6 +276,32 @@ def compute_signal(monitor: CapacityMonitor, app=None) -> dict:
     monitor.sample_queue_depth(queue_depth, now)
     slope = monitor.queue_slope()
     fleet = _fleet_view(app)
+
+    # Merge peers' gossiped capacity evidence so every replica derives
+    # the hint from the FLEET's burn/queue reality, not just its own
+    # routed share: burn rates take the per-window max (one replica
+    # paging means the fleet is paging), queue depth/capacity and slope
+    # sum (each replica queues only its own admissions). Two gossiping
+    # replicas therefore serve the same replica_hint within one sync
+    # interval — the agreement contract tests/test_flight_cost.py pins.
+    peer_evidence = _peer_capacity_evidence(app)
+    for cap in peer_evidence:
+        peer_burn = cap.get("burn_rates") or {}
+        for label in list(burn):
+            try:
+                burn[label] = round(
+                    max(burn[label], float(peer_burn.get(label) or 0.0)), 4
+                )
+            except (TypeError, ValueError):
+                continue
+        try:
+            queue_depth += int(cap.get("queue_depth") or 0)
+            queue_capacity += int(cap.get("queue_capacity") or 0)
+            slope = round(
+                slope + float(cap.get("queue_depth_slope_per_s") or 0.0), 4
+            )
+        except (TypeError, ValueError):
+            continue
 
     fast_burn = burn.get(_FAST_WINDOW, 0.0)
     slow_burn = burn.get(_SLOW_WINDOW, 0.0)
@@ -307,6 +359,9 @@ def compute_signal(monitor: CapacityMonitor, app=None) -> dict:
         "queue_depth_slope_per_s": slope,
         "saturation": saturation,
         "replica_hint": hint,
+        # How many replicas' evidence (self + synced peers) fed this
+        # derivation — 1 means a purely local view.
+        "evidence_replicas": 1 + len(peer_evidence),
         **fleet,
     }
     # Gauge twins so a plain Prometheus pipeline (or the dashboards' new

@@ -94,6 +94,7 @@ def local_fleet_snapshot(app=None, compact: bool = False) -> dict:
     from ..stats.engine_stats import get_engine_stats_scraper
     from ..stats.request_stats import get_request_stats_monitor
     from .canary import get_canary_prober
+    from .capacity import get_capacity_monitor
 
     backend = _resolve(app, "state_backend", get_state_backend)
     discovery = _resolve(app, "service_discovery", get_service_discovery)
@@ -168,6 +169,27 @@ def local_fleet_snapshot(app=None, compact: bool = False) -> dict:
             controller.tenants_snapshot() if controller is not None else {}
         ),
     }
+
+    # Capacity evidence (docs/autoscaling.md "Signal convergence"): the
+    # SLO-burn windows and admission-queue state feeding
+    # /autoscale/signal are replica-LOCAL — only the replica that
+    # proxied a slow request burns budget for it. Gossiping the raw
+    # evidence lets every replica's compute_signal() merge the fleet's
+    # view (burn = max, queue = sum) so two routers serve the SAME
+    # replica_hint within one sync interval — the convergence the
+    # operator's max-merge relies on as defense, not correctness.
+    cap_monitor = _resolve(app, "capacity_monitor", get_capacity_monitor)
+    if cap_monitor is not None:
+        queue_depth = queue_capacity = 0
+        if controller is not None and getattr(controller, "enabled", False):
+            queue_depth = controller.queue_len()
+            queue_capacity = int(getattr(controller, "max_queue", 0) or 0)
+        snapshot["capacity"] = {
+            "burn_rates": cap_monitor.burn_rates(),
+            "queue_depth": queue_depth,
+            "queue_capacity": queue_capacity,
+            "queue_depth_slope_per_s": cap_monitor.queue_slope(),
+        }
     return snapshot
 
 

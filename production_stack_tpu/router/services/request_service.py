@@ -238,6 +238,30 @@ def _note_failure(url: str, request_id: str = "", span=None) -> None:
                 span.add_event("breaker_state", server=url, state=state.value)
 
 
+# Scale-to-zero wake-on-arrival (docs/autoscaling.md "Scale to zero"):
+# how often a request held for a waking standby re-probes it, and the
+# cap on how long it will hold before surfacing the 503 (a deadline
+# header always bounds it tighter).
+_WAKE_POLL_S = 0.25
+_WAKE_WAIT_MAX_S = 30.0
+
+
+async def _fire_wake(session: aiohttp.ClientSession, url: str) -> None:
+    """POST /wake_up to a slept standby — the first admission arrival IS
+    the wake signal for a scaled-to-zero pool. Best-effort: a failed wake
+    surfaces as the sleeping 503s the caller already handles (and the
+    operator's reconcile loop wakes the engine on its next pass)."""
+    try:
+        # pstlint: disable=hop-contract(admin wake of a slept standby, not a proxied client request — there is no deadline/trace context to forward; the woken engine serves many clients)
+        async with session.post(
+            url + "/wake_up", timeout=aiohttp.ClientTimeout(total=5)
+        ) as resp:
+            await resp.read()
+            logger.info("woke sleeping engine %s (status %d)", url, resp.status)
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        logger.warning("wake_up POST to %s failed: %s", url, e)
+
+
 # Content chunks between journal checkpoints on replicated routers: small
 # enough that a takeover rarely loses more than a few tokens of splice
 # budget, large enough that checkpointing stays off the per-chunk path.
@@ -381,6 +405,11 @@ async def proxy_and_stream(
     tried = {url}
     attempt = 0
     streaming = bool(parsed.get("stream"))
+    # Scale-to-zero wake-on-arrival: engines we already fired /wake_up at
+    # (once per request), and the monotonic cap on holding the request
+    # for a wake when there is no other engine to fail over to.
+    woken: set = set()
+    wake_wait_until: Optional[float] = None
 
     # SLO accounting (docs/observability.md "SLOs & alerting"): the
     # router-observed TTFT — proxy entry to the first upstream byte of the
@@ -437,6 +466,7 @@ async def proxy_and_stream(
         journal: Optional[StreamJournal] = None
         failure_noted = False  # at most one breaker/stats failure per attempt
         completed = False  # ... and at most one completion per attempt
+        standby_503 = False  # sleeping/warming rejection from a woken standby
 
         def _complete() -> None:
             # Idempotent per attempt: write_eof raising after the stream
@@ -488,6 +518,27 @@ async def proxy_and_stream(
                         # traffic (the /ready probes clear it once the
                         # pass finishes), spare the breaker, fail over.
                         get_service_discovery().set_warming(url, True)
+                        # A wake this request fired re-enters the warmup
+                        # pass — keep holding for it below if there is
+                        # nowhere else to go.
+                        standby_503 = url in woken
+                    elif upstream.status == 503 and "X-PST-Sleeping" in upstream.headers:
+                        # Slept standby (scale-to-zero): the first arrival
+                        # IS the wake signal. Fire the wake once, mark the
+                        # endpoint warming (wake re-enters the warmup pass;
+                        # the /ready probes clear it), spare the breaker,
+                        # and fail over — or hold for the wake below when
+                        # this was the only routable engine.
+                        get_service_discovery().set_warming(url, True)
+                        if url not in woken:
+                            woken.add(url)
+                            await _fire_wake(session, url)
+                            # The standby is waking: clear the sleep mark the
+                            # operator's fan-out set (static discovery has no
+                            # probe loop to reconcile it); warming gates
+                            # routability until the wake pass finishes.
+                            get_service_discovery().set_sleeping(url, False)
+                        standby_503 = True
                     else:
                         _note_failure(url, request_id, span=attempt_span)
                         failure_noted = True
@@ -526,7 +577,30 @@ async def proxy_and_stream(
                         url = next_url
                         tried.add(url)
                         continue
+                    if not ok and standby_503:
+                        # Scale-to-zero with a single standby: nowhere to
+                        # fail over, but a wake is in flight — hold the
+                        # request (bounded by the wake cap and any
+                        # deadline) and retry the same engine instead of
+                        # surfacing the 503 to the client.
+                        now_m = time.monotonic()
+                        if wake_wait_until is None:
+                            wake_wait_until = now_m + _WAKE_WAIT_MAX_S
+                        if now_m < wake_wait_until and not _deadline_blocks_attempt(
+                            deadline, _WAKE_POLL_S
+                        ):
+                            _complete()
+                            attempt_span.set_attribute("outcome", "wake_wait")
+                            attempt_span.end()
+                            upstream.release()
+                            await asyncio.sleep(_WAKE_POLL_S)
+                            continue
                     # Nowhere left to go: stream the 5xx through unchanged.
+                if ok and url in woken:
+                    # The woken standby answered live traffic: clear the
+                    # warming mark the wake path set (K8s discovery has no
+                    # probe loop to reconcile it between pod events).
+                    get_service_discovery().set_warming(url, False)
                 try:
                     response = web.StreamResponse(status=upstream.status)
                     for k, v in upstream.headers.items():
@@ -1178,6 +1252,12 @@ async def _buffered_attempt(
     elif status == 503 and "X-PST-Warming" in headers:
         get_service_discovery().set_warming(url, True)
         span.set_attribute("outcome", "warming")
+    elif status == 503 and "X-PST-Sleeping" in headers:
+        # A hedge/race attempt hit a slept standby: wake it for future
+        # traffic (the racing primary serves this request).
+        get_service_discovery().set_warming(url, True)
+        spawn_owned(_fire_wake(session, url), name=f"wake:{url}")
+        span.set_attribute("outcome", "sleeping")
     elif status == 504 and DEADLINE_EXCEEDED_HEADER in headers:
         span.set_attribute("outcome", "deadline_shed")
         trace.add_event("deadline_shed", stage="engine", server=url)
@@ -1528,6 +1608,13 @@ async def route_general_request(request: web.Request, endpoint: str) -> web.Stre
         candidates = [
             e for e in endpoints if (e.has_model(requested_model) and not e.sleep)
         ]
+        if not candidates:
+            # Scale-to-zero (docs/autoscaling.md "Scale to zero"): a pool
+            # parked at a single slept standby has no routable engine — the
+            # first arrival must WAKE it, not 404. Slept matches become
+            # candidates; the proxy's tagged-503 path fires /wake_up and
+            # holds the request through the wake.
+            candidates = [e for e in endpoints if e.has_model(requested_model)]
     # Disagg is the fleet SHAPE, not just a routing policy
     # (docs/disagg.md): the two-leg flow engages for the legacy
     # label-split policy AND whenever THIS MODEL's serving set declares
@@ -2008,14 +2095,21 @@ async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.R
     """Admin proxy for /sleep, /wake_up, /is_sleeping across engines.
 
     Targets engines by ``model`` query-param label (or all engines when
-    omitted), mirroring reference ``request.py:437-513``.
+    omitted), mirroring reference ``request.py:437-513``; ``url`` targets
+    one specific engine — the operator's scale-to-zero path
+    (docs/autoscaling.md "Scale to zero") sleeps exactly one standby.
     """
     discovery = get_service_discovery()
     endpoints = discovery.get_endpoint_info()
     label = request.query.get("model")
-    targets = [e for e in endpoints if label is None or e.model_label == label or label in e.model_names]
+    url = request.query.get("url")
+    targets = [
+        e for e in endpoints
+        if (url is None or e.url == url)
+        and (label is None or e.model_label == label or label in e.model_names)
+    ]
     if not targets:
-        return _error_response(404, f"no engines matching {label!r}",
+        return _error_response(404, f"no engines matching {url or label!r}",
                                "not_found_error",
                                request_id=request.get("request_id"))
     session: aiohttp.ClientSession = request.app["client_session"]
@@ -2033,10 +2127,22 @@ async def route_sleep_wakeup_request(request: web.Request, action: str) -> web.R
                 return await resp.json()
         level = request.query.get("level")
         params = {"level": level} if level else None
+        if action == "sleep":
+            # Unroutable BEFORE the engine acks: same ordering as the
+            # drain fan-out — no request may race into a standby that is
+            # about to stop serving (docs/autoscaling.md "Scale to zero").
+            discovery.set_sleeping(ep.url, True)
         async with session.post(
             f"{ep.url}/{action}", params=params, headers=headers
         ) as resp:
-            return {"status": resp.status}
+            status = resp.status
+        if action == "sleep" and status >= 400:
+            discovery.set_sleeping(ep.url, False)  # engine refused: restore
+        elif action == "wake_up" and status < 400:
+            # Routable again; if the wake re-enters warmup the engine's
+            # tagged 503 re-marks it warming on first contact.
+            discovery.set_sleeping(ep.url, False)
+        return {"status": status}
 
     return web.json_response(await _admin_fanout(targets, call))
 

@@ -141,6 +141,7 @@ class FakeEngineState:
         # tests that need an engine pinned "full" without traffic.
         self.kv_fill_floor = 0.0
         self.sleeping = False
+        self.sleep_level: Optional[str] = None
         self.lora_adapters: List[str] = []
         self.requests_seen: List[dict] = []
         # Fault injection (resilience tests): POST /admin/fail arms one of
@@ -866,6 +867,16 @@ def create_fake_engine_app(
             # The real engine sheds already-expired work at admission; a
             # router honoring the contract never forwards such a request.
             return _deadline_exceeded_response(request)
+        if state.sleeping:
+            # Parity with the real engine's sleep gate: a slept engine
+            # refuses generation outright. The tagged 503 lets the router
+            # fail over (and fire a wake) without feeding the breaker.
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "service_unavailable", "code": 503}},
+                status=503,
+                headers={"X-PST-Sleeping": "1", **echo},
+            )
         if state.draining:
             return web.json_response(
                 {"error": {"message": "engine is draining",
@@ -1326,6 +1337,7 @@ def create_fake_engine_app(
             "draining": state.draining,
             "warming": state.warming,
             "sleeping": state.sleeping,
+            "sleep_level": state.sleep_level,
             "in_flight": state.num_running,
             "kv_occupancy": round(state.kv_occupancy, 4),
             "kv_capacity_tokens": state.kv_capacity_tokens,
@@ -1415,6 +1427,8 @@ def create_fake_engine_app(
         }
         if state.fail_mode == "error":
             reason = "unhealthy"
+        elif state.sleeping:
+            reason = "sleeping"
         elif state.warming:
             reason = "warming"
         elif state.draining:
@@ -1544,12 +1558,27 @@ def create_fake_engine_app(
         )
 
     async def sleep(request: web.Request) -> web.Response:
+        level = request.query.get("level", "1")
         state.sleeping = True
-        return web.json_response({"status": "sleeping"})
+        state.sleep_level = level
+        return web.json_response({"status": "sleeping", "level": level})
 
     async def wake_up(request: web.Request) -> web.Response:
+        was_sleeping = state.sleeping
         state.sleeping = False
-        return web.json_response({"status": "awake"})
+        state.sleep_level = None
+        if was_sleeping:
+            # Wake re-enters the simulated warmup exactly like a restart:
+            # ``--ready-delay`` governs the wake time, and a warm compile
+            # cache (marker file present) shrinks it to the warm-restart
+            # fraction — zero fresh compiles, scale-to-zero's
+            # wake->first-token bound becomes CPU-measurable.
+            state.configure_warmup(state.ready_delay, state.warmup_cache_dir)
+        return web.json_response({
+            "status": "awake",
+            "warming": state.warming,
+            "effective_ready_delay": round(state.effective_ready_delay, 3),
+        })
 
     async def load_lora(request: web.Request) -> web.Response:
         body = await request.json()

@@ -1,0 +1,257 @@
+"""In-process fake Kubernetes API server (envtest analogue).
+
+Extracted from ``tests/test_operator.py`` so the operator unit tests, the
+``operator``-mode e2e legs (``tests/e2e/test_routing.py``) and the bench
+autoscale phase all drive the SAME API-server semantics: list/get with
+single ``k=v`` label selectors, create/replace with resourceVersion and
+generation-bump-on-spec-change, merge-patch of ``/status``, finalizer
+deletion semantics, and chunked ``?watch=true`` streams.
+
+The real ``pst-operator`` binary points at :attr:`FakeK8s.url` via
+``--api-server``; the router's K8s discovery reaches the same server via
+the ``PST_K8S_API_SERVER`` env override — a full closed autoscaling loop
+on one CPU host with no cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+from aiohttp import web
+
+# API path prefixes as the operator addresses them.
+PST = "/apis/pst.production-stack.io/v1alpha1"
+APPS = "/apis/apps/v1"
+CORE = "/api/v1"
+
+
+class FakeK8s:
+    """Minimal namespaced K8s API: enough semantics for the controller."""
+
+    def __init__(self):
+        # (api_prefix, plural) -> {name: obj}
+        self.store = {}
+        self.rv = 0
+        self.url = None
+        self._ready = threading.Event()
+        self._loop = None
+        # (prefix, plural) -> list of asyncio.Queue for ?watch=true streams
+        self._watchers = {}
+
+    # -- storage helpers --------------------------------------------------
+
+    def bucket(self, prefix, plural):
+        return self.store.setdefault((prefix, plural), {})
+
+    def seed(self, prefix, plural, obj):
+        name = obj["metadata"]["name"]
+        obj["metadata"].setdefault("uid", f"uid-{name}")
+        self.bucket(prefix, plural)[name] = obj
+        # Seeding after start() is the harness playing kubelet (e.g. the
+        # autoscale e2e starting the pods a scaled-up Deployment implies):
+        # live ?watch=true streams must see the object appear. Queues are
+        # loop-owned, so hop onto the server loop.
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(
+                self._broadcast, prefix, plural, "ADDED", obj
+            )
+
+    def seed_engine_pod(self, name, port, model="base", ip="127.0.0.1"):
+        """A Running engine pod as the engine Deployment would produce it."""
+        self.seed(CORE, "pods", {
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {"model": model}},
+            "spec": {"containers": [{
+                "name": "engine",
+                "ports": [{"containerPort": port}],
+            }]},
+            "status": {"podIP": ip, "phase": "Running",
+                       # The router's pod-IP watcher requires the Ready
+                       # condition, not just the phase.
+                       "conditions": [{"type": "Ready", "status": "True"}]},
+        })
+
+    def seed_router_replica(self, name, port, ip="127.0.0.1"):
+        """A router Service + Running pod pair the operator's autoscale
+        actuator discovers (component=router Service -> selector -> pod)."""
+        self.seed(CORE, "services", {
+            "metadata": {
+                "name": name, "namespace": "default",
+                "labels": {"app.kubernetes.io/component": "router"},
+            },
+            "spec": {"selector": {"app": name},
+                     "ports": [{"port": 80, "targetPort": port}]},
+        })
+        self.seed(CORE, "pods", {
+            "metadata": {"name": f"{name}-0", "namespace": "default",
+                         "labels": {"app": name}},
+            "spec": {"containers": [{
+                "name": "router",
+                "ports": [{"containerPort": port}],
+            }]},
+            "status": {"podIP": ip, "phase": "Running"},
+        })
+
+    def _broadcast(self, prefix, plural, event_type, obj):
+        for q in self._watchers.get((prefix, plural), []):
+            q.put_nowait({"type": event_type, "object": obj})
+
+    # -- aiohttp app ------------------------------------------------------
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_route("*", "/{api:apis?}/{rest:.*}", self.handle)
+        return app
+
+    async def handle(self, request: web.Request):
+        # Paths: /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+        #        /apis/{group}/{ver}/namespaces/{ns}/{plural}[/{name}[/status]]
+        parts = request.path.strip("/").split("/")
+        if parts[0] == "api":
+            prefix = "/api/" + parts[1]
+            rest = parts[2:]
+        else:
+            prefix = "/apis/" + parts[1] + "/" + parts[2]
+            rest = parts[3:]
+        if len(rest) < 2 or rest[0] != "namespaces":
+            return web.json_response({"error": "bad path"}, status=400)
+        plural = rest[2]
+        name = rest[3] if len(rest) > 3 else None
+        subresource = rest[4] if len(rest) > 4 else None
+        bucket = self.bucket(prefix, plural)
+
+        if request.method == "GET" and name is None:
+            if request.query.get("watch") == "true":
+                # K8s watch wire format: one JSON event object per line,
+                # chunked. Synthetic ADDED events for existing objects first
+                # (a watch without resourceVersion), then live mutations.
+                resp = web.StreamResponse()
+                resp.enable_chunked_encoding()
+                await resp.prepare(request)
+                q = asyncio.Queue()
+                for obj in bucket.values():
+                    q.put_nowait({"type": "ADDED", "object": obj})
+                self._watchers.setdefault((prefix, plural), []).append(q)
+                try:
+                    while True:
+                        event = await q.get()
+                        if event is None:  # shutdown sentinel: clean EOF
+                            break
+                        await resp.write(
+                            (json.dumps(event) + "\n").encode()
+                        )
+                except (ConnectionResetError, asyncio.CancelledError):
+                    pass
+                finally:
+                    self._watchers[(prefix, plural)].remove(q)
+                return resp
+            items = list(bucket.values())
+            selector = request.query.get("labelSelector")
+            if selector:
+                k, _, v = selector.partition("=")
+                items = [
+                    o for o in items
+                    if o.get("metadata", {}).get("labels", {}).get(k) == v
+                ]
+            return web.json_response({"kind": "List", "items": items})
+        if request.method == "GET":
+            if name not in bucket:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(bucket[name])
+        if request.method == "POST":
+            obj = await request.json()
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            obj["metadata"].setdefault("uid", f"uid-{obj['metadata']['name']}")
+            obj["metadata"].setdefault("generation", 1)
+            bucket[obj["metadata"]["name"]] = obj
+            self._broadcast(prefix, plural, "ADDED", obj)
+            return web.json_response(obj, status=201)
+        if request.method == "PUT":
+            obj = await request.json()
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            meta = obj["metadata"]
+            # generation bumps only on spec changes (API-server semantics —
+            # the operator's watch filter depends on this).
+            old = bucket.get(name, {})
+            gen = old.get("metadata", {}).get("generation", 1)
+            meta["generation"] = (
+                gen + 1 if obj.get("spec") != old.get("spec") else gen
+            )
+            # API-server finalizer semantics: removing the last finalizer
+            # from an object marked for deletion actually deletes it.
+            if meta.get("deletionTimestamp") and not meta.get("finalizers"):
+                bucket.pop(name, None)
+                self._broadcast(prefix, plural, "DELETED", obj)
+                return web.json_response(obj)
+            bucket[name] = obj
+            self._broadcast(prefix, plural, "MODIFIED", obj)
+            return web.json_response(obj)
+        if request.method == "PATCH":
+            if name not in bucket:
+                return web.json_response({"error": "not found"}, status=404)
+            patch = await request.json()
+            target = bucket[name]
+            if subresource == "status" or "status" in patch:
+                target.setdefault("status", {}).update(patch.get("status", {}))
+            return web.json_response(target)
+        if request.method == "DELETE":
+            obj = bucket.get(name)
+            if obj and obj.get("metadata", {}).get("finalizers"):
+                # Finalizers pending: mark for deletion, keep the object.
+                obj["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+                self._broadcast(prefix, plural, "MODIFIED", obj)
+                return web.json_response(obj)
+            bucket.pop(name, None)
+            if obj:
+                self._broadcast(prefix, plural, "DELETED", obj)
+            return web.json_response({"status": "ok"})
+        return web.json_response({"error": "unsupported"}, status=405)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._runner = web.AppRunner(self.make_app())
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            self.url = f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+            self._ready.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def stop(self):
+        """Graceful teardown: end watch streams with a sentinel (clean EOF
+        to the operator, no mid-write ConnectionResets), clean the runner
+        up on its own loop, then stop the loop. Keeps teardown log noise
+        from burying real failures (VERDICT r3 #10; envtest's clean
+        lifecycle is the model, suite_test.go:1-88)."""
+        if not self._loop:
+            return
+
+        async def shutdown():
+            for qs in self._watchers.values():
+                for q in list(qs):
+                    q.put_nowait(None)
+            await asyncio.sleep(0.05)  # let handlers write EOF and return
+            if getattr(self, "_runner", None) is not None:
+                await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

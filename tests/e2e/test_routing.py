@@ -1491,6 +1491,349 @@ def leg_fleet_observability():
                 p.kill()
 
 
+# ---------------------------------------------------------------------------
+# Autoscale legs (docs/autoscaling.md): the CLOSED loop on one CPU host —
+# REAL router (k8s discovery) + REAL pst-operator binary (--once = one
+# reconcile tick) + fake engines + in-process fake K8s API server. The
+# harness plays the kubelet: when the actuator scales the Deployment it
+# starts/stops engine processes and seeds/removes their pods.
+# ---------------------------------------------------------------------------
+
+OPERATOR_DIR = os.path.join(REPO, "operator")
+OPERATOR_BIN = os.path.join(OPERATOR_DIR, "build", "pst-operator")
+
+
+def _operator_pass(k8s_url, timeout=120):
+    """One reconcile tick of the real operator binary."""
+    proc = subprocess.run(
+        [OPERATOR_BIN, "--api-server", k8s_url, "--namespace", "default",
+         "--once"],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+class K8sFleet:
+    """Fake K8s API + fake engines on distinct loopback IPs (pod-IP
+    discovery addresses every pod at one shared port) + the REAL router in
+    k8s-discovery mode + a TPURuntime CR whose replica count the operator's
+    autoscale actuator owns."""
+
+    def __init__(self, n_engines, autoscale, router_args=None, speed=2000):
+        sys.path.insert(0, REPO)
+        from production_stack_tpu.testing.fake_k8s import CORE, PST, FakeK8s
+        self.CORE, self.PST = CORE, PST
+        subprocess.run(["make"], cwd=OPERATOR_DIR, check=True,
+                       capture_output=True)
+        self.k8s = FakeK8s().start()
+        self.engine_port = free_port()
+        self.speed = speed
+        self.engines = {}  # pod name -> {"proc", "url"}
+        self._next = 0
+        for _ in range(n_engines):
+            self.add_engine()
+
+        self.port = free_port()
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   PST_K8S_API_SERVER=self.k8s.url)
+        self.router = subprocess.Popen(
+            [sys.executable, "-m", "production_stack_tpu.router.app",
+             "--host", "127.0.0.1", "--port", str(self.port),
+             "--service-discovery", "k8s",
+             "--k8s-label-selector", "model=base",
+             "--k8s-port", str(self.engine_port),
+             "--routing-logic", "roundrobin",
+             "--engine-stats-interval", "1"] + (router_args or []),
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        wait_http(f"http://127.0.0.1:{self.port}/health")
+        self.url = f"http://127.0.0.1:{self.port}"
+        # The operator's actuator discovers router replicas through the
+        # component=router Service, then polls GET /autoscale/signal.
+        self.k8s.seed_router_replica("pst-router", self.port)
+        self.k8s.seed(PST, "tpuruntimes", {
+            "apiVersion": "pst.production-stack.io/v1alpha1",
+            "kind": "TPURuntime",
+            "metadata": {"name": "base", "namespace": "default"},
+            "spec": {"model": MODEL, "replicas": n_engines,
+                     "engineConfig": {}, "kvCache": {},
+                     "autoscale": autoscale},
+        })
+
+    def add_engine(self):
+        """Kubelet role: one more Running engine pod, real process behind
+        it. Distinct loopback IP, shared port (pod-IP discovery)."""
+        name = f"base-engine-{self._next}"
+        ip = f"127.0.0.{self._next + 2}"
+        self._next += 1
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "production_stack_tpu.testing.fake_engine",
+             "--host", ip, "--port", str(self.engine_port),
+             "--model", MODEL, "--speed", str(self.speed), "--name", name],
+            env=dict(os.environ, PYTHONPATH=REPO),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        url = f"http://{ip}:{self.engine_port}"
+        wait_http(f"{url}/health")
+        self.engines[name] = {"proc": proc, "url": url}
+        self.k8s.seed_engine_pod(name, self.engine_port, ip=ip)
+        return name
+
+    def cr_status(self):
+        return self.k8s.bucket(self.PST, "tpuruntimes")["base"].get(
+            "status", {})
+
+    def dep_replicas(self):
+        return self.k8s.bucket(
+            self.APPS, "deployments")["base-engine"]["spec"]["replicas"]
+
+    @property
+    def APPS(self):
+        from production_stack_tpu.testing.fake_k8s import APPS
+        return APPS
+
+    def signal(self):
+        return _get_json(f"{self.url}/autoscale/signal")
+
+    def wait_signal(self, pred, timeout=30):
+        deadline = time.time() + timeout
+        sig = None
+        while time.time() < deadline:
+            sig = self.signal()
+            if pred(sig):
+                return sig
+            time.sleep(0.3)
+        raise AssertionError(f"signal never converged: {sig}")
+
+    def compile_total(self, name):
+        with urllib.request.urlopen(
+            f"{self.engines[name]['url']}/metrics", timeout=5
+        ) as r:
+            text = r.read().decode()
+        return sum(
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("pst_engine_compile_total")
+        )
+
+    def stop(self):
+        procs = [self.router] + [e["proc"] for e in self.engines.values()]
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.k8s.stop()
+
+
+def leg_autoscale_surge():
+    """Surge absorption through the closed loop: burn-rate evidence raises
+    the router's replica hint, one operator tick scales the Deployment
+    (immediately — no cooldown on the way UP), the harness-kubelet starts
+    the new engine, the router discovers it and traffic spreads — with zero
+    fresh compiles on the new replica (warm-start path)."""
+    fleet = K8sFleet(
+        1,
+        {"minReplicas": 1, "maxReplicas": 3,
+         "scaleDownStabilizationS": 3600, "idleVerdicts": 3},
+        router_args=["--slo-ttft-ms", "40", "--admission-rate", "200",
+                     "--proxy-retries", "0",
+                     "--breaker-failure-threshold", "100"],
+    )
+    try:
+        fleet.wait_signal(lambda s: s["engines_ready"] == 1)
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["routersPolled"] == 1, st
+        assert st["desiredReplicas"] == 1, st
+
+        # Surge: the lone engine turns slow (300ms >> the 40ms objective),
+        # every request burns budget, the multi-window rule pages and the
+        # hint asks for more replicas.
+        req = urllib.request.Request(
+            f"{fleet.engines['base-engine-0']['url']}/admin/fail",
+            data=json.dumps({"mode": "slow", "delay": 0.3,
+                             "count": -1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        for i in range(30):
+            status, _, _ = post(
+                f"{fleet.url}/v1/completions",
+                {"model": MODEL, "prompt": f"surge {i}", "max_tokens": 2},
+            )
+            assert status == 200, status
+        sig = fleet.signal()
+        assert sig["replica_hint"] >= 2, sig
+
+        absorb_start = time.time()
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["lastAutoscaleAction"] == "scale_up", st
+        want = st["desiredReplicas"]
+        assert 2 <= want <= 3, st
+        assert fleet.dep_replicas() == want
+
+        # Kubelet role: start the pods the scaled Deployment implies.
+        new_names = [fleet.add_engine() for _ in range(want - 1)]
+        fleet.wait_signal(lambda s: s["engines_ready"] == want)
+        absorb_s = time.time() - absorb_start
+
+        # Absorb: every request lands (old engine still slow — the new
+        # capacity is what absorbs), new replicas take traffic, and their
+        # compile counters never move (zero cold compiles: the warm-start
+        # path, not a fresh XLA storm, brought them up).
+        before = {n: fleet.compile_total(n) for n in new_names}
+        served = Counter()
+        for i in range(20):
+            status, by, _ = post(
+                f"{fleet.url}/v1/completions",
+                {"model": MODEL, "prompt": f"absorb {i}", "max_tokens": 2},
+            )
+            assert status == 200, status
+            served[by] += 1
+        assert any(n in served for n in new_names), served
+        after = {n: fleet.compile_total(n) for n in new_names}
+        assert after == before, (before, after)
+    finally:
+        fleet.stop()
+    print(f"PASS autoscale_surge (hint {sig['replica_hint']}, "
+          f"{want} replicas, absorb {absorb_s:.1f}s, 0 fresh compiles)")
+
+
+def leg_autoscale_scaledown():
+    """Graceful scale-down + fencing + scale-to-zero: surplus capacity arms
+    over idleVerdicts ticks, the victim (lowest in-flight) drains THROUGH
+    the router while its live stream completes (zero truncation — SIGKILL
+    never lands on a streaming response), the crash-looping pod is fenced
+    out of every count, and the last engine parks slept then wakes on the
+    first arrival."""
+    import threading as _threading
+
+    fleet = K8sFleet(
+        2,
+        {"minReplicas": 1, "maxReplicas": 4, "scaleDownStabilizationS": 0,
+         "idleVerdicts": 2, "drainDeadlineS": 60, "scaleToZero": True},
+        speed=40,  # slow token clock => streams live for seconds
+    )
+    try:
+        fleet.wait_signal(lambda s: s["engines_ready"] == 2)
+
+        # Three live streams, round-robined 2:1 — the lighter engine is
+        # the victim the router fleet scores lowest.
+        results = {}
+
+        def one_stream(i):
+            results[i] = _stream_collect(
+                fleet.url,
+                {"model": MODEL, "prompt": f"long {i}", "max_tokens": 240,
+                 "stream": True},
+                f"scaledown-{i}",
+            )
+
+        threads = [_threading.Thread(target=one_stream, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # streams registered in the router's accounting
+
+        # Tick 1: surplus verdict (hint 1 < 2 running) arms the streak but
+        # hysteresis holds. Tick 2: streak reached — drain the victim
+        # (blocking until its stream finishes), shrink, delete the pod.
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["lastAutoscaleAction"] == "hold_streak", st
+        assert fleet.dep_replicas() == 2
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["lastAutoscaleAction"] == "scale_down", st
+        assert fleet.dep_replicas() == 1
+
+        for t in threads:
+            t.join(timeout=60)
+        for i, (toks, body, died, _hdrs) in results.items():
+            assert not died, f"stream {i} transport-died"
+            assert len(toks) == 240, f"stream {i} truncated: {len(toks)}"
+            assert "[DONE]" in body, f"stream {i} never finished"
+
+        pods = set(fleet.k8s.bucket(fleet.CORE, "pods"))
+        survivors = {n for n in fleet.engines if n in pods}
+        assert len(survivors) == 1, pods
+        victim = next(n for n in fleet.engines if n not in pods)
+        with urllib.request.urlopen(f"{fleet.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert metric_value(metrics, "pst_stream_truncated_total") == 0.0
+
+        # Kubelet role: the deleted pod's process terminates (SIGTERM,
+        # post-drain — never SIGKILL mid-stream).
+        fleet.engines[victim]["proc"].send_signal(signal.SIGTERM)
+        # Zero requests route to the victim after the drain.
+        survivor = next(iter(survivors))
+        for i in range(6):
+            status, by, _ = post(
+                f"{fleet.url}/v1/completions",
+                {"model": MODEL, "prompt": f"post {i}", "max_tokens": 2},
+            )
+            assert status == 200 and by == survivor, (status, by)
+
+        # A crash-looping pod appears: fenced by the operator (reported,
+        # held out of actuation), ignored by the router (never Ready) —
+        # it must never inflate the ready count or the replica hint.
+        fleet.k8s.seed(fleet.CORE, "pods", {
+            "metadata": {"name": "pod-bad", "namespace": "default",
+                         "labels": {"model": "base"}},
+            "spec": {"containers": [{"name": "engine",
+                                     "ports": [{"containerPort": 1}]}]},
+            "status": {"podIP": "", "phase": "Pending",
+                       "containerStatuses": [{
+                           "restartCount": 7,
+                           "state": {"waiting":
+                                     {"reason": "CrashLoopBackOff"}}}]},
+        })
+        sig = fleet.wait_signal(
+            lambda s: s["engines_ready"] == 1 and s["in_flight_total"] == 0)
+        assert sig["replica_hint"] == 1, sig
+
+        # Scale-to-zero: two fully-quiet ticks at the floor park the last
+        # engine slept (pod kept — compile cache warm), then the first
+        # arrival wakes it through the router.
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["fencedPods"] == ["pod-bad"], st
+        assert st["replicaHint"] == 1, "fenced pod inflated the hint"
+        _operator_pass(fleet.k8s.url)
+        st = fleet.cr_status()
+        assert st["lastAutoscaleAction"] == "sleep", st
+        assert st["sleeping"] is True and st["phase"] == "Sleeping", st
+        eng = fleet.engines[survivor]["url"]
+        assert _get_json(f"{eng}/is_sleeping")["is_sleeping"] is True
+        assert survivor in fleet.k8s.bucket(fleet.CORE, "pods")
+
+        wake_start = time.time()
+        status, by, _ = post(
+            f"{fleet.url}/v1/completions",
+            {"model": MODEL, "prompt": "wake up", "max_tokens": 4},
+        )
+        wake_s = time.time() - wake_start
+        assert status == 200 and by == survivor, (status, by)
+        assert wake_s < 15, wake_s
+        assert _get_json(f"{eng}/is_sleeping")["is_sleeping"] is False
+    finally:
+        fleet.stop()
+    print(f"PASS autoscale_scaledown (victim {victim} drained, 3 streams "
+          f"intact, wake->first-token {wake_s:.2f}s)")
+
+
 LEGS = {
     "roundrobin": leg_roundrobin,
     "session": leg_session,
@@ -1507,6 +1850,8 @@ LEGS = {
     "tenant_flood": leg_tenant_flood,
     "fleet_observability": leg_fleet_observability,
     "capacity": leg_capacity,
+    "autoscale_surge": leg_autoscale_surge,
+    "autoscale_scaledown": leg_autoscale_scaledown,
 }
 
 

@@ -618,6 +618,85 @@ async def test_autoscale_signal_two_replica_agreement():
         reset_router_singletons()
 
 
+async def test_autoscale_signal_hint_converges_on_disagreement():
+    """The operator's max-merge depends on replicas NOT disagreeing for
+    long: burn/queue evidence is replica-local (only the replica that
+    proxied a slow request burns budget), so when one replica alone
+    observes page-level burn, the other must still serve the same
+    elevated ``replica_hint`` within one gossip sync interval — the
+    evidence rides the fleet snapshot and compute_signal max-merges it."""
+    from production_stack_tpu.router.app import create_app
+    from production_stack_tpu.router.parser import parse_args
+
+    engine_app = create_fake_engine_app(model=MODEL, speed=5000)
+    engine_runner, engine_url = await _start_site(engine_app)
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    runners, apps = [], []
+    try:
+        for i, port in enumerate(ports):
+            app = create_app(parse_args([
+                "--service-discovery", "static",
+                "--static-backends", engine_url,
+                "--static-models", MODEL,
+                "--engine-stats-interval", "0.2",
+                "--slo-ttft-ms", "200",
+                "--state-backend", "gossip",
+                "--state-peers",
+                ",".join(u for j, u in enumerate(urls) if j != i),
+                "--state-sync-interval", "0.1",
+                "--state-peer-timeout", "1.0",
+                "--state-replica-id", f"r{i}",
+            ]))
+            runner, _ = await _start_site(app, port)
+            runners.append(runner)
+            apps.append(app)
+        await asyncio.sleep(0.6)  # membership + first snapshot exchange
+
+        async with aiohttp.ClientSession() as sess:
+            # Baseline: both replicas idle, hints agree.
+            base = []
+            for url in urls:
+                async with sess.get(f"{url}/autoscale/signal") as resp:
+                    assert resp.status == 200
+                    base.append(await resp.json())
+            assert base[0]["replica_hint"] == base[1]["replica_hint"]
+
+            # Disagreement: ONLY replica 0 observes page-level burn
+            # (50 blown-TTFT events into ITS monitor; replica 1's
+            # windows stay clean).
+            for _ in range(50):
+                apps[0]["capacity_monitor"].observe(False)
+            local = compute_signal(apps[0]["capacity_monitor"], apps[0])
+            assert local["page_burning"] is True
+
+            # Within one sync interval the evidence gossips across and
+            # replica 1 — which saw zero bad requests — serves the same
+            # page-burning verdict and the same elevated hint.
+            deadline = time.time() + 5.0
+            signals = []
+            while time.time() < deadline:
+                await asyncio.sleep(0.15)
+                signals = []
+                for url in urls:
+                    async with sess.get(f"{url}/autoscale/signal") as resp:
+                        assert resp.status == 200
+                        signals.append(await resp.json())
+                if (signals[1]["page_burning"]
+                        and signals[0]["replica_hint"]
+                        == signals[1]["replica_hint"]):
+                    break
+            assert signals[1]["page_burning"] is True, signals[1]
+            assert signals[1]["evidence_replicas"] == 2
+            assert signals[0]["replica_hint"] == signals[1]["replica_hint"]
+            assert signals[1]["replica_hint"] > base[1]["replica_hint"]
+    finally:
+        await engine_runner.cleanup()
+        for runner in reversed(runners):
+            await runner.cleanup()
+        reset_router_singletons()
+
+
 async def test_autoscale_signal_404_when_disabled():
     from production_stack_tpu.router.app import create_app
     from production_stack_tpu.router.parser import parse_args

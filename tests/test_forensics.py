@@ -374,6 +374,10 @@ def _passing_round() -> dict:
                    "kvserver_kill": {"hit_rate_delta": 0.01,
                                      "meets_target": True,
                                      "requests_ok": True, "fallbacks": 0}},
+        "autoscale": {"absorb_seconds": 4.0, "p99_during_absorb_ms": 180.0,
+                      "cold_compiles_on_new_replicas": 0,
+                      "failed_during_absorb": 0,
+                      "wake_to_first_token_s": 0.4, "meets_target": True},
     }
 
 
@@ -407,6 +411,7 @@ def _set(d: dict, path, value) -> dict:
     (("cost", "overlap", "attributed_fraction"), 0.5, "cost_attribution"),
     (("disagg", "kvserver_kill", "meets_target"), False,
      "kvserver_kill_hold"),
+    (("autoscale", "meets_target"), False, "autoscale_surge_absorb"),
     (("sweep",), [{"qps": 0.5, "p50_ttft_ms": 100.0,
                    "p99_ttft_ms": 1000.0}], "tail_shape"),
 ])

@@ -311,6 +311,40 @@ class TestConfigContract:
         assert "--mode is not documented" in joined        # docs row
         assert len(active) == 8, [f.message for f in active]
 
+    def test_autoscale_contract_fires_both_directions(self, tmp_path):
+        """The TPURuntime spec.autoscale.* knobs are contract-checked
+        against their four surfaces (CRD schema, reconciler reads,
+        sample CR, docs). Mutating the registry (one ghost knob added,
+        one real knob dropped) must fire every direction against the
+        REAL repo anchors."""
+        analysis = tmp_path / "analysis"
+        analysis.mkdir()
+        src = (
+            REPO / "production_stack_tpu/analysis/config_registry.py"
+        ).read_text()
+        src += (
+            "\nAUTOSCALE_KEYS = tuple(\n"
+            "    s for s in AUTOSCALE_KEYS if s.key != 'scaleToZero'\n"
+            ") + (AutoscaleKeySpec('ghostKnob'),)\n"
+        )
+        (analysis / "config_registry.py").write_text(src)
+        router = tmp_path / "router"
+        router.mkdir()
+        (router / "parser.py").write_text(
+            (REPO / "production_stack_tpu/router/parser.py").read_text()
+        )
+        active = lint_with_root(tmp_path, REPO, "config-contract")
+        msgs = "\n".join(f.message for f in active)
+        assert "AutoscaleKeySpec 'ghostKnob' is absent from" in msgs
+        assert "'ghostKnob' is never read by" in msgs
+        assert "'ghostKnob' is missing from the sample CR" in msgs
+        assert "'ghostKnob' is not documented in" in msgs
+        assert "CRD autoscale key 'scaleToZero' has no AutoscaleKeySpec" \
+            in msgs
+        assert "reads spec.autoscale.scaleToZero but no AutoscaleKeySpec" \
+            in msgs
+        assert len(active) == 6, [f.message for f in active]
+
 
 class TestSuppressionMachinery:
     def test_reasonless_disable_is_flagged_and_inert(self):
